@@ -1,0 +1,43 @@
+"""spark_rapids_ml_tpu — a TPU-native Spark-ML-shaped accelerator framework.
+
+A from-scratch JAX/XLA re-design of the capability surface of
+wbo4958/spark-rapids-ml (the 22.12-era Scala/JVM module): drop-in
+``PCA``-style estimators (``setInputCol/setOutputCol/setK/fit/transform/
+save/load``) whose accelerator substrate is JAX/XLA on TPU instead of
+cuDF/RAFT/cuBLAS/cuSolver on GPU.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+- ``models``   — estimator/model layer (reference L5/L6: RapidsPCA / PCA).
+- ``ops``      — pure-JAX device kernels (reference L1/L3: rapidsml_jni.cu /
+                 RAPIDSML.scala). Gram, eigh-descending + signflip, projection,
+                 scaler stats, KMeans steps. All ``jax.jit``-able, static shapes.
+- ``parallel`` — distributed layer (reference L4 + its Spark reduce):
+                 device meshes, ``shard_map``/``psum`` Gram allreduce over ICI,
+                 ring feature-sharded Gram, host tree-aggregate fallback.
+- ``utils``    — columnar ingestion (Arrow; the ColumnarRdd analog),
+                 persistence (params JSON + parquet), tracing (NVTX analog).
+- ``bridge``   — native C++ runtime module (reference L2/C7: JniRAPIDSML +
+                 librapidsml_jni.so analog): columnar packing and a
+                 host-side fallback linalg backend behind a C ABI.
+"""
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy top-level re-exports so `import spark_rapids_ml_tpu` stays cheap
+    # (no JAX import) until an estimator is actually touched.
+    if name in ("PCA", "PCAModel"):
+        from spark_rapids_ml_tpu.models import pca
+
+        return getattr(pca, name)
+    if name in ("KMeans", "KMeansModel"):
+        from spark_rapids_ml_tpu.models import kmeans
+
+        return getattr(kmeans, name)
+    if name in ("StandardScaler", "StandardScalerModel", "Normalizer"):
+        from spark_rapids_ml_tpu.models import scaler
+
+        return getattr(scaler, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,18 @@
+"""Pure-JAX device kernels — the TPU replacement for the reference native layer.
+
+Every function here is shape-static, functional, and ``jax.jit``-compatible so
+XLA can tile the matmuls onto the MXU and fuse the elementwise epilogues.
+"""
+
+from spark_rapids_ml_tpu.ops.linalg import (  # noqa: F401
+    GramStats,
+    combine_gram_stats,
+    eigh_descending,
+    explained_variance,
+    gram,
+    gram_stats,
+    pca_fit_from_cov,
+    pca_fit_local,
+    project,
+    sign_flip,
+)

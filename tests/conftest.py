@@ -1,0 +1,38 @@
+"""Test harness configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4) but fixes its biggest
+gap: everything here runs WITHOUT accelerator hardware. We force the JAX CPU
+backend with 8 virtual devices so the multi-chip sharding paths
+(shard_map/psum over a Mesh) compile and execute in any environment —
+the analog of the reference exercising "distributed" behavior with
+2-partition local RDDs (PCASuite.scala:55-56).
+
+This must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# f64 on the CPU backend so differential tests can hold tight tolerances
+# against NumPy oracles; the framework code itself is dtype-agnostic.
+jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache so repeated test runs don't re-trace/compile.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
