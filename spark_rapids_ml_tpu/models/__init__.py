@@ -1,0 +1,1 @@
+"""Estimator/Model layer — Spark-ML-shaped API over the JAX kernel core."""

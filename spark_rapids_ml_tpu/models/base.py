@@ -1,0 +1,78 @@
+"""Estimator / Transformer / Model base classes with save/load.
+
+The Spark ML pipeline-stage contract the reference builds on:
+``Estimator.fit(dataset) -> Model``, ``Transformer.transform(dataset)``,
+``MLWritable.save/MLReadable.load`` (RapidsPCA.scala:52-88,102-185).
+"""
+
+from __future__ import annotations
+
+import importlib
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from spark_rapids_ml_tpu.models.params import Params
+from spark_rapids_ml_tpu.utils import persistence
+
+
+class Saveable(Params):
+    """DefaultParamsWritable/Readable analog.
+
+    Subclasses override ``_saveData``/``_loadData`` for ndarray payloads
+    (models); pure-params stages (estimators, Normalizer) need nothing else.
+    """
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        p = Path(path)
+        if p.exists() and not overwrite:
+            raise FileExistsError(f"{path} already exists (use overwrite=True)")
+        persistence.save_metadata(p, self)
+        data = self._saveData()
+        if data:
+            persistence.save_arrays(p, data)
+
+    # Spark-style fluent alias: model.write().overwrite().save(path) collapses
+    # to save(path, overwrite=True) here.
+    def write(self) -> "Saveable":
+        return self
+
+    def overwrite(self) -> "Saveable":
+        self._overwrite = True
+        return self
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {}
+
+    @classmethod
+    def load(cls, path: str) -> Any:
+        meta = persistence.load_metadata(path)
+        module, _, qualname = meta["class"].rpartition(".")
+        klass = getattr(importlib.import_module(module), qualname)
+        if not issubclass(klass, cls) and cls is not Saveable:
+            raise TypeError(f"{path} holds a {klass.__name__}, not a {cls.__name__}")
+        data = {}
+        if (Path(path) / "data.parquet").exists():
+            data = persistence.load_arrays(path)
+        instance = klass._fromSaved(meta["uid"], data)
+        instance._restoreParamState(meta)
+        return instance
+
+    @classmethod
+    def _fromSaved(cls, uid: str, data: dict[str, np.ndarray]):
+        return cls(uid=uid)
+
+
+class Transformer(Saveable):
+    def transform(self, dataset: Any) -> Any:
+        raise NotImplementedError
+
+
+class Estimator(Saveable):
+    def fit(self, dataset: Any) -> "Model":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer produced by an Estimator."""

@@ -1,0 +1,141 @@
+"""A Spark-ML-shaped Params system.
+
+The reference's estimator layer leans on Spark ML's ``Params`` machinery —
+typed ``Param`` objects with defaults, fluent ``setX`` builders, ``copy``
+with uid preservation, and JSON round-tripping through
+``DefaultParamsWriter/Reader`` (RapidsPCA.scala:34-45,193-229). This module
+provides the same contract natively in Python so estimators here feel
+byte-identical to the reference's API surface
+(``PCA().setInputCol("features").setK(3).fit(df)``).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import uuid
+from typing import Any, Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class Param(Generic[T]):
+    """A typed parameter descriptor owned by a Params class."""
+
+    def __init__(self, name: str, doc: str, convert: Callable[[Any], T] | None = None):
+        self.name = name
+        self.doc = doc
+        self.convert = convert
+
+    def __repr__(self):
+        return f"Param({self.name})"
+
+
+class Params:
+    """Base class carrying a param map + default map keyed by param name.
+
+    Mirrors Spark ML semantics: explicitly-set values shadow defaults
+    (``getOrDefault``), ``copy()`` deep-copies the maps but keeps class
+    identity, and ``uid`` identifies instances across save/load.
+    """
+
+    def __init__(self, uid: str | None = None):
+        self.uid = uid or f"{type(self).__name__}_{uuid.uuid4().hex[:12]}"
+        self._paramMap: dict[str, Any] = {}
+        self._defaultParamMap: dict[str, Any] = {}
+
+    # -- param discovery ----------------------------------------------------
+    @classmethod
+    def params(cls) -> list[Param]:
+        out = []
+        for klass in cls.__mro__:
+            for v in vars(klass).values():
+                if isinstance(v, Param) and v not in out:
+                    out.append(v)
+        return out
+
+    def _param(self, name: str) -> Param:
+        for p in type(self).params():
+            if p.name == name:
+                return p
+        raise KeyError(f"{type(self).__name__} has no param {name!r}")
+
+    # -- get/set ------------------------------------------------------------
+    def _set(self, **kwargs) -> "Params":
+        for name, value in kwargs.items():
+            p = self._param(name)
+            if value is not None and p.convert is not None:
+                value = p.convert(value)
+            self._paramMap[name] = value
+        return self
+
+    def _setDefault(self, **kwargs) -> "Params":
+        self._defaultParamMap.update(kwargs)
+        return self
+
+    def isSet(self, name: str) -> bool:
+        return name in self._paramMap
+
+    def hasDefault(self, name: str) -> bool:
+        return name in self._defaultParamMap
+
+    def getOrDefault(self, name: str) -> Any:
+        if name in self._paramMap:
+            return self._paramMap[name]
+        if name in self._defaultParamMap:
+            return self._defaultParamMap[name]
+        raise KeyError(f"param {name!r} is not set and has no default")
+
+    # -- lifecycle ----------------------------------------------------------
+    def copy(self) -> "Params":
+        other = _copy.copy(self)
+        other._paramMap = dict(self._paramMap)
+        other._defaultParamMap = dict(self._defaultParamMap)
+        return other
+
+    def _copyValues(self, to: "Params") -> "Params":
+        """Propagate this instance's params onto ``to`` (estimator → model),
+        like Spark's ``copyValues`` (used at RapidsPCA.scala:79)."""
+        for p in type(to).params():
+            if p.name in self._paramMap:
+                to._paramMap[p.name] = self._paramMap[p.name]
+        return to
+
+    def explainParams(self) -> str:
+        lines = []
+        for p in type(self).params():
+            cur = self._paramMap.get(p.name, self._defaultParamMap.get(p.name))
+            lines.append(f"{p.name}: {p.doc} (current: {cur})")
+        return "\n".join(lines)
+
+    # -- persistence hooks (see utils.persistence) --------------------------
+    def _paramState(self) -> dict:
+        return {"paramMap": dict(self._paramMap), "defaultParamMap": dict(self._defaultParamMap)}
+
+    def _restoreParamState(self, state: dict) -> None:
+        self._paramMap.update(state.get("paramMap", {}))
+        self._defaultParamMap.update(state.get("defaultParamMap", {}))
+
+
+# ---------------------------------------------------------------------------
+# Shared param mixins (Spark ML's HasInputCol / HasOutputCol / PCAParams shape)
+# ---------------------------------------------------------------------------
+
+
+class HasInputCol(Params):
+    inputCol = Param("inputCol", "name of the input ArrayType column", str)
+
+    def setInputCol(self, value: str):
+        return self._set(inputCol=value)
+
+    def getInputCol(self) -> str:
+        return self.getOrDefault("inputCol")
+
+
+class HasOutputCol(Params):
+    outputCol = Param("outputCol", "name of the output column", str)
+
+    def setOutputCol(self, value: str):
+        return self._set(outputCol=value)
+
+    def getOutputCol(self) -> str:
+        return self.getOrDefault("outputCol")

@@ -1,0 +1,211 @@
+"""PCA estimator and model — the reference's flagship capability, TPU-native.
+
+API parity targets (SURVEY.md §1 L5/L6):
+- ``com.nvidia.spark.ml.feature.PCA`` drop-in surface (PCA.scala:27-37):
+  ``setInputCol`` (an **ArrayType** column, not a Vector — README.md:35-37),
+  ``setOutputCol``, ``setK``, ``fit``, companion ``load``.
+- ``RapidsPCA``/``RapidsPCAModel`` behavior (RapidsPCA.scala:52-185):
+  ``meanCentering`` param, dual-path transform (accelerated columnar +
+  CPU row fallback), params-JSON + parquet persistence.
+
+Semantics preserved exactly (SURVEY.md §3.1 "numerical semantics"):
+- the "covariance" is the scatter-form Gram (no 1/(n-1) scaling),
+- components come out in descending eigenvalue order, sign-flipped so each
+  column's max-|element| is positive,
+- explainedVariance = sᵢ/Σs over the FULL singular-value spectrum (s = √λ),
+  truncated to k — the reference's non-textbook definition.
+
+One deliberate deviation, documented: the reference *accepts* meanCentering
+but never implements it (TODO stub, RapidsRowMatrix.scala:111-117) — its
+observable behavior is always the uncentered Gram. Here the param works.
+``meanCentering=False`` (the default, matching observable reference behavior)
+reproduces the reference bit-for-bit semantics; ``True`` actually centers.
+
+TPU-first architecture notes: each partition's Gram rides one large MXU
+matmul on zero-padded power-of-two row buckets (static shapes ⇒ a handful of
+XLA programs, compiled once); partials reduce as a ``GramStats`` monoid
+(host tree-aggregate here; ``parallel`` owns the mesh/psum variant); the n×n
+decomposition runs on device via the refined eigh (ops.linalg.refine_eigh).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
+from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+try:
+    import pyarrow as pa
+except Exception:  # pragma: no cover
+    pa = None
+
+
+class PCAParams(HasInputCol, HasOutputCol):
+    """Shared params — the RapidsPCAParams analog (RapidsPCA.scala:34-45)."""
+
+    k = Param("k", "number of principal components", int)
+    meanCentering = Param(
+        "meanCentering",
+        "center the data before computing the covariance (the reference "
+        "accepts this but computes the uncentered Gram regardless; False "
+        "reproduces reference behavior exactly)",
+        bool,
+    )
+
+    def __init__(self, uid: str | None = None):
+        super().__init__(uid)
+        self._setDefault(meanCentering=False, outputCol="pca_features")
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def getMeanCentering(self) -> bool:
+        return self.getOrDefault("meanCentering")
+
+
+# Module-level jitted kernels: jax.jit caches per input shape, and row
+# bucketing keeps the set of shapes small.
+_gram_stats = jax.jit(L.gram_stats)
+
+
+def _fit_from_stats(stats: L.GramStats, k: int, mean_centering: bool):
+    cov = L.covariance_from_stats(stats, mean_centering=mean_centering)
+    return L.pca_fit_from_cov(cov, k)
+
+
+_fit_from_stats_jit = jax.jit(_fit_from_stats, static_argnums=(1, 2))
+_project = jax.jit(L.project)
+
+
+class PCA(PCAParams, Estimator):
+    """TPU-accelerated PCA with the reference's drop-in API.
+
+    >>> model = PCA().setInputCol("features").setOutputCol("pca").setK(3).fit(df)
+    >>> out = model.transform(df)
+    """
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid)
+        if kwargs:
+            self._set(**{k: v for k, v in kwargs.items() if v is not None})
+
+    def setK(self, value: int) -> "PCA":
+        return self._set(k=value)
+
+    def setMeanCentering(self, value: bool) -> "PCA":
+        return self._set(meanCentering=value)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "PCAModel":
+        """Two-phase fit, mirroring the reference call stack (SURVEY.md §3.1):
+        per-partition device Gram accumulation + cross-partition reduce, then
+        a single device decomposition."""
+        input_col = self._paramMap.get("inputCol") or self._defaultParamMap.get("inputCol")
+        ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
+        k = self.getK()
+        mean_centering = self.getMeanCentering()
+
+        with trace_range("compute cov"):  # NvtxRange analog, RapidsRowMatrix.scala:62
+            partials = []
+            n_cols = None
+            for mat in ds.matrices():
+                if n_cols is None:
+                    n_cols = mat.shape[1]  # infer nCols like RapidsPCA.scala:74
+                elif mat.shape[1] != n_cols:
+                    raise ValueError(
+                        f"inconsistent feature dim: {mat.shape[1]} != {n_cols}"
+                    )
+                padded, true_rows = columnar.pad_rows(mat)
+                stats = _gram_stats(jnp.asarray(padded))
+                # padding adds zero rows: fix only the count
+                partials.append(
+                    L.GramStats(stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype))
+                )
+            from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+
+            stats = tree_reduce(partials, L.combine_gram_stats)
+        if k > n_cols:
+            raise ValueError(f"k={k} must be <= number of features {n_cols}")
+
+        with trace_range("eigh"):  # "cuSolver SVD" range analog, RapidsRowMatrix.scala:70
+            pc, explained = _fit_from_stats_jit(stats, k, mean_centering)
+
+        model = PCAModel(
+            uid=self.uid,
+            pc=np.asarray(pc),
+            explainedVariance=np.asarray(explained),
+        )
+        return self._copyValues(model)
+
+
+class PCAModel(PCAParams, Model):
+    """Fitted PCA model: ``pc`` [n, k] and ``explainedVariance`` [k].
+
+    ``transform`` is dual-path like the reference (RapidsPCA.scala:128-161):
+    the columnar path projects whole batches on device; ``transform_rows`` is
+    the row-at-a-time CPU fallback (``apply``, RapidsPCA.scala:157-160).
+    """
+
+    def __init__(
+        self,
+        uid: str | None = None,
+        pc: np.ndarray | None = None,
+        explainedVariance: np.ndarray | None = None,
+    ):
+        super().__init__(uid)
+        self.pc = None if pc is None else np.asarray(pc)
+        self.explainedVariance = (
+            None if explainedVariance is None else np.asarray(explainedVariance)
+        )
+
+    # -- transform ----------------------------------------------------------
+    def _project_matrix(self, mat: np.ndarray) -> np.ndarray:
+        padded, true_rows = columnar.pad_rows(mat)
+        out = _project(jnp.asarray(padded), jnp.asarray(self.pc, dtype=padded.dtype))
+        return np.asarray(out)[:true_rows]
+
+    def transform(self, dataset: Any) -> Any:
+        """Project the input column; returns the same container type with the
+        output column appended (ArrayType-shaped, like the reference)."""
+        input_col = self._paramMap.get("inputCol")
+        output_col = self.getOutputCol()
+        with trace_range("pca transform"):
+            if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
+                mat = columnar.extract_matrix(dataset, input_col)
+                out = self._project_matrix(mat)
+                col = columnar.matrix_to_arrow_column(out)
+                if isinstance(dataset, pa.RecordBatch):
+                    dataset = pa.Table.from_batches([dataset])
+                return dataset.append_column(output_col, col)
+            if hasattr(dataset, "columns") and hasattr(dataset, "assign") and input_col:
+                mat = columnar.extract_matrix(dataset, input_col)
+                out = self._project_matrix(mat)
+                return dataset.assign(**{output_col: list(out)})
+            if isinstance(dataset, columnar.PartitionedDataset):
+                return columnar.PartitionedDataset(
+                    [self._project_matrix(m) for m in dataset.matrices()],
+                    dataset.input_col,
+                )
+            mat = columnar.extract_matrix(dataset, input_col)
+            return self._project_matrix(mat)
+
+    def transform_rows(self, rows) -> list[np.ndarray]:
+        """CPU row-fallback path (reference ``apply``, RapidsPCA.scala:157-160):
+        pcᵀ·row per row, no accelerator involved."""
+        pct = self.pc.T
+        return [pct @ np.asarray(r) for r in rows]
+
+    # -- persistence ----------------------------------------------------------
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"pc": self.pc, "explainedVariance": self.explainedVariance}
+
+    @classmethod
+    def _fromSaved(cls, uid: str, data: dict[str, np.ndarray]) -> "PCAModel":
+        return cls(uid=uid, pc=data["pc"], explainedVariance=data["explainedVariance"])

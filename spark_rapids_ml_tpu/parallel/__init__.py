@@ -1,0 +1,1 @@
+"""Distributed layer: meshes, collectives, sharded Gram, host aggregation."""

@@ -1,0 +1,34 @@
+"""Host-side tree reduction — the portable cross-partition reducer.
+
+The reference reduces per-partition covariance partials on the JVM heap via
+Spark's ``RDD.reduce((a, b) => a + b)`` (RapidsRowMatrix.scala:139) — a
+shuffle-mediated tree. This is the equivalent portable path for when
+partitions are *not* co-scheduled as one SPMD mesh program: a balanced
+pairwise tree over host/device values. The mesh-native reducer (psum over
+ICI) lives in ``parallel.gram``.
+
+Tree (vs left-fold) matters twice: it bounds the f32 accumulation error
+chain at O(log n) combines, and its pairwise rounds mirror how a real
+multi-host reduction would execute.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def tree_reduce(items: Sequence[T], combine: Callable[[T, T], T]) -> T:
+    """Balanced pairwise reduction of a non-empty sequence."""
+    items = list(items)
+    if not items:
+        raise ValueError("cannot reduce an empty sequence")
+    while len(items) > 1:
+        nxt = []
+        for i in range(0, len(items) - 1, 2):
+            nxt.append(combine(items[i], items[i + 1]))
+        if len(items) % 2:
+            nxt.append(items[-1])
+        items = nxt
+    return items[0]

@@ -1,0 +1,1 @@
+"""Runtime utilities: columnar ingestion, persistence, tracing."""

@@ -1,0 +1,192 @@
+"""Columnar data ingestion — the TPU build's ColumnarRdd/ArrayType analog.
+
+The reference gets device-resident columnar input for free from the
+spark-rapids plugin: ``ColumnarRdd(df)`` yields cudf Tables on GPU
+(RapidsRowMatrix.scala:23,118), and its public API takes an **ArrayType**
+column rather than Spark ``Vector`` (README.md:35-37). That columnar engine is
+CUDA-only, so this module owns the equivalent data path for TPU:
+
+- accept "ArrayType-column"-shaped data from the containers available here
+  (pyarrow Tables/RecordBatches with list columns, pandas DataFrames with
+  object columns of arrays, plain ndarrays),
+- extract a contiguous row-major [rows, n] block with zero copies whenever
+  the Arrow layout allows it (fixed-size-list / list with uniform lengths,
+  no nulls),
+- bucket-pad row counts so variable-sized partitions map onto a small set of
+  static XLA program shapes (TPU: compile once per bucket, not per batch).
+
+``PartitionedDataset`` is the RDD stand-in: an ordered list of columnar
+partitions with map/collect helpers, so estimators express "per-partition
+kernel + cross-partition reduce" exactly like the reference's
+``ColumnarRdd(df).map{...}.reduce(...)`` without depending on Spark.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+try:  # pyarrow is present in the image, but keep the core importable without it
+    import pyarrow as pa
+except Exception:  # pragma: no cover
+    pa = None
+
+
+# ---------------------------------------------------------------------------
+# Column extraction
+# ---------------------------------------------------------------------------
+
+
+def _from_arrow_column(col) -> np.ndarray:
+    """Arrow list/fixed_size_list column → [rows, n] ndarray, zero-copy when
+    the child values buffer is contiguous and null-free."""
+    if isinstance(col, pa.ChunkedArray):
+        if col.num_chunks == 1:
+            return _from_arrow_column(col.chunk(0))
+        return np.concatenate([_from_arrow_column(c) for c in col.chunks])
+    if pa.types.is_fixed_size_list(col.type):
+        n = col.type.list_size
+        if col.null_count:
+            raise ValueError("null rows are not supported in the input column")
+        values = col.values.to_numpy(zero_copy_only=False)
+        return values.reshape(-1, n)[col.offset : col.offset + len(col)]
+    if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+        if col.null_count:
+            raise ValueError("null rows are not supported in the input column")
+        offsets = col.offsets.to_numpy(zero_copy_only=False)
+        lengths = np.diff(offsets)
+        if len(lengths) == 0:
+            raise ValueError("empty input column")
+        n = int(lengths[0])
+        if not np.all(lengths == n):
+            raise ValueError("ragged rows: all rows must have equal length")
+        values = col.values.to_numpy(zero_copy_only=False)
+        return values[offsets[0] : offsets[-1]].reshape(-1, n)
+    raise TypeError(f"unsupported Arrow column type for ArrayType input: {col.type}")
+
+
+def extract_matrix(data: Any, input_col: str | None = None) -> np.ndarray:
+    """Extract a row-major [rows, n] float matrix from any supported container.
+
+    Supported: 2-D ndarray / JAX array; pyarrow Table/RecordBatch (list or
+    fixed-size-list column named ``input_col``); pandas DataFrame whose
+    ``input_col`` holds per-row arrays/lists (the ArrayType shape); and
+    sequences of per-row arrays.
+    """
+    if pa is not None and isinstance(data, (pa.Table, pa.RecordBatch)):
+        if input_col is None:
+            raise ValueError("input_col is required for Arrow tables")
+        return _from_arrow_column(data.column(input_col))
+    # pandas without importing it eagerly
+    if hasattr(data, "columns") and hasattr(data, "__getitem__") and input_col is not None:
+        try:
+            series = data[input_col]
+        except Exception:
+            series = None
+        if series is not None and hasattr(series, "to_numpy"):
+            rows = series.to_numpy()
+            return np.stack([np.asarray(r) for r in rows])
+    arr = np.asarray(data)
+    if arr.ndim == 2:
+        return arr
+    if arr.ndim == 1 and arr.dtype == object:
+        return np.stack([np.asarray(r) for r in arr])
+    raise TypeError(
+        f"cannot extract a [rows, n] matrix from {type(data).__name__}"
+        + (f" column {input_col!r}" if input_col else "")
+    )
+
+
+def matrix_to_arrow_column(x: np.ndarray):
+    """[rows, k] ndarray → Arrow FixedSizeList column (zero-copy values).
+
+    The transform output stays an "ArrayType" column like the reference's
+    (RapidsPCA.scala:98-104 builds a cudf LIST column the same way).
+    """
+    rows, k = x.shape
+    values = pa.array(np.ascontiguousarray(x).reshape(-1))
+    return pa.FixedSizeListArray.from_arrays(values, k)
+
+
+# ---------------------------------------------------------------------------
+# Shape bucketing
+# ---------------------------------------------------------------------------
+
+
+def bucket_rows(rows: int, *, min_bucket: int = 128) -> int:
+    """Round a row count up to the next power-of-two bucket.
+
+    XLA compiles one program per distinct shape; padding partitions to
+    power-of-two buckets bounds the number of compilations at log₂(max/min)
+    while wasting <2x FLOPs worst case. Zero-padding is exact for every
+    reduction we run (Gram, column sums, scaler moments): padded rows
+    contribute zero, and true counts ride in ``GramStats.count``.
+    """
+    return max(min_bucket, 1 << math.ceil(math.log2(max(rows, 1))))
+
+
+def pad_rows(x: np.ndarray, *, min_bucket: int = 128) -> tuple[np.ndarray, int]:
+    """Zero-pad [rows, n] to its row bucket; returns (padded, true_rows)."""
+    rows = x.shape[0]
+    bucket = bucket_rows(rows, min_bucket=min_bucket)
+    if bucket == rows:
+        return x, rows
+    out = np.zeros((bucket, x.shape[1]), dtype=x.dtype)
+    out[:rows] = x
+    return out, rows
+
+
+# ---------------------------------------------------------------------------
+# Partitioned dataset (RDD stand-in)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PartitionedDataset:
+    """An ordered collection of columnar partitions with an input column.
+
+    The minimal RDD-shaped surface the estimators need: per-partition map and
+    an ordered collect. Reduction strategy is owned by ``parallel`` (host
+    tree-aggregate or mesh psum), not by the dataset.
+    """
+
+    partitions: list[Any]
+    input_col: str | None = None
+
+    @staticmethod
+    def from_any(
+        data: Any, input_col: str | None = None, num_partitions: int | None = None
+    ) -> "PartitionedDataset":
+        """Wrap any supported container; optionally re-split into
+        ``num_partitions`` row slices (the test harness's analog of
+        ``sc.parallelize(data, 2)`` in PCASuite.scala:55-56)."""
+        if isinstance(data, PartitionedDataset):
+            return data
+        if isinstance(data, (list, tuple)) and data and (
+            pa is not None and isinstance(data[0], (pa.Table, pa.RecordBatch))
+        ):
+            return PartitionedDataset(list(data), input_col)
+        x = extract_matrix(data, input_col)
+        if num_partitions and num_partitions > 1:
+            splits = np.array_split(x, num_partitions)
+        else:
+            splits = [x]
+        return PartitionedDataset(splits, input_col)
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+    def matrices(self) -> Iterator[np.ndarray]:
+        for p in self.partitions:
+            yield extract_matrix(p, self.input_col)
+
+    def map_matrices(self, fn: Callable[[np.ndarray], Any]) -> list[Any]:
+        return [fn(m) for m in self.matrices()]
+
+    def collect_matrix(self) -> np.ndarray:
+        mats = list(self.matrices())
+        return mats[0] if len(mats) == 1 else np.concatenate(mats)

@@ -1,0 +1,88 @@
+"""Model persistence — params JSON + parquet data, reference layout.
+
+The reference persists models as Spark ML does (RapidsPCA.scala:193-229):
+``path/metadata`` holds a params JSON (class, uid, timestamp, param map) and
+``path/data`` holds a 1-partition parquet of the model payload. We keep that
+exact on-disk shape — ``metadata.json`` + ``data.parquet`` — with ndarray
+payloads stored as flattened parquet columns plus shape metadata, so saved
+models are inspectable with stock Arrow tooling.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+except Exception:  # pragma: no cover
+    pa = None
+    pq = None
+
+_LIBRARY_VERSION_KEY = "libraryVersion"
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (np.integer, np.floating)):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+def save_metadata(path: str | Path, instance, extra: dict | None = None) -> None:
+    """DefaultParamsWriter.saveMetadata analog (RapidsPCA.scala:196)."""
+    from spark_rapids_ml_tpu import __version__
+
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    state = instance._paramState()
+    meta = {
+        "class": f"{type(instance).__module__}.{type(instance).__qualname__}",
+        "timestamp": int(time.time() * 1000),
+        _LIBRARY_VERSION_KEY: __version__,
+        "uid": instance.uid,
+        "paramMap": {k: _jsonable(v) for k, v in state["paramMap"].items()},
+        "defaultParamMap": {k: _jsonable(v) for k, v in state["defaultParamMap"].items()},
+    }
+    if extra:
+        meta.update(extra)
+    (path / "metadata.json").write_text(json.dumps(meta, indent=2))
+
+
+def load_metadata(path: str | Path) -> dict:
+    return json.loads((Path(path) / "metadata.json").read_text())
+
+
+def save_arrays(path: str | Path, arrays: dict[str, np.ndarray]) -> None:
+    """Write named ndarrays as one single-row-group parquet file — the analog
+    of the reference's ``repartition(1).write.parquet`` (RapidsPCA.scala:197-199)."""
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    cols, names, shapes = [], [], {}
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)
+        shapes[name] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+        cols.append(pa.array(arr.reshape(-1)))
+        names.append(name)
+    table = pa.table(
+        {n: pa.array([c.to_numpy(zero_copy_only=False)]) for n, c in zip(names, cols)}
+    )
+    table = table.replace_schema_metadata({"tpu_ml_shapes": json.dumps(shapes)})
+    pq.write_table(table, path / "data.parquet")
+
+
+def load_arrays(path: str | Path) -> dict[str, np.ndarray]:
+    table = pq.read_table(Path(path) / "data.parquet")
+    shapes = json.loads(table.schema.metadata[b"tpu_ml_shapes"].decode())
+    out = {}
+    for name in table.column_names:
+        flat = np.asarray(table.column(name).to_pylist()[0])
+        info = shapes[name]
+        out[name] = flat.astype(info["dtype"]).reshape(info["shape"])
+    return out

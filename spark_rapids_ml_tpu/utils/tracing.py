@@ -1,0 +1,46 @@
+"""Tracing / profiling annotations — the NVTX-range analog.
+
+The reference wraps its two training phases in NVTX ranges visible in Nsight
+(``NvtxRange("compute cov", RED)`` / ``NvtxRange("cuSolver SVD", BLUE)``,
+RapidsRowMatrix.scala:62,70). On TPU the equivalent surface is xprof /
+TensorBoard: ``jax.profiler.TraceAnnotation`` marks host spans and
+``jax.named_scope`` tags the traced HLO so the phases are findable in a
+device profile. ``trace_range`` layers both, plus wall-clock accounting into
+a process-local metrics registry (the observability the reference lacked).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+
+import jax
+
+logger = logging.getLogger("spark_rapids_ml_tpu")
+
+# name -> [total_seconds, call_count]
+_METRICS: dict[str, list[float]] = defaultdict(lambda: [0.0, 0])
+
+
+@contextlib.contextmanager
+def trace_range(name: str):
+    """Host+device trace span with wall-clock metrics accumulation."""
+    start = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
+    elapsed = time.perf_counter() - start
+    m = _METRICS[name]
+    m[0] += elapsed
+    m[1] += 1
+    logger.debug("trace %s: %.3fs", name, elapsed)
+
+
+def metrics() -> dict[str, dict[str, float]]:
+    """Snapshot of accumulated phase timings."""
+    return {k: {"seconds": v[0], "count": v[1]} for k, v in _METRICS.items()}
+
+
+def reset_metrics() -> None:
+    _METRICS.clear()
