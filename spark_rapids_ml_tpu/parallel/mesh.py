@@ -1,0 +1,67 @@
+"""Device-mesh construction helpers.
+
+The reference has no mesh concept — its "cluster" is Spark dynamically
+scheduling partition tasks, with cross-partition reduction through the JVM
+(SURVEY.md §2 "Distributed communication backend"). The TPU-native design
+inverts that: devices form a named ``jax.sharding.Mesh`` and XLA inserts ICI
+collectives for every cross-device movement. Axis conventions used across
+this package:
+
+- ``"data"``  — row/batch parallelism (the reference's partition axis),
+- ``"feat"``  — feature-dimension sharding (the capability the reference
+  lacks: its n×n buffers must fit one device, RapidsRowMatrix.scala:50-52).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FEAT_AXIS = "feat"
+
+
+def create_mesh(
+    data: int | None = None,
+    feat: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build a (data, feat) mesh over the given (default: all) devices.
+
+    With ``data=None`` the data axis absorbs all devices not used by
+    ``feat``. The feat axis is innermost so feature-block ring transfers ride
+    neighboring ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        if len(devices) % feat:
+            raise ValueError(f"{len(devices)} devices not divisible by feat={feat}")
+        data = len(devices) // feat
+    count = data * feat
+    if count > len(devices):
+        raise ValueError(f"mesh {data}x{feat} needs {count} devices, have {len(devices)}")
+    grid = np.array(devices[:count]).reshape(data, feat)
+    return Mesh(grid, (DATA_AXIS, FEAT_AXIS))
+
+
+def data_sharding(mesh: Mesh, *, feature_sharded: bool = False) -> NamedSharding:
+    """Input sharding for a [rows, n] matrix on the mesh."""
+    spec = P(DATA_AXIS, FEAT_AXIS) if feature_sharded else P(DATA_AXIS, None)
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def factor_mesh(n_devices: int) -> tuple[int, int]:
+    """Pick a (data, feat) factorization: feat gets the largest power of two
+    ≤ √n so both axes are exercised whenever possible."""
+    feat = 1
+    while feat * 2 <= int(math.isqrt(n_devices)) and n_devices % (feat * 2) == 0:
+        feat *= 2
+    return n_devices // feat, feat

@@ -12,7 +12,7 @@ This must run before the first ``import jax`` anywhere in the test session.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -20,6 +20,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# The environment may have force-registered an accelerator PJRT plugin at
+# interpreter start (sitecustomize), latching JAX_PLATFORMS before this file
+# runs — override through the config, which wins as long as no backend has
+# been initialized yet.
+jax.config.update("jax_platforms", "cpu")
 
 # f64 on the CPU backend so differential tests can hold tight tolerances
 # against NumPy oracles; the framework code itself is dtype-agnostic.

@@ -1,0 +1,93 @@
+"""Distributed-layer tests on the 8-device virtual CPU mesh.
+
+These validate the SPMD paths the reference never had: psum Gram allreduce,
+the ring feature-sharded Gram, and the end-to-end sharded fit — all compiled
+and executed over a real (virtual-device) Mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.parallel import gram as G
+from spark_rapids_ml_tpu.parallel import mesh as M
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return M.create_mesh(data=4, feat=2)
+
+
+@pytest.fixture
+def x(rng):
+    return rng.normal(size=(256, 32))
+
+
+class TestShardedGram:
+    def test_matches_local(self, mesh8, x, rng):
+        xs = jax.device_put(x, M.data_sharding(mesh8))
+        stats = G.sharded_gram_stats(xs, mesh8)
+        np.testing.assert_allclose(np.asarray(stats.xtx), x.T @ x, rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(stats.col_sum), x.sum(0), rtol=1e-10)
+        assert int(stats.count) == 256
+
+    def test_jit_compiles_once(self, mesh8, x):
+        xs = jax.device_put(x, M.data_sharding(mesh8))
+        fn = jax.jit(lambda a: G.sharded_gram_stats(a, mesh8))
+        s1 = fn(xs)
+        np.testing.assert_allclose(np.asarray(s1.xtx), x.T @ x, rtol=1e-10)
+
+
+class TestRingGram:
+    def test_matches_local(self, mesh8, x):
+        xs = jax.device_put(x, M.data_sharding(mesh8, feature_sharded=True))
+        g, col_sum, count = G.ring_gram(xs, mesh8)
+        np.testing.assert_allclose(np.asarray(g), x.T @ x, rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(col_sum), x.sum(0), rtol=1e-10)
+        assert int(count) == 256
+
+    def test_gram_output_is_feature_sharded(self, mesh8, x):
+        xs = jax.device_put(x, M.data_sharding(mesh8, feature_sharded=True))
+        g, _, _ = G.ring_gram(xs, mesh8)
+        # block-rows live on the feat axis: each shard is [n/feat, n]
+        shard_shapes = {s.data.shape for s in g.addressable_shards}
+        assert shard_shapes == {(16, 32)}
+
+    def test_larger_feat_axis(self, x):
+        mesh = M.create_mesh(data=2, feat=4)
+        xs = jax.device_put(x, M.data_sharding(mesh, feature_sharded=True))
+        g, _, _ = G.ring_gram(xs, mesh)
+        np.testing.assert_allclose(np.asarray(g), x.T @ x, rtol=1e-10)
+
+
+class TestDistributedFit:
+    @pytest.mark.parametrize("feature_sharded", [False, True])
+    @pytest.mark.parametrize("mean_centering", [False, True])
+    def test_matches_single_device(self, mesh8, x, feature_sharded, mean_centering):
+        fit = G.make_distributed_fit(
+            mesh8, 5, mean_centering=mean_centering, feature_sharded=feature_sharded
+        )
+        pc, ev = fit(jnp.asarray(x))
+        pc_ref, ev_ref = L.pca_fit_local(jnp.asarray(x), 5, mean_centering=mean_centering)
+        np.testing.assert_allclose(np.asarray(pc), np.asarray(pc_ref), atol=1e-8)
+        np.testing.assert_allclose(np.asarray(ev), np.asarray(ev_ref), atol=1e-10)
+
+    def test_outputs_replicated(self, mesh8, x):
+        fit = G.make_distributed_fit(mesh8, 3)
+        pc, _ = fit(jnp.asarray(x))
+        assert pc.sharding.is_fully_replicated
+
+
+class TestMeshHelpers:
+    def test_factor_mesh(self):
+        assert M.factor_mesh(8) == (4, 2)
+        assert M.factor_mesh(16) == (4, 4)
+        assert M.factor_mesh(1) == (1, 1)
+        assert M.factor_mesh(6) == (3, 2)
+
+    def test_create_mesh_validates(self):
+        with pytest.raises(ValueError):
+            M.create_mesh(data=16, feat=2)
