@@ -1,0 +1,174 @@
+"""ctypes loader + NumPy-facing API for the native bridge library.
+
+The JniRAPIDSML analog (JniRAPIDSML.java:26-78): a lazy singleton that
+locates ``libtpuml_bridge.so`` next to the package (building it with the
+local toolchain on first use if absent — our stand-in for the reference's
+extract-from-jar-resources bootstrap), loads it once per process, and wraps
+the C ABI with shape-checked NumPy signatures.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libtpuml_bridge.so"
+
+_lib = None
+
+
+class NativeBridgeError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    try:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        out = getattr(e, "stderr", "")
+        raise NativeBridgeError(f"failed to build native bridge: {e}\n{out}") from e
+
+
+def get_lib() -> ctypes.CDLL:
+    """Load (building if needed) the bridge library — once per process, like
+    the reference's eager singleton (JniRAPIDSML.java:27,60-62)."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _LIB_PATH.exists():
+        _build()
+    lib = ctypes.CDLL(str(_LIB_PATH))
+
+    i32, i64 = ctypes.c_int32, ctypes.c_int64
+    dp = ctypes.POINTER(ctypes.c_double)
+    ip = ctypes.POINTER(ctypes.c_int32)
+
+    lib.tpuml_version.restype = i32
+    lib.tpuml_pack_rows.argtypes = [ctypes.POINTER(dp), i64, i64, dp]
+    lib.tpuml_pack_rows.restype = i32
+    lib.tpuml_pack_list.argtypes = [dp, ip, i64, i64, dp]
+    lib.tpuml_pack_list.restype = i32
+    lib.tpuml_gram.argtypes = [dp, i64, i64, dp]
+    lib.tpuml_gram.restype = i32
+    lib.tpuml_sign_flip.argtypes = [dp, i64, i64]
+    lib.tpuml_sign_flip.restype = i32
+    lib.tpuml_eigh_descending.argtypes = [dp, i64, dp, dp]
+    lib.tpuml_eigh_descending.restype = i32
+    lib.tpuml_project.argtypes = [dp, dp, i64, i64, i64, dp]
+    lib.tpuml_project.restype = i32
+
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        get_lib()
+        return True
+    except (NativeBridgeError, OSError):
+        return False
+
+
+def _as_c(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x, dtype=np.float64)
+
+
+def _dptr(x: np.ndarray):
+    return x.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def _check(code: int, op: str) -> None:
+    if code != 0:
+        raise NativeBridgeError(f"native {op} failed with code {code}")
+
+
+def version() -> int:
+    return int(get_lib().tpuml_version())
+
+
+def pack_rows(rows: list[np.ndarray]) -> np.ndarray:
+    """Gather per-row arrays into a contiguous [rows, n] matrix natively."""
+    if not rows:
+        raise ValueError("no rows")
+    rows = [_as_c(r) for r in rows]
+    n = rows[0].shape[0]
+    ptrs = (ctypes.POINTER(ctypes.c_double) * len(rows))(*[_dptr(r) for r in rows])
+    out = np.empty((len(rows), n), dtype=np.float64)
+    _check(get_lib().tpuml_pack_rows(ptrs, len(rows), n, _dptr(out)), "pack_rows")
+    return out
+
+
+def pack_list(values: np.ndarray, offsets: np.ndarray, n: int) -> np.ndarray:
+    """Arrow list buffers (values + int32 offsets) → [rows, n], ragged-checked."""
+    values = _as_c(values)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int32)
+    rows = len(offsets) - 1
+    out = np.empty((rows, n), dtype=np.float64)
+    code = get_lib().tpuml_pack_list(
+        _dptr(values), offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        rows, n, _dptr(out),
+    )
+    _check(code, "pack_list")
+    return out
+
+
+def gram(x: np.ndarray, out: np.ndarray | None = None) -> np.ndarray:
+    """C += XᵀX. Pass ``out`` to accumulate across batches (the reference's
+    per-partition covariance loop semantics)."""
+    x = _as_c(x)
+    rows, n = x.shape
+    if out is None:
+        out = np.zeros((n, n), dtype=np.float64)
+    _check(get_lib().tpuml_gram(_dptr(x), rows, n, _dptr(out)), "gram")
+    return out
+
+
+def sign_flip(u: np.ndarray) -> np.ndarray:
+    u = _as_c(u).copy()
+    _check(get_lib().tpuml_sign_flip(_dptr(u), u.shape[0], u.shape[1]), "sign_flip")
+    return u
+
+
+def eigh_descending(cov: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """calSVD contract: (components [n, n], singular values [n])."""
+    cov = _as_c(cov)
+    n = cov.shape[0]
+    comps = np.empty((n, n), dtype=np.float64)
+    s = np.empty(n, dtype=np.float64)
+    _check(
+        get_lib().tpuml_eigh_descending(_dptr(cov), n, _dptr(comps), _dptr(s)),
+        "eigh_descending",
+    )
+    return comps, s
+
+
+def project(x: np.ndarray, pc: np.ndarray) -> np.ndarray:
+    x, pc = _as_c(x), _as_c(pc)
+    rows, n = x.shape
+    k = pc.shape[1]
+    out = np.empty((rows, k), dtype=np.float64)
+    _check(get_lib().tpuml_project(_dptr(x), _dptr(pc), rows, n, k, _dptr(out)), "project")
+    return out
+
+
+def pca_fit_host(x: np.ndarray, k: int, *, mean_centering: bool = False):
+    """Pure-native end-to-end PCA fit (no accelerator): the full reference
+    fit() semantics on the host backend. Returns (pc [n, k], ev [k])."""
+    x = _as_c(x)
+    g = gram(x)
+    if mean_centering:
+        s = x.sum(axis=0)
+        g = g - np.outer(s, s) / max(len(x), 1)
+    comps, sv = eigh_descending(g)
+    total = sv.sum()
+    ev = (sv / total if total > 0 else sv)[:k]
+    return comps[:, :k], ev

@@ -196,9 +196,16 @@ class PCAModel(PCAParams, Model):
             mat = columnar.extract_matrix(dataset, input_col)
             return self._project_matrix(mat)
 
-    def transform_rows(self, rows) -> list[np.ndarray]:
+    def transform_rows(self, rows, use_native: bool = False) -> list[np.ndarray]:
         """CPU row-fallback path (reference ``apply``, RapidsPCA.scala:157-160):
-        pcᵀ·row per row, no accelerator involved."""
+        pcᵀ·row per row, no accelerator involved. With ``use_native=True`` the
+        rows are packed and projected through the C++ bridge instead (the
+        native columnar path of the reference's dual-mode UDF)."""
+        if use_native:
+            from spark_rapids_ml_tpu import bridge
+
+            packed = bridge.pack_rows([np.asarray(r) for r in rows])
+            return list(bridge.project(packed, self.pc))
         pct = self.pc.T
         return [pct @ np.asarray(r) for r in rows]
 

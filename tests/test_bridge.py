@@ -1,0 +1,121 @@
+"""Native bridge tests — differential against NumPy, plus the JAX-vs-native
+cross-check the reference never had (its native layer was only ever tested
+through the full Spark stack, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+bridge = pytest.importorskip("spark_rapids_ml_tpu.bridge")
+
+if not bridge.available():  # pragma: no cover
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+def test_version():
+    assert bridge.version() == 10
+
+
+class TestPacking:
+    def test_pack_rows(self, rng):
+        rows = [rng.normal(size=12) for _ in range(50)]
+        out = bridge.pack_rows(rows)
+        np.testing.assert_array_equal(out, np.stack(rows))
+
+    def test_pack_list(self, rng):
+        mat = rng.normal(size=(30, 8))
+        values = mat.reshape(-1)
+        offsets = np.arange(0, 31 * 8, 8, dtype=np.int32)
+        out = bridge.pack_list(values, offsets, 8)
+        np.testing.assert_array_equal(out, mat)
+
+    def test_pack_list_ragged_rejected(self, rng):
+        values = rng.normal(size=20)
+        offsets = np.array([0, 8, 13, 20], dtype=np.int32)  # ragged
+        with pytest.raises(bridge.NativeBridgeError):
+            bridge.pack_list(values, offsets, 8)
+
+
+class TestGram:
+    def test_matches_numpy(self, rng):
+        x = rng.normal(size=(300, 40))
+        np.testing.assert_allclose(bridge.gram(x), x.T @ x, rtol=1e-12)
+
+    def test_accumulation_across_batches(self, rng):
+        """Repeated calls accumulate — the per-partition covariance loop
+        semantics (RapidsRowMatrix.scala:122-137)."""
+        a, b = rng.normal(size=(100, 16)), rng.normal(size=(64, 16))
+        out = bridge.gram(a)
+        out = bridge.gram(b, out=out)
+        full = np.concatenate([a, b])
+        np.testing.assert_allclose(out, full.T @ full, rtol=1e-12)
+
+    def test_odd_sizes(self, rng):
+        x = rng.normal(size=(7, 131))  # not multiples of the tile size
+        np.testing.assert_allclose(bridge.gram(x), x.T @ x, rtol=1e-12)
+
+
+class TestSignFlip:
+    def test_semantics(self, rng):
+        u = rng.normal(size=(20, 6))
+        flipped = bridge.sign_flip(u)
+        for j in range(6):
+            col = flipped[:, j]
+            assert col[np.argmax(np.abs(col))] > 0
+        np.testing.assert_allclose(np.abs(flipped), np.abs(u), rtol=1e-15)
+
+    def test_matches_jax_kernel(self, rng):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import linalg as L
+
+        u = rng.normal(size=(15, 7))
+        np.testing.assert_allclose(
+            bridge.sign_flip(u), np.asarray(L.sign_flip(jnp.asarray(u))), rtol=1e-12
+        )
+
+
+class TestEigh:
+    def test_against_numpy(self, rng):
+        x = rng.normal(size=(200, 24))
+        cov = x.T @ x
+        comps, s = bridge.eigh_descending(cov)
+        evals, evecs = np.linalg.eigh(cov)
+        order = np.argsort(evals)[::-1]
+        np.testing.assert_allclose(s, np.sqrt(evals[order]), rtol=1e-9)
+        np.testing.assert_allclose(
+            np.abs(comps), np.abs(evecs[:, order]), rtol=1e-6, atol=1e-9
+        )
+        # residual: Jacobi should be LAPACK-grade
+        resid = np.max(np.abs(cov @ comps - comps * (s**2)[None, :]))
+        assert resid < 1e-9 * np.max(np.abs(cov))
+
+    def test_descending_and_flipped(self, rng):
+        x = rng.normal(size=(100, 10))
+        comps, s = bridge.eigh_descending(x.T @ x)
+        assert np.all(np.diff(s) <= 1e-9)
+        for j in range(10):
+            col = comps[:, j]
+            assert col[np.argmax(np.abs(col))] > 0
+
+
+class TestProject:
+    def test_matches_numpy(self, rng):
+        x = rng.normal(size=(500, 32))
+        pc = rng.normal(size=(32, 5))
+        np.testing.assert_allclose(bridge.project(x, pc), x @ pc, rtol=1e-12)
+
+
+class TestHostFit:
+    @pytest.mark.parametrize("center", [False, True])
+    def test_matches_jax_path(self, rng, center):
+        """The native fallback and the JAX device path must produce the same
+        model — the dual-backend contract."""
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import linalg as L
+
+        x = rng.normal(size=(300, 20))
+        pc_n, ev_n = bridge.pca_fit_host(x, 5, mean_centering=center)
+        pc_j, ev_j = L.pca_fit_local(jnp.asarray(x), 5, mean_centering=center)
+        np.testing.assert_allclose(pc_n, np.asarray(pc_j), atol=1e-8)
+        np.testing.assert_allclose(ev_n, np.asarray(ev_j), atol=1e-10)
