@@ -1,0 +1,185 @@
+"""KMeans estimator/model — the stretch estimator (BASELINE.json config 5).
+
+Spark MLlib-shaped params (``k``, ``maxIter``, ``tol``, ``seed``,
+``initMode``); Lloyd iterations run as per-partition device passes producing
+``KMeansStats`` monoids, tree-reduced across partitions — structurally
+identical to PCA's fit, so the same mesh/psum reducer swaps in for SPMD
+execution. Seeding is k-means++ on a bounded row sample (the role Spark's
+k-means|| plays at cluster scale).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
+from spark_rapids_ml_tpu.ops import kmeans as KM
+from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+_MAX_INIT_SAMPLE = 16384
+
+
+class _KMeansParams(HasInputCol, HasOutputCol):
+    k = Param("k", "number of clusters", int)
+    maxIter = Param("maxIter", "maximum Lloyd iterations", int)
+    tol = Param("tol", "convergence tolerance on max centroid movement", float)
+    seed = Param("seed", "random seed", int)
+    initMode = Param("initMode", "'k-means++' or 'random'", str)
+
+    def __init__(self, uid: str | None = None):
+        super().__init__(uid)
+        self._setDefault(
+            maxIter=20, tol=1e-4, seed=0, initMode="k-means++", outputCol="prediction"
+        )
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def getMaxIter(self) -> int:
+        return self.getOrDefault("maxIter")
+
+    def getTol(self) -> float:
+        return self.getOrDefault("tol")
+
+    def getSeed(self) -> int:
+        return self.getOrDefault("seed")
+
+    def getInitMode(self) -> str:
+        return self.getOrDefault("initMode")
+
+
+class KMeans(_KMeansParams, Estimator):
+    def setK(self, value: int) -> "KMeans":
+        return self._set(k=value)
+
+    def setMaxIter(self, value: int) -> "KMeans":
+        return self._set(maxIter=value)
+
+    def setTol(self, value: float) -> "KMeans":
+        return self._set(tol=value)
+
+    def setSeed(self, value: int) -> "KMeans":
+        return self._set(seed=value)
+
+    def setInitMode(self, value: str) -> "KMeans":
+        return self._set(initMode=value)
+
+    def _init_centers(self, ds: columnar.PartitionedDataset, k: int) -> np.ndarray:
+        rng = np.random.default_rng(self.getSeed())
+        # bounded sample across partitions for seeding
+        mats = list(ds.matrices())
+        total = sum(len(m) for m in mats)
+        take = min(total, _MAX_INIT_SAMPLE)
+        sample = np.concatenate(
+            [m[rng.choice(len(m), max(1, int(take * len(m) / total)), replace=False)]
+             for m in mats]
+        )
+        if self.getInitMode() == "random":
+            idx = rng.choice(len(sample), k, replace=False)
+            return sample[idx]
+        key = jax.random.PRNGKey(self.getSeed())
+        centers = KM.kmeans_plus_plus_init(key, jnp.asarray(sample), k)
+        return np.asarray(centers)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "KMeansModel":
+        input_col = self._paramMap.get("inputCol")
+        ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
+        k = self.getK()
+        tol_sq = self.getTol() ** 2
+
+        with trace_range("kmeans init"):
+            centers = self._init_centers(ds, k)
+
+        # pre-pad partitions once; weights mask the padding
+        padded = []
+        for mat in ds.matrices():
+            pm, true_rows = columnar.pad_rows(mat)
+            w = np.zeros(pm.shape[0], pm.dtype)
+            w[:true_rows] = 1.0
+            padded.append((jnp.asarray(pm), jnp.asarray(w)))
+
+        cost = np.inf
+        with trace_range("kmeans lloyd"):
+            for _ in range(self.getMaxIter()):
+                c = jnp.asarray(centers)
+                partials = [KM.kmeans_stats(x, c, w) for x, w in padded]
+                stats = tree_reduce(partials, KM.combine_kmeans_stats)
+                new_centers = np.asarray(KM.update_centers(stats, c))
+                cost = float(stats.cost)
+                shift = float(KM.center_shift_sq(c, jnp.asarray(new_centers)))
+                centers = new_centers
+                if shift <= tol_sq:
+                    break
+
+        model = KMeansModel(uid=self.uid, clusterCenters=centers, trainingCost=cost)
+        return self._copyValues(model)
+
+
+class KMeansModel(_KMeansParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        clusterCenters: np.ndarray | None = None,
+        trainingCost: float = float("nan"),
+    ):
+        super().__init__(uid)
+        self.clusterCenters = (
+            None if clusterCenters is None else np.asarray(clusterCenters)
+        )
+        self.trainingCost = trainingCost
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        padded, true_rows = columnar.pad_rows(mat)
+        labels, _ = jax.jit(KM.assign_clusters)(
+            jnp.asarray(padded), jnp.asarray(self.clusterCenters, dtype=padded.dtype)
+        )
+        return np.asarray(labels)[:true_rows]
+
+    def transform(self, dataset: Any) -> Any:
+        """Append an integer ``prediction`` column (Spark KMeansModel shape)."""
+        with trace_range("kmeans transform"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                self._predict_matrix,
+            )
+
+    def predict(self, row) -> int:
+        """Single-row prediction (host path)."""
+        d = np.sum((self.clusterCenters - np.asarray(row)[None, :]) ** 2, axis=1)
+        return int(np.argmin(d))
+
+    def computeCost(self, dataset: Any) -> float:
+        """Sum of squared distances to nearest centroid (inertia)."""
+        input_col = self._paramMap.get("inputCol")
+        ds = columnar.PartitionedDataset.from_any(dataset, input_col)
+        total = 0.0
+        for mat in ds.matrices():
+            padded, true_rows = columnar.pad_rows(mat)
+            _, dists = jax.jit(KM.assign_clusters)(
+                jnp.asarray(padded), jnp.asarray(self.clusterCenters, dtype=padded.dtype)
+            )
+            total += float(jnp.sum(dists[:true_rows]))
+        return total
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {
+            "clusterCenters": self.clusterCenters,
+            "trainingCost": np.asarray([self.trainingCost]),
+        }
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(
+            uid=uid,
+            clusterCenters=data["clusterCenters"],
+            trainingCost=float(data["trainingCost"][0]),
+        )

@@ -174,27 +174,13 @@ class PCAModel(PCAParams, Model):
     def transform(self, dataset: Any) -> Any:
         """Project the input column; returns the same container type with the
         output column appended (ArrayType-shaped, like the reference)."""
-        input_col = self._paramMap.get("inputCol")
-        output_col = self.getOutputCol()
         with trace_range("pca transform"):
-            if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
-                mat = columnar.extract_matrix(dataset, input_col)
-                out = self._project_matrix(mat)
-                col = columnar.matrix_to_arrow_column(out)
-                if isinstance(dataset, pa.RecordBatch):
-                    dataset = pa.Table.from_batches([dataset])
-                return dataset.append_column(output_col, col)
-            if hasattr(dataset, "columns") and hasattr(dataset, "assign") and input_col:
-                mat = columnar.extract_matrix(dataset, input_col)
-                out = self._project_matrix(mat)
-                return dataset.assign(**{output_col: list(out)})
-            if isinstance(dataset, columnar.PartitionedDataset):
-                return columnar.PartitionedDataset(
-                    [self._project_matrix(m) for m in dataset.matrices()],
-                    dataset.input_col,
-                )
-            mat = columnar.extract_matrix(dataset, input_col)
-            return self._project_matrix(mat)
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                self._project_matrix,
+            )
 
     def transform_rows(self, rows, use_native: bool = False) -> list[np.ndarray]:
         """CPU row-fallback path (reference ``apply``, RapidsPCA.scala:157-160):

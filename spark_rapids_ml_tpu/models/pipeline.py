@@ -1,0 +1,88 @@
+"""Pipeline — chained stages, Spark ML shape.
+
+Enables BASELINE config 4 end-to-end: ``Pipeline(stages=[StandardScaler(...),
+PCA(...)])`` fits preprocessing + decomposition as one unit and transforms
+in sequence.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model, Saveable, Transformer
+from spark_rapids_ml_tpu.utils import persistence
+
+
+class Pipeline(Estimator):
+    def __init__(self, uid: str | None = None, stages: list | None = None):
+        super().__init__(uid)
+        self.stages = list(stages or [])
+
+    def setStages(self, stages: list) -> "Pipeline":
+        self.stages = list(stages)
+        return self
+
+    def getStages(self) -> list:
+        return self.stages
+
+    def fit(self, dataset: Any) -> "PipelineModel":
+        """Fit estimator stages in order, transforming the running dataset
+        through each fitted model (Spark Pipeline semantics)."""
+        fitted = []
+        current = dataset
+        for stage in self.stages:
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+                fitted.append(model)
+                current = model.transform(current)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                current = stage.transform(current)
+            else:
+                raise TypeError(f"pipeline stage {stage!r} is not a stage")
+        model = PipelineModel(uid=self.uid, stages=fitted)
+        return model
+
+    # -- persistence: stages in numbered subdirectories ----------------------
+    def save(self, path: str, overwrite: bool = False) -> None:
+        p = Path(path)
+        if p.exists() and not overwrite:
+            raise FileExistsError(f"{path} already exists (use overwrite=True)")
+        persistence.save_metadata(p, self, extra={"numStages": len(self.stages)})
+        for i, stage in enumerate(self.stages):
+            stage.save(p / f"stage_{i}", overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str) -> "Pipeline":
+        meta = persistence.load_metadata(path)
+        stages = [
+            Saveable.load(Path(path) / f"stage_{i}") for i in range(meta["numStages"])
+        ]
+        obj = cls(uid=meta["uid"], stages=stages)
+        obj._restoreParamState(meta)
+        return obj
+
+
+class PipelineModel(Model):
+    def __init__(self, uid: str | None = None, stages: list | None = None):
+        super().__init__(uid)
+        self.stages = list(stages or [])
+
+    def transform(self, dataset: Any) -> Any:
+        current = dataset
+        for stage in self.stages:
+            current = stage.transform(current)
+        return current
+
+    save = Pipeline.save  # same numbered-subdir layout
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineModel":
+        meta = persistence.load_metadata(path)
+        stages = [
+            Saveable.load(Path(path) / f"stage_{i}") for i in range(meta["numStages"])
+        ]
+        obj = cls(uid=meta["uid"], stages=stages)
+        obj._restoreParamState(meta)
+        return obj

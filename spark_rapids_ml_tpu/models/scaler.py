@@ -1,0 +1,132 @@
+"""StandardScaler and Normalizer — the preprocessing stages of BASELINE
+config 4 ("StandardScaler / Normalizer fused into the PCA input pipeline").
+
+API shape follows Spark MLlib (the reference's host framework): StandardScaler
+is an Estimator with ``withMean`` (default False) / ``withStd`` (default
+True); Normalizer is a stateless Transformer with a ``p`` norm param
+(default 2.0). Fit statistics use the same partition-monoid + tree-reduce
+design as PCA's GramStats, so the distributed story is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model, Transformer
+from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
+from spark_rapids_ml_tpu.ops import scaler as S
+from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+_moment_stats = jax.jit(S.moment_stats)
+_finalize = jax.jit(S.finalize_moments)
+
+
+class _ScalerParams(HasInputCol, HasOutputCol):
+    withMean = Param("withMean", "center features before scaling", bool)
+    withStd = Param("withStd", "scale features to unit sample std", bool)
+
+    def __init__(self, uid: str | None = None):
+        super().__init__(uid)
+        self._setDefault(withMean=False, withStd=True, outputCol="scaled_features")
+
+    def getWithMean(self) -> bool:
+        return self.getOrDefault("withMean")
+
+    def getWithStd(self) -> bool:
+        return self.getOrDefault("withStd")
+
+
+class StandardScaler(_ScalerParams, Estimator):
+    def setWithMean(self, value: bool) -> "StandardScaler":
+        return self._set(withMean=value)
+
+    def setWithStd(self, value: bool) -> "StandardScaler":
+        return self._set(withStd=value)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "StandardScalerModel":
+        input_col = self._paramMap.get("inputCol")
+        ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
+        with trace_range("scaler moments"):
+            partials = []
+            for mat in ds.matrices():
+                padded, true_rows = columnar.pad_rows(mat)
+                st = _moment_stats(jnp.asarray(padded))
+                partials.append(
+                    S.MomentStats(jnp.asarray(true_rows, st.count.dtype), st.total, st.total_sq)
+                )
+            stats = tree_reduce(partials, S.combine_moment_stats)
+            mean, std = _finalize(stats)
+        model = StandardScalerModel(
+            uid=self.uid, mean=np.asarray(mean), std=np.asarray(std)
+        )
+        return self._copyValues(model)
+
+
+class StandardScalerModel(_ScalerParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        mean: np.ndarray | None = None,
+        std: np.ndarray | None = None,
+    ):
+        super().__init__(uid)
+        self.mean = None if mean is None else np.asarray(mean)
+        self.std = None if std is None else np.asarray(std)
+
+    def _scale(self, mat: np.ndarray) -> np.ndarray:
+        out = jax.jit(
+            S.standardize, static_argnames=("with_mean", "with_std")
+        )(
+            jnp.asarray(mat),
+            jnp.asarray(self.mean, dtype=mat.dtype),
+            jnp.asarray(self.std, dtype=mat.dtype),
+            with_mean=self.getWithMean(),
+            with_std=self.getWithStd(),
+        )
+        return np.asarray(out)
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("scaler transform"):
+            return columnar.apply_column_transform(
+                dataset, self._paramMap.get("inputCol"), self.getOutputCol(), self._scale
+            )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"mean": self.mean, "std": self.std}
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(uid=uid, mean=data["mean"], std=data["std"])
+
+
+class Normalizer(HasInputCol, HasOutputCol, Transformer):
+    """Stateless row p-normalization (Spark ``Normalizer`` semantics)."""
+
+    p = Param("p", "norm order (p >= 1; inf supported)", float)
+
+    def __init__(self, uid: str | None = None):
+        super().__init__(uid)
+        self._setDefault(p=2.0, outputCol="normalized_features")
+
+    def setP(self, value: float) -> "Normalizer":
+        return self._set(p=value)
+
+    def getP(self) -> float:
+        return self.getOrDefault("p")
+
+    def transform(self, dataset: Any) -> Any:
+        p = self.getP()
+        fn = jax.jit(lambda m: S.normalize(m, p))
+        with trace_range("normalize"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                lambda m: np.asarray(fn(jnp.asarray(m))),
+            )

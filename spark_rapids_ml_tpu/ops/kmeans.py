@@ -1,0 +1,145 @@
+"""KMeans device kernels — the stretch estimator (BASELINE.json config 5).
+
+The reference family's KMeans runs RAFT pairwise-distance + argmin kernels
+on GPU; the TPU-native formulation puts both hot ops on the MXU:
+
+- distances: ‖x−c‖² expanded to ‖x‖² + ‖c‖² − 2·x·cᵀ — the cross term is a
+  [rows, n]×[n, k] matmul;
+- centroid accumulation: scatter-by-label recast as a one-hot matmul
+  onehotᵀ·x ([k, rows]×[rows, n]) — a second MXU pass instead of the GPU's
+  atomic scatters, which TPUs don't like.
+
+Row blocks are processed under ``lax.scan`` so the [block, k] distance and
+one-hot tiles stay bounded in VMEM/HBM regardless of partition size (rows·k
+would otherwise explode at k=1000). Per-partition ``KMeansStats`` are the
+usual commutative monoid, reduced by the same tree/psum machinery as PCA.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_ml_tpu.ops.linalg import DEFAULT_PRECISION
+
+
+class KMeansStats(NamedTuple):
+    """Sufficient statistics of one Lloyd iteration over a row shard."""
+
+    sums: jax.Array  # [k, n] — per-cluster feature sums
+    counts: jax.Array  # [k]   — per-cluster row counts
+    cost: jax.Array  # []    — sum of min squared distances (inertia)
+
+
+def combine_kmeans_stats(a: KMeansStats, b: KMeansStats) -> KMeansStats:
+    return KMeansStats(a.sums + b.sums, a.counts + b.counts, a.cost + b.cost)
+
+
+def pairwise_sq_dists(
+    x: jax.Array, centers: jax.Array, *, precision=DEFAULT_PRECISION
+) -> jax.Array:
+    """[rows, k] squared distances via the MXU cross-term expansion."""
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)
+    c_sq = jnp.sum(centers * centers, axis=1)[None, :]
+    cross = jnp.matmul(x, centers.T, precision=precision)
+    return jnp.clip(x_sq + c_sq - 2.0 * cross, 0.0, None)
+
+
+def assign_clusters(
+    x: jax.Array, centers: jax.Array, *, precision=DEFAULT_PRECISION
+) -> tuple[jax.Array, jax.Array]:
+    """(labels [rows], min squared distances [rows])."""
+    d = pairwise_sq_dists(x, centers, precision=precision)
+    return jnp.argmin(d, axis=1), jnp.min(d, axis=1)
+
+
+@partial(jax.jit, static_argnames=("block_rows",))
+def kmeans_stats(
+    x: jax.Array,
+    centers: jax.Array,
+    weights: jax.Array | None = None,
+    *,
+    block_rows: int = 8192,
+) -> KMeansStats:
+    """One Lloyd accumulation pass over a row shard, scanned in blocks.
+
+    ``weights`` masks padded rows (0 weight) so shape bucketing stays exact.
+    """
+    rows, n = x.shape
+    k = centers.shape[0]
+    if weights is None:
+        weights = jnp.ones((rows,), x.dtype)
+
+    pad = (-rows) % block_rows
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        weights = jnp.pad(weights, (0, pad))
+    nb = x.shape[0] // block_rows
+    xb = x.reshape(nb, block_rows, n)
+    wb = weights.reshape(nb, block_rows)
+
+    def step(carry, blk):
+        sums, counts, cost = carry
+        xi, wi = blk
+        labels, dists = assign_clusters(xi, centers)
+        onehot = (
+            labels[:, None] == jnp.arange(k, dtype=labels.dtype)[None, :]
+        ).astype(x.dtype) * wi[:, None]
+        sums = sums + jnp.matmul(onehot.T, xi, precision=DEFAULT_PRECISION)
+        counts = counts + jnp.sum(onehot, axis=0)
+        cost = cost + jnp.sum(dists * wi)
+        return (sums, counts, cost), None
+
+    init = (
+        jnp.zeros((k, n), x.dtype),
+        jnp.zeros((k,), x.dtype),
+        jnp.zeros((), x.dtype),
+    )
+    (sums, counts, cost), _ = lax.scan(step, init, (xb, wb))
+    return KMeansStats(sums, counts, cost)
+
+
+def update_centers(stats: KMeansStats, old_centers: jax.Array) -> jax.Array:
+    """New centroids = sums/counts; empty clusters keep their old center
+    (Spark MLlib behavior)."""
+    counts = stats.counts[:, None]
+    safe = jnp.where(counts > 0, counts, jnp.ones_like(counts))
+    return jnp.where(counts > 0, stats.sums / safe, old_centers)
+
+
+def center_shift_sq(old: jax.Array, new: jax.Array) -> jax.Array:
+    """Max squared movement of any centroid — the convergence criterion."""
+    return jnp.max(jnp.sum((old - new) ** 2, axis=1))
+
+
+def kmeans_plus_plus_init(
+    key: jax.Array, x: jax.Array, k: int, *, precision=DEFAULT_PRECISION
+) -> jax.Array:
+    """k-means++ seeding on a (sub)sample, fully jittable.
+
+    D²-weighted sequential sampling (Arthur & Vassilvitskii); the estimator
+    layer samples the dataset down before calling so rows stays modest —
+    the same role Spark's k-means|| plays for its distributed init.
+    """
+    rows = x.shape[0]
+
+    first = jax.random.randint(key, (), 0, rows)
+    centers0 = jnp.zeros((k, x.shape[1]), x.dtype).at[0].set(x[first])
+    d0 = jnp.sum((x - centers0[0][None, :]) ** 2, axis=1)
+
+    def body(i, carry):
+        centers, dists, key = carry
+        key, sub = jax.random.split(key)
+        probs = dists / jnp.maximum(jnp.sum(dists), jnp.finfo(x.dtype).tiny)
+        idx = jax.random.choice(sub, rows, p=probs)
+        c = x[idx]
+        centers = centers.at[i].set(c)
+        d_new = jnp.sum((x - c[None, :]) ** 2, axis=1)
+        return centers, jnp.minimum(dists, d_new), key
+
+    centers, _, _ = lax.fori_loop(1, k, body, (centers0, d0, key))
+    return centers

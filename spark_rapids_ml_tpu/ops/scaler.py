@@ -1,0 +1,79 @@
+"""Feature scaling kernels — StandardScaler / Normalizer device math.
+
+BASELINE.json config 4: "StandardScaler / Normalizer preprocessing fused into
+the PCA input pipeline". Statistics follow the same partition-monoid design
+as PCA's GramStats: per-partition moments combine across partitions, so the
+same reducers (tree-aggregate or mesh psum) apply. All transforms are pure
+elementwise/matmul-free kernels XLA fuses into adjacent ops — which is what
+"fused into the PCA input pipeline" means here: standardize + Gram compile
+into one program with no extra HBM round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MomentStats(NamedTuple):
+    """Per-feature first/second moments — a commutative monoid like GramStats."""
+
+    count: jax.Array  # []
+    total: jax.Array  # [n]  — per-feature sums
+    total_sq: jax.Array  # [n]  — per-feature sums of squares
+
+
+def moment_stats(x: jax.Array) -> MomentStats:
+    return MomentStats(
+        count=jnp.asarray(x.shape[0], x.dtype),
+        total=jnp.sum(x, axis=0),
+        total_sq=jnp.sum(x * x, axis=0),
+    )
+
+
+def combine_moment_stats(a: MomentStats, b: MomentStats) -> MomentStats:
+    return MomentStats(a.count + b.count, a.total + b.total, a.total_sq + b.total_sq)
+
+
+def finalize_moments(stats: MomentStats) -> tuple[jax.Array, jax.Array]:
+    """(mean, sample std) from reduced moments.
+
+    Sample (n−1) variance to match Spark MLlib's StandardScaler; variance is
+    clipped at zero against catastrophic cancellation on constant features.
+    """
+    count = jnp.maximum(stats.count, 1)
+    mean = stats.total / count
+    denom = jnp.maximum(count - 1, 1)
+    var = jnp.clip((stats.total_sq - count * mean * mean) / denom, 0.0, None)
+    return mean, jnp.sqrt(var)
+
+
+def standardize(
+    x: jax.Array,
+    mean: jax.Array,
+    std: jax.Array,
+    *,
+    with_mean: bool = False,
+    with_std: bool = True,
+) -> jax.Array:
+    """(x − μ)/σ with Spark's flag semantics (withMean default false there);
+    zero-variance features pass through unscaled rather than dividing by 0."""
+    if with_mean:
+        x = x - mean[None, :]
+    if with_std:
+        safe = jnp.where(std > 0, std, jnp.ones_like(std))
+        x = x / safe[None, :]
+    return x
+
+
+def normalize(x: jax.Array, p: float = 2.0) -> jax.Array:
+    """Row-wise p-normalization (Spark Normalizer semantics, p ≥ 1):
+    rows with zero norm are left untouched."""
+    if p == float("inf"):
+        norms = jnp.max(jnp.abs(x), axis=1)
+    else:
+        norms = jnp.sum(jnp.abs(x) ** p, axis=1) ** (1.0 / p)
+    safe = jnp.where(norms > 0, norms, jnp.ones_like(norms))
+    return x / safe[:, None]

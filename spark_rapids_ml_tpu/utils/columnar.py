@@ -111,6 +111,33 @@ def matrix_to_arrow_column(x: np.ndarray):
     return pa.FixedSizeListArray.from_arrays(values, k)
 
 
+def apply_column_transform(dataset: Any, input_col: str | None, output_col: str, fn):
+    """Apply a matrix→matrix (or matrix→vector) transform to the input column
+    and append the result as ``output_col``, preserving the container type.
+
+    ``fn`` receives a [rows, n] ndarray and returns a [rows, k] ndarray (an
+    ArrayType-shaped output column, like the reference's transform —
+    RapidsPCA.scala:165) or a [rows] vector (a scalar column, e.g. KMeans
+    predictions).
+    """
+    if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
+        mat = extract_matrix(dataset, input_col)
+        out = np.asarray(fn(mat))
+        col = pa.array(out) if out.ndim == 1 else matrix_to_arrow_column(out)
+        if isinstance(dataset, pa.RecordBatch):
+            dataset = pa.Table.from_batches([dataset])
+        return dataset.append_column(output_col, col)
+    if hasattr(dataset, "columns") and hasattr(dataset, "assign") and input_col:
+        mat = extract_matrix(dataset, input_col)
+        out = np.asarray(fn(mat))
+        return dataset.assign(**{output_col: list(out) if out.ndim > 1 else out})
+    if isinstance(dataset, PartitionedDataset):
+        return PartitionedDataset(
+            [np.asarray(fn(m)) for m in dataset.matrices()], dataset.input_col
+        )
+    return np.asarray(fn(extract_matrix(dataset, input_col)))
+
+
 # ---------------------------------------------------------------------------
 # Shape bucketing
 # ---------------------------------------------------------------------------

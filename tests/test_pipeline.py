@@ -1,0 +1,77 @@
+"""Pipeline tests — BASELINE config 4: scaler + PCA fused end-to-end."""
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.models.pca import PCA
+from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
+from spark_rapids_ml_tpu.models.scaler import Normalizer, StandardScaler
+
+
+def _df(rng, rows=200, n=10):
+    x = rng.normal(size=(rows, n)) * rng.uniform(0.5, 4.0, size=n)[None, :]
+    return pd.DataFrame({"features": list(x)}), x
+
+
+class TestPipeline:
+    def test_scaler_then_pca(self, rng):
+        df, x = _df(rng)
+        pipe = Pipeline(
+            stages=[
+                StandardScaler().setInputCol("features").setOutputCol("scaled").setWithMean(True),
+                PCA().setInputCol("scaled").setOutputCol("pca").setK(3),
+            ]
+        )
+        model = pipe.fit(df)
+        out = model.transform(df)
+        assert "pca" in out.columns
+
+        # differential: same composition by hand
+        xs = (x - x.mean(0)) / x.std(0, ddof=1)
+        evals, evecs = np.linalg.eigh(xs.T @ xs)
+        order = np.argsort(evals)[::-1]
+        want = xs @ evecs[:, order[:3]]
+        got = np.stack(out["pca"].to_numpy())
+        np.testing.assert_allclose(np.abs(got), np.abs(want), atol=1e-6)
+
+    def test_transformer_stage_in_pipeline(self, rng):
+        df, _ = _df(rng)
+        pipe = Pipeline(
+            stages=[
+                Normalizer().setInputCol("features").setOutputCol("norm"),
+                PCA().setInputCol("norm").setOutputCol("pca").setK(2),
+            ]
+        )
+        out = pipe.fit(df).transform(df)
+        assert {"norm", "pca"} <= set(out.columns)
+
+    def test_pipeline_model_persistence(self, rng, tmp_path):
+        df, _ = _df(rng)
+        pipe = Pipeline(
+            stages=[
+                StandardScaler().setInputCol("features").setOutputCol("s"),
+                PCA().setInputCol("s").setOutputCol("p").setK(2),
+            ]
+        )
+        model = pipe.fit(df)
+        model.save(tmp_path / "pm")
+        loaded = PipelineModel.load(tmp_path / "pm")
+        out1 = model.transform(df)
+        out2 = loaded.transform(df)
+        np.testing.assert_allclose(
+            np.stack(out1["p"].to_numpy()), np.stack(out2["p"].to_numpy())
+        )
+
+    def test_pipeline_estimator_persistence(self, rng, tmp_path):
+        pipe = Pipeline(
+            stages=[
+                StandardScaler().setInputCol("f").setOutputCol("s"),
+                PCA().setInputCol("s").setK(2),
+            ]
+        )
+        pipe.save(tmp_path / "pipe")
+        loaded = Pipeline.load(tmp_path / "pipe")
+        assert len(loaded.getStages()) == 2
+        assert isinstance(loaded.getStages()[0], StandardScaler)
+        assert isinstance(loaded.getStages()[1], PCA)
+        assert loaded.getStages()[1].getK() == 2

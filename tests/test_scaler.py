@@ -1,0 +1,73 @@
+"""StandardScaler / Normalizer tests — differential vs scikit-learn."""
+
+import numpy as np
+import pytest
+from sklearn.preprocessing import StandardScaler as SkScaler
+from sklearn.preprocessing import normalize as sk_normalize
+
+from spark_rapids_ml_tpu.models.scaler import Normalizer, StandardScaler, StandardScalerModel
+
+
+@pytest.fixture
+def data(rng):
+    x = rng.normal(size=(300, 12)) * rng.uniform(0.1, 5.0, size=12)[None, :]
+    return x + rng.uniform(-3, 3, size=12)[None, :]
+
+
+class TestStandardScaler:
+    def test_moments_match_numpy(self, data):
+        model = StandardScaler().setInputCol("f").fit(data, num_partitions=3)
+        np.testing.assert_allclose(model.mean, data.mean(axis=0), rtol=1e-10)
+        np.testing.assert_allclose(model.std, data.std(axis=0, ddof=1), rtol=1e-10)
+
+    def test_defaults_match_spark(self, data):
+        """Spark defaults: withStd=True, withMean=False."""
+        model = StandardScaler().setInputCol("f").fit(data)
+        out = model.transform(data)
+        np.testing.assert_allclose(out, data / data.std(axis=0, ddof=1), rtol=1e-9)
+
+    def test_with_mean_matches_sklearn(self, data):
+        model = (
+            StandardScaler().setInputCol("f").setWithMean(True).fit(data)
+        )
+        out = model.transform(data)
+        want = SkScaler().fit_transform(data) * np.sqrt((len(data) - 1) / len(data))
+        # sklearn uses population std; rescale to sample-std semantics
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_constant_feature_passthrough(self, rng):
+        x = rng.normal(size=(50, 3))
+        x[:, 1] = 7.0  # zero variance
+        model = StandardScaler().setInputCol("f").setWithMean(True).fit(x)
+        out = model.transform(x)
+        np.testing.assert_allclose(out[:, 1], 0.0, atol=1e-12)  # centered, unscaled
+        assert np.all(np.isfinite(out))
+
+    def test_persistence_roundtrip(self, data, tmp_path):
+        model = StandardScaler().setInputCol("f").setWithMean(True).fit(data)
+        model.save(tmp_path / "s")
+        loaded = StandardScalerModel.load(tmp_path / "s")
+        np.testing.assert_array_equal(loaded.mean, model.mean)
+        assert loaded.getWithMean() is True
+        np.testing.assert_allclose(loaded.transform(data), model.transform(data))
+
+
+class TestNormalizer:
+    @pytest.mark.parametrize("p", [1.0, 2.0, 3.0])
+    def test_matches_sklearn(self, data, p):
+        out = Normalizer().setInputCol("f").setP(p).transform(data)
+        want = sk_normalize(data, norm={1.0: "l1", 2.0: "l2"}.get(p, "l2"))
+        if p in (1.0, 2.0):
+            np.testing.assert_allclose(out, want, rtol=1e-6)
+        norms = np.sum(np.abs(out) ** p, axis=1) ** (1 / p)
+        np.testing.assert_allclose(norms, 1.0, rtol=1e-6)
+
+    def test_inf_norm(self, data):
+        out = Normalizer().setInputCol("f").setP(float("inf")).transform(data)
+        np.testing.assert_allclose(np.max(np.abs(out), axis=1), 1.0, rtol=1e-9)
+
+    def test_zero_row_untouched(self):
+        x = np.array([[0.0, 0.0], [3.0, 4.0]])
+        out = Normalizer().setInputCol("f").transform(x)
+        np.testing.assert_array_equal(out[0], [0.0, 0.0])
+        np.testing.assert_allclose(out[1], [0.6, 0.8], rtol=1e-9)
