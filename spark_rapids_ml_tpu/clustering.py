@@ -1,0 +1,5 @@
+"""Drop-in clustering namespace mirroring ``pyspark.ml.clustering``."""
+
+from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel  # noqa: F401
+
+__all__ = ["KMeans", "KMeansModel"]

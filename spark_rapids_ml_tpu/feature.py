@@ -1,0 +1,19 @@
+"""Drop-in feature namespace — the L6 public API analog.
+
+The reference's entire public surface is a namespace-mirroring shim: a
+10-line ``com.nvidia.spark.ml.feature.PCA`` subclass whose only job is to
+give users a familiarly-pathed class (PCA.scala:27-37, SURVEY.md §1 L6).
+This module is the same idea for the Python/Spark-ML package layout —
+``spark_rapids_ml_tpu.feature`` mirrors ``pyspark.ml.feature``'s naming, so
+a user's ``from pyspark.ml.feature import PCA, StandardScaler, Normalizer``
+becomes a one-line import swap.
+"""
+
+from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401
+from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
+    Normalizer,
+    StandardScaler,
+    StandardScalerModel,
+)
+
+__all__ = ["PCA", "PCAModel", "StandardScaler", "StandardScalerModel", "Normalizer"]

@@ -113,23 +113,26 @@ class PCA(PCAParams, Estimator):
         mean_centering = self.getMeanCentering()
 
         with trace_range("compute cov"):  # NvtxRange analog, RapidsRowMatrix.scala:62
-            partials = []
-            n_cols = None
-            for mat in ds.matrices():
-                if n_cols is None:
-                    n_cols = mat.shape[1]  # infer nCols like RapidsPCA.scala:74
-                elif mat.shape[1] != n_cols:
+            mats = list(ds.matrices())
+            n_cols = mats[0].shape[1]  # infer nCols like RapidsPCA.scala:74
+            for m in mats[1:]:
+                if m.shape[1] != n_cols:
                     raise ValueError(
-                        f"inconsistent feature dim: {mat.shape[1]} != {n_cols}"
+                        f"inconsistent feature dim: {m.shape[1]} != {n_cols}"
                     )
+
+            def partition_task(mat):
                 padded, true_rows = columnar.pad_rows(mat)
                 stats = _gram_stats(jnp.asarray(padded))
                 # padding adds zero rows: fix only the count
-                partials.append(
-                    L.GramStats(stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype))
+                return L.GramStats(
+                    stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype)
                 )
+
+            from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
             from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
 
+            partials = run_partition_tasks(partition_task, mats)
             stats = tree_reduce(partials, L.combine_gram_stats)
         if k > n_cols:
             raise ValueError(f"k={k} must be <= number of features {n_cols}")

@@ -53,13 +53,17 @@ class StandardScaler(_ScalerParams, Estimator):
         input_col = self._paramMap.get("inputCol")
         ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
         with trace_range("scaler moments"):
-            partials = []
-            for mat in ds.matrices():
+
+            def partition_task(mat):
                 padded, true_rows = columnar.pad_rows(mat)
                 st = _moment_stats(jnp.asarray(padded))
-                partials.append(
-                    S.MomentStats(jnp.asarray(true_rows, st.count.dtype), st.total, st.total_sq)
+                return S.MomentStats(
+                    jnp.asarray(true_rows, st.count.dtype), st.total, st.total_sq
                 )
+
+            from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+
+            partials = run_partition_tasks(partition_task, list(ds.matrices()))
             stats = tree_reduce(partials, S.combine_moment_stats)
             mean, std = _finalize(stats)
         model = StandardScalerModel(

@@ -1,0 +1,67 @@
+"""Partition task executor with retry — the Spark-task-semantics shim.
+
+The reference's failure story is entirely delegated: native errors become
+Java exceptions, the task fails, Spark re-schedules it (SURVEY.md §5). With
+no Spark underneath, this module owns that contract: run per-partition work
+on a bounded thread pool, retry transient failures per-task up to
+``max_retries`` (Spark's ``spark.task.maxFailures`` analog, default 4
+attempts there), fail fast on exhaustion, and keep results in partition
+order. Device dispatch is async under the hood, so threads overlap host-side
+extraction/padding with device compute.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence, TypeVar
+
+logger = logging.getLogger("spark_rapids_ml_tpu")
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+class TaskFailedError(RuntimeError):
+    """A partition task exhausted its retry budget."""
+
+
+def run_partition_tasks(
+    fn: Callable[[T], R],
+    items: Sequence[T],
+    *,
+    max_retries: int = 3,
+    max_workers: int = 4,
+    retry_backoff_s: float = 0.05,
+) -> list[R]:
+    """Apply ``fn`` to every item, in order, with per-task retries.
+
+    Deterministic-output contract: results are returned in input order
+    regardless of completion order, so reductions over them are stable.
+    """
+    items = list(items)
+    if not items:
+        return []
+
+    def attempt(idx_item):
+        idx, item = idx_item
+        last = None
+        for att in range(1 + max_retries):
+            try:
+                return fn(item)
+            except Exception as e:  # noqa: BLE001 — retry any task failure
+                last = e
+                logger.warning(
+                    "partition task %d attempt %d/%d failed: %s",
+                    idx, att + 1, 1 + max_retries, e,
+                )
+                time.sleep(retry_backoff_s * (2**att))
+        raise TaskFailedError(
+            f"partition task {idx} failed after {1 + max_retries} attempts"
+        ) from last
+
+    if len(items) == 1 or max_workers <= 1:
+        return [attempt((i, it)) for i, it in enumerate(items)]
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as pool:
+        return list(pool.map(attempt, enumerate(items)))

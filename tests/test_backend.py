@@ -1,0 +1,75 @@
+"""Comm backend + executor tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.parallel import backend as B
+from spark_rapids_ml_tpu.parallel import mesh as M
+from spark_rapids_ml_tpu.parallel.executor import TaskFailedError, run_partition_tasks
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return M.create_mesh(data=8, feat=1)
+
+
+class TestCollectives:
+    def test_allreduce(self, mesh, rng):
+        x = rng.normal(size=(8, 16, 16))
+        got = B.allreduce(jnp.asarray(x), mesh, M.DATA_AXIS)
+        np.testing.assert_allclose(np.asarray(got), x.sum(0), rtol=1e-12)
+
+    def test_allreduce_uneven_stacking(self, mesh, rng):
+        # 16 partials over 8 devices: 2 resident slices each
+        x = rng.normal(size=(16, 4))
+        got = B.allreduce(jnp.asarray(x), mesh, M.DATA_AXIS)
+        np.testing.assert_allclose(np.asarray(got), x.sum(0), rtol=1e-12)
+
+    def test_allgather(self, mesh, rng):
+        x = rng.normal(size=(8, 4))
+        got = B.allgather(jnp.asarray(x), mesh, M.DATA_AXIS)
+        np.testing.assert_allclose(np.asarray(got), x, rtol=1e-15)
+
+    def test_single_process_helpers(self):
+        info = B.process_info()
+        assert info["process_count"] == 1
+        assert B.broadcast_host(42) == 42
+        B.initialize()  # no-op single host
+
+    def test_host_reduce(self, rng):
+        parts = [rng.normal(size=(6, 6)) for _ in range(5)]
+        got = B.host_reduce(parts, lambda a, b: a + b)
+        np.testing.assert_allclose(got, sum(parts), rtol=1e-12)
+
+
+class TestExecutor:
+    def test_order_preserved(self):
+        out = run_partition_tasks(lambda i: i * 2, list(range(20)), max_workers=8)
+        assert out == [i * 2 for i in range(20)]
+
+    def test_retries_transient_failure(self):
+        attempts = {}
+
+        def flaky(i):
+            attempts[i] = attempts.get(i, 0) + 1
+            if i == 3 and attempts[i] < 3:
+                raise RuntimeError("transient")
+            return i
+
+        out = run_partition_tasks(flaky, list(range(5)), max_workers=2)
+        assert out == list(range(5))
+        assert attempts[3] == 3
+
+    def test_exhausted_retries_raise(self):
+        def always_fails(i):
+            raise RuntimeError("permanent")
+
+        with pytest.raises(TaskFailedError, match="after 3 attempts"):
+            run_partition_tasks(
+                always_fails, [1], max_retries=2, retry_backoff_s=0.0
+            )
+
+    def test_empty(self):
+        assert run_partition_tasks(lambda i: i, []) == []
