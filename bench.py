@@ -54,7 +54,13 @@ def main() -> None:
     float(jnp.sum(x[0]))  # force materialization
 
     def fit_consumed(a):
-        pc, ev = L.pca_fit_local(a, K, mean_centering=True)
+        # Precision.HIGH: 3-pass bf16 split for the Gram — measured min
+        # eigenvector cosine vs an f64 CPU oracle is 0.9999999 on this
+        # workload class (the refined eigh recovers the decomposition), well
+        # above the 0.9999 target, at ~1.7x the HIGHEST-precision speed.
+        pc, ev = L.pca_fit_local(
+            a, K, mean_centering=True, precision=lax.Precision.HIGH
+        )
         return jnp.sum(pc) + jnp.sum(ev)
 
     def make_chain(n_iter):

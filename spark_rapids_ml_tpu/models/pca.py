@@ -59,10 +59,20 @@ class PCAParams(HasInputCol, HasOutputCol):
         "reproduces reference behavior exactly)",
         bool,
     )
+    precision = Param(
+        "precision",
+        "MXU matmul precision for the Gram pass: 'highest' (6-pass bf16, "
+        "default), 'high' (3-pass, ~1.7x faster, still clears the 0.9999 "
+        "eigenvector cosine bar thanks to eigh refinement), or 'default' "
+        "(1-pass bf16)",
+        str,
+    )
 
     def __init__(self, uid: str | None = None):
         super().__init__(uid)
-        self._setDefault(meanCentering=False, outputCol="pca_features")
+        self._setDefault(
+            meanCentering=False, outputCol="pca_features", precision="highest"
+        )
 
     def getK(self) -> int:
         return self.getOrDefault("k")
@@ -73,7 +83,13 @@ class PCAParams(HasInputCol, HasOutputCol):
 
 # Module-level jitted kernels: jax.jit caches per input shape, and row
 # bucketing keeps the set of shapes small.
-_gram_stats = jax.jit(L.gram_stats)
+_gram_stats = jax.jit(L.gram_stats, static_argnames=("precision",))
+
+_PRECISIONS = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
 
 
 def _fit_from_stats(stats: L.GramStats, k: int, mean_centering: bool):
@@ -103,6 +119,11 @@ class PCA(PCAParams, Estimator):
     def setMeanCentering(self, value: bool) -> "PCA":
         return self._set(meanCentering=value)
 
+    def setPrecision(self, value: str) -> "PCA":
+        if value not in _PRECISIONS:
+            raise ValueError(f"precision must be one of {sorted(_PRECISIONS)}")
+        return self._set(precision=value)
+
     def fit(self, dataset: Any, num_partitions: int | None = None) -> "PCAModel":
         """Two-phase fit, mirroring the reference call stack (SURVEY.md §3.1):
         per-partition device Gram accumulation + cross-partition reduce, then
@@ -121,9 +142,11 @@ class PCA(PCAParams, Estimator):
                         f"inconsistent feature dim: {m.shape[1]} != {n_cols}"
                     )
 
+            prec = _PRECISIONS[self.getOrDefault("precision")]
+
             def partition_task(mat):
                 padded, true_rows = columnar.pad_rows(mat)
-                stats = _gram_stats(jnp.asarray(padded))
+                stats = _gram_stats(jnp.asarray(padded), precision=prec)
                 # padding adds zero rows: fix only the count
                 return L.GramStats(
                     stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype)

@@ -1,0 +1,53 @@
+"""Fused Pallas Gram kernel — interpret-mode differentials on CPU.
+
+On hardware the same kernel is exercised by bench.py; here the interpreter
+validates the math (split-bf16 accumulation, moment fusion, padding)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.ops.pallas_gram import fused_gram_moments
+
+
+def _check(x, **kw):
+    g, cs, sq = fused_gram_moments(jnp.asarray(x, jnp.float32), interpret=True, **kw)
+    xf = x.astype(np.float64)
+    # split-bf16 carries ~16 mantissa bits -> ~1e-5 relative
+    rows = x.shape[0]
+    scale = np.abs(xf.T @ xf).max() + 1e-12
+    np.testing.assert_allclose(np.asarray(g), xf.T @ xf, atol=3e-5 * scale)
+    # moments are reconstructed from hi+lo (~2^-17 relative per element);
+    # with cancellation the error is absolute, ~sqrt(rows)·2^-17·|x|
+    np.testing.assert_allclose(
+        np.asarray(cs), xf.sum(0), rtol=1e-4, atol=2e-4 * np.sqrt(rows)
+    )
+    np.testing.assert_allclose(
+        np.asarray(sq), (xf**2).sum(0), rtol=1e-4, atol=2e-4 * np.sqrt(rows)
+    )
+
+
+class TestFusedGram:
+    def test_block_aligned(self, rng):
+        _check(rng.normal(size=(2048, 256)), block_rows=512, block_cols=128)
+
+    def test_row_padding(self, rng):
+        _check(rng.normal(size=(700, 128)), block_rows=512, block_cols=128)
+
+    def test_col_padding(self, rng):
+        _check(rng.normal(size=(512, 200)), block_rows=256, block_cols=128)
+
+    def test_multi_col_blocks(self, rng):
+        # exercises the off-diagonal (i != j) tiles and the i==0 moment wave
+        _check(rng.normal(size=(512, 384)), block_rows=256, block_cols=128)
+
+    def test_split_precision_beats_bf16(self, rng):
+        """The hi+lo split must be far more accurate than plain bf16."""
+        x = rng.normal(size=(1024, 128)).astype(np.float32)
+        g, _, _ = fused_gram_moments(jnp.asarray(x), interpret=True,
+                                     block_rows=512, block_cols=128)
+        exact = x.astype(np.float64).T @ x.astype(np.float64)
+        bf = np.asarray(jnp.asarray(x, jnp.bfloat16), np.float64)
+        err_split = np.abs(np.asarray(g) - exact).max()
+        err_bf16 = np.abs(bf.T @ bf - exact).max()
+        assert err_split < err_bf16 / 20
