@@ -70,8 +70,12 @@ class PCAParams(HasInputCol, HasOutputCol):
 
     def __init__(self, uid: str | None = None):
         super().__init__(uid)
+        from spark_rapids_ml_tpu.utils.config import get_config
+
         self._setDefault(
-            meanCentering=False, outputCol="pca_features", precision="highest"
+            meanCentering=False,
+            outputCol="pca_features",
+            precision=get_config().default_precision,
         )
 
     def getK(self) -> int:

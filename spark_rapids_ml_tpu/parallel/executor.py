@@ -31,15 +31,24 @@ def run_partition_tasks(
     fn: Callable[[T], R],
     items: Sequence[T],
     *,
-    max_retries: int = 3,
-    max_workers: int = 4,
+    max_retries: int | None = None,
+    max_workers: int | None = None,
     retry_backoff_s: float = 0.05,
 ) -> list[R]:
     """Apply ``fn`` to every item, in order, with per-task retries.
 
     Deterministic-output contract: results are returned in input order
     regardless of completion order, so reductions over them are stable.
+    Defaults come from the runtime config (TPU_ML_MAX_WORKERS /
+    TPU_ML_TASK_RETRIES).
     """
+    from spark_rapids_ml_tpu.utils.config import get_config
+
+    cfg = get_config()
+    if max_retries is None:
+        max_retries = cfg.task_retries
+    if max_workers is None:
+        max_workers = cfg.max_workers
     items = list(items)
     if not items:
         return []

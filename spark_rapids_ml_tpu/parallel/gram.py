@@ -56,6 +56,24 @@ def sharded_gram_stats(
     return _stats(x)
 
 
+def sharded_moment_stats(x: jax.Array, mesh: Mesh):
+    """Data-parallel StandardScaler moments: local sums + psum over ICI."""
+    from spark_rapids_ml_tpu.ops import scaler as S
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(DATA_AXIS, None),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def _stats(xl):
+        s = S.moment_stats(xl)
+        return jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), s)
+
+    return _stats(x)
+
+
 def ring_gram(
     x: jax.Array,
     mesh: Mesh,

@@ -1,0 +1,65 @@
+"""Sharded KMeans — Lloyd iterations as SPMD mesh programs.
+
+Same shape as ``parallel.gram``: each device runs the MXU Lloyd kernels on
+its row shard, a psum over the ``data`` axis combines the KMeansStats
+monoid, and the centroid update happens replicated — one XLA program per
+iteration, collectives on ICI, no host round-trip for the reduction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from spark_rapids_ml_tpu.ops import kmeans as KM
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+
+def sharded_kmeans_stats(
+    x: jax.Array,
+    centers: jax.Array,
+    mesh: Mesh,
+    *,
+    block_rows: int = 8192,
+) -> KM.KMeansStats:
+    """One Lloyd accumulation pass over a data-sharded [rows, n] X; centers
+    replicated; replicated stats out."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def _stats(xl, c):
+        local = KM.kmeans_stats(xl, c, block_rows=min(block_rows, xl.shape[0]))
+        return jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), local)
+
+    return _stats(x, centers)
+
+
+def distributed_lloyd_step(
+    x: jax.Array, centers: jax.Array, mesh: Mesh
+) -> tuple[jax.Array, jax.Array]:
+    """One full distributed Lloyd iteration: (new_centers, cost)."""
+    stats = sharded_kmeans_stats(x, centers, mesh)
+    return KM.update_centers(stats, centers), stats.cost
+
+
+def make_distributed_lloyd(mesh: Mesh):
+    """jit the Lloyd step with shardings bound: X data-sharded, centers and
+    outputs replicated."""
+    return jax.jit(
+        partial(distributed_lloyd_step, mesh=mesh),
+        in_shardings=(
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P()),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
+    )

@@ -143,7 +143,7 @@ def apply_column_transform(dataset: Any, input_col: str | None, output_col: str,
 # ---------------------------------------------------------------------------
 
 
-def bucket_rows(rows: int, *, min_bucket: int = 128) -> int:
+def bucket_rows(rows: int, *, min_bucket: int | None = None) -> int:
     """Round a row count up to the next power-of-two bucket.
 
     XLA compiles one program per distinct shape; padding partitions to
@@ -151,11 +151,16 @@ def bucket_rows(rows: int, *, min_bucket: int = 128) -> int:
     while wasting <2x FLOPs worst case. Zero-padding is exact for every
     reduction we run (Gram, column sums, scaler moments): padded rows
     contribute zero, and true counts ride in ``GramStats.count``.
+    The bucket floor comes from the runtime config (TPU_ML_MIN_BUCKET).
     """
+    if min_bucket is None:
+        from spark_rapids_ml_tpu.utils.config import get_config
+
+        min_bucket = get_config().min_bucket
     return max(min_bucket, 1 << math.ceil(math.log2(max(rows, 1))))
 
 
-def pad_rows(x: np.ndarray, *, min_bucket: int = 128) -> tuple[np.ndarray, int]:
+def pad_rows(x: np.ndarray, *, min_bucket: int | None = None) -> tuple[np.ndarray, int]:
     """Zero-pad [rows, n] to its row bucket; returns (padded, true_rows)."""
     rows = x.shape[0]
     bucket = bucket_rows(rows, min_bucket=min_bucket)

@@ -1,0 +1,58 @@
+"""Runtime configuration — the env/conf tier of the config system.
+
+The reference's config is three-tier (SURVEY.md §5): (1) per-estimator ML
+Params, (2) Spark runtime confs (``spark.rapids.sql.enabled``, GPU resource
+amounts), (3) build-time flags. Tier 1 lives in ``models.params``. This
+module is tier 2 for the TPU build — process-level knobs read from
+``TPU_ML_*`` environment variables once at first use, overridable in code:
+
+- ``TPU_ML_MIN_BUCKET``      (int, default 128)  — row-bucket floor for
+  static-shape padding (utils.columnar.bucket_rows).
+- ``TPU_ML_MAX_WORKERS``     (int, default 4)    — partition executor pool.
+- ``TPU_ML_TASK_RETRIES``    (int, default 3)    — per-task retry budget
+  (the ``spark.task.maxFailures`` analog).
+- ``TPU_ML_DEFAULT_PRECISION`` ('highest'|'high'|'default') — estimator-level
+  default for the Gram/projection matmul precision.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class RuntimeConfig:
+    min_bucket: int = field(default_factory=lambda: _int_env("TPU_ML_MIN_BUCKET", 128))
+    max_workers: int = field(default_factory=lambda: _int_env("TPU_ML_MAX_WORKERS", 4))
+    task_retries: int = field(default_factory=lambda: _int_env("TPU_ML_TASK_RETRIES", 3))
+    default_precision: str = field(
+        default_factory=lambda: os.environ.get("TPU_ML_DEFAULT_PRECISION", "highest")
+    )
+
+
+_config: RuntimeConfig | None = None
+
+
+def get_config() -> RuntimeConfig:
+    global _config
+    if _config is None:
+        _config = RuntimeConfig()
+    return _config
+
+
+def set_config(**overrides) -> RuntimeConfig:
+    """Override runtime knobs in code (tests, notebooks)."""
+    cfg = get_config()
+    for k, v in overrides.items():
+        if not hasattr(cfg, k):
+            raise KeyError(f"unknown config key {k!r}")
+        setattr(cfg, k, v)
+    return cfg

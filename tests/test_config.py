@@ -1,0 +1,47 @@
+"""Runtime config tests."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.config import get_config, set_config
+
+
+@pytest.fixture(autouse=True)
+def restore_config():
+    cfg = get_config()
+    saved = cfg.__dict__.copy()
+    yield
+    cfg.__dict__.update(saved)
+
+
+def test_defaults():
+    cfg = get_config()
+    assert cfg.min_bucket == 128
+    assert cfg.task_retries == 3
+    assert cfg.default_precision == "highest"
+
+
+def test_set_config_overrides():
+    set_config(min_bucket=32)
+    assert columnar.bucket_rows(5) == 32
+    set_config(min_bucket=256)
+    assert columnar.bucket_rows(5) == 256
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(KeyError):
+        set_config(bogus=1)
+
+
+def test_pca_precision_default_follows_config():
+    set_config(default_precision="high")
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    assert PCA().getOrDefault("precision") == "high"
+
+
+def test_bucket_rows_powers_of_two():
+    assert columnar.bucket_rows(128) == 128
+    assert columnar.bucket_rows(129) == 256
+    assert columnar.bucket_rows(1) == 128

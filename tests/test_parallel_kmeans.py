@@ -1,0 +1,65 @@
+"""Sharded KMeans / scaler-moments mesh tests on the virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.ops import kmeans as KM
+from spark_rapids_ml_tpu.parallel import gram as G
+from spark_rapids_ml_tpu.parallel import kmeans as PK
+from spark_rapids_ml_tpu.parallel import mesh as M
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return M.create_mesh(data=8, feat=1)
+
+
+class TestShardedKMeans:
+    def test_stats_match_local(self, mesh, rng):
+        x = rng.normal(size=(512, 8))
+        c = rng.normal(size=(5, 8))
+        xs = jax.device_put(jnp.asarray(x), M.data_sharding(mesh))
+        got = PK.sharded_kmeans_stats(xs, jnp.asarray(c), mesh, block_rows=64)
+        want = KM.kmeans_stats(jnp.asarray(x), jnp.asarray(c), block_rows=64)
+        np.testing.assert_allclose(np.asarray(got.sums), np.asarray(want.sums), atol=1e-8)
+        np.testing.assert_allclose(np.asarray(got.counts), np.asarray(want.counts))
+        np.testing.assert_allclose(float(got.cost), float(want.cost), rtol=1e-10)
+
+    def test_lloyd_step_converges_on_blobs(self, mesh, rng):
+        centers0 = np.array([[0.0, 0.0], [8.0, 8.0]])
+        x = np.concatenate(
+            [c + rng.normal(scale=0.3, size=(128, 2)) for c in centers0]
+        )
+        rng.shuffle(x)
+        step = PK.make_distributed_lloyd(mesh)
+        c = jnp.asarray(centers0 + rng.normal(scale=0.5, size=(2, 2)))
+        xs = jnp.asarray(x)
+        for _ in range(5):
+            c, cost = step(xs, c)
+        got = np.asarray(c)[np.lexsort(np.asarray(c).T)]
+        np.testing.assert_allclose(got, centers0, atol=0.15)
+        assert float(cost) < 2 * len(x) * 0.3**2 * 2
+
+    def test_outputs_replicated(self, mesh, rng):
+        step = PK.make_distributed_lloyd(mesh)
+        c, _ = step(
+            jnp.asarray(rng.normal(size=(256, 4))), jnp.asarray(rng.normal(size=(3, 4)))
+        )
+        assert c.sharding.is_fully_replicated
+
+
+class TestShardedMoments:
+    def test_match_local(self, mesh, rng):
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        x = rng.normal(size=(256, 16))
+        xs = jax.device_put(jnp.asarray(x), M.data_sharding(mesh))
+        got = G.sharded_moment_stats(xs, mesh)
+        np.testing.assert_allclose(np.asarray(got.total), x.sum(0), rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(got.total_sq), (x**2).sum(0), rtol=1e-10)
+        assert int(got.count) == 256
+        mean, std = S.finalize_moments(got)
+        np.testing.assert_allclose(np.asarray(mean), x.mean(0), rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(std), x.std(0, ddof=1), rtol=1e-8)
