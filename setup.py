@@ -1,0 +1,33 @@
+"""Build glue: compile the native bridge during wheel builds.
+
+The reference compiles its native module from the JVM build (Maven antrun
+invokes cmake+ninja at the ``validate`` phase, pom.xml:345-368) and copies
+the resulting .so into the jar's resources (pom.xml:369-396). This is the
+same pattern for a Python artifact: ``build_py`` shells out to the bridge
+Makefile so ``libtpuml_bridge.so`` lands inside the package directory and is
+picked up by the package-data glob. If no C++ toolchain is present the build
+degrades gracefully — the bridge also self-builds on first use at runtime
+(bridge/__init__.py), and every bridge consumer has a pure-Python path.
+"""
+
+import subprocess
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+NATIVE_DIR = Path(__file__).parent / "spark_rapids_ml_tpu" / "bridge" / "native"
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        try:
+            subprocess.run(["make", "-C", str(NATIVE_DIR)], check=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            # Non-fatal: the runtime loader rebuilds on first use.
+            print(f"warning: native bridge build skipped ({e})")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

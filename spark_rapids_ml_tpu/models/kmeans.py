@@ -88,14 +88,51 @@ class KMeans(_KMeansParams, Estimator):
         centers = KM.kmeans_plus_plus_init(key, jnp.asarray(sample), k)
         return np.asarray(centers)
 
-    def fit(self, dataset: Any, num_partitions: int | None = None) -> "KMeansModel":
+    def fit(
+        self,
+        dataset: Any,
+        num_partitions: int | None = None,
+        *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 1,
+    ) -> "KMeansModel":
+        """Lloyd training with optional mid-training checkpoint/resume.
+
+        With ``checkpoint_dir`` set, training state (centers, iteration,
+        cost) is durably checkpointed every ``checkpoint_every`` iterations,
+        and an interrupted fit pointed at the same directory resumes from the
+        newest checkpoint instead of re-seeding — a capability the reference
+        lacks entirely (model persistence only, SURVEY.md §5).
+        """
+        if checkpoint_every < 1:
+            raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
         input_col = self._paramMap.get("inputCol")
         ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
         k = self.getK()
         tol_sq = self.getTol() ** 2
 
-        with trace_range("kmeans init"):
-            centers = self._init_centers(ds, k)
+        ckpt = start_iter = None
+        cost = np.inf
+        if checkpoint_dir is not None:
+            from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+            ckpt = TrainingCheckpointer(checkpoint_dir)
+            resumed = ckpt.latest()
+            if resumed is not None:
+                step, arrays, state = resumed
+                if arrays["centers"].shape[0] != k:
+                    raise ValueError(
+                        f"checkpoint at {checkpoint_dir} holds "
+                        f"{arrays['centers'].shape[0]} centers but k={k}; "
+                        "point checkpoint_dir at a fresh directory to train "
+                        "with different params"
+                    )
+                centers, start_iter = arrays["centers"], step + 1
+                cost = float(state.get("cost", np.inf))
+        if start_iter is None:
+            start_iter = 0
+            with trace_range("kmeans init"):
+                centers = self._init_centers(ds, k)
 
         # pre-pad partitions once; weights mask the padding
         padded = []
@@ -105,9 +142,15 @@ class KMeans(_KMeansParams, Estimator):
             w[:true_rows] = 1.0
             padded.append((jnp.asarray(pm), jnp.asarray(w)))
 
-        cost = np.inf
+        n_cols = padded[0][0].shape[1]
+        if centers.shape[1] != n_cols:
+            raise ValueError(
+                f"checkpoint/init centers have {centers.shape[1]} features but "
+                f"the dataset has {n_cols}; is checkpoint_dir stale?"
+            )
+
         with trace_range("kmeans lloyd"):
-            for _ in range(self.getMaxIter()):
+            for it in range(start_iter, self.getMaxIter()):
                 c = jnp.asarray(centers)
                 partials = [KM.kmeans_stats(x, c, w) for x, w in padded]
                 stats = tree_reduce(partials, KM.combine_kmeans_stats)
@@ -115,6 +158,8 @@ class KMeans(_KMeansParams, Estimator):
                 cost = float(stats.cost)
                 shift = float(KM.center_shift_sq(c, jnp.asarray(new_centers)))
                 centers = new_centers
+                if ckpt is not None and (it + 1) % checkpoint_every == 0:
+                    ckpt.save(it, {"centers": centers}, {"cost": cost})
                 if shift <= tol_sq:
                     break
 

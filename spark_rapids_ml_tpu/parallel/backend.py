@@ -21,11 +21,10 @@ from functools import partial
 from typing import Sequence
 
 import jax
-import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
+from spark_rapids_ml_tpu.parallel.mesh import shard_map
 from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
 
 _initialized = False
@@ -68,6 +67,27 @@ def process_info() -> dict:
 # ---------------------------------------------------------------------------
 # Mesh collectives facade
 # ---------------------------------------------------------------------------
+
+
+def mapreduce_data_axis(kernel, mesh: Mesh, *, replicated_args: int = 0):
+    """shard_map a partition-stats kernel over the ``data`` axis and
+    psum-combine its monoid output (replicated result).
+
+    ``kernel(x_local, *replicated)`` takes the device-local row shard plus
+    ``replicated_args`` fully-replicated operands and returns any pytree of
+    summable statistics — the GramStats/MomentStats/KMeansStats pattern. This
+    is the one place the collective scaffolding lives; every sharded
+    estimator reducer is an instantiation.
+    """
+    from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+    in_specs = (P(DATA_AXIS, None),) + (P(),) * replicated_args
+
+    @partial(shard_map, mesh=mesh, in_specs=in_specs, out_specs=P(), check_rep=False)
+    def _run(*args):
+        return jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), kernel(*args))
+
+    return _run
 
 
 def allreduce(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:

@@ -25,10 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 from spark_rapids_ml_tpu.ops import linalg as L
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, FEAT_AXIS
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, FEAT_AXIS, shard_map
 
 
 def sharded_gram_stats(
@@ -42,36 +41,19 @@ def sharded_gram_stats(
     ``x`` is [rows, n] sharded along ``data``; the result is replicated.
     """
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=P(DATA_AXIS, None),
-        out_specs=P(),
-        check_rep=False,
-    )
-    def _stats(xl):
-        s = L.gram_stats(xl, precision=precision)
-        return jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), s)
+    from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
 
-    return _stats(x)
+    return mapreduce_data_axis(
+        lambda xl: L.gram_stats(xl, precision=precision), mesh
+    )(x)
 
 
 def sharded_moment_stats(x: jax.Array, mesh: Mesh):
     """Data-parallel StandardScaler moments: local sums + psum over ICI."""
     from spark_rapids_ml_tpu.ops import scaler as S
+    from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=P(DATA_AXIS, None),
-        out_specs=P(),
-        check_rep=False,
-    )
-    def _stats(xl):
-        s = S.moment_stats(xl)
-        return jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), s)
-
-    return _stats(x)
+    return mapreduce_data_axis(S.moment_stats, mesh)(x)
 
 
 def ring_gram(

@@ -11,10 +11,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 from spark_rapids_ml_tpu.ops import kmeans as KM
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
@@ -30,18 +27,15 @@ def sharded_kmeans_stats(
     """One Lloyd accumulation pass over a data-sharded [rows, n] X; centers
     replicated; replicated stats out."""
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P()),
-        out_specs=P(),
-        check_rep=False,
-    )
-    def _stats(xl, c):
-        local = KM.kmeans_stats(xl, c, block_rows=min(block_rows, xl.shape[0]))
-        return jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), local)
+    from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
 
-    return _stats(x, centers)
+    return mapreduce_data_axis(
+        lambda xl, c: KM.kmeans_stats(
+            xl, c, block_rows=min(block_rows, xl.shape[0])
+        ),
+        mesh,
+        replicated_args=1,
+    )(x, centers)
 
 
 def distributed_lloyd_step(

@@ -15,6 +15,7 @@ this package:
 from __future__ import annotations
 
 import math
+from functools import partial
 
 import jax
 import numpy as np
@@ -22,6 +23,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 FEAT_AXIS = "feat"
+
+
+def shard_map(f=None, **kwargs):
+    """``jax.shard_map`` across JAX versions: new releases renamed the
+    replication-check kwarg ``check_rep`` → ``check_vma`` and moved the API
+    out of ``jax.experimental``. All sharded kernels in this package route
+    through this shim."""
+    if f is None:
+        return partial(shard_map, **kwargs)
+    if hasattr(jax, "shard_map"):
+        kwargs.setdefault("check_vma", kwargs.pop("check_rep", True))
+        return jax.shard_map(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as _sm  # pragma: no cover
+
+    return _sm(f, **kwargs)  # pragma: no cover
 
 
 def create_mesh(
@@ -56,6 +72,38 @@ def data_sharding(mesh: Mesh, *, feature_sharded: bool = False) -> NamedSharding
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def create_hybrid_mesh(feat: int = 1) -> Mesh:
+    """Multi-slice (data, feat) mesh laid out so ``feat`` rides ICI.
+
+    On a multi-slice TPU deployment devices within a slice talk over ICI
+    (fast) and across slices over DCN (slow). The ring-Gram ``ppermute`` and
+    the per-step collectives must therefore stay intra-slice, with only the
+    once-per-fit Gram psum crossing DCN. This builds the mesh from
+    ``mesh_utils.create_hybrid_device_mesh`` (DCN × ICI topology-aware
+    ordering) and collapses it to the package's (data, feat) axes with
+    ``feat`` innermost — i.e. entirely inside a slice.
+
+    Falls back to the flat ``create_mesh`` when the runtime reports a single
+    slice/granule (e.g. CPU or single-host TPU).
+    """
+    devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None in slice_ids or len(slice_ids) == 1:
+        return create_mesh(feat=feat)
+    from jax.experimental import mesh_utils
+
+    n_slices = len(slice_ids)
+    per_slice = len(devices) // n_slices
+    if per_slice % feat:
+        raise ValueError(f"feat={feat} must divide devices-per-slice={per_slice}")
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_slice // feat, feat),
+        dcn_mesh_shape=(n_slices, 1),
+        devices=devices,
+    )
+    return Mesh(grid, (DATA_AXIS, FEAT_AXIS))
 
 
 def factor_mesh(n_devices: int) -> tuple[int, int]:

@@ -1,0 +1,98 @@
+"""Mid-training checkpoint/resume for iterative estimators.
+
+The reference has model persistence only — "no mid-training checkpointing —
+training is a single two-phase job" (SURVEY.md §5). Its stretch family is
+iterative (Lloyd sweeps over 50M rows, BASELINE.json config 5), where a
+preempted job losing every completed iteration is real money on shared TPU
+pods, so this framework makes training-state checkpointing a first-class
+subsystem rather than inheriting the gap.
+
+Design: a checkpoint is a step-numbered directory holding one ``.npz`` of
+named arrays plus a ``state.json`` of scalars. Writes are atomic
+(write to ``<dir>/.tmp-<step>``, fsync, ``os.replace``) so a preemption
+mid-write can never corrupt the latest durable state — readers only ever see
+fully-renamed step directories. Retention keeps the newest ``keep`` steps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from pathlib import Path
+
+import numpy as np
+
+_STEP_PREFIX = "step-"
+
+
+class TrainingCheckpointer:
+    """Atomic step-numbered checkpoints of training state in one directory.
+
+    >>> ckpt = TrainingCheckpointer(dir)
+    >>> ckpt.save(3, {"centers": c}, {"cost": 1.5})
+    >>> step, arrays, state = ckpt.latest()
+    """
+
+    def __init__(self, directory: str | Path, *, keep: int = 2):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.dir = Path(directory)
+        self.keep = keep
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _step_dir(self, step: int) -> Path:
+        return self.dir / f"{_STEP_PREFIX}{step:09d}"
+
+    def steps(self) -> list[int]:
+        out = []
+        for p in self.dir.iterdir():
+            if p.is_dir() and p.name.startswith(_STEP_PREFIX):
+                try:
+                    out.append(int(p.name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def save(self, step: int, arrays: dict[str, np.ndarray], state: dict | None = None) -> None:
+        tmp = self.dir / f".tmp-{step}"
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        np.savez(tmp / "arrays.npz", **{k: np.asarray(v) for k, v in arrays.items()})
+        (tmp / "state.json").write_text(json.dumps({"step": step, **(state or {})}))
+        # fsync the files then atomically publish the directory
+        for f in tmp.iterdir():
+            fd = os.open(f, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        final = self._step_dir(step)
+        if final.exists():
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        self._retain()
+
+    def _retain(self) -> None:
+        steps = self.steps()
+        for s in steps[: -self.keep]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def load(self, step: int) -> tuple[dict[str, np.ndarray], dict]:
+        d = self._step_dir(step)
+        with np.load(d / "arrays.npz") as z:
+            arrays = {k: z[k] for k in z.files}
+        state = json.loads((d / "state.json").read_text())
+        return arrays, state
+
+    def latest(self) -> tuple[int, dict[str, np.ndarray], dict] | None:
+        """Newest durable checkpoint, or None. Skips any step whose payload
+        is unreadable (e.g. a stale dir from a different schema)."""
+        for step in reversed(self.steps()):
+            try:
+                arrays, state = self.load(step)
+            except Exception:
+                continue
+            return step, arrays, state
+        return None

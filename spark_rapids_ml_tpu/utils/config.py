@@ -21,11 +21,25 @@ import os
 from dataclasses import dataclass, field
 
 
+VALID_PRECISIONS = ("highest", "high", "default")
+
+
 def _int_env(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))
     except ValueError:
-        return default
+        raise ValueError(
+            f"{name}={os.environ[name]!r} is not an integer"
+        ) from None
+
+
+def _precision_env() -> str:
+    v = os.environ.get("TPU_ML_DEFAULT_PRECISION", "highest")
+    if v not in VALID_PRECISIONS:
+        raise ValueError(
+            f"TPU_ML_DEFAULT_PRECISION={v!r} must be one of {VALID_PRECISIONS}"
+        )
+    return v
 
 
 @dataclass
@@ -33,9 +47,7 @@ class RuntimeConfig:
     min_bucket: int = field(default_factory=lambda: _int_env("TPU_ML_MIN_BUCKET", 128))
     max_workers: int = field(default_factory=lambda: _int_env("TPU_ML_MAX_WORKERS", 4))
     task_retries: int = field(default_factory=lambda: _int_env("TPU_ML_TASK_RETRIES", 3))
-    default_precision: str = field(
-        default_factory=lambda: os.environ.get("TPU_ML_DEFAULT_PRECISION", "highest")
-    )
+    default_precision: str = field(default_factory=_precision_env)
 
 
 _config: RuntimeConfig | None = None
@@ -54,5 +66,11 @@ def set_config(**overrides) -> RuntimeConfig:
     for k, v in overrides.items():
         if not hasattr(cfg, k):
             raise KeyError(f"unknown config key {k!r}")
+        if k == "default_precision" and v not in VALID_PRECISIONS:
+            raise ValueError(
+                f"default_precision={v!r} must be one of {VALID_PRECISIONS}"
+            )
+        if k != "default_precision" and not isinstance(v, int):
+            raise TypeError(f"{k} must be an int, got {type(v).__name__}")
         setattr(cfg, k, v)
     return cfg

@@ -1,0 +1,106 @@
+"""Mid-training checkpoint/resume tests — the subsystem the reference lacks
+(model persistence only, SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models.kmeans import KMeans
+from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+
+@pytest.fixture
+def blobs(rng):
+    centers = np.array([[0.0, 0.0, 0.0], [10.0, 10.0, 0.0], [-10.0, 5.0, 5.0]])
+    x = np.concatenate([c + rng.normal(scale=0.5, size=(80, 3)) for c in centers])
+    rng.shuffle(x)
+    return x
+
+
+class TestCheckpointer:
+    def test_save_load_roundtrip(self, tmp_path):
+        ckpt = TrainingCheckpointer(tmp_path)
+        a = np.arange(12.0).reshape(3, 4)
+        ckpt.save(0, {"centers": a}, {"cost": 1.5})
+        step, arrays, state = ckpt.latest()
+        assert step == 0
+        np.testing.assert_array_equal(arrays["centers"], a)
+        assert state["cost"] == 1.5
+
+    def test_latest_picks_newest(self, tmp_path):
+        ckpt = TrainingCheckpointer(tmp_path, keep=5)
+        for s in range(4):
+            ckpt.save(s, {"v": np.asarray([s])})
+        step, arrays, _ = ckpt.latest()
+        assert step == 3
+        assert arrays["v"][0] == 3
+
+    def test_retention(self, tmp_path):
+        ckpt = TrainingCheckpointer(tmp_path, keep=2)
+        for s in range(5):
+            ckpt.save(s, {"v": np.asarray([s])})
+        assert ckpt.steps() == [3, 4]
+
+    def test_empty_dir_returns_none(self, tmp_path):
+        assert TrainingCheckpointer(tmp_path).latest() is None
+
+    def test_partial_write_is_invisible(self, tmp_path):
+        """A torn write (tmp dir left behind) must not be seen as a state."""
+        ckpt = TrainingCheckpointer(tmp_path)
+        ckpt.save(1, {"v": np.asarray([1.0])})
+        (tmp_path / ".tmp-2").mkdir()  # simulated mid-crash leftover
+        step, _, _ = ckpt.latest()
+        assert step == 1
+
+    def test_corrupt_step_skipped(self, tmp_path):
+        ckpt = TrainingCheckpointer(tmp_path)
+        ckpt.save(1, {"v": np.asarray([1.0])})
+        bad = tmp_path / "step-000000002"
+        bad.mkdir()
+        (bad / "arrays.npz").write_bytes(b"not a zip")
+        step, arrays, _ = ckpt.latest()
+        assert step == 1 and arrays["v"][0] == 1.0
+
+
+class TestKMeansResume:
+    def test_resume_matches_uninterrupted(self, blobs, tmp_path):
+        """Interrupt after 2 iterations, resume from the checkpoint directory:
+        the final centers must equal an uninterrupted run's."""
+        mk = lambda: KMeans().setInputCol("f").setK(3).setSeed(1).setMaxIter(12)
+        full = mk().fit(blobs)
+
+        mk().setMaxIter(2).fit(blobs, checkpoint_dir=str(tmp_path / "ck"))
+        resumed = mk().fit(blobs, checkpoint_dir=str(tmp_path / "ck"))
+
+        c_full = full.clusterCenters[np.lexsort(full.clusterCenters.T)]
+        c_res = resumed.clusterCenters[np.lexsort(resumed.clusterCenters.T)]
+        np.testing.assert_allclose(c_res, c_full, atol=1e-6)
+
+    def test_resume_skips_completed_iterations(self, blobs, tmp_path, monkeypatch):
+        """Resuming a converged run must not re-run init (no re-seeding)."""
+        mk = lambda: KMeans().setInputCol("f").setK(3).setSeed(1).setMaxIter(12)
+        mk().fit(blobs, checkpoint_dir=str(tmp_path / "ck"))
+
+        est = mk()
+        def boom(*a, **k):
+            raise AssertionError("init must not run on resume")
+        monkeypatch.setattr(est, "_init_centers", boom)
+        model = est.fit(blobs, checkpoint_dir=str(tmp_path / "ck"))
+        assert model.clusterCenters.shape == (3, 3)
+
+    def test_resume_with_different_k_rejected(self, blobs, tmp_path):
+        KMeans().setInputCol("f").setK(3).setSeed(1).setMaxIter(3).setTol(0.0).fit(
+            blobs, checkpoint_dir=str(tmp_path / "ck")
+        )
+        with pytest.raises(ValueError, match="3 centers but k=5"):
+            KMeans().setInputCol("f").setK(5).setSeed(1).fit(
+                blobs, checkpoint_dir=str(tmp_path / "ck")
+            )
+
+    def test_checkpoint_every(self, rng, tmp_path):
+        # unstructured data: Lloyd keeps moving, so no early convergence break
+        x = rng.uniform(size=(400, 5))
+        KMeans().setInputCol("f").setK(8).setSeed(1).setMaxIter(6).setTol(0.0).fit(
+            x, checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=3
+        )
+        steps = TrainingCheckpointer(tmp_path / "ck").steps()
+        assert steps == [2, 5]

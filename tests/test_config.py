@@ -15,11 +15,40 @@ def restore_config():
     cfg.__dict__.update(saved)
 
 
-def test_defaults():
-    cfg = get_config()
+def test_defaults(monkeypatch):
+    # assert built-in defaults, immune to TPU_ML_* set in the outer env
+    for var in (
+        "TPU_ML_MIN_BUCKET",
+        "TPU_ML_MAX_WORKERS",
+        "TPU_ML_TASK_RETRIES",
+        "TPU_ML_DEFAULT_PRECISION",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    from spark_rapids_ml_tpu.utils.config import RuntimeConfig
+
+    cfg = RuntimeConfig()
     assert cfg.min_bucket == 128
     assert cfg.task_retries == 3
     assert cfg.default_precision == "highest"
+
+
+def test_invalid_env_rejected(monkeypatch):
+    from spark_rapids_ml_tpu.utils.config import RuntimeConfig
+
+    monkeypatch.setenv("TPU_ML_DEFAULT_PRECISION", "hi")
+    with pytest.raises(ValueError, match="TPU_ML_DEFAULT_PRECISION"):
+        RuntimeConfig()
+    monkeypatch.delenv("TPU_ML_DEFAULT_PRECISION")
+    monkeypatch.setenv("TPU_ML_MIN_BUCKET", "tiny")
+    with pytest.raises(ValueError, match="TPU_ML_MIN_BUCKET"):
+        RuntimeConfig()
+
+
+def test_set_config_validates_values():
+    with pytest.raises(ValueError):
+        set_config(default_precision="hi")
+    with pytest.raises(TypeError):
+        set_config(min_bucket="64")
 
 
 def test_set_config_overrides():

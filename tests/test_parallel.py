@@ -91,3 +91,21 @@ class TestMeshHelpers:
     def test_create_mesh_validates(self):
         with pytest.raises(ValueError):
             M.create_mesh(data=16, feat=2)
+
+    def test_hybrid_mesh_falls_back_single_slice(self):
+        # CPU devices report no slice topology → flat (data, feat) mesh
+        mesh = M.create_hybrid_mesh(feat=2)
+        assert mesh.axis_names == (M.DATA_AXIS, M.FEAT_AXIS)
+        assert mesh.shape[M.FEAT_AXIS] == 2
+
+    def test_shard_map_shim_decorator_form(self, mesh8):
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        @M.shard_map(mesh=mesh8, in_specs=P(M.DATA_AXIS), out_specs=P(), check_rep=False)
+        def total(v):
+            return lax.psum(v.sum(), M.DATA_AXIS)
+
+        x = np.arange(16.0)
+        assert float(total(x)) == x.sum()
