@@ -40,4 +40,13 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import scaler
 
         return getattr(scaler, name)
+    if name in (
+        "LinearRegression",
+        "LinearRegressionModel",
+        "LogisticRegression",
+        "LogisticRegressionModel",
+    ):
+        from spark_rapids_ml_tpu.models import linear
+
+        return getattr(linear, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

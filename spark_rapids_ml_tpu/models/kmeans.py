@@ -182,8 +182,9 @@ class KMeansModel(_KMeansParams, Model):
 
     def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
         padded, true_rows = columnar.pad_rows(mat)
+        xd = jnp.asarray(padded)
         labels, _ = jax.jit(KM.assign_clusters)(
-            jnp.asarray(padded), jnp.asarray(self.clusterCenters, dtype=padded.dtype)
+            xd, jnp.asarray(self.clusterCenters, dtype=xd.dtype)
         )
         return np.asarray(labels)[:true_rows]
 
@@ -209,8 +210,9 @@ class KMeansModel(_KMeansParams, Model):
         total = 0.0
         for mat in ds.matrices():
             padded, true_rows = columnar.pad_rows(mat)
+            xd = jnp.asarray(padded)
             _, dists = jax.jit(KM.assign_clusters)(
-                jnp.asarray(padded), jnp.asarray(self.clusterCenters, dtype=padded.dtype)
+                xd, jnp.asarray(self.clusterCenters, dtype=xd.dtype)
             )
             total += float(jnp.sum(dists[:true_rows]))
         return total

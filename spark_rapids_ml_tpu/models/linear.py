@@ -1,0 +1,300 @@
+"""LinearRegression / LogisticRegression estimators — the GLM family.
+
+Spark-ML-shaped supervised estimators (``featuresCol``/``labelCol``/
+``predictionCol``, fluent setters, save/load) on the same two-phase
+architecture as PCA (SURVEY.md §3.1): per-partition MXU statistics monoids,
+tree-reduced across partitions (mesh/psum variants live in
+``parallel.linear``), then a tiny replicated solve.
+
+- ``LinearRegression``: one data pass (normal equations), closed-form L2.
+- ``LogisticRegression``: IRLS/Newton — one monoid pass per iteration, with
+  the same ``checkpoint_dir`` mid-training checkpoint/resume contract as
+  KMeans (utils/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    Param,
+)
+from spark_rapids_ml_tpu.ops import linear as LIN
+from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+import jax.numpy as jnp
+
+_linear_stats = jax.jit(LIN.linear_stats)
+_solve_normal = jax.jit(LIN.solve_normal, static_argnames=("fit_intercept",))
+_newton_stats = jax.jit(LIN.logistic_newton_stats)
+_newton_update = jax.jit(LIN.newton_update, static_argnames=("fit_intercept",))
+_predict_linear = jax.jit(LIN.predict_linear)
+_predict_proba = jax.jit(LIN.predict_logistic_proba)
+
+
+class _SupervisedParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
+    regParam = Param("regParam", "L2 regularization strength λ", float)
+    fitIntercept = Param("fitIntercept", "whether to fit an intercept term", bool)
+
+    def __init__(self, uid: str | None = None):
+        super().__init__(uid)
+        self._setDefault(
+            featuresCol="features",
+            labelCol="label",
+            predictionCol="prediction",
+            regParam=0.0,
+            fitIntercept=True,
+        )
+
+    def setRegParam(self, value: float):
+        return self._set(regParam=value)
+
+    def setFitIntercept(self, value: bool):
+        return self._set(fitIntercept=value)
+
+    def getRegParam(self) -> float:
+        return self.getOrDefault("regParam")
+
+    def getFitIntercept(self) -> bool:
+        return self.getOrDefault("fitIntercept")
+
+    def _labeled(self, dataset: Any, num_partitions: int | None):
+        return columnar.labeled_partitions(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("labelCol"),
+            num_partitions,
+        )
+
+
+class _GLMModel(_SupervisedParams, Model):
+    """Shared fitted-model surface: coefficients [n] + intercept."""
+
+    def __init__(
+        self,
+        uid: str | None = None,
+        coefficients: np.ndarray | None = None,
+        intercept: float = 0.0,
+    ):
+        super().__init__(uid)
+        self.coefficients = (
+            None if coefficients is None else np.asarray(coefficients)
+        )
+        self.intercept = float(intercept)
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, dataset: Any) -> Any:
+        return columnar.apply_column_transform(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("predictionCol"),
+            self._predict_matrix,
+        )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {
+            "coefficients": self.coefficients,
+            "intercept": np.asarray([self.intercept]),
+        }
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(
+            uid=uid,
+            coefficients=data["coefficients"],
+            intercept=float(data["intercept"][0]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Linear regression
+# ---------------------------------------------------------------------------
+
+
+class LinearRegression(_SupervisedParams, Estimator):
+    """Closed-form (normal equations) least squares with optional L2.
+
+    One MXU pass builds the (XᵀX, Xᵀy, …) monoid per partition; the [n, n]
+    solve runs once on the reduced statistics. λ scales with the row count,
+    so results match ``sklearn.linear_model.Ridge(alpha=regParam·rows)``.
+    """
+
+    def fit(
+        self, dataset: Any, num_partitions: int | None = None
+    ) -> "LinearRegressionModel":
+        parts = self._labeled(dataset, num_partitions)
+
+        def task(part):
+            x, y = part
+            xp, yp, w = columnar.pad_labeled(x, y)
+            return _linear_stats(jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w))
+
+        with trace_range("linreg stats"):
+            partials = run_partition_tasks(task, parts)
+            stats = tree_reduce(partials, LIN.combine_linear_stats)
+        with trace_range("linreg solve"):
+            coef, intercept = _solve_normal(
+                stats,
+                reg_param=self.getRegParam(),
+                fit_intercept=self.getFitIntercept(),
+            )
+        model = LinearRegressionModel(
+            uid=self.uid,
+            coefficients=np.asarray(coef),
+            intercept=float(intercept),
+        )
+        return self._copyValues(model)
+
+
+class LinearRegressionModel(_GLMModel):
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        padded, true_rows = columnar.pad_rows(mat)
+        xd = jnp.asarray(padded)
+        out = _predict_linear(
+            xd,
+            jnp.asarray(self.coefficients, dtype=xd.dtype),
+            jnp.asarray(self.intercept, dtype=xd.dtype),
+        )
+        return np.asarray(out)[:true_rows]
+
+    def predict(self, row) -> float:
+        return float(np.dot(self.coefficients, np.asarray(row)) + self.intercept)
+
+
+# ---------------------------------------------------------------------------
+# Logistic regression
+# ---------------------------------------------------------------------------
+
+
+class LogisticRegression(_SupervisedParams, Estimator):
+    """Binary logistic regression via IRLS/Newton.
+
+    Each iteration is one distributed monoid pass (XᵀWX, Xᵀ(y−p)) plus a
+    replicated [d, d] solve; convergence on the Newton step norm. Supports
+    the same ``checkpoint_dir``/``checkpoint_every`` mid-training
+    checkpoint/resume contract as KMeans.
+    """
+
+    maxIter = Param("maxIter", "maximum Newton iterations", int)
+    tol = Param("tol", "convergence tolerance on the Newton step norm", float)
+
+    def __init__(self, uid: str | None = None):
+        super().__init__(uid)
+        self._setDefault(maxIter=25, tol=1e-6)
+
+    def setMaxIter(self, value: int):
+        return self._set(maxIter=value)
+
+    def setTol(self, value: float):
+        return self._set(tol=value)
+
+    def getMaxIter(self) -> int:
+        return self.getOrDefault("maxIter")
+
+    def getTol(self) -> float:
+        return self.getOrDefault("tol")
+
+    def fit(
+        self,
+        dataset: Any,
+        num_partitions: int | None = None,
+        *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 5,
+    ) -> "LogisticRegressionModel":
+        if checkpoint_every < 1:
+            raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        parts = self._labeled(dataset, num_partitions)
+        fit_intercept = self.getFitIntercept()
+
+        padded = []
+        for x, y in parts:
+            labels = np.unique(y)
+            if not np.all(np.isin(labels, (0.0, 1.0))):
+                raise ValueError(
+                    f"binary logistic regression requires 0/1 labels, got {labels}"
+                )
+            xp, yp, w = columnar.pad_labeled(x, y)
+            if fit_intercept:
+                xp = np.concatenate([xp, np.ones((xp.shape[0], 1), xp.dtype)], axis=1)
+            padded.append((jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w)))
+
+        d = padded[0][0].shape[1]
+        w_full = np.zeros(d)
+        start_iter = 0
+        ckpt = None
+        if checkpoint_dir is not None:
+            from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+            ckpt = TrainingCheckpointer(checkpoint_dir)
+            resumed = ckpt.latest()
+            if resumed is not None:
+                step, arrays, _ = resumed
+                if arrays["w"].shape[0] != d:
+                    raise ValueError(
+                        f"checkpoint at {checkpoint_dir} holds {arrays['w'].shape[0]} "
+                        f"parameters but this fit has {d}; is checkpoint_dir stale?"
+                    )
+                w_full, start_iter = arrays["w"], step + 1
+
+        with trace_range("logreg newton"):
+            for it in range(start_iter, self.getMaxIter()):
+                wj = jnp.asarray(w_full)
+
+                def task(part, wj=wj):
+                    x, y, w = part
+                    return _newton_stats(x, y, wj, w)
+
+                partials = run_partition_tasks(task, padded)
+                stats = tree_reduce(partials, LIN.combine_newton_stats)
+                new_w, step_norm = _newton_update(
+                    wj,
+                    stats,
+                    reg_param=self.getRegParam(),
+                    fit_intercept=fit_intercept,
+                )
+                w_full = np.asarray(new_w)
+                if ckpt is not None and (it + 1) % checkpoint_every == 0:
+                    ckpt.save(it, {"w": w_full}, {"loss": float(stats.loss)})
+                if float(step_norm) <= self.getTol():
+                    break
+
+        if fit_intercept:
+            coef, intercept = w_full[:-1], float(w_full[-1])
+        else:
+            coef, intercept = w_full, 0.0
+        model = LogisticRegressionModel(
+            uid=self.uid, coefficients=coef, intercept=intercept
+        )
+        return self._copyValues(model)
+
+
+class LogisticRegressionModel(_GLMModel):
+    def predict_proba_matrix(self, mat: np.ndarray) -> np.ndarray:
+        padded, true_rows = columnar.pad_rows(mat)
+        xd = jnp.asarray(padded)
+        out = _predict_proba(
+            xd,
+            jnp.asarray(self.coefficients, dtype=xd.dtype),
+            jnp.asarray(self.intercept, dtype=xd.dtype),
+        )
+        return np.asarray(out)[:true_rows]
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        return (self.predict_proba_matrix(mat) >= 0.5).astype(np.float64)
+
+    def predict(self, row) -> float:
+        z = float(np.dot(self.coefficients, np.asarray(row)) + self.intercept)
+        return 1.0 if z >= 0.0 else 0.0

@@ -139,3 +139,33 @@ class HasOutputCol(Params):
 
     def getOutputCol(self) -> str:
         return self.getOrDefault("outputCol")
+
+
+class HasFeaturesCol(Params):
+    featuresCol = Param("featuresCol", "name of the features ArrayType column", str)
+
+    def setFeaturesCol(self, value: str):
+        return self._set(featuresCol=value)
+
+    def getFeaturesCol(self) -> str:
+        return self.getOrDefault("featuresCol")
+
+
+class HasLabelCol(Params):
+    labelCol = Param("labelCol", "name of the scalar label column", str)
+
+    def setLabelCol(self, value: str):
+        return self._set(labelCol=value)
+
+    def getLabelCol(self) -> str:
+        return self.getOrDefault("labelCol")
+
+
+class HasPredictionCol(Params):
+    predictionCol = Param("predictionCol", "name of the prediction output column", str)
+
+    def setPredictionCol(self, value: str):
+        return self._set(predictionCol=value)
+
+    def getPredictionCol(self) -> str:
+        return self.getOrDefault("predictionCol")

@@ -198,7 +198,8 @@ class PCAModel(PCAParams, Model):
     # -- transform ----------------------------------------------------------
     def _project_matrix(self, mat: np.ndarray) -> np.ndarray:
         padded, true_rows = columnar.pad_rows(mat)
-        out = _project(jnp.asarray(padded), jnp.asarray(self.pc, dtype=padded.dtype))
+        xd = jnp.asarray(padded)  # device dtype (f32 unless x64 is enabled)
+        out = _project(xd, jnp.asarray(self.pc, dtype=xd.dtype))
         return np.asarray(out)[:true_rows]
 
     def transform(self, dataset: Any) -> Any:

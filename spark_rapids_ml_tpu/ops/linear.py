@@ -1,0 +1,211 @@
+"""Linear-model device kernels — normal equations and IRLS, MXU-first.
+
+The reference repo ships one estimator (PCA), but its family
+(spark-rapids-ml's wider line-up) pairs it with GLMs. These kernels extend
+the same architectural pattern the PCA path established (SURVEY.md §2
+"parallelism strategies"): per-partition sufficient statistics as a
+commutative monoid, combined by tree-aggregate or a mesh psum, with a tiny
+replicated solve at the end.
+
+- **LinearRegression** (closed form): the monoid is (XᵀX, Xᵀy, Σx, Σy, Σy²,
+  m). Everything the [n, n] solve needs is one MXU pass over the data —
+  structurally identical to PCA's Gram pass, so the hot loop hits the MXU
+  with the same intensity.
+- **LogisticRegression** (IRLS/Newton): each iteration's monoid is
+  (XᵀWX, Xᵀ(y−p), loss) with W = p(1−p) — two matmuls per block. The
+  replicated Newton solve is [n+1, n+1], negligible next to the data pass.
+
+The intercept rides as an augmented all-ones feature column (``augment``),
+so gradients/Hessians need no special-casing; L2 regularization masks the
+intercept coordinate out of the penalty, matching Spark ML/sklearn.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.ops.linalg import DEFAULT_PRECISION
+
+
+def augment(x: jax.Array) -> jax.Array:
+    """Append an all-ones intercept column: [rows, n] → [rows, n+1]."""
+    return jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Linear regression (normal equations)
+# ---------------------------------------------------------------------------
+
+
+class LinearStats(NamedTuple):
+    """Sufficient statistics for (optionally intercepted, L2) least squares."""
+
+    xtx: jax.Array  # [n, n]
+    xty: jax.Array  # [n]
+    x_sum: jax.Array  # [n]
+    y_sum: jax.Array  # []
+    y_sq: jax.Array  # []
+    count: jax.Array  # []
+
+
+def linear_stats(
+    x: jax.Array,
+    y: jax.Array,
+    weights: jax.Array | None = None,
+    *,
+    precision=DEFAULT_PRECISION,
+) -> LinearStats:
+    """One-pass statistics over a row shard; ``weights`` masks padded rows."""
+    if weights is not None:
+        xw = x * weights[:, None]
+        yw = y * weights
+        count = jnp.sum(weights)
+    else:
+        xw, yw = x, y
+        count = jnp.asarray(x.shape[0], x.dtype)
+    return LinearStats(
+        xtx=jnp.matmul(x.T, xw, precision=precision),
+        xty=jnp.matmul(x.T, yw, precision=precision),
+        x_sum=jnp.sum(xw, axis=0),
+        y_sum=jnp.sum(yw),
+        y_sq=jnp.sum(yw * y),
+        count=count,
+    )
+
+
+def combine_linear_stats(a: LinearStats, b: LinearStats) -> LinearStats:
+    return LinearStats(*(av + bv for av, bv in zip(a, b)))
+
+
+def solve_normal(
+    stats: LinearStats, *, reg_param: float = 0.0, fit_intercept: bool = True
+) -> tuple[jax.Array, jax.Array]:
+    """(coefficients [n], intercept []) from reduced statistics.
+
+    With an intercept the normal equations are solved on centered moments
+    (A = XᵀX − m·μμᵀ, b = Xᵀy − m·μȳ), which never penalizes the intercept;
+    λ follows Spark ML's convention of scaling with the row count
+    (regParam multiplies m so results match sklearn Ridge(alpha=λ·m)).
+    """
+    m = jnp.maximum(stats.count, jnp.ones_like(stats.count))
+    n = stats.xtx.shape[0]
+    lam = reg_param * m
+    if fit_intercept:
+        mu = stats.x_sum / m
+        ybar = stats.y_sum / m
+        a = stats.xtx - m * jnp.outer(mu, mu)
+        b = stats.xty - m * mu * ybar
+    else:
+        a = stats.xtx
+        b = stats.xty
+    a = a + lam * jnp.eye(n, dtype=a.dtype)
+    coef = jax.scipy.linalg.solve(a, b, assume_a="pos")
+    # Rank-deficient designs (constant/collinear columns, λ=0) break the
+    # Cholesky path with NaNs; fall back to the min-norm lstsq solution.
+    # The [n, n] solve is negligible next to the data pass, so computing
+    # the fallback unconditionally keeps this jittable (no host branch).
+    coef_lstsq = jnp.linalg.lstsq(a, b)[0]
+    coef = jnp.where(jnp.all(jnp.isfinite(coef)), coef, coef_lstsq)
+    intercept = (
+        stats.y_sum / m - jnp.dot(stats.x_sum / m, coef)
+        if fit_intercept
+        else jnp.zeros((), coef.dtype)
+    )
+    return coef, intercept
+
+
+def predict_linear(
+    x: jax.Array, coef: jax.Array, intercept: jax.Array, *, precision=DEFAULT_PRECISION
+) -> jax.Array:
+    return jnp.matmul(x, coef, precision=precision) + intercept
+
+
+# ---------------------------------------------------------------------------
+# Logistic regression (IRLS / Newton)
+# ---------------------------------------------------------------------------
+
+
+class NewtonStats(NamedTuple):
+    """One Newton iteration's sufficient statistics over a row shard."""
+
+    hess: jax.Array  # [d, d] — XᵀWX, W = p(1−p)
+    grad: jax.Array  # [d]   — Xᵀ(y − p)
+    loss: jax.Array  # []    — Σ log-loss
+    count: jax.Array  # []
+
+
+def combine_newton_stats(a: NewtonStats, b: NewtonStats) -> NewtonStats:
+    return NewtonStats(*(av + bv for av, bv in zip(a, b)))
+
+
+def logistic_newton_stats(
+    x_aug: jax.Array,
+    y: jax.Array,
+    w_full: jax.Array,
+    weights: jax.Array | None = None,
+    *,
+    precision=DEFAULT_PRECISION,
+) -> NewtonStats:
+    """Local gradient/Hessian/log-loss at ``w_full`` over an augmented shard.
+
+    ``x_aug`` is [rows, d] with the intercept column appended (d = n+1 when
+    fitting an intercept); ``w_full`` is the full [d] parameter vector.
+    """
+    z = jnp.matmul(x_aug, w_full, precision=precision)
+    p = jax.nn.sigmoid(z)
+    mask = (
+        weights
+        if weights is not None
+        else jnp.ones(x_aug.shape[0], x_aug.dtype)
+    )
+    resid = (y - p) * mask
+    w = p * (1.0 - p) * mask
+    # log-loss via logaddexp for stability: log(1+e^z) − y·z
+    loss = jnp.sum((jnp.logaddexp(0.0, z) - y * z) * mask)
+    hess = jnp.matmul(x_aug.T * w[None, :], x_aug, precision=precision)
+    grad = jnp.matmul(x_aug.T, resid, precision=precision)
+    return NewtonStats(
+        hess=hess,
+        grad=grad,
+        loss=loss,
+        count=jnp.sum(mask),
+    )
+
+
+def newton_update(
+    w_full: jax.Array,
+    stats: NewtonStats,
+    *,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """One damped-free Newton step: (new w, step-norm).
+
+    L2 penalizes every coordinate except the intercept (the last one when
+    ``fit_intercept``); λ scales with the row count like ``solve_normal``.
+    """
+    d = w_full.shape[0]
+    m = jnp.maximum(stats.count, jnp.ones_like(stats.count))
+    pen = jnp.ones((d,), w_full.dtype)
+    if fit_intercept:
+        pen = pen.at[-1].set(0.0)
+    lam = reg_param * m * pen
+    hess = stats.hess + jnp.diag(lam)
+    grad = stats.grad - lam * w_full
+    # tiny ridge keeps the solve well-posed when classes separate perfectly
+    eps = 1e-8 * jnp.trace(hess) / d
+    delta = jax.scipy.linalg.solve(
+        hess + eps * jnp.eye(d, dtype=hess.dtype), grad, assume_a="pos"
+    )
+    return w_full + delta, jnp.linalg.norm(delta)
+
+
+def predict_logistic_proba(
+    x: jax.Array, coef: jax.Array, intercept: jax.Array, *, precision=DEFAULT_PRECISION
+) -> jax.Array:
+    return jax.nn.sigmoid(
+        jnp.matmul(x, coef, precision=precision) + intercept
+    )

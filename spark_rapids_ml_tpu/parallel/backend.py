@@ -69,7 +69,9 @@ def process_info() -> dict:
 # ---------------------------------------------------------------------------
 
 
-def mapreduce_data_axis(kernel, mesh: Mesh, *, replicated_args: int = 0):
+def mapreduce_data_axis(
+    kernel, mesh: Mesh, *, replicated_args: int = 0, in_specs=None
+):
     """shard_map a partition-stats kernel over the ``data`` axis and
     psum-combine its monoid output (replicated result).
 
@@ -77,11 +79,14 @@ def mapreduce_data_axis(kernel, mesh: Mesh, *, replicated_args: int = 0):
     ``replicated_args`` fully-replicated operands and returns any pytree of
     summable statistics — the GramStats/MomentStats/KMeansStats pattern. This
     is the one place the collective scaffolding lives; every sharded
-    estimator reducer is an instantiation.
+    estimator reducer is an instantiation. Pass explicit ``in_specs`` when
+    the operands aren't the standard ([rows, n] sharded + replicated) shape
+    (e.g. a label vector sharded as ``P(DATA_AXIS)``).
     """
     from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 
-    in_specs = (P(DATA_AXIS, None),) + (P(),) * replicated_args
+    if in_specs is None:
+        in_specs = (P(DATA_AXIS, None),) + (P(),) * replicated_args
 
     @partial(shard_map, mesh=mesh, in_specs=in_specs, out_specs=P(), check_rep=False)
     def _run(*args):

@@ -1,0 +1,107 @@
+"""Sharded GLM training — normal equations and Newton steps as SPMD programs.
+
+Same architecture as ``parallel.gram``/``parallel.kmeans``: the statistics
+monoid is computed per device shard and psum-combined over the ``data``
+axis; the small solve happens replicated. For LinearRegression the whole fit
+is ONE XLA program; for LogisticRegression each Newton iteration is one.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_ml_tpu.ops import linear as LIN
+from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+
+def sharded_linear_stats(
+    x: jax.Array, y: jax.Array, mesh: Mesh
+) -> LIN.LinearStats:
+    """LinearStats over data-sharded (X [rows, n], y [rows]); replicated out."""
+    return mapreduce_data_axis(
+        LIN.linear_stats,
+        mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+    )(x, y)
+
+
+def distributed_linreg_fit(
+    x: jax.Array,
+    y: jax.Array,
+    mesh: Mesh,
+    *,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """Full distributed least-squares fit: (coefficients, intercept)."""
+    stats = sharded_linear_stats(x, y, mesh)
+    return LIN.solve_normal(stats, reg_param=reg_param, fit_intercept=fit_intercept)
+
+
+def make_distributed_linreg_fit(
+    mesh: Mesh, *, reg_param: float = 0.0, fit_intercept: bool = True
+):
+    """jit with shardings bound: X/y data-sharded, outputs replicated."""
+    return jax.jit(
+        partial(
+            distributed_linreg_fit,
+            mesh=mesh,
+            reg_param=reg_param,
+            fit_intercept=fit_intercept,
+        ),
+        in_shardings=(
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+
+def sharded_newton_stats(
+    x_aug: jax.Array, y: jax.Array, w_full: jax.Array, mesh: Mesh
+) -> LIN.NewtonStats:
+    """One logistic Newton statistics pass: X/y data-sharded, w replicated."""
+    return mapreduce_data_axis(
+        LIN.logistic_newton_stats,
+        mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+    )(x_aug, y, w_full)
+
+
+def distributed_newton_step(
+    x_aug: jax.Array,
+    y: jax.Array,
+    w_full: jax.Array,
+    mesh: Mesh,
+    *,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """One full distributed IRLS iteration: (new w, step-norm)."""
+    stats = sharded_newton_stats(x_aug, y, w_full, mesh)
+    return LIN.newton_update(
+        w_full, stats, reg_param=reg_param, fit_intercept=fit_intercept
+    )
+
+
+def make_distributed_newton_step(
+    mesh: Mesh, *, reg_param: float = 0.0, fit_intercept: bool = True
+):
+    return jax.jit(
+        partial(
+            distributed_newton_step,
+            mesh=mesh,
+            reg_param=reg_param,
+            fit_intercept=fit_intercept,
+        ),
+        in_shardings=(
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P()),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
+    )

@@ -72,6 +72,13 @@ class TrainingCheckpointer:
         if final.exists():
             shutil.rmtree(final)
         os.replace(tmp, final)
+        # fsync the parent directory so the rename itself is durable across
+        # power loss, not just the file contents
+        dfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self._retain()
 
     def _retain(self) -> None:

@@ -138,6 +138,56 @@ def apply_column_transform(dataset: Any, input_col: str | None, output_col: str,
     return np.asarray(fn(extract_matrix(dataset, input_col)))
 
 
+def extract_vector(data: Any, col: str) -> np.ndarray:
+    """Extract a scalar column (labels) as a [rows] float vector."""
+    if pa is not None and isinstance(data, (pa.Table, pa.RecordBatch)):
+        return np.asarray(data.column(col).to_numpy(zero_copy_only=False), dtype=np.float64)
+    if hasattr(data, "columns") and hasattr(data, "__getitem__"):
+        series = data[col]
+        if hasattr(series, "to_numpy"):
+            return np.asarray(series.to_numpy(), dtype=np.float64)
+    raise TypeError(f"cannot extract label column {col!r} from {type(data).__name__}")
+
+
+def labeled_partitions(
+    data: Any,
+    features_col: str | None,
+    label_col: str | None,
+    num_partitions: int | None = None,
+) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Split supervised data into [(X [rows, n], y [rows]), ...] partitions.
+
+    Supported: an (X, y) tuple of arrays, or a table-like container (pandas /
+    Arrow) holding an ArrayType features column and a scalar label column —
+    the Spark ML ``featuresCol``/``labelCol`` input contract.
+    """
+    if isinstance(data, tuple) and len(data) == 2:
+        x, y = np.asarray(data[0]), np.asarray(data[1], dtype=np.float64)
+    else:
+        x = extract_matrix(data, features_col)
+        y = extract_vector(data, label_col)
+    if len(x) != len(y):
+        raise ValueError(f"features have {len(x)} rows but labels have {len(y)}")
+    if num_partitions and num_partitions > 1:
+        return list(
+            zip(np.array_split(x, num_partitions), np.array_split(y, num_partitions))
+        )
+    return [(x, y)]
+
+
+def pad_labeled(
+    x: np.ndarray, y: np.ndarray, *, min_bucket: int | None = None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Bucket-pad an (X, y) pair; returns (padded_x, padded_y, weights) with
+    zero weights marking padded rows."""
+    padded, true_rows = pad_rows(x, min_bucket=min_bucket)
+    yp = np.zeros(padded.shape[0], dtype=padded.dtype)
+    yp[:true_rows] = y
+    w = np.zeros(padded.shape[0], dtype=padded.dtype)
+    w[:true_rows] = 1.0
+    return padded, yp, w
+
+
 # ---------------------------------------------------------------------------
 # Shape bucketing
 # ---------------------------------------------------------------------------

@@ -1,0 +1,218 @@
+"""GLM family tests — differentials vs sklearn closed forms and estimator
+behavior (multi-partition parity, persistence, checkpoint/resume)."""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import LinearRegression as SkLinear
+from sklearn.linear_model import LogisticRegression as SkLogistic
+from sklearn.linear_model import Ridge as SkRidge
+
+from spark_rapids_ml_tpu.models.linear import (
+    LinearRegression,
+    LinearRegressionModel,
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+
+
+@pytest.fixture
+def reg_data(rng):
+    x = rng.normal(size=(400, 6))
+    true_w = np.array([1.5, -2.0, 0.0, 3.0, 0.5, -1.0])
+    y = x @ true_w + 0.7 + 0.01 * rng.normal(size=400)
+    return x, y
+
+
+@pytest.fixture
+def cls_data(rng):
+    x = rng.normal(size=(600, 4))
+    true_w = np.array([2.0, -1.0, 0.5, 0.0])
+    p = 1 / (1 + np.exp(-(x @ true_w - 0.3)))
+    y = (rng.uniform(size=600) < p).astype(np.float64)
+    return x, y
+
+
+class TestLinearRegression:
+    def test_matches_sklearn_ols(self, reg_data):
+        x, y = reg_data
+        model = LinearRegression().fit((x, y))
+        sk = SkLinear().fit(x, y)
+        np.testing.assert_allclose(model.coefficients, sk.coef_, atol=1e-8)
+        np.testing.assert_allclose(model.intercept, sk.intercept_, atol=1e-8)
+
+    def test_matches_sklearn_ridge(self, reg_data):
+        x, y = reg_data
+        lam = 0.1
+        model = LinearRegression().setRegParam(lam).fit((x, y))
+        sk = SkRidge(alpha=lam * len(x)).fit(x, y)
+        np.testing.assert_allclose(model.coefficients, sk.coef_, atol=1e-7)
+        np.testing.assert_allclose(model.intercept, sk.intercept_, atol=1e-7)
+
+    def test_no_intercept(self, reg_data):
+        x, y = reg_data
+        model = LinearRegression().setFitIntercept(False).fit((x, y))
+        sk = SkLinear(fit_intercept=False).fit(x, y)
+        np.testing.assert_allclose(model.coefficients, sk.coef_, atol=1e-8)
+        assert model.intercept == 0.0
+
+    def test_multi_partition_equals_single(self, reg_data):
+        x, y = reg_data
+        m1 = LinearRegression().fit((x, y), num_partitions=1)
+        m3 = LinearRegression().fit((x, y), num_partitions=3)
+        np.testing.assert_allclose(m3.coefficients, m1.coefficients, atol=1e-9)
+        np.testing.assert_allclose(m3.intercept, m1.intercept, atol=1e-9)
+
+    def test_transform_pandas(self, reg_data):
+        import pandas as pd
+
+        x, y = reg_data
+        df = pd.DataFrame({"features": list(x), "label": y})
+        model = LinearRegression().fit(df)
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        np.testing.assert_allclose(
+            out["prediction"].to_numpy(), x @ model.coefficients + model.intercept,
+            atol=1e-8,
+        )
+
+    def test_predict_single_row(self, reg_data):
+        x, y = reg_data
+        model = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(
+            model.predict(x[0]), model._predict_matrix(x[:1])[0], atol=1e-8
+        )
+
+    def test_persistence_roundtrip(self, reg_data, tmp_path):
+        x, y = reg_data
+        model = LinearRegression().setRegParam(0.05).fit((x, y))
+        model.save(tmp_path / "lr")
+        loaded = LinearRegressionModel.load(tmp_path / "lr")
+        np.testing.assert_array_equal(loaded.coefficients, model.coefficients)
+        assert loaded.intercept == model.intercept
+        assert loaded.getRegParam() == 0.05
+
+    def test_singular_design_finite(self, rng):
+        # constant feature column + intercept => singular normal equations;
+        # the lstsq fallback must produce finite coefficients, not NaN
+        x = np.ones((50, 3))
+        y = rng.normal(size=50)
+        model = LinearRegression().fit((x, y))
+        assert np.all(np.isfinite(model.coefficients))
+        np.testing.assert_allclose(
+            model._predict_matrix(x), np.full(50, y.mean()), atol=1e-6
+        )
+
+    def test_mismatched_rows_rejected(self, reg_data):
+        x, y = reg_data
+        with pytest.raises(ValueError, match="rows"):
+            LinearRegression().fit((x, y[:-5]))
+
+
+class TestLogisticRegression:
+    def test_matches_sklearn(self, cls_data):
+        x, y = cls_data
+        lam = 0.01
+        model = LogisticRegression().setRegParam(lam).fit((x, y))
+        # sklearn minimizes sum-loss + 1/(2C)·|w|²; our λ scales with rows
+        sk = SkLogistic(C=1.0 / (lam * len(x)), tol=1e-10).fit(x, y)
+        np.testing.assert_allclose(model.coefficients, sk.coef_[0], atol=1e-4)
+        np.testing.assert_allclose(model.intercept, sk.intercept_[0], atol=1e-4)
+
+    def test_separable_data_regularized(self, rng):
+        # perfectly separable: unregularized weights diverge; λ keeps it sane
+        x = np.concatenate([rng.normal(-3, 0.5, (50, 2)), rng.normal(3, 0.5, (50, 2))])
+        y = np.concatenate([np.zeros(50), np.ones(50)])
+        model = LogisticRegression().setRegParam(0.1).fit((x, y))
+        preds = model._predict_matrix(x)
+        assert (preds == y).mean() == 1.0
+
+    def test_multi_partition_equals_single(self, cls_data):
+        x, y = cls_data
+        m1 = LogisticRegression().setRegParam(0.01).fit((x, y), num_partitions=1)
+        m3 = LogisticRegression().setRegParam(0.01).fit((x, y), num_partitions=3)
+        np.testing.assert_allclose(m3.coefficients, m1.coefficients, atol=1e-8)
+
+    def test_bad_labels_rejected(self, cls_data):
+        x, _ = cls_data
+        y = np.full(len(x), 2.0)
+        with pytest.raises(ValueError, match="0/1 labels"):
+            LogisticRegression().fit((x, y))
+
+    def test_proba_monotone_in_margin(self, cls_data):
+        x, y = cls_data
+        model = LogisticRegression().setRegParam(0.01).fit((x, y))
+        proba = model.predict_proba_matrix(x)
+        margin = x @ model.coefficients + model.intercept
+        assert np.all((proba >= 0.5) == (margin >= 0))
+
+    def test_checkpoint_resume_matches(self, cls_data, tmp_path):
+        x, y = cls_data
+        mk = lambda: LogisticRegression().setRegParam(0.01).setMaxIter(20)
+        full = mk().fit((x, y))
+        mk().setMaxIter(3).fit(
+            (x, y), checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1
+        )
+        resumed = mk().fit((x, y), checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1)
+        np.testing.assert_allclose(resumed.coefficients, full.coefficients, atol=1e-6)
+
+    def test_persistence_roundtrip(self, cls_data, tmp_path):
+        x, y = cls_data
+        model = LogisticRegression().setRegParam(0.01).fit((x, y))
+        model.save(tmp_path / "logit")
+        loaded = LogisticRegressionModel.load(tmp_path / "logit")
+        np.testing.assert_array_equal(loaded.coefficients, model.coefficients)
+        np.testing.assert_array_equal(loaded._predict_matrix(x), model._predict_matrix(x))
+
+
+class TestShardedGLM:
+    @pytest.fixture
+    def mesh8(self):
+        from spark_rapids_ml_tpu.parallel import mesh as M
+
+        return M.create_mesh(data=8)
+
+    def test_sharded_linreg_matches_host(self, reg_data, mesh8):
+        import jax
+
+        from spark_rapids_ml_tpu.parallel import linear as PL
+        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x, y = reg_data
+        fit = PL.make_distributed_linreg_fit(mesh8, reg_param=0.05)
+        xs = jax.device_put(x, NamedSharding(mesh8, P(DATA_AXIS, None)))
+        ys = jax.device_put(y, NamedSharding(mesh8, P(DATA_AXIS)))
+        coef, intercept = fit(xs, ys)
+        host = LinearRegression().setRegParam(0.05).fit((x, y))
+        np.testing.assert_allclose(np.asarray(coef), host.coefficients, atol=1e-7)
+        np.testing.assert_allclose(float(intercept), host.intercept, atol=1e-7)
+
+    def test_sharded_newton_matches_host_stats(self, cls_data, mesh8):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import linear as LIN
+        from spark_rapids_ml_tpu.parallel import linear as PL
+        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x, y = cls_data
+        x_aug = np.concatenate([x, np.ones((len(x), 1))], axis=1)
+        w0 = jnp.zeros(x_aug.shape[1])
+        step = PL.make_distributed_newton_step(mesh8, reg_param=0.01)
+        xs = jax.device_put(x_aug, NamedSharding(mesh8, P(DATA_AXIS, None)))
+        ys = jax.device_put(y, NamedSharding(mesh8, P(DATA_AXIS)))
+        w1, norm1 = step(xs, ys, w0)
+        stats = LIN.logistic_newton_stats(jnp.asarray(x_aug), jnp.asarray(y), w0)
+        w1_host, norm1_host = LIN.newton_update(w0, stats, reg_param=0.01)
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(w1_host), atol=1e-8)
+        np.testing.assert_allclose(float(norm1), float(norm1_host), atol=1e-8)
+
+
+def test_dropin_namespaces():
+    from spark_rapids_ml_tpu.classification import LogisticRegression as L1
+    from spark_rapids_ml_tpu.regression import LinearRegression as R1
+    import spark_rapids_ml_tpu as pkg
+
+    assert pkg.LinearRegression is R1
+    assert pkg.LogisticRegression is L1
