@@ -67,6 +67,14 @@ class PCAParams(HasInputCol, HasOutputCol):
         "(1-pass bf16)",
         str,
     )
+    solver = Param(
+        "solver",
+        "decomposition solver: 'full' (exact refined eigh, reference "
+        "parity), 'randomized' (HMT subspace iteration, O(n²·(k+p)) — "
+        "explainedVariance uses a trace-based tail estimate), or 'auto' "
+        "(randomized when n ≥ 1024 and k ≪ n)",
+        str,
+    )
 
     def __init__(self, uid: str | None = None):
         super().__init__(uid)
@@ -76,6 +84,7 @@ class PCAParams(HasInputCol, HasOutputCol):
             meanCentering=False,
             outputCol="pca_features",
             precision=get_config().default_precision,
+            solver="full",
         )
 
     def getK(self) -> int:
@@ -89,19 +98,15 @@ class PCAParams(HasInputCol, HasOutputCol):
 # bucketing keeps the set of shapes small.
 _gram_stats = jax.jit(L.gram_stats, static_argnames=("precision",))
 
-_PRECISIONS = {
-    "highest": jax.lax.Precision.HIGHEST,
-    "high": jax.lax.Precision.HIGH,
-    "default": jax.lax.Precision.DEFAULT,
-}
+_PRECISIONS = L.PRECISIONS
 
 
-def _fit_from_stats(stats: L.GramStats, k: int, mean_centering: bool):
+def _fit_from_stats(stats: L.GramStats, k: int, mean_centering: bool, solver: str):
     cov = L.covariance_from_stats(stats, mean_centering=mean_centering)
-    return L.pca_fit_from_cov(cov, k)
+    return L.pca_fit_from_cov(cov, k, solver=solver)
 
 
-_fit_from_stats_jit = jax.jit(_fit_from_stats, static_argnums=(1, 2))
+_fit_from_stats_jit = jax.jit(_fit_from_stats, static_argnums=(1, 2, 3))
 _project = jax.jit(L.project)
 
 
@@ -127,6 +132,11 @@ class PCA(PCAParams, Estimator):
         if value not in _PRECISIONS:
             raise ValueError(f"precision must be one of {sorted(_PRECISIONS)}")
         return self._set(precision=value)
+
+    def setSolver(self, value: str) -> "PCA":
+        if value not in ("full", "randomized", "auto"):
+            raise ValueError("solver must be 'full', 'randomized', or 'auto'")
+        return self._set(solver=value)
 
     def fit(self, dataset: Any, num_partitions: int | None = None) -> "PCAModel":
         """Two-phase fit, mirroring the reference call stack (SURVEY.md §3.1):
@@ -165,7 +175,9 @@ class PCA(PCAParams, Estimator):
             raise ValueError(f"k={k} must be <= number of features {n_cols}")
 
         with trace_range("eigh"):  # "cuSolver SVD" range analog, RapidsRowMatrix.scala:70
-            pc, explained = _fit_from_stats_jit(stats, k, mean_centering)
+            pc, explained = _fit_from_stats_jit(
+                stats, k, mean_centering, self.getOrDefault("solver")
+            )
 
         model = PCAModel(
             uid=self.uid,

@@ -44,6 +44,14 @@ from jax import lax
 # multi-pass bf16 (6-pass) which recovers ~f32 accuracy on the MXU.
 DEFAULT_PRECISION = lax.Precision.HIGHEST
 
+# The user-facing precision tiers (the estimators' ``precision`` param and
+# the TPU_ML_DEFAULT_PRECISION config knob map through this).
+PRECISIONS = {
+    "highest": lax.Precision.HIGHEST,
+    "high": lax.Precision.HIGH,
+    "default": lax.Precision.DEFAULT,
+}
+
 
 class GramStats(NamedTuple):
     """Partition-local sufficient statistics for (optionally centered) PCA.
@@ -185,6 +193,78 @@ def eigh_descending(
     return sign_flip(evecs), singular_values
 
 
+def randomized_eigh_descending(
+    cov: jax.Array,
+    k: int,
+    *,
+    oversample: int = 10,
+    power_iters: int = 2,
+    seed: int = 0,
+    precision=DEFAULT_PRECISION,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Randomized top-k eigendecomposition of a PSD matrix (descending).
+
+    Halko–Martinsson–Tropp randomized subspace iteration, shaped for the
+    MXU: every step is a large dense matmul ([n, n]·[n, l] with
+    l = k + oversample) plus a thin QR — O(n²·l) instead of the full eigh's
+    O(n³). The win is real once n is a few thousand and k ≪ n (the regime
+    the reference cannot reach at all: its n×n eig is single-GPU cuSolver,
+    rapidsml_jni.cu:251).
+
+    Returns ``(components [n, k], singular_values [l], tail_count)`` where
+    singular values are √max(λ, 0) for ALL l = k + oversample Ritz values
+    (the extra ones cost nothing and make the explained-variance tail
+    estimate far tighter), components are the top-k Ritz vectors sign-flipped
+    with the same orientation rule as the exact path, and ``tail_count`` =
+    n − l is the count of eigenvalues not represented in the returned
+    spectrum.
+    """
+    n = cov.shape[0]
+    l = min(n, k + oversample)
+    key = jax.random.PRNGKey(seed)
+    omega = jax.random.normal(key, (n, l), dtype=cov.dtype)
+    q, _ = jnp.linalg.qr(jnp.matmul(cov, omega, precision=precision))
+    for _ in range(power_iters):
+        q, _ = jnp.linalg.qr(jnp.matmul(cov, q, precision=precision))
+    # Rayleigh–Ritz on the captured subspace: B = QᵀAQ, eigh of the small
+    # l×l system, lift back with U = Q·V.
+    aq = jnp.matmul(cov, q, precision=precision)
+    b = jnp.matmul(q.T, aq, precision=precision)
+    b = 0.5 * (b + b.T)
+    evals, v = jnp.linalg.eigh(b)  # ascending
+    evals = evals[::-1]
+    v = v[:, ::-1][:, :k]
+    u = sign_flip(jnp.matmul(q, v, precision=precision))
+    singular_values = jnp.sqrt(jnp.clip(evals, 0.0, None))
+    return u, singular_values, jnp.asarray(n - l, dtype=cov.dtype)
+
+
+def explained_variance_from_partial(
+    singular_values: jax.Array, trace: jax.Array, tail_count: jax.Array
+) -> jax.Array:
+    """Reference-shaped explainedVariance from a PARTIAL spectrum.
+
+    The reference normalizes sᵢ over the FULL spectrum
+    (RapidsRowMatrix.scala:92-93); a randomized solver only has the top
+    l = k + oversample singular values. The unseen tail's Σ√λ is estimated
+    from the leftover trace: Σλ_tail = trace − Σλ_top, and by concavity
+    Σ√λ_tail ≤ √(tail_count·Σλ_tail); we use that bound as the estimate
+    (exact when the tail is flat, conservative — ratios shrink — when it
+    decays). Since everything below λ_l is ≤ the smallest computed Ritz
+    value, the estimate is applied only to that sub-λ_l remainder — the
+    oversampled Ritz values carry the rest — so the error is confined to
+    the flattest part of the spectrum. Returns ratios for all input values;
+    callers truncate to k.
+    """
+    top_sum = jnp.sum(singular_values)
+    top_eval_sum = jnp.sum(singular_values**2)
+    tail_eval_sum = jnp.clip(trace - top_eval_sum, 0.0, None)
+    tail_sum = jnp.sqrt(tail_eval_sum * jnp.clip(tail_count, 0.0, None))
+    total = top_sum + tail_sum
+    safe_total = jnp.where(total > 0, total, jnp.ones_like(total))
+    return singular_values / safe_total
+
+
 def explained_variance(singular_values: jax.Array, k: int) -> jax.Array:
     """sᵢ/Σs over the FULL spectrum, truncated to the first k.
 
@@ -196,8 +276,37 @@ def explained_variance(singular_values: jax.Array, k: int) -> jax.Array:
     return (singular_values / safe_total)[:k]
 
 
-def pca_fit_from_cov(cov: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
-    """Decomposition stage: covariance → (pc [n, k], explained_variance [k])."""
+def pca_fit_from_cov(
+    cov: jax.Array,
+    k: int,
+    *,
+    solver: str = "full",
+    oversample: int = 10,
+    power_iters: int = 2,
+    seed: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """Decomposition stage: covariance → (pc [n, k], explained_variance [k]).
+
+    ``solver``:
+    - ``"full"`` — exact refined eigh (reference-parity path).
+    - ``"randomized"`` — HMT subspace iteration, O(n²·(k+p)); explained
+      variance uses the trace-based tail estimate.
+    - ``"auto"`` — randomized when it is clearly profitable
+      (n ≥ 1024 and k + oversample ≤ n/8), else full.
+    """
+    n = cov.shape[0]
+    if solver == "auto":
+        solver = (
+            "randomized" if n >= 1024 and (k + oversample) * 8 <= n else "full"
+        )
+    if solver == "randomized":
+        u, s, tail_count = randomized_eigh_descending(
+            cov, k, oversample=oversample, power_iters=power_iters, seed=seed
+        )
+        ev = explained_variance_from_partial(s, jnp.trace(cov), tail_count)
+        return u, ev[:k]
+    if solver != "full":
+        raise ValueError(f"unknown solver {solver!r}")
     components, s = eigh_descending(cov)
     return components[:, :k], explained_variance(s, k)
 

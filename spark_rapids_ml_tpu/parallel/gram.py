@@ -115,13 +115,15 @@ def distributed_pca_fit(
     *,
     mean_centering: bool = False,
     feature_sharded: bool = False,
+    solver: str = "full",
     precision=L.DEFAULT_PRECISION,
 ) -> tuple[jax.Array, jax.Array]:
     """The full distributed training step as one jittable SPMD program.
 
     Gram accumulation is sharded per the flags; the n×n decomposition
-    (refined eigh) runs on the replicated covariance — XLA gathers the
-    block-rows over ICI when the feature-sharded path produced them.
+    (refined eigh, or randomized subspace iteration when ``solver`` says so)
+    runs on the replicated covariance — XLA gathers the block-rows over ICI
+    when the feature-sharded path produced them.
     """
     if feature_sharded:
         g, col_sum, count = ring_gram(x, mesh, precision=precision)
@@ -129,7 +131,7 @@ def distributed_pca_fit(
     else:
         stats = sharded_gram_stats(x, mesh, precision=precision)
     cov = L.covariance_from_stats(stats, mean_centering=mean_centering)
-    return L.pca_fit_from_cov(cov, k)
+    return L.pca_fit_from_cov(cov, k, solver=solver)
 
 
 def make_distributed_fit(
@@ -138,6 +140,7 @@ def make_distributed_fit(
     *,
     mean_centering: bool = False,
     feature_sharded: bool = False,
+    solver: str = "full",
 ):
     """jit-compile ``distributed_pca_fit`` with mesh shardings bound.
 
@@ -153,6 +156,7 @@ def make_distributed_fit(
             mesh=mesh,
             mean_centering=mean_centering,
             feature_sharded=feature_sharded,
+            solver=solver,
         ),
         in_shardings=NamedSharding(mesh, in_spec),
         out_shardings=NamedSharding(mesh, P()),

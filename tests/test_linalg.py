@@ -118,6 +118,81 @@ class TestExplainedVariance:
         np.testing.assert_array_equal(ev, [0.0, 0.0])
 
 
+class TestRandomizedSolver:
+    def test_matches_exact_on_decaying_spectrum(self, rng):
+        """Top-k subspace and singular values agree with the exact eigh on a
+        spectrum with decay (the regime randomized SVD targets)."""
+        n, k = 64, 5
+        # Construct a PSD matrix with geometric spectral decay.
+        q, _ = np.linalg.qr(rng.normal(size=(n, n)))
+        evals = 100.0 * (0.5 ** np.arange(n))
+        cov = (q * evals) @ q.T
+        u, s, tail = L.randomized_eigh_descending(
+            jnp.asarray(cov), k, power_iters=3
+        )
+        u, s = np.asarray(u), np.asarray(s)
+        assert s.shape == (k + 10,)  # full oversampled Ritz spectrum
+        np.testing.assert_allclose(s[:k] ** 2, evals[:k], rtol=1e-6)
+        # subspace check, sign-invariant
+        np.testing.assert_allclose(np.abs(u), np.abs(q[:, :k]), atol=1e-5)
+        assert int(tail) == n - k - 10
+
+    def test_sign_flip_orientation(self, rng):
+        n, k = 32, 4
+        x = _random(rng, rows=200, n=n)
+        u, _, _ = L.randomized_eigh_descending(jnp.asarray(x.T @ x), k)
+        u = np.asarray(u)
+        for j in range(k):
+            assert u[np.argmax(np.abs(u[:, j])), j] > 0
+
+    def test_pca_fit_from_cov_solver_dispatch(self, rng):
+        # rank-structured data: randomized solvers need spectral separation
+        # between the kept components (near-degenerate Wishart spectra mix
+        # eigenvectors — inherent to the method, not a bug).
+        base = rng.normal(size=(300, 6))
+        x = base @ rng.normal(size=(6, 24)) + 1e-3 * _random(rng, rows=300, n=24)
+        cov = jnp.asarray(x.T @ x)
+        pc_full, ev_full = L.pca_fit_from_cov(cov, 3, solver="full")
+        pc_rand, ev_rand = L.pca_fit_from_cov(cov, 3, solver="randomized")
+        np.testing.assert_allclose(
+            np.abs(np.asarray(pc_rand)), np.abs(np.asarray(pc_full)), atol=1e-6
+        )
+        # ev uses the tail estimate → looser agreement, same ordering
+        np.testing.assert_allclose(
+            np.asarray(ev_rand), np.asarray(ev_full), rtol=0.1
+        )
+        with pytest.raises(ValueError):
+            L.pca_fit_from_cov(cov, 3, solver="bogus")
+
+    def test_auto_picks_full_for_small_n(self, rng):
+        """auto == full for n < 1024 — bit-identical output."""
+        x = _random(rng, rows=100, n=16)
+        cov = jnp.asarray(x.T @ x)
+        pc_a, ev_a = L.pca_fit_from_cov(cov, 3, solver="auto")
+        pc_f, ev_f = L.pca_fit_from_cov(cov, 3, solver="full")
+        np.testing.assert_array_equal(np.asarray(pc_a), np.asarray(pc_f))
+        np.testing.assert_array_equal(np.asarray(ev_a), np.asarray(ev_f))
+
+    def test_jittable_with_static_solver(self, rng):
+        x = _random(rng, rows=100, n=16)
+        fit = jax.jit(L.pca_fit_from_cov, static_argnums=(1,), static_argnames=("solver",))
+        pc, ev = fit(jnp.asarray(x.T @ x), 3, solver="randomized")
+        assert pc.shape == (16, 3) and ev.shape == (3,)
+
+    def test_tail_estimate_flat_spectrum_exact(self):
+        """The √(m·trace_tail) tail estimate is exact for a flat tail."""
+        n, k = 40, 4
+        evals = np.concatenate([[100.0, 90.0, 80.0, 70.0], np.full(n - k, 2.0)])
+        s_top = jnp.asarray(np.sqrt(evals[:k]))
+        ev = np.asarray(
+            L.explained_variance_from_partial(
+                s_top, jnp.asarray(evals.sum()), jnp.asarray(float(n - k))
+            )
+        )
+        s_all = np.sqrt(evals)
+        np.testing.assert_allclose(ev, (s_all / s_all.sum())[:k], rtol=1e-10)
+
+
 class TestEndToEnd:
     @pytest.mark.parametrize("mean_centering", [False, True])
     def test_projection_matches_sklearn_subspace(self, rng, mean_centering):

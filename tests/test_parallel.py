@@ -80,6 +80,18 @@ class TestDistributedFit:
         pc, _ = fit(jnp.asarray(x))
         assert pc.sharding.is_fully_replicated
 
+    def test_randomized_solver_distributed(self, mesh8, rng):
+        """Sharded Gram + randomized Rayleigh–Ritz as one SPMD program."""
+        base = rng.normal(size=(256, 4))
+        x = base @ rng.normal(size=(4, 32)) + 0.01 * rng.normal(size=(256, 32))
+        fit = G.make_distributed_fit(mesh8, 3, solver="randomized")
+        pc, ev = fit(jnp.asarray(x))
+        pc_ref, _ = L.pca_fit_local(jnp.asarray(x), 3)
+        np.testing.assert_allclose(
+            np.abs(np.asarray(pc)), np.abs(np.asarray(pc_ref)), atol=1e-6
+        )
+        assert pc.sharding.is_fully_replicated and ev.shape == (3,)
+
 
 class TestMeshHelpers:
     def test_factor_mesh(self):

@@ -78,6 +78,25 @@ class TestFit:
         with pytest.raises(ValueError, match="k=21"):
             PCA().setInputCol("f").setK(21).fit(data)
 
+    def test_randomized_solver_matches_full(self, data):
+        """The data has a rank-5 signal + noise, so the top-4 subspace is
+        well-separated — the randomized solver must agree with the exact
+        one there (sign-invariant transform comparison, PCASuite-style)."""
+        k = 4
+        full = PCA().setInputCol("f").setK(k).fit(data)
+        rand = PCA().setInputCol("f").setK(k).setSolver("randomized").fit(data)
+        np.testing.assert_allclose(
+            np.abs(rand.transform(data)), np.abs(full.transform(data)), atol=1e-5
+        )
+        # trace-based tail estimate keeps ratios in the right ballpark
+        np.testing.assert_allclose(
+            rand.explainedVariance, full.explainedVariance, rtol=0.15
+        )
+
+    def test_solver_validation(self):
+        with pytest.raises(ValueError, match="solver"):
+            PCA().setSolver("qr")
+
 
 class TestContainers:
     """The input-format surface: ArrayType-shaped columns in every container."""
