@@ -1,0 +1,21 @@
+"""Spark integration layer.
+
+Two tiers, split so the executor-side math never depends on pyspark:
+
+- :mod:`spark_rapids_ml_tpu.spark.arrow_fns` — pure Arrow-iterator plan
+  functions that run inside Spark Python workers (``mapInArrow`` bodies).
+  Importable and testable everywhere.
+- :mod:`spark_rapids_ml_tpu.spark.estimators` — ``SparkPCA``/``SparkPCAModel``
+  drop-in estimators over ``pyspark.sql.DataFrame``; pyspark is imported
+  lazily on first Spark-DataFrame use.
+
+This package is the TPU build's replacement for the reference's L0 Spark
+substrate hooks — ColumnarRdd ingestion and the RapidsUDF columnar transform
+(SURVEY.md §1 L0, §3.2) — built on Spark's portable Arrow execution surface
+instead of the CUDA-only spark-rapids columnar engine.
+"""
+
+from spark_rapids_ml_tpu.spark import arrow_fns
+from spark_rapids_ml_tpu.spark.estimators import SparkPCA, SparkPCAModel
+
+__all__ = ["arrow_fns", "SparkPCA", "SparkPCAModel"]

@@ -1,0 +1,208 @@
+"""Executor-side Arrow plan functions for the Spark integration — pyspark-free.
+
+The reference's Spark data path is supplied by the spark-rapids plugin:
+``ColumnarRdd(df)`` hands fit() device-resident cudf tables
+(RapidsRowMatrix.scala:118) and a ``RapidsUDF`` runs the columnar transform
+(RapidsPCA.scala:129-155). That engine is CUDA-only; the TPU-native
+equivalent is Spark's Arrow execution surface: ``DataFrame.mapInArrow`` hands
+each partition an iterator of ``pyarrow.RecordBatch`` directly in the Python
+worker, where JAX puts them on the local TPU.
+
+This module holds the functions that run INSIDE those workers. They are
+deliberately free of any pyspark import — they consume/produce plain Arrow
+batches — so the whole executor-side computation is unit-testable in any
+environment (the reference's biggest test gap, SURVEY.md §4) and reusable by
+any Arrow-speaking host (DuckDB, Ray datasets, a bare py4j bridge).
+
+Serialization contract: partition-local ``GramStats`` travel back to the
+driver as a ONE-ROW Arrow batch (xtx flattened to a list column) — the analog
+of the reference shipping each partition's n×n breeze matrix through Spark's
+``reduce`` (RapidsRowMatrix.scala:133-139), except the payload here is a
+columnar batch instead of JVM serialization.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.utils import columnar
+
+
+def stats_schema() -> pa.Schema:
+    """Arrow schema for one serialized GramStats row.
+
+    Variable-length list fields, NOT fixed-size lists: Spark maps ArrayType
+    to Arrow ListType at the mapInArrow boundary, and the batches a worker
+    yields must match the declared Spark schema exactly.
+    """
+    return pa.schema(
+        [
+            pa.field("xtx", pa.list_(pa.float64())),
+            pa.field("col_sum", pa.list_(pa.float64())),
+            pa.field("count", pa.float64()),
+        ]
+    )
+
+
+def _list_column(values: np.ndarray, row_len: int) -> pa.ListArray:
+    """Wrap a flat float64 buffer as a variable-list column of uniform rows."""
+    offsets = pa.array(
+        np.arange(0, values.size + 1, row_len, dtype=np.int32)
+    )
+    return pa.ListArray.from_arrays(offsets, pa.array(values))
+
+
+def stats_to_batch(stats: L.GramStats) -> pa.RecordBatch:
+    """GramStats → one-row Arrow RecordBatch (the shuffle payload)."""
+    xtx = np.asarray(stats.xtx, dtype=np.float64)
+    col_sum = np.asarray(stats.col_sum, dtype=np.float64)
+    n = col_sum.shape[0]
+    return pa.RecordBatch.from_arrays(
+        [
+            _list_column(xtx.reshape(-1), n * n),
+            _list_column(col_sum, n),
+            pa.array([float(np.asarray(stats.count))]),
+        ],
+        schema=stats_schema(),
+    )
+
+
+def stats_from_batches(batches: Iterable[pa.RecordBatch]) -> L.GramStats:
+    """Merge serialized per-partition stats rows back into one GramStats.
+
+    This is the driver-side reduction of the portable path — the analog of
+    the reference's ``cov.reduce((a, b) => a + b)`` over breeze matrices
+    (RapidsRowMatrix.scala:139), running on host ndarrays.
+    """
+    rows: list[tuple[np.ndarray, np.ndarray, float]] = []
+    for batch in batches:
+        t = pa.Table.from_batches([batch]) if isinstance(batch, pa.RecordBatch) else batch
+        for i in range(t.num_rows):
+            rows.append(
+                (
+                    np.asarray(t.column("xtx")[i].values.to_numpy(zero_copy_only=False)),
+                    np.asarray(
+                        t.column("col_sum")[i].values.to_numpy(zero_copy_only=False)
+                    ),
+                    float(t.column("count")[i].as_py()),
+                )
+            )
+    return _merge_stats_rows(rows)
+
+
+def stats_from_rows(rows: Iterable) -> L.GramStats:
+    """Merge stats from row objects (e.g. ``pyspark.sql.Row`` from a
+    ``collect()``) — the PySpark <4.0 path, where ``DataFrame.toArrow``
+    doesn't exist. Each row must expose ``xtx``/``col_sum``/``count``."""
+    return _merge_stats_rows(
+        [
+            (np.asarray(r["xtx"]), np.asarray(r["col_sum"]), float(r["count"]))
+            for r in rows
+        ]
+    )
+
+
+def _merge_stats_rows(
+    rows: Iterable[tuple[np.ndarray, np.ndarray, float]]
+) -> L.GramStats:
+    xtx = col_sum = None
+    count = 0.0
+    for row_xtx, row_sum, row_count in rows:
+        n = row_sum.shape[0]
+        if xtx is None:
+            xtx = np.zeros((n, n))
+            col_sum = np.zeros(n)
+        xtx += row_xtx.reshape(n, n)
+        col_sum += row_sum
+        count += row_count
+    if xtx is None:
+        raise ValueError("no partition statistics received")
+    return L.GramStats(xtx, col_sum, np.float64(count))
+
+
+def make_fit_partition_fn(
+    input_col: str, *, precision: str = "highest"
+) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
+    """Build the ``mapInArrow`` body for the fit pass.
+
+    The returned function accumulates a partition's GramStats on the local
+    accelerator — one bucket-padded MXU Gram per incoming batch, combined on
+    device — and yields a single serialized stats row. Mirrors the
+    per-partition closure at RapidsRowMatrix.scala:122-137.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    prec = L.PRECISIONS[precision]
+    gram_stats = jax.jit(L.gram_stats, static_argnames=("precision",))
+
+    def fit_partition(batches: Iterator[pa.RecordBatch]) -> Iterator[pa.RecordBatch]:
+        acc = None
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            mat = columnar.extract_matrix(batch, input_col)
+            padded, true_rows = columnar.pad_rows(mat)
+            stats = gram_stats(jnp.asarray(padded), precision=prec)
+            stats = L.GramStats(
+                stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype)
+            )
+            acc = stats if acc is None else L.combine_gram_stats(acc, stats)
+        if acc is not None:
+            yield stats_to_batch(acc)
+
+    return fit_partition
+
+
+def make_transform_partition_fn(
+    input_col: str, output_col: str, pc: np.ndarray
+) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
+    """Build the ``mapInArrow`` body for the batched-projection transform.
+
+    Streaming analog of the reference's columnar UDF (``evaluateColumnar``,
+    RapidsPCA.scala:130-155): each Arrow batch is projected on the local
+    accelerator and re-emitted with the output ArrayType column appended.
+    ``pc`` is captured in the closure — Spark broadcasts it with the task,
+    the same replication the reference relies on (RapidsPCA.scala:153).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    project = jax.jit(L.project)
+    pc = np.asarray(pc)
+    pc_dev = None  # uploaded once, first batch fixes the device dtype
+
+    def transform_partition(
+        batches: Iterator[pa.RecordBatch],
+    ) -> Iterator[pa.RecordBatch]:
+        nonlocal pc_dev
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            mat = columnar.extract_matrix(batch, input_col)
+            padded, true_rows = columnar.pad_rows(mat)
+            xd = jnp.asarray(padded)
+            if pc_dev is None or pc_dev.dtype != xd.dtype:
+                pc_dev = jnp.asarray(pc, dtype=xd.dtype)
+            out = np.asarray(project(xd, pc_dev))[:true_rows]
+            # FLOAT64 variable-list output column: Spark's ArrayType(Double)
+            # Arrow mapping (reference output is FLOAT64, rapidsml_jni.cu:89)
+            flat = out.astype(np.float64, copy=False).reshape(-1)
+            col = _list_column(flat, out.shape[1])
+            yield pa.RecordBatch.from_arrays(
+                [*batch.columns, col],
+                schema=batch.schema.append(pa.field(output_col, col.type)),
+            )
+
+    return transform_partition
+
+
+def transform_output_schema(input_schema: pa.Schema, output_col: str) -> pa.Schema:
+    """Schema of the transform output: input columns + the ArrayType output
+    (``transformSchema`` analog, RapidsPCA.scala:168-175). Variable list —
+    the Arrow type Spark's ArrayType(Double) maps to."""
+    return input_schema.append(pa.field(output_col, pa.list_(pa.float64())))

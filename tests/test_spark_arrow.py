@@ -1,0 +1,188 @@
+"""Tests for the Spark integration's executor-side Arrow plan functions.
+
+These run WITHOUT pyspark: the mapInArrow bodies consume plain pyarrow
+RecordBatch iterators, so the whole executor-side computation is verified
+here; the thin pyspark-facing wrappers add only plan wiring. (The reference
+has no Spark-free test path at all — SURVEY.md §4.)
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_ml_tpu import PCA
+from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.spark import SparkPCA, SparkPCAModel, arrow_fns
+
+
+def _batches(x, sizes, col="features"):
+    """Split [rows, n] into Arrow record batches of the given row counts."""
+    out, at = [], 0
+    for s in sizes:
+        chunk = x[at : at + s]
+        at += s
+        arr = pa.FixedSizeListArray.from_arrays(
+            pa.array(chunk.reshape(-1)), x.shape[1]
+        )
+        out.append(pa.RecordBatch.from_arrays([arr], names=[col]))
+    assert at == len(x)
+    return out
+
+
+@pytest.fixture
+def x(rng):
+    return rng.normal(size=(200, 12))
+
+
+class TestStatsSerialization:
+    def test_round_trip(self, x):
+        stats = L.gram_stats(x)
+        batch = arrow_fns.stats_to_batch(stats)
+        back = arrow_fns.stats_from_batches([batch])
+        np.testing.assert_allclose(back.xtx, np.asarray(stats.xtx), rtol=1e-12)
+        np.testing.assert_allclose(back.col_sum, np.asarray(stats.col_sum), rtol=1e-12)
+        assert float(back.count) == 200.0
+
+    def test_merge_multiple_rows(self, x):
+        halves = [L.gram_stats(x[:100]), L.gram_stats(x[100:])]
+        merged = arrow_fns.stats_from_batches(
+            [arrow_fns.stats_to_batch(s) for s in halves]
+        )
+        np.testing.assert_allclose(merged.xtx, x.T @ x, rtol=1e-10)
+        assert float(merged.count) == 200.0
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError, match="no partition statistics"):
+            arrow_fns.stats_from_batches([])
+
+    def test_stats_batch_uses_variable_lists(self, x):
+        """Spark maps ArrayType to Arrow ListType — the emitted batch must
+        use variable lists or the worker/JVM boundary rejects it."""
+        batch = arrow_fns.stats_to_batch(L.gram_stats(x))
+        assert batch.schema.field("xtx").type == pa.list_(pa.float64())
+        assert batch.schema.field("col_sum").type == pa.list_(pa.float64())
+
+    def test_stats_from_rows_collect_path(self, x):
+        """The PySpark <4.0 fallback: merge from collect()-style row dicts."""
+        halves = [L.gram_stats(x[:100]), L.gram_stats(x[100:])]
+        rows = [
+            {
+                "xtx": np.asarray(s.xtx).reshape(-1).tolist(),
+                "col_sum": np.asarray(s.col_sum).tolist(),
+                "count": float(np.asarray(s.count)),
+            }
+            for s in halves
+        ]
+        merged = arrow_fns.stats_from_rows(rows)
+        np.testing.assert_allclose(merged.xtx, x.T @ x, rtol=1e-10)
+        assert float(merged.count) == 200.0
+
+
+class TestFitPartitionFn:
+    def test_stats_match_full_matrix(self, x):
+        """Partition fn over streamed batches == GramStats of all rows —
+        the property the cross-partition reduce relies on."""
+        fn = arrow_fns.make_fit_partition_fn("features")
+        out = list(fn(iter(_batches(x, [64, 100, 36]))))
+        assert len(out) == 1  # one stats row per partition
+        stats = arrow_fns.stats_from_batches(out)
+        np.testing.assert_allclose(stats.xtx, x.T @ x, rtol=1e-8)
+        np.testing.assert_allclose(stats.col_sum, x.sum(0), rtol=1e-8)
+        assert float(stats.count) == 200.0
+
+    def test_empty_partition_yields_nothing(self):
+        fn = arrow_fns.make_fit_partition_fn("features")
+        assert list(fn(iter([]))) == []
+
+    def test_zero_row_batches_skipped(self, x):
+        """Spark can deliver 0-row batches; they must be skipped, not crash
+        the column extraction."""
+        empty = pa.RecordBatch.from_arrays(
+            [pa.array([], type=pa.list_(pa.float64()))], names=["features"]
+        )
+        fn = arrow_fns.make_fit_partition_fn("features")
+        out = list(fn(iter([empty, *_batches(x, [200]), empty])))
+        stats = arrow_fns.stats_from_batches(out)
+        np.testing.assert_allclose(stats.xtx, x.T @ x, rtol=1e-8)
+        tfn = arrow_fns.make_transform_partition_fn(
+            "features", "out", np.eye(12)[:, :2]
+        )
+        assert len(list(tfn(iter([empty])))) == 0
+
+    def test_two_partitions_equal_one(self, x):
+        fn = arrow_fns.make_fit_partition_fn("features")
+        p1 = list(fn(iter(_batches(x[:80], [80]))))
+        p2 = list(fn(iter(_batches(x[80:], [70, 50]))))
+        merged = arrow_fns.stats_from_batches(p1 + p2)
+        np.testing.assert_allclose(merged.xtx, x.T @ x, rtol=1e-8)
+
+    def test_end_to_end_matches_core_pca(self, x):
+        """mapInArrow-plan fit == the core estimator's fit, exactly the
+        equivalence the SparkPCA wrapper provides."""
+        fn = arrow_fns.make_fit_partition_fn("features")
+        stats_rows = []
+        for part in ([0, 90], [90, 200]):
+            stats_rows += list(fn(iter(_batches(x[part[0]:part[1]], [part[1] - part[0]]))))
+        stats = arrow_fns.stats_from_batches(stats_rows)
+        import jax.numpy as jnp
+
+        cov = L.covariance_from_stats(
+            L.GramStats(jnp.asarray(stats.xtx), jnp.asarray(stats.col_sum),
+                        jnp.asarray(stats.count)),
+            mean_centering=False,
+        )
+        pc, ev = L.pca_fit_from_cov(cov, 3)
+        core = PCA().setInputCol("f").setK(3).fit(x)
+        np.testing.assert_allclose(np.asarray(pc), core.pc, atol=1e-8)
+        np.testing.assert_allclose(np.asarray(ev), core.explainedVariance, atol=1e-10)
+
+
+class TestTransformPartitionFn:
+    def test_appends_projection_column(self, x, rng):
+        pc = rng.normal(size=(12, 4))
+        fn = arrow_fns.make_transform_partition_fn("features", "out", pc)
+        out = list(fn(iter(_batches(x, [128, 72]))))
+        assert len(out) == 2
+        got = np.concatenate(
+            [
+                np.asarray(b.column("out").values.to_numpy()).reshape(-1, 4)
+                for b in out
+            ]
+        )
+        np.testing.assert_allclose(got, x @ pc, atol=1e-8)
+        # input columns preserved
+        assert out[0].schema.names == ["features", "out"]
+
+    def test_output_is_float64_variable_list(self, x, rng):
+        pc = rng.normal(size=(12, 2))
+        fn = arrow_fns.make_transform_partition_fn("features", "out", pc)
+        (batch,) = list(fn(iter(_batches(x, [200]))))
+        assert batch.column("out").type == pa.list_(pa.float64())
+
+    def test_schema_helper(self):
+        schema = pa.schema([pa.field("features", pa.list_(pa.float64(), 12))])
+        out = arrow_fns.transform_output_schema(schema, "out")
+        assert out.field("out").type == pa.list_(pa.float64())
+
+
+class TestSparkWrappers:
+    def test_non_spark_input_falls_through(self, x):
+        """SparkPCA on non-Spark input behaves exactly like core PCA."""
+        model = SparkPCA().setInputCol("f").setK(3).fit(x)
+        assert isinstance(model, SparkPCAModel)
+        core = PCA().setInputCol("f").setK(3).fit(x)
+        np.testing.assert_allclose(model.pc, core.pc, atol=1e-12)
+        out = model.transform(x)
+        np.testing.assert_allclose(out, x @ model.pc, atol=1e-8)
+
+    def test_spark_import_error_is_actionable(self):
+        try:
+            import pyspark  # noqa: F401
+
+            pytest.skip("pyspark installed; gating not exercised")
+        except ImportError:
+            pass
+        from spark_rapids_ml_tpu.spark.estimators import _require_pyspark
+
+        with pytest.raises(ImportError, match="requires pyspark"):
+            _require_pyspark()
