@@ -3,8 +3,10 @@
 Workload: BASELINE.json config-2 shape scaled to a single chip — k=50 on
 2M×512 f32, data device-resident (matching the reference's semantics, where
 ColumnarRdd hands fit() device-resident cudf tables). The measured program is
-the full fit: mean-centered Gram (MXU, HIGHEST precision) + refined eigh +
-sign-flip + explained variance.
+the full fit exactly as the reference observably computes it
+(RapidsRowMatrix.scala:111-117: uncentered Gram) — Gram on the MXU
+(3-pass bf16 split, Precision.HIGH) + refined eigh + sign-flip + explained
+variance.
 
 Methodology: the PJRT transport here has ~70 ms host↔device round-trip
 latency and an unreliable ``block_until_ready`` fence, so single-dispatch
@@ -55,11 +57,16 @@ def main() -> None:
 
     def fit_consumed(a):
         # Precision.HIGH: 3-pass bf16 split for the Gram — measured min
-        # eigenvector cosine vs an f64 CPU oracle is 0.9999999 on this
-        # workload class (the refined eigh recovers the decomposition), well
-        # above the 0.9999 target, at ~1.7x the HIGHEST-precision speed.
+        # eigenvector cosine vs an f64 CPU oracle is 0.99999999984 for THIS
+        # uncentered program on this workload class (200k×512 validation run
+        # on the real chip; the refined eigh recovers the decomposition),
+        # well above the 0.9999 target, at ~1.7x the HIGHEST-precision speed.
+        # mean_centering=False is the reference's observable fit (its
+        # centering is a TODO stub, RapidsRowMatrix.scala:111-117): the
+        # measured program is exactly uncentered Gram + eig, matching what
+        # the A100 proxy models — and skips a second HBM pass over X.
         pc, ev = L.pca_fit_local(
-            a, K, mean_centering=True, precision=lax.Precision.HIGH
+            a, K, mean_centering=False, precision=lax.Precision.HIGH
         )
         return jnp.sum(pc) + jnp.sum(ev)
 
@@ -90,7 +97,11 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "pca_fit_device_wall_clock_2Mx512_k50",
+                # metric renamed from ..._2Mx512_k50 when the measured
+                # program switched to the reference-faithful uncentered fit
+                # (older recorded runs measured the centered variant and are
+                # not directly comparable).
+                "metric": "pca_fit_uncentered_device_wall_clock_2Mx512_k50",
                 "value": round(per_fit, 5),
                 "unit": "seconds",
                 "vs_baseline": round(A100_ESTIMATE_S / per_fit, 3),

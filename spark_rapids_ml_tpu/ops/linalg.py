@@ -322,9 +322,17 @@ def pca_fit_local(
 
     Fully jit-able with static ``k``/``mean_centering``. This is the
     whole reference fit() hot path (SURVEY.md §3.1) as one XLA program.
+
+    When ``mean_centering=False`` (the reference's observable behavior —
+    its centering is a TODO stub, RapidsRowMatrix.scala:111-117) the
+    column-sum statistic is skipped entirely: that saves a second full
+    HBM pass over X, leaving exactly the reference's computation
+    (uncentered Gram + eig).
     """
+    if not mean_centering:
+        return pca_fit_from_cov(gram(x, precision=precision), k)
     stats = gram_stats(x, precision=precision)
-    cov = covariance_from_stats(stats, mean_centering=mean_centering)
+    cov = covariance_from_stats(stats, mean_centering=True)
     return pca_fit_from_cov(cov, k)
 
 
