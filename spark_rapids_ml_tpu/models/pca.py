@@ -71,8 +71,10 @@ class PCAParams(HasInputCol, HasOutputCol):
         "solver",
         "decomposition solver: 'full' (exact refined eigh, reference "
         "parity), 'randomized' (HMT subspace iteration, O(n²·(k+p)) — "
-        "explainedVariance uses a trace-based tail estimate), or 'auto' "
-        "(randomized when n ≥ 1024 and k ≪ n)",
+        "explainedVariance uses a trace-based tail estimate), 'svd' "
+        "(direct TSQR→SVD(R): never forms XᵀX, works at cond(X) instead of "
+        "cond(X)² — best for ill-conditioned data), or 'auto' (randomized "
+        "when n ≥ 1024 and k ≪ n)",
         str,
     )
 
@@ -108,6 +110,9 @@ def _fit_from_stats(stats: L.GramStats, k: int, mean_centering: bool, solver: st
 
 _fit_from_stats_jit = jax.jit(_fit_from_stats, static_argnums=(1, 2, 3))
 _project = jax.jit(L.project)
+_qr_r = jax.jit(L.qr_r)
+_combine_r = jax.jit(L.combine_r)
+_svd_from_r_jit = jax.jit(L.svd_from_r, static_argnums=(1,))
 
 
 class PCA(PCAParams, Estimator):
@@ -134,9 +139,39 @@ class PCA(PCAParams, Estimator):
         return self._set(precision=value)
 
     def setSolver(self, value: str) -> "PCA":
-        if value not in ("full", "randomized", "auto"):
-            raise ValueError("solver must be 'full', 'randomized', or 'auto'")
+        if value not in ("full", "randomized", "svd", "auto"):
+            raise ValueError(
+                "solver must be 'full', 'randomized', 'svd', or 'auto'"
+            )
         return self._set(solver=value)
+
+    def _reduce_r(self, mats, mean_centering: bool):
+        """Reduction stage of the direct TSQR fit: per-partition R factors
+        tree-reduced with QR-of-stacked-pair (``ops.linalg.combine_r`` — an
+        associative semigroup, exactly like the GramStats monoid). Partitions
+        ride the same power-of-two row bucketing as the Gram path (``qr_r``'s
+        R is invariant under zero-row padding), so the shape set — and with
+        it the number of XLA compiles — stays small. Centering needs the
+        global mean first, so it costs one extra cheap pass (column sums
+        only) over the partitions, applied *before* padding so pad rows stay
+        zero."""
+        from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+        from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+
+        mean = None
+        if mean_centering:
+            count = max(sum(m.shape[0] for m in mats), 1)
+            col_sum = sum(m.sum(axis=0, dtype=np.float64) for m in mats)
+            mean = col_sum / count
+
+        def partition_task(mat):
+            if mean is not None:
+                mat = mat - mean.astype(mat.dtype)[None, :]
+            padded, _ = columnar.pad_rows(mat)
+            return _qr_r(jnp.asarray(padded))
+
+        partials = run_partition_tasks(partition_task, mats)
+        return tree_reduce(partials, _combine_r)
 
     def fit(self, dataset: Any, num_partitions: int | None = None) -> "PCAModel":
         """Two-phase fit, mirroring the reference call stack (SURVEY.md §3.1):
@@ -156,28 +191,35 @@ class PCA(PCAParams, Estimator):
                         f"inconsistent feature dim: {m.shape[1]} != {n_cols}"
                     )
 
-            prec = _PRECISIONS[self.getOrDefault("precision")]
+            solver = self.getOrDefault("solver")
+            if k > n_cols:
+                raise ValueError(f"k={k} must be <= number of features {n_cols}")
+            if solver == "svd":
+                r = self._reduce_r(mats, mean_centering)
+            else:
+                prec = _PRECISIONS[self.getOrDefault("precision")]
 
-            def partition_task(mat):
-                padded, true_rows = columnar.pad_rows(mat)
-                stats = _gram_stats(jnp.asarray(padded), precision=prec)
-                # padding adds zero rows: fix only the count
-                return L.GramStats(
-                    stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype)
-                )
+                def partition_task(mat):
+                    padded, true_rows = columnar.pad_rows(mat)
+                    stats = _gram_stats(jnp.asarray(padded), precision=prec)
+                    # padding adds zero rows: fix only the count
+                    return L.GramStats(
+                        stats.xtx,
+                        stats.col_sum,
+                        jnp.asarray(true_rows, stats.count.dtype),
+                    )
 
-            from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
-            from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+                from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+                from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
 
-            partials = run_partition_tasks(partition_task, mats)
-            stats = tree_reduce(partials, L.combine_gram_stats)
-        if k > n_cols:
-            raise ValueError(f"k={k} must be <= number of features {n_cols}")
+                partials = run_partition_tasks(partition_task, mats)
+                stats = tree_reduce(partials, L.combine_gram_stats)
 
         with trace_range("eigh"):  # "cuSolver SVD" range analog, RapidsRowMatrix.scala:70
-            pc, explained = _fit_from_stats_jit(
-                stats, k, mean_centering, self.getOrDefault("solver")
-            )
+            if solver == "svd":
+                pc, explained = _svd_from_r_jit(r, k)
+            else:
+                pc, explained = _fit_from_stats_jit(stats, k, mean_centering, solver)
 
         model = PCAModel(
             uid=self.uid,

@@ -336,6 +336,67 @@ def pca_fit_local(
     return pca_fit_from_cov(cov, k)
 
 
+def qr_r(x: jax.Array) -> jax.Array:
+    """R factor of a (tall) row block, always shaped [n, n].
+
+    The building block of the direct-SVD fit path: R carries the complete
+    sufficient statistic for X's right singular structure (RᵀR = XᵀX) while
+    staying orthogonal-factor-accurate — unlike the Gram matrix, forming R
+    never squares the condition number. Blocks with fewer than n rows are
+    zero-padded (QR of [X; 0] has the same R up to the rows X determines).
+    """
+    rows, n = x.shape
+    if rows < n:
+        x = jnp.concatenate([x, jnp.zeros((n - rows, n), x.dtype)], axis=0)
+    return jnp.linalg.qr(x, mode="r")
+
+
+def combine_r(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Associative combine for R factors: QR of the stacked pair.
+
+    (RᵃᵀRᵃ + RᵇᵀRᵇ) is preserved, so R factors reduce across partitions
+    exactly like ``GramStats`` — a semigroup ridden by ``tree_reduce`` on
+    the portable path and by the butterfly exchange in ``parallel.tsqr`` on
+    the mesh path.
+    """
+    return jnp.linalg.qr(jnp.concatenate([a, b], axis=0), mode="r")
+
+
+def svd_from_r(r: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Decomposition stage of the direct path: R → (pc [n, k], ev [k]).
+
+    The singular values of R are exactly the singular values of X (X = QR
+    with Q orthonormal), so the reference's explained-variance definition —
+    sᵢ/Σs over the FULL spectrum, truncated to k (RapidsRowMatrix.scala:92-99)
+    — transfers unchanged, computed here without ever forming XᵀX. Right
+    singular vectors get the same deterministic sign-flip orientation as the
+    eigh path (rapidsml_jni.cu:35-61).
+    """
+    _, s, vt = jnp.linalg.svd(r, full_matrices=False)  # descending already
+    components = sign_flip(vt.T[:, :k])
+    return components, explained_variance(s, k)
+
+
+def pca_fit_local_svd(
+    x: jax.Array,
+    k: int,
+    *,
+    mean_centering: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Single-device direct-SVD fit: rows → (pc, explainedVariance).
+
+    Numerically superior alternative to the Gram path for ill-conditioned
+    data: cond(XᵀX) = cond(X)², so the Gram route loses half the working
+    digits before the eigensolver even starts; QR → SVD(R) works at
+    cond(X). The reference has no such path (its only route is the Gram +
+    cuSolver eig, SURVEY.md §3.1); this is a capability-add enabled by the
+    TSQR reduction being mesh-friendly.
+    """
+    if mean_centering:
+        x = x - jnp.mean(x, axis=0, keepdims=True)
+    return svd_from_r(qr_r(x), k)
+
+
 def project(x: jax.Array, pc: jax.Array, *, precision=DEFAULT_PRECISION) -> jax.Array:
     """Transform projection X·PC for a [rows, n] block and [n, k] components.
 
