@@ -25,6 +25,21 @@ DATA_AXIS = "data"
 FEAT_AXIS = "feat"
 
 
+def center_columns_shard(xl):
+    """Shard-local mean-centering over the ``data`` axis.
+
+    Call inside a shard_map body whose mesh has the data axis: one psum for
+    the column sums, one for the global row count, subtract. Shared by the
+    TSQR and sketched fit paths.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    s = lax.psum(jnp.sum(xl, axis=0), DATA_AXIS)
+    c = lax.psum(jnp.asarray(xl.shape[0], xl.dtype), DATA_AXIS)
+    return xl - (s / c)[None, :]
+
+
 def shard_map(f=None, **kwargs):
     """``jax.shard_map`` across JAX versions: new releases renamed the
     replication-check kwarg ``check_rep`` → ``check_vma`` and moved the API

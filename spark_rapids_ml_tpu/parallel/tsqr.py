@@ -26,7 +26,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.ops import linalg as L
-from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, shard_map
+from spark_rapids_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    center_columns_shard,
+    shard_map,
+)
 
 
 def _butterfly_r(r_local: jax.Array, n_data: int) -> jax.Array:
@@ -54,6 +58,20 @@ def _butterfly_r(r_local: jax.Array, n_data: int) -> jax.Array:
     return r
 
 
+def merge_r(r: jax.Array, n_data: int) -> jax.Array:
+    """Merge per-device R factors over the ``data`` axis (shard_map context).
+
+    Butterfly when the axis size is a power of two, all-gather + replicated
+    QR otherwise. Returns the same replicated R on every device.
+    """
+    if n_data == 1:
+        return r
+    if n_data & (n_data - 1) == 0:
+        return _butterfly_r(r, n_data)
+    rs = lax.all_gather(r, DATA_AXIS)  # [D, n, n]
+    return jnp.linalg.qr(rs.reshape(-1, r.shape[1]), mode="r")
+
+
 def tsqr_r(x: jax.Array, mesh: Mesh) -> jax.Array:
     """R factor of a [rows, n] matrix row-sharded over the ``data`` axis.
 
@@ -64,7 +82,6 @@ def tsqr_r(x: jax.Array, mesh: Mesh) -> jax.Array:
     where the butterfly doesn't apply).
     """
     n_data = mesh.shape[DATA_AXIS]
-    butterfly = n_data & (n_data - 1) == 0 and n_data > 1
 
     @partial(
         shard_map,
@@ -74,13 +91,7 @@ def tsqr_r(x: jax.Array, mesh: Mesh) -> jax.Array:
         check_rep=False,
     )
     def _tsqr(xl):
-        r = L.qr_r(xl)
-        if not butterfly:
-            if n_data == 1:
-                return r
-            rs = lax.all_gather(r, DATA_AXIS)  # [D, n, n]
-            return jnp.linalg.qr(rs.reshape(-1, r.shape[1]), mode="r")
-        return _butterfly_r(r, n_data)
+        return merge_r(L.qr_r(xl), n_data)
 
     return _tsqr(x)
 
@@ -109,9 +120,7 @@ def distributed_pca_fit_svd(
             check_rep=False,
         )
         def _center(xl):
-            s = lax.psum(jnp.sum(xl, axis=0), DATA_AXIS)
-            c = lax.psum(jnp.asarray(xl.shape[0], xl.dtype), DATA_AXIS)
-            return xl - (s / c)[None, :]
+            return center_columns_shard(xl)
 
         x = _center(x)
     r = tsqr_r(x, mesh)
