@@ -32,6 +32,10 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import pca
 
         return getattr(pca, name)
+    if name in ("TruncatedSVD", "TruncatedSVDModel"):
+        from spark_rapids_ml_tpu.models import truncated_svd
+
+        return getattr(truncated_svd, name)
     if name in ("KMeans", "KMeansModel"):
         from spark_rapids_ml_tpu.models import kmeans
 

@@ -15,5 +15,17 @@ from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
     StandardScaler,
     StandardScalerModel,
 )
+from spark_rapids_ml_tpu.models.truncated_svd import (  # noqa: F401
+    TruncatedSVD,
+    TruncatedSVDModel,
+)
 
-__all__ = ["PCA", "PCAModel", "StandardScaler", "StandardScalerModel", "Normalizer"]
+__all__ = [
+    "PCA",
+    "PCAModel",
+    "StandardScaler",
+    "StandardScalerModel",
+    "Normalizer",
+    "TruncatedSVD",
+    "TruncatedSVDModel",
+]

@@ -1,0 +1,223 @@
+"""TruncatedSVD estimator — direct low-rank factorization of uncentered X.
+
+A sibling of PCA for the LSA/recommender use case: identical partition
+architecture (per-partition device statistics, tree-reduced; SURVEY.md §3.1
+shape) but the model is the SVD of X itself — which, for uncentered data, is
+exactly what the reference's PCA *actually* computes (its meanCentering is a
+TODO stub, RapidsRowMatrix.scala:111-117), here exposed under the name that
+matches the semantics. Differences from PCA:
+
+- no centering param at all — TruncatedSVD is defined on raw X;
+- the model carries ``singularValues`` (σᵢ of X, the √λ the reference
+  computes in ``calSVD``'s seqRoot step, rapidsml_jni.cu:254) instead of the
+  normalized explainedVariance ratio;
+- ``explained_variance_ratio`` is still derivable and provided as a method.
+
+Solvers mirror PCA's: 'gram' (Gram + refined eigh — the reference-shaped
+route), 'svd' (TSQR direct, cond(X) accuracy), 'randomized' (HMT on the
+Gram), 'auto'.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
+from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+
+class TruncatedSVDParams(HasInputCol, HasOutputCol):
+    k = Param("k", "number of singular vectors to keep", int)
+    precision = Param(
+        "precision",
+        "MXU matmul precision for the Gram pass ('highest'/'high'/'default')",
+        str,
+    )
+    solver = Param(
+        "solver",
+        "decomposition solver: 'gram' (Gram + refined eigh), 'svd' (TSQR "
+        "direct), 'randomized' (HMT subspace iteration), 'auto'",
+        str,
+    )
+
+    def __init__(self, uid: str | None = None):
+        super().__init__(uid)
+        from spark_rapids_ml_tpu.utils.config import get_config
+
+        self._setDefault(
+            outputCol="svd_features",
+            precision=get_config().default_precision,
+            solver="gram",
+        )
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+
+_gram = jax.jit(L.gram, static_argnames=("precision",))
+_qr_r = jax.jit(L.qr_r)
+_combine_r = jax.jit(L.combine_r)
+_project = jax.jit(L.project)
+
+
+_svd_values_from_r_jit = jax.jit(L.svd_components_from_r, static_argnums=(1,))
+
+_OVERSAMPLE = 10  # forwarded to randomized_eigh_descending and its auto rule
+
+
+def _decompose_gram(g: jax.Array, k: int, solver: str):
+    """Gram → (components [n, k], singular values [n or l])."""
+    n = g.shape[0]
+    if solver == "auto":
+        # same profitability rule as pca_fit_from_cov (ops/linalg.py)
+        solver = "randomized" if n >= 1024 and (k + _OVERSAMPLE) * 8 <= n else "gram"
+    if solver == "randomized":
+        u, s, _ = L.randomized_eigh_descending(g, k, oversample=_OVERSAMPLE)
+        return u, s
+    components, s = L.eigh_descending(g)
+    return components[:, :k], s
+
+
+_decompose_gram_jit = jax.jit(_decompose_gram, static_argnums=(1, 2))
+
+
+class TruncatedSVD(TruncatedSVDParams, Estimator):
+    """Top-k SVD of the (uncentered) input matrix.
+
+    >>> model = TruncatedSVD().setInputCol("f").setK(10).fit(df)
+    >>> reduced = model.transform(df)
+    """
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid)
+        if kwargs:
+            self._set(**{k: v for k, v in kwargs.items() if v is not None})
+
+    def setK(self, value: int) -> "TruncatedSVD":
+        return self._set(k=value)
+
+    def setPrecision(self, value: str) -> "TruncatedSVD":
+        if value not in L.PRECISIONS:
+            raise ValueError(f"precision must be one of {sorted(L.PRECISIONS)}")
+        return self._set(precision=value)
+
+    def setSolver(self, value: str) -> "TruncatedSVD":
+        if value not in ("gram", "svd", "randomized", "auto"):
+            raise ValueError(
+                "solver must be 'gram', 'svd', 'randomized', or 'auto'"
+            )
+        return self._set(solver=value)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "TruncatedSVDModel":
+        input_col = self._paramMap.get("inputCol") or self._defaultParamMap.get(
+            "inputCol"
+        )
+        ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
+        k = self.getK()
+        solver = self.getOrDefault("solver")
+
+        from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+        from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+
+        with trace_range("tsvd reduce"):
+            mats = list(ds.matrices())
+            n_cols = mats[0].shape[1]
+            for m in mats[1:]:
+                if m.shape[1] != n_cols:
+                    raise ValueError(
+                        f"inconsistent feature dim: {m.shape[1]} != {n_cols}"
+                    )
+            if k > n_cols:
+                raise ValueError(f"k={k} must be <= number of features {n_cols}")
+
+            if solver == "svd":
+
+                def task(mat):
+                    padded, _ = columnar.pad_rows(mat)
+                    return _qr_r(jnp.asarray(padded))
+
+                reduced = tree_reduce(run_partition_tasks(task, mats), _combine_r)
+            else:
+                prec = L.PRECISIONS[self.getOrDefault("precision")]
+
+                def task(mat):
+                    padded, _ = columnar.pad_rows(mat)
+                    return _gram(jnp.asarray(padded), precision=prec)
+
+                reduced = tree_reduce(
+                    run_partition_tasks(task, mats), lambda a, b: a + b
+                )
+
+        with trace_range("tsvd decompose"):
+            if solver == "svd":
+                components, s = _svd_values_from_r_jit(reduced, k)
+            else:
+                components, evals_sqrt = _decompose_gram_jit(reduced, k, solver)
+                s = evals_sqrt
+
+        model = TruncatedSVDModel(
+            uid=self.uid,
+            components=np.asarray(components),
+            singularValues=np.asarray(s[:k]),
+        )
+        return self._copyValues(model)
+
+
+class TruncatedSVDModel(TruncatedSVDParams, Model):
+    """Fitted model: ``components`` [n, k], ``singularValues`` [k] (σ of X)."""
+
+    def __init__(
+        self,
+        uid: str | None = None,
+        components: np.ndarray | None = None,
+        singularValues: np.ndarray | None = None,
+    ):
+        super().__init__(uid)
+        self.components = None if components is None else np.asarray(components)
+        self.singularValues = (
+            None if singularValues is None else np.asarray(singularValues)
+        )
+
+    def explained_variance_ratio(self) -> np.ndarray:
+        """σᵢ/Σσ over the *retained* spectrum — note the reference's PCA
+        normalizes over the full spectrum; a truncated model only has k
+        values, so this ratio is relative to what was kept."""
+        total = self.singularValues.sum()
+        return self.singularValues / (total if total > 0 else 1.0)
+
+    def _project_matrix(self, mat: np.ndarray) -> np.ndarray:
+        padded, true_rows = columnar.pad_rows(mat)
+        xd = jnp.asarray(padded)
+        out = _project(xd, jnp.asarray(self.components, dtype=xd.dtype))
+        return np.asarray(out)[:true_rows]
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("tsvd transform"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                self._project_matrix,
+            )
+
+    def transform_rows(self, rows) -> list[np.ndarray]:
+        ct = self.components.T
+        return [ct @ np.asarray(r) for r in rows]
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"components": self.components, "singularValues": self.singularValues}
+
+    @classmethod
+    def _fromSaved(cls, uid: str, data: dict[str, np.ndarray]) -> "TruncatedSVDModel":
+        return cls(
+            uid=uid,
+            components=data["components"],
+            singularValues=data["singularValues"],
+        )

@@ -362,18 +362,27 @@ def combine_r(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.linalg.qr(jnp.concatenate([a, b], axis=0), mode="r")
 
 
-def svd_from_r(r: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
-    """Decomposition stage of the direct path: R → (pc [n, k], ev [k]).
+def svd_components_from_r(r: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """R → (components [n, k], singular values [n], both of X).
 
     The singular values of R are exactly the singular values of X (X = QR
-    with Q orthonormal), so the reference's explained-variance definition —
-    sᵢ/Σs over the FULL spectrum, truncated to k (RapidsRowMatrix.scala:92-99)
-    — transfers unchanged, computed here without ever forming XᵀX. Right
-    singular vectors get the same deterministic sign-flip orientation as the
-    eigh path (rapidsml_jni.cu:35-61).
+    with Q orthonormal). Right singular vectors get the same deterministic
+    sign-flip orientation as the eigh path (rapidsml_jni.cu:35-61). The one
+    SVD(R) kernel both direct-path estimators (PCA solver='svd' and
+    TruncatedSVD) decompose through.
     """
     _, s, vt = jnp.linalg.svd(r, full_matrices=False)  # descending already
-    components = sign_flip(vt.T[:, :k])
+    return sign_flip(vt.T[:, :k]), s
+
+
+def svd_from_r(r: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Decomposition stage of the direct PCA path: R → (pc [n, k], ev [k]).
+
+    The reference's explained-variance definition — sᵢ/Σs over the FULL
+    spectrum, truncated to k (RapidsRowMatrix.scala:92-99) — transfers
+    unchanged, computed here without ever forming XᵀX.
+    """
+    components, s = svd_components_from_r(r, k)
     return components, explained_variance(s, k)
 
 

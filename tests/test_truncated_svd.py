@@ -1,0 +1,98 @@
+"""TruncatedSVD estimator tests — differential vs a NumPy SVD oracle."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import TruncatedSVD, TruncatedSVDModel
+
+
+def _oracle(x, k):
+    _, s, vt = np.linalg.svd(x, full_matrices=False)
+    v = vt.T[:, :k]
+    idx = np.argmax(np.abs(v), axis=0)
+    return v * np.where(v[idx, np.arange(k)] < 0, -1.0, 1.0), s
+
+
+@pytest.fixture
+def x(rng):
+    return rng.normal(size=(500, 24)) @ rng.normal(size=(24, 24))
+
+
+class TestFit:
+    @pytest.mark.parametrize("solver", ["gram", "svd"])
+    def test_matches_oracle(self, x, solver):
+        m = (
+            TruncatedSVD()
+            .setInputCol("f")
+            .setK(5)
+            .setSolver(solver)
+            .fit(x, num_partitions=3)
+        )
+        v, s = _oracle(x, 5)
+        np.testing.assert_allclose(m.components, v, atol=1e-6)
+        np.testing.assert_allclose(m.singularValues, s[:5], rtol=1e-8)
+
+    def test_randomized_solver(self, rng):
+        u, _ = np.linalg.qr(rng.normal(size=(600, 32)))
+        w, _ = np.linalg.qr(rng.normal(size=(32, 32)))
+        x = (u * np.logspace(1, -2, 32)) @ w.T
+        m = TruncatedSVD().setInputCol("f").setK(4).setSolver("randomized").fit(x)
+        v, s = _oracle(x, 4)
+        cos = np.abs(np.sum(m.components * v, axis=0))
+        assert cos.min() > 0.9999
+        np.testing.assert_allclose(m.singularValues, s[:4], rtol=1e-6)
+
+    def test_uncentered_semantics(self, rng):
+        """TruncatedSVD decomposes raw X — a large mean offset must shift the
+        leading component toward the mean direction (unlike centered PCA)."""
+        x = rng.normal(size=(400, 16)) + 50.0
+        m = TruncatedSVD().setInputCol("f").setK(1).fit(x)
+        mean_dir = x.mean(0) / np.linalg.norm(x.mean(0))
+        assert abs(float(m.components[:, 0] @ mean_dir)) > 0.999
+
+    def test_matches_reference_pca_fit(self, x):
+        """On uncentered data TruncatedSVD and the reference-parity PCA fit
+        compute the same subspace (the reference's PCA never centers)."""
+        from spark_rapids_ml_tpu import PCA
+
+        tsvd = TruncatedSVD().setInputCol("f").setK(4).fit(x, num_partitions=2)
+        pca = PCA().setInputCol("f").setK(4).fit(x, num_partitions=2)
+        np.testing.assert_allclose(tsvd.components, pca.pc, atol=1e-6)
+
+    def test_k_too_large(self, x):
+        with pytest.raises(ValueError):
+            TruncatedSVD().setInputCol("f").setK(100).fit(x)
+
+    def test_bad_solver(self):
+        with pytest.raises(ValueError):
+            TruncatedSVD().setSolver("eig")
+
+
+class TestModel:
+    def test_transform_projects(self, x):
+        m = TruncatedSVD().setInputCol("f").setK(3).fit(x)
+        out = np.asarray(m.transform(x))
+        np.testing.assert_allclose(out, x @ m.components, atol=1e-8)
+
+    def test_transform_rows_fallback(self, x):
+        m = TruncatedSVD().setInputCol("f").setK(3).fit(x)
+        rows = [x[i] for i in range(5)]
+        outs = m.transform_rows(rows)
+        np.testing.assert_allclose(
+            np.stack(outs), x[:5] @ m.components, atol=1e-8
+        )
+
+    def test_explained_variance_ratio(self, x):
+        m = TruncatedSVD().setInputCol("f").setK(4).fit(x)
+        r = m.explained_variance_ratio()
+        assert r.shape == (4,) and abs(r.sum() - 1.0) < 1e-9
+        assert (np.diff(r) <= 1e-12).all()  # descending
+
+    def test_persistence_roundtrip(self, x, tmp_path):
+        m = TruncatedSVD().setInputCol("f").setK(3).fit(x)
+        p = str(tmp_path / "tsvd")
+        m.save(p)
+        m2 = TruncatedSVDModel.load(p)
+        np.testing.assert_array_equal(m.components, m2.components)
+        np.testing.assert_array_equal(m.singularValues, m2.singularValues)
+        assert m2.getK() == 3
