@@ -53,4 +53,17 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import linear
 
         return getattr(linear, name)
+    if name in (
+        "ParamGridBuilder",
+        "CrossValidator",
+        "CrossValidatorModel",
+        "TrainValidationSplit",
+        "TrainValidationSplitModel",
+        "RegressionEvaluator",
+        "BinaryClassificationEvaluator",
+        "ClusteringEvaluator",
+    ):
+        from spark_rapids_ml_tpu.models import tuning
+
+        return getattr(tuning, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -69,18 +69,18 @@ _project = jax.jit(L.project)
 
 _svd_values_from_r_jit = jax.jit(L.svd_components_from_r, static_argnums=(1,))
 
-_OVERSAMPLE = 10  # forwarded to randomized_eigh_descending and its auto rule
-
-
 def _decompose_gram(g: jax.Array, k: int, solver: str):
     """Gram → (components [n, k], singular values [n or l])."""
     n = g.shape[0]
     if solver == "auto":
-        # same profitability rule as pca_fit_from_cov (ops/linalg.py)
-        solver = "randomized" if n >= 1024 and (k + _OVERSAMPLE) * 8 <= n else "gram"
+        solver = "randomized" if L.randomized_profitable(n, k) else "gram"
     if solver == "randomized":
-        u, s, _ = L.randomized_eigh_descending(g, k, oversample=_OVERSAMPLE)
+        u, s, _ = L.randomized_eigh_descending(g, k)
         return u, s
+    if solver != "gram":
+        # setSolver validates, but constructor kwargs / ParamGridBuilder maps
+        # bypass it — fail loudly rather than silently running the eigh path.
+        raise ValueError(f"unknown solver {solver!r}")
     components, s = L.eigh_descending(g)
     return components[:, :k], s
 

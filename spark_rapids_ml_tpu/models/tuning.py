@@ -1,0 +1,418 @@
+"""Model selection: param grids, evaluators, cross-validation.
+
+Spark ML's ``pyspark.ml.tuning``/``pyspark.ml.evaluation`` surface for this
+framework — a capability the reference module lacks entirely (its user does
+model selection by hand around `fit`). API mirrors Spark: ``ParamGridBuilder``
+→ list of param maps, ``CrossValidator``/``TrainValidationSplit`` estimators
+whose fitted models delegate ``transform`` to the best sub-model.
+
+TPU note: every candidate fit reuses the same jitted kernels (jax.jit caches
+by shape, and the fold row-counts are bucket-padded by the estimators), so a
+k-fold × m-candidate sweep compiles each kernel once, not k·m times.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import (
+    HasLabelCol,
+    HasPredictionCol,
+    Param,
+    Params,
+)
+from spark_rapids_ml_tpu.utils import columnar
+
+try:
+    import pyarrow as pa
+except Exception:  # pragma: no cover
+    pa = None
+
+
+# ---------------------------------------------------------------------------
+# Dataset row helpers (container-generic)
+# ---------------------------------------------------------------------------
+
+
+def n_rows(dataset: Any) -> int:
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        return len(np.asarray(dataset[0]))
+    if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
+        return dataset.num_rows
+    if hasattr(dataset, "iloc"):
+        return len(dataset)
+    return len(np.asarray(dataset))
+
+
+def row_slice(dataset: Any, idx: np.ndarray) -> Any:
+    """Take rows by integer index, preserving the container type."""
+    idx = np.asarray(idx)
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        return (np.asarray(dataset[0])[idx], np.asarray(dataset[1])[idx])
+    if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
+        return dataset.take(pa.array(idx))
+    if hasattr(dataset, "iloc"):
+        return dataset.iloc[idx]
+    return np.asarray(dataset)[idx]
+
+
+def _labels_of(dataset: Any, label_col: str) -> np.ndarray:
+    if isinstance(dataset, tuple) and len(dataset) == 2:
+        return np.asarray(dataset[1], dtype=np.float64)
+    return columnar.extract_vector(dataset, label_col)
+
+
+# ---------------------------------------------------------------------------
+# Param grid
+# ---------------------------------------------------------------------------
+
+
+class ParamGridBuilder:
+    """Cartesian-product grids of param settings.
+
+    >>> grid = (ParamGridBuilder()
+    ...         .addGrid("regParam", [0.0, 0.1])
+    ...         .addGrid("fitIntercept", [True, False])
+    ...         .build())
+    """
+
+    def __init__(self):
+        self._grid: dict[str, list] = {}
+        self._base: dict[str, Any] = {}
+
+    def addGrid(self, param: "Param | str", values) -> "ParamGridBuilder":
+        name = param.name if isinstance(param, Param) else param
+        self._grid[name] = list(values)
+        return self
+
+    def baseOn(self, **kwargs) -> "ParamGridBuilder":
+        self._base.update(kwargs)
+        return self
+
+    def build(self) -> list[dict[str, Any]]:
+        maps = [dict(self._base)]
+        for name, values in self._grid.items():
+            maps = [{**m, name: v} for m in maps for v in values]
+        return maps
+
+
+# ---------------------------------------------------------------------------
+# Evaluators
+# ---------------------------------------------------------------------------
+
+
+class Evaluator(Params):
+    def evaluate(self, dataset: Any, predictions: np.ndarray | None = None) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+    def _predictions_of(self, dataset, predictions):
+        if predictions is not None:
+            return np.asarray(predictions, dtype=np.float64).reshape(-1)
+        return columnar.extract_vector(dataset, self.getOrDefault("predictionCol"))
+
+
+class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
+    """rmse (default) / mse / mae / r2 on (labelCol, predictionCol)."""
+
+    metricName = Param("metricName", "rmse|mse|mae|r2", str)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid)
+        self._setDefault(metricName="rmse", labelCol="label", predictionCol="prediction")
+        if kwargs:
+            self._set(**{k: v for k, v in kwargs.items() if v is not None})
+
+    def setMetricName(self, value: str) -> "RegressionEvaluator":
+        if value not in ("rmse", "mse", "mae", "r2"):
+            raise ValueError("metricName must be rmse, mse, mae, or r2")
+        return self._set(metricName=value)
+
+    def isLargerBetter(self) -> bool:
+        return self.getOrDefault("metricName") == "r2"
+
+    def evaluate(self, dataset, predictions=None) -> float:
+        y = _labels_of(dataset, self.getOrDefault("labelCol"))
+        p = self._predictions_of(dataset, predictions)
+        err = y - p
+        metric = self.getOrDefault("metricName")
+        if metric == "mse":
+            return float(np.mean(err**2))
+        if metric == "rmse":
+            return float(np.sqrt(np.mean(err**2)))
+        if metric == "mae":
+            return float(np.mean(np.abs(err)))
+        ss_tot = float(np.sum((y - y.mean()) ** 2))
+        return 1.0 - float(np.sum(err**2)) / (ss_tot if ss_tot > 0 else 1.0)
+
+
+class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
+    """areaUnderROC (default, rank statistic over scores) or accuracy."""
+
+    metricName = Param("metricName", "areaUnderROC|accuracy", str)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid)
+        self._setDefault(
+            metricName="areaUnderROC", labelCol="label", predictionCol="prediction"
+        )
+        if kwargs:
+            self._set(**{k: v for k, v in kwargs.items() if v is not None})
+
+    def setMetricName(self, value: str) -> "BinaryClassificationEvaluator":
+        if value not in ("areaUnderROC", "accuracy"):
+            raise ValueError("metricName must be areaUnderROC or accuracy")
+        return self._set(metricName=value)
+
+    def evaluate(self, dataset, predictions=None) -> float:
+        y = _labels_of(dataset, self.getOrDefault("labelCol"))
+        p = self._predictions_of(dataset, predictions)
+        if self.getOrDefault("metricName") == "accuracy":
+            return float(np.mean((p >= 0.5) == (y >= 0.5)))
+        pos, neg = p[y >= 0.5], p[y < 0.5]
+        if len(pos) == 0 or len(neg) == 0:
+            return 0.5
+        # Mann–Whitney U with tie correction: AUC = P(score⁺ > score⁻)
+        order = np.argsort(np.concatenate([pos, neg]), kind="mergesort")
+        ranks = np.empty(len(order))
+        ranks[order] = np.arange(1, len(order) + 1)
+        # average ranks over ties
+        allp = np.concatenate([pos, neg])
+        sorted_p = allp[order]
+        _, inv, counts = np.unique(sorted_p, return_inverse=True, return_counts=True)
+        cum = np.cumsum(counts)
+        avg_rank_of_group = cum - (counts - 1) / 2.0
+        ranks[order] = avg_rank_of_group[inv]
+        u = ranks[: len(pos)].sum() - len(pos) * (len(pos) + 1) / 2.0
+        return float(u / (len(pos) * len(neg)))
+
+
+class ClusteringEvaluator(Evaluator):
+    """Mean silhouette (squared-Euclidean) on (featuresCol, predictionCol).
+
+    Row pairs are O(rows²); rows are subsampled to ``maxRows`` (deterministic)
+    above that — the Spark evaluator makes the same tradeoff via its
+    squared-Euclidean variant.
+    """
+
+    featuresCol = Param("featuresCol", "features column", str)
+    predictionCol = Param("predictionCol", "cluster assignment column", str)
+    maxRows = Param("maxRows", "subsample cap for the pairwise pass", int)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid)
+        self._setDefault(featuresCol="features", predictionCol="prediction", maxRows=2048)
+        if kwargs:
+            self._set(**{k: v for k, v in kwargs.items() if v is not None})
+
+    def evaluate(self, dataset, predictions=None) -> float:
+        x = columnar.extract_matrix(dataset, self.getOrDefault("featuresCol"))
+        p = self._predictions_of(dataset, predictions).astype(np.int64)
+        cap = self.getOrDefault("maxRows")
+        if len(x) > cap:
+            sel = np.random.default_rng(0).choice(len(x), cap, replace=False)
+            x, p = x[sel], p[sel]
+        d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        labels = np.unique(p)
+        if len(labels) < 2:
+            return 0.0
+        sil = np.zeros(len(x))
+        for i in range(len(x)):
+            same = p == p[i]
+            same[i] = False
+            a = d2[i, same].mean() if same.any() else 0.0
+            b = min(
+                d2[i, p == c].mean() for c in labels if c != p[i]
+            )
+            sil[i] = 0.0 if max(a, b) == 0 else (b - a) / max(a, b)
+        return float(sil.mean())
+
+
+# ---------------------------------------------------------------------------
+# Validators
+# ---------------------------------------------------------------------------
+
+
+def _fit_and_eval(estimator, params, evaluator, train, val):
+    est = estimator.copy()
+    if params:
+        est._set(**params)
+    model = est.fit(train)
+    if isinstance(val, tuple):
+        pred = model.transform(val[0])
+        return model, evaluator.evaluate(val, predictions=np.asarray(pred))
+    out = model.transform(val)
+    if isinstance(out, np.ndarray):  # bare-matrix containers: predictions only
+        return model, evaluator.evaluate(val, predictions=out)
+    return model, evaluator.evaluate(out)
+
+
+class _ValidatorParams(Params):
+    seed = Param("seed", "fold shuffle seed", int)
+
+    def _candidates(self):
+        maps = self._maps
+        return maps if maps else [{}]
+
+
+class CrossValidator(_ValidatorParams, Estimator):
+    """k-fold cross-validation over a param grid.
+
+    >>> cv = CrossValidator(estimator=LinearRegression(),
+    ...                     estimatorParamMaps=grid,
+    ...                     evaluator=RegressionEvaluator(),
+    ...                     numFolds=3)
+    >>> best = cv.fit((x, y)).bestModel
+    """
+
+    numFolds = Param("numFolds", "number of folds", int)
+
+    def __init__(
+        self,
+        uid: str | None = None,
+        estimator: Estimator | None = None,
+        estimatorParamMaps: list[dict] | None = None,
+        evaluator: Evaluator | None = None,
+        numFolds: int = 3,
+        seed: int = 0,
+        collectSubModels: bool = False,
+    ):
+        super().__init__(uid)
+        self._estimator = estimator
+        self._maps = estimatorParamMaps or []
+        self._evaluator = evaluator
+        self._collect = collectSubModels
+        self._setDefault(numFolds=3, seed=0)
+        self._set(numFolds=numFolds, seed=seed)
+
+    def fit(self, dataset: Any) -> "CrossValidatorModel":
+        k = self.getOrDefault("numFolds")
+        if k < 2:
+            raise ValueError("numFolds must be >= 2")
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        idx = rng.permutation(n_rows(dataset))
+        folds = np.array_split(idx, k)
+        candidates = self._candidates()
+        metrics = np.zeros((len(candidates), k))
+        sub_models = [] if self._collect else None
+        for f in range(k):
+            val_idx = folds[f]
+            train_idx = np.concatenate([folds[i] for i in range(k) if i != f])
+            train = row_slice(dataset, train_idx)
+            val = row_slice(dataset, val_idx)
+            fold_models = []
+            for c, params in enumerate(candidates):
+                model, metric = _fit_and_eval(
+                    self._estimator, params, self._evaluator, train, val
+                )
+                metrics[c, f] = metric
+                fold_models.append(model)
+            if sub_models is not None:
+                sub_models.append(fold_models)
+        avg = metrics.mean(axis=1)
+        best_idx = int(np.argmax(avg) if self._evaluator.isLargerBetter() else np.argmin(avg))
+        best_est = self._estimator.copy()
+        if candidates[best_idx]:
+            best_est._set(**candidates[best_idx])
+        best_model = best_est.fit(dataset)
+        return CrossValidatorModel(
+            uid=self.uid,
+            bestModel=best_model,
+            avgMetrics=list(avg),
+            bestIndex=best_idx,
+            subModels=sub_models,
+        )
+
+
+class CrossValidatorModel(Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        bestModel: Model | None = None,
+        avgMetrics: list[float] | None = None,
+        bestIndex: int = 0,
+        subModels=None,
+    ):
+        super().__init__(uid)
+        self.bestModel = bestModel
+        self.avgMetrics = avgMetrics or []
+        self.bestIndex = bestIndex
+        self.subModels = subModels
+
+    def transform(self, dataset: Any) -> Any:
+        return self.bestModel.transform(dataset)
+
+
+class TrainValidationSplit(_ValidatorParams, Estimator):
+    """Single train/validation split over a param grid (cheaper than CV)."""
+
+    trainRatio = Param("trainRatio", "fraction of rows used for training", float)
+
+    def __init__(
+        self,
+        uid: str | None = None,
+        estimator: Estimator | None = None,
+        estimatorParamMaps: list[dict] | None = None,
+        evaluator: Evaluator | None = None,
+        trainRatio: float = 0.75,
+        seed: int = 0,
+    ):
+        super().__init__(uid)
+        self._estimator = estimator
+        self._maps = estimatorParamMaps or []
+        self._evaluator = evaluator
+        self._setDefault(trainRatio=0.75, seed=0)
+        self._set(trainRatio=trainRatio, seed=seed)
+
+    def fit(self, dataset: Any) -> "TrainValidationSplitModel":
+        ratio = self.getOrDefault("trainRatio")
+        if not 0.0 < ratio < 1.0:
+            raise ValueError("trainRatio must be in (0, 1)")
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        idx = rng.permutation(n_rows(dataset))
+        cut = int(len(idx) * ratio)
+        if cut == 0 or cut == len(idx):
+            raise ValueError("split produced an empty train or validation set")
+        train = row_slice(dataset, idx[:cut])
+        val = row_slice(dataset, idx[cut:])
+        candidates = self._candidates()
+        metrics = []
+        for params in candidates:
+            _, metric = _fit_and_eval(
+                self._estimator, params, self._evaluator, train, val
+            )
+            metrics.append(metric)
+        arr = np.asarray(metrics)
+        best_idx = int(np.argmax(arr) if self._evaluator.isLargerBetter() else np.argmin(arr))
+        best_est = self._estimator.copy()
+        if candidates[best_idx]:
+            best_est._set(**candidates[best_idx])
+        best_model = best_est.fit(dataset)
+        return TrainValidationSplitModel(
+            uid=self.uid,
+            bestModel=best_model,
+            validationMetrics=metrics,
+            bestIndex=best_idx,
+        )
+
+
+class TrainValidationSplitModel(Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        bestModel: Model | None = None,
+        validationMetrics: list[float] | None = None,
+        bestIndex: int = 0,
+    ):
+        super().__init__(uid)
+        self.bestModel = bestModel
+        self.validationMetrics = validationMetrics or []
+        self.bestIndex = bestIndex
+
+    def transform(self, dataset: Any) -> Any:
+        return self.bestModel.transform(dataset)

@@ -276,6 +276,13 @@ def explained_variance(singular_values: jax.Array, k: int) -> jax.Array:
     return (singular_values / safe_total)[:k]
 
 
+def randomized_profitable(n: int, k: int, *, oversample: int = 10) -> bool:
+    """Shared 'auto' solver rule: the HMT subspace iteration wins when n is
+    large and the captured subspace l = k + oversample is a small fraction of
+    it. Both PCA and TruncatedSVD dispatch through this single predicate."""
+    return n >= 1024 and (k + oversample) * 8 <= n
+
+
 def pca_fit_from_cov(
     cov: jax.Array,
     k: int,
@@ -297,7 +304,9 @@ def pca_fit_from_cov(
     n = cov.shape[0]
     if solver == "auto":
         solver = (
-            "randomized" if n >= 1024 and (k + oversample) * 8 <= n else "full"
+            "randomized"
+            if randomized_profitable(n, k, oversample=oversample)
+            else "full"
         )
     if solver == "randomized":
         u, s, tail_count = randomized_eigh_descending(

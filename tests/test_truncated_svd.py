@@ -67,6 +67,11 @@ class TestFit:
         with pytest.raises(ValueError):
             TruncatedSVD().setSolver("eig")
 
+    def test_bad_solver_via_kwargs_raises_in_fit(self, x):
+        # constructor kwargs bypass setSolver validation; fit must still fail
+        with pytest.raises(ValueError, match="unknown solver"):
+            TruncatedSVD(solver="full").setInputCol("f").setK(2).fit(x)
+
 
 class TestModel:
     def test_transform_projects(self, x):

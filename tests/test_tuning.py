@@ -1,0 +1,228 @@
+"""Model-selection layer tests: grids, evaluators, CV, train/val split."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    BinaryClassificationEvaluator,
+    ClusteringEvaluator,
+    CrossValidator,
+    KMeans,
+    LinearRegression,
+    LogisticRegression,
+    ParamGridBuilder,
+    RegressionEvaluator,
+    TrainValidationSplit,
+)
+
+
+class TestParamGridBuilder:
+    def test_cartesian_product(self):
+        grid = (
+            ParamGridBuilder()
+            .addGrid("regParam", [0.0, 0.1, 1.0])
+            .addGrid("fitIntercept", [True, False])
+            .build()
+        )
+        assert len(grid) == 6
+        assert {m["regParam"] for m in grid} == {0.0, 0.1, 1.0}
+
+    def test_base_on(self):
+        grid = (
+            ParamGridBuilder()
+            .baseOn(maxIter=7)
+            .addGrid("regParam", [0.0, 0.1])
+            .build()
+        )
+        assert all(m["maxIter"] == 7 for m in grid)
+
+    def test_param_object_key(self):
+        grid = ParamGridBuilder().addGrid(LinearRegression.regParam, [0.5]).build()
+        assert grid == [{"regParam": 0.5}]
+
+
+class TestRegressionEvaluator:
+    def test_metrics(self):
+        y = np.array([1.0, 2.0, 3.0, 4.0])
+        p = np.array([1.5, 2.0, 2.5, 4.0])
+        ev = RegressionEvaluator()
+        assert abs(ev.evaluate((None, y), predictions=p) - np.sqrt(0.125)) < 1e-12
+        assert (
+            abs(ev.setMetricName("mae").evaluate((None, y), predictions=p) - 0.25)
+            < 1e-12
+        )
+        r2 = ev.setMetricName("r2").evaluate((None, y), predictions=p)
+        assert 0.8 < r2 < 1.0
+        assert ev.isLargerBetter() and not ev.setMetricName("rmse").isLargerBetter()
+
+    def test_bad_metric(self):
+        with pytest.raises(ValueError):
+            RegressionEvaluator().setMetricName("mape")
+
+
+class TestBinaryEvaluator:
+    def test_auc_perfect_and_random(self, rng):
+        y = np.array([0, 0, 1, 1], dtype=float)
+        ev = BinaryClassificationEvaluator()
+        assert ev.evaluate((None, y), predictions=np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+        assert ev.evaluate((None, y), predictions=np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+        # ties → 0.5 contribution each
+        assert ev.evaluate((None, y), predictions=np.zeros(4)) == 0.5
+
+    def test_auc_matches_sklearn_formula(self, rng):
+        y = (rng.normal(size=200) > 0).astype(float)
+        p = y * 0.3 + rng.normal(size=200) * 0.5
+        ev = BinaryClassificationEvaluator()
+        auc = ev.evaluate((None, y), predictions=p)
+        # brute-force pairwise
+        pos, neg = p[y == 1], p[y == 0]
+        brute = np.mean(
+            (pos[:, None] > neg[None, :]) + 0.5 * (pos[:, None] == neg[None, :])
+        )
+        assert abs(auc - brute) < 1e-12
+
+    def test_accuracy(self):
+        y = np.array([0, 1, 1, 0], dtype=float)
+        ev = BinaryClassificationEvaluator().setMetricName("accuracy")
+        assert ev.evaluate((None, y), predictions=np.array([0.1, 0.9, 0.4, 0.2])) == 0.75
+
+
+class TestClusteringEvaluator:
+    def test_well_separated_beats_random(self, rng):
+        a = rng.normal(size=(50, 4)) + 10
+        b = rng.normal(size=(50, 4)) - 10
+        x = np.vstack([a, b])
+        good = np.array([0] * 50 + [1] * 50)
+        bad = rng.integers(0, 2, 100)
+        ev = ClusteringEvaluator()
+        s_good = ev.evaluate(x, predictions=good)
+        s_bad = ev.evaluate(x, predictions=bad)
+        assert s_good > 0.9 > s_bad
+
+
+class TestCrossValidator:
+    def test_selects_correct_reg_param(self, rng):
+        # y depends linearly on x: the un-regularized candidate must win
+        x = rng.normal(size=(300, 6))
+        w = rng.normal(size=6)
+        y = x @ w + 0.01 * rng.normal(size=300)
+        grid = ParamGridBuilder().addGrid("regParam", [0.0, 10.0]).build()
+        cv = CrossValidator(
+            estimator=LinearRegression(),
+            estimatorParamMaps=grid,
+            evaluator=RegressionEvaluator(),
+            numFolds=3,
+        )
+        cvm = cv.fit((x, y))
+        assert cvm.bestIndex == 0
+        assert len(cvm.avgMetrics) == 2
+        assert cvm.avgMetrics[0] < cvm.avgMetrics[1]
+        np.testing.assert_allclose(cvm.bestModel.coefficients, w, atol=0.01)
+
+    def test_transform_delegates_to_best(self, rng):
+        x = rng.normal(size=(200, 4))
+        y = x @ np.arange(1.0, 5.0)
+        cv = CrossValidator(
+            estimator=LinearRegression(),
+            estimatorParamMaps=[{}],
+            evaluator=RegressionEvaluator(),
+            numFolds=2,
+        )
+        cvm = cv.fit((x, y))
+        pred = np.asarray(cvm.transform(x))
+        np.testing.assert_allclose(pred, y, atol=1e-5)
+
+    def test_classification_auc(self, rng):
+        x = rng.normal(size=(400, 5))
+        y = (x[:, 0] + 0.3 * rng.normal(size=400) > 0).astype(float)
+        grid = ParamGridBuilder().addGrid("regParam", [0.01, 100.0]).build()
+        cv = CrossValidator(
+            estimator=LogisticRegression(),
+            estimatorParamMaps=grid,
+            evaluator=BinaryClassificationEvaluator(),
+            numFolds=2,
+        )
+        cvm = cv.fit((x, y))
+        assert cvm.bestIndex == 0  # heavy L2 kills the signal
+
+    def test_collect_sub_models(self, rng):
+        x = rng.normal(size=(100, 3))
+        y = x @ np.ones(3)
+        cv = CrossValidator(
+            estimator=LinearRegression(),
+            estimatorParamMaps=[{}, {"regParam": 0.1}],
+            evaluator=RegressionEvaluator(),
+            numFolds=2,
+            collectSubModels=True,
+        )
+        cvm = cv.fit((x, y))
+        assert len(cvm.subModels) == 2  # folds
+        assert len(cvm.subModels[0]) == 2  # candidates
+
+    def test_bad_folds(self):
+        with pytest.raises(ValueError):
+            CrossValidator(
+                estimator=LinearRegression(),
+                evaluator=RegressionEvaluator(),
+                numFolds=1,
+            ).fit((np.zeros((4, 2)), np.zeros(4)))
+
+    def test_unsupervised_kmeans_grid(self, rng):
+        a = rng.normal(size=(60, 3)) + 8
+        b = rng.normal(size=(60, 3)) - 8
+        x = np.vstack([a, b]).astype(np.float32)
+        grid = ParamGridBuilder().addGrid("k", [2, 6]).build()
+        cv = CrossValidator(
+            estimator=KMeans().setSeed(0),
+            estimatorParamMaps=grid,
+            evaluator=ClusteringEvaluator(),
+            numFolds=2,
+        )
+        cvm = cv.fit(x)
+        assert cvm.bestIndex == 0  # true structure has 2 clusters
+
+
+class TestTrainValidationSplit:
+    def test_basic(self, rng):
+        x = rng.normal(size=(300, 5))
+        w = rng.normal(size=5)
+        y = x @ w
+        tvs = TrainValidationSplit(
+            estimator=LinearRegression(),
+            estimatorParamMaps=ParamGridBuilder().addGrid("regParam", [0.0, 50.0]).build(),
+            evaluator=RegressionEvaluator(),
+            trainRatio=0.7,
+        )
+        m = tvs.fit((x, y))
+        assert m.bestIndex == 0
+        assert len(m.validationMetrics) == 2
+        np.testing.assert_allclose(m.bestModel.coefficients, w, atol=1e-4)
+
+    def test_bad_ratio(self):
+        with pytest.raises(ValueError):
+            TrainValidationSplit(
+                estimator=LinearRegression(),
+                evaluator=RegressionEvaluator(),
+                trainRatio=1.5,
+            ).fit((np.zeros((4, 2)), np.zeros(4)))
+
+
+class TestContainers:
+    def test_pandas_cv(self, rng):
+        pd = pytest.importorskip("pandas")
+        x = rng.normal(size=(120, 3))
+        y = x @ np.ones(3) + 0.01 * rng.normal(size=120)
+        df = pd.DataFrame(
+            {"features": list(x), "label": y}
+        )
+        cv = CrossValidator(
+            estimator=LinearRegression()
+            .setFeaturesCol("features")
+            .setLabelCol("label")
+            .setPredictionCol("prediction"),
+            estimatorParamMaps=[{}],
+            evaluator=RegressionEvaluator(),
+            numFolds=2,
+        )
+        cvm = cv.fit(df)
+        assert cvm.avgMetrics[0] < 0.1
