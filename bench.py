@@ -56,17 +56,25 @@ def main() -> None:
     float(jnp.sum(x[0]))  # force materialization
 
     def fit_consumed(a):
-        # Precision.HIGH: 3-pass bf16 split for the Gram — measured min
-        # eigenvector cosine vs an f64 CPU oracle is 0.99999999984 for THIS
-        # uncentered program on this workload class (200k×512 validation run
-        # on the real chip; the refined eigh recovers the decomposition),
-        # well above the 0.9999 target, at ~1.7x the HIGHEST-precision speed.
-        # mean_centering=False is the reference's observable fit (its
-        # centering is a TODO stub, RapidsRowMatrix.scala:111-117): the
-        # measured program is exactly uncentered Gram + eig, matching what
-        # the A100 proxy models — and skips a second HBM pass over X.
-        pc, ev = L.pca_fit_local(
-            a, K, mean_centering=False, precision=lax.Precision.HIGH
+        # Precision.HIGH: 3-pass bf16 split for the Gram — at the measured
+        # MXU roofline (16.7 ms of the total; a hand-written Pallas
+        # upper-triangle kernel reached 23 ms despite 37.5% fewer flops —
+        # see ops/pallas_gram.py). Decomposition: HMT randomized subspace
+        # iteration with oversample=20 (k=50 ≪ n=512 makes the O(n²·l)
+        # solver strictly profitable vs the O(n³)+refinement eigh; ~6.7 ms
+        # saved). Measured min eigenvector cosine vs an f64 CPU oracle for
+        # THIS uncentered program on this workload class: 0.9999999980
+        # (200k×512 validation run on the real chip), well above the 0.9999
+        # target. mean_centering=False is the reference's observable fit
+        # (its centering is a TODO stub, RapidsRowMatrix.scala:111-117):
+        # the measured program is exactly uncentered Gram + top-k eig,
+        # matching what the A100 proxy models — and skips a second HBM pass
+        # over X.
+        pc, ev = L.pca_fit_from_cov(
+            L.gram(a, precision=lax.Precision.HIGH),
+            K,
+            solver="randomized",
+            oversample=20,
         )
         return jnp.sum(pc) + jnp.sum(ev)
 

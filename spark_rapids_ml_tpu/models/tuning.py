@@ -42,9 +42,16 @@ def n_rows(dataset: Any) -> int:
         return len(np.asarray(dataset[0]))
     if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
         return dataset.num_rows
+    if isinstance(dataset, columnar.PartitionedDataset):
+        return sum(m.shape[0] for m in dataset.matrices())
     if hasattr(dataset, "iloc"):
         return len(dataset)
-    return len(np.asarray(dataset))
+    arr = np.asarray(dataset)
+    if arr.ndim == 0:
+        raise TypeError(
+            f"unsupported dataset container for row splitting: {type(dataset).__name__}"
+        )
+    return len(arr)
 
 
 def row_slice(dataset: Any, idx: np.ndarray) -> Any:
@@ -54,9 +61,18 @@ def row_slice(dataset: Any, idx: np.ndarray) -> Any:
         return (np.asarray(dataset[0])[idx], np.asarray(dataset[1])[idx])
     if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
         return dataset.take(pa.array(idx))
+    if isinstance(dataset, columnar.PartitionedDataset):
+        return columnar.PartitionedDataset(
+            [dataset.collect_matrix()[idx]], dataset.input_col
+        )
     if hasattr(dataset, "iloc"):
         return dataset.iloc[idx]
-    return np.asarray(dataset)[idx]
+    arr = np.asarray(dataset)
+    if arr.ndim == 0:
+        raise TypeError(
+            f"unsupported dataset container for row splitting: {type(dataset).__name__}"
+        )
+    return arr[idx]
 
 
 def _labels_of(dataset: Any, label_col: str) -> np.ndarray:
@@ -178,15 +194,13 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         if len(pos) == 0 or len(neg) == 0:
             return 0.5
         # Mann–Whitney U with tie correction: AUC = P(score⁺ > score⁻)
-        order = np.argsort(np.concatenate([pos, neg]), kind="mergesort")
-        ranks = np.empty(len(order))
-        ranks[order] = np.arange(1, len(order) + 1)
-        # average ranks over ties
         allp = np.concatenate([pos, neg])
+        order = np.argsort(allp, kind="mergesort")
         sorted_p = allp[order]
         _, inv, counts = np.unique(sorted_p, return_inverse=True, return_counts=True)
         cum = np.cumsum(counts)
-        avg_rank_of_group = cum - (counts - 1) / 2.0
+        avg_rank_of_group = cum - (counts - 1) / 2.0  # tie-averaged ranks
+        ranks = np.empty(len(order))
         ranks[order] = avg_rank_of_group[inv]
         u = ranks[: len(pos)].sum() - len(pos) * (len(pos) + 1) / 2.0
         return float(u / (len(pos) * len(neg)))
@@ -217,7 +231,10 @@ class ClusteringEvaluator(Evaluator):
         if len(x) > cap:
             sel = np.random.default_rng(0).choice(len(x), cap, replace=False)
             x, p = x[sel], p[sel]
-        d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        # Gram identity keeps the pairwise pass at one [rows, rows] matrix
+        # (the [rows, rows, dims] broadcast would be GBs at default maxRows).
+        sq = (x * x).sum(-1)
+        d2 = np.maximum(sq[:, None] + sq[None, :] - 2.0 * (x @ x.T), 0.0)
         labels = np.unique(p)
         if len(labels) < 2:
             return 0.0
@@ -225,10 +242,10 @@ class ClusteringEvaluator(Evaluator):
         for i in range(len(x)):
             same = p == p[i]
             same[i] = False
-            a = d2[i, same].mean() if same.any() else 0.0
-            b = min(
-                d2[i, p == c].mean() for c in labels if c != p[i]
-            )
+            if not same.any():
+                continue  # singleton cluster: conventional silhouette is 0
+            a = d2[i, same].mean()
+            b = min(d2[i, p == c].mean() for c in labels if c != p[i])
             sil[i] = 0.0 if max(a, b) == 0 else (b - a) / max(a, b)
         return float(sil.mean())
 
@@ -243,6 +260,22 @@ def _fit_and_eval(estimator, params, evaluator, train, val):
     if params:
         est._set(**params)
     model = est.fit(train)
+    # AUC ranks SCORES; a thresholded 0/1 prediction column collapses it to
+    # balanced accuracy. When the model exposes a probability surface
+    # (LogisticRegression), rank that instead — the Spark evaluator makes
+    # the same choice by reading rawPrediction rather than prediction.
+    if (
+        isinstance(evaluator, BinaryClassificationEvaluator)
+        and evaluator.getOrDefault("metricName") == "areaUnderROC"
+        and hasattr(model, "predict_proba_matrix")
+    ):
+        feats = (
+            np.asarray(val[0])
+            if isinstance(val, tuple)
+            else columnar.extract_matrix(val, model.getOrDefault("featuresCol"))
+        )
+        scores = model.predict_proba_matrix(feats)
+        return model, evaluator.evaluate(val, predictions=scores)
     if isinstance(val, tuple):
         pred = model.transform(val[0])
         return model, evaluator.evaluate(val, predictions=np.asarray(pred))

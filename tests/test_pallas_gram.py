@@ -7,7 +7,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from spark_rapids_ml_tpu.ops.pallas_gram import fused_gram_moments
+from spark_rapids_ml_tpu.ops.pallas_gram import (
+    fused_gram_moments,
+    symmetric_gram_moments,
+)
 
 
 def _check(x, **kw):
@@ -40,6 +43,36 @@ class TestFusedGram:
     def test_multi_col_blocks(self, rng):
         # exercises the off-diagonal (i != j) tiles and the i==0 moment wave
         _check(rng.normal(size=(512, 384)), block_rows=256, block_cols=128)
+
+    def test_symmetric_variant_matches(self, rng):
+        """The upper-triangle-skip kernel must agree with the full one and
+        produce an exactly symmetric Gram (mirrored, not recomputed)."""
+        x = rng.normal(size=(700, 300)).astype(np.float32)
+        g, cs, sq = symmetric_gram_moments(
+            jnp.asarray(x), block_rows=256, block_cols=128, interpret=True
+        )
+        g = np.asarray(g)
+        xf = x.astype(np.float64)
+        scale = np.abs(xf.T @ xf).max()
+        # off-diagonal blocks are mirrored bit-exactly; diagonal blocks are
+        # computed directly and the hi·lo / lo·hi accumulation orders differ
+        # by f32 rounding, so symmetry there is to rounding only
+        np.testing.assert_allclose(g, g.T, atol=1e-5 * scale)
+        np.testing.assert_array_equal(g[128:, :128], g[:128, 128:].T)
+        np.testing.assert_allclose(g, xf.T @ xf, atol=3e-5 * scale)
+        np.testing.assert_allclose(np.asarray(cs), xf.sum(0), rtol=1e-4, atol=6e-3)
+        np.testing.assert_allclose(
+            np.asarray(sq), (xf**2).sum(0), rtol=1e-4, atol=6e-3
+        )
+
+    def test_symmetric_single_tile(self, rng):
+        x = rng.normal(size=(512, 128)).astype(np.float32)
+        g, _, _ = symmetric_gram_moments(
+            jnp.asarray(x), block_rows=256, block_cols=128, interpret=True
+        )
+        xf = x.astype(np.float64)
+        scale = np.abs(xf.T @ xf).max()
+        np.testing.assert_allclose(np.asarray(g), xf.T @ xf, atol=3e-5 * scale)
 
     def test_split_precision_beats_bf16(self, rng):
         """The hi+lo split must be far more accurate than plain bf16."""

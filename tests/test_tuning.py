@@ -100,6 +100,44 @@ class TestClusteringEvaluator:
         assert s_good > 0.9 > s_bad
 
 
+class TestClusteringEvaluatorEdgeCases:
+    def test_singletons_do_not_win(self, rng):
+        """Every-point-its-own-cluster must not score 1.0 (singletons get 0,
+        the sklearn/Spark convention) — else fragmented k wins model selection."""
+        x = np.vstack(
+            [rng.normal(size=(40, 3)) + 9, rng.normal(size=(40, 3)) - 9]
+        )
+        ev = ClusteringEvaluator()
+        fragmented = ev.evaluate(x, predictions=np.arange(80))
+        true_split = ev.evaluate(x, predictions=np.array([0] * 40 + [1] * 40))
+        assert fragmented == 0.0
+        assert true_split > fragmented
+
+    def test_large_subsample_memory(self, rng):
+        """maxRows at the default with wide features must not allocate a
+        [rows, rows, dims] broadcast (the Gram-identity path keeps it 2-D)."""
+        x = rng.normal(size=(3000, 256)).astype(np.float32)
+        p = (x[:, 0] > 0).astype(int)
+        s = ClusteringEvaluator().evaluate(x, predictions=p)
+        assert np.isfinite(s)
+
+
+class TestAUCUsesScores:
+    def test_proba_surface_preferred_over_thresholded(self, rng):
+        """CV's AUC must rank probabilities, not thresholded 0/1 labels."""
+        from spark_rapids_ml_tpu.models.tuning import _fit_and_eval
+
+        x = rng.normal(size=(400, 4))
+        y = (x[:, 0] + rng.normal(size=400) > 0).astype(float)
+        ev = BinaryClassificationEvaluator()
+        model, auc_scores = _fit_and_eval(
+            LogisticRegression(), {}, ev, (x[:300], y[:300]), (x[300:], y[300:])
+        )
+        hard = (model.predict_proba_matrix(x[300:]) >= 0.5).astype(float)
+        auc_hard = ev.evaluate((None, y[300:]), predictions=hard)
+        assert auc_scores > auc_hard  # score ranking strictly beats 0/1 ties
+
+
 class TestCrossValidator:
     def test_selects_correct_reg_param(self, rng):
         # y depends linearly on x: the un-regularized candidate must win
