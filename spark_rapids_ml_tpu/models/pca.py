@@ -74,7 +74,7 @@ class PCAParams(HasInputCol, HasOutputCol):
         "explainedVariance uses a trace-based tail estimate), 'svd' "
         "(direct TSQR→SVD(R): never forms XᵀX, works at cond(X) instead of "
         "cond(X)² — best for ill-conditioned data), or 'auto' (randomized "
-        "when n ≥ 1024 and k ≪ n)",
+        "when n ≥ 256 and k + oversample ≤ n/4)",
         str,
     )
 

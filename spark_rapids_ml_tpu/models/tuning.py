@@ -55,7 +55,11 @@ def n_rows(dataset: Any) -> int:
 
 
 def row_slice(dataset: Any, idx: np.ndarray) -> Any:
-    """Take rows by integer index, preserving the container type."""
+    """Take rows by integer index, preserving the container type.
+
+    PartitionedDataset callers: collect once (``_collect_for_split``) before
+    repeated slicing — this branch re-concatenates the partitions per call.
+    """
     idx = np.asarray(idx)
     if isinstance(dataset, tuple) and len(dataset) == 2:
         return (np.asarray(dataset[0])[idx], np.asarray(dataset[1])[idx])
@@ -73,6 +77,17 @@ def row_slice(dataset: Any, idx: np.ndarray) -> Any:
             f"unsupported dataset container for row splitting: {type(dataset).__name__}"
         )
     return arr[idx]
+
+
+def _collect_for_split(dataset: Any) -> Any:
+    """Normalize containers that are expensive to slice repeatedly: a
+    PartitionedDataset is collected to one matrix ONCE per fit (k-fold CV
+    slices 2k times; re-concatenating every time would copy the whole
+    dataset O(k) times). Partitioning is a fit-time distribution detail the
+    candidate estimators re-establish via ``num_partitions`` anyway."""
+    if isinstance(dataset, columnar.PartitionedDataset):
+        return dataset.collect_matrix()
+    return dataset
 
 
 def _labels_of(dataset: Any, label_col: str) -> np.ndarray:
@@ -327,6 +342,7 @@ class CrossValidator(_ValidatorParams, Estimator):
         k = self.getOrDefault("numFolds")
         if k < 2:
             raise ValueError("numFolds must be >= 2")
+        dataset = _collect_for_split(dataset)
         rng = np.random.default_rng(self.getOrDefault("seed"))
         idx = rng.permutation(n_rows(dataset))
         folds = np.array_split(idx, k)
@@ -406,6 +422,7 @@ class TrainValidationSplit(_ValidatorParams, Estimator):
         ratio = self.getOrDefault("trainRatio")
         if not 0.0 < ratio < 1.0:
             raise ValueError("trainRatio must be in (0, 1)")
+        dataset = _collect_for_split(dataset)
         rng = np.random.default_rng(self.getOrDefault("seed"))
         idx = rng.permutation(n_rows(dataset))
         cut = int(len(idx) * ratio)

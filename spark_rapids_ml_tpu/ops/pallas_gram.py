@@ -43,6 +43,34 @@ from jax.experimental import pallas as pl
 _CONTRACT_ROWS = (((0,), (0,)), ((), ()))  # aᵀb for row-major tiles
 
 
+def _pad_and_split(x, block_rows, block_cols):
+    """Shared kernel prologue: f32 cast, block padding, hi/lo bf16 split.
+
+    Returns (hi, lo, n) where n is the pre-padding column count. Zero
+    padding is exact for Gram/moment reductions; hi + lo carries ~16
+    mantissa bits of the f32 input.
+    """
+    if x.dtype != jnp.float32:
+        x = x.astype(jnp.float32)
+    n = x.shape[1]
+    pr = (-x.shape[0]) % block_rows
+    pn = (-n) % block_cols
+    if pr or pn:
+        x = jnp.pad(x, ((0, pr), (0, pn)))
+    hi = x.astype(jnp.bfloat16)
+    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo, n
+
+
+def _trim(gram, colsum, sumsq, n):
+    """Shared epilogue: drop the column padding from all three outputs."""
+    if gram.shape[0] != n:
+        gram = gram[:n, :n]
+        colsum = colsum[:, :n]
+        sumsq = sumsq[:, :n]
+    return gram, colsum[0], sumsq[0]
+
+
 def _fused_kernel(hi_i, lo_i, hi_j, lo_j, gram_ref, colsum_ref, sumsq_ref):
     i = pl.program_id(0)
     r = pl.program_id(2)
@@ -136,19 +164,10 @@ def symmetric_gram_moments(
     Fits when the n×n f32 Gram + two bf16 row blocks fit VMEM: n ≤ ~1280 at
     the defaults. Callers gate on n and fall back to the XLA path above.
     """
-    if x.dtype != jnp.float32:
-        x = x.astype(jnp.float32)
-    rows, n = x.shape
-    pr = (-rows) % block_rows
-    pn = (-n) % block_cols
-    if pr or pn:
-        x = jnp.pad(x, ((0, pr), (0, pn)))
-    rows_p, n_p = x.shape
+    hi, lo, n = _pad_and_split(x, block_rows, block_cols)
+    rows_p, n_p = hi.shape
     nt = n_p // block_cols
     n_row_blocks = rows_p // block_rows
-
-    hi = x.astype(jnp.bfloat16)
-    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
 
     row_block = pl.BlockSpec((block_rows, n_p), lambda r: (r, 0))
     full_out = pl.BlockSpec((n_p, n_p), lambda r: (0, 0))
@@ -174,11 +193,7 @@ def symmetric_gram_moments(
         interpret=interpret,
     )(hi, lo)
 
-    if pn:
-        gram = gram[:n, :n]
-        colsum = colsum[:, :n]
-        sumsq = sumsq[:, :n]
-    return gram, colsum[0], sumsq[0]
+    return _trim(gram, colsum, sumsq, n)
 
 
 def fused_gram_moments(
@@ -194,17 +209,8 @@ def fused_gram_moments(
     caller keeps true row counts (same contract as ops.linalg.GramStats).
     ``interpret=True`` runs the kernel on CPU for tests.
     """
-    if x.dtype != jnp.float32:
-        x = x.astype(jnp.float32)
-    rows, n = x.shape
-    pr = (-rows) % block_rows
-    pn = (-n) % block_cols
-    if pr or pn:
-        x = jnp.pad(x, ((0, pr), (0, pn)))
-    rows_p, n_p = x.shape
-
-    hi = x.astype(jnp.bfloat16)
-    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    hi, lo, n = _pad_and_split(x, block_rows, block_cols)
+    rows_p, n_p = hi.shape
 
     grid = (n_p // block_cols, n_p // block_cols, rows_p // block_rows)
     row_tile_i = pl.BlockSpec((block_rows, block_cols), lambda i, j, r: (r, i))
@@ -232,8 +238,4 @@ def fused_gram_moments(
         interpret=interpret,
     )(hi, lo, hi, lo)
 
-    if pn:
-        gram = gram[:n, :n]
-        colsum = colsum[:, :n]
-        sumsq = sumsq[:, :n]
-    return gram, colsum[0], sumsq[0]
+    return _trim(gram, colsum, sumsq, n)

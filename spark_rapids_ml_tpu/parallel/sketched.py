@@ -142,6 +142,100 @@ def sketched_pca_fit(
     return _fit(x)
 
 
+def sharded_column_means(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Feature-sharded column means of a (data, feat)-sharded X — the μ a
+    centered sketched fit needs at transform time, spec ``P(feat)``."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(DATA_AXIS, FEAT_AXIS),
+        out_specs=P(FEAT_AXIS),
+        check_rep=False,
+    )
+    def _mean(xl):
+        s = lax.psum(jnp.sum(xl, axis=0), DATA_AXIS)
+        c = lax.psum(jnp.asarray(xl.shape[0], xl.dtype), DATA_AXIS)
+        return s / c
+
+    return _mean(x)
+
+
+def sharded_project(
+    x: jax.Array,
+    components: jax.Array,
+    mesh: Mesh,
+    *,
+    mean: jax.Array | None = None,
+    precision=L.DEFAULT_PRECISION,
+) -> jax.Array:
+    """Transform for feature-sharded components: Y = (X−μ)·V, no replication.
+
+    ``x`` is [rows, n] sharded (data, feat); ``components`` is [n, k] sharded
+    by block-row over ``feat`` (exactly what ``sketched_pca_fit`` emits).
+    Each device contracts its feature block — [r_l, c_l]·[c_l, k] on the MXU
+    — and one psum over ``feat`` completes the projection. Output [rows, k]
+    is data-sharded. Completes the large-n story end-to-end: neither fit nor
+    transform ever holds an n-sized replicated object.
+
+    ``mean``: REQUIRED when the components came from a
+    ``mean_centering=True`` fit — a feature-sharded [n] vector (spec
+    ``P(feat)``, from ``sharded_column_means`` over the training data);
+    omitting it silently offsets every projection by μ·V. The centering
+    rides the same psum: (X−μ)·V = Σⱼ (Xⱼ−μⱼ)·Vⱼ.
+
+    Reference contrast: its transform re-uploads the full [n, k] pc to the
+    device on EVERY batch (rapidsml_jni.cu:85, SURVEY.md §3.2) — here the
+    components never leave the mesh, let alone get replicated.
+    """
+    in_specs = [P(DATA_AXIS, FEAT_AXIS), P(FEAT_AXIS, None)]
+    if mean is not None:
+        in_specs.append(P(FEAT_AXIS))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P(DATA_AXIS, None),
+        check_rep=False,
+    )
+    def _proj(xl, vl, *maybe_mu):
+        if maybe_mu:
+            xl = xl - maybe_mu[0][None, :]
+        return lax.psum(jnp.matmul(xl, vl, precision=precision), FEAT_AXIS)
+
+    args = (x, components) if mean is None else (x, components, mean)
+    return _proj(*args)
+
+
+def make_sharded_project(mesh: Mesh, *, centered: bool = False):
+    """jit-compile ``sharded_project`` with mesh shardings bound.
+
+    With ``centered=True`` the returned function takes ``(x, components,
+    mean)`` — use for components from a ``mean_centering=True`` fit.
+    """
+    in_sh = [
+        NamedSharding(mesh, P(DATA_AXIS, FEAT_AXIS)),
+        NamedSharding(mesh, P(FEAT_AXIS, None)),
+    ]
+    if centered:
+        in_sh.append(NamedSharding(mesh, P(FEAT_AXIS)))
+
+        def f(x, components, mean):
+            return sharded_project(x, components, mesh, mean=mean)
+
+    else:
+
+        def f(x, components):
+            return sharded_project(x, components, mesh)
+
+    return jax.jit(
+        f,
+        in_shardings=tuple(in_sh),
+        out_shardings=NamedSharding(mesh, P(DATA_AXIS, None)),
+    )
+
+
 def make_sketched_fit(
     mesh: Mesh,
     k: int,

@@ -165,13 +165,21 @@ class TestRandomizedSolver:
             L.pca_fit_from_cov(cov, 3, solver="bogus")
 
     def test_auto_picks_full_for_small_n(self, rng):
-        """auto == full for n < 1024 — bit-identical output."""
+        """auto == full below the measured profitability threshold —
+        bit-identical output."""
         x = _random(rng, rows=100, n=16)
         cov = jnp.asarray(x.T @ x)
         pc_a, ev_a = L.pca_fit_from_cov(cov, 3, solver="auto")
         pc_f, ev_f = L.pca_fit_from_cov(cov, 3, solver="full")
         np.testing.assert_array_equal(np.asarray(pc_a), np.asarray(pc_f))
         np.testing.assert_array_equal(np.asarray(ev_a), np.asarray(ev_f))
+
+    def test_profitability_rule_covers_bench_shape(self):
+        """The measured win (v5e, n=512, k=50, oversample=20) must be inside
+        the shared auto rule, else solver='auto' leaves it on the table."""
+        assert L.randomized_profitable(512, 50, oversample=20)
+        assert not L.randomized_profitable(128, 50)  # l > n/4
+        assert not L.randomized_profitable(100, 10)  # n below floor
 
     def test_jittable_with_static_solver(self, rng):
         x = _random(rng, rows=100, n=16)

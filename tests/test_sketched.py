@@ -118,6 +118,41 @@ class TestSketchedPCA:
         cos = np.abs(np.sum(np.asarray(pc) * v, axis=0))
         assert cos.min() > 0.9999
 
+    def test_sharded_project_end_to_end(self, mesh42, rng):
+        """fit + transform with NOTHING n-sized replicated anywhere."""
+        x = _decaying(rng, 512, 64)
+        xs = jax.device_put(x, M.data_sharding(mesh42, feature_sharded=True))
+        pc, _ = SK.make_sketched_fit(mesh42, 5)(xs)
+        out = SK.make_sharded_project(mesh42)(xs, pc)
+        # oracle: dense projection with the gathered components
+        np.testing.assert_allclose(
+            np.asarray(out), x @ np.asarray(pc), atol=1e-8
+        )
+        # output is data-sharded [rows/4, k] per shard
+        assert {s.data.shape for s in out.addressable_shards} == {(128, 5)}
+
+    def test_sharded_project_centered(self, mesh42, rng):
+        """Components from a centered fit must project (X−μ)·V, with μ
+        feature-sharded — never replicated."""
+        x = _decaying(rng, 512, 64) + 7.0
+        xs = jax.device_put(x, M.data_sharding(mesh42, feature_sharded=True))
+        pc, _ = SK.sketched_pca_fit(xs, 4, mesh42, mean_centering=True)
+        mu = SK.sharded_column_means(xs, mesh42)
+        np.testing.assert_allclose(np.asarray(mu), x.mean(0), rtol=1e-12)
+        out = SK.make_sharded_project(mesh42, centered=True)(xs, pc, mu)
+        expect = (x - x.mean(0)) @ np.asarray(pc)
+        np.testing.assert_allclose(np.asarray(out), expect, atol=1e-7)
+
+    def test_sharded_project_matches_dense(self, mesh42, rng):
+        x = rng.normal(size=(256, 64))
+        v = rng.normal(size=(64, 7))
+        xs = jax.device_put(x, M.data_sharding(mesh42, feature_sharded=True))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        vs = jax.device_put(v, NamedSharding(mesh42, P(M.FEAT_AXIS, None)))
+        out = SK.sharded_project(xs, vs, mesh42)
+        np.testing.assert_allclose(np.asarray(out), x @ v, atol=1e-9)
+
     def test_seed_determinism(self, mesh42, rng):
         x = _decaying(rng, 256, 64)
         xs = jax.device_put(x, M.data_sharding(mesh42, feature_sharded=True))
