@@ -32,6 +32,13 @@ class _KMeansParams(HasInputCol, HasOutputCol):
     tol = Param("tol", "convergence tolerance on max centroid movement", float)
     seed = Param("seed", "random seed", int)
     initMode = Param("initMode", "'k-means++' or 'random'", str)
+    weightCol = Param(
+        "weightCol",
+        "optional instance-weight column (Spark ML weightCol contract); "
+        "weighted Lloyd sums/counts/cost ride the same per-row vector that "
+        "masks shape-bucketing padding",
+        str,
+    )
 
     def __init__(self, uid: str | None = None):
         super().__init__(uid)
@@ -71,10 +78,22 @@ class KMeans(_KMeansParams, Estimator):
     def setInitMode(self, value: str) -> "KMeans":
         return self._set(initMode=value)
 
-    def _init_centers(self, ds: columnar.PartitionedDataset, k: int) -> np.ndarray:
+    def setWeightCol(self, value: str) -> "KMeans":
+        return self._set(weightCol=value)
+
+    def _init_centers(
+        self,
+        mats: list[np.ndarray],
+        k: int,
+        part_weights=None,
+    ) -> np.ndarray:
         rng = np.random.default_rng(self.getSeed())
-        # bounded sample across partitions for seeding
-        mats = list(ds.matrices())
+        # bounded sample across partitions for seeding; zero-weight rows are
+        # excluded instances and must never seed a center (a zero-count
+        # center would survive Lloyd updates unchanged)
+        if part_weights is not None:
+            mats = [m[w > 0] for m, w in zip(mats, part_weights)]
+            mats = [m for m in mats if len(m)]
         total = sum(len(m) for m in mats)
         take = min(total, _MAX_INIT_SAMPLE)
         sample = np.concatenate(
@@ -93,6 +112,7 @@ class KMeans(_KMeansParams, Estimator):
         dataset: Any,
         num_partitions: int | None = None,
         *,
+        sample_weight=None,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 1,
     ) -> "KMeansModel":
@@ -110,6 +130,10 @@ class KMeans(_KMeansParams, Estimator):
         ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
         k = self.getK()
         tol_sq = self.getTol() ** 2
+        mats = list(ds.matrices())  # materialize ONCE (extraction may copy)
+        part_weights = columnar.resolve_partition_weights(
+            dataset, mats, self._paramMap.get("weightCol"), sample_weight
+        )
 
         ckpt = start_iter = None
         cost = np.inf
@@ -132,14 +156,15 @@ class KMeans(_KMeansParams, Estimator):
         if start_iter is None:
             start_iter = 0
             with trace_range("kmeans init"):
-                centers = self._init_centers(ds, k)
+                centers = self._init_centers(mats, k, part_weights)
 
-        # pre-pad partitions once; weights mask the padding
+        # pre-pad partitions once; the weight vector masks padding (0) and
+        # carries instance weights (1.0 when unweighted) on true rows
         padded = []
-        for mat in ds.matrices():
+        for i, mat in enumerate(mats):
             pm, true_rows = columnar.pad_rows(mat)
-            w = np.zeros(pm.shape[0], pm.dtype)
-            w[:true_rows] = 1.0
+            w = np.zeros(pm.shape[0], columnar.float_dtype_for(pm.dtype))
+            w[:true_rows] = 1.0 if part_weights is None else part_weights[i]
             padded.append((jnp.asarray(pm), jnp.asarray(w)))
 
         n_cols = padded[0][0].shape[1]

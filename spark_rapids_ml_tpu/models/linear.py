@@ -45,6 +45,13 @@ _predict_proba = jax.jit(LIN.predict_logistic_proba)
 class _SupervisedParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
     regParam = Param("regParam", "L2 regularization strength λ", float)
     fitIntercept = Param("fitIntercept", "whether to fit an intercept term", bool)
+    weightCol = Param(
+        "weightCol",
+        "optional instance-weight column (Spark ML weightCol contract); "
+        "weights ride the same per-row vector that masks shape-bucketing "
+        "padding, so weighted fits cost nothing extra",
+        str,
+    )
 
     def __init__(self, uid: str | None = None):
         super().__init__(uid)
@@ -58,6 +65,9 @@ class _SupervisedParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
 
     def setRegParam(self, value: float):
         return self._set(regParam=value)
+
+    def setWeightCol(self, value: str):
+        return self._set(weightCol=value)
 
     def setFitIntercept(self, value: bool):
         return self._set(fitIntercept=value)
@@ -74,6 +84,7 @@ class _SupervisedParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
             self.getOrDefault("featuresCol"),
             self.getOrDefault("labelCol"),
             num_partitions,
+            weight_col=self._paramMap.get("weightCol"),
         )
 
 
@@ -137,8 +148,8 @@ class LinearRegression(_SupervisedParams, Estimator):
         parts = self._labeled(dataset, num_partitions)
 
         def task(part):
-            x, y = part
-            xp, yp, w = columnar.pad_labeled(x, y)
+            x, y, sw = part
+            xp, yp, w = columnar.pad_labeled(x, y, sw)
             return _linear_stats(jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w))
 
         with trace_range("linreg stats"):
@@ -220,13 +231,13 @@ class LogisticRegression(_SupervisedParams, Estimator):
         fit_intercept = self.getFitIntercept()
 
         padded = []
-        for x, y in parts:
+        for x, y, sw in parts:
             labels = np.unique(y)
             if not np.all(np.isin(labels, (0.0, 1.0))):
                 raise ValueError(
                     f"binary logistic regression requires 0/1 labels, got {labels}"
                 )
-            xp, yp, w = columnar.pad_labeled(x, y)
+            xp, yp, w = columnar.pad_labeled(x, y, sw)
             if fit_intercept:
                 xp = np.concatenate([xp, np.ones((xp.shape[0], 1), xp.dtype)], axis=1)
             padded.append((jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w)))

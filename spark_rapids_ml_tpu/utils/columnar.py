@@ -154,37 +154,120 @@ def labeled_partitions(
     features_col: str | None,
     label_col: str | None,
     num_partitions: int | None = None,
-) -> list[tuple[np.ndarray, np.ndarray]]:
-    """Split supervised data into [(X [rows, n], y [rows]), ...] partitions.
+    weight_col: str | None = None,
+) -> list[tuple[np.ndarray, np.ndarray, np.ndarray | None]]:
+    """Split supervised data into [(X, y, w-or-None), ...] partitions.
 
-    Supported: an (X, y) tuple of arrays, or a table-like container (pandas /
-    Arrow) holding an ArrayType features column and a scalar label column —
-    the Spark ML ``featuresCol``/``labelCol`` input contract.
+    Supported: an (X, y) or (X, y, w) tuple of arrays, or a table-like
+    container (pandas / Arrow) holding an ArrayType features column, a
+    scalar label column, and optionally a scalar ``weight_col`` — the Spark
+    ML ``featuresCol``/``labelCol``/``weightCol`` input contract. Instance
+    weights must be non-negative.
     """
-    if isinstance(data, tuple) and len(data) == 2:
+    w = None
+    if isinstance(data, tuple) and len(data) in (2, 3):
         x, y = np.asarray(data[0]), np.asarray(data[1], dtype=np.float64)
+        if len(data) == 3 and data[2] is not None:
+            w = data[2]
     else:
         x = extract_matrix(data, features_col)
         y = extract_vector(data, label_col)
+        if weight_col:
+            w = extract_vector(data, weight_col)
     if len(x) != len(y):
         raise ValueError(f"features have {len(x)} rows but labels have {len(y)}")
-    if num_partitions and num_partitions > 1:
-        return list(
-            zip(np.array_split(x, num_partitions), np.array_split(y, num_partitions))
-        )
-    return [(x, y)]
+    if w is not None:
+        w = validate_weights(w, len(x))
+    n_split = num_partitions if num_partitions and num_partitions > 1 else 1
+    xs = np.array_split(x, n_split)
+    ys = np.array_split(y, n_split)
+    ws = np.array_split(w, n_split) if w is not None else [None] * n_split
+    return list(zip(xs, ys, ws))
+
+
+def float_dtype_for(dtype) -> np.dtype:
+    """The dtype side-vectors (labels, weights) should use for a feature
+    matrix: the matrix's own dtype when floating, else f64 — assigning
+    fractional values into an integer-dtype buffer would silently floor
+    them."""
+    return dtype if np.issubdtype(dtype, np.floating) else np.dtype(np.float64)
+
+
+def validate_weights(
+    w: Any, n_rows: int | None = None, *, allow_all_zero: bool = False
+) -> np.ndarray:
+    """Spark weightCol contract checks, enforced in ONE place: 1-D,
+    length-matched, non-negative, not all zero."""
+    w = np.asarray(w, dtype=np.float64).reshape(-1)
+    if n_rows is not None and len(w) != n_rows:
+        raise ValueError(f"dataset has {n_rows} rows but weights have {len(w)}")
+    if (w < 0).any():
+        raise ValueError("instance weights must be non-negative")
+    if not allow_all_zero and not (w > 0).any():
+        raise ValueError("all instance weights are zero")
+    return w
+
+
+def resolve_partition_weights(
+    dataset: Any,
+    mats: list[np.ndarray],
+    weight_col: str | None = None,
+    sample_weight: Any | None = None,
+) -> list[np.ndarray] | None:
+    """Resolve instance weights into per-partition slices aligned with
+    ``mats`` (the materialized partition matrices, in order), or None when
+    the fit is unweighted.
+
+    Sources, in precedence order: the ``sample_weight`` array argument
+    (sklearn-style), then ``weight_col`` extracted from the container —
+    whole-container extraction, falling back to per-partition extraction for
+    pre-partitioned table lists.
+    """
+    if sample_weight is None and not weight_col:
+        return None
+    total_rows = sum(len(m) for m in mats)
+    if sample_weight is not None:
+        sw = validate_weights(sample_weight, total_rows)
+    else:
+        try:
+            sw = extract_vector(dataset, weight_col)
+        except TypeError:
+            if isinstance(dataset, PartitionedDataset):
+                slices = [
+                    validate_weights(
+                        extract_vector(p, weight_col), len(m), allow_all_zero=True
+                    )
+                    for p, m in zip(dataset.partitions, mats)
+                ]
+                if not any((s > 0).any() for s in slices):
+                    raise ValueError("all instance weights are zero")
+                return slices
+            raise
+        sw = validate_weights(sw, total_rows)
+    out, off = [], 0
+    for m in mats:
+        out.append(sw[off : off + len(m)])
+        off += len(m)
+    return out
 
 
 def pad_labeled(
-    x: np.ndarray, y: np.ndarray, *, min_bucket: int | None = None
+    x: np.ndarray,
+    y: np.ndarray,
+    weights: np.ndarray | None = None,
+    *,
+    min_bucket: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Bucket-pad an (X, y) pair; returns (padded_x, padded_y, weights) with
-    zero weights marking padded rows."""
+    """Bucket-pad an (X, y[, w]) group; returns (padded_x, padded_y, w) where
+    the weight vector is zero on padded rows and carries the instance
+    weights (1.0 when none were given) on true rows — so the padding mask
+    and Spark-style instance weighting ride one vector through the kernels."""
     padded, true_rows = pad_rows(x, min_bucket=min_bucket)
-    yp = np.zeros(padded.shape[0], dtype=padded.dtype)
+    dtype = float_dtype_for(padded.dtype)
+    yp = np.zeros(padded.shape[0], dtype=dtype)
     yp[:true_rows] = y
-    w = np.zeros(padded.shape[0], dtype=padded.dtype)
-    w[:true_rows] = 1.0
+    w = np.zeros(padded.shape[0], dtype=dtype)
+    w[:true_rows] = 1.0 if weights is None else weights
     return padded, yp, w
 
 

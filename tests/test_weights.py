@@ -1,0 +1,166 @@
+"""Instance-weight (weightCol) support — differential vs weighted oracles.
+
+Spark ML's weightCol contract: a non-negative per-row weight column scales
+each instance's contribution to the loss. The equivalence oracle used
+throughout: integer weight w ≡ replicating the row w times.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import KMeans, LinearRegression, LogisticRegression
+
+
+@pytest.fixture
+def xyw(rng):
+    x = rng.normal(size=(300, 5))
+    coef = rng.normal(size=5)
+    y = x @ coef + 0.01 * rng.normal(size=300)
+    w = rng.integers(1, 4, 300).astype(np.float64)
+    return x, y, w
+
+
+def _replicate(x, y, w):
+    reps = w.astype(int)
+    return np.repeat(x, reps, axis=0), np.repeat(y, reps)
+
+
+class TestWeightedLinearRegression:
+    def test_matches_replication_oracle(self, xyw):
+        x, y, w = xyw
+        m_w = LinearRegression().fit((x, y, w), num_partitions=3)
+        xr, yr = _replicate(x, y, w)
+        m_r = LinearRegression().fit((xr, yr), num_partitions=3)
+        np.testing.assert_allclose(m_w.coefficients, m_r.coefficients, atol=1e-8)
+        np.testing.assert_allclose(m_w.intercept, m_r.intercept, atol=1e-8)
+
+    def test_unit_weights_noop(self, xyw):
+        x, y, _ = xyw
+        m_w = LinearRegression().fit((x, y, np.ones(len(y))))
+        m_u = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(m_w.coefficients, m_u.coefficients, atol=1e-12)
+
+    def test_zero_weight_excludes_rows(self, rng):
+        x = rng.normal(size=(100, 3))
+        y = x @ np.ones(3)
+        # poison the tail rows, then weight them out
+        y2 = y.copy()
+        y2[80:] += 100.0
+        w = np.ones(100)
+        w[80:] = 0.0
+        m = LinearRegression().fit((x, y2, w))
+        np.testing.assert_allclose(m.coefficients, np.ones(3), atol=1e-6)
+
+    def test_weight_col_from_dataframe(self, xyw):
+        pd = pytest.importorskip("pandas")
+        x, y, w = xyw
+        df = pd.DataFrame({"features": list(x), "label": y, "w": w})
+        m_w = (
+            LinearRegression()
+            .setFeaturesCol("features")
+            .setLabelCol("label")
+            .setWeightCol("w")
+            .fit(df, num_partitions=2)
+        )
+        xr, yr = _replicate(x, y, w)
+        m_r = LinearRegression().fit((xr, yr))
+        np.testing.assert_allclose(m_w.coefficients, m_r.coefficients, atol=1e-8)
+
+    def test_negative_weights_rejected(self, xyw):
+        x, y, w = xyw
+        with pytest.raises(ValueError, match="non-negative"):
+            LinearRegression().fit((x, y, -w))
+
+    def test_length_mismatch_rejected(self, xyw):
+        x, y, w = xyw
+        with pytest.raises(ValueError, match="weights"):
+            LinearRegression().fit((x, y, w[:-5]))
+
+
+class TestWeightedLogisticRegression:
+    def test_matches_replication_oracle(self, rng):
+        x = rng.normal(size=(400, 4))
+        y = (x[:, 0] + 0.5 * rng.normal(size=400) > 0).astype(float)
+        w = rng.integers(1, 4, 400).astype(np.float64)
+        m_w = LogisticRegression().setRegParam(0.01).fit((x, y, w))
+        xr, yr = _replicate(x, y, w)
+        m_r = LogisticRegression().setRegParam(0.01).fit((xr, yr))
+        np.testing.assert_allclose(m_w.coefficients, m_r.coefficients, rtol=1e-5)
+        np.testing.assert_allclose(m_w.intercept, m_r.intercept, atol=1e-5)
+
+    def test_zero_weight_excludes_rows(self, rng):
+        x = rng.normal(size=(200, 3))
+        y = (x[:, 0] > 0).astype(float)
+        y2 = y.copy()
+        y2[150:] = 1.0 - y2[150:]  # flip labels on the tail
+        w = np.ones(200)
+        w[150:] = 0.0
+        m_w = LogisticRegression().setRegParam(0.01).fit((x, y2, w))
+        m_clean = LogisticRegression().setRegParam(0.01).fit((x[:150], y[:150]))
+        np.testing.assert_allclose(m_w.coefficients, m_clean.coefficients, rtol=1e-5)
+
+
+class TestWeightedKMeans:
+    def test_matches_replication_oracle(self, rng):
+        a = rng.normal(size=(60, 3)) + 6
+        b = rng.normal(size=(60, 3)) - 6
+        x = np.vstack([a, b])
+        w = rng.integers(1, 4, 120).astype(np.float64)
+        km = lambda: KMeans().setK(2).setSeed(3).setMaxIter(30)
+        m_w = km().fit(x, sample_weight=w)
+        m_r = km().fit(np.repeat(x, w.astype(int), axis=0))
+        # same cluster structure: compare sorted centers
+        cw = m_w.clusterCenters[np.argsort(m_w.clusterCenters[:, 0])]
+        cr = m_r.clusterCenters[np.argsort(m_r.clusterCenters[:, 0])]
+        np.testing.assert_allclose(cw, cr, atol=1e-4)
+
+    def test_zero_weight_ignores_outliers(self, rng):
+        x = np.vstack(
+            [rng.normal(size=(50, 2)) + 5, rng.normal(size=(50, 2)) - 5,
+             np.full((5, 2), 100.0)]  # far outliers
+        )
+        w = np.ones(105)
+        w[100:] = 0.0
+        m = KMeans().setK(2).setSeed(0).fit(x, sample_weight=w)
+        assert np.abs(m.clusterCenters).max() < 10  # outliers never pull a center
+
+    def test_weight_col_from_dataframe(self, rng):
+        pd = pytest.importorskip("pandas")
+        x = np.vstack([rng.normal(size=(40, 2)) + 4, rng.normal(size=(40, 2)) - 4])
+        w = rng.integers(1, 3, 80).astype(np.float64)
+        df = pd.DataFrame({"features": list(x), "w": w})
+        m = (
+            KMeans().setK(2).setSeed(1).setInputCol("features").setWeightCol("w")
+            .fit(df)
+        )
+        m_r = KMeans().setK(2).setSeed(1).fit(np.repeat(x, w.astype(int), axis=0))
+        cw = m.clusterCenters[np.argsort(m.clusterCenters[:, 0])]
+        cr = m_r.clusterCenters[np.argsort(m_r.clusterCenters[:, 0])]
+        np.testing.assert_allclose(cw, cr, atol=1e-4)
+
+    def test_negative_sample_weight_rejected(self, rng):
+        x = rng.normal(size=(20, 2))
+        with pytest.raises(ValueError, match="non-negative"):
+            KMeans().setK(2).fit(x, sample_weight=-np.ones(20))
+
+    def test_all_zero_weights_rejected(self, rng):
+        x = rng.normal(size=(20, 2))
+        with pytest.raises(ValueError, match="all instance weights are zero"):
+            KMeans().setK(2).fit(x, sample_weight=np.zeros(20))
+        with pytest.raises(ValueError, match="all instance weights are zero"):
+            LinearRegression().fit((x, np.zeros(20), np.zeros(20)))
+
+    def test_fractional_weights_on_integer_features(self, rng):
+        """Integer-dtype X must not floor fractional weights (or labels) —
+        side vectors get a float dtype."""
+        x_int = rng.integers(-5, 6, size=(100, 3))
+        y = x_int @ np.array([1.0, 2.0, 3.0]) + 0.5
+        w = np.full(100, 0.5)
+        m = LinearRegression().fit((x_int, y, w))
+        # uniform weights = unweighted fit
+        m_u = LinearRegression().fit((x_int.astype(float), y))
+        np.testing.assert_allclose(m.coefficients, m_u.coefficients, atol=1e-8)
+
+        km = KMeans().setK(2).setSeed(0)
+        model = km.fit(x_int.astype(np.float64), sample_weight=np.full(100, 0.5))
+        assert np.isfinite(model.trainingCost)
