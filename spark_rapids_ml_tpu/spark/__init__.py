@@ -16,6 +16,29 @@ instead of the CUDA-only spark-rapids columnar engine.
 """
 
 from spark_rapids_ml_tpu.spark import arrow_fns
-from spark_rapids_ml_tpu.spark.estimators import SparkPCA, SparkPCAModel
+from spark_rapids_ml_tpu.spark.estimators import (
+    SparkKMeans,
+    SparkKMeansModel,
+    SparkLinearRegression,
+    SparkLinearRegressionModel,
+    SparkLogisticRegression,
+    SparkLogisticRegressionModel,
+    SparkPCA,
+    SparkPCAModel,
+    SparkStandardScaler,
+    SparkStandardScalerModel,
+)
 
-__all__ = ["arrow_fns", "SparkPCA", "SparkPCAModel"]
+__all__ = [
+    "arrow_fns",
+    "SparkPCA",
+    "SparkPCAModel",
+    "SparkKMeans",
+    "SparkKMeansModel",
+    "SparkLinearRegression",
+    "SparkLinearRegressionModel",
+    "SparkLogisticRegression",
+    "SparkLogisticRegressionModel",
+    "SparkStandardScaler",
+    "SparkStandardScalerModel",
+]

@@ -32,43 +32,27 @@ from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.utils import columnar
 
 
-def stats_schema() -> pa.Schema:
-    """Arrow schema for one serialized GramStats row.
-
-    Variable-length list fields, NOT fixed-size lists: Spark maps ArrayType
-    to Arrow ListType at the mapInArrow boundary, and the batches a worker
-    yields must match the declared Spark schema exactly.
-    """
-    return pa.schema(
-        [
-            pa.field("xtx", pa.list_(pa.float64())),
-            pa.field("col_sum", pa.list_(pa.float64())),
-            pa.field("count", pa.float64()),
-        ]
-    )
-
-
 def _list_column(values: np.ndarray, row_len: int) -> pa.ListArray:
-    """Wrap a flat float64 buffer as a variable-list column of uniform rows."""
+    """Wrap a flat float64 buffer as a variable-list column of uniform rows.
+
+    Variable-length lists, NOT fixed-size: Spark maps ArrayType to Arrow
+    ListType at the mapInArrow boundary, and the batches a worker yields
+    must match the declared Spark schema exactly.
+    """
     offsets = pa.array(
         np.arange(0, values.size + 1, row_len, dtype=np.int32)
     )
     return pa.ListArray.from_arrays(offsets, pa.array(values))
 
 
+def _gram_shapes(n: int) -> dict[str, tuple]:
+    return {"xtx": (n, n), "col_sum": (n,), "count": ()}
+
+
 def stats_to_batch(stats: L.GramStats) -> pa.RecordBatch:
-    """GramStats → one-row Arrow RecordBatch (the shuffle payload)."""
-    xtx = np.asarray(stats.xtx, dtype=np.float64)
-    col_sum = np.asarray(stats.col_sum, dtype=np.float64)
-    n = col_sum.shape[0]
-    return pa.RecordBatch.from_arrays(
-        [
-            _list_column(xtx.reshape(-1), n * n),
-            _list_column(col_sum, n),
-            pa.array([float(np.asarray(stats.count))]),
-        ],
-        schema=stats_schema(),
-    )
+    """GramStats → one-row Arrow RecordBatch (the shuffle payload); a thin
+    adapter over the generic ``arrays_to_batch`` serializer."""
+    return arrays_to_batch({f: np.asarray(v) for f, v in zip(stats._fields, stats)})
 
 
 def stats_from_batches(batches: Iterable[pa.RecordBatch]) -> L.GramStats:
@@ -78,50 +62,279 @@ def stats_from_batches(batches: Iterable[pa.RecordBatch]) -> L.GramStats:
     the reference's ``cov.reduce((a, b) => a + b)`` over breeze matrices
     (RapidsRowMatrix.scala:139), running on host ndarrays.
     """
-    rows: list[tuple[np.ndarray, np.ndarray, float]] = []
-    for batch in batches:
-        t = pa.Table.from_batches([batch]) if isinstance(batch, pa.RecordBatch) else batch
-        for i in range(t.num_rows):
-            rows.append(
-                (
-                    np.asarray(t.column("xtx")[i].values.to_numpy(zero_copy_only=False)),
-                    np.asarray(
-                        t.column("col_sum")[i].values.to_numpy(zero_copy_only=False)
-                    ),
-                    float(t.column("count")[i].as_py()),
-                )
-            )
-    return _merge_stats_rows(rows)
+    tables = [
+        pa.Table.from_batches([b]) if isinstance(b, pa.RecordBatch) else b
+        for b in batches
+    ]
+    n = None
+    for t in tables:
+        if t.num_rows:
+            n = len(t.column("col_sum")[0])
+            break
+    if n is None:
+        raise ValueError("no partition statistics received")
+    arr = arrays_from_batches(tables, _gram_shapes(n))
+    return L.GramStats(arr["xtx"], arr["col_sum"], np.float64(arr["count"]))
 
 
 def stats_from_rows(rows: Iterable) -> L.GramStats:
     """Merge stats from row objects (e.g. ``pyspark.sql.Row`` from a
     ``collect()``) — the PySpark <4.0 path, where ``DataFrame.toArrow``
     doesn't exist. Each row must expose ``xtx``/``col_sum``/``count``."""
-    return _merge_stats_rows(
-        [
-            (np.asarray(r["xtx"]), np.asarray(r["col_sum"]), float(r["count"]))
-            for r in rows
-        ]
-    )
-
-
-def _merge_stats_rows(
-    rows: Iterable[tuple[np.ndarray, np.ndarray, float]]
-) -> L.GramStats:
-    xtx = col_sum = None
-    count = 0.0
-    for row_xtx, row_sum, row_count in rows:
-        n = row_sum.shape[0]
-        if xtx is None:
-            xtx = np.zeros((n, n))
-            col_sum = np.zeros(n)
-        xtx += row_xtx.reshape(n, n)
-        col_sum += row_sum
-        count += row_count
-    if xtx is None:
+    rows = list(rows)
+    if not rows:
         raise ValueError("no partition statistics received")
-    return L.GramStats(xtx, col_sum, np.float64(count))
+    n = len(np.asarray(rows[0]["col_sum"]).reshape(-1))
+    arr = arrays_from_rows(rows, _gram_shapes(n))
+    return L.GramStats(arr["xtx"], arr["col_sum"], np.float64(arr["count"]))
+
+
+# ---------------------------------------------------------------------------
+# Generic named-array statistics serialization (GLM / KMeans / scaler monoids)
+# ---------------------------------------------------------------------------
+#
+# Every estimator's partition statistic in this framework is a NamedTuple of
+# arrays that merges by ELEMENTWISE SUM (GramStats, LinearStats, NewtonStats,
+# KMeansStats, MomentStats). One serializer therefore serves them all: each
+# field travels as a flattened float64 list column, and the driver-side merge
+# is a per-field sum — the Arrow-columnar analog of the reference shipping
+# breeze matrices through Spark's reduce (RapidsRowMatrix.scala:139).
+
+
+def arrays_schema(fields: list[str]) -> pa.Schema:
+    return pa.schema([pa.field(f, pa.list_(pa.float64())) for f in fields])
+
+
+def arrays_to_batch(arrays: dict[str, np.ndarray]) -> pa.RecordBatch:
+    """dict of ndarrays → one-row RecordBatch of flattened list columns."""
+    cols = []
+    for name, a in arrays.items():
+        flat = np.asarray(a, dtype=np.float64).reshape(-1)
+        cols.append(_list_column(flat, flat.size))
+    return pa.RecordBatch.from_arrays(cols, schema=arrays_schema(list(arrays)))
+
+
+def arrays_from_batches(
+    batches: Iterable[pa.RecordBatch], shapes: dict[str, tuple]
+) -> dict[str, np.ndarray]:
+    """Sum-merge serialized stats rows back into named arrays of ``shapes``."""
+    acc = {name: np.zeros(shape) for name, shape in shapes.items()}
+    got = False
+    for batch in batches:
+        t = pa.Table.from_batches([batch]) if isinstance(batch, pa.RecordBatch) else batch
+        for i in range(t.num_rows):
+            got = True
+            for name, shape in shapes.items():
+                flat = np.asarray(
+                    t.column(name)[i].values.to_numpy(zero_copy_only=False)
+                )
+                acc[name] += flat.reshape(shape)
+    if not got:
+        raise ValueError("no partition statistics received")
+    return acc
+
+
+def arrays_from_rows(rows: Iterable, shapes: dict[str, tuple]) -> dict[str, np.ndarray]:
+    """The PySpark <4.0 ``collect()`` fallback for ``arrays_from_batches``."""
+    acc = {name: np.zeros(shape) for name, shape in shapes.items()}
+    got = False
+    for r in rows:
+        got = True
+        for name, shape in shapes.items():
+            acc[name] += np.asarray(r[name], dtype=np.float64).reshape(shape)
+    if not got:
+        raise ValueError("no partition statistics received")
+    return acc
+
+
+def _labeled_from_batch(batch, features_col, label_col, weight_col, *, binary=False):
+    mat = columnar.extract_matrix(batch, features_col)
+    y = np.asarray(
+        batch.column(label_col).to_numpy(zero_copy_only=False), dtype=np.float64
+    )
+    if binary and not np.all(np.isin(y, (0.0, 1.0))):
+        raise ValueError(
+            "binary logistic regression requires 0/1 labels, got "
+            f"{np.unique(y)[:8]}"
+        )
+    sw = None
+    if weight_col:
+        sw = columnar.validate_weights(
+            batch.column(weight_col).to_numpy(zero_copy_only=False),
+            len(mat),
+            allow_all_zero=True,
+        )
+    return mat, y, sw
+
+
+def make_linreg_partition_fn(
+    features_col: str, label_col: str, weight_col: str | None = None
+) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
+    """mapInArrow body: accumulate a partition's LinearStats on device."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import linear as LIN
+
+    def fit_partition(batches):
+        acc = None
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            mat, y, sw = _labeled_from_batch(batch, features_col, label_col, weight_col)
+            xp, yp, w = columnar.pad_labeled(mat, y, sw)
+            stats = LIN.linear_stats(
+                jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w)
+            )
+            acc = stats if acc is None else LIN.combine_linear_stats(acc, stats)
+        if acc is not None:
+            yield arrays_to_batch(
+                {f: np.asarray(v) for f, v in zip(acc._fields, acc)}
+            )
+
+    return fit_partition
+
+
+def make_logreg_newton_partition_fn(
+    features_col: str,
+    label_col: str,
+    w_full: np.ndarray,
+    *,
+    fit_intercept: bool = True,
+    weight_col: str | None = None,
+) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
+    """mapInArrow body for ONE logistic Newton iteration's statistics.
+
+    The driver runs one Spark job per Newton iteration, broadcasting the
+    current parameter vector in the closure — the standard distributed-IRLS
+    schedule (each iteration is a full data pass; 5-25 jobs total).
+    """
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import linear as LIN
+
+    w_full = np.asarray(w_full)
+
+    def newton_partition(batches):
+        acc = None
+        wj = jnp.asarray(w_full)
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            mat, y, sw = _labeled_from_batch(
+                batch, features_col, label_col, weight_col, binary=True
+            )
+            xp, yp, w = columnar.pad_labeled(mat, y, sw)
+            if fit_intercept:
+                xp = np.concatenate([xp, np.ones((xp.shape[0], 1), xp.dtype)], axis=1)
+            stats = LIN.logistic_newton_stats(
+                jnp.asarray(xp), jnp.asarray(yp), wj, jnp.asarray(w)
+            )
+            acc = stats if acc is None else LIN.combine_newton_stats(acc, stats)
+        if acc is not None:
+            yield arrays_to_batch(
+                {f: np.asarray(v) for f, v in zip(acc._fields, acc)}
+            )
+
+    return newton_partition
+
+
+def make_kmeans_partition_fn(
+    input_col: str, centers: np.ndarray, weight_col: str | None = None
+) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
+    """mapInArrow body for one Lloyd iteration's KMeansStats (one Spark job
+    per iteration, centers broadcast in the closure)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import kmeans as KM
+
+    centers = np.asarray(centers)
+
+    def lloyd_partition(batches):
+        acc = None
+        c = jnp.asarray(centers)
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            mat = columnar.extract_matrix(batch, input_col)
+            pm, true_rows = columnar.pad_rows(mat)
+            w = np.zeros(pm.shape[0], columnar.float_dtype_for(pm.dtype))
+            if weight_col:
+                w[:true_rows] = columnar.validate_weights(
+                    batch.column(weight_col).to_numpy(zero_copy_only=False),
+                    true_rows,
+                    allow_all_zero=True,
+                )
+            else:
+                w[:true_rows] = 1.0
+            stats = KM.kmeans_stats(jnp.asarray(pm), c, jnp.asarray(w))
+            acc = stats if acc is None else KM.combine_kmeans_stats(acc, stats)
+        if acc is not None:
+            yield arrays_to_batch(
+                {f: np.asarray(v) for f, v in zip(acc._fields, acc)}
+            )
+
+    return lloyd_partition
+
+
+def make_moments_partition_fn(
+    input_col: str,
+) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
+    """mapInArrow body for StandardScaler's moment statistics."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import scaler as S
+
+    def moments_partition(batches):
+        acc = None
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            mat = columnar.extract_matrix(batch, input_col)
+            # bucket-pad like every other partition fn (zero rows are exact
+            # for the sums; only the count needs fixing), else each distinct
+            # Arrow batch size costs an XLA recompile
+            pm, true_rows = columnar.pad_rows(mat)
+            stats = S.moment_stats(jnp.asarray(pm))
+            stats = S.MomentStats(
+                count=jnp.asarray(true_rows, stats.count.dtype),
+                total=stats.total,
+                total_sq=stats.total_sq,
+            )
+            acc = stats if acc is None else S.combine_moment_stats(acc, stats)
+        if acc is not None:
+            yield arrays_to_batch(
+                {f: np.asarray(v) for f, v in zip(acc._fields, acc)}
+            )
+
+    return moments_partition
+
+
+def make_matrix_map_partition_fn(
+    input_col: str, output_col: str, matrix_fn: Callable[[np.ndarray], np.ndarray]
+) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
+    """Generic mapInArrow transform body: apply ``matrix_fn`` to the input
+    column's [rows, n] matrix and append the result — a float64 list column
+    when 2-D (ArrayType), a float64 scalar column when 1-D (predictions).
+    Streaming generalization of the reference's columnar UDF pattern
+    (RapidsPCA.scala:128-161) shared by every model's Spark transform.
+    """
+
+    def map_partition(batches):
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            out = np.asarray(matrix_fn(columnar.extract_matrix(batch, input_col)))
+            if out.ndim == 2:
+                flat = out.astype(np.float64, copy=False).reshape(-1)
+                col = _list_column(flat, out.shape[1])
+            else:
+                col = pa.array(out.astype(np.float64, copy=False))
+            yield pa.RecordBatch.from_arrays(
+                [*batch.columns, col],
+                schema=batch.schema.append(pa.field(output_col, col.type)),
+            )
+
+    return map_partition
 
 
 def make_fit_partition_fn(

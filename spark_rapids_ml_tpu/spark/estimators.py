@@ -25,7 +25,15 @@ from typing import Any
 
 import numpy as np
 
+from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel
+from spark_rapids_ml_tpu.models.linear import (
+    LinearRegression,
+    LinearRegressionModel,
+    LogisticRegression,
+    LogisticRegressionModel,
+)
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.spark import arrow_fns
 from spark_rapids_ml_tpu.utils.tracing import trace_range
@@ -41,21 +49,6 @@ def _require_pyspark():
             "(pip install pyspark>=3.4); the core estimators in "
             "spark_rapids_ml_tpu work without it on pandas/Arrow/ndarray input"
         ) from e
-
-
-def _spark_stats_type():
-    """Spark schema for the serialized GramStats row (mapInArrow needs it).
-    ArrayType maps to the Arrow variable list the workers emit
-    (``arrow_fns.stats_schema``)."""
-    from pyspark.sql import types as T
-
-    return T.StructType(
-        [
-            T.StructField("xtx", T.ArrayType(T.DoubleType())),
-            T.StructField("col_sum", T.ArrayType(T.DoubleType())),
-            T.StructField("count", T.DoubleType()),
-        ]
-    )
 
 
 class SparkPCA(PCA):
@@ -95,7 +88,9 @@ class SparkPCA(PCA):
             fit_fn = arrow_fns.make_fit_partition_fn(
                 input_col, precision=self.getOrDefault("precision")
             )
-            stats_df = selected.mapInArrow(fit_fn, schema=_spark_stats_type())
+            stats_df = selected.mapInArrow(
+                fit_fn, schema=_spark_arrays_type(["xtx", "col_sum", "count"])
+            )
             if hasattr(stats_df, "toArrow"):  # PySpark >= 4.0: stays columnar
                 stats = arrow_fns.stats_from_batches(stats_df.toArrow().to_batches())
             else:  # PySpark 3.4/3.5: tiny payload (one [n,n] row per partition)
@@ -144,3 +139,336 @@ class SparkPCAModel(PCAModel):
 def _is_spark_df(dataset: Any) -> bool:
     mod = type(dataset).__module__ or ""
     return mod.startswith("pyspark.")
+
+
+# ---------------------------------------------------------------------------
+# Shared plan helpers for the stats-monoid estimators
+# ---------------------------------------------------------------------------
+
+
+def _spark_arrays_type(fields: list[str]):
+    from pyspark.sql import types as T
+
+    return T.StructType(
+        [T.StructField(f, T.ArrayType(T.DoubleType())) for f in fields]
+    )
+
+
+def _collect_stats(df, partition_fn, fields: list[str], shapes: dict[str, tuple]):
+    """Run a stats mapInArrow pass and sum-merge the per-partition rows on
+    the driver (toArrow on PySpark >= 4, collect() fallback below)."""
+    stats_df = df.mapInArrow(partition_fn, schema=_spark_arrays_type(fields))
+    if hasattr(stats_df, "toArrow"):
+        return arrow_fns.arrays_from_batches(stats_df.toArrow().to_batches(), shapes)
+    return arrow_fns.arrays_from_rows(stats_df.collect(), shapes)
+
+
+def _resolve_col(obj, *names) -> str | None:
+    """First set-or-defaulted column param among ``names`` — plain
+    ``_paramMap.get`` would miss defaults like featuresCol='features'."""
+    for n in names:
+        if obj.isSet(n) or obj.hasDefault(n):
+            return obj.getOrDefault(n)
+    return None
+
+
+def _spark_transform(model, dataset, matrix_fn, output_col, scalar: bool):
+    from pyspark.sql import types as T
+
+    input_col = _resolve_col(model, "inputCol", "featuresCol")
+    fn = arrow_fns.make_matrix_map_partition_fn(input_col, output_col, matrix_fn)
+    out_type = (
+        T.DoubleType() if scalar else T.ArrayType(T.DoubleType())
+    )
+    schema = T.StructType(
+        dataset.schema.fields + [T.StructField(output_col, out_type)]
+    )
+    return dataset.mapInArrow(fn, schema=schema)
+
+
+def _infer_n(df, col: str) -> int:
+    first = df.select(col).first()
+    if first is None:
+        raise ValueError("empty dataset")
+    if first[0] is None:
+        raise ValueError(
+            f"input column {col!r} contains null feature vectors; "
+            "drop or impute nulls before fit"
+        )
+    return len(first[0])
+
+
+# ---------------------------------------------------------------------------
+# GLMs
+# ---------------------------------------------------------------------------
+
+
+class SparkLinearRegression(LinearRegression):
+    """LinearRegression over pyspark DataFrames: one mapInArrow stats pass,
+    driver-side normal-equations solve. Non-Spark inputs fall through."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkLinearRegressionModel(
+                uid=core.uid, coefficients=core.coefficients, intercept=core.intercept
+            )
+            return self._copyValues(model)
+        _require_pyspark()
+        feats = self.getOrDefault("featuresCol")
+        label = self.getOrDefault("labelCol")
+        weight_col = self._paramMap.get("weightCol")
+        cols = [feats, label] + ([weight_col] if weight_col else [])
+        n = _infer_n(dataset, feats)
+        shapes = {
+            "xtx": (n, n), "xty": (n,), "x_sum": (n,),
+            "y_sum": (), "y_sq": (), "count": (),
+        }
+        with trace_range("linreg stats"):
+            fn = arrow_fns.make_linreg_partition_fn(feats, label, weight_col)
+            arrays = _collect_stats(
+                dataset.select(*cols), fn, list(shapes), shapes
+            )
+            if weight_col and float(arrays["count"]) == 0.0:
+                raise ValueError("all instance weights are zero")
+        with trace_range("linreg solve"):
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.ops import linear as LIN
+
+            stats = LIN.LinearStats(**{k: jnp.asarray(v) for k, v in arrays.items()})
+            coef, intercept = LIN.solve_normal(
+                stats,
+                reg_param=self.getRegParam(),
+                fit_intercept=self.getFitIntercept(),
+            )
+        model = SparkLinearRegressionModel(
+            uid=self.uid, coefficients=np.asarray(coef), intercept=float(intercept)
+        )
+        return self._copyValues(model)
+
+
+class SparkLinearRegressionModel(LinearRegressionModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        _require_pyspark()
+        return _spark_transform(
+            self, dataset, self._predict_matrix,
+            self.getOrDefault("predictionCol"), scalar=True,
+        )
+
+
+class SparkLogisticRegression(LogisticRegression):
+    """Distributed IRLS over pyspark DataFrames: one Spark job per Newton
+    iteration (current parameters broadcast in the task closure), replicated
+    [d, d] solve on the driver between jobs."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkLogisticRegressionModel(
+                uid=core.uid, coefficients=core.coefficients, intercept=core.intercept
+            )
+            return self._copyValues(model)
+        _require_pyspark()
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import linear as LIN
+
+        feats = self.getOrDefault("featuresCol")
+        label = self.getOrDefault("labelCol")
+        weight_col = self._paramMap.get("weightCol")
+        cols = [feats, label] + ([weight_col] if weight_col else [])
+        selected = dataset.select(*cols)
+        fit_intercept = self.getFitIntercept()
+        n = _infer_n(dataset, feats)
+        d = n + 1 if fit_intercept else n
+        shapes = {"hess": (d, d), "grad": (d,), "loss": (), "count": ()}
+        w_full = np.zeros(d)
+        with trace_range("logreg newton"):
+            for _ in range(self.getMaxIter()):
+                fn = arrow_fns.make_logreg_newton_partition_fn(
+                    feats, label, w_full,
+                    fit_intercept=fit_intercept, weight_col=weight_col,
+                )
+                arrays = _collect_stats(selected, fn, list(shapes), shapes)
+                if weight_col and float(arrays["count"]) == 0.0:
+                    raise ValueError("all instance weights are zero")
+                stats = LIN.NewtonStats(
+                    **{k: jnp.asarray(v) for k, v in arrays.items()}
+                )
+                new_w, step_norm = LIN.newton_update(
+                    jnp.asarray(w_full), stats,
+                    reg_param=self.getRegParam(), fit_intercept=fit_intercept,
+                )
+                w_full = np.asarray(new_w)
+                if float(step_norm) <= self.getTol():
+                    break
+        if fit_intercept:
+            coef, intercept = w_full[:-1], float(w_full[-1])
+        else:
+            coef, intercept = w_full, 0.0
+        model = SparkLogisticRegressionModel(
+            uid=self.uid, coefficients=coef, intercept=intercept
+        )
+        return self._copyValues(model)
+
+
+class SparkLogisticRegressionModel(LogisticRegressionModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        _require_pyspark()
+        return _spark_transform(
+            self, dataset, self._predict_matrix,
+            self.getOrDefault("predictionCol"), scalar=True,
+        )
+
+
+# ---------------------------------------------------------------------------
+# KMeans
+# ---------------------------------------------------------------------------
+
+
+class SparkKMeans(KMeans):
+    """Lloyd over pyspark DataFrames: seed from a driver-side sample, then
+    one mapInArrow stats job per iteration (centers broadcast per job)."""
+
+    _INIT_SAMPLE = 4096
+
+    def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions, **kwargs)
+            model = SparkKMeansModel(
+                uid=core.uid,
+                clusterCenters=core.clusterCenters,
+                trainingCost=core.trainingCost,
+            )
+            return self._copyValues(model)
+        if kwargs.get("checkpoint_dir") is not None:
+            raise NotImplementedError(
+                "mid-training checkpoint/resume is not implemented on the "
+                "Spark DataFrame path yet; use the core KMeans on a "
+                "non-Spark container for checkpointed training"
+            )
+        _require_pyspark()
+        import jax
+        import jax.numpy as jnp
+
+        from pyspark.sql import functions as F
+
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        input_col = _resolve_col(self, "inputCol")
+        weight_col = self._paramMap.get("weightCol")
+        cols = [input_col] + ([weight_col] if weight_col else [])
+        selected = dataset.select(*cols)
+        k = self.getK()
+        tol_sq = self.getTol() ** 2
+
+        with trace_range("kmeans init"):
+            # zero-weight rows are excluded instances: filter them in the
+            # PLAN so the bounded head sample only sees seedable rows
+            seed_df = (
+                selected.where(F.col(weight_col) > 0) if weight_col else selected
+            )
+            sample_rows = seed_df.limit(self._INIT_SAMPLE).collect()
+            if len(sample_rows) < k:
+                raise ValueError(
+                    f"k={k} but only {len(sample_rows)} rows with positive "
+                    "weight were found to seed centers from"
+                )
+            sample = np.stack([np.asarray(r[0]) for r in sample_rows])
+            if self.getInitMode() == "random":
+                rng = np.random.default_rng(self.getSeed())
+                centers = sample[rng.choice(len(sample), k, replace=False)]
+            else:
+                key = jax.random.PRNGKey(self.getSeed())
+                centers = np.asarray(
+                    KM.kmeans_plus_plus_init(key, jnp.asarray(sample), k)
+                )
+
+        n = centers.shape[1]
+        shapes = {"sums": (k, n), "counts": (k,), "cost": ()}
+        cost = np.inf
+        with trace_range("kmeans lloyd"):
+            for _ in range(self.getMaxIter()):
+                fn = arrow_fns.make_kmeans_partition_fn(
+                    input_col, centers, weight_col
+                )
+                arrays = _collect_stats(selected, fn, list(shapes), shapes)
+                if weight_col and float(arrays["counts"].sum()) == 0.0:
+                    raise ValueError("all instance weights are zero")
+                stats = KM.KMeansStats(
+                    **{f: jnp.asarray(v) for f, v in arrays.items()}
+                )
+                new_centers = np.asarray(
+                    KM.update_centers(stats, jnp.asarray(centers))
+                )
+                cost = float(stats.cost)
+                shift = float(
+                    KM.center_shift_sq(jnp.asarray(centers), jnp.asarray(new_centers))
+                )
+                centers = new_centers
+                if shift <= tol_sq:
+                    break
+        model = SparkKMeansModel(
+            uid=self.uid, clusterCenters=centers, trainingCost=cost
+        )
+        return self._copyValues(model)
+
+
+class SparkKMeansModel(KMeansModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        _require_pyspark()
+        return _spark_transform(
+            self, dataset, self._predict_matrix,
+            self.getOutputCol(), scalar=True,
+        )
+
+
+# ---------------------------------------------------------------------------
+# StandardScaler
+# ---------------------------------------------------------------------------
+
+
+class SparkStandardScaler(StandardScaler):
+    """StandardScaler over pyspark DataFrames: one mapInArrow moments pass."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkStandardScalerModel(
+                uid=core.uid, mean=core.mean, std=core.std
+            )
+            return self._copyValues(model)
+        _require_pyspark()
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        input_col = _resolve_col(self, "inputCol")
+        n = _infer_n(dataset, input_col)
+        shapes = {"count": (), "total": (n,), "total_sq": (n,)}
+        with trace_range("scaler moments"):
+            fn = arrow_fns.make_moments_partition_fn(input_col)
+            arrays = _collect_stats(dataset.select(input_col), fn, list(shapes), shapes)
+            stats = S.MomentStats(**{f: jnp.asarray(v) for f, v in arrays.items()})
+            mean, std = S.finalize_moments(stats)
+        model = SparkStandardScalerModel(
+            uid=self.uid, mean=np.asarray(mean), std=np.asarray(std)
+        )
+        return self._copyValues(model)
+
+
+class SparkStandardScalerModel(StandardScalerModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        _require_pyspark()
+        return _spark_transform(
+            self, dataset, self._scale, self.getOutputCol(), scalar=False
+        )

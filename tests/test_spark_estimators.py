@@ -1,0 +1,260 @@
+"""Widened Spark integration: GLM/KMeans/scaler plan functions + wrappers.
+
+Same strategy as test_spark_arrow.py — the mapInArrow bodies are exercised
+as plain Arrow-iterator functions (no pyspark needed), and the Spark-facing
+wrappers are verified to fall through to the core paths on non-Spark input.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_ml_tpu import (
+    KMeans,
+    LinearRegression,
+    LogisticRegression,
+    StandardScaler,
+)
+from spark_rapids_ml_tpu.spark import (
+    SparkKMeans,
+    SparkLinearRegression,
+    SparkLogisticRegression,
+    SparkStandardScaler,
+    arrow_fns,
+)
+
+
+def _labeled_batches(x, y, sizes, w=None):
+    out, at = [], 0
+    for s in sizes:
+        cols = [
+            pa.FixedSizeListArray.from_arrays(
+                pa.array(x[at : at + s].reshape(-1)), x.shape[1]
+            ),
+            pa.array(y[at : at + s]),
+        ]
+        names = ["features", "label"]
+        if w is not None:
+            cols.append(pa.array(w[at : at + s]))
+            names.append("wt")
+        out.append(pa.RecordBatch.from_arrays(cols, names=names))
+        at += s
+    assert at == len(x)
+    return out
+
+
+@pytest.fixture
+def xy(rng):
+    x = rng.normal(size=(300, 6))
+    coef = rng.normal(size=6)
+    y = x @ coef + 0.01 * rng.normal(size=300)
+    return x, y, coef
+
+
+class TestArraysSerialization:
+    def test_round_trip_sum_merge(self, rng):
+        a = {"m": rng.normal(size=(4, 4)), "v": rng.normal(size=4), "s": np.array(3.0)}
+        b = {"m": rng.normal(size=(4, 4)), "v": rng.normal(size=4), "s": np.array(2.0)}
+        shapes = {"m": (4, 4), "v": (4,), "s": ()}
+        merged = arrow_fns.arrays_from_batches(
+            [arrow_fns.arrays_to_batch(a), arrow_fns.arrays_to_batch(b)], shapes
+        )
+        np.testing.assert_allclose(merged["m"], a["m"] + b["m"], rtol=1e-12)
+        np.testing.assert_allclose(merged["s"], 5.0)
+
+    def test_rows_fallback(self, rng):
+        a = {"v": rng.normal(size=3)}
+        rows = [{"v": a["v"].tolist()}]
+        out = arrow_fns.arrays_from_rows(rows, {"v": (3,)})
+        np.testing.assert_allclose(out["v"], a["v"], rtol=1e-12)
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError, match="no partition statistics"):
+            arrow_fns.arrays_from_batches([], {"v": (2,)})
+
+
+class TestLinregPlan:
+    def test_stats_match_direct_fit(self, xy):
+        from spark_rapids_ml_tpu.ops import linear as LIN
+        import jax.numpy as jnp
+
+        x, y, coef = xy
+        fn = arrow_fns.make_linreg_partition_fn("features", "label")
+        batches = _labeled_batches(x, y, [100, 120, 80])
+        shapes = {
+            "xtx": (6, 6), "xty": (6,), "x_sum": (6,),
+            "y_sum": (), "y_sq": (), "count": (),
+        }
+        arrays = arrow_fns.arrays_from_batches(fn(iter(batches)), shapes)
+        stats = LIN.LinearStats(**{k: jnp.asarray(v) for k, v in arrays.items()})
+        c, b = LIN.solve_normal(stats, reg_param=0.0, fit_intercept=True)
+        np.testing.assert_allclose(np.asarray(c), coef, atol=0.01)
+        assert float(arrays["count"]) == 300.0
+
+    def test_weighted(self, xy, rng):
+        from spark_rapids_ml_tpu.ops import linear as LIN
+        import jax.numpy as jnp
+
+        x, y, _ = xy
+        w = rng.integers(1, 4, 300).astype(np.float64)
+        fn = arrow_fns.make_linreg_partition_fn("features", "label", "wt")
+        shapes = {
+            "xtx": (6, 6), "xty": (6,), "x_sum": (6,),
+            "y_sum": (), "y_sq": (), "count": (),
+        }
+        arrays = arrow_fns.arrays_from_batches(
+            fn(iter(_labeled_batches(x, y, [150, 150], w))), shapes
+        )
+        stats = LIN.LinearStats(**{k: jnp.asarray(v) for k, v in arrays.items()})
+        c, b = LIN.solve_normal(stats, reg_param=0.0, fit_intercept=True)
+        m_ref = LinearRegression().fit((x, y, w))
+        np.testing.assert_allclose(np.asarray(c), m_ref.coefficients, atol=1e-6)
+
+
+class TestLogregPlan:
+    def test_newton_iterations_converge(self, rng):
+        from spark_rapids_ml_tpu.ops import linear as LIN
+        import jax.numpy as jnp
+
+        x = rng.normal(size=(400, 4))
+        y = (x[:, 0] + 0.3 * rng.normal(size=400) > 0).astype(float)
+        batches = _labeled_batches(x, y, [200, 200])
+        d = 5
+        shapes = {"hess": (d, d), "grad": (d,), "loss": (), "count": ()}
+        w_full = np.zeros(d)
+        for _ in range(15):
+            fn = arrow_fns.make_logreg_newton_partition_fn(
+                "features", "label", w_full
+            )
+            arrays = arrow_fns.arrays_from_batches(fn(iter(batches)), shapes)
+            stats = LIN.NewtonStats(**{k: jnp.asarray(v) for k, v in arrays.items()})
+            new_w, step = LIN.newton_update(
+                jnp.asarray(w_full), stats, reg_param=0.01
+            )
+            w_full = np.asarray(new_w)
+            if float(step) < 1e-6:
+                break
+        m_ref = LogisticRegression().setRegParam(0.01).fit((x, y))
+        np.testing.assert_allclose(w_full[:-1], m_ref.coefficients, rtol=1e-4)
+
+
+class TestKMeansPlan:
+    def test_lloyd_step_matches_core(self, rng):
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+        import jax.numpy as jnp
+
+        a = rng.normal(size=(60, 3)) + 5
+        b = rng.normal(size=(60, 3)) - 5
+        x = np.vstack([a, b])
+        centers = x[[0, 60]]
+        fn = arrow_fns.make_kmeans_partition_fn("features", centers)
+        batches = [
+            pa.RecordBatch.from_arrays(
+                [pa.FixedSizeListArray.from_arrays(pa.array(chunk.reshape(-1)), 3)],
+                names=["features"],
+            )
+            for chunk in (x[:70], x[70:])
+        ]
+        shapes = {"sums": (2, 3), "counts": (2,), "cost": ()}
+        arrays = arrow_fns.arrays_from_batches(fn(iter(batches)), shapes)
+        ref = KM.kmeans_stats(jnp.asarray(x), jnp.asarray(centers))
+        np.testing.assert_allclose(arrays["sums"], np.asarray(ref.sums), rtol=1e-6)
+        np.testing.assert_allclose(arrays["counts"], np.asarray(ref.counts))
+        np.testing.assert_allclose(arrays["cost"], float(ref.cost), rtol=1e-6)
+
+
+class TestMomentsPlan:
+    def test_matches_scaler_fit(self, rng):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        x = rng.normal(size=(250, 8)) * 3 + 1
+        fn = arrow_fns.make_moments_partition_fn("features")
+        batches = [
+            pa.RecordBatch.from_arrays(
+                [pa.FixedSizeListArray.from_arrays(pa.array(chunk.reshape(-1)), 8)],
+                names=["features"],
+            )
+            for chunk in (x[:100], x[100:])
+        ]
+        shapes = {"count": (), "total": (8,), "total_sq": (8,)}
+        arrays = arrow_fns.arrays_from_batches(fn(iter(batches)), shapes)
+        stats = S.MomentStats(**{k: jnp.asarray(v) for k, v in arrays.items()})
+        mean, std = S.finalize_moments(stats)
+        np.testing.assert_allclose(np.asarray(mean), x.mean(0), rtol=1e-9)
+        np.testing.assert_allclose(np.asarray(std), x.std(0, ddof=1), rtol=1e-9)
+
+
+class TestMatrixMapPlan:
+    def test_scalar_output_column(self, rng):
+        x = rng.normal(size=(50, 4))
+        fn = arrow_fns.make_matrix_map_partition_fn(
+            "features", "pred", lambda m: m.sum(axis=1)
+        )
+        batch = pa.RecordBatch.from_arrays(
+            [pa.FixedSizeListArray.from_arrays(pa.array(x.reshape(-1)), 4)],
+            names=["features"],
+        )
+        out = list(fn(iter([batch])))[0]
+        assert out.schema.field("pred").type == pa.float64()
+        np.testing.assert_allclose(
+            out.column("pred").to_numpy(), x.sum(axis=1), rtol=1e-12
+        )
+
+    def test_list_output_column(self, rng):
+        x = rng.normal(size=(50, 4))
+        fn = arrow_fns.make_matrix_map_partition_fn(
+            "features", "out", lambda m: m[:, :2]
+        )
+        batch = pa.RecordBatch.from_arrays(
+            [pa.FixedSizeListArray.from_arrays(pa.array(x.reshape(-1)), 4)],
+            names=["features"],
+        )
+        out = list(fn(iter([batch])))[0]
+        assert out.schema.field("out").type == pa.list_(pa.float64())
+
+
+class TestBinaryLabelValidationInPlan:
+    def test_non_binary_labels_rejected(self, rng):
+        x = rng.normal(size=(50, 3))
+        y = rng.integers(1, 3, 50).astype(float)  # {1, 2}: invalid coding
+        fn = arrow_fns.make_logreg_newton_partition_fn(
+            "features", "label", np.zeros(4)
+        )
+        with pytest.raises(ValueError, match="0/1 labels"):
+            list(fn(iter(_labeled_batches(x, y, [50]))))
+
+
+class TestWrapperFallThrough:
+    """Non-Spark inputs route to the core estimators and return Spark-model
+    subclasses, so one estimator object serves both worlds."""
+
+    def test_linreg(self, xy):
+        x, y, coef = xy
+        m = SparkLinearRegression().fit((x, y))
+        np.testing.assert_allclose(m.coefficients, coef, atol=0.01)
+        core = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(m.coefficients, core.coefficients, atol=1e-12)
+
+    def test_logreg(self, rng):
+        x = rng.normal(size=(200, 3))
+        y = (x[:, 0] > 0).astype(float)
+        m = SparkLogisticRegression().setRegParam(0.1).fit((x, y))
+        core = LogisticRegression().setRegParam(0.1).fit((x, y))
+        np.testing.assert_allclose(m.coefficients, core.coefficients, atol=1e-10)
+
+    def test_kmeans(self, rng):
+        x = np.vstack([rng.normal(size=(40, 2)) + 4, rng.normal(size=(40, 2)) - 4])
+        m = SparkKMeans().setK(2).setSeed(0).fit(x)
+        core = KMeans().setK(2).setSeed(0).fit(x)
+        np.testing.assert_allclose(
+            np.sort(m.clusterCenters, axis=0), np.sort(core.clusterCenters, axis=0)
+        )
+
+    def test_scaler(self, rng):
+        x = rng.normal(size=(100, 5)) * 2 + 3
+        m = SparkStandardScaler().setInputCol("f").fit(x)
+        np.testing.assert_allclose(m.mean, x.mean(0), rtol=1e-9)
+        out = np.asarray(m.transform(x))
+        np.testing.assert_allclose(out.std(0, ddof=1), np.ones(5), rtol=1e-9)
