@@ -32,6 +32,14 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import pca
 
         return getattr(pca, name)
+    if name in (
+        "IncrementalPCA",
+        "IncrementalTruncatedSVD",
+        "IncrementalStandardScaler",
+    ):
+        from spark_rapids_ml_tpu.models import incremental
+
+        return getattr(incremental, name)
     if name in ("TruncatedSVD", "TruncatedSVDModel"):
         from spark_rapids_ml_tpu.models import truncated_svd
 

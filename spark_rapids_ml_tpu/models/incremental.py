@@ -1,0 +1,249 @@
+"""Incremental (streaming) fits — partial_fit/finalize over the stats monoids.
+
+The framework's fits are all "accumulate a commutative-monoid statistic,
+then one small solve" (docs/ARCHITECTURE.md §2). That structure gives
+streaming fits for free: ``partial_fit(batch)`` folds a batch into the
+running statistic on device, ``finalize()`` runs the decomposition and
+returns the same fitted model the one-shot estimator produces — bit-for-bit
+when the batch concatenation equals the one-shot input, because the monoid
+combine is exactly the cross-partition reduction the batch path uses.
+
+This is a capability the reference lacks (its fit is a single two-phase
+job, SURVEY.md §3.1) and the sklearn ``IncrementalPCA`` shape users expect
+for data that arrives in chunks or exceeds host memory.
+
+Accumulator memory is O(model²) regardless of stream length: [n, n] for
+PCA, [n, n] for TruncatedSVD's Gram route (or [n, n] R for the svd route),
+[n] for the scaler.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.pca import (
+    PCA,
+    PCAModel,
+    _combine_r,
+    _fit_from_stats_jit,
+    _gram_stats,
+    _qr_r,
+    _svd_from_r_jit,
+)
+from spark_rapids_ml_tpu.models.scaler import (
+    StandardScaler,
+    StandardScalerModel,
+    _moment_stats,
+)
+from spark_rapids_ml_tpu.models.truncated_svd import (
+    TruncatedSVD,
+    TruncatedSVDModel,
+    _decompose_gram_jit,
+    _gram,
+    _svd_values_from_r_jit,
+)
+from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.ops import scaler as S
+from spark_rapids_ml_tpu.utils import columnar
+
+_combine_gram = jax.jit(L.combine_gram_stats)
+_combine_moments = jax.jit(S.combine_moment_stats)
+
+
+def _as_matrix(est, batch: Any) -> np.ndarray:
+    input_col = est._paramMap.get("inputCol")
+    return columnar.extract_matrix(batch, input_col)
+
+
+def _pin_solver(est) -> str:
+    """The accumulator layout depends on the solver route; switching solvers
+    mid-stream would silently orphan the batches accumulated under the other
+    route. Pin it at the first partial_fit."""
+    solver = est.getOrDefault("solver")
+    pinned = getattr(est, "_solver_used", None)
+    if pinned is None:
+        est._solver_used = solver
+    elif solver != pinned:
+        raise ValueError(
+            f"solver changed mid-stream ({pinned!r} -> {solver!r}); "
+            "reset() before switching solvers"
+        )
+    return solver
+
+
+class IncrementalPCA(PCA):
+    """PCA fitted by streaming batches.
+
+    >>> inc = IncrementalPCA().setK(4)
+    >>> for chunk in stream:
+    ...     inc.partial_fit(chunk)
+    >>> model = inc.finalize()
+
+    ``fit`` still works (one-shot, inherited). The running statistic is the
+    same ``GramStats`` triple the batch fit reduces, so
+    ``partial_fit(a); partial_fit(b); finalize()`` ==
+    ``fit(concat(a, b))`` for every solver.
+    """
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._acc = None
+        self._r_acc = None
+        self._n_cols: int | None = None
+        self._rows_seen = 0
+
+    @property
+    def n_rows_seen(self) -> int:
+        if self._acc is not None:
+            return int(np.asarray(self._acc.count))
+        return self._rows_seen if self._r_acc is not None else 0
+
+    def partial_fit(self, batch: Any) -> "IncrementalPCA":
+        mat = _as_matrix(self, batch)
+        if self._n_cols is None:
+            self._n_cols = mat.shape[1]
+        elif mat.shape[1] != self._n_cols:
+            raise ValueError(
+                f"inconsistent feature dim: {mat.shape[1]} != {self._n_cols}"
+            )
+        solver = _pin_solver(self)
+        padded, true_rows = columnar.pad_rows(mat)
+        if solver == "svd":
+            if self.getMeanCentering():
+                raise ValueError(
+                    "solver='svd' with meanCentering needs the global mean "
+                    "before any QR; use the gram-route solvers for "
+                    "incremental centered fits"
+                )
+            r = _qr_r(jnp.asarray(padded))
+            self._r_acc = r if self._r_acc is None else _combine_r(self._r_acc, r)
+            self._rows_seen = getattr(self, "_rows_seen", 0) + len(mat)
+            return self
+        prec = L.PRECISIONS[self.getOrDefault("precision")]
+        stats = _gram_stats(jnp.asarray(padded), precision=prec)
+        stats = L.GramStats(
+            stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype)
+        )
+        self._acc = stats if self._acc is None else _combine_gram(self._acc, stats)
+        return self
+
+    def finalize(self) -> PCAModel:
+        k = self.getK()
+        if self._n_cols is not None and k > self._n_cols:
+            raise ValueError(f"k={k} must be <= number of features {self._n_cols}")
+        if self._r_acc is not None:
+            pc, explained = _svd_from_r_jit(self._r_acc, k)
+        elif self._acc is not None:
+            pc, explained = _fit_from_stats_jit(
+                self._acc, k, self.getMeanCentering(), self.getOrDefault("solver")
+            )
+        else:
+            raise ValueError("finalize() before any partial_fit()")
+        model = PCAModel(
+            uid=self.uid,
+            pc=np.asarray(pc),
+            explainedVariance=np.asarray(explained),
+        )
+        return self._copyValues(model)
+
+    def reset(self) -> "IncrementalPCA":
+        self._acc = self._r_acc = self._n_cols = self._solver_used = None
+        self._rows_seen = 0
+        return self
+
+
+class IncrementalTruncatedSVD(TruncatedSVD):
+    """TruncatedSVD fitted by streaming batches (gram or svd route)."""
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._gram = None
+        self._r_acc = None
+        self._n_cols: int | None = None
+
+    def partial_fit(self, batch: Any) -> "IncrementalTruncatedSVD":
+        mat = _as_matrix(self, batch)
+        if self._n_cols is None:
+            self._n_cols = mat.shape[1]
+        elif mat.shape[1] != self._n_cols:
+            raise ValueError(
+                f"inconsistent feature dim: {mat.shape[1]} != {self._n_cols}"
+            )
+        padded, _ = columnar.pad_rows(mat)
+        if _pin_solver(self) == "svd":
+            r = _qr_r(jnp.asarray(padded))
+            self._r_acc = r if self._r_acc is None else _combine_r(self._r_acc, r)
+        else:
+            prec = L.PRECISIONS[self.getOrDefault("precision")]
+            g = _gram(jnp.asarray(padded), precision=prec)
+            self._gram = g if self._gram is None else self._gram + g
+        return self
+
+    def finalize(self) -> TruncatedSVDModel:
+        k = self.getK()
+        if self._n_cols is not None and k > self._n_cols:
+            raise ValueError(f"k={k} must be <= number of features {self._n_cols}")
+        if self._r_acc is not None:
+            components, s = _svd_values_from_r_jit(self._r_acc, k)
+        elif self._gram is not None:
+            components, s = _decompose_gram_jit(
+                self._gram, k, self.getOrDefault("solver")
+            )
+        else:
+            raise ValueError("finalize() before any partial_fit()")
+        model = TruncatedSVDModel(
+            uid=self.uid,
+            components=np.asarray(components),
+            singularValues=np.asarray(s[:k]),
+        )
+        return self._copyValues(model)
+
+    def reset(self) -> "IncrementalTruncatedSVD":
+        self._gram = self._r_acc = self._n_cols = self._solver_used = None
+        return self
+
+
+class IncrementalStandardScaler(StandardScaler):
+    """StandardScaler fitted by streaming batches."""
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid)
+        if kwargs:
+            self._set(**{k: v for k, v in kwargs.items() if v is not None})
+        self._acc = None
+        self._n_cols: int | None = None
+
+    def partial_fit(self, batch: Any) -> "IncrementalStandardScaler":
+        mat = _as_matrix(self, batch)
+        if self._n_cols is None:
+            self._n_cols = mat.shape[1]
+        elif mat.shape[1] != self._n_cols:
+            raise ValueError(
+                f"inconsistent feature dim: {mat.shape[1]} != {self._n_cols}"
+            )
+        padded, true_rows = columnar.pad_rows(mat)
+        stats = _moment_stats(jnp.asarray(padded))
+        stats = S.MomentStats(
+            count=jnp.asarray(true_rows, stats.count.dtype),
+            total=stats.total,
+            total_sq=stats.total_sq,
+        )
+        self._acc = stats if self._acc is None else _combine_moments(self._acc, stats)
+        return self
+
+    def finalize(self) -> StandardScalerModel:
+        if self._acc is None:
+            raise ValueError("finalize() before any partial_fit()")
+        mean, std = S.finalize_moments(self._acc)
+        model = StandardScalerModel(
+            uid=self.uid, mean=np.asarray(mean), std=np.asarray(std)
+        )
+        return self._copyValues(model)
+
+    def reset(self) -> "IncrementalStandardScaler":
+        self._acc = self._n_cols = None
+        return self

@@ -1,0 +1,130 @@
+"""Incremental partial_fit/finalize — equality with one-shot fits.
+
+The monoid structure guarantees streaming == batch; these tests pin that
+contract for every solver route.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    PCA,
+    IncrementalPCA,
+    IncrementalStandardScaler,
+    IncrementalTruncatedSVD,
+    StandardScaler,
+    TruncatedSVD,
+)
+
+
+@pytest.fixture
+def x(rng):
+    return rng.normal(size=(400, 16)) @ rng.normal(size=(16, 16))
+
+
+def _chunks(x, sizes):
+    out, at = [], 0
+    for s in sizes:
+        out.append(x[at : at + s])
+        at += s
+    assert at == len(x)
+    return out
+
+
+class TestIncrementalPCA:
+    @pytest.mark.parametrize("solver", ["full", "svd"])
+    def test_streaming_equals_batch(self, x, solver):
+        inc = IncrementalPCA().setInputCol("f").setK(4).setSolver(solver)
+        for chunk in _chunks(x, [150, 130, 120]):
+            inc.partial_fit(chunk)
+        m_inc = inc.finalize()
+        m_batch = PCA().setInputCol("f").setK(4).setSolver(solver).fit(x)
+        np.testing.assert_allclose(m_inc.pc, m_batch.pc, atol=1e-9)
+        np.testing.assert_allclose(
+            m_inc.explainedVariance, m_batch.explainedVariance, atol=1e-12
+        )
+
+    def test_centered_gram_route(self, x):
+        xc = x + 5.0
+        inc = IncrementalPCA().setInputCol("f").setK(3).setMeanCentering(True)
+        for chunk in _chunks(xc, [200, 200]):
+            inc.partial_fit(chunk)
+        m_inc = inc.finalize()
+        m_batch = PCA().setInputCol("f").setK(3).setMeanCentering(True).fit(xc)
+        np.testing.assert_allclose(m_inc.pc, m_batch.pc, atol=1e-9)
+
+    def test_centered_svd_route_rejected(self, x):
+        inc = IncrementalPCA().setK(2).setSolver("svd").setMeanCentering(True)
+        with pytest.raises(ValueError, match="global mean"):
+            inc.partial_fit(x)
+
+    def test_rows_seen_and_reset(self, x):
+        inc = IncrementalPCA().setK(2)
+        inc.partial_fit(x[:100]).partial_fit(x[100:250])
+        assert inc.n_rows_seen == 250
+        inc.reset()
+        assert inc.n_rows_seen == 0
+        with pytest.raises(ValueError, match="before any partial_fit"):
+            inc.finalize()
+
+    def test_inconsistent_width_rejected(self, x):
+        inc = IncrementalPCA().setK(2)
+        inc.partial_fit(x)
+        with pytest.raises(ValueError, match="inconsistent feature dim"):
+            inc.partial_fit(x[:, :8])
+
+    def test_solver_switch_mid_stream_rejected(self, x):
+        inc = IncrementalPCA().setK(2).setSolver("full")
+        inc.partial_fit(x[:100])
+        inc._set(solver="svd")
+        with pytest.raises(ValueError, match="solver changed mid-stream"):
+            inc.partial_fit(x[100:])
+        # reset clears the pin
+        inc.reset()
+        inc.partial_fit(x)
+        assert inc.finalize().pc.shape == (16, 2)
+
+    def test_transform_from_finalized(self, x):
+        inc = IncrementalPCA().setInputCol("f").setK(3)
+        inc.partial_fit(x)
+        model = inc.finalize()
+        out = np.asarray(model.transform(x))
+        np.testing.assert_allclose(out, x @ model.pc, atol=1e-8)
+
+
+class TestIncrementalTruncatedSVD:
+    @pytest.mark.parametrize("solver", ["gram", "svd"])
+    def test_streaming_equals_batch(self, x, solver):
+        inc = IncrementalTruncatedSVD().setInputCol("f").setK(5).setSolver(solver)
+        for chunk in _chunks(x, [100, 300]):
+            inc.partial_fit(chunk)
+        m_inc = inc.finalize()
+        m_batch = TruncatedSVD().setInputCol("f").setK(5).setSolver(solver).fit(x)
+        np.testing.assert_allclose(m_inc.components, m_batch.components, atol=1e-9)
+        np.testing.assert_allclose(
+            m_inc.singularValues, m_batch.singularValues, rtol=1e-10
+        )
+
+
+class TestIncrementalScaler:
+    def test_streaming_equals_batch(self, x):
+        inc = IncrementalStandardScaler().setInputCol("f")
+        for chunk in _chunks(x, [50, 250, 100]):
+            inc.partial_fit(chunk)
+        m_inc = inc.finalize()
+        m_batch = StandardScaler().setInputCol("f").fit(x)
+        np.testing.assert_allclose(m_inc.mean, m_batch.mean, rtol=1e-12)
+        np.testing.assert_allclose(m_inc.std, m_batch.std, rtol=1e-12)
+
+    def test_unfinalized_raises(self):
+        with pytest.raises(ValueError, match="before any partial_fit"):
+            IncrementalStandardScaler().finalize()
+
+    def test_kwargs_forwarded(self, x):
+        inc = IncrementalStandardScaler(inputCol="f", withMean=True)
+        assert inc.getOrDefault("withMean") is True
+
+    def test_width_mismatch_rejected(self, x):
+        inc = IncrementalStandardScaler().partial_fit(x)
+        with pytest.raises(ValueError, match="inconsistent feature dim"):
+            inc.partial_fit(x[:, :4])
