@@ -55,8 +55,16 @@ _combine_moments = jax.jit(S.combine_moment_stats)
 
 
 def _as_matrix(est, batch: Any) -> np.ndarray:
+    """Extract the batch matrix AND pin/verify the stream's feature width."""
     input_col = est._paramMap.get("inputCol")
-    return columnar.extract_matrix(batch, input_col)
+    mat = columnar.extract_matrix(batch, input_col)
+    if est._n_cols is None:
+        est._n_cols = mat.shape[1]
+    elif mat.shape[1] != est._n_cols:
+        raise ValueError(
+            f"inconsistent feature dim: {mat.shape[1]} != {est._n_cols}"
+        )
+    return mat
 
 
 def _pin_solver(est) -> str:
@@ -104,12 +112,6 @@ class IncrementalPCA(PCA):
 
     def partial_fit(self, batch: Any) -> "IncrementalPCA":
         mat = _as_matrix(self, batch)
-        if self._n_cols is None:
-            self._n_cols = mat.shape[1]
-        elif mat.shape[1] != self._n_cols:
-            raise ValueError(
-                f"inconsistent feature dim: {mat.shape[1]} != {self._n_cols}"
-            )
         solver = _pin_solver(self)
         padded, true_rows = columnar.pad_rows(mat)
         if solver == "svd":
@@ -135,11 +137,14 @@ class IncrementalPCA(PCA):
         k = self.getK()
         if self._n_cols is not None and k > self._n_cols:
             raise ValueError(f"k={k} must be <= number of features {self._n_cols}")
+        if self._acc is not None or self._r_acc is not None:
+            _pin_solver(self)  # a solver switch after the last batch is
+            # the same mistake as mid-stream — same clear error
         if self._r_acc is not None:
             pc, explained = _svd_from_r_jit(self._r_acc, k)
         elif self._acc is not None:
             pc, explained = _fit_from_stats_jit(
-                self._acc, k, self.getMeanCentering(), self.getOrDefault("solver")
+                self._acc, k, self.getMeanCentering(), self._solver_used
             )
         else:
             raise ValueError("finalize() before any partial_fit()")
@@ -167,12 +172,6 @@ class IncrementalTruncatedSVD(TruncatedSVD):
 
     def partial_fit(self, batch: Any) -> "IncrementalTruncatedSVD":
         mat = _as_matrix(self, batch)
-        if self._n_cols is None:
-            self._n_cols = mat.shape[1]
-        elif mat.shape[1] != self._n_cols:
-            raise ValueError(
-                f"inconsistent feature dim: {mat.shape[1]} != {self._n_cols}"
-            )
         padded, _ = columnar.pad_rows(mat)
         if _pin_solver(self) == "svd":
             r = _qr_r(jnp.asarray(padded))
@@ -187,12 +186,12 @@ class IncrementalTruncatedSVD(TruncatedSVD):
         k = self.getK()
         if self._n_cols is not None and k > self._n_cols:
             raise ValueError(f"k={k} must be <= number of features {self._n_cols}")
+        if self._gram is not None or self._r_acc is not None:
+            _pin_solver(self)
         if self._r_acc is not None:
             components, s = _svd_values_from_r_jit(self._r_acc, k)
         elif self._gram is not None:
-            components, s = _decompose_gram_jit(
-                self._gram, k, self.getOrDefault("solver")
-            )
+            components, s = _decompose_gram_jit(self._gram, k, self._solver_used)
         else:
             raise ValueError("finalize() before any partial_fit()")
         model = TruncatedSVDModel(
@@ -219,12 +218,6 @@ class IncrementalStandardScaler(StandardScaler):
 
     def partial_fit(self, batch: Any) -> "IncrementalStandardScaler":
         mat = _as_matrix(self, batch)
-        if self._n_cols is None:
-            self._n_cols = mat.shape[1]
-        elif mat.shape[1] != self._n_cols:
-            raise ValueError(
-                f"inconsistent feature dim: {mat.shape[1]} != {self._n_cols}"
-            )
         padded, true_rows = columnar.pad_rows(mat)
         stats = _moment_stats(jnp.asarray(padded))
         stats = S.MomentStats(

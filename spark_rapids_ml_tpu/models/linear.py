@@ -40,6 +40,16 @@ _newton_stats = jax.jit(LIN.logistic_newton_stats)
 _newton_update = jax.jit(LIN.newton_update, static_argnames=("fit_intercept",))
 _predict_linear = jax.jit(LIN.predict_linear)
 _predict_proba = jax.jit(LIN.predict_logistic_proba)
+# Full-Newton multinomial cap: the Hessian is [C·d, C·d] and its block
+# assembly unrolls C(C+1)/2 matmuls — fine for classical multiclass,
+# pathological for ID-like labels.
+_MAX_CLASSES = 64
+
+_softmax_stats = jax.jit(LIN.softmax_newton_stats, static_argnames=("n_classes",))
+_softmax_update = jax.jit(
+    LIN.softmax_newton_update, static_argnames=("n_classes", "fit_intercept")
+)
+_predict_softmax = jax.jit(LIN.predict_softmax_proba)
 
 
 class _SupervisedParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
@@ -189,6 +199,41 @@ class LinearRegressionModel(_GLMModel):
 # ---------------------------------------------------------------------------
 
 
+def _pad_parts(parts, fit_intercept: bool, label_dtype=None):
+    """Bucket-pad labeled partitions and append the intercept column —
+    the shared Newton-loop preamble (binary and multinomial)."""
+    padded = []
+    for x, y, sw in parts:
+        xp, yp, w = columnar.pad_labeled(x, y, sw)
+        if fit_intercept:
+            xp = np.concatenate([xp, np.ones((xp.shape[0], 1), xp.dtype)], axis=1)
+        if label_dtype is not None:
+            yp = yp.astype(label_dtype)
+        padded.append((jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w)))
+    return padded
+
+
+def _resume_newton_checkpoint(checkpoint_dir: str | None, n_params: int):
+    """(initial w, start iteration, checkpointer-or-None) for a Newton loop,
+    resuming from the newest durable checkpoint when one exists."""
+    w = np.zeros(n_params)
+    if checkpoint_dir is None:
+        return w, 0, None
+    from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+    ckpt = TrainingCheckpointer(checkpoint_dir)
+    resumed = ckpt.latest()
+    if resumed is None:
+        return w, 0, ckpt
+    step, arrays, _ = resumed
+    if arrays["w"].shape[0] != n_params:
+        raise ValueError(
+            f"checkpoint at {checkpoint_dir} holds {arrays['w'].shape[0]} "
+            f"parameters but this fit has {n_params}; is checkpoint_dir stale?"
+        )
+    return arrays["w"], step + 1, ckpt
+
+
 class LogisticRegression(_SupervisedParams, Estimator):
     """Binary logistic regression via IRLS/Newton.
 
@@ -230,35 +275,32 @@ class LogisticRegression(_SupervisedParams, Estimator):
         parts = self._labeled(dataset, num_partitions)
         fit_intercept = self.getFitIntercept()
 
-        padded = []
-        for x, y, sw in parts:
-            labels = np.unique(y)
-            if not np.all(np.isin(labels, (0.0, 1.0))):
-                raise ValueError(
-                    f"binary logistic regression requires 0/1 labels, got {labels}"
-                )
-            xp, yp, w = columnar.pad_labeled(x, y, sw)
-            if fit_intercept:
-                xp = np.concatenate([xp, np.ones((xp.shape[0], 1), xp.dtype)], axis=1)
-            padded.append((jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w)))
-
+        all_labels = np.unique(np.concatenate([np.unique(y) for _, y, _ in parts]))
+        if not np.all(all_labels == np.round(all_labels)) or all_labels.min() < 0:
+            raise ValueError(
+                "logistic regression requires integer class labels "
+                f"0..C-1, got {all_labels[:8]}"
+            )
+        n_classes = int(all_labels.max()) + 1
+        if n_classes > _MAX_CLASSES:
+            raise ValueError(
+                f"labels imply {n_classes} classes (max label "
+                f"{int(all_labels.max())}), over the supported cap of "
+                f"{_MAX_CLASSES} — the full-Newton Hessian is [C·d, C·d]. "
+                "Check for mislabeled/ID-like rows, or re-encode labels "
+                "densely as 0..C-1"
+            )
+        if n_classes > 2:
+            return self._fit_multinomial(
+                parts,
+                n_classes,
+                fit_intercept,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+            )
+        padded = _pad_parts(parts, fit_intercept)
         d = padded[0][0].shape[1]
-        w_full = np.zeros(d)
-        start_iter = 0
-        ckpt = None
-        if checkpoint_dir is not None:
-            from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
-
-            ckpt = TrainingCheckpointer(checkpoint_dir)
-            resumed = ckpt.latest()
-            if resumed is not None:
-                step, arrays, _ = resumed
-                if arrays["w"].shape[0] != d:
-                    raise ValueError(
-                        f"checkpoint at {checkpoint_dir} holds {arrays['w'].shape[0]} "
-                        f"parameters but this fit has {d}; is checkpoint_dir stale?"
-                    )
-                w_full, start_iter = arrays["w"], step + 1
+        w_full, start_iter, ckpt = _resume_newton_checkpoint(checkpoint_dir, d)
 
         with trace_range("logreg newton"):
             for it in range(start_iter, self.getMaxIter()):
@@ -291,11 +333,108 @@ class LogisticRegression(_SupervisedParams, Estimator):
         )
         return self._copyValues(model)
 
+    def _fit_multinomial(
+        self,
+        parts,
+        n_classes: int,
+        fit_intercept: bool,
+        *,
+        checkpoint_dir: str | None,
+        checkpoint_every: int,
+    ) -> "LogisticRegressionModel":
+        """Softmax IRLS: full-Newton on the flattened [C·d] parameter.
+
+        Same distributed schedule as the binary path — one stats-monoid pass
+        per iteration (SoftmaxStats: the full Fisher Hessian as C(C+1)/2 MXU
+        block matmuls), replicated [C·d, C·d] solve between passes. Spark ML
+        fits the same family with L-BFGS; full Newton converges in a handful
+        of data passes, which on TPU (where each pass is cheap and the solve
+        is tiny) is the better trade.
+        """
+        padded = _pad_parts(parts, fit_intercept, label_dtype=np.int32)
+        d = padded[0][0].shape[1]
+        w_flat, start_iter, ckpt = _resume_newton_checkpoint(
+            checkpoint_dir, n_classes * d
+        )
+
+        with trace_range("softmax newton"):
+            for it in range(start_iter, self.getMaxIter()):
+                wj = jnp.asarray(w_flat)
+
+                def task(part, wj=wj):
+                    x, y, w = part
+                    return _softmax_stats(x, y, wj, n_classes, w)
+
+                partials = run_partition_tasks(task, padded)
+                stats = tree_reduce(partials, LIN.combine_softmax_stats)
+                new_w, step_norm = _softmax_update(
+                    wj,
+                    stats,
+                    n_classes,
+                    reg_param=self.getRegParam(),
+                    fit_intercept=fit_intercept,
+                )
+                w_flat = np.asarray(new_w)
+                if ckpt is not None and (it + 1) % checkpoint_every == 0:
+                    ckpt.save(it, {"w": w_flat}, {"loss": float(stats.loss)})
+                if float(step_norm) <= self.getTol():
+                    break
+
+        w_mat = w_flat.reshape(n_classes, d)
+        if fit_intercept:
+            coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
+        else:
+            coef_matrix, intercepts = w_mat, np.zeros(n_classes)
+        model = LogisticRegressionModel(
+            uid=self.uid,
+            coefficientMatrix=coef_matrix,
+            interceptVector=intercepts,
+        )
+        return self._copyValues(model)
+
 
 class LogisticRegressionModel(_GLMModel):
+    """Binary or multinomial fitted model.
+
+    Binary: ``coefficients`` [n] + ``intercept`` (``predict_proba_matrix``
+    returns [rows] P(y=1), preserving the binary contract). Multinomial:
+    ``coefficientMatrix`` [C, n] + ``interceptVector`` [C]
+    (``predict_proba_matrix`` returns [rows, C]); transform emits the argmax
+    class — the Spark LogisticRegressionModel shape.
+    """
+
+    def __init__(
+        self,
+        uid: str | None = None,
+        coefficients: np.ndarray | None = None,
+        intercept: float = 0.0,
+        coefficientMatrix: np.ndarray | None = None,
+        interceptVector: np.ndarray | None = None,
+    ):
+        super().__init__(uid, coefficients=coefficients, intercept=intercept)
+        self.coefficientMatrix = (
+            None if coefficientMatrix is None else np.asarray(coefficientMatrix)
+        )
+        self.interceptVector = (
+            None if interceptVector is None else np.asarray(interceptVector)
+        )
+
+    @property
+    def numClasses(self) -> int:
+        if self.coefficientMatrix is not None:
+            return self.coefficientMatrix.shape[0]
+        return 2
+
     def predict_proba_matrix(self, mat: np.ndarray) -> np.ndarray:
         padded, true_rows = columnar.pad_rows(mat)
         xd = jnp.asarray(padded)
+        if self.coefficientMatrix is not None:
+            out = _predict_softmax(
+                xd,
+                jnp.asarray(self.coefficientMatrix, dtype=xd.dtype),
+                jnp.asarray(self.interceptVector, dtype=xd.dtype),
+            )
+            return np.asarray(out)[:true_rows]
         out = _predict_proba(
             xd,
             jnp.asarray(self.coefficients, dtype=xd.dtype),
@@ -304,8 +443,32 @@ class LogisticRegressionModel(_GLMModel):
         return np.asarray(out)[:true_rows]
 
     def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
-        return (self.predict_proba_matrix(mat) >= 0.5).astype(np.float64)
+        proba = self.predict_proba_matrix(mat)
+        if proba.ndim == 2:
+            return np.argmax(proba, axis=1).astype(np.float64)
+        return (proba >= 0.5).astype(np.float64)
 
     def predict(self, row) -> float:
+        if self.coefficientMatrix is not None:
+            z = self.coefficientMatrix @ np.asarray(row) + self.interceptVector
+            return float(np.argmax(z))
         z = float(np.dot(self.coefficients, np.asarray(row)) + self.intercept)
         return 1.0 if z >= 0.0 else 0.0
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        if self.coefficientMatrix is not None:
+            return {
+                "coefficientMatrix": self.coefficientMatrix,
+                "interceptVector": self.interceptVector,
+            }
+        return super()._saveData()
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        if "coefficientMatrix" in data:
+            return cls(
+                uid=uid,
+                coefficientMatrix=data["coefficientMatrix"],
+                interceptVector=data["interceptVector"],
+            )
+        return super()._fromSaved(uid, data)

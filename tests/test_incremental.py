@@ -79,6 +79,8 @@ class TestIncrementalPCA:
         inc._set(solver="svd")
         with pytest.raises(ValueError, match="solver changed mid-stream"):
             inc.partial_fit(x[100:])
+        with pytest.raises(ValueError, match="solver changed mid-stream"):
+            inc.finalize()  # switch AFTER the last batch is the same mistake
         # reset clears the pin
         inc.reset()
         inc.partial_fit(x)

@@ -133,10 +133,12 @@ class TestLogisticRegression:
         np.testing.assert_allclose(m3.coefficients, m1.coefficients, atol=1e-8)
 
     def test_bad_labels_rejected(self, cls_data):
+        # non-integer labels are invalid for any family
         x, _ = cls_data
-        y = np.full(len(x), 2.0)
-        with pytest.raises(ValueError, match="0/1 labels"):
-            LogisticRegression().fit((x, y))
+        with pytest.raises(ValueError, match="integer class labels"):
+            LogisticRegression().fit((x, np.full(len(x), 0.5)))
+        with pytest.raises(ValueError, match="integer class labels"):
+            LogisticRegression().fit((x, np.full(len(x), -1.0)))
 
     def test_proba_monotone_in_margin(self, cls_data):
         x, y = cls_data
