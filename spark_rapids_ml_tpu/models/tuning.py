@@ -38,7 +38,7 @@ except Exception:  # pragma: no cover
 
 
 def n_rows(dataset: Any) -> int:
-    if isinstance(dataset, tuple) and len(dataset) == 2:
+    if isinstance(dataset, tuple) and len(dataset) in (2, 3):
         return len(np.asarray(dataset[0]))
     if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
         return dataset.num_rows
@@ -61,8 +61,11 @@ def row_slice(dataset: Any, idx: np.ndarray) -> Any:
     repeated slicing — this branch re-concatenates the partitions per call.
     """
     idx = np.asarray(idx)
-    if isinstance(dataset, tuple) and len(dataset) == 2:
-        return (np.asarray(dataset[0])[idx], np.asarray(dataset[1])[idx])
+    if isinstance(dataset, tuple) and len(dataset) in (2, 3):
+        # (X, y), weighted (X, y, w), or unweighted (X, y, None)
+        return tuple(
+            None if part is None else np.asarray(part)[idx] for part in dataset
+        )
     if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
         return dataset.take(pa.array(idx))
     if isinstance(dataset, columnar.PartitionedDataset):
@@ -91,7 +94,7 @@ def _collect_for_split(dataset: Any) -> Any:
 
 
 def _labels_of(dataset: Any, label_col: str) -> np.ndarray:
-    if isinstance(dataset, tuple) and len(dataset) == 2:
+    if isinstance(dataset, tuple) and len(dataset) in (2, 3):
         return np.asarray(dataset[1], dtype=np.float64)
     return columnar.extract_vector(dataset, label_col)
 

@@ -186,6 +186,25 @@ def _spark_transform(model, dataset, matrix_fn, output_col, scalar: bool):
     return dataset.mapInArrow(fn, schema=schema)
 
 
+def _reject_checkpoint_kwargs(kwargs: dict) -> None:
+    """Validate fit kwargs on the Spark path with the SAME strictness the
+    core estimators apply on local containers — a typo or a bad
+    checkpoint_every must not silently train differently per container."""
+    kwargs = dict(kwargs)
+    checkpoint_dir = kwargs.pop("checkpoint_dir", None)
+    checkpoint_every = kwargs.pop("checkpoint_every", 1)
+    if kwargs:
+        raise TypeError(f"unexpected fit() kwargs: {sorted(kwargs)}")
+    if checkpoint_every is not None and checkpoint_every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    if checkpoint_dir is not None:
+        raise NotImplementedError(
+            "mid-training checkpoint/resume is not implemented on the "
+            "Spark DataFrame path yet; use the core estimator on a "
+            "non-Spark container for checkpointed training"
+        )
+
+
 def _infer_n(df, col: str) -> int:
     first = df.select(col).first()
     if first is None:
@@ -207,7 +226,17 @@ class SparkLinearRegression(LinearRegression):
     """LinearRegression over pyspark DataFrames: one mapInArrow stats pass,
     driver-side normal-equations solve. Non-Spark inputs fall through."""
 
-    def fit(self, dataset: Any, num_partitions: int | None = None):
+    def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
+        if kwargs:
+            # the normal-equations solve is a single pass — there is no
+            # training loop to checkpoint on EITHER data path
+            extra = set(kwargs) - {"checkpoint_dir", "checkpoint_every"}
+            if extra:
+                raise TypeError(f"unexpected fit() kwargs: {sorted(extra)}")
+            raise NotImplementedError(
+                "LinearRegression trains in one closed-form pass; "
+                "mid-training checkpointing does not apply"
+            )
         if not _is_spark_df(dataset):
             core = super().fit(dataset, num_partitions)
             model = SparkLinearRegressionModel(
@@ -264,13 +293,20 @@ class SparkLogisticRegression(LogisticRegression):
     iteration (current parameters broadcast in the task closure), replicated
     [d, d] solve on the driver between jobs."""
 
-    def fit(self, dataset: Any, num_partitions: int | None = None):
+    def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
         if not _is_spark_df(dataset):
-            core = super().fit(dataset, num_partitions)
+            core = super().fit(dataset, num_partitions, **kwargs)
+            # copy EVERY fitted field: a >=3-class dataset trains multinomial,
+            # whose state lives in coefficientMatrix/interceptVector
             model = SparkLogisticRegressionModel(
-                uid=core.uid, coefficients=core.coefficients, intercept=core.intercept
+                uid=core.uid,
+                coefficients=core.coefficients,
+                intercept=core.intercept,
+                coefficientMatrix=core.coefficientMatrix,
+                interceptVector=core.interceptVector,
             )
             return self._copyValues(model)
+        _reject_checkpoint_kwargs(kwargs)
         _require_pyspark()
         import jax.numpy as jnp
 
@@ -346,12 +382,7 @@ class SparkKMeans(KMeans):
                 trainingCost=core.trainingCost,
             )
             return self._copyValues(model)
-        if kwargs.get("checkpoint_dir") is not None:
-            raise NotImplementedError(
-                "mid-training checkpoint/resume is not implemented on the "
-                "Spark DataFrame path yet; use the core KMeans on a "
-                "non-Spark container for checkpointed training"
-            )
+        _reject_checkpoint_kwargs(kwargs)
         _require_pyspark()
         import jax
         import jax.numpy as jnp
@@ -369,11 +400,34 @@ class SparkKMeans(KMeans):
 
         with trace_range("kmeans init"):
             # zero-weight rows are excluded instances: filter them in the
-            # PLAN so the bounded head sample only sees seedable rows
+            # PLAN so the bounded sample only sees seedable rows
             seed_df = (
                 selected.where(F.col(weight_col) > 0) if weight_col else selected
             )
-            sample_rows = seed_df.limit(self._INIT_SAMPLE).collect()
+            # RANDOM sample across all partitions, not limit() (which takes
+            # the first rows in plan order — biased when data is sorted or
+            # partition-clustered, and can yield pathological k-means++
+            # seeds). df.sample needs a fraction: derive it from a count and
+            # oversample 2x to absorb Bernoulli-sampling variance, then trim.
+            total = seed_df.count()
+            if total > self._INIT_SAMPLE:
+                fraction = min(1.0, 2.0 * self._INIT_SAMPLE / total)
+                sample_rows = seed_df.sample(
+                    fraction=fraction, seed=self.getSeed()
+                ).collect()
+                if len(sample_rows) > self._INIT_SAMPLE:
+                    # trim on the driver with an rng, NOT limit() — limit
+                    # would re-bias toward whichever partitions plan first
+                    rng = np.random.default_rng(self.getSeed())
+                    keep = rng.choice(
+                        len(sample_rows), self._INIT_SAMPLE, replace=False
+                    )
+                    sample_rows = [sample_rows[i] for i in keep]
+                elif len(sample_rows) < self.getK():
+                    # pathological sampling shortfall: take everything bounded
+                    sample_rows = seed_df.limit(self._INIT_SAMPLE).collect()
+            else:
+                sample_rows = seed_df.collect()
             if len(sample_rows) < k:
                 raise ValueError(
                     f"k={k} but only {len(sample_rows)} rows with positive "

@@ -258,3 +258,54 @@ class TestWrapperFallThrough:
         np.testing.assert_allclose(m.mean, x.mean(0), rtol=1e-9)
         out = np.asarray(m.transform(x))
         np.testing.assert_allclose(out.std(0, ddof=1), np.ones(5), rtol=1e-9)
+
+    def test_logreg_multinomial_fall_through_predicts(self, rng):
+        # >=3-class local data trains multinomial; the wrapper must carry
+        # coefficientMatrix/interceptVector through or predict crashes
+        x = rng.normal(size=(300, 4))
+        y = np.argmax(x[:, :3], axis=1).astype(float)
+        m = SparkLogisticRegression().setRegParam(0.1).fit((x, y))
+        assert m.coefficientMatrix is not None and m.coefficientMatrix.shape[0] == 3
+        assert m.interceptVector is not None
+        preds = np.asarray(m.transform(x))
+        assert preds.shape == (300,)
+        assert np.mean(preds == y) > 0.8
+        assert float(m.predict(x[0])) in (0.0, 1.0, 2.0)
+
+    def test_checkpoint_kwargs_fall_through(self, rng, tmp_path):
+        x = rng.normal(size=(120, 3))
+        y = (x[:, 0] > 0).astype(float)
+        m = SparkLogisticRegression().fit(
+            (x, y), checkpoint_dir=str(tmp_path), checkpoint_every=1
+        )
+        assert m.coefficients is not None
+        # at least one durable checkpoint landed
+        assert any(tmp_path.iterdir())
+
+    def test_checkpoint_kwargs_linreg_rejected_clearly(self, xy):
+        x, y, coef = xy
+        # LinearRegression has no mid-training loop: a checkpoint request is
+        # a clear NotImplementedError, not a raw TypeError deep in core fit
+        with pytest.raises(NotImplementedError, match="closed-form"):
+            SparkLinearRegression().fit((x, y), checkpoint_dir="/tmp/nope")
+        with pytest.raises(TypeError, match="unexpected"):
+            SparkLinearRegression().fit((x, y), checkpont_dir="/tmp/typo")
+
+    def test_unweighted_none_3tuple_cv(self, rng):
+        # (X, y, None) is the documented unweighted 3-tuple form; fold
+        # slicing must pass the None through untouched
+        from spark_rapids_ml_tpu.models.tuning import (
+            CrossValidator,
+            RegressionEvaluator,
+        )
+
+        x = rng.normal(size=(90, 3))
+        y = x @ np.ones(3)
+        cv = CrossValidator(
+            estimator=LinearRegression(),
+            estimatorParamMaps=[{}],
+            evaluator=RegressionEvaluator(),
+            numFolds=2,
+        )
+        cvm = cv.fit((x, y, None))
+        assert cvm.avgMetrics[0] < 0.1

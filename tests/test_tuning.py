@@ -264,3 +264,56 @@ class TestContainers:
         )
         cvm = cv.fit(df)
         assert cvm.avgMetrics[0] < 0.1
+
+    def test_weighted_3tuple_cv(self, rng):
+        # (X, y, w) instance-weighted data must thread through fold slicing
+        x = rng.normal(size=(160, 3))
+        y = x @ np.ones(3) + 0.01 * rng.normal(size=160)
+        w = rng.uniform(0.5, 2.0, size=160)
+        cv = CrossValidator(
+            estimator=LinearRegression(),
+            estimatorParamMaps=[{"regParam": 0.0}, {"regParam": 0.1}],
+            evaluator=RegressionEvaluator(),
+            numFolds=3,
+        )
+        cvm = cv.fit((x, y, w))
+        assert min(cvm.avgMetrics) < 0.1
+        assert cvm.bestModel.coefficients.shape == (3,)
+
+    def test_weighted_3tuple_tvs(self, rng):
+        from spark_rapids_ml_tpu.models.tuning import TrainValidationSplit
+
+        x = rng.normal(size=(160, 3))
+        y = x @ np.ones(3) + 0.01 * rng.normal(size=160)
+        w = rng.uniform(0.5, 2.0, size=160)
+        tvs = TrainValidationSplit(
+            estimator=LinearRegression(),
+            estimatorParamMaps=[{}],
+            evaluator=RegressionEvaluator(),
+            trainRatio=0.8,
+        )
+        tm = tvs.fit((x, y, w))
+        assert tm.validationMetrics[0] < 0.1
+
+    def test_weights_change_weighted_fit(self, rng):
+        # weights actually reach the estimator: near-zero weight on a
+        # poisoned half must recover the clean coefficients
+        x = rng.normal(size=(200, 2))
+        y = x @ np.array([1.0, -2.0])
+        y_bad = y.copy()
+        y_bad[100:] += 100.0  # poisoned rows
+        w = np.ones(200)
+        w[100:] = 1e-9
+        from spark_rapids_ml_tpu.models.tuning import TrainValidationSplit
+
+        tvs = TrainValidationSplit(
+            estimator=LinearRegression(),
+            estimatorParamMaps=[{}],
+            evaluator=RegressionEvaluator(),
+            trainRatio=0.75,
+            seed=3,
+        )
+        tm = tvs.fit((x, y_bad, w))
+        np.testing.assert_allclose(
+            tm.bestModel.coefficients, [1.0, -2.0], atol=1e-3
+        )
