@@ -1,0 +1,42 @@
+"""localspark — a pyspark-API-compatible local execution engine.
+
+Two jobs, one component:
+
+1. **Execution proof for the Spark integration.** The reference is only
+   testable against a live Spark (`PCASuite.scala:42-88` runs fit/transform
+   on a real SparkSession via the harness `RapidsMLTest.scala:22-33`).
+   pyspark cannot be assumed present, so this package supplies the same
+   proof locally: a ``DataFrame`` whose ``mapInArrow`` ships the plan
+   function to a REAL separate worker process — serialized with
+   cloudpickle (the serializer Spark itself uses for Python UDFs), data
+   crossing as Arrow IPC streams, output validated against the declared
+   schema — so every failure mode Spark introduces (closure pickling,
+   worker-side imports/JAX init, Arrow schema mismatches) is exercised
+   without a JVM. The real-pyspark integration suite runs the same tests
+   against a live SparkSession when pyspark is installed (CI).
+
+2. **Standalone mode for users.** The Spark-facing estimators
+   (``spark_rapids_ml_tpu.spark``) accept these DataFrames
+   interchangeably with pyspark ones, so the drop-in API works on a
+   laptop or a single TPU VM with no Spark cluster at all — a capability
+   the reference cannot offer (it is compiled against the JVM plugin,
+   SURVEY.md §1 L0).
+
+API surface mirrors the ``pyspark.sql`` subset the estimators use:
+``LocalSparkSession.createDataFrame``, ``DataFrame.{select, where, limit,
+sample, randomSplit, repartition, mapInArrow, collect, first, count,
+toArrow, schema}``, ``types.{StructType, StructField, ArrayType,
+DoubleType, ...}``, ``functions.{col, rand, lit}``.
+"""
+
+from spark_rapids_ml_tpu.localspark import functions, types
+from spark_rapids_ml_tpu.localspark.dataframe import DataFrame, Row
+from spark_rapids_ml_tpu.localspark.session import LocalSparkSession
+
+__all__ = [
+    "DataFrame",
+    "LocalSparkSession",
+    "Row",
+    "functions",
+    "types",
+]

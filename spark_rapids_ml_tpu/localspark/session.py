@@ -1,0 +1,311 @@
+"""LocalSparkSession: createDataFrame + the worker-process pool behind
+``mapInArrow`` (see ``worker.py`` for the boundary-fidelity contract)."""
+
+from __future__ import annotations
+
+import atexit
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Any, Iterator
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_ml_tpu.localspark import types as T
+from spark_rapids_ml_tpu.localspark import worker as W
+from spark_rapids_ml_tpu.localspark.dataframe import (
+    DataFrame,
+    Row,
+    _infer_type,
+    dataframe_from_partitions,
+)
+
+
+class WorkerException(RuntimeError):
+    """A mapInArrow plan function raised inside a worker process; carries the
+    worker-side traceback (the analog of pyspark's PythonException)."""
+
+
+class _Worker:
+    """One reusable worker subprocess + its half of the framing protocol."""
+
+    dead = False
+
+    def __init__(self, extra_env: dict[str, str] | None = None):
+        env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
+        self._stderr = tempfile.NamedTemporaryFile(
+            mode="w+b", prefix="localspark-worker-", suffix=".log", delete=False
+        )
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_rapids_ml_tpu.localspark.worker"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=self._stderr,
+            env=env,
+        )
+        self._lock = threading.Lock()
+
+    def run_task(self, fn_bytes: bytes, data: bytes, schema_bytes: bytes) -> bytes:
+        with self._lock:
+            try:
+                out = self.proc.stdin
+                out.write(W.MAGIC)
+                W.write_block(out, fn_bytes)
+                W.write_block(out, data)
+                W.write_block(out, schema_bytes)
+                out.flush()
+                status = self.proc.stdout.read(1)
+                if len(status) != 1:
+                    raise EOFError
+                payload = W.read_block(self.proc.stdout)
+            except (EOFError, BrokenPipeError, OSError) as e:
+                self.dead = True  # session must not reuse this process
+                raise WorkerException(
+                    "localspark worker died mid-task; stderr tail:\n"
+                    + self._stderr_tail()
+                ) from e
+        if status == b"E":
+            import cloudpickle
+
+            raise WorkerException(
+                "mapInArrow plan function failed in the worker process:\n"
+                + cloudpickle.loads(payload)
+            )
+        return payload
+
+    def _stderr_tail(self, limit: int = 4000) -> str:
+        try:
+            with open(self._stderr.name, "rb") as f:
+                data = f.read()
+            return data[-limit:].decode(errors="replace")
+        except OSError:
+            return "<stderr unavailable>"
+
+    def close(self) -> None:
+        try:
+            if self.proc.stdin:
+                self.proc.stdin.close()
+            self.proc.wait(timeout=10)
+        except Exception:
+            self.proc.kill()
+        finally:
+            try:
+                self._stderr.close()
+                os.unlink(self._stderr.name)
+            except OSError:
+                pass
+
+
+class LocalSparkSession:
+    """A no-JVM session with the ``SparkSession`` surface the estimators use.
+
+    Parameters mirror the Spark knobs they stand in for:
+
+    - ``parallelism``: default partition count of ``createDataFrame``
+      (``spark.default.parallelism``)
+    - ``num_workers``: worker processes executing mapInArrow tasks; they are
+      reused across jobs (``spark.python.worker.reuse``)
+    - ``max_records_per_batch``: input chunking so plan functions see
+      multiple batches per partition
+      (``spark.sql.execution.arrow.maxRecordsPerBatch``)
+    - ``worker_env``: extra env for workers — e.g. force
+      ``{"JAX_PLATFORMS": "cpu"}`` so CPU workers don't contend for a
+      single TPU chip the driver holds
+    """
+
+    def __init__(
+        self,
+        parallelism: int = 2,
+        num_workers: int = 1,
+        max_records_per_batch: int = 10_000,
+        worker_env: dict[str, str] | None = None,
+    ):
+        if parallelism < 1 or num_workers < 1 or max_records_per_batch < 1:
+            raise ValueError("parallelism/num_workers/max_records_per_batch >= 1")
+        self.parallelism = parallelism
+        self.num_workers = num_workers
+        self.max_records_per_batch = max_records_per_batch
+        self._worker_env = dict(worker_env or {})
+        self._workers: list[_Worker] = []
+        self._closed = False
+        atexit.register(self.stop)
+
+    # -- DataFrame construction --------------------------------------------
+
+    def createDataFrame(
+        self,
+        data: Any,
+        schema: T.StructType | list[str] | None = None,
+        numPartitions: int | None = None,
+    ) -> DataFrame:
+        if self._closed:
+            raise RuntimeError("session is stopped")
+        # pa.Table first: it also implements the dataframe-interchange
+        # protocol, so the pandas duck-check below would claim it
+        if isinstance(data, pa.Table):
+            struct = T.from_arrow_schema(data.schema)
+            parts = self._split_batches(data, numPartitions or self.parallelism)
+            return dataframe_from_partitions(self, struct, parts)
+        if hasattr(data, "itertuples"):  # pandas (or API-compatible) frame
+            rows = [tuple(r) for r in data.itertuples(index=False)]
+            names = [str(c) for c in data.columns]
+            struct = self._infer_schema(rows, names) if schema is None else schema
+        else:
+            rows = [tuple(r) for r in data]
+            if schema is None:
+                raise ValueError(
+                    "createDataFrame from rows needs a schema (StructType or "
+                    "column names)"
+                )
+            struct = schema
+            names = None
+        if isinstance(struct, list):
+            struct = self._infer_schema(rows, struct)
+        if not isinstance(struct, T.StructType):
+            raise TypeError(f"unsupported schema: {struct!r}")
+
+        arrow_schema = struct.to_arrow()
+        columns = []
+        for i, field in enumerate(arrow_schema):
+            vals = [_coerce_cell(r[i]) for r in rows]
+            columns.append(pa.array(vals, type=field.type))
+        table = pa.Table.from_arrays(columns, schema=arrow_schema)
+        parts = self._split_batches(table, numPartitions or self.parallelism)
+        return dataframe_from_partitions(self, struct, parts)
+
+    def _infer_schema(self, rows, names) -> T.StructType:
+        if not rows:
+            raise ValueError("cannot infer schema from an empty dataset")
+        first = rows[0]
+        if len(first) != len(names):
+            raise ValueError(
+                f"row arity {len(first)} != number of column names {len(names)}"
+            )
+        return T.StructType(
+            [T.StructField(n, _infer_type(v)) for n, v in zip(names, first)]
+        )
+
+    def _split_batches(
+        self, table: pa.Table, num_partitions: int
+    ) -> list[list[pa.RecordBatch]]:
+        cuts = np.linspace(0, table.num_rows, num_partitions + 1).astype(int)
+        return [
+            table.slice(lo, hi - lo).to_batches() if hi > lo else []
+            for lo, hi in zip(cuts[:-1], cuts[1:])
+        ]
+
+    # -- execution ----------------------------------------------------------
+
+    def _chunk_batches(
+        self, part: list[pa.RecordBatch], schema: pa.Schema
+    ) -> bytes:
+        """One partition -> IPC stream, re-chunked to max_records_per_batch."""
+        out = []
+        for b in part:
+            for at in range(0, b.num_rows, self.max_records_per_batch):
+                out.append(b.slice(at, self.max_records_per_batch))
+        return W.batches_to_ipc(out, schema)
+
+    def _ensure_workers(self) -> list[_Worker]:
+        if self._closed:
+            raise RuntimeError("session is stopped")
+        # a crashed worker (segfault/OOM) is replaced, not reused — one
+        # transient death must not poison the session
+        for w in [w for w in self._workers if w.dead or w.proc.poll() is not None]:
+            self._workers.remove(w)
+            w.close()
+        while len(self._workers) < self.num_workers:
+            self._workers.append(_Worker(self._worker_env))
+        return self._workers
+
+    def _run_map_in_arrow(
+        self, func, task_parts: list[bytes], target: pa.Schema
+    ) -> Iterator[list[pa.RecordBatch]]:
+        import cloudpickle
+
+        fn_bytes = cloudpickle.dumps(func)  # fails here exactly like Spark would
+        schema_bytes = target.serialize().to_pybytes()
+        workers = self._ensure_workers()
+        results: list[list[pa.RecordBatch] | None] = [None] * len(task_parts)
+
+        def run_on(worker: _Worker, indices: list[int]) -> None:
+            for i in indices:
+                payload = worker.run_task(fn_bytes, task_parts[i], schema_bytes)
+                results[i], _ = W.batches_from_ipc(payload)
+
+        assignments = [
+            (workers[w], [i for i in range(len(task_parts)) if i % len(workers) == w])
+            for w in range(len(workers))
+        ]
+        live = [a for a in assignments if a[1]]
+        if len(live) == 1:
+            run_on(*live[0])
+        elif live:
+            errors: list[BaseException] = []
+
+            def guarded(a):
+                try:
+                    run_on(*a)
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=guarded, args=(a,), daemon=True) for a in live
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+        yield from (r if r is not None else [] for r in results)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def stop(self) -> None:
+        self._closed = True
+        workers, self._workers = self._workers, []
+        for w in workers:
+            w.close()
+
+    def __enter__(self) -> "LocalSparkSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # pyspark-compat sugar so ``LocalSparkSession.builder...getOrCreate()``
+    # shaped code works in examples
+    class _Builder:
+        def master(self, _):
+            return self
+
+        def appName(self, _):
+            return self
+
+        def config(self, *_, **__):
+            return self
+
+        def getOrCreate(self) -> "LocalSparkSession":
+            return LocalSparkSession()
+
+    class _BuilderDescriptor:
+        def __get__(self, obj, objtype=None) -> "LocalSparkSession._Builder":
+            return LocalSparkSession._Builder()
+
+    builder = _BuilderDescriptor()
+
+
+def _coerce_cell(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, Row):
+        return tuple(v)
+    return v

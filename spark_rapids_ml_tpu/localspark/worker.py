@@ -1,0 +1,145 @@
+"""The localspark Python worker: a separate OS process that executes
+mapInArrow plan functions, mirroring Spark's executor-side Python worker.
+
+Faithfulness to Spark's boundaries is the point (SURVEY.md §4 — the
+reference is only ever tested through a live executor):
+
+- the plan function arrives **cloudpickle-serialized** (the serializer
+  pyspark itself uses for Python UDFs), so un-picklable closures fail here
+  exactly as they would on a cluster;
+- partition data crosses as an **Arrow IPC stream**, so schema/layout
+  assumptions are exercised at a process boundary, not in-process;
+- the worker is a **fresh interpreter** (``python -m``) — module-level
+  state of the driver process is NOT available; the function's own imports
+  (including JAX device init) must work cold, like on an executor;
+- output batches are **cast to the declared schema**, the validation Spark
+  applies to mapInArrow results; a mismatch raises here, not downstream;
+- workers are **reused** across jobs of a session (Spark's
+  ``spark.python.worker.reuse``), so per-process caches (jitted kernels)
+  amortize the way they do on real executors.
+
+Framing protocol, little-endian u64 lengths, one task per request::
+
+    driver -> worker:  b"LSPK" | fn | input-arrow-stream | target-schema
+    worker -> driver:  b"O" | output-arrow-stream        (success)
+                       b"E" | pickled traceback string   (failure)
+
+stdout is re-pointed at stderr after startup so user ``print``\\ s inside
+plan functions cannot corrupt the protocol stream (Spark's workers talk
+over a socket for the same reason).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import sys
+import traceback
+
+import pyarrow as pa
+
+MAGIC = b"LSPK"
+
+
+def write_block(stream, payload: bytes) -> None:
+    stream.write(struct.pack("<Q", len(payload)))
+    stream.write(payload)
+
+
+def read_block(stream) -> bytes:
+    header = stream.read(8)
+    if len(header) != 8:
+        raise EOFError("worker protocol stream truncated")
+    (length,) = struct.unpack("<Q", header)
+    payload = stream.read(length)
+    if len(payload) != length:
+        raise EOFError("worker protocol stream truncated")
+    return payload
+
+
+def batches_to_ipc(batches: list[pa.RecordBatch], schema: pa.Schema) -> bytes:
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, schema) as writer:
+        for b in batches:
+            writer.write_batch(b)
+    return sink.getvalue()
+
+
+def batches_from_ipc(payload: bytes) -> tuple[list[pa.RecordBatch], pa.Schema]:
+    with pa.ipc.open_stream(pa.BufferReader(payload)) as reader:
+        schema = reader.schema
+        return list(reader), schema
+
+
+def cast_to_declared(batch: pa.RecordBatch, target: pa.Schema) -> pa.RecordBatch:
+    """Validate/cast one output batch against the declared mapInArrow schema.
+
+    Matches Spark's behavior: columns are matched by NAME (order-free),
+    value-compatible types are cast, anything else is an error naming the
+    column — so a plan-function bug surfaces at the boundary with a
+    message, not as corrupt downstream data.
+    """
+    if batch.schema.equals(target):
+        return batch
+    cols = []
+    for field in target:
+        idx = batch.schema.get_field_index(field.name)
+        if idx < 0:
+            raise ValueError(
+                f"mapInArrow output is missing declared column {field.name!r}; "
+                f"got columns {batch.schema.names}"
+            )
+        col = batch.column(idx)
+        if col.type != field.type:
+            try:
+                col = col.cast(field.type)
+            except pa.ArrowInvalid as e:
+                raise ValueError(
+                    f"mapInArrow output column {field.name!r} has type "
+                    f"{col.type}, cannot cast to declared {field.type}: {e}"
+                ) from e
+        cols.append(col)
+    return pa.RecordBatch.from_arrays(cols, schema=target)
+
+
+def run_task(fn_bytes: bytes, data: bytes, schema_bytes: bytes) -> bytes:
+    """Execute one mapInArrow task; returns the output IPC stream bytes."""
+    import cloudpickle
+
+    fn = cloudpickle.loads(fn_bytes)
+    batches, _ = batches_from_ipc(data)
+    target = pa.ipc.read_schema(pa.BufferReader(schema_bytes))
+    out = [cast_to_declared(b, target) for b in fn(iter(batches)) ]
+    return batches_to_ipc(out, target)
+
+
+def main() -> None:
+    import cloudpickle
+
+    # keep the protocol fd private; user prints go to stderr
+    proto_in = os.fdopen(os.dup(0), "rb")
+    proto_out = os.fdopen(os.dup(1), "wb")
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    while True:
+        magic = proto_in.read(4)
+        if not magic:
+            return  # driver closed the pipe: clean shutdown
+        if magic != MAGIC:
+            raise RuntimeError(f"bad task frame magic: {magic!r}")
+        fn_bytes = read_block(proto_in)
+        data = read_block(proto_in)
+        schema_bytes = read_block(proto_in)
+        try:
+            payload, status = run_task(fn_bytes, data, schema_bytes), b"O"
+        except BaseException:
+            payload, status = cloudpickle.dumps(traceback.format_exc()), b"E"
+        proto_out.write(status)
+        write_block(proto_out, payload)
+        proto_out.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -12,17 +12,28 @@ This module holds the functions that run INSIDE those workers. They are
 deliberately free of any pyspark import — they consume/produce plain Arrow
 batches — so the whole executor-side computation is unit-testable in any
 environment (the reference's biggest test gap, SURVEY.md §4) and reusable by
-any Arrow-speaking host (DuckDB, Ray datasets, a bare py4j bridge).
+any Arrow-speaking host (localspark, DuckDB, Ray datasets, a bare py4j
+bridge).
 
-Serialization contract: partition-local ``GramStats`` travel back to the
-driver as a ONE-ROW Arrow batch (xtx flattened to a list column) — the analog
-of the reference shipping each partition's n×n breeze matrix through Spark's
-``reduce`` (RapidsRowMatrix.scala:133-139), except the payload here is a
-columnar batch instead of JVM serialization.
+**Serialization contract (what Spark actually ships).** Every plan function
+is a module-level callable CLASS instance whose state is plain data (column
+names, float precision tags, host ndarrays) — never a jitted callable or a
+device array. cloudpickle therefore serializes them compactly and
+deterministically, and the jitted kernels are (re)built lazily inside the
+worker process via the module-level caches below, exactly once per executor
+(mirroring how the reference's JNI singleton loads the native library once
+per executor JVM, JniRAPIDSML.java:27-58).
+
+Partition statistics travel back to the driver as ONE-ROW Arrow batches
+(each array field flattened to a list column) — the analog of the reference
+shipping each partition's n×n breeze matrix through Spark's ``reduce``
+(RapidsRowMatrix.scala:133-139), except the payload here is a columnar batch
+instead of JVM serialization.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Iterable, Iterator
 
 import numpy as np
@@ -47,6 +58,25 @@ def _list_column(values: np.ndarray, row_len: int) -> pa.ListArray:
 
 def _gram_shapes(n: int) -> dict[str, tuple]:
     return {"xtx": (n, n), "col_sum": (n,), "count": ()}
+
+
+# ---------------------------------------------------------------------------
+# Per-process jitted-kernel caches (built lazily INSIDE the worker)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_gram_stats():
+    import jax
+
+    return jax.jit(L.gram_stats, static_argnames=("precision",))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_project():
+    import jax
+
+    return jax.jit(L.project)
 
 
 def stats_to_batch(stats: L.GramStats) -> pa.RecordBatch:
@@ -167,31 +197,302 @@ def _labeled_from_batch(batch, features_col, label_col, weight_col, *, binary=Fa
     return mat, y, sw
 
 
-def make_linreg_partition_fn(
-    features_col: str, label_col: str, weight_col: str | None = None
-) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
-    """mapInArrow body: accumulate a partition's LinearStats on device."""
-    import jax.numpy as jnp
+class _StatsAccumulatorFn:
+    """Base for plan functions that fold a partition into one stats row.
 
-    from spark_rapids_ml_tpu.ops import linear as LIN
+    Subclasses implement ``_batch_stats(batch) -> NamedTuple`` and
+    ``_combine(a, b)``; ``__call__`` is the mapInArrow body. Instances are
+    PICKLABLE BY CONSTRUCTION: ``__init__`` stores only plain host data and
+    anything heavy (jitted kernels, device buffers) is created inside the
+    worker on first batch.
+    """
 
-    def fit_partition(batches):
+    def __call__(
+        self, batches: Iterator[pa.RecordBatch]
+    ) -> Iterator[pa.RecordBatch]:
         acc = None
         for batch in batches:
             if batch.num_rows == 0:
                 continue
-            mat, y, sw = _labeled_from_batch(batch, features_col, label_col, weight_col)
-            xp, yp, w = columnar.pad_labeled(mat, y, sw)
-            stats = LIN.linear_stats(
-                jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w)
-            )
-            acc = stats if acc is None else LIN.combine_linear_stats(acc, stats)
+            stats = self._batch_stats(batch)
+            acc = stats if acc is None else self._combine(acc, stats)
         if acc is not None:
             yield arrays_to_batch(
                 {f: np.asarray(v) for f, v in zip(acc._fields, acc)}
             )
 
-    return fit_partition
+    def _batch_stats(self, batch: pa.RecordBatch):
+        raise NotImplementedError
+
+    def _combine(self, a, b):
+        raise NotImplementedError
+
+
+class FitPartitionFn(_StatsAccumulatorFn):
+    """The fit-pass mapInArrow body: accumulate a partition's GramStats on
+    the local accelerator — one bucket-padded MXU Gram per incoming batch,
+    combined on device. Mirrors the per-partition closure at
+    RapidsRowMatrix.scala:122-137."""
+
+    def __init__(self, input_col: str, precision: str = "highest"):
+        self.input_col = input_col
+        self.precision = precision
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        mat = columnar.extract_matrix(batch, self.input_col)
+        padded, true_rows = columnar.pad_rows(mat)
+        stats = _jitted_gram_stats()(
+            jnp.asarray(padded), precision=L.PRECISIONS[self.precision]
+        )
+        return L.GramStats(
+            stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype)
+        )
+
+    def _combine(self, a, b):
+        return L.combine_gram_stats(a, b)
+
+
+class LinRegPartitionFn(_StatsAccumulatorFn):
+    """mapInArrow body: accumulate a partition's LinearStats on device."""
+
+    def __init__(
+        self, features_col: str, label_col: str, weight_col: str | None = None
+    ):
+        self.features_col = features_col
+        self.label_col = label_col
+        self.weight_col = weight_col
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import linear as LIN
+
+        mat, y, sw = _labeled_from_batch(
+            batch, self.features_col, self.label_col, self.weight_col
+        )
+        xp, yp, w = columnar.pad_labeled(mat, y, sw)
+        return LIN.linear_stats(jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w))
+
+    def _combine(self, a, b):
+        from spark_rapids_ml_tpu.ops import linear as LIN
+
+        return LIN.combine_linear_stats(a, b)
+
+
+class LogRegNewtonPartitionFn(_StatsAccumulatorFn):
+    """mapInArrow body for ONE logistic Newton iteration's statistics.
+
+    The driver runs one Spark job per Newton iteration, broadcasting the
+    current parameter vector in the task state — the standard
+    distributed-IRLS schedule (each iteration is a full data pass; 5-25
+    jobs total). ``w_full`` is a HOST ndarray so the serialized task stays
+    device-free.
+    """
+
+    def __init__(
+        self,
+        features_col: str,
+        label_col: str,
+        w_full: np.ndarray,
+        *,
+        fit_intercept: bool = True,
+        weight_col: str | None = None,
+    ):
+        self.features_col = features_col
+        self.label_col = label_col
+        self.w_full = np.asarray(w_full)
+        self.fit_intercept = fit_intercept
+        self.weight_col = weight_col
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import linear as LIN
+
+        mat, y, sw = _labeled_from_batch(
+            batch, self.features_col, self.label_col, self.weight_col, binary=True
+        )
+        xp, yp, w = columnar.pad_labeled(mat, y, sw)
+        if self.fit_intercept:
+            xp = np.concatenate([xp, np.ones((xp.shape[0], 1), xp.dtype)], axis=1)
+        return LIN.logistic_newton_stats(
+            jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(self.w_full), jnp.asarray(w)
+        )
+
+    def _combine(self, a, b):
+        from spark_rapids_ml_tpu.ops import linear as LIN
+
+        return LIN.combine_newton_stats(a, b)
+
+
+class KMeansPartitionFn(_StatsAccumulatorFn):
+    """mapInArrow body for one Lloyd iteration's KMeansStats (one Spark job
+    per iteration, centers broadcast in the task state as a host array)."""
+
+    def __init__(
+        self, input_col: str, centers: np.ndarray, weight_col: str | None = None
+    ):
+        self.input_col = input_col
+        self.centers = np.asarray(centers)
+        self.weight_col = weight_col
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        mat = columnar.extract_matrix(batch, self.input_col)
+        pm, true_rows = columnar.pad_rows(mat)
+        w = np.zeros(pm.shape[0], columnar.float_dtype_for(pm.dtype))
+        if self.weight_col:
+            w[:true_rows] = columnar.validate_weights(
+                batch.column(self.weight_col).to_numpy(zero_copy_only=False),
+                true_rows,
+                allow_all_zero=True,
+            )
+        else:
+            w[:true_rows] = 1.0
+        return KM.kmeans_stats(
+            jnp.asarray(pm), jnp.asarray(self.centers), jnp.asarray(w)
+        )
+
+    def _combine(self, a, b):
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        return KM.combine_kmeans_stats(a, b)
+
+
+class MomentsPartitionFn(_StatsAccumulatorFn):
+    """mapInArrow body for StandardScaler's moment statistics."""
+
+    def __init__(self, input_col: str):
+        self.input_col = input_col
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        mat = columnar.extract_matrix(batch, self.input_col)
+        # bucket-pad like every other partition fn (zero rows are exact
+        # for the sums; only the count needs fixing), else each distinct
+        # Arrow batch size costs an XLA recompile
+        pm, true_rows = columnar.pad_rows(mat)
+        stats = S.moment_stats(jnp.asarray(pm))
+        return S.MomentStats(
+            count=jnp.asarray(true_rows, stats.count.dtype),
+            total=stats.total,
+            total_sq=stats.total_sq,
+        )
+
+    def _combine(self, a, b):
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        return S.combine_moment_stats(a, b)
+
+
+class MatrixMapPartitionFn:
+    """Generic mapInArrow transform body: apply ``matrix_fn`` to the input
+    column's [rows, n] matrix and append the result — a float64 list column
+    when 2-D (ArrayType), a float64 scalar column when 1-D (predictions).
+    Streaming generalization of the reference's columnar UDF pattern
+    (RapidsPCA.scala:128-161) shared by every model's Spark transform.
+
+    ``matrix_fn`` is typically a fitted model's bound ``_predict_matrix`` —
+    cloudpickle ships the model object (plain params + host ndarrays) to the
+    worker, the closure-broadcast the reference relies on for ``pc``
+    (RapidsPCA.scala:153).
+    """
+
+    def __init__(
+        self,
+        input_col: str,
+        output_col: str,
+        matrix_fn: Callable[[np.ndarray], np.ndarray],
+    ):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.matrix_fn = matrix_fn
+
+    def __call__(self, batches):
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            out = np.asarray(
+                self.matrix_fn(columnar.extract_matrix(batch, self.input_col))
+            )
+            if out.ndim == 2:
+                flat = out.astype(np.float64, copy=False).reshape(-1)
+                col = _list_column(flat, out.shape[1])
+            else:
+                col = pa.array(out.astype(np.float64, copy=False))
+            yield pa.RecordBatch.from_arrays(
+                [*batch.columns, col],
+                schema=batch.schema.append(pa.field(self.output_col, col.type)),
+            )
+
+
+class TransformPartitionFn:
+    """The batched-projection transform body.
+
+    Streaming analog of the reference's columnar UDF (``evaluateColumnar``,
+    RapidsPCA.scala:130-155): each Arrow batch is projected on the local
+    accelerator and re-emitted with the output ArrayType column appended.
+    ``pc`` travels as a HOST ndarray in the serialized task (the reference
+    broadcasts it in the task closure, RapidsPCA.scala:153) and is uploaded
+    to the device once per worker, on the first batch.
+    """
+
+    def __init__(self, input_col: str, output_col: str, pc: np.ndarray):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.pc = np.asarray(pc)
+        self._pc_dev = None  # per-process device copy; never serialized
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_pc_dev"] = None  # device buffers must not cross processes
+        return state
+
+    def __call__(self, batches):
+        import jax.numpy as jnp
+
+        project = _jitted_project()
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            mat = columnar.extract_matrix(batch, self.input_col)
+            padded, true_rows = columnar.pad_rows(mat)
+            xd = jnp.asarray(padded)
+            if self._pc_dev is None or self._pc_dev.dtype != xd.dtype:
+                self._pc_dev = jnp.asarray(self.pc, dtype=xd.dtype)
+            out = np.asarray(project(xd, self._pc_dev))[:true_rows]
+            # FLOAT64 variable-list output column: Spark's ArrayType(Double)
+            # Arrow mapping (reference output is FLOAT64, rapidsml_jni.cu:89)
+            flat = out.astype(np.float64, copy=False).reshape(-1)
+            col = _list_column(flat, out.shape[1])
+            yield pa.RecordBatch.from_arrays(
+                [*batch.columns, col],
+                schema=batch.schema.append(pa.field(self.output_col, col.type)),
+            )
+
+
+# ---------------------------------------------------------------------------
+# Factory aliases — the original closure-factory API, now returning the
+# picklable task objects above
+# ---------------------------------------------------------------------------
+
+
+def make_fit_partition_fn(input_col: str, *, precision: str = "highest"):
+    return FitPartitionFn(input_col, precision)
+
+
+def make_linreg_partition_fn(
+    features_col: str, label_col: str, weight_col: str | None = None
+):
+    return LinRegPartitionFn(features_col, label_col, weight_col)
 
 
 def make_logreg_newton_partition_fn(
@@ -201,217 +502,34 @@ def make_logreg_newton_partition_fn(
     *,
     fit_intercept: bool = True,
     weight_col: str | None = None,
-) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
-    """mapInArrow body for ONE logistic Newton iteration's statistics.
-
-    The driver runs one Spark job per Newton iteration, broadcasting the
-    current parameter vector in the closure — the standard distributed-IRLS
-    schedule (each iteration is a full data pass; 5-25 jobs total).
-    """
-    import jax.numpy as jnp
-
-    from spark_rapids_ml_tpu.ops import linear as LIN
-
-    w_full = np.asarray(w_full)
-
-    def newton_partition(batches):
-        acc = None
-        wj = jnp.asarray(w_full)
-        for batch in batches:
-            if batch.num_rows == 0:
-                continue
-            mat, y, sw = _labeled_from_batch(
-                batch, features_col, label_col, weight_col, binary=True
-            )
-            xp, yp, w = columnar.pad_labeled(mat, y, sw)
-            if fit_intercept:
-                xp = np.concatenate([xp, np.ones((xp.shape[0], 1), xp.dtype)], axis=1)
-            stats = LIN.logistic_newton_stats(
-                jnp.asarray(xp), jnp.asarray(yp), wj, jnp.asarray(w)
-            )
-            acc = stats if acc is None else LIN.combine_newton_stats(acc, stats)
-        if acc is not None:
-            yield arrays_to_batch(
-                {f: np.asarray(v) for f, v in zip(acc._fields, acc)}
-            )
-
-    return newton_partition
+):
+    return LogRegNewtonPartitionFn(
+        features_col,
+        label_col,
+        w_full,
+        fit_intercept=fit_intercept,
+        weight_col=weight_col,
+    )
 
 
 def make_kmeans_partition_fn(
     input_col: str, centers: np.ndarray, weight_col: str | None = None
-) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
-    """mapInArrow body for one Lloyd iteration's KMeansStats (one Spark job
-    per iteration, centers broadcast in the closure)."""
-    import jax.numpy as jnp
-
-    from spark_rapids_ml_tpu.ops import kmeans as KM
-
-    centers = np.asarray(centers)
-
-    def lloyd_partition(batches):
-        acc = None
-        c = jnp.asarray(centers)
-        for batch in batches:
-            if batch.num_rows == 0:
-                continue
-            mat = columnar.extract_matrix(batch, input_col)
-            pm, true_rows = columnar.pad_rows(mat)
-            w = np.zeros(pm.shape[0], columnar.float_dtype_for(pm.dtype))
-            if weight_col:
-                w[:true_rows] = columnar.validate_weights(
-                    batch.column(weight_col).to_numpy(zero_copy_only=False),
-                    true_rows,
-                    allow_all_zero=True,
-                )
-            else:
-                w[:true_rows] = 1.0
-            stats = KM.kmeans_stats(jnp.asarray(pm), c, jnp.asarray(w))
-            acc = stats if acc is None else KM.combine_kmeans_stats(acc, stats)
-        if acc is not None:
-            yield arrays_to_batch(
-                {f: np.asarray(v) for f, v in zip(acc._fields, acc)}
-            )
-
-    return lloyd_partition
+):
+    return KMeansPartitionFn(input_col, centers, weight_col)
 
 
-def make_moments_partition_fn(
-    input_col: str,
-) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
-    """mapInArrow body for StandardScaler's moment statistics."""
-    import jax.numpy as jnp
-
-    from spark_rapids_ml_tpu.ops import scaler as S
-
-    def moments_partition(batches):
-        acc = None
-        for batch in batches:
-            if batch.num_rows == 0:
-                continue
-            mat = columnar.extract_matrix(batch, input_col)
-            # bucket-pad like every other partition fn (zero rows are exact
-            # for the sums; only the count needs fixing), else each distinct
-            # Arrow batch size costs an XLA recompile
-            pm, true_rows = columnar.pad_rows(mat)
-            stats = S.moment_stats(jnp.asarray(pm))
-            stats = S.MomentStats(
-                count=jnp.asarray(true_rows, stats.count.dtype),
-                total=stats.total,
-                total_sq=stats.total_sq,
-            )
-            acc = stats if acc is None else S.combine_moment_stats(acc, stats)
-        if acc is not None:
-            yield arrays_to_batch(
-                {f: np.asarray(v) for f, v in zip(acc._fields, acc)}
-            )
-
-    return moments_partition
+def make_moments_partition_fn(input_col: str):
+    return MomentsPartitionFn(input_col)
 
 
 def make_matrix_map_partition_fn(
     input_col: str, output_col: str, matrix_fn: Callable[[np.ndarray], np.ndarray]
-) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
-    """Generic mapInArrow transform body: apply ``matrix_fn`` to the input
-    column's [rows, n] matrix and append the result — a float64 list column
-    when 2-D (ArrayType), a float64 scalar column when 1-D (predictions).
-    Streaming generalization of the reference's columnar UDF pattern
-    (RapidsPCA.scala:128-161) shared by every model's Spark transform.
-    """
-
-    def map_partition(batches):
-        for batch in batches:
-            if batch.num_rows == 0:
-                continue
-            out = np.asarray(matrix_fn(columnar.extract_matrix(batch, input_col)))
-            if out.ndim == 2:
-                flat = out.astype(np.float64, copy=False).reshape(-1)
-                col = _list_column(flat, out.shape[1])
-            else:
-                col = pa.array(out.astype(np.float64, copy=False))
-            yield pa.RecordBatch.from_arrays(
-                [*batch.columns, col],
-                schema=batch.schema.append(pa.field(output_col, col.type)),
-            )
-
-    return map_partition
+):
+    return MatrixMapPartitionFn(input_col, output_col, matrix_fn)
 
 
-def make_fit_partition_fn(
-    input_col: str, *, precision: str = "highest"
-) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
-    """Build the ``mapInArrow`` body for the fit pass.
-
-    The returned function accumulates a partition's GramStats on the local
-    accelerator — one bucket-padded MXU Gram per incoming batch, combined on
-    device — and yields a single serialized stats row. Mirrors the
-    per-partition closure at RapidsRowMatrix.scala:122-137.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    prec = L.PRECISIONS[precision]
-    gram_stats = jax.jit(L.gram_stats, static_argnames=("precision",))
-
-    def fit_partition(batches: Iterator[pa.RecordBatch]) -> Iterator[pa.RecordBatch]:
-        acc = None
-        for batch in batches:
-            if batch.num_rows == 0:
-                continue
-            mat = columnar.extract_matrix(batch, input_col)
-            padded, true_rows = columnar.pad_rows(mat)
-            stats = gram_stats(jnp.asarray(padded), precision=prec)
-            stats = L.GramStats(
-                stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype)
-            )
-            acc = stats if acc is None else L.combine_gram_stats(acc, stats)
-        if acc is not None:
-            yield stats_to_batch(acc)
-
-    return fit_partition
-
-
-def make_transform_partition_fn(
-    input_col: str, output_col: str, pc: np.ndarray
-) -> Callable[[Iterator[pa.RecordBatch]], Iterator[pa.RecordBatch]]:
-    """Build the ``mapInArrow`` body for the batched-projection transform.
-
-    Streaming analog of the reference's columnar UDF (``evaluateColumnar``,
-    RapidsPCA.scala:130-155): each Arrow batch is projected on the local
-    accelerator and re-emitted with the output ArrayType column appended.
-    ``pc`` is captured in the closure — Spark broadcasts it with the task,
-    the same replication the reference relies on (RapidsPCA.scala:153).
-    """
-    import jax
-    import jax.numpy as jnp
-
-    project = jax.jit(L.project)
-    pc = np.asarray(pc)
-    pc_dev = None  # uploaded once, first batch fixes the device dtype
-
-    def transform_partition(
-        batches: Iterator[pa.RecordBatch],
-    ) -> Iterator[pa.RecordBatch]:
-        nonlocal pc_dev
-        for batch in batches:
-            if batch.num_rows == 0:
-                continue
-            mat = columnar.extract_matrix(batch, input_col)
-            padded, true_rows = columnar.pad_rows(mat)
-            xd = jnp.asarray(padded)
-            if pc_dev is None or pc_dev.dtype != xd.dtype:
-                pc_dev = jnp.asarray(pc, dtype=xd.dtype)
-            out = np.asarray(project(xd, pc_dev))[:true_rows]
-            # FLOAT64 variable-list output column: Spark's ArrayType(Double)
-            # Arrow mapping (reference output is FLOAT64, rapidsml_jni.cu:89)
-            flat = out.astype(np.float64, copy=False).reshape(-1)
-            col = _list_column(flat, out.shape[1])
-            yield pa.RecordBatch.from_arrays(
-                [*batch.columns, col],
-                schema=batch.schema.append(pa.field(output_col, col.type)),
-            )
-
-    return transform_partition
+def make_transform_partition_fn(input_col: str, output_col: str, pc: np.ndarray):
+    return TransformPartitionFn(input_col, output_col, pc)
 
 
 def transform_output_schema(input_schema: pa.Schema, output_col: str) -> pa.Schema:

@@ -46,9 +46,27 @@ def _require_pyspark():
     except ImportError as e:  # pragma: no cover - exercised via message test
         raise ImportError(
             "spark_rapids_ml_tpu.spark.estimators requires pyspark "
-            "(pip install pyspark>=3.4); the core estimators in "
-            "spark_rapids_ml_tpu work without it on pandas/Arrow/ndarray input"
+            "(pip install pyspark>=3.4) for pyspark DataFrames; the core "
+            "estimators work without it on pandas/Arrow/ndarray input, and "
+            "spark_rapids_ml_tpu.localspark offers the DataFrame API "
+            "without a JVM"
         ) from e
+
+
+def _sql_mods(dataset):
+    """(types, functions) modules for the dataset's SQL backend — pyspark's
+    for a pyspark DataFrame, localspark's for the no-JVM engine. All plan
+    construction below goes through this pair, so the two backends run the
+    SAME estimator code."""
+    mod = type(dataset).__module__ or ""
+    if mod.startswith("pyspark."):
+        _require_pyspark()
+        from pyspark.sql import functions, types
+
+        return types, functions
+    from spark_rapids_ml_tpu.localspark import functions, types
+
+    return types, functions
 
 
 class SparkPCA(PCA):
@@ -67,7 +85,7 @@ class SparkPCA(PCA):
                 SparkPCAModel(uid=core.uid, pc=core.pc,
                               explainedVariance=core.explainedVariance)
             )
-        _require_pyspark()
+        T, _ = _sql_mods(dataset)
         input_col = self.getInputCol()
         with trace_range("compute cov"):  # NvtxRange analog, RapidsRowMatrix.scala:62
             selected = dataset.select(input_col)
@@ -89,7 +107,7 @@ class SparkPCA(PCA):
                 input_col, precision=self.getOrDefault("precision")
             )
             stats_df = selected.mapInArrow(
-                fit_fn, schema=_spark_arrays_type(["xtx", "col_sum", "count"])
+                fit_fn, schema=_spark_arrays_type(T, ["xtx", "col_sum", "count"])
             )
             if hasattr(stats_df, "toArrow"):  # PySpark >= 4.0: stays columnar
                 stats = arrow_fns.stats_from_batches(stats_df.toArrow().to_batches())
@@ -122,9 +140,7 @@ class SparkPCAModel(PCAModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)
-        _require_pyspark()
-        from pyspark.sql import types as T
-
+        T, _ = _sql_mods(dataset)
         input_col = self.getInputCol()
         output_col = self.getOutputCol()
         fn = arrow_fns.make_transform_partition_fn(input_col, output_col, self.pc)
@@ -138,7 +154,9 @@ class SparkPCAModel(PCAModel):
 
 def _is_spark_df(dataset: Any) -> bool:
     mod = type(dataset).__module__ or ""
-    return mod.startswith("pyspark.")
+    return mod.startswith("pyspark.") or mod.startswith(
+        "spark_rapids_ml_tpu.localspark"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -146,9 +164,7 @@ def _is_spark_df(dataset: Any) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _spark_arrays_type(fields: list[str]):
-    from pyspark.sql import types as T
-
+def _spark_arrays_type(T, fields: list[str]):
     return T.StructType(
         [T.StructField(f, T.ArrayType(T.DoubleType())) for f in fields]
     )
@@ -157,7 +173,8 @@ def _spark_arrays_type(fields: list[str]):
 def _collect_stats(df, partition_fn, fields: list[str], shapes: dict[str, tuple]):
     """Run a stats mapInArrow pass and sum-merge the per-partition rows on
     the driver (toArrow on PySpark >= 4, collect() fallback below)."""
-    stats_df = df.mapInArrow(partition_fn, schema=_spark_arrays_type(fields))
+    T, _ = _sql_mods(df)
+    stats_df = df.mapInArrow(partition_fn, schema=_spark_arrays_type(T, fields))
     if hasattr(stats_df, "toArrow"):
         return arrow_fns.arrays_from_batches(stats_df.toArrow().to_batches(), shapes)
     return arrow_fns.arrays_from_rows(stats_df.collect(), shapes)
@@ -173,9 +190,9 @@ def _resolve_col(obj, *names) -> str | None:
 
 
 def _spark_transform(model, dataset, matrix_fn, output_col, scalar: bool):
-    from pyspark.sql import types as T
-
-    input_col = _resolve_col(model, "inputCol", "featuresCol")
+    T, _ = _sql_mods(dataset)
+    # Spark ML reads the "features" column when the param is unset
+    input_col = _resolve_col(model, "inputCol", "featuresCol") or "features"
     fn = arrow_fns.make_matrix_map_partition_fn(input_col, output_col, matrix_fn)
     out_type = (
         T.DoubleType() if scalar else T.ArrayType(T.DoubleType())
@@ -243,7 +260,6 @@ class SparkLinearRegression(LinearRegression):
                 uid=core.uid, coefficients=core.coefficients, intercept=core.intercept
             )
             return self._copyValues(model)
-        _require_pyspark()
         feats = self.getOrDefault("featuresCol")
         label = self.getOrDefault("labelCol")
         weight_col = self._paramMap.get("weightCol")
@@ -281,7 +297,6 @@ class SparkLinearRegressionModel(LinearRegressionModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)
-        _require_pyspark()
         return _spark_transform(
             self, dataset, self._predict_matrix,
             self.getOrDefault("predictionCol"), scalar=True,
@@ -307,7 +322,6 @@ class SparkLogisticRegression(LogisticRegression):
             )
             return self._copyValues(model)
         _reject_checkpoint_kwargs(kwargs)
-        _require_pyspark()
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.ops import linear as LIN
@@ -355,7 +369,6 @@ class SparkLogisticRegressionModel(LogisticRegressionModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)
-        _require_pyspark()
         return _spark_transform(
             self, dataset, self._predict_matrix,
             self.getOrDefault("predictionCol"), scalar=True,
@@ -383,15 +396,14 @@ class SparkKMeans(KMeans):
             )
             return self._copyValues(model)
         _reject_checkpoint_kwargs(kwargs)
-        _require_pyspark()
         import jax
         import jax.numpy as jnp
 
-        from pyspark.sql import functions as F
-
         from spark_rapids_ml_tpu.ops import kmeans as KM
 
-        input_col = _resolve_col(self, "inputCol")
+        _, F = _sql_mods(dataset)
+
+        input_col = _resolve_col(self, "inputCol") or "features"
         weight_col = self._paramMap.get("weightCol")
         cols = [input_col] + ([weight_col] if weight_col else [])
         selected = dataset.select(*cols)
@@ -477,7 +489,6 @@ class SparkKMeansModel(KMeansModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)
-        _require_pyspark()
         return _spark_transform(
             self, dataset, self._predict_matrix,
             self.getOutputCol(), scalar=True,
@@ -499,12 +510,11 @@ class SparkStandardScaler(StandardScaler):
                 uid=core.uid, mean=core.mean, std=core.std
             )
             return self._copyValues(model)
-        _require_pyspark()
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.ops import scaler as S
 
-        input_col = _resolve_col(self, "inputCol")
+        input_col = _resolve_col(self, "inputCol") or "features"
         n = _infer_n(dataset, input_col)
         shapes = {"count": (), "total": (n,), "total_sq": (n,)}
         with trace_range("scaler moments"):
@@ -522,7 +532,6 @@ class SparkStandardScalerModel(StandardScalerModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)
-        _require_pyspark()
         return _spark_transform(
             self, dataset, self._scale, self.getOutputCol(), scalar=False
         )

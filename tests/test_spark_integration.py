@@ -1,0 +1,326 @@
+"""Live-DataFrame integration suite for every Spark-facing estimator.
+
+The analog of the reference's only test suite (PCASuite.scala:42-88 on the
+harness RapidsMLTest.scala:22-33): run fit AND transform through the real
+DataFrame execution surface — multi-partition data, plan functions shipped
+to worker processes, results collected back — and compare against the
+core-path (non-Spark) results as the differential oracle, with the
+reference's own sign-invariant abs-tol 1e-5 contract for PCA
+(PCASuite.scala:80-87).
+
+Backends: ``localspark`` always (the no-JVM engine whose mapInArrow runs in
+separate worker processes — see localspark/worker.py for the fidelity
+contract); ``pyspark`` additionally when installed (CI installs it), running
+the SAME tests on a real local[4] SparkSession.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import (
+    KMeans,
+    LinearRegression,
+    LogisticRegression,
+    PCA,
+    StandardScaler,
+)
+from spark_rapids_ml_tpu.spark import (
+    SparkKMeans,
+    SparkLinearRegression,
+    SparkLogisticRegression,
+    SparkPCA,
+    SparkStandardScaler,
+)
+from spark_rapids_ml_tpu.spark.estimators import SparkPCAModel
+
+
+def _have_pyspark() -> bool:
+    try:
+        import pyspark  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+BACKENDS = ["localspark"] + (["pyspark"] if _have_pyspark() else [])
+
+
+class Backend:
+    """One handle bundling (session, types, functions, createDataFrame)."""
+
+    def __init__(self, name, session, types_mod, functions_mod):
+        self.name = name
+        self.session = session
+        self.T = types_mod
+        self.F = functions_mod
+
+    def df(self, rows, schema, partitions=4):
+        if self.name == "localspark":
+            return self.session.createDataFrame(
+                rows, schema, numPartitions=partitions
+            )
+        return self.session.createDataFrame(rows, schema).repartition(partitions)
+
+    def features_schema(self, extra=()):
+        T = self.T
+        fields = [T.StructField("features", T.ArrayType(T.DoubleType()))]
+        for name, t in extra:
+            fields.append(T.StructField(name, t))
+        return T.StructType(fields)
+
+
+@pytest.fixture(scope="module", params=BACKENDS)
+def backend(request):
+    if request.param == "localspark":
+        from spark_rapids_ml_tpu import localspark
+        from spark_rapids_ml_tpu.localspark import functions as LF
+        from spark_rapids_ml_tpu.localspark import types as LT
+
+        # x64 + shared compile cache in the workers so differential
+        # tolerances hold tight and repeated sessions don't re-trace
+        session = localspark.LocalSparkSession(
+            parallelism=4,
+            worker_env={
+                "JAX_PLATFORMS": "cpu",
+                "JAX_ENABLE_X64": "1",
+                "JAX_COMPILATION_CACHE_DIR": "/tmp/jax_test_cache",
+            },
+        )
+        yield Backend("localspark", session, LT, LF)
+        session.stop()
+    else:
+        from pyspark.sql import SparkSession
+        from pyspark.sql import functions as PF
+        from pyspark.sql import types as PT
+
+        session = (
+            SparkSession.builder.master("local[4]")
+            .appName("spark-rapids-ml-tpu-it")
+            .config("spark.sql.execution.arrow.pyspark.enabled", "true")
+            .config("spark.default.parallelism", "4")
+            .config("spark.sql.shuffle.partitions", "4")
+            .getOrCreate()
+        )
+        yield Backend("pyspark", session, PT, PF)
+        session.stop()
+
+
+@pytest.fixture(scope="module")
+def rng_m():
+    return np.random.default_rng(11)
+
+
+class TestSparkPCAIntegration:
+    """fit + transform through live mapInArrow — PCASuite.scala:42-88."""
+
+    def test_fit_transform_differential(self, backend, rng_m):
+        x = rng_m.normal(size=(320, 10))
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=4
+        )
+        est = SparkPCA().setInputCol("features").setOutputCol("pca").setK(4)
+        model = est.fit(df)
+        core = PCA().setInputCol("features").setOutputCol("pca").setK(4).fit(x)
+        # sign-invariant comparison, reference tolerance (PCASuite.scala:80-87)
+        np.testing.assert_allclose(np.abs(model.pc), np.abs(core.pc), atol=1e-5)
+        np.testing.assert_allclose(
+            model.explainedVariance, core.explainedVariance, atol=1e-5
+        )
+
+        out = model.transform(df)
+        rows = out.collect()
+        assert len(rows) == 320
+        got = np.asarray([r["pca"] for r in rows])
+        want = np.asarray(core.transform_rows(x))
+        np.testing.assert_allclose(np.abs(got), np.abs(want), atol=1e-5)
+
+    def test_transform_appends_column_and_keeps_input(self, backend, rng_m):
+        x = rng_m.normal(size=(40, 6))
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=2
+        )
+        model = SparkPCA().setInputCol("features").setOutputCol("out").setK(2).fit(df)
+        out_df = model.transform(df)
+        assert [f.name for f in out_df.schema.fields] == ["features", "out"]
+        row = out_df.first()
+        assert len(row["features"]) == 6 and len(row["out"]) == 2
+
+    def test_k_greater_than_n_fails_before_job(self, backend, rng_m):
+        x = rng_m.normal(size=(12, 3))
+        df = backend.df([(row.tolist(),) for row in x], backend.features_schema())
+        with pytest.raises(ValueError, match="k=5 must be <="):
+            SparkPCA().setInputCol("features").setK(5).fit(df)
+
+    def test_null_feature_vector_rejected(self, backend, rng_m):
+        df = backend.df(
+            [(None,), ([1.0, 2.0],)], backend.features_schema(), partitions=1
+        )
+        with pytest.raises(ValueError, match="null feature"):
+            SparkPCA().setInputCol("features").setK(1).fit(df)
+
+    def test_persistence_round_trip(self, backend, rng_m, tmp_path):
+        x = rng_m.normal(size=(60, 5))
+        df = backend.df([(row.tolist(),) for row in x], backend.features_schema())
+        model = SparkPCA().setInputCol("features").setK(3).fit(df)
+        path = str(tmp_path / "pca_model")
+        model.save(path)
+        loaded = SparkPCAModel.load(path)
+        np.testing.assert_allclose(loaded.pc, model.pc)
+        got = np.asarray([r["pca_features"] for r in loaded.transform(df).collect()])
+        want = np.asarray([r["pca_features"] for r in model.transform(df).collect()])
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_mean_centering_on_df(self, backend, rng_m):
+        # capability-add vs the reference (whose meanCentering is a TODO
+        # stub, RapidsRowMatrix.scala:111-117): verify it on the live path
+        x = rng_m.normal(size=(200, 6)) + 7.0
+        df = backend.df([(row.tolist(),) for row in x], backend.features_schema())
+        model = (
+            SparkPCA().setInputCol("features").setK(3).setMeanCentering(True).fit(df)
+        )
+        core = PCA().setInputCol("features").setK(3).setMeanCentering(True).fit(x)
+        np.testing.assert_allclose(np.abs(model.pc), np.abs(core.pc), atol=1e-5)
+
+
+class TestSparkGLMIntegration:
+    def _labeled_df(self, backend, x, y, w=None, partitions=4):
+        T = backend.T
+        extra = [("label", T.DoubleType())]
+        rows = [(row.tolist(), float(lbl)) for row, lbl in zip(x, y)]
+        if w is not None:
+            extra.append(("wt", T.DoubleType()))
+            rows = [
+                (row.tolist(), float(lbl), float(wi))
+                for row, lbl, wi in zip(x, y, w)
+            ]
+        return backend.df(rows, backend.features_schema(extra), partitions)
+
+    def test_linreg_fit_and_transform(self, backend, rng_m):
+        x = rng_m.normal(size=(400, 5))
+        coef = np.array([1.0, -2.0, 0.5, 3.0, 0.0])
+        y = x @ coef + 1.5 + 0.01 * rng_m.normal(size=400)
+        df = self._labeled_df(backend, x, y)
+        model = SparkLinearRegression().fit(df)
+        core = LinearRegression().fit((x, y))
+        np.testing.assert_allclose(model.coefficients, core.coefficients, atol=1e-6)
+        np.testing.assert_allclose(model.intercept, core.intercept, atol=1e-6)
+        preds = np.asarray([r["prediction"] for r in model.transform(df).collect()])
+        np.testing.assert_allclose(preds, x @ core.coefficients + core.intercept, atol=1e-6)
+
+    def test_linreg_weighted(self, backend, rng_m):
+        x = rng_m.normal(size=(300, 3))
+        y = x @ np.ones(3)
+        y_bad = y.copy()
+        y_bad[150:] += 50.0
+        w = np.ones(300)
+        w[150:] = 1e-12
+        df = self._labeled_df(backend, x, y_bad, w)
+        model = SparkLinearRegression().setWeightCol("wt").fit(df)
+        np.testing.assert_allclose(model.coefficients, np.ones(3), atol=1e-4)
+
+    def test_logreg_newton_over_jobs(self, backend, rng_m):
+        x = rng_m.normal(size=(500, 4))
+        true_w = np.array([2.0, -1.0, 0.5, 0.0])
+        p = 1.0 / (1.0 + np.exp(-(x @ true_w - 0.3)))
+        y = (rng_m.random(500) < p).astype(float)
+        df = self._labeled_df(backend, x, y)
+        est = SparkLogisticRegression().setRegParam(1e-4).setMaxIter(15)
+        model = est.fit(df)
+        core = LogisticRegression().setRegParam(1e-4).setMaxIter(15).fit((x, y))
+        np.testing.assert_allclose(model.coefficients, core.coefficients, atol=1e-5)
+        preds = np.asarray([r["prediction"] for r in model.transform(df).collect()])
+        assert np.mean(preds == y) > 0.8
+
+    def test_logreg_bad_labels_fail_in_worker(self, backend, rng_m):
+        x = rng_m.normal(size=(40, 3))
+        y = rng_m.integers(0, 3, size=40).astype(float)  # 3 classes
+        df = self._labeled_df(backend, x, y)
+        with pytest.raises(Exception, match="0/1 labels"):
+            SparkLogisticRegression().fit(df)
+
+
+class TestSparkKMeansIntegration:
+    def test_fit_matches_core(self, backend, rng_m):
+        centers_true = np.array([[6.0, 6.0], [-6.0, 6.0], [0.0, -7.0]])
+        x = np.vstack(
+            [rng_m.normal(size=(80, 2)) * 0.4 + c for c in centers_true]
+        )
+        perm = rng_m.permutation(len(x))
+        x = x[perm]
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=4
+        )
+        model = SparkKMeans().setK(3).setSeed(5).setMaxIter(20).fit(df)
+        got = np.asarray(sorted(model.clusterCenters.tolist()))
+        want = np.asarray(sorted(centers_true.tolist()))
+        np.testing.assert_allclose(got, want, atol=0.3)
+        preds = np.asarray([r["prediction"] for r in model.transform(df).collect()])
+        assert preds.shape == (240,)
+        assert len(np.unique(preds)) == 3
+
+    def test_seeding_not_biased_by_row_order(self, backend, rng_m, monkeypatch):
+        """Partition-ordered data where head-seeding demonstrably fails:
+        the first _INIT_SAMPLE rows all sit in ONE cluster, and maxIter is
+        too small for Lloyd to recover from seeding all centers there
+        (ADVICE round 1; core KMeans samples correctly, kmeans.py:84-108)."""
+        monkeypatch.setattr(SparkKMeans, "_INIT_SAMPLE", 64)
+        centers_true = np.array(
+            [[20.0, 0.0], [-20.0, 0.0], [0.0, 20.0], [0.0, -20.0]]
+        )
+        # ORDERED: all of cluster 0 first, then 1, 2, 3
+        x = np.vstack(
+            [rng_m.normal(size=(500, 2)) * 0.3 + c for c in centers_true]
+        )
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=4
+        )
+        model = SparkKMeans().setK(4).setSeed(1).setMaxIter(2).fit(df)
+        got = np.asarray(sorted(model.clusterCenters.tolist()))
+        want = np.asarray(sorted(centers_true.tolist()))
+        np.testing.assert_allclose(got, want, atol=1.0)
+
+    def test_weighted_kmeans_df(self, backend, rng_m):
+        T = backend.T
+        x = np.vstack(
+            [
+                rng_m.normal(size=(100, 2)) * 0.2 + [4, 4],
+                rng_m.normal(size=(100, 2)) * 0.2 - [4, 4],
+                rng_m.normal(size=(50, 2)) * 0.2 + [40, 40],  # zero-weight blob
+            ]
+        )
+        w = np.concatenate([np.ones(200), np.zeros(50)])
+        rows = [(row.tolist(), float(wi)) for row, wi in zip(x, w)]
+        df = backend.df(
+            rows, backend.features_schema([("wt", T.DoubleType())]), partitions=3
+        )
+        model = (
+            SparkKMeans().setK(2).setSeed(0).setWeightCol("wt").setMaxIter(15).fit(df)
+        )
+        centers = np.asarray(sorted(model.clusterCenters.tolist()))
+        np.testing.assert_allclose(
+            centers, [[-4.0, -4.0], [4.0, 4.0]], atol=0.3
+        )
+
+
+class TestSparkScalerIntegration:
+    def test_fit_transform(self, backend, rng_m):
+        x = rng_m.normal(size=(250, 6)) * 3.0 + 5.0
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=4
+        )
+        model = (
+            SparkStandardScaler()
+            .setInputCol("features")
+            .setOutputCol("scaled")
+            .setWithMean(True)  # Spark default is withMean=False
+            .fit(df)
+        )
+        core = StandardScaler().setInputCol("features").setWithMean(True).fit(x)
+        np.testing.assert_allclose(model.mean, core.mean, atol=1e-9)
+        np.testing.assert_allclose(model.std, core.std, atol=1e-9)
+        out = np.asarray(
+            [r["scaled"] for r in model.transform(df).collect()]
+        )
+        np.testing.assert_allclose(out.mean(0), np.zeros(6), atol=1e-9)
+        np.testing.assert_allclose(out.std(0, ddof=1), np.ones(6), atol=1e-9)
