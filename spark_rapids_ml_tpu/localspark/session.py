@@ -16,6 +16,7 @@ import pyarrow as pa
 
 from spark_rapids_ml_tpu.localspark import types as T
 from spark_rapids_ml_tpu.localspark import worker as W
+from spark_rapids_ml_tpu.utils import devicepolicy
 from spark_rapids_ml_tpu.localspark.dataframe import (
     DataFrame,
     Row,
@@ -34,10 +35,10 @@ class _Worker:
 
     dead = False
 
-    def __init__(self, extra_env: dict[str, str] | None = None):
-        env = dict(os.environ)
-        if extra_env:
-            env.update(extra_env)
+    def __init__(self, extra_env: dict[str, str | None] | None = None):
+        env = devicepolicy.apply_overrides(os.environ, extra_env or {})
+        self._probe_armed = bool(env.get(devicepolicy.PROBE_VAR))
+        self._tasks_done = 0
         self._stderr = tempfile.NamedTemporaryFile(
             mode="w+b", prefix="localspark-worker-", suffix=".log", delete=False
         )
@@ -65,10 +66,27 @@ class _Worker:
                 payload = W.read_block(self.proc.stdout)
             except (EOFError, BrokenPipeError, OSError) as e:
                 self.dead = True  # session must not reuse this process
+                try:  # EOF can precede process teardown: wait briefly for rc
+                    rc = self.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    rc = None
+                # the probe can only fail before the first task of an armed
+                # worker — a later rc collision is an unrelated crash
+                if (
+                    rc == devicepolicy.PROBE_EXIT_CODE
+                    and self._probe_armed
+                    and self._tasks_done == 0
+                ):
+                    raise WorkerException(
+                        "localspark worker failed its device-policy probe "
+                        "(see utils/devicepolicy.py); stderr tail:\n"
+                        + self._stderr_tail()
+                    ) from e
                 raise WorkerException(
-                    "localspark worker died mid-task; stderr tail:\n"
-                    + self._stderr_tail()
+                    f"localspark worker died mid-task (exit code {rc}); "
+                    "stderr tail:\n" + self._stderr_tail()
                 ) from e
+        self._tasks_done += 1
         if status == b"E":
             import cloudpickle
 
@@ -113,9 +131,15 @@ class LocalSparkSession:
     - ``max_records_per_batch``: input chunking so plan functions see
       multiple batches per partition
       (``spark.sql.execution.arrow.maxRecordsPerBatch``)
-    - ``worker_env``: extra env for workers — e.g. force
-      ``{"JAX_PLATFORMS": "cpu"}`` so CPU workers don't contend for a
-      single TPU chip the driver holds
+    - ``worker_platform``: the device policy for worker processes (see
+      ``utils.devicepolicy``). Default ``"cpu"`` — one device owner per
+      host: the driver keeps the accelerator, workers run the JAX CPU
+      backend, and the known accelerator-bootstrap env triggers are
+      scrubbed from worker environments so an interpreter-start plugin
+      cannot claim (or block on) the chip. Pass ``None`` to let workers
+      inherit the parent environment untouched.
+    - ``worker_env``: extra env overrides for workers, applied on top of
+      the device policy (a value of ``None`` removes the variable)
     """
 
     def __init__(
@@ -123,14 +147,16 @@ class LocalSparkSession:
         parallelism: int = 2,
         num_workers: int = 1,
         max_records_per_batch: int = 10_000,
-        worker_env: dict[str, str] | None = None,
+        worker_env: dict[str, str | None] | None = None,
+        worker_platform: str | None = "cpu",
     ):
         if parallelism < 1 or num_workers < 1 or max_records_per_batch < 1:
             raise ValueError("parallelism/num_workers/max_records_per_batch >= 1")
         self.parallelism = parallelism
         self.num_workers = num_workers
         self.max_records_per_batch = max_records_per_batch
-        self._worker_env = dict(worker_env or {})
+        self._worker_env = devicepolicy.worker_env(worker_platform)
+        self._worker_env.update(worker_env or {})
         self._workers: list[_Worker] = []
         self._closed = False
         atexit.register(self.stop)

@@ -123,6 +123,23 @@ def main() -> None:
     os.dup2(2, 1)
     sys.stdout = sys.stderr
 
+    # Device-policy probe BEFORE accepting tasks: if this process cannot
+    # initialize JAX on its assigned platform within a bounded time, exit
+    # with a diagnosable error instead of hanging the first fit() job
+    # indefinitely (utils/devicepolicy.py documents why the env var alone is
+    # not enough). Armed by the session only on accelerator-attached hosts,
+    # because it costs the cold-interpreter fidelity documented above. The
+    # driver maps PROBE_EXIT_CODE to a policy-specific WorkerException.
+    from spark_rapids_ml_tpu.utils import devicepolicy
+
+    if os.environ.get(devicepolicy.PROBE_VAR):
+        try:
+            devicepolicy.probe_platform()
+        except devicepolicy.DevicePolicyError as e:
+            print(f"[tpu-ml worker] device policy violation: {e}", file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(devicepolicy.PROBE_EXIT_CODE)
+
     while True:
         magic = proto_in.read(4)
         if not magic:

@@ -1,0 +1,175 @@
+"""Worker device-assignment policy: who owns the accelerator on a host.
+
+The reference's executor topology gives every executor JVM its own GPU and a
+one-singleton-per-process native loader (JniRAPIDSML.java:27-58) — device
+ownership is decided by Spark's resource scheduling before any task code
+runs. On TPU hosts the equivalent decision must be made *by us*, because a
+JAX process claims its accelerator at interpreter start (a PJRT plugin
+registered from `sitecustomize`/`.pth` hooks), **before** any framework code
+executes. Two consequences this module owns:
+
+1. ``JAX_PLATFORMS=cpu`` in a child's environment is NOT sufficient to keep
+   it off the accelerator: a site-installed bootstrap can register and dial
+   the device plugin at interpreter start regardless, and when another
+   process (the driver) already holds the single chip the child blocks
+   indefinitely waiting for a grant — an unbounded hang, observed in
+   practice, not an error.
+2. Therefore the policy is enforced in TWO places: the *parent* scrubs the
+   known accelerator-bootstrap trigger variables from the child environment
+   (so the plugin never registers), and the *child* runs a bounded-time
+   device probe that fail-fasts with a diagnosable error if it still ended
+   up on the wrong platform or cannot initialize at all.
+
+Default policy — **one device owner per host**: the driver process owns the
+accelerator; worker subprocesses run the JAX CPU backend. This matches the
+single-chip topology of a TPU host where N Python workers cannot share the
+chip the way N CUDA contexts share a GPU. Opt out by constructing
+``LocalSparkSession(worker_platform=None)`` (workers inherit the parent
+environment untouched — appropriate when each worker host has its own
+accelerator, i.e. a real multi-host cluster).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping
+
+# Environment variables whose mere presence makes an interpreter-start hook
+# register an accelerator PJRT plugin (and potentially dial/claim the
+# device). Scrubbed from worker environments under the "cpu" policy.
+# Extensible without a code change via TPU_ML_WORKER_SCRUB_VARS (comma-sep).
+ACCELERATOR_BOOTSTRAP_VARS: tuple[str, ...] = (
+    "PALLAS_AXON_POOL_IPS",   # axon PJRT bootstrap trigger
+    "AXON_POOL_SVC_OVERRIDE",
+    "AXON_LOOPBACK_RELAY",
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_WORKER_ID",
+    "TPU_VISIBLE_DEVICES",
+)
+
+# Env contract between the session (parent) and worker (child):
+PLATFORM_VAR = "TPU_ML_WORKER_PLATFORM"          # expected jax platform name
+PROBE_VAR = "TPU_ML_WORKER_PROBE"                # "1": probe at worker startup
+PROBE_TIMEOUT_VAR = "TPU_ML_WORKER_PROBE_TIMEOUT"  # seconds, float
+DEFAULT_PROBE_TIMEOUT = 60.0
+
+# Exit code a worker uses for a failed device probe; distinguishable in the
+# driver's WorkerException from a plan-function crash.
+PROBE_EXIT_CODE = 17
+
+
+def scrub_vars() -> tuple[str, ...]:
+    extra = tuple(
+        v.strip()
+        for v in os.environ.get("TPU_ML_WORKER_SCRUB_VARS", "").split(",")
+        if v.strip()
+    )
+    return ACCELERATOR_BOOTSTRAP_VARS + extra
+
+
+def worker_env(platform: str | None = "cpu") -> dict[str, str | None]:
+    """Environment overrides for a worker subprocess under ``platform``.
+
+    A value of ``None`` means *remove the variable* from the inherited
+    environment (the caller applies this — see LocalSparkSession._Worker).
+    ``platform=None`` returns no overrides: the child inherits everything,
+    including accelerator ownership.
+    """
+    if platform is None:
+        return {}
+    env: dict[str, str | None] = {v: None for v in scrub_vars()}
+    env["JAX_PLATFORMS"] = platform
+    env[PLATFORM_VAR] = platform
+    # The startup probe initializes JAX inside the worker, which costs ~1s
+    # and forecloses pre-init jax.config choices by plan functions — so it
+    # is armed only where the risk it guards against exists: hosts whose
+    # parent environment carries an accelerator bootstrap trigger. On clean
+    # CPU hosts workers keep their cold-interpreter fidelity.
+    if any(v in os.environ for v in scrub_vars()):
+        env[PROBE_VAR] = "1"
+    return env
+
+
+def apply_overrides(
+    base: Mapping[str, str], overrides: Mapping[str, str | None]
+) -> dict[str, str]:
+    """Merge ``overrides`` into a copy of ``base``; ``None`` deletes."""
+    env = dict(base)
+    for key, value in overrides.items():
+        if value is None:
+            env.pop(key, None)
+        else:
+            env[key] = value
+    return env
+
+
+class DevicePolicyError(RuntimeError):
+    """The worker process could not honor its assigned device platform."""
+
+
+def probe_platform(
+    expected: str | None = None, timeout: float | None = None
+) -> str:
+    """Initialize JAX and verify the backend platform, in bounded time.
+
+    Runs ``jax.devices()`` on a daemon thread and waits at most ``timeout``
+    seconds. Three failure modes, all raising :class:`DevicePolicyError`
+    (instead of the unbounded hang that motivates this module):
+
+    - the probe does not complete in time (an interpreter-start plugin is
+      blocking on a device grant another process holds);
+    - JAX initialization raised;
+    - the initialized platform differs from ``expected``.
+
+    Returns the platform name on success. ``expected``/``timeout`` default
+    from the TPU_ML_WORKER_* env contract.
+    """
+    import threading
+
+    if expected is None:
+        expected = os.environ.get(PLATFORM_VAR) or None
+    if timeout is None:
+        raw = os.environ.get(PROBE_TIMEOUT_VAR, str(DEFAULT_PROBE_TIMEOUT))
+        try:
+            timeout = float(raw)
+        except ValueError as e:
+            raise DevicePolicyError(
+                f"{PROBE_TIMEOUT_VAR}={raw!r} is not a number of seconds"
+            ) from e
+    result: dict[str, str] = {}
+
+    def _probe() -> None:
+        try:
+            import jax
+
+            result["platform"] = jax.devices()[0].platform
+        except BaseException as e:  # noqa: BLE001 - reported to the parent
+            result["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_probe, name="tpu-ml-device-probe", daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise DevicePolicyError(
+            f"device probe did not complete within {timeout}s: JAX backend "
+            "initialization is blocked — most likely an accelerator plugin "
+            "registered at interpreter start is waiting for a device another "
+            "process owns. Scrub the bootstrap variables from the worker "
+            f"environment (see devicepolicy.ACCELERATOR_BOOTSTRAP_VARS / "
+            f"TPU_ML_WORKER_SCRUB_VARS) or raise {PROBE_TIMEOUT_VAR}."
+        )
+    if "error" in result:
+        raise DevicePolicyError(
+            f"JAX failed to initialize in the worker: {result['error']}"
+        )
+    platform = result.get("platform", "<unknown>")
+    if expected is not None and platform != expected:
+        raise DevicePolicyError(
+            f"worker was assigned platform {expected!r} but JAX initialized "
+            f"{platform!r}. Under the one-device-owner-per-host policy the "
+            "driver owns the accelerator and workers must run on CPU; a "
+            "site-level bootstrap overrode the worker's JAX_PLATFORMS. "
+            "Remove the bootstrap trigger from the worker environment or run "
+            "the session with worker_platform=None to hand workers the device."
+        )
+    return platform
