@@ -221,7 +221,10 @@ class DataFrame:
 
     # -- the execution boundary --------------------------------------------
 
-    def mapInArrow(self, func, schema) -> "DataFrame":
+    def mapInArrow(self, func, schema, barrier: bool = False) -> "DataFrame":
+        """pyspark 3.5 signature incl. ``barrier``: when True all partition
+        tasks launch simultaneously with a BarrierTaskContext (the surface an
+        SPMD ``jax.distributed`` bootstrap needs — see session docstring)."""
         if isinstance(schema, str):
             raise TypeError(
                 "localspark mapInArrow takes a StructType schema, not a DDL string"
@@ -235,7 +238,12 @@ class DataFrame:
                 session._chunk_batches(part, self._arrow_schema())
                 for part in self._parts()
             ]
-            yield from session._run_map_in_arrow(func, task_parts, arrow_target)
+            runner = (
+                session._run_map_in_arrow_barrier
+                if barrier
+                else session._run_map_in_arrow
+            )
+            yield from runner(func, task_parts, arrow_target)
 
         return self._derive(out_schema, parts)
 

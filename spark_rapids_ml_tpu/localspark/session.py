@@ -51,14 +51,27 @@ class _Worker:
         )
         self._lock = threading.Lock()
 
-    def run_task(self, fn_bytes: bytes, data: bytes, schema_bytes: bytes) -> bytes:
+    def run_task(
+        self,
+        fn_bytes: bytes,
+        data: bytes,
+        schema_bytes: bytes,
+        context: dict | None = None,
+    ) -> bytes:
         with self._lock:
             try:
                 out = self.proc.stdin
-                out.write(W.MAGIC)
+                if context is None:
+                    out.write(W.MAGIC)
+                else:
+                    out.write(W.MAGIC_BARRIER)
                 W.write_block(out, fn_bytes)
                 W.write_block(out, data)
                 W.write_block(out, schema_bytes)
+                if context is not None:
+                    import json
+
+                    W.write_block(out, json.dumps(context).encode())
                 out.flush()
                 status = self.proc.stdout.read(1)
                 if len(status) != 1:
@@ -157,6 +170,8 @@ class LocalSparkSession:
         self.max_records_per_batch = max_records_per_batch
         self._worker_env = devicepolicy.worker_env(worker_platform)
         self._worker_env.update(worker_env or {})
+        # rendezvous bound for barrier stages (spark.barrier.sync.timeout)
+        self.barrier_timeout = 120.0
         self._workers: list[_Worker] = []
         self._closed = False
         atexit.register(self.stop)
@@ -289,6 +304,71 @@ class LocalSparkSession:
                 t.join()
             if errors:
                 raise errors[0]
+        yield from (r if r is not None else [] for r in results)
+
+    def _run_map_in_arrow_barrier(
+        self, func, task_parts: list[bytes], target: pa.Schema
+    ) -> Iterator[list[pa.RecordBatch]]:
+        """Barrier-mode stage: every partition's task launches SIMULTANEOUSLY
+        in its own FRESH worker process, with a shared BarrierTaskContext for
+        rendezvous/allGather — Spark's ``RDD.barrier()`` semantics, which an
+        SPMD mesh program needs from the scheduler.
+
+        Fresh (non-reused) workers are deliberate: a barrier task typically
+        bootstraps ``jax.distributed`` for the stage's process group, which
+        must happen before the interpreter's first JAX backend init — a
+        reused worker (or one that ran the device-policy probe) has already
+        initialized JAX. The workers are torn down when the stage ends, like
+        Spark executors finishing a barrier stage. The startup probe is
+        disarmed for the same reason; the bootstrap-trigger scrub (the part
+        that prevents the accelerator hang) still applies.
+        """
+        import cloudpickle
+
+        from spark_rapids_ml_tpu.utils import devicepolicy
+
+        if self._closed:
+            raise RuntimeError("session is stopped")
+        n = len(task_parts)
+        fn_bytes = cloudpickle.dumps(func)
+        schema_bytes = target.serialize().to_pybytes()
+        barrier_dir = tempfile.mkdtemp(prefix="localspark-barrier-")
+        env = dict(self._worker_env)
+        env.pop(devicepolicy.PROBE_VAR, None)
+        workers = [_Worker(env) for _ in range(n)]
+        results: list[list[pa.RecordBatch] | None] = [None] * n
+        errors: list[BaseException] = []
+
+        def run_one(rank: int) -> None:
+            context = {
+                "partition_id": rank,
+                "num_tasks": n,
+                "barrier_dir": barrier_dir,
+                "timeout": self.barrier_timeout,
+            }
+            try:
+                payload = workers[rank].run_task(
+                    fn_bytes, task_parts[rank], schema_bytes, context
+                )
+                results[rank], _ = W.batches_from_ipc(payload)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run_one, args=(r,), daemon=True)
+            for r in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for w in workers:
+            w.close()
+        import shutil
+
+        shutil.rmtree(barrier_dir, ignore_errors=True)
+        if errors:
+            raise errors[0]
         yield from (r if r is not None else [] for r in results)
 
     # -- lifecycle -----------------------------------------------------------

@@ -21,8 +21,14 @@ reference is only ever tested through a live executor):
 Framing protocol, little-endian u64 lengths, one task per request::
 
     driver -> worker:  b"LSPK" | fn | input-arrow-stream | target-schema
+    driver -> worker:  b"LSPB" | fn | input-arrow-stream | target-schema
+                       | json task-context               (barrier task)
     worker -> driver:  b"O" | output-arrow-stream        (success)
                        b"E" | pickled traceback string   (failure)
+
+A barrier frame additionally installs a ``BarrierTaskContext`` (see
+``taskcontext.py``) before invoking the plan function, the way Spark's
+worker exposes ``BarrierTaskContext.get()`` inside barrier stages.
 
 stdout is re-pointed at stderr after startup so user ``print``\\ s inside
 plan functions cannot corrupt the protocol stream (Spark's workers talk
@@ -40,6 +46,7 @@ import traceback
 import pyarrow as pa
 
 MAGIC = b"LSPK"
+MAGIC_BARRIER = b"LSPB"
 
 
 def write_block(stream, payload: bytes) -> None:
@@ -103,14 +110,34 @@ def cast_to_declared(batch: pa.RecordBatch, target: pa.Schema) -> pa.RecordBatch
     return pa.RecordBatch.from_arrays(cols, schema=target)
 
 
-def run_task(fn_bytes: bytes, data: bytes, schema_bytes: bytes) -> bytes:
+def run_task(
+    fn_bytes: bytes,
+    data: bytes,
+    schema_bytes: bytes,
+    context: dict | None = None,
+) -> bytes:
     """Execute one mapInArrow task; returns the output IPC stream bytes."""
     import cloudpickle
+
+    from spark_rapids_ml_tpu.localspark.taskcontext import BarrierTaskContext
 
     fn = cloudpickle.loads(fn_bytes)
     batches, _ = batches_from_ipc(data)
     target = pa.ipc.read_schema(pa.BufferReader(schema_bytes))
-    out = [cast_to_declared(b, target) for b in fn(iter(batches)) ]
+    if context is not None:
+        BarrierTaskContext._install(
+            BarrierTaskContext(
+                partition_id=context["partition_id"],
+                num_tasks=context["num_tasks"],
+                barrier_dir=context["barrier_dir"],
+                timeout=context.get("timeout", 120.0),
+            )
+        )
+    try:
+        out = [cast_to_declared(b, target) for b in fn(iter(batches))]
+    finally:
+        if context is not None:
+            BarrierTaskContext._install(None)
     return batches_to_ipc(out, target)
 
 
@@ -140,17 +167,22 @@ def main() -> None:
             sys.stderr.flush()
             os._exit(devicepolicy.PROBE_EXIT_CODE)
 
+    import json
+
     while True:
         magic = proto_in.read(4)
         if not magic:
             return  # driver closed the pipe: clean shutdown
-        if magic != MAGIC:
+        if magic not in (MAGIC, MAGIC_BARRIER):
             raise RuntimeError(f"bad task frame magic: {magic!r}")
         fn_bytes = read_block(proto_in)
         data = read_block(proto_in)
         schema_bytes = read_block(proto_in)
+        context = (
+            json.loads(read_block(proto_in)) if magic == MAGIC_BARRIER else None
+        )
         try:
-            payload, status = run_task(fn_bytes, data, schema_bytes), b"O"
+            payload, status = run_task(fn_bytes, data, schema_bytes, context), b"O"
         except BaseException:
             payload, status = cloudpickle.dumps(traceback.format_exc()), b"E"
         proto_out.write(status)

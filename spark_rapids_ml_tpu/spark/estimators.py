@@ -34,6 +34,7 @@ from spark_rapids_ml_tpu.models.linear import (
 )
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
 from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel
+from spark_rapids_ml_tpu.models.params import Param
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.spark import arrow_fns
 from spark_rapids_ml_tpu.utils.tracing import trace_range
@@ -78,6 +79,34 @@ class SparkPCA(PCA):
     one estimator serves both worlds.
     """
 
+    distribution = Param(
+        "distribution",
+        "cross-partition reduction strategy for DataFrame fits: "
+        "'driver-merge' (per-partition stats rows merged on the driver — "
+        "the portable path, architecture parity with the reference's JVM "
+        "reduce, RapidsRowMatrix.scala:139), 'mesh-barrier' (all partition "
+        "tasks form one jax.distributed SPMD mesh inside a barrier stage "
+        "and the reduction is a psum collective in one XLA program — the "
+        "driver receives a single pre-reduced row; see spark/spmd.py), or "
+        "'mesh-local' (rows stream to the driver process, which runs the "
+        "same psum program over ITS device mesh — the one-device-owner-"
+        "per-host deployment where the driver holds all local chips; see "
+        "utils/devicepolicy.py)",
+        str,
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(distribution="driver-merge")
+
+    def setDistribution(self, value: str) -> "SparkPCA":
+        if value not in ("driver-merge", "mesh-barrier", "mesh-local"):
+            raise ValueError(
+                "distribution must be 'driver-merge', 'mesh-barrier', or "
+                "'mesh-local'"
+            )
+        return self._set(distribution=value)
+
     def fit(self, dataset: Any, num_partitions: int | None = None) -> "SparkPCAModel":
         if not _is_spark_df(dataset):
             core = super().fit(dataset, num_partitions)
@@ -103,16 +132,47 @@ class SparkPCA(PCA):
             # validate before launching the cluster-wide Gram pass
             if k > n:
                 raise ValueError(f"k={k} must be <= number of features {n}")
-            fit_fn = arrow_fns.make_fit_partition_fn(
-                input_col, precision=self.getOrDefault("precision")
-            )
-            stats_df = selected.mapInArrow(
-                fit_fn, schema=_spark_arrays_type(T, ["xtx", "col_sum", "count"])
-            )
-            if hasattr(stats_df, "toArrow"):  # PySpark >= 4.0: stays columnar
-                stats = arrow_fns.stats_from_batches(stats_df.toArrow().to_batches())
-            else:  # PySpark 3.4/3.5: tiny payload (one [n,n] row per partition)
-                stats = arrow_fns.stats_from_rows(stats_df.collect())
+            distribution = self.getOrDefault("distribution")
+            if distribution == "mesh-barrier":
+                from spark_rapids_ml_tpu.spark import spmd
+
+                fit_fn = spmd.MeshGramPartitionFn(
+                    input_col, precision=self.getOrDefault("precision")
+                )
+                stats_df = selected.mapInArrow(
+                    fit_fn,
+                    schema=_spark_arrays_type(T, spmd.MESH_FIELDS),
+                    barrier=True,
+                )
+                batches = (
+                    stats_df.toArrow().to_batches()
+                    if hasattr(stats_df, "toArrow")
+                    else None
+                )
+                if batches is not None:
+                    stats, _ = spmd.single_stats_from_batches(batches, n)
+                else:  # PySpark 3.5 collect() fallback
+                    rows = stats_df.collect()
+                    stats, _ = spmd.single_stats_from_batches(
+                        [arrow_fns.arrays_to_batch(
+                            {f: np.asarray(r[f], dtype=np.float64)
+                             for f in spmd.MESH_FIELDS}
+                        ) for r in rows],
+                        n,
+                    )
+            elif distribution == "mesh-local":
+                stats = self._mesh_local_stats(selected, input_col, n)
+            else:
+                fit_fn = arrow_fns.make_fit_partition_fn(
+                    input_col, precision=self.getOrDefault("precision")
+                )
+                stats_df = selected.mapInArrow(
+                    fit_fn, schema=_spark_arrays_type(T, ["xtx", "col_sum", "count"])
+                )
+                if hasattr(stats_df, "toArrow"):  # PySpark >= 4.0: stays columnar
+                    stats = arrow_fns.stats_from_batches(stats_df.toArrow().to_batches())
+                else:  # PySpark 3.4/3.5: tiny payload (one [n,n] row per partition)
+                    stats = arrow_fns.stats_from_rows(stats_df.collect())
         with trace_range("eigh"):
             import jax.numpy as jnp
 
@@ -131,6 +191,45 @@ class SparkPCA(PCA):
             uid=self.uid, pc=np.asarray(pc), explainedVariance=np.asarray(ev)
         )
         return self._copyValues(model)
+
+    def _mesh_local_stats(self, selected, input_col: str, n: int) -> L.GramStats:
+        """'mesh-local': stream rows to the driver and run the psum Gram
+        program over the driver's own device mesh (parallel/gram.py) — the
+        deployment where one process owns every local chip and DataFrame
+        workers only do ingestion. Same XLA program as the in-core mesh
+        path; zero pad rows are exact, the true count overrides."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.parallel import gram as G
+        from spark_rapids_ml_tpu.parallel import mesh as M
+        from spark_rapids_ml_tpu.utils import columnar
+
+        if hasattr(selected, "toArrow"):
+            batches = selected.toArrow().to_batches()
+            mats = [
+                columnar.extract_matrix(b, input_col)
+                for b in batches
+                if b.num_rows
+            ]
+            mat = np.concatenate(mats, axis=0)
+        else:  # PySpark 3.5: row collect fallback
+            mat = np.asarray(
+                [r[0] for r in selected.collect()], dtype=np.float64
+            )
+        rows = mat.shape[0]
+        mesh = M.create_mesh()
+        ndev = mesh.size
+        shard = columnar.bucket_rows(-(-rows // ndev))
+        padded = np.zeros((shard * ndev, n), dtype=mat.dtype)
+        padded[:rows] = mat
+        xs = jax.device_put(jnp.asarray(padded), M.data_sharding(mesh))
+        stats = G.sharded_gram_stats(
+            xs, mesh, precision=L.PRECISIONS[self.getOrDefault("precision")]
+        )
+        return L.GramStats(
+            stats.xtx, stats.col_sum, jnp.asarray(float(rows), stats.count.dtype)
+        )
 
 
 class SparkPCAModel(PCAModel):

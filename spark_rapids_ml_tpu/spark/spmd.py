@@ -1,0 +1,221 @@
+"""Executors as an SPMD mesh — the barrier-stage fit path.
+
+This is the north-star architecture move over the reference: its fit()
+reduces per-partition Gram matrices through the JVM heap and Spark's shuffle
+(RapidsRowMatrix.scala:133-139). Here, the N partition tasks of ONE barrier
+stage bootstrap a ``jax.distributed`` process group and execute a single
+SPMD XLA program in which the cross-partition reduction is a ``psum``
+collective — ICI on a TPU pod, Gloo/DCN on CPU hosts — and the driver only
+ever receives the one already-reduced statistics row. No per-partition
+[n, n] buffer crosses a process boundary or touches the driver.
+
+How the Spark scheduler meets the mesh (SURVEY.md §7 hard part 2):
+
+1. the estimator launches ``mapInArrow(fn, schema, barrier=True)`` — Spark's
+   barrier execution mode guarantees all N tasks run simultaneously;
+2. inside each task, one ``allGather`` round (BarrierTaskContext — pyspark's
+   or localspark's) exchanges ``{rank, rows, coordinator}``: rank 0 proposes
+   its address plus a free port as the ``jax.distributed`` coordinator, and
+   the row counts let every task agree on a common padded shard shape
+   (collectives need identical per-shard shapes; zero rows are exact for
+   every monoid we reduce);
+3. each task calls ``jax.distributed.initialize(coord, N, rank)`` — which
+   must be that interpreter's FIRST JAX backend touch, which is why barrier
+   stages run in fresh worker processes (localspark does this natively; on
+   real Spark set ``spark.python.worker.reuse=false`` for barrier fits);
+4. the global mesh spans every device of every task's process; the stats
+   kernel + ``psum`` compile as one program via the same
+   ``backend.mapreduce_data_axis`` scaffolding the in-process mesh path
+   uses (parallel/gram.py);
+5. rank 0 emits the replicated result as a single Arrow row; other ranks
+   emit nothing.
+
+The fallback when barrier scheduling is unavailable stays the portable
+driver-merge path in ``estimators.py`` (reference-parity architecture).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Iterator
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.spark import arrow_fns
+from spark_rapids_ml_tpu.utils import columnar
+
+MESH_FIELDS = ["xtx", "col_sum", "count", "mesh_size"]
+
+
+def get_barrier_context():
+    """The live BarrierTaskContext — pyspark's inside a real Spark barrier
+    task, localspark's inside a ``mapInArrow(..., barrier=True)`` stage."""
+    try:
+        from pyspark import BarrierTaskContext as SparkCtx  # type: ignore
+
+        ctx = SparkCtx.get()
+        if ctx is not None:
+            return ctx
+    except Exception:  # pyspark absent or not in a barrier task
+        pass
+    from spark_rapids_ml_tpu.localspark.taskcontext import BarrierTaskContext
+
+    return BarrierTaskContext.get()
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _pad_to(mat: np.ndarray, rows: int) -> np.ndarray:
+    if mat.shape[0] == rows:
+        return mat
+    out = np.zeros((rows, mat.shape[1]), dtype=mat.dtype)
+    out[: mat.shape[0]] = mat
+    return out
+
+
+class MeshGramPartitionFn:
+    """Barrier-stage plan function: fit-pass GramStats via one SPMD psum.
+
+    Picklable by construction (plain column name + precision tag, like every
+    plan fn in ``arrow_fns``); everything heavy happens inside the task.
+    """
+
+    def __init__(self, input_col: str, precision: str = "highest"):
+        self.input_col = input_col
+        self.precision = precision
+
+    def __call__(
+        self, batches: Iterator[pa.RecordBatch]
+    ) -> Iterator[pa.RecordBatch]:
+        ctx = get_barrier_context()
+        rank = ctx.partitionId()
+        size = len(ctx.getTaskInfos())
+
+        mats = [
+            columnar.extract_matrix(b, self.input_col)
+            for b in batches
+            if b.num_rows
+        ]
+        local = (
+            np.concatenate(mats, axis=0)
+            if mats
+            else np.zeros((0, 0), dtype=np.float64)
+        )
+
+        # Rendezvous round: rank 0 proposes the jax.distributed coordinator;
+        # row counts establish the common shard shape every process pads to.
+        my_addr = ctx.getTaskInfos()[rank].address if rank < size else "127.0.0.1"
+        proposal = {
+            "rank": rank,
+            "rows": int(local.shape[0]),
+            "n": int(local.shape[1]),
+            "coord": f"{my_addr.split(':')[0]}:{_free_port()}" if rank == 0 else None,
+        }
+        gathered = [json.loads(m) for m in ctx.allGather(json.dumps(proposal))]
+        by_rank = sorted(gathered, key=lambda g: g["rank"])
+        coord = by_rank[0]["coord"]
+        n = max(g["n"] for g in by_rank)
+        total_rows = sum(g["rows"] for g in by_rank)
+        max_rows = max(g["rows"] for g in by_rank)
+        if local.shape[1] == 0:  # empty partition: keep the shard shape legal
+            local = np.zeros((0, n), dtype=np.float64)
+
+        # This must be the interpreter's first JAX backend touch (module
+        # docstring, point 3) — fresh barrier workers guarantee it.
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=size, process_id=rank
+        )
+        try:
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from spark_rapids_ml_tpu.parallel import backend as B
+            from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, create_mesh
+
+            ldc = len(jax.local_devices())
+            # common shard shape: bucket for compile stability, then round to
+            # the per-process device count so the shard splits evenly
+            shard_rows = columnar.bucket_rows(max(max_rows, 1))
+            shard_rows = ((shard_rows + ldc - 1) // ldc) * ldc
+            padded = _pad_to(local, shard_rows)
+
+            # global mesh in process order, so shard r of the global array is
+            # process r's rows
+            devices = sorted(
+                jax.devices(), key=lambda d: (d.process_index, d.id)
+            )
+            mesh = create_mesh(data=len(devices), feat=1, devices=devices)
+            sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+            garr = jax.make_array_from_process_local_data(
+                sharding, padded, (size * shard_rows, n)
+            )
+            stats = B.mapreduce_data_axis(
+                lambda xl: L.gram_stats(
+                    xl, precision=L.PRECISIONS[self.precision]
+                ),
+                mesh,
+            )(garr)
+            xtx = np.asarray(jax.device_get(stats.xtx))
+            col_sum = np.asarray(jax.device_get(stats.col_sum))
+        finally:
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass  # ephemeral worker exits right after the stage anyway
+
+        if rank == 0:
+            # count uses the TRUE row total from the rendezvous (pad rows
+            # contribute zero to xtx/col_sum and are excluded here)
+            yield arrow_fns.arrays_to_batch(
+                {
+                    "xtx": xtx,
+                    "col_sum": col_sum,
+                    "count": np.float64(total_rows),
+                    "mesh_size": np.float64(size),
+                }
+            )
+
+
+def single_stats_from_batches(
+    batches, n: int
+) -> tuple[L.GramStats, int]:
+    """Decode the barrier stage's output: EXACTLY one pre-reduced stats row.
+
+    More than one row means per-partition statistics leaked to the driver —
+    the architectural regression this path exists to prevent — so it raises
+    rather than silently summing.
+    """
+    rows = 0
+    arrays = None
+    for b in batches:
+        t = pa.Table.from_batches([b]) if isinstance(b, pa.RecordBatch) else b
+        rows += t.num_rows
+        if t.num_rows and arrays is None:
+            arrays = {
+                name: np.asarray(
+                    t.column(name)[0].values.to_numpy(zero_copy_only=False)
+                )
+                for name in MESH_FIELDS
+            }
+    if arrays is None:
+        raise ValueError("no statistics received from the barrier stage")
+    if rows != 1:
+        raise AssertionError(
+            f"mesh fit must deliver exactly ONE pre-reduced stats row to the "
+            f"driver, got {rows} — per-partition statistics are leaking"
+        )
+    stats = L.GramStats(
+        arrays["xtx"].reshape(n, n),
+        arrays["col_sum"].reshape(n),
+        np.float64(arrays["count"][0]),
+    )
+    return stats, int(arrays["mesh_size"][0])
