@@ -79,6 +79,20 @@ def _jitted_project():
     return jax.jit(L.project)
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_qr_r():
+    import jax
+
+    return jax.jit(L.qr_r)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_combine_r():
+    import jax
+
+    return jax.jit(L.combine_r)
+
+
 def stats_to_batch(stats: L.GramStats) -> pa.RecordBatch:
     """GramStats → one-row Arrow RecordBatch (the shuffle payload); a thin
     adapter over the generic ``arrays_to_batch`` serializer."""
@@ -252,6 +266,80 @@ class FitPartitionFn(_StatsAccumulatorFn):
 
     def _combine(self, a, b):
         return L.combine_gram_stats(a, b)
+
+
+class QRPartitionFn:
+    """mapInArrow body for the direct-SVD fit pass: fold a partition's rows
+    into ONE [n, n] R factor via qr_r/combine_r (the cond(X)-accurate
+    sufficient statistic — RᵀR = XᵀX without squaring the condition number,
+    ops/linalg.py:353-376). Unlike the stats monoids, R factors merge by
+    QR-of-stacked-pair, not elementwise sum, so the driver reduces the
+    per-partition rows with a ``combine_r`` tree instead of a sum.
+
+    ``mean`` (from a prior cheap moments pass) enables meanCentering: rows
+    are centered BEFORE padding so pad rows stay exactly zero.
+    """
+
+    def __init__(self, input_col: str, mean: np.ndarray | None = None):
+        self.input_col = input_col
+        self.mean = None if mean is None else np.asarray(mean, dtype=np.float64)
+
+    def __call__(
+        self, batches: Iterator[pa.RecordBatch]
+    ) -> Iterator[pa.RecordBatch]:
+        import jax.numpy as jnp
+
+        r = None
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            mat = columnar.extract_matrix(batch, self.input_col)
+            if self.mean is not None:
+                mat = mat - self.mean.astype(mat.dtype)[None, :]
+            padded, _ = columnar.pad_rows(mat)
+            rb = _jitted_qr_r()(jnp.asarray(padded))
+            r = rb if r is None else _jitted_combine_r()(r, rb)
+        if r is not None:
+            yield arrays_to_batch({"r": np.asarray(r)})
+
+
+def r_from_batches(batches: Iterable[pa.RecordBatch], n: int) -> np.ndarray:
+    """Tree-reduce the per-partition R rows into the global [n, n] R.
+
+    The driver-side reduction of the direct-SVD path — ``combine_r`` is
+    associative (a semigroup like GramStats), so a balanced tree keeps both
+    accuracy and depth logarithmic.
+    """
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+
+    rs = []
+    for b in batches:
+        t = pa.Table.from_batches([b]) if isinstance(b, pa.RecordBatch) else b
+        for i in range(t.num_rows):
+            flat = np.asarray(
+                t.column("r")[i].values.to_numpy(zero_copy_only=False)
+            )
+            rs.append(jnp.asarray(flat.reshape(n, n)))
+    if not rs:
+        raise ValueError("no partition R factors received")
+    return np.asarray(tree_reduce(rs, _jitted_combine_r()))
+
+
+def r_from_rows(rows: Iterable, n: int) -> np.ndarray:
+    """The PySpark <4.0 ``collect()`` fallback for ``r_from_batches``."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+
+    rs = [
+        jnp.asarray(np.asarray(r["r"], dtype=np.float64).reshape(n, n))
+        for r in rows
+    ]
+    if not rs:
+        raise ValueError("no partition R factors received")
+    return np.asarray(tree_reduce(rs, _jitted_combine_r()))
 
 
 class LinRegPartitionFn(_StatsAccumulatorFn):

@@ -133,6 +133,10 @@ class SparkPCA(PCA):
             if k > n:
                 raise ValueError(f"k={k} must be <= number of features {n}")
             distribution = self.getOrDefault("distribution")
+            if self.getOrDefault("solver") == "svd":
+                # direct TSQR→SVD(R) path: never forms XᵀX, works at cond(X)
+                # instead of cond(X)² (ops/linalg.py:403-420 rationale)
+                return self._fit_svd(selected, input_col, n, k, distribution)
             if distribution == "mesh-barrier":
                 from spark_rapids_ml_tpu.spark import spmd
 
@@ -192,6 +196,97 @@ class SparkPCA(PCA):
         )
         return self._copyValues(model)
 
+    def _fit_svd(
+        self, selected, input_col: str, n: int, k: int, distribution: str
+    ) -> "SparkPCAModel":
+        """The solver='svd' DataFrame fit: per-partition ``qr_r`` rows →
+        driver ``combine_r`` tree → ``svd_from_r`` (driver-merge), or the
+        butterfly-TSQR mesh program (mesh-local). R factors ride the SAME
+        one-row Arrow stats machinery as the Gram path; only the driver
+        reduction differs (QR-of-stacked-pair tree, not an elementwise sum).
+        meanCentering costs one extra cheap moments pass for the global
+        mean, applied worker-side before padding so pad rows stay zero."""
+        import jax.numpy as jnp
+
+        mean_centering = self.getMeanCentering()
+        if distribution == "mesh-local":
+            import jax
+
+            from spark_rapids_ml_tpu.parallel import mesh as M
+            from spark_rapids_ml_tpu.parallel import tsqr as TSQR
+            from spark_rapids_ml_tpu.utils import columnar
+
+            mat = self._collect_matrix(selected, input_col)
+            rows = mat.shape[0]
+            mesh = M.create_mesh()
+            ndev = mesh.size
+            shard = columnar.bucket_rows(-(-rows // ndev))
+            padded = np.zeros((shard * ndev, n), dtype=mat.dtype)
+            padded[:rows] = mat
+            if mean_centering:
+                # center BEFORE padding-aware fit: the mesh TSQR centers by
+                # shard statistics of the padded array, whose pad rows would
+                # bias the mean — use the true-row mean here instead
+                padded[:rows] -= mat.mean(axis=0, dtype=np.float64).astype(
+                    mat.dtype
+                )
+            fit_svd = TSQR.make_distributed_fit_svd(
+                mesh, k, mean_centering=False
+            )
+            pc, ev = fit_svd(
+                jax.device_put(jnp.asarray(padded), M.data_sharding(mesh))
+            )
+        else:
+            if distribution == "mesh-barrier":
+                raise ValueError(
+                    "solver='svd' is not available with "
+                    "distribution='mesh-barrier' yet; use 'driver-merge' "
+                    "(R factors tree-merge on the driver) or 'mesh-local' "
+                    "(butterfly TSQR over the driver's device mesh)"
+                )
+            T, _ = _sql_mods(selected)
+            mean = None
+            if mean_centering:
+                shapes = {"count": (), "total": (n,), "total_sq": (n,)}
+                arrays = _collect_stats(
+                    selected,
+                    arrow_fns.make_moments_partition_fn(input_col),
+                    list(shapes),
+                    shapes,
+                )
+                mean = arrays["total"] / max(float(arrays["count"]), 1.0)
+            fn = arrow_fns.QRPartitionFn(input_col, mean)
+            r_df = selected.mapInArrow(
+                fn, schema=_spark_arrays_type(T, ["r"])
+            )
+            if hasattr(r_df, "toArrow"):
+                r = arrow_fns.r_from_batches(r_df.toArrow().to_batches(), n)
+            else:
+                r = arrow_fns.r_from_rows(r_df.collect(), n)
+            with trace_range("svd from r"):
+                pc, ev = L.svd_from_r(jnp.asarray(r), k)
+        model = SparkPCAModel(
+            uid=self.uid, pc=np.asarray(pc), explainedVariance=np.asarray(ev)
+        )
+        return self._copyValues(model)
+
+    def _collect_matrix(self, selected, input_col: str) -> np.ndarray:
+        """Stream the input column to one driver-side [rows, n] ndarray —
+        the ingestion step of the 'mesh-local' deployment."""
+        from spark_rapids_ml_tpu.utils import columnar
+
+        if hasattr(selected, "toArrow"):
+            batches = selected.toArrow().to_batches()
+            mats = [
+                columnar.extract_matrix(b, input_col)
+                for b in batches
+                if b.num_rows
+            ]
+            return np.concatenate(mats, axis=0)
+        return np.asarray(  # PySpark 3.5: row collect fallback
+            [np.asarray(r[0]) for r in selected.collect()], dtype=np.float64
+        )
+
     def _mesh_local_stats(self, selected, input_col: str, n: int) -> L.GramStats:
         """'mesh-local': stream rows to the driver and run the psum Gram
         program over the driver's own device mesh (parallel/gram.py) — the
@@ -205,18 +300,7 @@ class SparkPCA(PCA):
         from spark_rapids_ml_tpu.parallel import mesh as M
         from spark_rapids_ml_tpu.utils import columnar
 
-        if hasattr(selected, "toArrow"):
-            batches = selected.toArrow().to_batches()
-            mats = [
-                columnar.extract_matrix(b, input_col)
-                for b in batches
-                if b.num_rows
-            ]
-            mat = np.concatenate(mats, axis=0)
-        else:  # PySpark 3.5: row collect fallback
-            mat = np.asarray(
-                [r[0] for r in selected.collect()], dtype=np.float64
-            )
+        mat = self._collect_matrix(selected, input_col)
         rows = mat.shape[0]
         mesh = M.create_mesh()
         ndev = mesh.size
