@@ -415,6 +415,118 @@ class LogRegNewtonPartitionFn(_StatsAccumulatorFn):
         return LIN.combine_newton_stats(a, b)
 
 
+class SoftmaxNewtonPartitionFn(_StatsAccumulatorFn):
+    """mapInArrow body for ONE multinomial (softmax) Newton iteration.
+
+    The multiclass sibling of ``LogRegNewtonPartitionFn``: the monoid is
+    SoftmaxStats (full [C·d, C·d] Fisher Hessian as C(C+1)/2 MXU block
+    matmuls, ops/linear.py:221-287). ``w_flat`` is the flattened [C·d]
+    parameter, a HOST ndarray so the serialized task stays device-free;
+    ``n_classes`` is established by a prior label-scan pass.
+    """
+
+    def __init__(
+        self,
+        features_col: str,
+        label_col: str,
+        w_flat: np.ndarray,
+        n_classes: int,
+        *,
+        fit_intercept: bool = True,
+        weight_col: str | None = None,
+    ):
+        self.features_col = features_col
+        self.label_col = label_col
+        self.w_flat = np.asarray(w_flat)
+        self.n_classes = int(n_classes)
+        self.fit_intercept = fit_intercept
+        self.weight_col = weight_col
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import linear as LIN
+
+        mat, y, sw = _labeled_from_batch(
+            batch, self.features_col, self.label_col, self.weight_col
+        )
+        if not np.all((y == np.round(y)) & (y >= 0) & (y < self.n_classes)):
+            raise ValueError(
+                f"multinomial labels must be integers in [0, {self.n_classes}), "
+                f"got {np.unique(y)[:8]}"
+            )
+        xp, yp, w = columnar.pad_labeled(mat, y, sw)
+        if self.fit_intercept:
+            xp = np.concatenate([xp, np.ones((xp.shape[0], 1), xp.dtype)], axis=1)
+        return LIN.softmax_newton_stats(
+            jnp.asarray(xp),
+            jnp.asarray(yp.astype(np.int32)),
+            jnp.asarray(self.w_flat),
+            self.n_classes,
+            jnp.asarray(w),
+        )
+
+    def _combine(self, a, b):
+        from spark_rapids_ml_tpu.ops import linear as LIN
+
+        return LIN.combine_softmax_stats(a, b)
+
+
+class LabelScanPartitionFn:
+    """One cheap pass yielding each partition's DISTINCT label values — the
+    class-count detection step of the multinomial Spark path (the analog of
+    the core path's ``np.unique`` over local partitions,
+    models/linear.py:278-284). Output is one variable-length row per
+    partition, merged driver-side by ``labels_from_batches`` (set-union, not
+    the sum-merge the stats monoids use)."""
+
+    def __init__(self, label_col: str):
+        self.label_col = label_col
+
+    def __call__(
+        self, batches: Iterator[pa.RecordBatch]
+    ) -> Iterator[pa.RecordBatch]:
+        uniq: np.ndarray | None = None
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            y = np.unique(
+                np.asarray(
+                    batch.column(self.label_col).to_numpy(zero_copy_only=False),
+                    dtype=np.float64,
+                )
+            )
+            uniq = y if uniq is None else np.union1d(uniq, y)
+        if uniq is not None:
+            yield arrays_to_batch({"labels": uniq})
+
+
+def labels_from_batches(batches: Iterable[pa.RecordBatch]) -> np.ndarray:
+    """Union-merge per-partition distinct-label rows."""
+    out: np.ndarray | None = None
+    for b in batches:
+        t = pa.Table.from_batches([b]) if isinstance(b, pa.RecordBatch) else b
+        for i in range(t.num_rows):
+            vals = np.asarray(
+                t.column("labels")[i].values.to_numpy(zero_copy_only=False)
+            )
+            out = vals if out is None else np.union1d(out, vals)
+    if out is None:
+        raise ValueError("no labels received (empty dataset?)")
+    return out
+
+
+def labels_from_rows(rows: Iterable) -> np.ndarray:
+    """The PySpark <4.0 ``collect()`` fallback for ``labels_from_batches``."""
+    out: np.ndarray | None = None
+    for r in rows:
+        vals = np.asarray(r["labels"], dtype=np.float64)
+        out = vals if out is None else np.union1d(out, vals)
+    if out is None:
+        raise ValueError("no labels received (empty dataset?)")
+    return out
+
+
 class KMeansPartitionFn(_StatsAccumulatorFn):
     """mapInArrow body for one Lloyd iteration's KMeansStats (one Spark job
     per iteration, centers broadcast in the task state as a host array)."""

@@ -516,6 +516,32 @@ class SparkLogisticRegression(LogisticRegression):
         selected = dataset.select(*cols)
         fit_intercept = self.getFitIntercept()
         n = _infer_n(dataset, feats)
+        # class-count detection: one cheap distinct-label pass over the
+        # label column (the DataFrame analog of the core path's np.unique,
+        # models/linear.py:278-292), so >=3-class datasets route to the
+        # softmax path with the same validation the core estimator applies
+        with trace_range("label scan"):
+            all_labels = self._scan_labels(dataset.select(label), label)
+        from spark_rapids_ml_tpu.models.linear import _MAX_CLASSES
+
+        if not np.all(all_labels == np.round(all_labels)) or all_labels.min() < 0:
+            raise ValueError(
+                "logistic regression requires integer class labels "
+                f"0..C-1, got {all_labels[:8]}"
+            )
+        n_classes = int(all_labels.max()) + 1
+        if n_classes > _MAX_CLASSES:
+            raise ValueError(
+                f"labels imply {n_classes} classes (max label "
+                f"{int(all_labels.max())}), over the supported cap of "
+                f"{_MAX_CLASSES} — the full-Newton Hessian is [C·d, C·d]. "
+                "Check for mislabeled/ID-like rows, or re-encode labels "
+                "densely as 0..C-1"
+            )
+        if n_classes > 2:
+            return self._fit_multinomial(
+                selected, feats, label, weight_col, n, n_classes, fit_intercept
+            )
         d = n + 1 if fit_intercept else n
         shapes = {"hess": (d, d), "grad": (d,), "loss": (), "count": ()}
         w_full = np.zeros(d)
@@ -544,6 +570,70 @@ class SparkLogisticRegression(LogisticRegression):
             coef, intercept = w_full, 0.0
         model = SparkLogisticRegressionModel(
             uid=self.uid, coefficients=coef, intercept=intercept
+        )
+        return self._copyValues(model)
+
+    @staticmethod
+    def _scan_labels(label_df, label: str) -> np.ndarray:
+        T, _ = _sql_mods(label_df)
+        scan_df = label_df.mapInArrow(
+            arrow_fns.LabelScanPartitionFn(label),
+            schema=_spark_arrays_type(T, ["labels"]),
+        )
+        if hasattr(scan_df, "toArrow"):
+            return arrow_fns.labels_from_batches(scan_df.toArrow().to_batches())
+        return arrow_fns.labels_from_rows(scan_df.collect())
+
+    def _fit_multinomial(
+        self,
+        selected,
+        feats: str,
+        label: str,
+        weight_col: str | None,
+        n: int,
+        n_classes: int,
+        fit_intercept: bool,
+    ) -> "SparkLogisticRegressionModel":
+        """Softmax IRLS over DataFrames: one Spark job per Newton iteration
+        on the flattened [C·d] parameter, mirroring the core path
+        (models/linear.py:336-393) with SoftmaxStats riding the same one-row
+        Arrow stats machinery as every other monoid."""
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import linear as LIN
+
+        d = n + 1 if fit_intercept else n
+        cd = n_classes * d
+        shapes = {"hess": (cd, cd), "grad": (cd,), "loss": (), "count": ()}
+        w_flat = np.zeros(cd)
+        with trace_range("softmax newton"):
+            for _ in range(self.getMaxIter()):
+                fn = arrow_fns.SoftmaxNewtonPartitionFn(
+                    feats, label, w_flat, n_classes,
+                    fit_intercept=fit_intercept, weight_col=weight_col,
+                )
+                arrays = _collect_stats(selected, fn, list(shapes), shapes)
+                if weight_col and float(arrays["count"]) == 0.0:
+                    raise ValueError("all instance weights are zero")
+                stats = LIN.SoftmaxStats(
+                    **{k: jnp.asarray(v) for k, v in arrays.items()}
+                )
+                new_w, step_norm = LIN.softmax_newton_update(
+                    jnp.asarray(w_flat), stats, n_classes,
+                    reg_param=self.getRegParam(), fit_intercept=fit_intercept,
+                )
+                w_flat = np.asarray(new_w)
+                if float(step_norm) <= self.getTol():
+                    break
+        w_mat = w_flat.reshape(n_classes, d)
+        if fit_intercept:
+            coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
+        else:
+            coef_matrix, intercepts = w_mat, np.zeros(n_classes)
+        model = SparkLogisticRegressionModel(
+            uid=self.uid,
+            coefficientMatrix=coef_matrix,
+            interceptVector=intercepts,
         )
         return self._copyValues(model)
 
