@@ -37,6 +37,7 @@ from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerMode
 from spark_rapids_ml_tpu.models.params import Param
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.spark import arrow_fns
+from spark_rapids_ml_tpu.utils import columnar
 from spark_rapids_ml_tpu.utils.tracing import trace_range
 
 
@@ -127,7 +128,7 @@ class SparkPCA(PCA):
                     f"input column {input_col!r} contains null feature "
                     "vectors; drop or impute nulls before fit"
                 )
-            n = len(first[0])
+            n = columnar.feature_dim(first[0])
             k = self.getK()
             # validate before launching the cluster-wide Gram pass
             if k > n:
@@ -214,7 +215,6 @@ class SparkPCA(PCA):
 
             from spark_rapids_ml_tpu.parallel import mesh as M
             from spark_rapids_ml_tpu.parallel import tsqr as TSQR
-            from spark_rapids_ml_tpu.utils import columnar
 
             mat = self._collect_matrix(selected, input_col)
             rows = mat.shape[0]
@@ -273,8 +273,6 @@ class SparkPCA(PCA):
     def _collect_matrix(self, selected, input_col: str) -> np.ndarray:
         """Stream the input column to one driver-side [rows, n] ndarray —
         the ingestion step of the 'mesh-local' deployment."""
-        from spark_rapids_ml_tpu.utils import columnar
-
         if hasattr(selected, "toArrow"):
             batches = selected.toArrow().to_batches()
             mats = [
@@ -283,8 +281,8 @@ class SparkPCA(PCA):
                 if b.num_rows
             ]
             return np.concatenate(mats, axis=0)
-        return np.asarray(  # PySpark 3.5: row collect fallback
-            [np.asarray(r[0]) for r in selected.collect()], dtype=np.float64
+        return np.stack(  # PySpark 3.5: row collect fallback
+            [columnar.row_vector_to_ndarray(r[0]) for r in selected.collect()]
         )
 
     def _mesh_local_stats(self, selected, input_col: str, n: int) -> L.GramStats:
@@ -298,7 +296,6 @@ class SparkPCA(PCA):
 
         from spark_rapids_ml_tpu.parallel import gram as G
         from spark_rapids_ml_tpu.parallel import mesh as M
-        from spark_rapids_ml_tpu.utils import columnar
 
         mat = self._collect_matrix(selected, input_col)
         rows = mat.shape[0]
@@ -414,7 +411,7 @@ def _infer_n(df, col: str) -> int:
             f"input column {col!r} contains null feature vectors; "
             "drop or impute nulls before fit"
         )
-    return len(first[0])
+    return columnar.feature_dim(first[0])
 
 
 # ---------------------------------------------------------------------------
@@ -539,7 +536,7 @@ class SparkLogisticRegression(LogisticRegression):
                 "densely as 0..C-1"
             )
         if n_classes > 2:
-            return self._fit_multinomial(
+            return self._fit_multinomial_df(
                 selected, feats, label, weight_col, n, n_classes, fit_intercept
             )
         d = n + 1 if fit_intercept else n
@@ -584,7 +581,7 @@ class SparkLogisticRegression(LogisticRegression):
             return arrow_fns.labels_from_batches(scan_df.toArrow().to_batches())
         return arrow_fns.labels_from_rows(scan_df.collect())
 
-    def _fit_multinomial(
+    def _fit_multinomial_df(
         self,
         selected,
         feats: str,
@@ -718,7 +715,9 @@ class SparkKMeans(KMeans):
                     f"k={k} but only {len(sample_rows)} rows with positive "
                     "weight were found to seed centers from"
                 )
-            sample = np.stack([np.asarray(r[0]) for r in sample_rows])
+            sample = np.stack(
+                [columnar.row_vector_to_ndarray(r[0]) for r in sample_rows]
+            )
             if self.getInitMode() == "random":
                 rng = np.random.default_rng(self.getSeed())
                 centers = sample[rng.choice(len(sample), k, replace=False)]

@@ -40,6 +40,99 @@ except Exception:  # pragma: no cover
 # ---------------------------------------------------------------------------
 
 
+# pyspark.ml VectorUDT's Arrow/sql layout: struct<type:tinyint, size:int,
+# indices:array<int>, values:array<double>> with type 0=sparse, 1=dense
+# (pyspark/ml/linalg/__init__.py VectorUDT.sqlType). Accepting it makes the
+# "change one import" story real for existing pyspark.ml pipelines, which
+# carry Vector columns — the reference documents ArrayType as its one
+# deviation (README.md:35-37); here both work.
+_VECTOR_UDT_FIELDS = ("type", "size", "indices", "values")
+
+
+def _is_vector_udt_struct(typ) -> bool:
+    if not pa.types.is_struct(typ):
+        return False
+    names = {typ.field(i).name for i in range(typ.num_fields)}
+    return names.issuperset(_VECTOR_UDT_FIELDS)
+
+
+def _from_vector_struct_column(col) -> np.ndarray:
+    """VectorUDT struct column → dense [rows, n]; dense rows reshape in one
+    step, sparse rows scatter by their indices."""
+    if col.null_count:
+        raise ValueError("null rows are not supported in the input column")
+    fields = {
+        col.type.field(i).name: flat
+        for i, flat in enumerate(col.flatten())
+    }
+    tcode = np.asarray(fields["type"].to_numpy(zero_copy_only=False))
+    values = fields["values"]
+    val_np = np.asarray(values.values.to_numpy(zero_copy_only=False))
+    offsets = np.asarray(values.offsets.to_numpy(zero_copy_only=False))
+    lengths = np.diff(offsets)
+    if np.all(tcode == 1):  # all dense: uniform-length list → one reshape
+        n = int(lengths[0]) if len(lengths) else 0
+        if not np.all(lengths == n):
+            raise ValueError("ragged rows: all rows must have equal length")
+        return val_np[offsets[0] : offsets[-1]].reshape(-1, n)
+    sizes = np.asarray(
+        fields["size"].to_numpy(zero_copy_only=False), dtype=np.float64
+    )
+    dims = np.where(tcode == 1, lengths, sizes)
+    n = int(dims[0]) if len(dims) else 0
+    if not np.all(dims == n):
+        raise ValueError("ragged rows: all rows must have equal length")
+    indices = fields["indices"]
+    idx_np = np.asarray(indices.values.to_numpy(zero_copy_only=False))
+    idx_offsets = np.asarray(indices.offsets.to_numpy(zero_copy_only=False))
+    rows = len(tcode)
+    out = np.zeros((rows, n), dtype=np.float64)
+    dense = tcode == 1
+    # fully vectorized, no per-row Python loop (executor hot path): the flat
+    # values buffer concatenates every row's list, so one repeat-mask splits
+    # dense from sparse values; the indices buffer holds ONLY sparse rows'
+    # entries (dense rows' lists are null → zero length), so it is already
+    # the flat column-id vector and its per-row lengths give the row ids.
+    flat_vals = val_np[offsets[0] : offsets[-1]]
+    sparse_mask = np.repeat(~dense, lengths)
+    if dense.any():
+        out[dense] = flat_vals[~sparse_mask].reshape(-1, n)
+    if (~dense).any():
+        col_ids = idx_np[idx_offsets[0] : idx_offsets[-1]]
+        row_ids = np.repeat(np.arange(rows), np.diff(idx_offsets))
+        out[row_ids, col_ids] = flat_vals[sparse_mask]
+    return out
+
+
+def row_vector_to_ndarray(value: Any) -> np.ndarray:
+    """One driver-side row value of a features column → [n] ndarray.
+
+    Handles the three shapes a collected row can carry: a plain
+    list/ndarray (ArrayType), a pyspark.ml Vector (``toArray``), or the
+    VectorUDT struct as a mapping (localspark / raw Arrow collect)."""
+    if hasattr(value, "toArray"):  # pyspark.ml DenseVector / SparseVector
+        return np.asarray(value.toArray(), dtype=np.float64)
+    if isinstance(value, dict) and set(value).issuperset(_VECTOR_UDT_FIELDS):
+        if value["type"] == 1:
+            return np.asarray(value["values"], dtype=np.float64)
+        out = np.zeros(int(value["size"]), dtype=np.float64)
+        out[np.asarray(value["indices"], dtype=np.int64)] = value["values"]
+        return out
+    return np.asarray(value, dtype=np.float64)
+
+
+def feature_dim(value: Any) -> int:
+    """Feature count of one driver-side row value (``_infer_n``'s helper) —
+    without densifying a sparse vector."""
+    if hasattr(value, "size") and not isinstance(value, (list, tuple, np.ndarray)):
+        return int(value.size)  # pyspark.ml Vector
+    if isinstance(value, dict) and set(value).issuperset(_VECTOR_UDT_FIELDS):
+        return (
+            len(value["values"]) if value["type"] == 1 else int(value["size"])
+        )
+    return len(value)
+
+
 def _from_arrow_column(col) -> np.ndarray:
     """Arrow list/fixed_size_list column → [rows, n] ndarray, zero-copy when
     the child values buffer is contiguous and null-free."""
@@ -47,6 +140,12 @@ def _from_arrow_column(col) -> np.ndarray:
         if col.num_chunks == 1:
             return _from_arrow_column(col.chunk(0))
         return np.concatenate([_from_arrow_column(c) for c in col.chunks])
+    if isinstance(col, pa.ExtensionArray):
+        # Arrow ships UDTs as extension arrays over their storage type;
+        # VectorUDT's storage is the struct handled below
+        return _from_arrow_column(col.storage)
+    if _is_vector_udt_struct(col.type):
+        return _from_vector_struct_column(col)
     if pa.types.is_fixed_size_list(col.type):
         n = col.type.list_size
         if col.null_count:
