@@ -287,3 +287,65 @@ class PCAModel(PCAParams, Model):
     @classmethod
     def _fromSaved(cls, uid: str, data: dict[str, np.ndarray]) -> "PCAModel":
         return cls(uid=uid, pc=data["pc"], explainedVariance=data["explainedVariance"])
+
+    # -- stock pyspark.ml interop (layout="spark") ---------------------------
+    # Spark's PCAModelWriter persists Row(pc: DenseMatrix, explainedVariance:
+    # DenseVector) under data/ plus DefaultParamsWriter metadata — the exact
+    # shape the reference writes too (RapidsPCA.scala:193-199). Only params
+    # stock Spark's PCAModel knows may appear in the metadata (its loader
+    # rejects unknown names).
+    _SPARK_ML_CLASS = "org.apache.spark.ml.feature.PCAModel"
+    _SPARK_ML_PARAMS = ("k", "inputCol", "outputCol")
+
+    def _saveSparkML(self, path: str) -> None:
+        from spark_rapids_ml_tpu.models.base import spark_set_params
+        from spark_rapids_ml_tpu.utils import persistence as P
+
+        params = {
+            k: v
+            for k, v in spark_set_params(self).items()
+            if k in self._SPARK_ML_PARAMS
+        }
+        params.setdefault("k", int(self.pc.shape[1]))
+        P.save_spark_ml_metadata(
+            path,
+            class_name=self._SPARK_ML_CLASS,
+            uid=self.uid,
+            param_map=params,
+        )
+        P.save_spark_ml_data(
+            path,
+            {
+                "pc": P._dense_matrix_struct(self.pc),
+                "explainedVariance": P._dense_vector_struct(self.explainedVariance),
+            },
+            {
+                "type": "struct",
+                "fields": [
+                    {
+                        "name": "pc",
+                        "type": P._matrix_udt_json(),
+                        "nullable": True,
+                        "metadata": {},
+                    },
+                    {
+                        "name": "explainedVariance",
+                        "type": P._vector_udt_json(),
+                        "nullable": True,
+                        "metadata": {},
+                    },
+                ],
+            },
+        )
+
+    @classmethod
+    def _fromSparkML(cls, meta: dict, table) -> "PCAModel":
+        from spark_rapids_ml_tpu.utils import persistence as P
+
+        return cls(
+            uid=meta["uid"],
+            pc=P.struct_to_matrix(table.column("pc")[0].as_py()),
+            explainedVariance=P.struct_to_vector(
+                table.column("explainedVariance")[0].as_py()
+            ),
+        )

@@ -7,7 +7,6 @@ in sequence.
 
 from __future__ import annotations
 
-from pathlib import Path
 from typing import Any
 
 from spark_rapids_ml_tpu.models.base import Estimator, Model, Saveable, Transformer
@@ -45,19 +44,27 @@ class Pipeline(Estimator):
         return model
 
     # -- persistence: stages in numbered subdirectories ----------------------
-    def save(self, path: str, overwrite: bool = False) -> None:
-        p = Path(path)
-        if p.exists() and not overwrite:
-            raise FileExistsError(f"{path} already exists (use overwrite=True)")
-        persistence.save_metadata(p, self, extra={"numStages": len(self.stages)})
+    def save(self, path: str, overwrite: bool = False, layout: str = "native") -> None:
+        if layout != "native":
+            raise ValueError("pipelines support only the native layout")
+        fs = persistence._FS(path)
+        if fs.exists():
+            if not overwrite:
+                raise FileExistsError(
+                    f"{path} already exists (use overwrite=True or "
+                    "write().overwrite())"
+                )
+            fs.rmtree()
+        persistence.save_metadata(path, self, extra={"numStages": len(self.stages)})
         for i, stage in enumerate(self.stages):
-            stage.save(p / f"stage_{i}", overwrite=overwrite)
+            stage.save(fs.join(f"stage_{i}"))
 
     @classmethod
     def load(cls, path: str) -> "Pipeline":
         meta = persistence.load_metadata(path)
+        fs = persistence._FS(path)
         stages = [
-            Saveable.load(Path(path) / f"stage_{i}") for i in range(meta["numStages"])
+            Saveable.load(fs.join(f"stage_{i}")) for i in range(meta["numStages"])
         ]
         obj = cls(uid=meta["uid"], stages=stages)
         obj._restoreParamState(meta)
@@ -80,8 +87,9 @@ class PipelineModel(Model):
     @classmethod
     def load(cls, path: str) -> "PipelineModel":
         meta = persistence.load_metadata(path)
+        fs = persistence._FS(path)
         stages = [
-            Saveable.load(Path(path) / f"stage_{i}") for i in range(meta["numStages"])
+            Saveable.load(fs.join(f"stage_{i}")) for i in range(meta["numStages"])
         ]
         obj = cls(uid=meta["uid"], stages=stages)
         obj._restoreParamState(meta)

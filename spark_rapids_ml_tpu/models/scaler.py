@@ -108,6 +108,48 @@ class StandardScalerModel(_ScalerParams, Model):
     def _fromSaved(cls, uid, data):
         return cls(uid=uid, mean=data["mean"], std=data["std"])
 
+    # -- stock pyspark.ml interop (layout="spark"): Spark persists
+    # Row(std: Vector, mean: Vector) in that order --------------------------
+    _SPARK_ML_CLASS = "org.apache.spark.ml.feature.StandardScalerModel"
+    _SPARK_ML_PARAMS = ("withMean", "withStd", "inputCol", "outputCol")
+
+    def _saveSparkML(self, path: str) -> None:
+        from spark_rapids_ml_tpu.models.base import spark_set_params
+        from spark_rapids_ml_tpu.utils import persistence as P
+
+        params = {
+            k: v
+            for k, v in spark_set_params(self).items()
+            if k in self._SPARK_ML_PARAMS
+        }
+        vec_field = lambda name: {  # noqa: E731 - tiny schema helper
+            "name": name,
+            "type": P._vector_udt_json(),
+            "nullable": True,
+            "metadata": {},
+        }
+        P.save_spark_ml_metadata(
+            path, class_name=self._SPARK_ML_CLASS, uid=self.uid, param_map=params
+        )
+        P.save_spark_ml_data(
+            path,
+            {
+                "std": P._dense_vector_struct(self.std),
+                "mean": P._dense_vector_struct(self.mean),
+            },
+            {"type": "struct", "fields": [vec_field("std"), vec_field("mean")]},
+        )
+
+    @classmethod
+    def _fromSparkML(cls, meta: dict, table) -> "StandardScalerModel":
+        from spark_rapids_ml_tpu.utils import persistence as P
+
+        return cls(
+            uid=meta["uid"],
+            mean=P.struct_to_vector(table.column("mean")[0].as_py()),
+            std=P.struct_to_vector(table.column("std")[0].as_py()),
+        )
+
 
 class Normalizer(HasInputCol, HasOutputCol, Transformer):
     """Stateless row p-normalization (Spark ``Normalizer`` semantics)."""

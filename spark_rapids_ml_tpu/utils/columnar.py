@@ -113,11 +113,9 @@ def row_vector_to_ndarray(value: Any) -> np.ndarray:
     if hasattr(value, "toArray"):  # pyspark.ml DenseVector / SparseVector
         return np.asarray(value.toArray(), dtype=np.float64)
     if isinstance(value, dict) and set(value).issuperset(_VECTOR_UDT_FIELDS):
-        if value["type"] == 1:
-            return np.asarray(value["values"], dtype=np.float64)
-        out = np.zeros(int(value["size"]), dtype=np.float64)
-        out[np.asarray(value["indices"], dtype=np.int64)] = value["values"]
-        return out
+        from spark_rapids_ml_tpu.utils.persistence import struct_to_vector
+
+        return struct_to_vector(value)
     return np.asarray(value, dtype=np.float64)
 
 
