@@ -123,18 +123,49 @@ def kmeans_plus_plus_init(
 
     D²-weighted sequential sampling (Arthur & Vassilvitskii); the estimator
     layer samples the dataset down before calling so rows stays modest —
-    the same role Spark's k-means|| plays for its distributed init.
+    the same role Spark's k-means|| plays for its distributed init. The
+    unweighted special case of ``weighted_kmeans_plus_plus_init``.
+    """
+    return weighted_kmeans_plus_plus_init(
+        key, x, jnp.ones((x.shape[0],), x.dtype), k, precision=precision
+    )
+
+
+def min_sq_dists(
+    x: jax.Array, centers: jax.Array, *, precision=DEFAULT_PRECISION
+) -> jax.Array:
+    """[rows] squared distance of each row to its nearest center."""
+    return jnp.min(pairwise_sq_dists(x, centers, precision=precision), axis=1)
+
+
+def weighted_kmeans_plus_plus_init(
+    key: jax.Array,
+    x: jax.Array,
+    w: jax.Array,
+    k: int,
+    *,
+    precision=DEFAULT_PRECISION,
+) -> jax.Array:
+    """Weighted k-means++ — the finishing step of k-means‖ (Bahmani et al.,
+    §3.4): reduce the oversampled candidate set to k seeds, sampling ∝ w·D².
+
+    ``w`` are candidate weights (how many data rows each candidate owns);
+    zero-weight candidates can never be drawn.
     """
     rows = x.shape[0]
+    w = w.astype(x.dtype)
+    tiny = jnp.finfo(x.dtype).tiny
 
-    first = jax.random.randint(key, (), 0, rows)
+    key, sub = jax.random.split(key)
+    first = jax.random.choice(sub, rows, p=w / jnp.maximum(jnp.sum(w), tiny))
     centers0 = jnp.zeros((k, x.shape[1]), x.dtype).at[0].set(x[first])
     d0 = jnp.sum((x - centers0[0][None, :]) ** 2, axis=1)
 
     def body(i, carry):
         centers, dists, key = carry
         key, sub = jax.random.split(key)
-        probs = dists / jnp.maximum(jnp.sum(dists), jnp.finfo(x.dtype).tiny)
+        scores = w * dists
+        probs = scores / jnp.maximum(jnp.sum(scores), tiny)
         idx = jax.random.choice(sub, rows, p=probs)
         c = x[idx]
         centers = centers.at[i].set(c)

@@ -564,6 +564,141 @@ class KMeansPartitionFn(_StatsAccumulatorFn):
         return KM.combine_kmeans_stats(a, b)
 
 
+class KMeansAssignStatsFn:
+    """mapInArrow body for the k-means‖ assignment passes: per-candidate
+    weighted row counts + total Σ w·d²(x, C), WITHOUT the [k, n] sums matrix
+    the Lloyd fn ships. Serves both the φ cost pass (reads ``cost``) and the
+    final candidate-weighting pass (reads ``counts``) of Bahmani et al.;
+    at ~2·initSteps·k candidates the unused sums would dominate the
+    shuffle-to-driver volume."""
+
+    def __init__(
+        self, input_col: str, centers: np.ndarray, weight_col: str | None = None
+    ):
+        self.input_col = input_col
+        self.centers = np.asarray(centers)
+        self.weight_col = weight_col
+
+    def __call__(
+        self, batches: Iterator[pa.RecordBatch]
+    ) -> Iterator[pa.RecordBatch]:
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        counts = np.zeros(len(self.centers))
+        total = 0.0
+        got = False
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            got = True
+            mat = columnar.extract_matrix(batch, self.input_col)
+            labels, d2 = KM.assign_clusters(
+                jnp.asarray(mat), jnp.asarray(self.centers, dtype=mat.dtype)
+            )
+            labels, d2 = np.asarray(labels), np.asarray(d2)
+            w = np.ones(len(mat))
+            if self.weight_col:
+                w = columnar.validate_weights(
+                    batch.column(self.weight_col).to_numpy(zero_copy_only=False),
+                    len(mat),
+                    allow_all_zero=True,
+                )
+            np.add.at(counts, labels, w)
+            total += float(np.dot(d2, w))
+        if got:
+            yield arrays_to_batch(
+                {"counts": counts, "cost": np.float64(total)}
+            )
+
+
+class KMeansParallelSampleFn:
+    """mapInArrow body for one k-means‖ oversampling round: every row is an
+    independent Bernoulli trial with p = min(1, ℓ·w·d²/φ); selected rows come
+    back as candidate rows (a list column), NOT statistics — the one plan
+    function in the family whose output is data.
+
+    Per-partition randomness must be deterministic yet distinct across
+    partitions; with no partition id available in a plain (non-barrier)
+    mapInArrow task, the rng seeds from (seed, content-hash of the batch),
+    which is stable across retries and distinct for distinct data.
+    """
+
+    def __init__(
+        self,
+        input_col: str,
+        centers: np.ndarray,
+        ell_over_phi: float,
+        seed: int,
+        weight_col: str | None = None,
+    ):
+        self.input_col = input_col
+        self.centers = np.asarray(centers)
+        self.ell_over_phi = float(ell_over_phi)
+        self.seed = int(seed)
+        self.weight_col = weight_col
+
+    def __call__(
+        self, batches: Iterator[pa.RecordBatch]
+    ) -> Iterator[pa.RecordBatch]:
+        import zlib
+
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            mat = columnar.extract_matrix(batch, self.input_col)
+            w = np.ones(len(mat))
+            if self.weight_col:
+                w = columnar.validate_weights(
+                    batch.column(self.weight_col).to_numpy(zero_copy_only=False),
+                    len(mat),
+                    allow_all_zero=True,
+                )
+            d2 = np.asarray(
+                KM.min_sq_dists(
+                    jnp.asarray(mat), jnp.asarray(self.centers, dtype=mat.dtype)
+                )
+            )
+            p = np.minimum(1.0, self.ell_over_phi * w * d2)
+            h = zlib.crc32(np.ascontiguousarray(mat[0]).tobytes()) ^ len(mat)
+            rng = np.random.default_rng([self.seed, h])
+            sel = rng.random(len(mat)) < p
+            if sel.any():
+                out = np.ascontiguousarray(mat[sel], dtype=np.float64)
+                yield pa.RecordBatch.from_arrays(
+                    [_list_column(out.reshape(-1), out.shape[1])],
+                    schema=pa.schema(
+                        [pa.field("candidate", pa.list_(pa.float64()))]
+                    ),
+                )
+
+
+def candidates_from_batches(batches: Iterable[pa.RecordBatch]) -> np.ndarray:
+    """Collect sampled candidate rows into one [m, n] ndarray (may be
+    empty: shape [0, 0])."""
+    mats = []
+    for b in batches:
+        t = pa.Table.from_batches([b]) if isinstance(b, pa.RecordBatch) else b
+        if t.num_rows:
+            mats.append(columnar.extract_matrix(t, "candidate"))
+    if not mats:
+        return np.zeros((0, 0))
+    return np.concatenate(mats, axis=0)
+
+
+def candidates_from_rows(rows: Iterable) -> np.ndarray:
+    """The PySpark <4.0 ``collect()`` fallback for ``candidates_from_batches``."""
+    mats = [np.asarray(r["candidate"], dtype=np.float64) for r in rows]
+    if not mats:
+        return np.zeros((0, 0))
+    return np.stack(mats)
+
+
 class MomentsPartitionFn(_StatsAccumulatorFn):
     """mapInArrow body for StandardScaler's moment statistics."""
 
