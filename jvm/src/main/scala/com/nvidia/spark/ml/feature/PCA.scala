@@ -1,0 +1,148 @@
+/*
+ * Thin JVM shim over the spark-rapids-ml-tpu Python runtime.
+ *
+ * Drop-in surface parity target: the reference's
+ * com.nvidia.spark.ml.feature.PCA (reference PCA.scala:27-37), whose user
+ * story is "change one import and your Scala Spark ML pipeline runs
+ * accelerated". The reference could implement that natively in Scala
+ * because its engine lives in the executor JVM (spark-rapids plugin +
+ * JNI); this framework's engine is the Python/JAX/XLA runtime, so the shim
+ * inverts the boundary:
+ *
+ *   1. write dataset.select(inputCol) to a staging parquet dir
+ *      (public API only — no private Arrow hooks);
+ *   2. exec `python -m spark_rapids_ml_tpu.jvm_bridge fit-pca ...`
+ *      (driver-side; the fit fans out over the host's TPU mesh);
+ *   3. the bridge writes the model in STOCK Spark ML layout, so this
+ *      class finishes with org.apache.spark.ml.feature.PCAModel.load —
+ *      the caller receives a stock Spark PCAModel with JVM-native
+ *      transform, persistence, and Pipeline integration. No custom model
+ *      class exists on the JVM side at all.
+ *
+ * Build: `mvn -f jvm/pom.xml package` (needs a JDK + Maven; the Python
+ * package must be importable by the `python3` on PATH of the driver).
+ * See jvm/README.md for the scope rationale.
+ */
+package com.nvidia.spark.ml.feature
+
+import java.nio.file.{Files, Path => JPath}
+import java.util.Comparator
+
+import scala.sys.process._
+
+import org.apache.spark.ml.Estimator
+import org.apache.spark.ml.feature.PCAModel
+import org.apache.spark.ml.linalg.VectorUDT
+import org.apache.spark.ml.param.{IntParam, BooleanParam, Param, ParamMap, ParamValidators}
+import org.apache.spark.ml.param.shared.{HasInputCol, HasOutputCol}
+import org.apache.spark.ml.util.{DefaultParamsWritable, DefaultParamsReadable, Identifiable}
+import org.apache.spark.sql.Dataset
+import org.apache.spark.sql.functions.col
+import org.apache.spark.sql.types.{ArrayType, StructField, StructType}
+
+class PCA(override val uid: String)
+    extends Estimator[PCAModel]
+    with HasInputCol
+    with HasOutputCol
+    with DefaultParamsWritable {
+
+  def this() = this(Identifiable.randomUID("tpu-pca"))
+
+  /** Number of principal components (reference PCA.scala:31). */
+  final val k: IntParam =
+    new IntParam(this, "k", "number of principal components", ParamValidators.gt(0))
+
+  /** Matches the reference's meanCentering param (RapidsPCA.scala:40-45) —
+    * and actually centers, where the reference's is a TODO stub. */
+  final val meanCentering: BooleanParam =
+    new BooleanParam(this, "meanCentering", "center data before the covariance")
+
+  /** Decomposition solver: full | randomized | svd | auto. */
+  final val solver: Param[String] = new Param[String](
+    this, "solver", "decomposition solver",
+    ParamValidators.inArray(Array("full", "randomized", "svd", "auto")))
+
+  /** Python interpreter with spark_rapids_ml_tpu importable. */
+  final val pythonExec: Param[String] =
+    new Param[String](this, "pythonExec", "python interpreter for the bridge")
+
+  /** Staging directory for the parquet handoff. On a MULTI-NODE cluster
+    * this MUST be a shared filesystem path visible to every executor AND
+    * the driver (NFS mount, fuse-mounted object store, ...); the default
+    * (empty = driver-local temp) is only correct under local[*] masters,
+    * and fit() fails fast otherwise rather than training on the subset of
+    * part files that happened to land on the driver host. */
+  final val stagingDir: Param[String] =
+    new Param[String](this, "stagingDir", "shared staging dir for the handoff")
+
+  setDefault(meanCentering -> false, solver -> "full", pythonExec -> "python3",
+    stagingDir -> "", outputCol -> "pca_features")
+
+  def setInputCol(value: String): this.type = set(inputCol, value)
+  def setOutputCol(value: String): this.type = set(outputCol, value)
+  def setK(value: Int): this.type = set(k, value)
+  def setMeanCentering(value: Boolean): this.type = set(meanCentering, value)
+  def setSolver(value: String): this.type = set(solver, value)
+  def setPythonExec(value: String): this.type = set(pythonExec, value)
+  def setStagingDir(value: String): this.type = set(stagingDir, value)
+
+  override def fit(dataset: Dataset[_]): PCAModel = {
+    transformSchema(dataset.schema, logging = true)
+    val master = dataset.sparkSession.sparkContext.master
+    val sharedStaging = $(stagingDir).nonEmpty
+    require(master.startsWith("local") || sharedStaging,
+      s"master is $master (multi-node): executors write their parquet part " +
+        "files to THEIR local filesystems, so the default driver-local " +
+        "staging would silently train on a subset of the data. Call " +
+        "setStagingDir(<path on a filesystem shared by all executors and " +
+        "the driver>).")
+    val scratch: JPath =
+      if (sharedStaging) Files.createTempDirectory(
+        java.nio.file.Paths.get($(stagingDir)), "tpuml-pca-")
+      else Files.createTempDirectory("tpuml-pca-")
+    try {
+      val inputDir = scratch.resolve("input").toString
+      val modelDir = scratch.resolve("model").toString
+      dataset.select(col($(inputCol))).write.mode("overwrite").parquet(inputDir)
+
+      val cmd = Seq(
+        $(pythonExec), "-m", "spark_rapids_ml_tpu.jvm_bridge", "fit-pca",
+        "--input", inputDir, "--output", modelDir,
+        "--input-col", $(inputCol), "--output-col", $(outputCol),
+        "--k", $(k).toString, "--solver", $(solver), "--layout", "spark") ++
+        (if ($(meanCentering)) Seq("--mean-centering") else Seq.empty)
+      val exit = Process(cmd).!
+      require(exit == 0, s"jvm_bridge fit-pca failed with exit code $exit")
+
+      // The bridge wrote the STOCK Spark ML layout: loading it yields a
+      // stock PCAModel — JVM-native transform/persistence/Pipeline for free.
+      val model = PCAModel.load(modelDir)
+      copyValues(model.setParent(this))
+    } finally {
+      // the staged parquet is a full copy of the input column — never leak
+      // it past the fit
+      Files.walk(scratch).sorted(Comparator.reverseOrder[JPath]())
+        .forEach(p => Files.deleteIfExists(p))
+    }
+  }
+
+  override def transformSchema(schema: StructType): StructType = {
+    require(schema.fieldNames.contains($(inputCol)),
+      s"input column ${$(inputCol)} not found")
+    val inType = schema($(inputCol)).dataType
+    require(inType.isInstanceOf[VectorUDT] || inType.isInstanceOf[ArrayType],
+      s"input column ${$(inputCol)} must be a Vector or ArrayType column, " +
+        s"got $inType")
+    require(!schema.fieldNames.contains($(outputCol)),
+      s"output column ${$(outputCol)} already exists")
+    // append outputCol like stock Spark PCA does, so Pipeline.fit's schema
+    // chaining sees the column this stage will produce
+    StructType(schema.fields :+ StructField($(outputCol), new VectorUDT, false))
+  }
+
+  override def copy(extra: ParamMap): PCA = defaultCopy(extra)
+}
+
+object PCA extends DefaultParamsReadable[PCA] {
+  override def load(path: String): PCA = super.load(path)
+}

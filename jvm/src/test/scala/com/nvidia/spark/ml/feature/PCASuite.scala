@@ -1,0 +1,52 @@
+/*
+ * Differential suite following the reference's PCASuite pattern
+ * (PCASuite.scala:42-88): fit the shim and stock Spark ML PCA on the same
+ * data and compare components sign-invariantly at abs-tol 1e-5.
+ *
+ * Runs under `mvn -f jvm/pom.xml test` on a machine with a JDK and a
+ * python3 that can import spark_rapids_ml_tpu.
+ */
+package com.nvidia.spark.ml.feature
+
+import scala.math.abs
+import scala.util.Random
+
+import org.apache.spark.ml.feature.{PCA => SparkPCA}
+import org.apache.spark.ml.linalg.Vectors
+import org.apache.spark.sql.SparkSession
+import org.scalatest.funsuite.AnyFunSuite
+
+class PCASuite extends AnyFunSuite {
+
+  private lazy val spark = SparkSession.builder()
+    .master("local[4]")
+    .appName("spark-rapids-ml-tpu-jvm-suite")
+    .getOrCreate()
+
+  test("shim PCA matches stock Spark ML PCA (sign-invariant, 1e-5)") {
+    val rng = new Random(11)
+    val rows = Seq.fill(300)(
+      Tuple1(Vectors.dense(Array.fill(8)(rng.nextGaussian()))))
+    import spark.implicits._
+    val df = rows.toDF("features").repartition(4)
+
+    val shimModel = new PCA()
+      .setInputCol("features").setOutputCol("pca").setK(3)
+      .fit(df)
+    val stockModel = new SparkPCA()
+      .setInputCol("features").setOutputCol("pca").setK(3)
+      .fit(df)
+
+    val a = shimModel.pc.toArray
+    val b = stockModel.pc.toArray
+    assert(a.length == b.length)
+    a.zip(b).foreach { case (x, y) =>
+      assert(abs(abs(x) - abs(y)) < 1e-5, s"component mismatch: $x vs $y")
+    }
+
+    // the shim returns a STOCK PCAModel: transform is JVM-native
+    val out = shimModel.transform(df)
+    assert(out.columns.contains("pca"))
+    assert(out.count() == 300)
+  }
+}

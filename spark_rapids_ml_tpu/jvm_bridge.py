@@ -1,0 +1,112 @@
+"""JVM delegation entry point — the Scala shim's Python side.
+
+The reference's product is a Scala estimator usable from JVM Spark with
+zero code change (PCA.scala:27-37, packaged per pom.xml:345-396). Its JVM
+surface exists because its ENGINE lives in the executor JVM (a spark-rapids
+plugin + JNI). This framework's engine is the Python/JAX/XLA runtime, so
+the JVM story inverts: a thin Scala estimator (``jvm/`` at the repo root)
+hands the data off and THIS module runs the fit.
+
+Contract (public Spark APIs only, no private Arrow hooks):
+
+1. the Scala ``com.nvidia.spark.ml.feature.PCA``-shaped estimator writes
+   ``dataset.select(inputCol)`` as parquet to a scratch dir;
+2. it execs ``python -m spark_rapids_ml_tpu.jvm_bridge fit-pca --input
+   <dir> --output <dir> ...`` (driver-side; the fit itself fans out over
+   this host's TPU mesh — the one-device-owner-per-host deployment of
+   utils/devicepolicy.py);
+3. the model is written in ``layout="spark"`` — the stock Spark ML on-disk
+   shape — so the Scala side finishes with
+   ``org.apache.spark.ml.feature.PCAModel.load(path)`` and returns a STOCK
+   Spark model: JVM-native transform, persistence, and Pipeline integration
+   come for free, and the shim stays ~100 lines with no custom model class.
+
+Parquet written from either an ArrayType column or a pyspark.ml VectorUDT
+column is accepted (utils/columnar.py handles both Arrow layouts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _read_matrix(input_path: str, input_col: str):
+    import numpy as np
+    import pyarrow.dataset as pads
+
+    from spark_rapids_ml_tpu.utils import columnar
+
+    table = pads.dataset(input_path, format="parquet").to_table()
+    if input_col not in table.column_names:
+        raise SystemExit(
+            f"column {input_col!r} not in {input_path} "
+            f"(has: {table.column_names})"
+        )
+    mats = [
+        columnar.extract_matrix(batch, input_col)
+        for batch in table.to_batches()
+        if batch.num_rows
+    ]
+    if not mats:
+        raise SystemExit(f"no rows under {input_path}")
+    return np.concatenate(mats, axis=0)
+
+
+def fit_pca(args: argparse.Namespace) -> None:
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    x = _read_matrix(args.input, args.input_col)
+    est = (
+        PCA()
+        .setInputCol(args.input_col)
+        .setOutputCol(args.output_col)
+        .setK(args.k)
+        .setMeanCentering(args.mean_centering)
+        .setSolver(args.solver)
+    )
+    model = est.fit(x, num_partitions=args.num_partitions)
+    model.save(args.output, overwrite=True, layout=args.layout)
+    print(
+        f"fit-pca ok rows={x.shape[0]} n={x.shape[1]} k={args.k} "
+        f"-> {args.output} ({args.layout} layout)",
+        file=sys.stderr,
+    )
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="spark_rapids_ml_tpu.jvm_bridge",
+        description="Driver-side fit entry point for the JVM (Scala) shim",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    p = sub.add_parser("fit-pca", help="fit PCA from a parquet handoff")
+    p.add_argument("--input", required=True, help="parquet dir of the input column")
+    p.add_argument("--output", required=True, help="model output dir")
+    p.add_argument("--input-col", default="features")
+    p.add_argument("--output-col", default="pca_features")
+    p.add_argument("--k", type=int, required=True)
+    p.add_argument("--mean-centering", action="store_true")
+    p.add_argument(
+        "--solver", default="full", choices=["full", "randomized", "svd", "auto"]
+    )
+    p.add_argument(
+        "--layout",
+        default="spark",
+        choices=["spark", "native"],
+        help="'spark' (default) = stock pyspark.ml layout, loadable by "
+        "org.apache.spark.ml.feature.PCAModel.load",
+    )
+    p.add_argument(
+        "--num-partitions",
+        type=int,
+        default=None,
+        help="row partitions for the local fit (default: one)",
+    )
+    p.set_defaults(func=fit_pca)
+    args = parser.parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""The Python half of the JVM shim contract (spark_rapids_ml_tpu/jvm_bridge):
+parquet handoff in → TPU fit → stock-Spark-ML-layout model out. The Scala
+half (jvm/) consumes exactly this via ``PCAModel.load``.
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_ml_tpu import PCA
+from spark_rapids_ml_tpu.jvm_bridge import main
+from spark_rapids_ml_tpu.models.pca import PCAModel
+from spark_rapids_ml_tpu.utils import persistence as P
+
+
+@pytest.fixture
+def x():
+    return np.random.default_rng(0).normal(size=(240, 6))
+
+
+def _write_parquet(path, x, col="features"):
+    flat = pa.array(x.reshape(-1))
+    offsets = pa.array(np.arange(0, x.size + 1, x.shape[1], dtype=np.int32))
+    table = pa.table({col: pa.ListArray.from_arrays(offsets, flat)})
+    path.mkdir(parents=True, exist_ok=True)
+    # Spark writes a multi-part dir + _SUCCESS; mimic that shape
+    pq.write_table(table.slice(0, 120), path / "part-00000.snappy.parquet")
+    pq.write_table(table.slice(120), path / "part-00001.snappy.parquet")
+    (path / "_SUCCESS").write_text("")
+
+
+class TestJvmBridgeFitPCA:
+    def test_fit_writes_stock_spark_layout(self, x, tmp_path):
+        inp = tmp_path / "in"
+        out = tmp_path / "model"
+        _write_parquet(inp, x)
+        main([
+            "fit-pca", "--input", str(inp), "--output", str(out),
+            "--input-col", "features", "--k", "3",
+        ])
+        # the Scala side's whole contract: stock Spark ML layout
+        assert P.is_spark_ml_layout(str(out))
+        assert (out / "metadata" / "part-00000").exists()
+        assert (out / "data" / "_SUCCESS").exists()
+        loaded = PCAModel.load(str(out))
+        core = PCA().setInputCol("features").setK(3).fit(x)
+        np.testing.assert_allclose(np.abs(loaded.pc), np.abs(core.pc), atol=1e-7)
+
+    def test_solver_and_centering_flags(self, x, tmp_path):
+        inp = tmp_path / "in"
+        _write_parquet(inp, x + 3.0)
+        out = tmp_path / "model"
+        main([
+            "fit-pca", "--input", str(inp), "--output", str(out),
+            "--k", "2", "--solver", "svd", "--mean-centering",
+        ])
+        loaded = PCAModel.load(str(out))
+        core = (
+            PCA().setInputCol("features").setK(2).setSolver("svd")
+            .setMeanCentering(True).fit(x + 3.0)
+        )
+        np.testing.assert_allclose(np.abs(loaded.pc), np.abs(core.pc), atol=1e-7)
+
+    def test_vector_udt_parquet_input(self, x, tmp_path):
+        # a parquet dir written from a Spark VectorUDT column carries the
+        # sqlType struct; the bridge must accept it like the estimators do
+        inp = tmp_path / "in"
+        inp.mkdir()
+        struct = pa.StructArray.from_arrays(
+            [
+                pa.array([1] * len(x), pa.int8()),
+                pa.array([None] * len(x), pa.int32()),
+                pa.array([None] * len(x), pa.list_(pa.int32())),
+                pa.array([row.tolist() for row in x], pa.list_(pa.float64())),
+            ],
+            names=["type", "size", "indices", "values"],
+        )
+        pq.write_table(
+            pa.table({"features": struct}), inp / "part-00000.parquet"
+        )
+        out = tmp_path / "model"
+        main(["fit-pca", "--input", str(inp), "--output", str(out), "--k", "2"])
+        core = PCA().setInputCol("features").setK(2).fit(x)
+        np.testing.assert_allclose(
+            np.abs(PCAModel.load(str(out)).pc), np.abs(core.pc), atol=1e-7
+        )
+
+    def test_missing_column_is_actionable(self, x, tmp_path):
+        inp = tmp_path / "in"
+        _write_parquet(inp, x, col="other")
+        with pytest.raises(SystemExit, match="'features' not in"):
+            main(["fit-pca", "--input", str(inp), "--output",
+                  str(tmp_path / "m"), "--k", "2"])
+
+    def test_cli_subprocess_exactly_as_scala_invokes(self, x, tmp_path):
+        # the Scala shim's literal invocation: python -m ... fit-pca ...
+        inp = tmp_path / "in"
+        out = tmp_path / "model"
+        _write_parquet(inp, x)
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "spark_rapids_ml_tpu.jvm_bridge",
+                "fit-pca", "--input", str(inp), "--output", str(out),
+                "--input-col", "features", "--output-col", "pca_features",
+                "--k", "3", "--solver", "full", "--layout", "spark",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "fit-pca ok rows=240" in r.stderr
+        assert P.is_spark_ml_layout(str(out))
