@@ -350,6 +350,23 @@ def pca_fit_local(
     return pca_fit_from_cov(cov, k)
 
 
+def min_cosine_vs_f64_oracle(x_host, pc, k: int) -> float:
+    """Min per-component |cosine| of fitted components vs the f64 host
+    oracle (uncentered scatter eigh, descending) — the accuracy check the
+    bench publishes per round and CI gates on (tests/test_accuracy_validation
+    .py); ONE implementation so they can never desynchronize."""
+    import numpy as np
+
+    xa = np.asarray(x_host, dtype=np.float64)
+    pc = np.asarray(pc, dtype=np.float64)
+    _, evecs = np.linalg.eigh(xa.T @ xa)
+    oracle = evecs[:, ::-1][:, :k]
+    cosines = np.abs(np.sum(pc * oracle, axis=0)) / (
+        np.linalg.norm(pc, axis=0) * np.linalg.norm(oracle, axis=0)
+    )
+    return float(cosines.min())
+
+
 def qr_r(x: jax.Array) -> jax.Array:
     """R factor of a (tall) row block, always shaped [n, n].
 
