@@ -71,14 +71,12 @@ def _sql_mods(dataset):
     return types, functions
 
 
-class SparkPCA(PCA):
-    """PCA whose ``fit``/``transform`` accept ``pyspark.sql.DataFrame``.
+class _HasDistribution:
+    """Mixin: the DataFrame-fit cross-partition reduction strategy param —
+    ONE definition shared by every estimator that offers the SPMD barrier
+    path (subclasses narrow/widen ``_ALLOWED_DISTRIBUTIONS``)."""
 
-    Inherits every param (k, inputCol, outputCol, meanCentering, precision,
-    solver) and the persistence format from the core :class:`PCA`; only the
-    data path differs. Non-Spark inputs fall through to the core paths, so
-    one estimator serves both worlds.
-    """
+    _ALLOWED_DISTRIBUTIONS: tuple = ("driver-merge", "mesh-barrier")
 
     distribution = Param(
         "distribution",
@@ -88,11 +86,11 @@ class SparkPCA(PCA):
         "reduce, RapidsRowMatrix.scala:139), 'mesh-barrier' (all partition "
         "tasks form one jax.distributed SPMD mesh inside a barrier stage "
         "and the reduction is a psum collective in one XLA program — the "
-        "driver receives a single pre-reduced row; see spark/spmd.py), or "
-        "'mesh-local' (rows stream to the driver process, which runs the "
-        "same psum program over ITS device mesh — the one-device-owner-"
-        "per-host deployment where the driver holds all local chips; see "
-        "utils/devicepolicy.py)",
+        "driver receives a single pre-reduced row; see spark/spmd.py), or, "
+        "where supported, 'mesh-local' (rows stream to the driver process, "
+        "which runs the same psum program over ITS device mesh — the "
+        "one-device-owner-per-host deployment where the driver holds all "
+        "local chips; see utils/devicepolicy.py)",
         str,
     )
 
@@ -100,13 +98,24 @@ class SparkPCA(PCA):
         super().__init__(uid, **kwargs)
         self._setDefault(distribution="driver-merge")
 
-    def setDistribution(self, value: str) -> "SparkPCA":
-        if value not in ("driver-merge", "mesh-barrier", "mesh-local"):
+    def setDistribution(self, value: str):
+        if value not in self._ALLOWED_DISTRIBUTIONS:
             raise ValueError(
-                "distribution must be 'driver-merge', 'mesh-barrier', or "
-                "'mesh-local'"
+                f"distribution must be one of {self._ALLOWED_DISTRIBUTIONS}"
             )
         return self._set(distribution=value)
+
+
+class SparkPCA(_HasDistribution, PCA):
+    """PCA whose ``fit``/``transform`` accept ``pyspark.sql.DataFrame``.
+
+    Inherits every param (k, inputCol, outputCol, meanCentering, precision,
+    solver) and the persistence format from the core :class:`PCA`; only the
+    data path differs. Non-Spark inputs fall through to the core paths, so
+    one estimator serves both worlds.
+    """
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-barrier", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None) -> "SparkPCAModel":
         if not _is_spark_df(dataset):
@@ -141,30 +150,18 @@ class SparkPCA(PCA):
             if distribution == "mesh-barrier":
                 from spark_rapids_ml_tpu.spark import spmd
 
-                fit_fn = spmd.MeshGramPartitionFn(
-                    input_col, precision=self.getOrDefault("precision")
+                arrays = _barrier_single_row(
+                    selected,
+                    spmd.MeshGramPartitionFn(
+                        input_col, precision=self.getOrDefault("precision")
+                    ),
+                    spmd.MESH_FIELDS,
+                    {"xtx": (n, n), "col_sum": (n,), "count": (),
+                     "mesh_size": ()},
                 )
-                stats_df = selected.mapInArrow(
-                    fit_fn,
-                    schema=_spark_arrays_type(T, spmd.MESH_FIELDS),
-                    barrier=True,
+                stats = L.GramStats(
+                    arrays["xtx"], arrays["col_sum"], np.float64(arrays["count"])
                 )
-                batches = (
-                    stats_df.toArrow().to_batches()
-                    if hasattr(stats_df, "toArrow")
-                    else None
-                )
-                if batches is not None:
-                    stats, _ = spmd.single_stats_from_batches(batches, n)
-                else:  # PySpark 3.5 collect() fallback
-                    rows = stats_df.collect()
-                    stats, _ = spmd.single_stats_from_batches(
-                        [arrow_fns.arrays_to_batch(
-                            {f: np.asarray(r[f], dtype=np.float64)
-                             for f in spmd.MESH_FIELDS}
-                        ) for r in rows],
-                        n,
-                    )
             elif distribution == "mesh-local":
                 stats = self._mesh_local_stats(selected, input_col, n)
             else:
@@ -350,6 +347,27 @@ def _spark_arrays_type(T, fields: list[str]):
     )
 
 
+def _barrier_single_row(df, fn, fields: list[str], shapes: dict[str, tuple]):
+    """Run one barrier-stage SPMD pass (spark/spmd.py) and decode the ONE
+    pre-reduced stats row it delivers; shared by every mesh-barrier fit."""
+    from spark_rapids_ml_tpu.spark import spmd
+
+    T, _ = _sql_mods(df)
+    stats_df = df.mapInArrow(
+        fn, schema=_spark_arrays_type(T, fields), barrier=True
+    )
+    if hasattr(stats_df, "toArrow"):
+        batches = stats_df.toArrow().to_batches()
+    else:  # PySpark 3.5 collect() fallback
+        batches = [
+            arrow_fns.arrays_to_batch(
+                {f: np.asarray(r[f], dtype=np.float64) for f in fields}
+            )
+            for r in stats_df.collect()
+        ]
+    return spmd.single_row_from_batches(batches, fields, shapes)
+
+
 def _collect_stats(df, partition_fn, fields: list[str], shapes: dict[str, tuple]):
     """Run a stats mapInArrow pass and sum-merge the per-partition rows on
     the driver (toArrow on PySpark >= 4, collect() fallback below)."""
@@ -416,9 +434,15 @@ def _infer_n(df, col: str) -> int:
 # ---------------------------------------------------------------------------
 
 
-class SparkLinearRegression(LinearRegression):
+class SparkLinearRegression(_HasDistribution, LinearRegression):
     """LinearRegression over pyspark DataFrames: one mapInArrow stats pass,
-    driver-side normal-equations solve. Non-Spark inputs fall through."""
+    driver-side normal-equations solve. Non-Spark inputs fall through.
+
+    ``distribution='mesh-barrier'`` replaces the driver-side sum-merge with
+    one SPMD psum across the barrier stage's jax.distributed process group
+    (spark/spmd.py MeshLinRegPartitionFn): the [n, n] normal-equations
+    reductions ride the mesh interconnect and the driver receives a single
+    pre-reduced row."""
 
     def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
         if kwargs:
@@ -447,10 +471,21 @@ class SparkLinearRegression(LinearRegression):
             "y_sum": (), "y_sq": (), "count": (),
         }
         with trace_range("linreg stats"):
-            fn = arrow_fns.make_linreg_partition_fn(feats, label, weight_col)
-            arrays = _collect_stats(
-                dataset.select(*cols), fn, list(shapes), shapes
-            )
+            if self.getOrDefault("distribution") == "mesh-barrier":
+                from spark_rapids_ml_tpu.spark import spmd
+
+                arrays = _barrier_single_row(
+                    dataset.select(*cols),
+                    spmd.MeshLinRegPartitionFn(feats, label, weight_col),
+                    spmd.LINREG_MESH_FIELDS,
+                    {**shapes, "mesh_size": ()},
+                )
+                arrays.pop("mesh_size")
+            else:
+                fn = arrow_fns.make_linreg_partition_fn(feats, label, weight_col)
+                arrays = _collect_stats(
+                    dataset.select(*cols), fn, list(shapes), shapes
+                )
             if weight_col and float(arrays["count"]) == 0.0:
                 raise ValueError("all instance weights are zero")
         with trace_range("linreg solve"):
@@ -954,8 +989,10 @@ class SparkKMeansModel(KMeansModel):
 # ---------------------------------------------------------------------------
 
 
-class SparkStandardScaler(StandardScaler):
-    """StandardScaler over pyspark DataFrames: one mapInArrow moments pass."""
+class SparkStandardScaler(_HasDistribution, StandardScaler):
+    """StandardScaler over pyspark DataFrames: one mapInArrow moments pass;
+    ``distribution='mesh-barrier'`` reduces the moments as one SPMD psum
+    across the barrier stage's process group (spark/spmd.py)."""
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         if not _is_spark_df(dataset):
@@ -972,8 +1009,21 @@ class SparkStandardScaler(StandardScaler):
         n = _infer_n(dataset, input_col)
         shapes = {"count": (), "total": (n,), "total_sq": (n,)}
         with trace_range("scaler moments"):
-            fn = arrow_fns.make_moments_partition_fn(input_col)
-            arrays = _collect_stats(dataset.select(input_col), fn, list(shapes), shapes)
+            if self.getOrDefault("distribution") == "mesh-barrier":
+                from spark_rapids_ml_tpu.spark import spmd
+
+                arrays = _barrier_single_row(
+                    dataset.select(input_col),
+                    spmd.MeshMomentsPartitionFn(input_col),
+                    spmd.MOMENTS_MESH_FIELDS,
+                    {**shapes, "mesh_size": ()},
+                )
+                arrays.pop("mesh_size")
+            else:
+                fn = arrow_fns.make_moments_partition_fn(input_col)
+                arrays = _collect_stats(
+                    dataset.select(input_col), fn, list(shapes), shapes
+                )
             stats = S.MomentStats(**{f: jnp.asarray(v) for f, v in arrays.items()})
             mean, std = S.finalize_moments(stats)
         model = SparkStandardScalerModel(

@@ -30,7 +30,11 @@ How the Spark scheduler meets the mesh (SURVEY.md §7 hard part 2):
 5. rank 0 emits the replicated result as a single Arrow row; other ranks
    emit nothing.
 
-The fallback when barrier scheduling is unavailable stays the portable
+The machinery is estimator-generic: every stats-monoid estimator
+instantiates ``_MeshReducePartitionFn`` with its own shard kernel —
+``MeshGramPartitionFn`` (PCA), ``MeshLinRegPartitionFn``
+(LinearRegression), ``MeshMomentsPartitionFn`` (StandardScaler). The
+fallback when barrier scheduling is unavailable stays the portable
 driver-merge path in ``estimators.py`` (reference-parity architecture).
 """
 
@@ -48,6 +52,10 @@ from spark_rapids_ml_tpu.spark import arrow_fns
 from spark_rapids_ml_tpu.utils import columnar
 
 MESH_FIELDS = ["xtx", "col_sum", "count", "mesh_size"]
+LINREG_MESH_FIELDS = [
+    "xtx", "xty", "x_sum", "y_sum", "y_sq", "count", "mesh_size",
+]
+MOMENTS_MESH_FIELDS = ["total", "total_sq", "count", "mesh_size"]
 
 
 def get_barrier_context():
@@ -75,22 +83,50 @@ def _free_port() -> int:
 def _pad_to(mat: np.ndarray, rows: int) -> np.ndarray:
     if mat.shape[0] == rows:
         return mat
-    out = np.zeros((rows, mat.shape[1]), dtype=mat.dtype)
+    out = np.zeros((rows,) + mat.shape[1:], dtype=mat.dtype)
     out[: mat.shape[0]] = mat
     return out
 
 
-class MeshGramPartitionFn:
-    """Barrier-stage plan function: fit-pass GramStats via one SPMD psum.
+class _MeshReducePartitionFn:
+    """Base barrier-stage plan function: one SPMD psum of a sum-monoid.
 
-    Picklable by construction (plain column name + precision tag, like every
-    plan fn in ``arrow_fns``); everything heavy happens inside the task.
+    Subclasses set ``FIELDS`` (output stat names, which always end with
+    ``count`` and ``mesh_size``) and implement ``_shard_kernel()``. With
+    ``USES_VECTORS`` unset the kernel takes ``(x_shard,)`` only; with it set
+    the kernel takes ``(x_shard, w_shard, y_shard)`` where ``w`` carries
+    instance weights on true rows and 0.0 on pad rows (the framework-wide
+    masking convention) and ``y`` is the label shard — the vector operands
+    are built and transferred only when a kernel actually consumes them.
+
+    Picklable by construction (plain column names + tags, like every plan fn
+    in ``arrow_fns``); everything heavy happens inside the task.
     """
 
-    def __init__(self, input_col: str, precision: str = "highest"):
+    FIELDS: list[str] = []
+    #: count comes from the rendezvous row total (exact under zero-padding)
+    #: unless the kernel emits a weighted count itself
+    COUNT_FROM_KERNEL = False
+    #: kernel signature: (x,) when False, (x, w, y) when True
+    USES_VECTORS = False
+
+    def __init__(
+        self,
+        input_col: str,
+        label_col: str | None = None,
+        weight_col: str | None = None,
+        precision: str = "highest",
+    ):
         self.input_col = input_col
+        self.label_col = label_col
+        self.weight_col = weight_col
         self.precision = precision
 
+    # -- subclass hook -------------------------------------------------------
+    def _shard_kernel(self):
+        raise NotImplementedError
+
+    # -- the mapInArrow body --------------------------------------------------
     def __call__(
         self, batches: Iterator[pa.RecordBatch]
     ) -> Iterator[pa.RecordBatch]:
@@ -98,15 +134,35 @@ class MeshGramPartitionFn:
         rank = ctx.partitionId()
         size = len(ctx.getTaskInfos())
 
-        mats = [
-            columnar.extract_matrix(b, self.input_col)
-            for b in batches
-            if b.num_rows
-        ]
+        mats, ys, ws = [], [], []
+        for b in batches:
+            if not b.num_rows:
+                continue
+            mat = columnar.extract_matrix(b, self.input_col)
+            mats.append(mat)
+            if self.label_col:
+                ys.append(
+                    np.asarray(
+                        b.column(self.label_col).to_numpy(zero_copy_only=False),
+                        dtype=np.float64,
+                    )
+                )
+            if self.weight_col:
+                ws.append(
+                    columnar.validate_weights(
+                        b.column(self.weight_col).to_numpy(zero_copy_only=False),
+                        len(mat),
+                        allow_all_zero=True,
+                    )
+                )
         local = (
             np.concatenate(mats, axis=0)
             if mats
             else np.zeros((0, 0), dtype=np.float64)
+        )
+        y_local = np.concatenate(ys) if ys else np.zeros(local.shape[0])
+        w_local = (
+            np.concatenate(ws) if ws else np.ones(local.shape[0])
         )
 
         # Rendezvous round: rank 0 proposes the jax.distributed coordinator;
@@ -154,18 +210,35 @@ class MeshGramPartitionFn:
                 jax.devices(), key=lambda d: (d.process_index, d.id)
             )
             mesh = create_mesh(data=len(devices), feat=1, devices=devices)
-            sharding = NamedSharding(mesh, P(DATA_AXIS, None))
-            garr = jax.make_array_from_process_local_data(
-                sharding, padded, (size * shard_rows, n)
+            x_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+            gx = jax.make_array_from_process_local_data(
+                x_sharding, padded, (size * shard_rows, n)
             )
+            operands = [gx]
+            specs = [P(DATA_AXIS, None)]
+            if self.USES_VECTORS:
+                v_sharding = NamedSharding(mesh, P(DATA_AXIS))
+                w_pad = _pad_to(w_local, shard_rows)  # pad rows get weight 0
+                y_pad = _pad_to(y_local, shard_rows)
+                operands.append(
+                    jax.make_array_from_process_local_data(
+                        v_sharding, w_pad, (size * shard_rows,)
+                    )
+                )
+                operands.append(
+                    jax.make_array_from_process_local_data(
+                        v_sharding, y_pad, (size * shard_rows,)
+                    )
+                )
+                specs += [P(DATA_AXIS), P(DATA_AXIS)]
+            kernel = self._shard_kernel()
             stats = B.mapreduce_data_axis(
-                lambda xl: L.gram_stats(
-                    xl, precision=L.PRECISIONS[self.precision]
-                ),
-                mesh,
-            )(garr)
-            xtx = np.asarray(jax.device_get(stats.xtx))
-            col_sum = np.asarray(jax.device_get(stats.col_sum))
+                kernel, mesh, in_specs=tuple(specs)
+            )(*operands)
+            host = {
+                name: np.asarray(jax.device_get(v))
+                for name, v in stats.items()
+            }
         finally:
             try:
                 jax.distributed.shutdown()
@@ -173,22 +246,74 @@ class MeshGramPartitionFn:
                 pass  # ephemeral worker exits right after the stage anyway
 
         if rank == 0:
-            # count uses the TRUE row total from the rendezvous (pad rows
-            # contribute zero to xtx/col_sum and are excluded here)
+            if not self.COUNT_FROM_KERNEL:
+                # pad rows contribute zero to every statistic; the TRUE row
+                # total comes from the rendezvous
+                host["count"] = np.float64(total_rows)
+            host["mesh_size"] = np.float64(size)
             yield arrow_fns.arrays_to_batch(
-                {
-                    "xtx": xtx,
-                    "col_sum": col_sum,
-                    "count": np.float64(total_rows),
-                    "mesh_size": np.float64(size),
-                }
+                {name: host[name] for name in self.FIELDS}
             )
 
 
-def single_stats_from_batches(
-    batches, n: int
-) -> tuple[L.GramStats, int]:
-    """Decode the barrier stage's output: EXACTLY one pre-reduced stats row.
+class MeshGramPartitionFn(_MeshReducePartitionFn):
+    """Fit-pass GramStats via one SPMD psum (the PCA barrier path)."""
+
+    FIELDS = MESH_FIELDS
+
+    def _shard_kernel(self):
+        precision = L.PRECISIONS[self.precision]
+
+        def kernel(x):  # zero pad rows are exact for the Gram monoid
+            import jax.numpy as jnp
+
+            return {
+                "xtx": L.gram(x, precision=precision),
+                "col_sum": jnp.sum(x, axis=0),
+            }
+
+        return kernel
+
+
+class MeshLinRegPartitionFn(_MeshReducePartitionFn):
+    """LinearStats via one SPMD psum — distributed normal equations where
+    the [n, n]/[n] reductions ride ICI, not the driver."""
+
+    FIELDS = LINREG_MESH_FIELDS
+    COUNT_FROM_KERNEL = True  # weighted count (Σw) — w is 0 on pad rows
+    USES_VECTORS = True
+
+    def _shard_kernel(self):
+        def kernel(x, w, y):
+            from spark_rapids_ml_tpu.ops import linear as LIN
+
+            s = LIN.linear_stats(x, y, w)
+            return dict(zip(s._fields, s))
+
+        return kernel
+
+
+class MeshMomentsPartitionFn(_MeshReducePartitionFn):
+    """MomentStats via one SPMD psum (the StandardScaler barrier path)."""
+
+    FIELDS = MOMENTS_MESH_FIELDS
+
+    def _shard_kernel(self):
+        def kernel(x):
+            import jax.numpy as jnp
+
+            return {
+                "total": jnp.sum(x, axis=0),
+                "total_sq": jnp.sum(x * x, axis=0),
+            }
+
+        return kernel
+
+
+def single_row_from_batches(
+    batches, fields: list[str], shapes: dict[str, tuple]
+) -> dict[str, np.ndarray]:
+    """Decode a barrier stage's output: EXACTLY one pre-reduced stats row.
 
     More than one row means per-partition statistics leaked to the driver —
     the architectural regression this path exists to prevent — so it raises
@@ -204,7 +329,7 @@ def single_stats_from_batches(
                 name: np.asarray(
                     t.column(name)[0].values.to_numpy(zero_copy_only=False)
                 )
-                for name in MESH_FIELDS
+                for name in fields
             }
     if arrays is None:
         raise ValueError("no statistics received from the barrier stage")
@@ -213,9 +338,19 @@ def single_stats_from_batches(
             f"mesh fit must deliver exactly ONE pre-reduced stats row to the "
             f"driver, got {rows} — per-partition statistics are leaking"
         )
-    stats = L.GramStats(
-        arrays["xtx"].reshape(n, n),
-        arrays["col_sum"].reshape(n),
-        np.float64(arrays["count"][0]),
+    return {name: arrays[name].reshape(shapes[name]) for name in fields}
+
+
+def single_stats_from_batches(
+    batches, n: int
+) -> tuple[L.GramStats, int]:
+    """The PCA-shaped decode of ``single_row_from_batches``."""
+    arrays = single_row_from_batches(
+        batches,
+        MESH_FIELDS,
+        {"xtx": (n, n), "col_sum": (n,), "count": (), "mesh_size": ()},
     )
-    return stats, int(arrays["mesh_size"][0])
+    stats = L.GramStats(
+        arrays["xtx"], arrays["col_sum"], np.float64(arrays["count"])
+    )
+    return stats, int(arrays["mesh_size"])

@@ -155,3 +155,83 @@ class TestSparkPCAMeshBarrier:
     def test_bad_distribution_rejected(self):
         with pytest.raises(ValueError, match="distribution"):
             SparkPCA().setDistribution("gossip")
+
+
+class TestMeshBarrierBeyondPCA:
+    """The SPMD barrier machinery is estimator-generic (r3): every
+    stats-monoid estimator reduces through one psum program."""
+
+    def test_linreg_mesh_barrier_differential(self, session, rng):
+        from spark_rapids_ml_tpu.spark import SparkLinearRegression
+
+        x = rng.normal(size=(400, 5))
+        coef = np.array([1.0, -2.0, 0.5, 3.0, 0.0])
+        y = x @ coef + 1.5 + 0.01 * rng.normal(size=400)
+        schema = LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        )
+        df = session.createDataFrame(
+            [(row.tolist(), float(lbl)) for row, lbl in zip(x, y)],
+            schema,
+            numPartitions=4,
+        )
+        base = SparkLinearRegression().setRegParam(1e-6)
+        mesh = base.copy().setDistribution("mesh-barrier").fit(df)
+        merge = base.copy().setDistribution("driver-merge").fit(df)
+        np.testing.assert_allclose(mesh.coefficients, merge.coefficients, atol=1e-8)
+        np.testing.assert_allclose(mesh.intercept, merge.intercept, atol=1e-8)
+
+    def test_linreg_mesh_barrier_weighted(self, session, rng):
+        from spark_rapids_ml_tpu.spark import SparkLinearRegression
+
+        x = rng.normal(size=(300, 3))
+        y = x @ np.ones(3)
+        y_bad = y.copy()
+        y_bad[150:] += 50.0
+        w = np.ones(300)
+        w[150:] = 1e-12
+        schema = LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+                LT.StructField("wt", LT.DoubleType()),
+            ]
+        )
+        df = session.createDataFrame(
+            [
+                (row.tolist(), float(lbl), float(wi))
+                for row, lbl, wi in zip(x, y_bad, w)
+            ],
+            schema,
+            numPartitions=4,
+        )
+        model = (
+            SparkLinearRegression().setWeightCol("wt")
+            .setDistribution("mesh-barrier").fit(df)
+        )
+        np.testing.assert_allclose(model.coefficients, np.ones(3), atol=1e-4)
+
+    def test_scaler_mesh_barrier_differential(self, session, rng):
+        from spark_rapids_ml_tpu.spark import SparkStandardScaler
+
+        x = rng.normal(size=(350, 6)) * 3.0 + 5.0
+        df = _features_df(session, x, partitions=4)
+        base = SparkStandardScaler().setInputCol("features")
+        mesh = base.copy().setDistribution("mesh-barrier").fit(df)
+        merge = base.copy().setDistribution("driver-merge").fit(df)
+        np.testing.assert_allclose(mesh.mean, merge.mean, atol=1e-10)
+        np.testing.assert_allclose(mesh.std, merge.std, atol=1e-10)
+
+    def test_bad_distribution_rejected(self):
+        from spark_rapids_ml_tpu.spark import (
+            SparkLinearRegression,
+            SparkStandardScaler,
+        )
+
+        with pytest.raises(ValueError, match="distribution"):
+            SparkLinearRegression().setDistribution("mesh-local")
+        with pytest.raises(ValueError, match="distribution"):
+            SparkStandardScaler().setDistribution("gossip")
