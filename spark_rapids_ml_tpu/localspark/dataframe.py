@@ -187,6 +187,32 @@ class DataFrame:
             for lo, hi in zip(lows, bounds)
         ]
 
+    def union(self, other: "DataFrame") -> "DataFrame":
+        """Concatenate rows POSITIONALLY (pyspark ``union`` semantics):
+        ``other``'s i-th column becomes this DataFrame's i-th column
+        regardless of its name, so the result's batches all carry THIS
+        schema's names and later name-based ops stay aligned."""
+        if len(self._schema.fields) != len(other._schema.fields):
+            raise ValueError(
+                f"union requires the same number of columns: "
+                f"{len(self._schema.fields)} vs {len(other._schema.fields)}"
+            )
+        names = [f.name for f in self._schema.fields]
+
+        def parts():
+            yield from self._parts()
+            for part in other._parts():
+                yield [
+                    pa.RecordBatch.from_arrays(list(b.columns), names=names)
+                    for b in part
+                ]
+
+        return self._derive(
+            self._schema, parts, self.rdd.getNumPartitions() + other.rdd.getNumPartitions()
+        )
+
+    unionAll = union  # pyspark alias
+
     def limit(self, n: int) -> "DataFrame":
         def parts():
             remaining = n

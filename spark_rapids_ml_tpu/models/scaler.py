@@ -166,13 +166,20 @@ class Normalizer(HasInputCol, HasOutputCol, Transformer):
     def getP(self) -> float:
         return self.getOrDefault("p")
 
+    def _normalize_matrix(self, mat: np.ndarray) -> np.ndarray:
+        """[rows, n] → row-p-normalized [rows, n]; the one matrix fn both the
+        local and the Spark (mapInArrow) transform paths run."""
+        return np.asarray(
+            jax.jit(S.normalize, static_argnums=(1,))(
+                jnp.asarray(mat), self.getP()
+            )
+        )
+
     def transform(self, dataset: Any) -> Any:
-        p = self.getP()
-        fn = jax.jit(lambda m: S.normalize(m, p))
         with trace_range("normalize"):
             return columnar.apply_column_transform(
                 dataset,
                 self._paramMap.get("inputCol"),
                 self.getOutputCol(),
-                lambda m: np.asarray(fn(jnp.asarray(m))),
+                self._normalize_matrix,
             )

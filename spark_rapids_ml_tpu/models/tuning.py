@@ -37,7 +37,48 @@ except Exception:  # pragma: no cover
 # ---------------------------------------------------------------------------
 
 
+def _is_spark_df(dataset: Any) -> bool:
+    return columnar.is_spark_dataframe(dataset)
+
+
+def _df_columns(df, *cols: str) -> list[np.ndarray]:
+    """Collect the named DataFrame columns in ONE job (separate collects
+    would re-execute the lineage per column and rely on cross-job row-order
+    stability for metric alignment). Scalar columns come back as [rows]
+    vectors, array/Vector columns as [rows, n] matrices; toArrow fast path
+    when the backend has it."""
+    selected = df.select(*cols)
+    if hasattr(selected, "toArrow"):
+        table = selected.toArrow()
+        out = []
+        for c in cols:
+            col = table.column(c)
+            typ = col.type
+            if pa.types.is_floating(typ) or pa.types.is_integer(typ):
+                out.append(
+                    np.asarray(col.to_numpy(zero_copy_only=False), dtype=np.float64)
+                )
+            else:
+                out.append(columnar.extract_matrix(table, c))
+        return out
+    rows = selected.collect()
+    out = []
+    for i, _ in enumerate(cols):
+        vals = [r[i] for r in rows]
+        if vals and (
+            np.isscalar(vals[0]) or isinstance(vals[0], (int, float))
+        ):
+            out.append(np.asarray(vals, dtype=np.float64))
+        else:
+            out.append(
+                np.stack([columnar.row_vector_to_ndarray(v) for v in vals])
+            )
+    return out
+
+
 def n_rows(dataset: Any) -> int:
+    if _is_spark_df(dataset):
+        return dataset.count()
     if isinstance(dataset, tuple) and len(dataset) in (2, 3):
         return len(np.asarray(dataset[0]))
     if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
@@ -96,6 +137,8 @@ def _collect_for_split(dataset: Any) -> Any:
 def _labels_of(dataset: Any, label_col: str) -> np.ndarray:
     if isinstance(dataset, tuple) and len(dataset) in (2, 3):
         return np.asarray(dataset[1], dtype=np.float64)
+    if _is_spark_df(dataset):
+        return _df_columns(dataset, label_col)[0]
     return columnar.extract_vector(dataset, label_col)
 
 
@@ -145,10 +188,24 @@ class Evaluator(Params):
     def isLargerBetter(self) -> bool:
         return True
 
-    def _predictions_of(self, dataset, predictions):
+    def _labeled_pair(self, dataset, predictions):
+        """(labels, predictions) host vectors — ONE DataFrame job when both
+        columns come from the same DF (separate collects would re-execute
+        the transform lineage and risk cross-job row-order drift)."""
+        label_col = self.getOrDefault("labelCol")
+        pred_col = self.getOrDefault("predictionCol")
         if predictions is not None:
-            return np.asarray(predictions, dtype=np.float64).reshape(-1)
-        return columnar.extract_vector(dataset, self.getOrDefault("predictionCol"))
+            return (
+                _labels_of(dataset, label_col),
+                np.asarray(predictions, dtype=np.float64).reshape(-1),
+            )
+        if _is_spark_df(dataset):
+            y, p = _df_columns(dataset, label_col, pred_col)
+            return y, p
+        return (
+            _labels_of(dataset, label_col),
+            columnar.extract_vector(dataset, pred_col),
+        )
 
 
 class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
@@ -171,8 +228,7 @@ class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         return self.getOrDefault("metricName") == "r2"
 
     def evaluate(self, dataset, predictions=None) -> float:
-        y = _labels_of(dataset, self.getOrDefault("labelCol"))
-        p = self._predictions_of(dataset, predictions)
+        y, p = self._labeled_pair(dataset, predictions)
         err = y - p
         metric = self.getOrDefault("metricName")
         if metric == "mse":
@@ -204,8 +260,7 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         return self._set(metricName=value)
 
     def evaluate(self, dataset, predictions=None) -> float:
-        y = _labels_of(dataset, self.getOrDefault("labelCol"))
-        p = self._predictions_of(dataset, predictions)
+        y, p = self._labeled_pair(dataset, predictions)
         if self.getOrDefault("metricName") == "accuracy":
             return float(np.mean((p >= 0.5) == (y >= 0.5)))
         pos, neg = p[y >= 0.5], p[y < 0.5]
@@ -243,9 +298,31 @@ class ClusteringEvaluator(Evaluator):
             self._set(**{k: v for k, v in kwargs.items() if v is not None})
 
     def evaluate(self, dataset, predictions=None) -> float:
-        x = columnar.extract_matrix(dataset, self.getOrDefault("featuresCol"))
-        p = self._predictions_of(dataset, predictions).astype(np.int64)
+        feats = self.getOrDefault("featuresCol")
+        pred_col = self.getOrDefault("predictionCol")
         cap = self.getOrDefault("maxRows")
+        if _is_spark_df(dataset) and predictions is None:
+            # push the subsample into the PLAN: never materialize more than
+            # ~2*cap rows on the driver for a cap-bounded metric
+            total = dataset.count()
+            if total > cap:
+                dataset = dataset.sample(
+                    fraction=min(1.0, 2.0 * cap / total), seed=0
+                )
+            x, p = _df_columns(dataset, feats, pred_col)
+            p = p.astype(np.int64)
+        else:
+            x = (
+                _df_columns(dataset, feats)[0]
+                if _is_spark_df(dataset)
+                else columnar.extract_matrix(dataset, feats)
+            )
+            if predictions is not None:
+                p = np.asarray(predictions, dtype=np.float64).reshape(-1).astype(np.int64)
+            elif _is_spark_df(dataset):
+                p = _df_columns(dataset, pred_col)[0].astype(np.int64)
+            else:
+                p = columnar.extract_vector(dataset, pred_col).astype(np.int64)
         if len(x) > cap:
             sel = np.random.default_rng(0).choice(len(x), cap, replace=False)
             x, p = x[sel], p[sel]
@@ -287,11 +364,19 @@ def _fit_and_eval(estimator, params, evaluator, train, val):
         and evaluator.getOrDefault("metricName") == "areaUnderROC"
         and hasattr(model, "predict_proba_matrix")
     ):
-        feats = (
-            np.asarray(val[0])
-            if isinstance(val, tuple)
-            else columnar.extract_matrix(val, model.getOrDefault("featuresCol"))
-        )
+        fcol = model.getOrDefault("featuresCol")
+        lcol = evaluator.getOrDefault("labelCol")
+        if isinstance(val, tuple):
+            feats = np.asarray(val[0])
+            scores = model.predict_proba_matrix(feats)
+            return model, evaluator.evaluate(val, predictions=scores)
+        if _is_spark_df(val):
+            feats, labels = _df_columns(val, fcol, lcol)  # one job
+            scores = model.predict_proba_matrix(feats)
+            return model, evaluator.evaluate(
+                (feats, labels), predictions=scores
+            )
+        feats = columnar.extract_matrix(val, fcol)
         scores = model.predict_proba_matrix(feats)
         return model, evaluator.evaluate(val, predictions=scores)
     if isinstance(val, tuple):
@@ -345,27 +430,65 @@ class CrossValidator(_ValidatorParams, Estimator):
         k = self.getOrDefault("numFolds")
         if k < 2:
             raise ValueError("numFolds must be >= 2")
-        dataset = _collect_for_split(dataset)
-        rng = np.random.default_rng(self.getOrDefault("seed"))
-        idx = rng.permutation(n_rows(dataset))
-        folds = np.array_split(idx, k)
+        if _is_spark_df(dataset):
+            # Spark-style fold assignment: one randomSplit plans k disjoint
+            # row subsets; each fold's train set is the union of the others.
+            # No row ever leaves the cluster for the split itself.
+            from functools import reduce
+
+            splits = dataset.randomSplit(
+                [1.0 / k] * k, seed=self.getOrDefault("seed")
+            )
+            if any(sp.first() is None for sp in splits):
+                raise ValueError(
+                    f"randomSplit produced an empty fold (numFolds={k}); "
+                    "the dataset is too small for this many folds"
+                )
+        else:
+            dataset = _collect_for_split(dataset)
+            rng = np.random.default_rng(self.getOrDefault("seed"))
+            idx = rng.permutation(n_rows(dataset))
+            folds = np.array_split(idx, k)
+            splits = None
         candidates = self._candidates()
         metrics = np.zeros((len(candidates), k))
         sub_models = [] if self._collect else None
         for f in range(k):
-            val_idx = folds[f]
-            train_idx = np.concatenate([folds[i] for i in range(k) if i != f])
-            train = row_slice(dataset, train_idx)
-            val = row_slice(dataset, val_idx)
-            fold_models = []
-            for c, params in enumerate(candidates):
-                model, metric = _fit_and_eval(
-                    self._estimator, params, self._evaluator, train, val
+            if splits is not None:
+                val = splits[f]
+                train = reduce(
+                    lambda a, b: a.union(b),
+                    [splits[i] for i in range(k) if i != f],
                 )
-                metrics[c, f] = metric
-                fold_models.append(model)
-            if sub_models is not None:
-                sub_models.append(fold_models)
+                # cache the fold: iterative candidates (Newton/Lloyd) run
+                # many jobs over train, and each would otherwise re-execute
+                # the randomSplit filters against the source
+                if hasattr(train, "cache"):
+                    train = train.cache()
+                if hasattr(val, "cache"):
+                    val = val.cache()
+            else:
+                val_idx = folds[f]
+                train_idx = np.concatenate(
+                    [folds[i] for i in range(k) if i != f]
+                )
+                train = row_slice(dataset, train_idx)
+                val = row_slice(dataset, val_idx)
+            try:
+                fold_models = []
+                for c, params in enumerate(candidates):
+                    model, metric = _fit_and_eval(
+                        self._estimator, params, self._evaluator, train, val
+                    )
+                    metrics[c, f] = metric
+                    fold_models.append(model)
+                if sub_models is not None:
+                    sub_models.append(fold_models)
+            finally:
+                if splits is not None:
+                    for df_ in (train, val):
+                        if hasattr(df_, "unpersist"):
+                            df_.unpersist()
         avg = metrics.mean(axis=1)
         best_idx = int(np.argmax(avg) if self._evaluator.isLargerBetter() else np.argmin(avg))
         best_est = self._estimator.copy()
@@ -425,14 +548,27 @@ class TrainValidationSplit(_ValidatorParams, Estimator):
         ratio = self.getOrDefault("trainRatio")
         if not 0.0 < ratio < 1.0:
             raise ValueError("trainRatio must be in (0, 1)")
-        dataset = _collect_for_split(dataset)
-        rng = np.random.default_rng(self.getOrDefault("seed"))
-        idx = rng.permutation(n_rows(dataset))
-        cut = int(len(idx) * ratio)
-        if cut == 0 or cut == len(idx):
-            raise ValueError("split produced an empty train or validation set")
-        train = row_slice(dataset, idx[:cut])
-        val = row_slice(dataset, idx[cut:])
+        if _is_spark_df(dataset):
+            train, val = dataset.randomSplit(
+                [ratio, 1.0 - ratio], seed=self.getOrDefault("seed")
+            )
+            if train.first() is None or val.first() is None:
+                raise ValueError(
+                    "split produced an empty train or validation set"
+                )
+            if hasattr(train, "cache"):
+                train, val = train.cache(), val.cache()
+        else:
+            dataset = _collect_for_split(dataset)
+            rng = np.random.default_rng(self.getOrDefault("seed"))
+            idx = rng.permutation(n_rows(dataset))
+            cut = int(len(idx) * ratio)
+            if cut == 0 or cut == len(idx):
+                raise ValueError(
+                    "split produced an empty train or validation set"
+                )
+            train = row_slice(dataset, idx[:cut])
+            val = row_slice(dataset, idx[cut:])
         candidates = self._candidates()
         metrics = []
         for params in candidates:

@@ -23,10 +23,13 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkLinearRegressionModel,
     SparkLogisticRegression,
     SparkLogisticRegressionModel,
+    SparkNormalizer,
     SparkPCA,
     SparkPCAModel,
     SparkStandardScaler,
     SparkStandardScalerModel,
+    SparkTruncatedSVD,
+    SparkTruncatedSVDModel,
 )
 
 __all__ = [
@@ -41,4 +44,7 @@ __all__ = [
     "SparkLogisticRegressionModel",
     "SparkStandardScaler",
     "SparkStandardScalerModel",
+    "SparkTruncatedSVD",
+    "SparkTruncatedSVDModel",
+    "SparkNormalizer",
 ]

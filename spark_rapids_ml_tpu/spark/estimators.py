@@ -33,7 +33,12 @@ from spark_rapids_ml_tpu.models.linear import (
     LogisticRegressionModel,
 )
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
-from spark_rapids_ml_tpu.models.scaler import StandardScaler, StandardScalerModel
+from spark_rapids_ml_tpu.models.scaler import (
+    Normalizer,
+    StandardScaler,
+    StandardScalerModel,
+)
+from spark_rapids_ml_tpu.models.truncated_svd import TruncatedSVD, TruncatedSVDModel
 from spark_rapids_ml_tpu.models.params import Param
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.spark import arrow_fns
@@ -330,10 +335,7 @@ class SparkPCAModel(PCAModel):
 
 
 def _is_spark_df(dataset: Any) -> bool:
-    mod = type(dataset).__module__ or ""
-    return mod.startswith("pyspark.") or mod.startswith(
-        "spark_rapids_ml_tpu.localspark"
-    )
+    return columnar.is_spark_dataframe(dataset)
 
 
 # ---------------------------------------------------------------------------
@@ -1038,4 +1040,90 @@ class SparkStandardScalerModel(StandardScalerModel):
             return super().transform(dataset)
         return _spark_transform(
             self, dataset, self._scale, self.getOutputCol(), scalar=False
+        )
+
+# ---------------------------------------------------------------------------
+# TruncatedSVD / Normalizer
+# ---------------------------------------------------------------------------
+
+
+class SparkTruncatedSVD(TruncatedSVD):
+    """TruncatedSVD over pyspark DataFrames — the LSA/recommender sibling of
+    SparkPCA: one Gram stats pass (solver 'gram'/'randomized'/'auto') or one
+    R-factor pass (solver 'svd', cond(X) accuracy) through mapInArrow, then
+    the replicated decomposition on the driver."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkTruncatedSVDModel(
+                uid=core.uid,
+                components=core.components,
+                singularValues=core.singularValues,
+            )
+            return self._copyValues(model)
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models import truncated_svd as TSVD
+
+        input_col = _resolve_col(self, "inputCol") or "features"
+        selected = dataset.select(input_col)
+        n = _infer_n(dataset, input_col)
+        k = self.getK()
+        if k > n:
+            raise ValueError(f"k={k} must be <= number of features {n}")
+        solver = self.getOrDefault("solver")
+        with trace_range("tsvd reduce"):
+            if solver == "svd":
+                T, _ = _sql_mods(dataset)
+                r_df = selected.mapInArrow(
+                    arrow_fns.QRPartitionFn(input_col),
+                    schema=_spark_arrays_type(T, ["r"]),
+                )
+                if hasattr(r_df, "toArrow"):
+                    r = arrow_fns.r_from_batches(r_df.toArrow().to_batches(), n)
+                else:
+                    r = arrow_fns.r_from_rows(r_df.collect(), n)
+        with trace_range("tsvd decompose"):
+            if solver == "svd":
+                components, sv = L.svd_components_from_r(jnp.asarray(r), k)
+            else:
+                fn = arrow_fns.make_fit_partition_fn(
+                    input_col, precision=self.getOrDefault("precision")
+                )
+                stats = _collect_stats(
+                    selected, fn, ["xtx", "col_sum", "count"],
+                    {"xtx": (n, n), "col_sum": (n,), "count": ()},
+                )
+                components, sv = TSVD._decompose_gram_jit(
+                    jnp.asarray(stats["xtx"]), k, solver
+                )
+        model = SparkTruncatedSVDModel(
+            uid=self.uid,
+            components=np.asarray(components),
+            singularValues=np.asarray(sv[:k]),
+        )
+        return self._copyValues(model)
+
+
+class SparkTruncatedSVDModel(TruncatedSVDModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._project_matrix, self.getOutputCol(),
+            scalar=False,
+        )
+
+
+class SparkNormalizer(Normalizer):
+    """Stateless row p-normalization over pyspark DataFrames: one
+    mapInArrow pass running the same matrix fn as the local path."""
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._normalize_matrix, self.getOutputCol(),
+            scalar=False,
         )

@@ -165,6 +165,15 @@ def _from_arrow_column(col) -> np.ndarray:
     raise TypeError(f"unsupported Arrow column type for ArrayType input: {col.type}")
 
 
+def is_spark_dataframe(obj: Any) -> bool:
+    """True for a pyspark DataFrame or a localspark one — the ONE module-
+    prefix check every layer (estimators, tuning) shares."""
+    mod = type(obj).__module__ or ""
+    return mod.startswith("pyspark.") or mod.startswith(
+        "spark_rapids_ml_tpu.localspark"
+    )
+
+
 def extract_matrix(data: Any, input_col: str | None = None) -> np.ndarray:
     """Extract a row-major [rows, n] float matrix from any supported container.
 

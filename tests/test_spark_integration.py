@@ -467,6 +467,63 @@ class TestSparkGLMIntegration:
         )
 
 
+class TestSparkTruncatedSVDIntegration:
+    @pytest.mark.parametrize("solver", ["gram", "svd", "randomized", "auto"])
+    def test_all_solvers_differential(self, backend, solver):
+        from spark_rapids_ml_tpu import TruncatedSVD
+        from spark_rapids_ml_tpu.spark import SparkTruncatedSVD
+
+        rng = np.random.default_rng(120)
+        x = rng.normal(size=(280, 10))
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=4
+        )
+        model = (
+            SparkTruncatedSVD().setInputCol("features").setK(4)
+            .setSolver(solver).fit(df)
+        )
+        core = TruncatedSVD().setInputCol("features").setK(4).setSolver(solver).fit(x)
+        np.testing.assert_allclose(
+            np.abs(model.components), np.abs(core.components), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            model.singularValues, core.singularValues, atol=1e-5
+        )
+        out = model.transform(df).collect()
+        assert len(out) == 280 and len(out[0]["svd_features"]) == 4
+
+    def test_k_validated_before_job(self, backend):
+        from spark_rapids_ml_tpu.spark import SparkTruncatedSVD
+
+        rng = np.random.default_rng(121)
+        df = backend.df(
+            [(r.tolist(),) for r in rng.normal(size=(10, 3))],
+            backend.features_schema(),
+        )
+        with pytest.raises(ValueError, match="k=7 must be <="):
+            SparkTruncatedSVD().setInputCol("features").setK(7).fit(df)
+
+
+class TestSparkNormalizerIntegration:
+    def test_transform_differential(self, backend):
+        from spark_rapids_ml_tpu import Normalizer
+        from spark_rapids_ml_tpu.spark import SparkNormalizer
+
+        rng = np.random.default_rng(122)
+        x = rng.normal(size=(120, 5)) * 4.0
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=3
+        )
+        for p in (1.0, 2.0, float("inf")):
+            out = (
+                SparkNormalizer().setInputCol("features").setP(p)
+                .transform(df).collect()
+            )
+            got = np.asarray([r["normalized_features"] for r in out])
+            want = Normalizer().setInputCol("features").setP(p).transform(x)
+            np.testing.assert_allclose(got, np.asarray(want), atol=1e-12)
+
+
 class TestSparkKMeansIntegration:
     def test_kmeans_parallel_init_over_jobs(self, backend):
         # VERDICT r2 weak #6: k-means|| as distributed DataFrame passes —

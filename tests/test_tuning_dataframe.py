@@ -1,0 +1,202 @@
+"""Model selection and pipelines over LIVE DataFrames (VERDICT r2 missing
+#4): CrossValidator/TrainValidationSplit split with randomSplit/union (no
+row leaves the cluster for the split), and Pipeline chains Spark-wrapped
+stages end to end.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.localspark import LocalSparkSession
+from spark_rapids_ml_tpu.localspark import types as LT
+from spark_rapids_ml_tpu.models.pipeline import Pipeline
+from spark_rapids_ml_tpu.models.tuning import (
+    BinaryClassificationEvaluator,
+    ClusteringEvaluator,
+    CrossValidator,
+    ParamGridBuilder,
+    RegressionEvaluator,
+    TrainValidationSplit,
+)
+from spark_rapids_ml_tpu.spark import (
+    SparkKMeans,
+    SparkLinearRegression,
+    SparkLogisticRegression,
+    SparkPCA,
+    SparkStandardScaler,
+)
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = LocalSparkSession(
+        parallelism=4,
+        worker_env={
+            "JAX_PLATFORMS": "cpu",
+            "JAX_ENABLE_X64": "1",
+            "JAX_COMPILATION_CACHE_DIR": "/tmp/jax_test_cache",
+        },
+    )
+    yield s
+    s.stop()
+
+
+def _labeled_df(session, x, y, partitions=4):
+    schema = LT.StructType(
+        [
+            LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+            LT.StructField("label", LT.DoubleType()),
+        ]
+    )
+    return session.createDataFrame(
+        [(row.tolist(), float(lbl)) for row, lbl in zip(x, y)],
+        schema,
+        numPartitions=partitions,
+    )
+
+
+def _features_df(session, x, partitions=4):
+    schema = LT.StructType(
+        [LT.StructField("features", LT.ArrayType(LT.DoubleType()))]
+    )
+    return session.createDataFrame(
+        [(row.tolist(),) for row in x], schema, numPartitions=partitions
+    )
+
+
+class TestCrossValidatorOverDataFrames:
+    def test_cv_picks_the_right_reg_param(self, session):
+        rng = np.random.default_rng(30)
+        x = rng.normal(size=(400, 6))
+        coef = np.array([2.0, -1.0, 0.5, 0.0, 1.0, -0.5])
+        y = x @ coef + 0.05 * rng.normal(size=400)
+        df = _labeled_df(session, x, y)
+        grid = ParamGridBuilder().addGrid("regParam", [0.0, 10.0]).build()
+        cv = CrossValidator(
+            estimator=SparkLinearRegression(),
+            estimatorParamMaps=grid,
+            evaluator=RegressionEvaluator(),
+            numFolds=3,
+            seed=1,
+        )
+        fitted = cv.fit(df)
+        # near-noiseless linear data: lambda=0 must beat heavy shrinkage
+        assert fitted.bestIndex == 0
+        assert len(fitted.avgMetrics) == 2
+        assert fitted.avgMetrics[0] < fitted.avgMetrics[1]  # rmse lower better
+        np.testing.assert_allclose(
+            fitted.bestModel.coefficients, coef, atol=0.05
+        )
+        preds = np.asarray(
+            [r["prediction"] for r in fitted.transform(df).collect()]
+        )
+        assert preds.shape == (400,)
+
+    def test_cv_auc_over_dataframes(self, session):
+        rng = np.random.default_rng(31)
+        x = rng.normal(size=(300, 3))
+        p = 1.0 / (1.0 + np.exp(-(x @ np.array([2.0, -1.0, 0.5]))))
+        y = (rng.random(300) < p).astype(float)
+        df = _labeled_df(session, x, y)
+        cv = CrossValidator(
+            estimator=SparkLogisticRegression().setMaxIter(8),
+            estimatorParamMaps=[{"regParam": 1e-3}],
+            evaluator=BinaryClassificationEvaluator(),
+            numFolds=2,
+            seed=2,
+        )
+        fitted = cv.fit(df)
+        assert fitted.avgMetrics[0] > 0.8  # AUC on ranked probabilities
+
+
+class TestTrainValidationSplitOverDataFrames:
+    def test_tvs_selects_and_refits(self, session):
+        rng = np.random.default_rng(32)
+        x = rng.normal(size=(300, 4))
+        y = x @ np.array([1.0, 2.0, -1.0, 0.5]) + 0.02 * rng.normal(size=300)
+        df = _labeled_df(session, x, y)
+        grid = ParamGridBuilder().addGrid("regParam", [0.0, 50.0]).build()
+        tvs = TrainValidationSplit(
+            estimator=SparkLinearRegression(),
+            estimatorParamMaps=grid,
+            evaluator=RegressionEvaluator(),
+            trainRatio=0.7,
+            seed=3,
+        )
+        fitted = tvs.fit(df)
+        assert fitted.bestIndex == 0
+        assert len(fitted.validationMetrics) == 2
+
+    def test_clustering_evaluator_over_dataframe(self, session):
+        rng = np.random.default_rng(33)
+        centers = np.array([[5.0, 5.0], [-5.0, -5.0]])
+        x = np.vstack([rng.normal(size=(60, 2)) * 0.4 + c for c in centers])
+        df = _features_df(session, x)
+        model = SparkKMeans().setInputCol("features").setK(2).setSeed(0).fit(df)
+        out = model.transform(df)
+        score = ClusteringEvaluator().evaluate(out)
+        assert score > 0.8  # well-separated blobs
+
+
+class TestPipelineOverDataFrames:
+    def test_scaler_then_pca_pipeline(self, session):
+        from spark_rapids_ml_tpu import PCA, StandardScaler
+
+        rng = np.random.default_rng(34)
+        x = rng.normal(size=(200, 6)) * np.array([1, 5, 10, 0.5, 2, 1]) + 3.0
+        df = _features_df(session, x)
+        pipe = Pipeline(
+            stages=[
+                SparkStandardScaler()
+                .setInputCol("features")
+                .setOutputCol("scaled"),
+                SparkPCA().setInputCol("scaled").setOutputCol("pca").setK(3),
+            ]
+        )
+        fitted = pipe.fit(df)
+        out = fitted.transform(df).collect()
+        assert len(out) == 200 and len(out[0]["pca"]) == 3
+        # differential vs the core pipeline on the same data
+        core_scaled = (
+            StandardScaler().setInputCol("features").setOutputCol("scaled").fit(x)
+        )
+        xs = np.asarray(core_scaled.transform(x))
+        core_pca = PCA().setInputCol("scaled").setK(3).fit(xs)
+        got = np.asarray([r["pca"] for r in out])
+        want = xs @ core_pca.pc
+        np.testing.assert_allclose(np.abs(got), np.abs(want), atol=1e-6)
+
+    def test_union_round_trips_rows(self, session):
+        rng = np.random.default_rng(35)
+        x = rng.normal(size=(50, 3))
+        df = _features_df(session, x, partitions=2)
+        a, b = df.randomSplit([0.5, 0.5], seed=0)
+        u = a.union(b)
+        assert u.count() == 50
+        got = np.sort(
+            np.asarray([r[0] for r in u.collect()], dtype=np.float64), axis=0
+        )
+        np.testing.assert_allclose(got, np.sort(x, axis=0), atol=1e-12)
+
+    def test_union_is_positional(self, session):
+        # pyspark union semantics: columns map by POSITION, not name
+        a = session.createDataFrame(
+            [(1.0, 10.0)],
+            LT.StructType(
+                [
+                    LT.StructField("x", LT.DoubleType()),
+                    LT.StructField("y", LT.DoubleType()),
+                ]
+            ),
+        )
+        b = session.createDataFrame(
+            [(2.0, 20.0)],
+            LT.StructType(
+                [
+                    LT.StructField("y", LT.DoubleType()),
+                    LT.StructField("x", LT.DoubleType()),
+                ]
+            ),
+        )
+        rows = a.union(b).select("x").collect()
+        assert sorted(r[0] for r in rows) == [1.0, 2.0]
