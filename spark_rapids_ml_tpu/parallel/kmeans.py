@@ -57,3 +57,66 @@ def make_distributed_lloyd(mesh: Mesh):
         ),
         out_shardings=NamedSharding(mesh, P()),
     )
+
+
+def make_distributed_kmeans_fit(
+    mesh: Mesh, *, max_iter: int = 20, tol: float = 1e-4, block_rows: int = 8192
+):
+    """The ENTIRE Lloyd training loop as ONE XLA program over the mesh.
+
+    ``lax.while_loop`` inside ``shard_map``: each iteration accumulates the
+    device-local KMeansStats (weighted; the weight vector masks pad rows),
+    one ``psum`` combines them, and the replicated centroid update advances
+    the carry — zero host round-trips in training. Convergence matches the
+    per-step estimator loop: stop when max squared centroid movement ≤ tol²
+    or after ``max_iter`` iterations. Inputs: X [rows, n] and weights [rows]
+    data-sharded, initial centers [k, n] replicated. Returns replicated
+    (centers, cost, iterations).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from spark_rapids_ml_tpu.parallel.mesh import shard_map
+
+    tol_sq = tol * tol
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        out_specs=(P(), P(), P()),
+        check_rep=False,
+    )
+    def run(x, w, centers0):
+        def cond(carry):
+            _, _, it, shift = carry
+            return (it < max_iter) & (shift > tol_sq)
+
+        def body(carry):
+            centers, _, it, _ = carry
+            stats = KM.kmeans_stats(
+                x, centers, w, block_rows=min(block_rows, x.shape[0])
+            )
+            stats = jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), stats)
+            new_centers = KM.update_centers(stats, centers)
+            shift = KM.center_shift_sq(centers, new_centers)
+            return new_centers, stats.cost, it + 1, shift
+
+        init = (
+            centers0,
+            jnp.asarray(jnp.inf, x.dtype),
+            jnp.int32(0),
+            jnp.asarray(jnp.inf, x.dtype),
+        )
+        centers, cost, it, _ = lax.while_loop(cond, body, init)
+        return centers, cost, it
+
+    return jax.jit(
+        run,
+        in_shardings=(
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P()),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
+    )

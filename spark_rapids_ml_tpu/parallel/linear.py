@@ -105,3 +105,67 @@ def make_distributed_newton_step(
         ),
         out_shardings=NamedSharding(mesh, P()),
     )
+
+
+def make_distributed_logreg_fit(
+    mesh: Mesh,
+    *,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 25,
+    tol: float = 1e-6,
+):
+    """The ENTIRE binary IRLS training loop as ONE XLA program over the mesh.
+
+    ``lax.while_loop`` runs inside ``shard_map``: each iteration computes the
+    local NewtonStats on the device's row shard, one ``psum`` combines them,
+    and the replicated [d, d] solve updates the carried parameter — no host
+    round-trip anywhere in training (the per-step variant above exists for
+    hosts that need to checkpoint between iterations). Inputs: ``x_aug``
+    [rows, d] data-sharded WITH the intercept column already appended when
+    ``fit_intercept``; ``y`` and the pad/instance-weight vector ``w`` sharded
+    alike. Returns replicated (w_full [d], iterations, final step-norm).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from spark_rapids_ml_tpu.parallel.mesh import shard_map
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_rep=False,
+    )
+    def run(x_aug, y, w_vec):
+        d = x_aug.shape[1]
+
+        def cond(carry):
+            _, it, step = carry
+            return (it < max_iter) & (step > tol)
+
+        def body(carry):
+            w_full, it, _ = carry
+            stats = LIN.logistic_newton_stats(x_aug, y, w_full, w_vec)
+            stats = jax.tree.map(
+                lambda v: lax.psum(v, DATA_AXIS), stats
+            )
+            new_w, step = LIN.newton_update(
+                w_full, stats, reg_param=reg_param, fit_intercept=fit_intercept
+            )
+            return new_w, it + 1, step
+
+        w0 = jnp.zeros((d,), x_aug.dtype)
+        init = (w0, jnp.int32(0), jnp.asarray(jnp.inf, x_aug.dtype))
+        return lax.while_loop(cond, body, init)
+
+    return jax.jit(
+        run,
+        in_shardings=(
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
+    )

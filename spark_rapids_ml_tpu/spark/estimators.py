@@ -517,10 +517,17 @@ class SparkLinearRegressionModel(LinearRegressionModel):
         )
 
 
-class SparkLogisticRegression(LogisticRegression):
-    """Distributed IRLS over pyspark DataFrames: one Spark job per Newton
+class SparkLogisticRegression(_HasDistribution, LogisticRegression):
+    """Distributed IRLS over pyspark DataFrames.
+
+    ``distribution='driver-merge'`` (default): one Spark job per Newton
     iteration (current parameters broadcast in the task closure), replicated
-    [d, d] solve on the driver between jobs."""
+    [d, d] solve on the driver between jobs — required for
+    ``checkpoint_dir`` and for multinomial fits.
+    ``distribution='mesh-barrier'``: the ENTIRE binary IRLS loop runs as one
+    XLA program (lax.while_loop with the psum inside the body) across the
+    barrier stage's jax.distributed mesh — zero driver round-trips during
+    training (spark/spmd.py MeshLogRegFitFn)."""
 
     def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
         if not _is_spark_df(dataset):
@@ -546,6 +553,15 @@ class SparkLogisticRegression(LogisticRegression):
         cols = [feats, label] + ([weight_col] if weight_col else [])
         selected = dataset.select(*cols)
         fit_intercept = self.getFitIntercept()
+        distribution = self.getOrDefault("distribution")
+        if distribution == "mesh-barrier" and checkpoint_dir is not None:
+            # params-only rejection: fail BEFORE any cluster job runs
+            raise ValueError(
+                "checkpoint_dir requires distribution='driver-merge': "
+                "the mesh-barrier fit runs the whole training loop as "
+                "one XLA program with no per-iteration driver hop to "
+                "checkpoint from"
+            )
         n = _infer_n(dataset, feats)
         # class-count detection: one cheap distinct-label pass over the
         # label column (the DataFrame analog of the core path's np.unique,
@@ -568,6 +584,16 @@ class SparkLogisticRegression(LogisticRegression):
                 f"{_MAX_CLASSES} — the full-Newton Hessian is [C·d, C·d]. "
                 "Check for mislabeled/ID-like rows, or re-encode labels "
                 "densely as 0..C-1"
+            )
+        if distribution == "mesh-barrier":
+            if n_classes > 2:
+                raise ValueError(
+                    "distribution='mesh-barrier' supports binary labels "
+                    f"only (got {n_classes} classes); multinomial fits use "
+                    "'driver-merge'"
+                )
+            return self._fit_binary_mesh_barrier(
+                selected, feats, label, weight_col, n, fit_intercept
             )
         if n_classes > 2:
             return self._fit_multinomial_df(
@@ -603,6 +629,37 @@ class SparkLogisticRegression(LogisticRegression):
                     ckpt.save(it, {"w": w_full}, {"loss": float(stats.loss)})
                 if float(step_norm) <= self.getTol():
                     break
+        return self._binary_model(w_full, fit_intercept)
+
+    def _fit_binary_mesh_barrier(
+        self, selected, feats, label, weight_col, n, fit_intercept
+    ) -> "SparkLogisticRegressionModel":
+        """One barrier stage = the whole binary Newton fit (spark/spmd.py)."""
+        from spark_rapids_ml_tpu.spark import spmd
+
+        d = n + 1 if fit_intercept else n
+        with trace_range("logreg mesh fit"):
+            arrays = _barrier_single_row(
+                selected,
+                spmd.MeshLogRegFitFn(
+                    feats, label, weight_col,
+                    reg_param=self.getRegParam(),
+                    fit_intercept=fit_intercept,
+                    max_iter=self.getMaxIter(),
+                    tol=self.getTol(),
+                ),
+                spmd.LOGREG_FIT_FIELDS,
+                {"w": (d,), "iterations": (), "count": (), "mesh_size": ()},
+            )
+        if weight_col and float(arrays["count"]) == 0.0:
+            raise ValueError("all instance weights are zero")
+        return self._binary_model(arrays["w"], fit_intercept)
+
+    def _binary_model(
+        self, w_full: np.ndarray, fit_intercept: bool
+    ) -> "SparkLogisticRegressionModel":
+        """The one place the fitted [d] parameter becomes a model — both
+        distribution modes return identically-shaped results."""
         if fit_intercept:
             coef, intercept = w_full[:-1], float(w_full[-1])
         else:
@@ -699,9 +756,14 @@ class SparkLogisticRegressionModel(LogisticRegressionModel):
 # ---------------------------------------------------------------------------
 
 
-class SparkKMeans(KMeans):
-    """Lloyd over pyspark DataFrames: seed from a driver-side sample, then
-    one mapInArrow stats job per iteration (centers broadcast per job)."""
+class SparkKMeans(_HasDistribution, KMeans):
+    """Lloyd over pyspark DataFrames: seeding runs driver-coordinated
+    (bounded sample or k-means|| passes), then training either as one
+    mapInArrow stats job per iteration with centers broadcast per job
+    (``distribution='driver-merge'``, required for ``checkpoint_dir``) or
+    as ONE barrier stage whose while_loop+psum program runs the entire
+    Lloyd loop on the executor mesh (``'mesh-barrier'``, zero driver
+    round-trips during training — spark/spmd.py MeshKMeansFitFn)."""
 
     _INIT_SAMPLE = 4096
 
@@ -728,6 +790,15 @@ class SparkKMeans(KMeans):
         selected = dataset.select(*cols)
         k = self.getK()
 
+        if (
+            self.getOrDefault("distribution") == "mesh-barrier"
+            and checkpoint_dir is not None
+        ):
+            raise ValueError(
+                "checkpoint_dir requires distribution='driver-merge': the "
+                "mesh-barrier fit runs the whole Lloyd loop as one XLA "
+                "program with no per-iteration driver hop to checkpoint from"
+            )
         # resume BEFORE seeding: an interrupted Spark-path fit pointed at the
         # same checkpoint_dir continues mid-Lloyd (the SAME resume contract
         # and layout as the core path — shared helper)
@@ -832,6 +903,28 @@ class SparkKMeans(KMeans):
         from spark_rapids_ml_tpu.ops import kmeans as KM
 
         k = self.getK()
+        if self.getOrDefault("distribution") == "mesh-barrier":
+            from spark_rapids_ml_tpu.spark import spmd
+
+            with trace_range("kmeans mesh fit"):
+                arrays = _barrier_single_row(
+                    selected,
+                    spmd.MeshKMeansFitFn(
+                        input_col, centers, weight_col,
+                        max_iter=self.getMaxIter(), tol=self.getTol(),
+                    ),
+                    spmd.KMEANS_FIT_FIELDS,
+                    {"centers": (k, centers.shape[1]), "cost": (),
+                     "iterations": (), "count": (), "mesh_size": ()},
+                )
+            if weight_col and float(arrays["count"]) == 0.0:
+                raise ValueError("all instance weights are zero")
+            model = SparkKMeansModel(
+                uid=self.uid,
+                clusterCenters=arrays["centers"],
+                trainingCost=float(arrays["cost"]),
+            )
+            return self._copyValues(model)
         tol_sq = self.getTol() ** 2
         n = centers.shape[1]
         shapes = {"sums": (k, n), "counts": (k,), "cost": ()}

@@ -122,9 +122,36 @@ class _MeshReducePartitionFn:
         self.weight_col = weight_col
         self.precision = precision
 
-    # -- subclass hook -------------------------------------------------------
+    # -- subclass hooks ------------------------------------------------------
+    def _prepare_matrix(self, mat: np.ndarray) -> np.ndarray:
+        """Worker-side feature-matrix preprocessing before the rendezvous
+        (e.g. appending the intercept column) — identity by default."""
+        return mat
+
     def _shard_kernel(self):
         raise NotImplementedError
+
+    def _run_on_mesh(self, mesh, gx, gw, gy) -> dict[str, np.ndarray]:
+        """Execute the SPMD program on the bootstrapped global mesh and
+        return host arrays. Default: one psum of ``_shard_kernel``'s monoid;
+        full-fit subclasses override with an entire training loop."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from spark_rapids_ml_tpu.parallel import backend as B
+        from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+        operands = [gx]
+        specs = [P(DATA_AXIS, None)]
+        if self.USES_VECTORS:
+            operands += [gw, gy]
+            specs += [P(DATA_AXIS), P(DATA_AXIS)]
+        stats = B.mapreduce_data_axis(
+            self._shard_kernel(), mesh, in_specs=tuple(specs)
+        )(*operands)
+        return {
+            name: np.asarray(jax.device_get(v)) for name, v in stats.items()
+        }
 
     # -- the mapInArrow body --------------------------------------------------
     def __call__(
@@ -138,7 +165,7 @@ class _MeshReducePartitionFn:
         for b in batches:
             if not b.num_rows:
                 continue
-            mat = columnar.extract_matrix(b, self.input_col)
+            mat = self._prepare_matrix(columnar.extract_matrix(b, self.input_col))
             mats.append(mat)
             if self.label_col:
                 ys.append(
@@ -180,7 +207,9 @@ class _MeshReducePartitionFn:
         n = max(g["n"] for g in by_rank)
         total_rows = sum(g["rows"] for g in by_rank)
         max_rows = max(g["rows"] for g in by_rank)
-        if local.shape[1] == 0:  # empty partition: keep the shard shape legal
+        if local.shape[0] == 0 and local.shape[1] != n:
+            # empty partition: adopt the group's column count so the padded
+            # shard shape stays legal
             local = np.zeros((0, n), dtype=np.float64)
 
         # This must be the interpreter's first JAX backend touch (module
@@ -191,10 +220,8 @@ class _MeshReducePartitionFn:
             coordinator_address=coord, num_processes=size, process_id=rank
         )
         try:
-            import jax.numpy as jnp
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from spark_rapids_ml_tpu.parallel import backend as B
             from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, create_mesh
 
             ldc = len(jax.local_devices())
@@ -214,31 +241,19 @@ class _MeshReducePartitionFn:
             gx = jax.make_array_from_process_local_data(
                 x_sharding, padded, (size * shard_rows, n)
             )
-            operands = [gx]
-            specs = [P(DATA_AXIS, None)]
+            gw = gy = None
             if self.USES_VECTORS:
                 v_sharding = NamedSharding(mesh, P(DATA_AXIS))
                 w_pad = _pad_to(w_local, shard_rows)  # pad rows get weight 0
-                y_pad = _pad_to(y_local, shard_rows)
-                operands.append(
-                    jax.make_array_from_process_local_data(
-                        v_sharding, w_pad, (size * shard_rows,)
-                    )
+                gw = jax.make_array_from_process_local_data(
+                    v_sharding, w_pad, (size * shard_rows,)
                 )
-                operands.append(
-                    jax.make_array_from_process_local_data(
+                if self.label_col:  # no dead transfer for label-free fits
+                    y_pad = _pad_to(y_local, shard_rows)
+                    gy = jax.make_array_from_process_local_data(
                         v_sharding, y_pad, (size * shard_rows,)
                     )
-                )
-                specs += [P(DATA_AXIS), P(DATA_AXIS)]
-            kernel = self._shard_kernel()
-            stats = B.mapreduce_data_axis(
-                kernel, mesh, in_specs=tuple(specs)
-            )(*operands)
-            host = {
-                name: np.asarray(jax.device_get(v))
-                for name, v in stats.items()
-            }
+            host = self._run_on_mesh(mesh, gx, gw, gy)
         finally:
             try:
                 jax.distributed.shutdown()
@@ -308,6 +323,111 @@ class MeshMomentsPartitionFn(_MeshReducePartitionFn):
             }
 
         return kernel
+
+
+LOGREG_FIT_FIELDS = ["w", "iterations", "count", "mesh_size"]
+KMEANS_FIT_FIELDS = ["centers", "cost", "iterations", "count", "mesh_size"]
+
+
+class MeshLogRegFitFn(_MeshReducePartitionFn):
+    """The ENTIRE binary IRLS fit in one barrier stage: a ``lax.while_loop``
+    of Newton iterations with the psum INSIDE the loop body
+    (parallel/linear.py make_distributed_logreg_fit) — zero driver
+    round-trips during training, vs one Spark job per iteration on the
+    driver-merge path. The driver receives the final [d] parameter."""
+
+    FIELDS = LOGREG_FIT_FIELDS
+    USES_VECTORS = True
+    COUNT_FROM_KERNEL = True
+
+    def __init__(
+        self,
+        features_col: str,
+        label_col: str,
+        weight_col: str | None,
+        *,
+        reg_param: float,
+        fit_intercept: bool,
+        max_iter: int,
+        tol: float,
+    ):
+        super().__init__(features_col, label_col, weight_col)
+        self.reg_param = float(reg_param)
+        self.fit_intercept = bool(fit_intercept)
+        self.max_iter = int(max_iter)
+        self.tol = float(tol)
+
+    def _prepare_matrix(self, mat: np.ndarray) -> np.ndarray:
+        if self.fit_intercept:
+            return np.concatenate(
+                [mat, np.ones((mat.shape[0], 1), mat.dtype)], axis=1
+            )
+        return mat
+
+    def _run_on_mesh(self, mesh, gx, gw, gy):
+        import jax
+
+        from spark_rapids_ml_tpu.parallel import linear as PL
+
+        import jax.numpy as jnp
+
+        fit = PL.make_distributed_logreg_fit(
+            mesh,
+            reg_param=self.reg_param,
+            fit_intercept=self.fit_intercept,
+            max_iter=self.max_iter,
+            tol=self.tol,
+        )
+        w, iters, _ = fit(gx, gy, gw)  # (x_aug, labels, weights)
+        return {
+            "w": np.asarray(jax.device_get(w)),
+            "iterations": np.float64(int(iters)),
+            # weighted count (pad rows weigh 0): the driver enforces the
+            # same all-zero-weights contract as the driver-merge path
+            "count": np.float64(float(jnp.sum(gw))),
+        }
+
+
+class MeshKMeansFitFn(_MeshReducePartitionFn):
+    """The ENTIRE Lloyd fit in one barrier stage (parallel/kmeans.py
+    make_distributed_kmeans_fit): initial centers ride the task state, the
+    while_loop + psum trains on the mesh, the driver receives final centers
+    + cost. Weights mask pad rows and carry instance weights."""
+
+    FIELDS = KMEANS_FIT_FIELDS
+    USES_VECTORS = True
+    COUNT_FROM_KERNEL = True
+
+    def __init__(
+        self,
+        input_col: str,
+        centers: np.ndarray,
+        weight_col: str | None,
+        *,
+        max_iter: int,
+        tol: float,
+    ):
+        super().__init__(input_col, None, weight_col)
+        self.centers = np.asarray(centers)
+        self.max_iter = int(max_iter)
+        self.tol = float(tol)
+
+    def _run_on_mesh(self, mesh, gx, gw, gy):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.parallel import kmeans as PK
+
+        fit = PK.make_distributed_kmeans_fit(
+            mesh, max_iter=self.max_iter, tol=self.tol
+        )
+        centers, cost, iters = fit(gx, gw, jnp.asarray(self.centers))
+        return {
+            "centers": np.asarray(jax.device_get(centers)),
+            "cost": np.float64(float(cost)),
+            "iterations": np.float64(int(iters)),
+            "count": np.float64(float(jnp.sum(gw))),  # weighted (see logreg)
+        }
 
 
 def single_row_from_batches(

@@ -121,3 +121,82 @@ class TestMeshHelpers:
 
         x = np.arange(16.0)
         assert float(total(x)) == x.sum()
+
+
+class TestFullLoopFits:
+    """The entire iterative fit as ONE XLA program (while_loop + psum inside
+    shard_map) — must match the per-step driver loop exactly."""
+
+    def test_logreg_full_loop_matches_core(self):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models.linear import LogisticRegression
+        from spark_rapids_ml_tpu.ops import linear as LIN
+        from spark_rapids_ml_tpu.parallel import linear as PL
+        from spark_rapids_ml_tpu.parallel import mesh as M
+
+        rng = np.random.default_rng(50)
+        rows, n = 512, 6
+        x = rng.normal(size=(rows, n))
+        p = 1.0 / (1.0 + np.exp(-(x @ rng.normal(size=n) - 0.2)))
+        y = (rng.random(rows) < p).astype(np.float64)
+
+        mesh = M.create_mesh(data=8, feat=1)
+        xa = np.concatenate([x, np.ones((rows, 1))], axis=1)
+        fit = PL.make_distributed_logreg_fit(
+            mesh, reg_param=1e-3, max_iter=15, tol=1e-9
+        )
+        w, iters, step = fit(
+            jax.device_put(jnp.asarray(xa), M.data_sharding(mesh)),
+            jax.device_put(jnp.asarray(y), jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(M.DATA_AXIS))),
+            jax.device_put(jnp.ones(rows), jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(M.DATA_AXIS))),
+        )
+        core = (
+            LogisticRegression().setRegParam(1e-3).setMaxIter(15).setTol(1e-9)
+            .fit((x, y))
+        )
+        np.testing.assert_allclose(
+            np.asarray(w)[:-1], core.coefficients, atol=1e-8
+        )
+        np.testing.assert_allclose(float(np.asarray(w)[-1]), core.intercept, atol=1e-8)
+        assert int(iters) >= 2
+
+    def test_kmeans_full_loop_matches_core(self):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+        from spark_rapids_ml_tpu.parallel import kmeans as PK
+        from spark_rapids_ml_tpu.parallel import mesh as M
+
+        rng = np.random.default_rng(51)
+        centers_true = rng.normal(size=(5, 4)) * 6.0
+        x = np.concatenate(
+            [rng.normal(size=(64, 4)) * 0.4 + c for c in centers_true]
+        )
+        rng.shuffle(x)
+        init = x[:5].copy()
+
+        mesh = M.create_mesh(data=8, feat=1)
+        fit = PK.make_distributed_kmeans_fit(mesh, max_iter=12, tol=1e-6)
+        centers, cost, iters = fit(
+            jax.device_put(jnp.asarray(x), M.data_sharding(mesh)),
+            jax.device_put(jnp.ones(len(x)), jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(M.DATA_AXIS))),
+            jnp.asarray(init),
+        )
+        # core loop from the same init: monkey-route by calling the ops loop
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        c = jnp.asarray(init)
+        cost_ref = None
+        for _ in range(12):
+            stats = KM.kmeans_stats(jnp.asarray(x), c)
+            new_c = KM.update_centers(stats, c)
+            cost_ref = float(stats.cost)
+            shift = float(KM.center_shift_sq(c, new_c))
+            c = new_c
+            if shift <= 1e-12:
+                break
+        np.testing.assert_allclose(np.asarray(centers), np.asarray(c), atol=1e-8)
+        np.testing.assert_allclose(float(cost), cost_ref, rtol=1e-10)

@@ -235,3 +235,151 @@ class TestMeshBarrierBeyondPCA:
             SparkLinearRegression().setDistribution("mesh-local")
         with pytest.raises(ValueError, match="distribution"):
             SparkStandardScaler().setDistribution("gossip")
+
+
+class TestFullLoopBarrierFits:
+    """The r3 capstone: ENTIRE iterative fits as one XLA program across the
+    barrier stage's process mesh — the driver sees only the final model."""
+
+    def test_logreg_full_fit_differential(self, session, rng):
+        from spark_rapids_ml_tpu.spark import SparkLogisticRegression
+
+        x = rng.normal(size=(480, 4))
+        p = 1.0 / (1.0 + np.exp(-(x @ np.array([2.0, -1.0, 0.5, 0.0]) - 0.3)))
+        y = (rng.random(480) < p).astype(float)
+        schema = LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        )
+        df = session.createDataFrame(
+            [(row.tolist(), float(lbl)) for row, lbl in zip(x, y)],
+            schema,
+            numPartitions=4,
+        )
+        base = SparkLogisticRegression().setRegParam(1e-3).setMaxIter(12)
+        mesh = base.copy().setDistribution("mesh-barrier").fit(df)
+        merge = base.copy().setDistribution("driver-merge").fit(df)
+        np.testing.assert_allclose(
+            mesh.coefficients, merge.coefficients, atol=1e-8
+        )
+        np.testing.assert_allclose(mesh.intercept, merge.intercept, atol=1e-8)
+
+    def test_kmeans_full_fit_differential(self, session, rng):
+        from spark_rapids_ml_tpu.spark import SparkKMeans
+
+        centers_true = rng.normal(size=(5, 3)) * 7.0
+        x = np.concatenate(
+            [rng.normal(size=(60, 3)) * 0.4 + c for c in centers_true]
+        )
+        rng.shuffle(x)
+        df = _features_df(session, x, partitions=4)
+        base = (
+            SparkKMeans().setInputCol("features").setK(5).setSeed(3)
+            .setMaxIter(10).setTol(0.0)
+        )
+        mesh = base.copy().setDistribution("mesh-barrier").fit(df)
+        merge = base.copy().setDistribution("driver-merge").fit(df)
+        # same driver-side seeding, same Lloyd math -> identical trajectory
+        np.testing.assert_allclose(
+            mesh.clusterCenters, merge.clusterCenters, atol=1e-8
+        )
+        np.testing.assert_allclose(
+            mesh.trainingCost, merge.trainingCost, rtol=1e-8
+        )
+
+    def test_multinomial_rejected_on_mesh_barrier(self, session, rng):
+        from spark_rapids_ml_tpu.spark import SparkLogisticRegression
+
+        x = rng.normal(size=(60, 3))
+        y = rng.integers(0, 3, size=60).astype(float)
+        schema = LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        )
+        df = session.createDataFrame(
+            [(row.tolist(), float(lbl)) for row, lbl in zip(x, y)], schema
+        )
+        est = SparkLogisticRegression().setDistribution("mesh-barrier")
+        with pytest.raises(ValueError, match="binary labels"):
+            est.fit(df)
+
+    def test_checkpoint_rejected_on_mesh_barrier(self, session, rng, tmp_path):
+        from spark_rapids_ml_tpu.spark import SparkKMeans, SparkLogisticRegression
+
+        x = rng.normal(size=(40, 3))
+        df = _features_df(session, x)
+        with pytest.raises(ValueError, match="driver-merge"):
+            SparkKMeans().setInputCol("features").setK(2).setDistribution(
+                "mesh-barrier"
+            ).fit(df, checkpoint_dir=str(tmp_path / "ck"))
+        schema = LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        )
+        ldf = session.createDataFrame(
+            [(r.tolist(), float(i % 2)) for i, r in enumerate(x)], schema
+        )
+        with pytest.raises(ValueError, match="driver-merge"):
+            SparkLogisticRegression().setDistribution("mesh-barrier").fit(
+                ldf, checkpoint_dir=str(tmp_path / "ck2")
+            )
+
+    def test_all_zero_weights_rejected_on_mesh_barrier(self, session, rng):
+        from spark_rapids_ml_tpu.spark import SparkLogisticRegression
+
+        x = rng.normal(size=(40, 3))
+        y = (rng.random(40) < 0.5).astype(float)
+        schema = LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+                LT.StructField("wt", LT.DoubleType()),
+            ]
+        )
+        df = session.createDataFrame(
+            [(r.tolist(), float(l), 0.0) for r, l in zip(x, y)], schema
+        )
+        est = (
+            SparkLogisticRegression().setWeightCol("wt")
+            .setDistribution("mesh-barrier").setMaxIter(3)
+        )
+        with pytest.raises(ValueError, match="all instance weights are zero"):
+            est.fit(df)
+
+    def test_weighted_logreg_mesh_barrier_differential(self, session, rng):
+        from spark_rapids_ml_tpu.spark import SparkLogisticRegression
+
+        x = rng.normal(size=(300, 3))
+        p = 1.0 / (1.0 + np.exp(-(x @ np.array([1.5, -1.0, 0.5]))))
+        y = (rng.random(300) < p).astype(float)
+        w = rng.uniform(0.1, 2.0, size=300)
+        schema = LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+                LT.StructField("wt", LT.DoubleType()),
+            ]
+        )
+        df = session.createDataFrame(
+            [
+                (r.tolist(), float(l), float(wi))
+                for r, l, wi in zip(x, y, w)
+            ],
+            schema,
+            numPartitions=4,
+        )
+        base = (
+            SparkLogisticRegression().setWeightCol("wt")
+            .setRegParam(1e-3).setMaxIter(10)
+        )
+        mesh = base.copy().setDistribution("mesh-barrier").fit(df)
+        merge = base.copy().setDistribution("driver-merge").fit(df)
+        np.testing.assert_allclose(
+            mesh.coefficients, merge.coefficients, atol=1e-8
+        )
