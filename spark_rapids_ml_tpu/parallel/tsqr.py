@@ -136,3 +136,45 @@ def make_distributed_fit_svd(mesh: Mesh, k: int, *, mean_centering: bool = False
         in_shardings=NamedSharding(mesh, P(DATA_AXIS, None)),
         out_shardings=NamedSharding(mesh, P()),
     )
+
+
+def make_distributed_fit_svd_masked(
+    mesh: Mesh, k: int, *, mean_centering: bool = False
+):
+    """Pad-mask-aware TSQR fit for PADDED shards (the barrier path, where
+    every process zero-pads to a common shard shape).
+
+    Zero pad rows are already exact for the uncentered QR (R of [X; 0] = R
+    of X), but centering would turn them into -mean rows and corrupt R — so
+    the global mean uses the TRUE row count (psum of the mask) and the
+    centered matrix is re-masked: (x − μ)·mask. ``w`` is the 1/0 pad mask,
+    data-sharded like x.
+    """
+    import jax.numpy as jnp
+
+    n_data = mesh.shape[DATA_AXIS]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def run(xl, wl):
+        if mean_centering:
+            col_sum = lax.psum(jnp.sum(xl, axis=0), DATA_AXIS)  # pads are 0
+            count = lax.psum(jnp.sum(wl), DATA_AXIS)
+            mean = col_sum / jnp.maximum(count, 1.0)
+            xl = (xl - mean[None, :]) * wl[:, None]
+        r = merge_r(L.qr_r(xl), n_data)
+        return L.svd_from_r(r, k)
+
+    return jax.jit(
+        run,
+        in_shardings=(
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
+    )

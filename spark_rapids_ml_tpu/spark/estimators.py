@@ -202,13 +202,17 @@ class SparkPCA(_HasDistribution, PCA):
     def _fit_svd(
         self, selected, input_col: str, n: int, k: int, distribution: str
     ) -> "SparkPCAModel":
-        """The solver='svd' DataFrame fit: per-partition ``qr_r`` rows →
-        driver ``combine_r`` tree → ``svd_from_r`` (driver-merge), or the
-        butterfly-TSQR mesh program (mesh-local). R factors ride the SAME
-        one-row Arrow stats machinery as the Gram path; only the driver
-        reduction differs (QR-of-stacked-pair tree, not an elementwise sum).
-        meanCentering costs one extra cheap moments pass for the global
-        mean, applied worker-side before padding so pad rows stay zero."""
+        """The solver='svd' DataFrame fit, per distribution: driver-merge
+        ships per-partition ``qr_r`` rows through the one-row Arrow stats
+        machinery and tree-merges them with ``combine_r`` (QR-of-stacked-
+        pair, not an elementwise sum); mesh-local runs the butterfly-TSQR
+        program over the driver's own device mesh; mesh-barrier runs it
+        across the barrier stage's jax.distributed process mesh, so the
+        driver receives only the finished (pc, ev). meanCentering on the
+        driver-merge path costs one extra cheap moments pass for the global
+        mean, applied worker-side before padding so pad rows stay zero;
+        mesh-local centers on the driver pre-padding, and mesh-barrier
+        centers in-program with the pad mask."""
         import jax.numpy as jnp
 
         mean_centering = self.getMeanCentering()
@@ -238,14 +242,21 @@ class SparkPCA(_HasDistribution, PCA):
             pc, ev = fit_svd(
                 jax.device_put(jnp.asarray(padded), M.data_sharding(mesh))
             )
-        else:
-            if distribution == "mesh-barrier":
-                raise ValueError(
-                    "solver='svd' is not available with "
-                    "distribution='mesh-barrier' yet; use 'driver-merge' "
-                    "(R factors tree-merge on the driver) or 'mesh-local' "
-                    "(butterfly TSQR over the driver's device mesh)"
+        elif distribution == "mesh-barrier":
+            # butterfly TSQR across the barrier stage's process mesh: the
+            # driver receives only the finished (pc, ev)
+            from spark_rapids_ml_tpu.spark import spmd
+
+            with trace_range("svd mesh fit"):
+                arrays = _barrier_single_row(
+                    selected,
+                    spmd.MeshSVDFitFn(input_col, k, mean_centering),
+                    spmd.SVD_FIT_FIELDS,
+                    {"pc": (n, k), "explainedVariance": (k,), "count": (),
+                     "mesh_size": ()},
                 )
+            pc, ev = arrays["pc"], arrays["explainedVariance"]
+        else:
             T, _ = _sql_mods(selected)
             mean = None
             if mean_centering:

@@ -326,6 +326,7 @@ class MeshMomentsPartitionFn(_MeshReducePartitionFn):
 
 
 LOGREG_FIT_FIELDS = ["w", "iterations", "count", "mesh_size"]
+SVD_FIT_FIELDS = ["pc", "explainedVariance", "count", "mesh_size"]
 KMEANS_FIT_FIELDS = ["centers", "cost", "iterations", "count", "mesh_size"]
 
 
@@ -385,6 +386,42 @@ class MeshLogRegFitFn(_MeshReducePartitionFn):
             # weighted count (pad rows weigh 0): the driver enforces the
             # same all-zero-weights contract as the driver-merge path
             "count": np.float64(float(jnp.sum(gw))),
+        }
+
+
+class MeshSVDFitFn(_MeshReducePartitionFn):
+    """The direct TSQR→SVD(R) PCA fit in one barrier stage: per-device QR,
+    butterfly R merge over the process mesh, replicated SVD of R — the
+    cond(X)-accurate solver running entirely on the mesh (parallel/tsqr.py
+    make_distributed_fit_svd_masked). The pad mask rides the weight vector
+    so mean-centering stays exact under the common padded shard shape."""
+
+    FIELDS = SVD_FIT_FIELDS
+
+    def __init__(self, input_col: str, k: int, mean_centering: bool):
+        super().__init__(input_col)
+        self.k = int(k)
+        self.mean_centering = bool(mean_centering)
+        # the 1/0 pad mask (PCA has no instance weights) is only consumed
+        # by the centered program — skip building/transferring it otherwise
+        self.USES_VECTORS = self.mean_centering
+
+    def _run_on_mesh(self, mesh, gx, gw, gy):
+        import jax
+
+        from spark_rapids_ml_tpu.parallel import tsqr as TSQR
+
+        if self.mean_centering:
+            fit = TSQR.make_distributed_fit_svd_masked(
+                mesh, self.k, mean_centering=True
+            )
+            pc, ev = fit(gx, gw)
+        else:  # zero pad rows are already exact for the uncentered QR
+            fit = TSQR.make_distributed_fit_svd(mesh, self.k)
+            pc, ev = fit(gx)
+        return {
+            "pc": np.asarray(jax.device_get(pc)),
+            "explainedVariance": np.asarray(jax.device_get(ev)),
         }
 
 

@@ -292,16 +292,26 @@ class TestSparkPCAIntegration:
         )
         assert back.getK() == 2
 
-    def test_svd_solver_mesh_barrier_rejected(self, backend):
+    @pytest.mark.parametrize("centering", [False, True])
+    def test_svd_solver_mesh_barrier_differential(self, backend, centering):
+        # r3: the TSQR solver runs ACROSS the barrier mesh too — per-device
+        # QR, butterfly R merge over the process group, replicated SVD(R);
+        # centering happens in-program with the pad mask
         rng_m = np.random.default_rng(104)
-        x = rng_m.normal(size=(20, 4))
-        df = backend.df([(row.tolist(),) for row in x], backend.features_schema())
-        est = (
-            SparkPCA().setInputCol("features").setK(2).setSolver("svd")
-            .setDistribution("mesh-barrier")
+        x = rng_m.normal(size=(260, 8)) + 4.0
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=4
         )
-        with pytest.raises(ValueError, match="mesh-barrier"):
-            est.fit(df)
+        base = (
+            SparkPCA().setInputCol("features").setK(3).setSolver("svd")
+            .setMeanCentering(centering)
+        )
+        mesh = base.copy().setDistribution("mesh-barrier").fit(df)
+        merge = base.copy().setDistribution("driver-merge").fit(df)
+        np.testing.assert_allclose(np.abs(mesh.pc), np.abs(merge.pc), atol=1e-8)
+        np.testing.assert_allclose(
+            mesh.explainedVariance, merge.explainedVariance, atol=1e-8
+        )
 
 
 class TestSparkGLMIntegration:
