@@ -169,3 +169,66 @@ def make_distributed_logreg_fit(
         ),
         out_shardings=NamedSharding(mesh, P()),
     )
+
+
+def make_distributed_softmax_fit(
+    mesh: Mesh,
+    n_classes: int,
+    *,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 25,
+    tol: float = 1e-6,
+):
+    """The ENTIRE multinomial (softmax) IRLS loop as ONE XLA program — the
+    C-class sibling of ``make_distributed_logreg_fit``: each iteration
+    psums the SoftmaxStats monoid (full [C·d, C·d] Fisher Hessian as
+    C(C+1)/2 MXU block matmuls per shard) and solves replicated. ``y``
+    arrives as the float label vector (sharded like x) and is cast to class
+    indices in-program. Returns replicated (w_flat [C·d], iterations,
+    final step-norm)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from spark_rapids_ml_tpu.parallel.mesh import shard_map
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_rep=False,
+    )
+    def run(x_aug, y, w_vec):
+        d = x_aug.shape[1]
+        y_idx = y.astype(jnp.int32)
+
+        def cond(carry):
+            _, it, step = carry
+            return (it < max_iter) & (step > tol)
+
+        def body(carry):
+            w_flat, it, _ = carry
+            stats = LIN.softmax_newton_stats(
+                x_aug, y_idx, w_flat, n_classes, w_vec
+            )
+            stats = jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), stats)
+            new_w, step = LIN.softmax_newton_update(
+                w_flat, stats, n_classes,
+                reg_param=reg_param, fit_intercept=fit_intercept,
+            )
+            return new_w, it + 1, step
+
+        w0 = jnp.zeros((n_classes * d,), x_aug.dtype)
+        init = (w0, jnp.int32(0), jnp.asarray(jnp.inf, x_aug.dtype))
+        return lax.while_loop(cond, body, init)
+
+    return jax.jit(
+        run,
+        in_shardings=(
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
+    )

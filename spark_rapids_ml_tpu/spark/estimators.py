@@ -533,12 +533,12 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
 
     ``distribution='driver-merge'`` (default): one Spark job per Newton
     iteration (current parameters broadcast in the task closure), replicated
-    [d, d] solve on the driver between jobs — required for
-    ``checkpoint_dir`` and for multinomial fits.
-    ``distribution='mesh-barrier'``: the ENTIRE binary IRLS loop runs as one
-    XLA program (lax.while_loop with the psum inside the body) across the
-    barrier stage's jax.distributed mesh — zero driver round-trips during
-    training (spark/spmd.py MeshLogRegFitFn)."""
+    solve on the driver between jobs — required for ``checkpoint_dir``.
+    ``distribution='mesh-barrier'``: the ENTIRE IRLS loop — binary sigmoid
+    or >=3-class softmax, routed automatically — runs as one XLA program
+    (lax.while_loop with the psum inside the body) across the barrier
+    stage's jax.distributed mesh: zero driver round-trips during training
+    (spark/spmd.py MeshLogRegFitFn / MeshSoftmaxFitFn)."""
 
     def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
         if not _is_spark_df(dataset):
@@ -598,10 +598,9 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
             )
         if distribution == "mesh-barrier":
             if n_classes > 2:
-                raise ValueError(
-                    "distribution='mesh-barrier' supports binary labels "
-                    f"only (got {n_classes} classes); multinomial fits use "
-                    "'driver-merge'"
+                return self._fit_softmax_mesh_barrier(
+                    selected, feats, label, weight_col, n, n_classes,
+                    fit_intercept,
                 )
             return self._fit_binary_mesh_barrier(
                 selected, feats, label, weight_col, n, fit_intercept
@@ -665,6 +664,42 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         if weight_col and float(arrays["count"]) == 0.0:
             raise ValueError("all instance weights are zero")
         return self._binary_model(arrays["w"], fit_intercept)
+
+    def _fit_softmax_mesh_barrier(
+        self, selected, feats, label, weight_col, n, n_classes, fit_intercept
+    ) -> "SparkLogisticRegressionModel":
+        """One barrier stage = the whole softmax Newton fit (spark/spmd.py
+        MeshSoftmaxFitFn); mirrors _fit_multinomial_df's model surface."""
+        from spark_rapids_ml_tpu.spark import spmd
+
+        d = n + 1 if fit_intercept else n
+        cd = n_classes * d
+        with trace_range("softmax mesh fit"):
+            arrays = _barrier_single_row(
+                selected,
+                spmd.MeshSoftmaxFitFn(
+                    feats, label, weight_col, n_classes,
+                    reg_param=self.getRegParam(),
+                    fit_intercept=fit_intercept,
+                    max_iter=self.getMaxIter(),
+                    tol=self.getTol(),
+                ),
+                spmd.LOGREG_FIT_FIELDS,
+                {"w": (cd,), "iterations": (), "count": (), "mesh_size": ()},
+            )
+        if weight_col and float(arrays["count"]) == 0.0:
+            raise ValueError("all instance weights are zero")
+        w_mat = arrays["w"].reshape(n_classes, d)
+        if fit_intercept:
+            coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
+        else:
+            coef_matrix, intercepts = w_mat, np.zeros(n_classes)
+        model = SparkLogisticRegressionModel(
+            uid=self.uid,
+            coefficientMatrix=coef_matrix,
+            interceptVector=intercepts,
+        )
+        return self._copyValues(model)
 
     def _binary_model(
         self, w_full: np.ndarray, fit_intercept: bool

@@ -365,21 +365,24 @@ class MeshLogRegFitFn(_MeshReducePartitionFn):
             )
         return mat
 
-    def _run_on_mesh(self, mesh, gx, gw, gy):
-        import jax
-
+    def _make_fit(self, mesh):
+        """The compiled full-loop program — the ONE hook subclasses override
+        (softmax swaps the factory; the result packaging below is shared)."""
         from spark_rapids_ml_tpu.parallel import linear as PL
 
-        import jax.numpy as jnp
-
-        fit = PL.make_distributed_logreg_fit(
+        return PL.make_distributed_logreg_fit(
             mesh,
             reg_param=self.reg_param,
             fit_intercept=self.fit_intercept,
             max_iter=self.max_iter,
             tol=self.tol,
         )
-        w, iters, _ = fit(gx, gy, gw)  # (x_aug, labels, weights)
+
+    def _run_on_mesh(self, mesh, gx, gw, gy):
+        import jax
+        import jax.numpy as jnp
+
+        w, iters, _ = self._make_fit(mesh)(gx, gy, gw)  # (x_aug, labels, w)
         return {
             "w": np.asarray(jax.device_get(w)),
             "iterations": np.float64(int(iters)),
@@ -387,6 +390,44 @@ class MeshLogRegFitFn(_MeshReducePartitionFn):
             # same all-zero-weights contract as the driver-merge path
             "count": np.float64(float(jnp.sum(gw))),
         }
+
+
+class MeshSoftmaxFitFn(MeshLogRegFitFn):
+    """The multinomial sibling of ``MeshLogRegFitFn``: the whole softmax
+    IRLS loop in one barrier stage via
+    ``parallel.linear.make_distributed_softmax_fit``; ``w`` comes back
+    flattened [C·d]."""
+
+    def __init__(
+        self,
+        features_col: str,
+        label_col: str,
+        weight_col: str | None,
+        n_classes: int,
+        *,
+        reg_param: float,
+        fit_intercept: bool,
+        max_iter: int,
+        tol: float,
+    ):
+        super().__init__(
+            features_col, label_col, weight_col,
+            reg_param=reg_param, fit_intercept=fit_intercept,
+            max_iter=max_iter, tol=tol,
+        )
+        self.n_classes = int(n_classes)
+
+    def _make_fit(self, mesh):
+        from spark_rapids_ml_tpu.parallel import linear as PL
+
+        return PL.make_distributed_softmax_fit(
+            mesh,
+            self.n_classes,
+            reg_param=self.reg_param,
+            fit_intercept=self.fit_intercept,
+            max_iter=self.max_iter,
+            tol=self.tol,
+        )
 
 
 class MeshSVDFitFn(_MeshReducePartitionFn):

@@ -289,11 +289,15 @@ class TestFullLoopBarrierFits:
             mesh.trainingCost, merge.trainingCost, rtol=1e-8
         )
 
-    def test_multinomial_rejected_on_mesh_barrier(self, session, rng):
+    def test_multinomial_full_fit_differential(self, session, rng):
+        # r3: >=3-class fits ALSO run the whole softmax loop on the mesh
         from spark_rapids_ml_tpu.spark import SparkLogisticRegression
 
-        x = rng.normal(size=(60, 3))
-        y = rng.integers(0, 3, size=60).astype(float)
+        centers = np.array([[3.0, 0.0], [0.0, 3.0], [-3.0, -3.0]])
+        x = np.vstack([rng.normal(size=(70, 2)) + c for c in centers])
+        y = np.repeat([0.0, 1.0, 2.0], 70)
+        perm = rng.permutation(len(y))
+        x, y = x[perm], y[perm]
         schema = LT.StructType(
             [
                 LT.StructField("features", LT.ArrayType(LT.DoubleType())),
@@ -301,11 +305,23 @@ class TestFullLoopBarrierFits:
             ]
         )
         df = session.createDataFrame(
-            [(row.tolist(), float(lbl)) for row, lbl in zip(x, y)], schema
+            [(row.tolist(), float(lbl)) for row, lbl in zip(x, y)],
+            schema,
+            numPartitions=4,
         )
-        est = SparkLogisticRegression().setDistribution("mesh-barrier")
-        with pytest.raises(ValueError, match="binary labels"):
-            est.fit(df)
+        base = SparkLogisticRegression().setRegParam(1e-2).setMaxIter(8)
+        mesh = base.copy().setDistribution("mesh-barrier").fit(df)
+        merge = base.copy().setDistribution("driver-merge").fit(df)
+        assert mesh.numClasses == 3
+        # softmax has a flat class-shift direction that amplifies float
+        # summation-order differences between the 8-device mesh psum and the
+        # 4-partition driver merge; 1e-6 is still far inside model noise
+        np.testing.assert_allclose(
+            mesh.coefficientMatrix, merge.coefficientMatrix, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            mesh.interceptVector, merge.interceptVector, atol=1e-6
+        )
 
     def test_checkpoint_rejected_on_mesh_barrier(self, session, rng, tmp_path):
         from spark_rapids_ml_tpu.spark import SparkKMeans, SparkLogisticRegression
