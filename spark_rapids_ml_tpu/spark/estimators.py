@@ -153,16 +153,8 @@ class SparkPCA(_HasDistribution, PCA):
                 # instead of cond(X)² (ops/linalg.py:403-420 rationale)
                 return self._fit_svd(selected, input_col, n, k, distribution)
             if distribution == "mesh-barrier":
-                from spark_rapids_ml_tpu.spark import spmd
-
-                arrays = _barrier_single_row(
-                    selected,
-                    spmd.MeshGramPartitionFn(
-                        input_col, precision=self.getOrDefault("precision")
-                    ),
-                    spmd.MESH_FIELDS,
-                    {"xtx": (n, n), "col_sum": (n,), "count": (),
-                     "mesh_size": ()},
+                arrays = _mesh_gram_arrays(
+                    selected, input_col, self.getOrDefault("precision"), n
                 )
                 stats = L.GramStats(
                     arrays["xtx"], arrays["col_sum"], np.float64(arrays["count"])
@@ -379,6 +371,20 @@ def _barrier_single_row(df, fn, fields: list[str], shapes: dict[str, tuple]):
             for r in stats_df.collect()
         ]
     return spmd.single_row_from_batches(batches, fields, shapes)
+
+
+def _mesh_gram_arrays(selected, input_col: str, precision: str, n: int) -> dict:
+    """One barrier-stage psum Gram pass (MeshGramPartitionFn) decoded to
+    host arrays — shared by every estimator whose mesh-barrier reduce is the
+    Gram monoid (SparkPCA, SparkTruncatedSVD)."""
+    from spark_rapids_ml_tpu.spark import spmd
+
+    return _barrier_single_row(
+        selected,
+        spmd.MeshGramPartitionFn(input_col, precision=precision),
+        spmd.MESH_FIELDS,
+        {"xtx": (n, n), "col_sum": (n,), "count": (), "mesh_size": ()},
+    )
 
 
 def _collect_stats(df, partition_fn, fields: list[str], shapes: dict[str, tuple]):
@@ -1186,11 +1192,13 @@ class SparkStandardScalerModel(StandardScalerModel):
 # ---------------------------------------------------------------------------
 
 
-class SparkTruncatedSVD(TruncatedSVD):
+class SparkTruncatedSVD(_HasDistribution, TruncatedSVD):
     """TruncatedSVD over pyspark DataFrames — the LSA/recommender sibling of
     SparkPCA: one Gram stats pass (solver 'gram'/'randomized'/'auto') or one
     R-factor pass (solver 'svd', cond(X) accuracy) through mapInArrow, then
-    the replicated decomposition on the driver."""
+    the replicated decomposition on the driver; ``distribution=
+    'mesh-barrier'`` reduces on the barrier stage's SPMD mesh instead (psum
+    Gram, or the butterfly-TSQR R merge for solver='svd')."""
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         if not _is_spark_df(dataset):
@@ -1212,6 +1220,24 @@ class SparkTruncatedSVD(TruncatedSVD):
         if k > n:
             raise ValueError(f"k={k} must be <= number of features {n}")
         solver = self.getOrDefault("solver")
+        distribution = self.getOrDefault("distribution")
+        if distribution == "mesh-barrier" and solver == "svd":
+            from spark_rapids_ml_tpu.spark import spmd
+
+            with trace_range("tsvd mesh fit"):
+                arrays = _barrier_single_row(
+                    selected,
+                    spmd.MeshTSVDFitFn(input_col, k),
+                    spmd.TSVD_FIT_FIELDS,
+                    {"components": (n, k), "singularValues": (k,),
+                     "count": (), "mesh_size": ()},
+                )
+            model = SparkTruncatedSVDModel(
+                uid=self.uid,
+                components=arrays["components"],
+                singularValues=arrays["singularValues"],
+            )
+            return self._copyValues(model)
         with trace_range("tsvd reduce"):
             if solver == "svd":
                 T, _ = _sql_mods(dataset)
@@ -1223,19 +1249,24 @@ class SparkTruncatedSVD(TruncatedSVD):
                     r = arrow_fns.r_from_batches(r_df.toArrow().to_batches(), n)
                 else:
                     r = arrow_fns.r_from_rows(r_df.collect(), n)
-        with trace_range("tsvd decompose"):
-            if solver == "svd":
-                components, sv = L.svd_components_from_r(jnp.asarray(r), k)
+            elif distribution == "mesh-barrier":
+                xtx = _mesh_gram_arrays(
+                    selected, input_col, self.getOrDefault("precision"), n
+                )["xtx"]
             else:
                 fn = arrow_fns.make_fit_partition_fn(
                     input_col, precision=self.getOrDefault("precision")
                 )
-                stats = _collect_stats(
+                xtx = _collect_stats(
                     selected, fn, ["xtx", "col_sum", "count"],
                     {"xtx": (n, n), "col_sum": (n,), "count": ()},
-                )
+                )["xtx"]
+        with trace_range("tsvd decompose"):
+            if solver == "svd":
+                components, sv = L.svd_components_from_r(jnp.asarray(r), k)
+            else:
                 components, sv = TSVD._decompose_gram_jit(
-                    jnp.asarray(stats["xtx"]), k, solver
+                    jnp.asarray(xtx), k, solver
                 )
         model = SparkTruncatedSVDModel(
             uid=self.uid,

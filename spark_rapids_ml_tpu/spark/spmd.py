@@ -327,6 +327,7 @@ class MeshMomentsPartitionFn(_MeshReducePartitionFn):
 
 LOGREG_FIT_FIELDS = ["w", "iterations", "count", "mesh_size"]
 SVD_FIT_FIELDS = ["pc", "explainedVariance", "count", "mesh_size"]
+TSVD_FIT_FIELDS = ["components", "singularValues", "count", "mesh_size"]
 KMEANS_FIT_FIELDS = ["centers", "cost", "iterations", "count", "mesh_size"]
 
 
@@ -463,6 +464,30 @@ class MeshSVDFitFn(_MeshReducePartitionFn):
         return {
             "pc": np.asarray(jax.device_get(pc)),
             "explainedVariance": np.asarray(jax.device_get(ev)),
+        }
+
+
+class MeshTSVDFitFn(_MeshReducePartitionFn):
+    """TruncatedSVD's barrier fit: TSQR across the process mesh (uncentered
+    by definition — zero pad rows are exact), replicated SVD of R emitting
+    components + raw singular values (σ of X, not the PCA variance ratio)."""
+
+    FIELDS = TSVD_FIT_FIELDS
+
+    def __init__(self, input_col: str, k: int):
+        super().__init__(input_col)
+        self.k = int(k)
+
+    def _run_on_mesh(self, mesh, gx, gw, gy):
+        import jax
+
+        from spark_rapids_ml_tpu.parallel import tsqr as TSQR
+
+        r = TSQR.tsqr_r(gx, mesh)
+        components, sv = L.svd_components_from_r(r, self.k)
+        return {
+            "components": np.asarray(jax.device_get(components)),
+            "singularValues": np.asarray(jax.device_get(sv))[: self.k],
         }
 
 

@@ -513,6 +513,25 @@ class TestSparkTruncatedSVDIntegration:
         with pytest.raises(ValueError, match="k=7 must be <="):
             SparkTruncatedSVD().setInputCol("features").setK(7).fit(df)
 
+    @pytest.mark.parametrize("solver", ["gram", "svd"])
+    def test_mesh_barrier_differential(self, backend, solver):
+        from spark_rapids_ml_tpu.spark import SparkTruncatedSVD
+
+        rng = np.random.default_rng(123)
+        x = rng.normal(size=(240, 9))
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=4
+        )
+        base = SparkTruncatedSVD().setInputCol("features").setK(4).setSolver(solver)
+        mesh = base.copy().setDistribution("mesh-barrier").fit(df)
+        merge = base.copy().setDistribution("driver-merge").fit(df)
+        np.testing.assert_allclose(
+            np.abs(mesh.components), np.abs(merge.components), atol=1e-8
+        )
+        np.testing.assert_allclose(
+            mesh.singularValues, merge.singularValues, atol=1e-8
+        )
+
 
 class TestSparkNormalizerIntegration:
     def test_transform_differential(self, backend):
