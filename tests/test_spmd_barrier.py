@@ -399,3 +399,17 @@ class TestFullLoopBarrierFits:
         np.testing.assert_allclose(
             mesh.coefficients, merge.coefficients, atol=1e-8
         )
+
+
+class TestBarrierEdgeCases:
+    def test_empty_partition_in_barrier_stage(self, session, rng):
+        # a partition with zero rows must adopt the group's column count and
+        # contribute nothing (zero shard) — not crash the rendezvous
+        x = rng.normal(size=(3, 5))  # 3 rows over 4 partitions -> one empty
+        df = _features_df(session, x, partitions=4)
+        model = (
+            SparkPCA().setInputCol("features").setK(2)
+            .setDistribution("mesh-barrier").fit(df)
+        )
+        core = SparkPCA().setInputCol("features").setK(2).fit(x)
+        np.testing.assert_allclose(np.abs(model.pc), np.abs(core.pc), atol=1e-8)
