@@ -1130,6 +1130,27 @@ class SparkKMeansModel(KMeansModel):
             self.getOutputCol(), scalar=True,
         )
 
+    def computeCost(self, dataset: Any) -> float:
+        """Sum of squared distances to the nearest centroid; on DataFrames
+        one mapInArrow assignment pass (KMeansAssignStatsFn) — the cost
+        reduces executor-side, only a scalar row reaches the driver."""
+        if not _is_spark_df(dataset):
+            return super().computeCost(dataset)
+        input_col = _resolve_col(self, "inputCol") or "features"
+        shapes = {"counts": (len(self.clusterCenters),), "cost": ()}
+        try:
+            arrays = _collect_stats(
+                dataset.select(input_col),
+                arrow_fns.KMeansAssignStatsFn(input_col, self.clusterCenters),
+                ["counts", "cost"],
+                shapes,
+            )
+        except ValueError as e:
+            if "no partition statistics" in str(e):
+                return 0.0  # every partition empty: match the core path
+            raise
+        return float(arrays["cost"])
+
 
 # ---------------------------------------------------------------------------
 # StandardScaler

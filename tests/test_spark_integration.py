@@ -712,6 +712,19 @@ class TestSparkKMeansIntegration:
         np.testing.assert_allclose(resumed.trainingCost, full.trainingCost, rtol=1e-9)
         np.testing.assert_allclose(resumed.clusterCenters, full.clusterCenters)
 
+    def test_compute_cost_on_dataframe(self, backend):
+        rng = np.random.default_rng(124)
+        centers_true = np.array([[6.0, 0.0], [-6.0, 0.0]])
+        x = np.concatenate(
+            [rng.normal(size=(50, 2)) * 0.5 + c for c in centers_true]
+        )
+        df = backend.df([(row.tolist(),) for row in x], backend.features_schema())
+        model = SparkKMeans().setInputCol("features").setK(2).setSeed(0).fit(df)
+        df_cost = model.computeCost(df)
+        core_cost = model.computeCost(x)  # core path on the same data
+        np.testing.assert_allclose(df_cost, core_cost, rtol=1e-9)
+        np.testing.assert_allclose(df_cost, model.trainingCost, rtol=1e-6)
+
     def test_weighted_kmeans_df(self, backend, rng_m):
         T = backend.T
         x = np.vstack(
@@ -756,3 +769,15 @@ class TestSparkScalerIntegration:
         )
         np.testing.assert_allclose(out.mean(0), np.zeros(6), atol=1e-9)
         np.testing.assert_allclose(out.std(0, ddof=1), np.ones(6), atol=1e-9)
+
+
+class TestEmptyDataFrameCost:
+    def test_compute_cost_empty_df_is_zero(self, backend):
+        from spark_rapids_ml_tpu.spark import SparkKMeansModel
+
+        model = SparkKMeansModel(
+            clusterCenters=np.zeros((2, 3)), trainingCost=0.0
+        ).setInputCol("features")
+        T = backend.T
+        empty = backend.df([], backend.features_schema(), partitions=2)
+        assert model.computeCost(empty) == 0.0
