@@ -154,7 +154,8 @@ class TestStructDecoding:
 
 class TestFsspecPaths:
     """Remote-path persistence through fsspec's built-in memory:// filesystem
-    — the same code path s3://, gs://, and hdfs:// take."""
+    — the same code path s3://, gs://, and hdfs:// take (fsspec is a
+    declared test dependency)."""
 
     def test_native_layout_memory_url(self, pca_model):
         url = "memory://tpu-ml-test/native_m"
