@@ -67,6 +67,15 @@ class PCAParams(HasInputCol, HasOutputCol):
         "(1-pass bf16)",
         str,
     )
+    standardize = Param(
+        "standardize",
+        "fuse StandardScaler into the fit (BASELINE config 4): the "
+        "decomposition runs on the covariance of (x−μ)/σ, derived from the "
+        "SAME one-pass GramStats — no separate scaling pass over the data — "
+        "and transform standardizes before projecting (the model carries "
+        "mean/std). Implies centering; sample (m−1) std like StandardScaler",
+        bool,
+    )
     solver = Param(
         "solver",
         "decomposition solver: 'full' (exact refined eigh, reference "
@@ -84,6 +93,7 @@ class PCAParams(HasInputCol, HasOutputCol):
 
         self._setDefault(
             meanCentering=False,
+            standardize=False,
             outputCol="pca_features",
             precision=get_config().default_precision,
             solver="full",
@@ -132,6 +142,9 @@ class PCA(PCAParams, Estimator):
 
     def setMeanCentering(self, value: bool) -> "PCA":
         return self._set(meanCentering=value)
+
+    def setStandardize(self, value: bool) -> "PCA":
+        return self._set(standardize=value)
 
     def setPrecision(self, value: str) -> "PCA":
         if value not in _PRECISIONS:
@@ -192,8 +205,16 @@ class PCA(PCAParams, Estimator):
                     )
 
             solver = self.getOrDefault("solver")
+            standardize = self.getOrDefault("standardize")
             if k > n_cols:
                 raise ValueError(f"k={k} must be <= number of features {n_cols}")
+            if standardize and solver == "svd":
+                raise ValueError(
+                    "standardize=True derives the scaled covariance from "
+                    "GramStats and so requires a covariance solver "
+                    "('full'/'randomized'/'auto'); solver='svd' decomposes "
+                    "R factors of the raw rows"
+                )
             if solver == "svd":
                 r = self._reduce_r(mats, mean_centering)
             else:
@@ -215,9 +236,13 @@ class PCA(PCAParams, Estimator):
                 partials = run_partition_tasks(partition_task, mats)
                 stats = tree_reduce(partials, L.combine_gram_stats)
 
+        mean = std = None
         with trace_range("eigh"):  # "cuSolver SVD" range analog, RapidsRowMatrix.scala:70
             if solver == "svd":
                 pc, explained = _svd_from_r_jit(r, k)
+            elif standardize:
+                cov, mean, std = L.standardized_cov_from_stats(stats)
+                pc, explained = L.pca_fit_from_cov(cov, k, solver=solver)
             else:
                 pc, explained = _fit_from_stats_jit(stats, k, mean_centering, solver)
 
@@ -225,6 +250,8 @@ class PCA(PCAParams, Estimator):
             uid=self.uid,
             pc=np.asarray(pc),
             explainedVariance=np.asarray(explained),
+            mean=None if mean is None else np.asarray(mean),
+            std=None if std is None else np.asarray(std),
         )
         return self._copyValues(model)
 
@@ -242,16 +269,26 @@ class PCAModel(PCAParams, Model):
         uid: str | None = None,
         pc: np.ndarray | None = None,
         explainedVariance: np.ndarray | None = None,
+        mean: np.ndarray | None = None,
+        std: np.ndarray | None = None,
     ):
         super().__init__(uid)
         self.pc = None if pc is None else np.asarray(pc)
         self.explainedVariance = (
             None if explainedVariance is None else np.asarray(explainedVariance)
         )
+        # set on standardize=True fits: transform scales before projecting
+        self.mean = None if mean is None else np.asarray(mean)
+        self.std = None if std is None else np.asarray(std)
 
     # -- transform ----------------------------------------------------------
+    def _standardize_host(self, mat: np.ndarray) -> np.ndarray:
+        """(x − μ)/σ for standardize-fit models, applied BEFORE padding so
+        pad rows stay zero (shared rule: columnar.standardize_host)."""
+        return columnar.standardize_host(mat, self.mean, self.std)
+
     def _project_matrix(self, mat: np.ndarray) -> np.ndarray:
-        padded, true_rows = columnar.pad_rows(mat)
+        padded, true_rows = columnar.pad_rows(self._standardize_host(mat))
         xd = jnp.asarray(padded)  # device dtype (f32 unless x64 is enabled)
         out = _project(xd, jnp.asarray(self.pc, dtype=xd.dtype))
         return np.asarray(out)[:true_rows]
@@ -272,21 +309,33 @@ class PCAModel(PCAParams, Model):
         pcᵀ·row per row, no accelerator involved. With ``use_native=True`` the
         rows are packed and projected through the C++ bridge instead (the
         native columnar path of the reference's dual-mode UDF)."""
+        mat = self._standardize_host(np.stack([np.asarray(r) for r in rows]))
+        rows = list(mat)
         if use_native:
             from spark_rapids_ml_tpu import bridge
 
-            packed = bridge.pack_rows([np.asarray(r) for r in rows])
+            packed = bridge.pack_rows(rows)
             return list(bridge.project(packed, self.pc))
         pct = self.pc.T
-        return [pct @ np.asarray(r) for r in rows]
+        return [pct @ r for r in rows]
 
     # -- persistence ----------------------------------------------------------
     def _saveData(self) -> dict[str, np.ndarray]:
-        return {"pc": self.pc, "explainedVariance": self.explainedVariance}
+        out = {"pc": self.pc, "explainedVariance": self.explainedVariance}
+        if self.mean is not None:
+            out["mean"] = self.mean
+            out["std"] = self.std
+        return out
 
     @classmethod
     def _fromSaved(cls, uid: str, data: dict[str, np.ndarray]) -> "PCAModel":
-        return cls(uid=uid, pc=data["pc"], explainedVariance=data["explainedVariance"])
+        return cls(
+            uid=uid,
+            pc=data["pc"],
+            explainedVariance=data["explainedVariance"],
+            mean=data.get("mean"),
+            std=data.get("std"),
+        )
 
     # -- stock pyspark.ml interop (layout="spark") ---------------------------
     # Spark's PCAModelWriter persists Row(pc: DenseMatrix, explainedVariance:
@@ -301,6 +350,13 @@ class PCAModel(PCAParams, Model):
         from spark_rapids_ml_tpu.models.base import spark_set_params
         from spark_rapids_ml_tpu.utils import persistence as P
 
+        if self.mean is not None:
+            raise NotImplementedError(
+                "stock Spark ML's PCAModel cannot represent a "
+                "standardize=True model's scaling state (mean/std); save "
+                "with the native layout, or fit an explicit "
+                "StandardScaler + PCA pipeline for Spark interop"
+            )
         params = {
             k: v
             for k, v in spark_set_params(self).items()

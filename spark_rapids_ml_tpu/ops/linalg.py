@@ -108,6 +108,31 @@ def covariance_from_stats(stats: GramStats, *, mean_centering: bool) -> jax.Arra
     return stats.xtx - jnp.outer(stats.col_sum, stats.col_sum) / denom
 
 
+def standardized_cov_from_stats(
+    stats: GramStats,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(scatter of standardized X, mean, sample std) from RAW GramStats —
+    the fused StandardScaler→PCA pipeline (BASELINE config 4) in ONE data
+    pass: with Xs = (X − μ)/σ,  XsᵀXs = D⁻¹(XᵀX − m·μμᵀ)D⁻¹ with D =
+    diag(σ), so the standardized covariance needs no second pass over the
+    data. σ is the sample (m−1) std matching StandardScaler
+    (ops/scaler.py finalize_moments); zero-variance features pass through
+    unscaled, like ``standardize``."""
+    from spark_rapids_ml_tpu.ops import scaler as S
+
+    # diag(XᵀX) IS the per-feature sum of squares: the scaler's own
+    # finalize_moments derives mean/sample-std, so the fused path can never
+    # drift from the staged StandardScaler pipeline it must equal
+    mean, std = S.finalize_moments(
+        S.MomentStats(stats.count, stats.col_sum, jnp.diagonal(stats.xtx))
+    )
+    m = jnp.maximum(stats.count, jnp.ones_like(stats.count))
+    safe = jnp.where(std > 0, std, jnp.ones_like(std))
+    centered = stats.xtx - m * jnp.outer(mean, mean)
+    cov = centered / jnp.outer(safe, safe)
+    return cov, mean, std
+
+
 def sign_flip(u: jax.Array) -> jax.Array:
     """Deterministic eigenvector orientation.
 

@@ -780,10 +780,21 @@ class TransformPartitionFn:
     to the device once per worker, on the first batch.
     """
 
-    def __init__(self, input_col: str, output_col: str, pc: np.ndarray):
+    def __init__(
+        self,
+        input_col: str,
+        output_col: str,
+        pc: np.ndarray,
+        mean: np.ndarray | None = None,
+        std: np.ndarray | None = None,
+    ):
         self.input_col = input_col
         self.output_col = output_col
         self.pc = np.asarray(pc)
+        # standardize-fit models (PCA standardize=True): scale worker-side
+        # before projecting, exactly like the model's local transform
+        self.mean = None if mean is None else np.asarray(mean)
+        self.std = None if std is None else np.asarray(std)
         self._pc_dev = None  # per-process device copy; never serialized
 
     def __getstate__(self):
@@ -798,7 +809,11 @@ class TransformPartitionFn:
         for batch in batches:
             if batch.num_rows == 0:
                 continue
-            mat = columnar.extract_matrix(batch, self.input_col)
+            mat = columnar.standardize_host(
+                columnar.extract_matrix(batch, self.input_col),
+                self.mean,
+                self.std,
+            )
             padded, true_rows = columnar.pad_rows(mat)
             xd = jnp.asarray(padded)
             if self._pc_dev is None or self._pc_dev.dtype != xd.dtype:
@@ -863,8 +878,14 @@ def make_matrix_map_partition_fn(
     return MatrixMapPartitionFn(input_col, output_col, matrix_fn)
 
 
-def make_transform_partition_fn(input_col: str, output_col: str, pc: np.ndarray):
-    return TransformPartitionFn(input_col, output_col, pc)
+def make_transform_partition_fn(
+    input_col: str,
+    output_col: str,
+    pc: np.ndarray,
+    mean: np.ndarray | None = None,
+    std: np.ndarray | None = None,
+):
+    return TransformPartitionFn(input_col, output_col, pc, mean, std)
 
 
 def transform_output_schema(input_schema: pa.Schema, output_col: str) -> pa.Schema:

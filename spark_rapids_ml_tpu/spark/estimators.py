@@ -127,7 +127,8 @@ class SparkPCA(_HasDistribution, PCA):
             core = super().fit(dataset, num_partitions)
             return self._copyValues(
                 SparkPCAModel(uid=core.uid, pc=core.pc,
-                              explainedVariance=core.explainedVariance)
+                              explainedVariance=core.explainedVariance,
+                              mean=core.mean, std=core.std)
             )
         T, _ = _sql_mods(dataset)
         input_col = self.getInputCol()
@@ -149,6 +150,13 @@ class SparkPCA(_HasDistribution, PCA):
                 raise ValueError(f"k={k} must be <= number of features {n}")
             distribution = self.getOrDefault("distribution")
             if self.getOrDefault("solver") == "svd":
+                if self.getOrDefault("standardize"):
+                    raise ValueError(
+                        "standardize=True derives the scaled covariance "
+                        "from GramStats and so requires a covariance solver "
+                        "('full'/'randomized'/'auto'); solver='svd' "
+                        "decomposes R factors of the raw rows"
+                    )
                 # direct TSQR→SVD(R) path: never forms XᵀX, works at cond(X)
                 # instead of cond(X)² (ops/linalg.py:403-420 rationale)
                 return self._fit_svd(selected, input_col, n, k, distribution)
@@ -175,19 +183,29 @@ class SparkPCA(_HasDistribution, PCA):
         with trace_range("eigh"):
             import jax.numpy as jnp
 
-            cov = L.covariance_from_stats(
-                L.GramStats(
-                    jnp.asarray(stats.xtx),
-                    jnp.asarray(stats.col_sum),
-                    jnp.asarray(stats.count),
-                ),
-                mean_centering=self.getMeanCentering(),
+            jstats = L.GramStats(
+                jnp.asarray(stats.xtx),
+                jnp.asarray(stats.col_sum),
+                jnp.asarray(stats.count),
             )
+            mean = std = None
+            if self.getOrDefault("standardize"):
+                # fused StandardScaler→PCA (BASELINE config 4): the scaled
+                # covariance comes from the SAME one-pass GramStats
+                cov, mean, std = L.standardized_cov_from_stats(jstats)
+            else:
+                cov = L.covariance_from_stats(
+                    jstats, mean_centering=self.getMeanCentering()
+                )
             pc, ev = L.pca_fit_from_cov(
                 cov, k, solver=self.getOrDefault("solver")
             )
         model = SparkPCAModel(
-            uid=self.uid, pc=np.asarray(pc), explainedVariance=np.asarray(ev)
+            uid=self.uid,
+            pc=np.asarray(pc),
+            explainedVariance=np.asarray(ev),
+            mean=None if mean is None else np.asarray(mean),
+            std=None if std is None else np.asarray(std),
         )
         return self._copyValues(model)
 
@@ -328,7 +346,9 @@ class SparkPCAModel(PCAModel):
         T, _ = _sql_mods(dataset)
         input_col = self.getInputCol()
         output_col = self.getOutputCol()
-        fn = arrow_fns.make_transform_partition_fn(input_col, output_col, self.pc)
+        fn = arrow_fns.make_transform_partition_fn(
+            input_col, output_col, self.pc, self.mean, self.std
+        )
         out_schema = T.StructType(
             dataset.schema.fields
             + [T.StructField(output_col, T.ArrayType(T.DoubleType()))]

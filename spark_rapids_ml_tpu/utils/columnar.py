@@ -357,6 +357,21 @@ def resolve_partition_weights(
     return out
 
 
+def standardize_host(
+    mat: np.ndarray, mean: np.ndarray | None, std: np.ndarray | None
+) -> np.ndarray:
+    """(x − μ)/σ on host rows with StandardScaler's zero-variance rule
+    (σ=0 features pass through unscaled) — the ONE implementation every
+    standardize-fit transform path shares (model local path, row fallback,
+    and the worker-side Arrow transform). No-op when mean is None."""
+    if mean is None:
+        return mat
+    safe = np.where(std > 0, std, 1.0)
+    return (mat - mean[None, :].astype(mat.dtype)) / safe[None, :].astype(
+        mat.dtype
+    )
+
+
 def pad_labeled(
     x: np.ndarray,
     y: np.ndarray,

@@ -228,6 +228,40 @@ class TestSparkPCAIntegration:
         core = PCA().setInputCol("features").setK(3).setSolver("svd").fit(x)
         np.testing.assert_allclose(np.abs(model.pc), np.abs(core.pc), atol=1e-4)
 
+    @pytest.mark.parametrize(
+        "distribution", ["driver-merge", "mesh-local", "mesh-barrier"]
+    )
+    def test_standardize_fused_on_df(self, backend, distribution):
+        # BASELINE config 4: StandardScaler fused into the PCA fit — one
+        # data pass on every distribution (the scaled covariance derives
+        # from the same GramStats row/psum)
+        from spark_rapids_ml_tpu import StandardScaler
+
+        rng = np.random.default_rng(125)
+        x = rng.normal(size=(240, 6)) * np.array(
+            [1.0, 40.0, 0.02, 5.0, 100.0, 1.0]
+        ) + 2.0
+        df = backend.df(
+            [(row.tolist(),) for row in x], backend.features_schema(), partitions=4
+        )
+        model = (
+            SparkPCA().setInputCol("features").setK(3).setStandardize(True)
+            .setDistribution(distribution).fit(df)
+        )
+        scaler = (
+            StandardScaler().setInputCol("features").setWithMean(True)
+            .setWithStd(True).fit(x)
+        )
+        xs = np.asarray(scaler.transform(x))
+        staged = PCA().setInputCol("features").setK(3).setMeanCentering(True).fit(xs)
+        np.testing.assert_allclose(np.abs(model.pc), np.abs(staged.pc), atol=1e-6)
+        out = np.asarray(
+            [r["pca_features"] for r in model.transform(df).collect()]
+        )
+        np.testing.assert_allclose(
+            np.abs(out), np.abs(np.asarray(staged.transform(xs))), atol=1e-6
+        )
+
     def test_vector_udt_input(self, backend):
         # VERDICT r2 missing #5: pyspark.ml pipelines carry VectorUDT
         # columns; fit + transform must accept them unmodified.

@@ -1,0 +1,95 @@
+"""Fused StandardScaler→PCA (BASELINE config 4): ``standardize=True`` runs
+the decomposition on the covariance of (x−μ)/σ derived from the SAME
+one-pass GramStats — differential-equal to the explicit two-stage pipeline,
+with no second pass over the data.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import PCA, StandardScaler
+from spark_rapids_ml_tpu.models.pca import PCAModel
+
+
+@pytest.fixture
+def x(rng):
+    # wildly different feature scales: the case standardization exists for
+    return rng.normal(size=(400, 8)) * np.array(
+        [1.0, 50.0, 0.01, 5.0, 100.0, 1.0, 0.5, 10.0]
+    ) + rng.normal(size=8) * 3.0
+
+
+class TestStandardizedPCA:
+    def test_equals_explicit_scaler_pipeline(self, x):
+        fused = PCA().setInputCol("f").setK(3).setStandardize(True).fit(x)
+        scaler = (
+            StandardScaler().setInputCol("f").setWithMean(True).setWithStd(True)
+            .fit(x)
+        )
+        xs = np.asarray(scaler.transform(x))
+        staged = PCA().setInputCol("f").setK(3).setMeanCentering(True).fit(xs)
+        np.testing.assert_allclose(np.abs(fused.pc), np.abs(staged.pc), atol=1e-9)
+        np.testing.assert_allclose(
+            fused.explainedVariance, staged.explainedVariance, atol=1e-9
+        )
+        # transform standardizes internally: fused(model, raw x) ==
+        # staged(model, scaled x)
+        got = np.asarray(fused.transform(x))
+        want = np.asarray(staged.transform(xs))
+        np.testing.assert_allclose(np.abs(got), np.abs(want), atol=1e-8)
+
+    def test_matches_sklearn_correlation_pca(self, x):
+        sk = pytest.importorskip("sklearn")
+        from sklearn.decomposition import PCA as SkPCA
+        from sklearn.preprocessing import StandardScaler as SkScaler
+
+        xs = SkScaler().fit_transform(x) * np.sqrt(len(x) / (len(x) - 1))
+        # sklearn scaler uses population std; rescale to sample-std space
+        sk_pc = SkPCA(n_components=3).fit(xs).components_.T
+        fused = PCA().setInputCol("f").setK(3).setStandardize(True).fit(x)
+        cos = np.abs(np.sum(fused.pc * sk_pc, axis=0)) / (
+            np.linalg.norm(fused.pc, axis=0) * np.linalg.norm(sk_pc, axis=0)
+        )
+        assert cos.min() > 1 - 1e-9
+
+    def test_row_fallback_and_native_standardize(self, x):
+        model = PCA().setInputCol("f").setK(2).setStandardize(True).fit(x)
+        want = np.asarray(model.transform(x))
+        got = np.asarray(model.transform_rows(list(x)))
+        np.testing.assert_allclose(np.abs(got), np.abs(want), atol=1e-8)
+
+    def test_persistence_round_trips_mean_std(self, x, tmp_path):
+        model = PCA().setInputCol("f").setK(2).setStandardize(True).fit(x)
+        p = str(tmp_path / "m")
+        model.save(p)
+        loaded = PCAModel.load(p)
+        np.testing.assert_allclose(loaded.mean, model.mean)
+        np.testing.assert_allclose(loaded.std, model.std)
+        np.testing.assert_allclose(
+            np.asarray(loaded.transform(x)), np.asarray(model.transform(x))
+        )
+        # plain models keep saving without the fields
+        plain = PCA().setInputCol("f").setK(2).fit(x)
+        p2 = str(tmp_path / "m2")
+        plain.save(p2)
+        assert PCAModel.load(p2).mean is None
+
+    def test_svd_solver_rejected(self, x):
+        with pytest.raises(ValueError, match="covariance solver"):
+            PCA().setInputCol("f").setK(2).setStandardize(True).setSolver(
+                "svd"
+            ).fit(x)
+
+    def test_zero_variance_feature_passes_through(self, rng):
+        x = rng.normal(size=(100, 4))
+        x[:, 2] = 7.0  # constant feature
+        model = PCA().setInputCol("f").setK(2).setStandardize(True).fit(x)
+        out = np.asarray(model.transform(x))
+        assert np.isfinite(out).all()
+
+    def test_spark_layout_save_rejected(self, x, tmp_path):
+        # stock Spark PCAModel cannot carry the scaling state — must refuse
+        # rather than silently produce a model that projects raw data
+        model = PCA().setInputCol("f").setK(2).setStandardize(True).fit(x)
+        with pytest.raises(NotImplementedError, match="scaling state"):
+            model.save(str(tmp_path / "m"), layout="spark")
