@@ -309,11 +309,17 @@ class DataFrame:
         rows: list[Row] = []
         for part in self._parts():
             for b in part:
-                cols = [c.to_pylist() for c in b.columns]
+                if not b.num_rows:
+                    continue
+                # slice BEFORE to_pylist — converting a whole multi-thousand
+                # row batch to Python objects to peek at one row dominates
+                # fit() setup time (nCols inference does head(1))
+                sl = b.slice(0, n - len(rows))
+                cols = [c.to_pylist() for c in sl.columns]
                 for vals in zip(*cols):
                     rows.append(Row([_value_to_python(v) for v in vals], names))
-                    if len(rows) >= n:
-                        return rows
+                if len(rows) >= n:
+                    return rows
         return rows
 
     def count(self) -> int:

@@ -17,7 +17,7 @@ first-class here:
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Sequence
 
 import jax
@@ -95,28 +95,36 @@ def mapreduce_data_axis(
     return _run
 
 
-def allreduce(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
-    """Sum-reduce a [stacked, ...] array over its leading dim across one mesh
-    axis: each device reduces its resident slices, one psum combines the
-    rest. Returns the replicated [...] total."""
-
+@lru_cache(maxsize=None)
+def _allreduce_prog(mesh: Mesh, axis: str):
     @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(), check_rep=False)
     def _psum(v):
         return lax.psum(v.sum(axis=0), axis)
 
-    return _psum(x)
+    return jax.jit(_psum)
 
 
-def allgather(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
-    """Gather shards along the leading dim over one mesh axis."""
+def allreduce(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
+    """Sum-reduce a [stacked, ...] array over its leading dim across one mesh
+    axis: each device reduces its resident slices, one psum combines the
+    rest. Returns the replicated [...] total."""
+    return _allreduce_prog(mesh, axis)(x)
 
+
+@lru_cache(maxsize=None)
+def _allgather_prog(mesh: Mesh, axis: str):
     @partial(
         shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(), check_rep=False
     )
     def _gather(v):
         return lax.all_gather(v, axis, tiled=True)
 
-    return _gather(x)
+    return jax.jit(_gather)
+
+
+def allgather(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
+    """Gather shards along the leading dim over one mesh axis."""
+    return _allgather_prog(mesh, axis)(x)
 
 
 def broadcast_host(value, root: int = 0):

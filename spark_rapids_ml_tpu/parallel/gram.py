@@ -19,7 +19,7 @@ program over the device mesh:
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +28,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, FEAT_AXIS, shard_map
+
+
+@lru_cache(maxsize=None)
+def _gram_stats_prog(mesh: Mesh, precision):
+    from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
+
+    return jax.jit(
+        mapreduce_data_axis(
+            lambda xl: L.gram_stats(xl, precision=precision), mesh
+        )
+    )
 
 
 def sharded_gram_stats(
@@ -39,21 +50,24 @@ def sharded_gram_stats(
     """Data-parallel GramStats: local MXU Gram + psum allreduce over ICI.
 
     ``x`` is [rows, n] sharded along ``data``; the result is replicated.
+    The compiled program is cached per (mesh, precision) so repeated fits
+    (the DataFrame path calls this once per ``fit()``) reuse the executable
+    instead of re-tracing a fresh closure each time.
     """
+    return _gram_stats_prog(mesh, precision)(x)
 
+
+@lru_cache(maxsize=None)
+def _moment_stats_prog(mesh: Mesh):
+    from spark_rapids_ml_tpu.ops import scaler as S
     from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
 
-    return mapreduce_data_axis(
-        lambda xl: L.gram_stats(xl, precision=precision), mesh
-    )(x)
+    return jax.jit(mapreduce_data_axis(S.moment_stats, mesh))
 
 
 def sharded_moment_stats(x: jax.Array, mesh: Mesh):
     """Data-parallel StandardScaler moments: local sums + psum over ICI."""
-    from spark_rapids_ml_tpu.ops import scaler as S
-    from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
-
-    return mapreduce_data_axis(S.moment_stats, mesh)(x)
+    return _moment_stats_prog(mesh)(x)
 
 
 def ring_gram(
@@ -71,6 +85,11 @@ def ring_gram(
     t computes XⱼᵀX₍ⱼ₊ₜ₎ — F·(C×C) MXU matmuls per device, F−1 neighbor
     transfers, zero host involvement.
     """
+    return _ring_gram_prog(mesh, precision)(x)
+
+
+@lru_cache(maxsize=None)
+def _ring_gram_prog(mesh: Mesh, precision):
     n_feat = mesh.shape[FEAT_AXIS]
 
     @partial(
@@ -105,7 +124,7 @@ def ring_gram(
         )
         return out, col_sum, count
 
-    return _ring(x)
+    return jax.jit(_ring)
 
 
 def distributed_pca_fit(
@@ -134,6 +153,7 @@ def distributed_pca_fit(
     return L.pca_fit_from_cov(cov, k, solver=solver)
 
 
+@lru_cache(maxsize=32)
 def make_distributed_fit(
     mesh: Mesh,
     k: int,
@@ -147,6 +167,7 @@ def make_distributed_fit(
     Inputs are constrained to the (data[, feat]) sharding; outputs are
     replicated (the model is small and every host needs it — same reason the
     reference collects U/S to the driver, RapidsRowMatrix.scala:86).
+    Cached per argument tuple so repeated fits share one executable.
     """
     in_spec = P(DATA_AXIS, FEAT_AXIS) if feature_sharded else P(DATA_AXIS, None)
     return jax.jit(

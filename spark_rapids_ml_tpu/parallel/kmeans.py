@@ -8,13 +8,28 @@ iteration, collectives on ICI, no host round-trip for the reduction.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.ops import kmeans as KM
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+
+@lru_cache(maxsize=None)
+def _kmeans_stats_prog(mesh: Mesh, block_rows: int):
+    from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
+
+    return jax.jit(
+        mapreduce_data_axis(
+            lambda xl, c: KM.kmeans_stats(
+                xl, c, block_rows=min(block_rows, xl.shape[0])
+            ),
+            mesh,
+            replicated_args=1,
+        )
+    )
 
 
 def sharded_kmeans_stats(
@@ -25,17 +40,9 @@ def sharded_kmeans_stats(
     block_rows: int = 8192,
 ) -> KM.KMeansStats:
     """One Lloyd accumulation pass over a data-sharded [rows, n] X; centers
-    replicated; replicated stats out."""
-
-    from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
-
-    return mapreduce_data_axis(
-        lambda xl, c: KM.kmeans_stats(
-            xl, c, block_rows=min(block_rows, xl.shape[0])
-        ),
-        mesh,
-        replicated_args=1,
-    )(x, centers)
+    replicated; replicated stats out. Compiled once per (mesh, block_rows) —
+    the estimator loop calls this every iteration."""
+    return _kmeans_stats_prog(mesh, block_rows)(x, centers)
 
 
 def distributed_lloyd_step(
@@ -46,6 +53,7 @@ def distributed_lloyd_step(
     return KM.update_centers(stats, centers), stats.cost
 
 
+@lru_cache(maxsize=None)
 def make_distributed_lloyd(mesh: Mesh):
     """jit the Lloyd step with shardings bound: X data-sharded, centers and
     outputs replicated."""
@@ -59,6 +67,7 @@ def make_distributed_lloyd(mesh: Mesh):
     )
 
 
+@lru_cache(maxsize=32)
 def make_distributed_kmeans_fit(
     mesh: Mesh, *, max_iter: int = 20, tol: float = 1e-4, block_rows: int = 8192
 ):

@@ -8,7 +8,7 @@ is ONE XLA program; for LogisticRegression each Newton iteration is one.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -18,15 +18,22 @@ from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
 
 
+@lru_cache(maxsize=None)
+def _linear_stats_prog(mesh: Mesh):
+    return jax.jit(
+        mapreduce_data_axis(
+            LIN.linear_stats,
+            mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        )
+    )
+
+
 def sharded_linear_stats(
     x: jax.Array, y: jax.Array, mesh: Mesh
 ) -> LIN.LinearStats:
     """LinearStats over data-sharded (X [rows, n], y [rows]); replicated out."""
-    return mapreduce_data_axis(
-        LIN.linear_stats,
-        mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
-    )(x, y)
+    return _linear_stats_prog(mesh)(x, y)
 
 
 def distributed_linreg_fit(
@@ -42,6 +49,7 @@ def distributed_linreg_fit(
     return LIN.solve_normal(stats, reg_param=reg_param, fit_intercept=fit_intercept)
 
 
+@lru_cache(maxsize=32)
 def make_distributed_linreg_fit(
     mesh: Mesh, *, reg_param: float = 0.0, fit_intercept: bool = True
 ):
@@ -61,15 +69,22 @@ def make_distributed_linreg_fit(
     )
 
 
+@lru_cache(maxsize=None)
+def _newton_stats_prog(mesh: Mesh):
+    return jax.jit(
+        mapreduce_data_axis(
+            LIN.logistic_newton_stats,
+            mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        )
+    )
+
+
 def sharded_newton_stats(
     x_aug: jax.Array, y: jax.Array, w_full: jax.Array, mesh: Mesh
 ) -> LIN.NewtonStats:
     """One logistic Newton statistics pass: X/y data-sharded, w replicated."""
-    return mapreduce_data_axis(
-        LIN.logistic_newton_stats,
-        mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
-    )(x_aug, y, w_full)
+    return _newton_stats_prog(mesh)(x_aug, y, w_full)
 
 
 def distributed_newton_step(
@@ -88,6 +103,7 @@ def distributed_newton_step(
     )
 
 
+@lru_cache(maxsize=32)
 def make_distributed_newton_step(
     mesh: Mesh, *, reg_param: float = 0.0, fit_intercept: bool = True
 ):
@@ -107,6 +123,7 @@ def make_distributed_newton_step(
     )
 
 
+@lru_cache(maxsize=32)
 def make_distributed_logreg_fit(
     mesh: Mesh,
     *,
@@ -171,6 +188,7 @@ def make_distributed_logreg_fit(
     )
 
 
+@lru_cache(maxsize=32)
 def make_distributed_softmax_fit(
     mesh: Mesh,
     n_classes: int,

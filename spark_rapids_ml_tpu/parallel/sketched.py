@@ -26,7 +26,7 @@ for flat spectra use more ``power_iters``/``oversample`` or the exact paths.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -208,6 +208,7 @@ def sharded_project(
     return _proj(*args)
 
 
+@lru_cache(maxsize=None)
 def make_sharded_project(mesh: Mesh, *, centered: bool = False):
     """jit-compile ``sharded_project`` with mesh shardings bound.
 
@@ -236,6 +237,7 @@ def make_sharded_project(mesh: Mesh, *, centered: bool = False):
     )
 
 
+@lru_cache(maxsize=32)
 def make_sketched_fit(
     mesh: Mesh,
     k: int,

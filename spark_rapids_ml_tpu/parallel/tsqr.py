@@ -18,7 +18,7 @@ driver round-trips.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -81,6 +81,11 @@ def tsqr_r(x: jax.Array, mesh: Mesh) -> jax.Array:
     one collective, O(D·n³) replicated compute (fine for the small-D case
     where the butterfly doesn't apply).
     """
+    return _tsqr_r_prog(mesh)(x)
+
+
+@lru_cache(maxsize=None)
+def _tsqr_r_prog(mesh: Mesh):
     n_data = mesh.shape[DATA_AXIS]
 
     @partial(
@@ -93,7 +98,7 @@ def tsqr_r(x: jax.Array, mesh: Mesh) -> jax.Array:
     def _tsqr(xl):
         return merge_r(L.qr_r(xl), n_data)
 
-    return _tsqr(x)
+    return jax.jit(_tsqr)
 
 
 def distributed_pca_fit_svd(
@@ -127,6 +132,7 @@ def distributed_pca_fit_svd(
     return L.svd_from_r(r, k)
 
 
+@lru_cache(maxsize=32)
 def make_distributed_fit_svd(mesh: Mesh, k: int, *, mean_centering: bool = False):
     """jit-compile ``distributed_pca_fit_svd`` with mesh shardings bound."""
     return jax.jit(
@@ -138,6 +144,7 @@ def make_distributed_fit_svd(mesh: Mesh, k: int, *, mean_centering: bool = False
     )
 
 
+@lru_cache(maxsize=32)
 def make_distributed_fit_svd_masked(
     mesh: Mesh, k: int, *, mean_centering: bool = False
 ):

@@ -65,30 +65,42 @@ def _gram_shapes(n: int) -> dict[str, tuple]:
 # ---------------------------------------------------------------------------
 
 
+def _worker_jax():
+    """Worker-side jax import hook: also points the fresh interpreter at the
+    persistent XLA compilation cache, so per-job worker processes don't pay
+    a cold compile on every fit."""
+    import jax
+
+    from spark_rapids_ml_tpu.utils.config import enable_compilation_cache
+
+    enable_compilation_cache()
+    return jax
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_gram_stats():
-    import jax
+    jax = _worker_jax()
 
     return jax.jit(L.gram_stats, static_argnames=("precision",))
 
 
 @functools.lru_cache(maxsize=None)
 def _jitted_project():
-    import jax
+    jax = _worker_jax()
 
     return jax.jit(L.project)
 
 
 @functools.lru_cache(maxsize=None)
 def _jitted_qr_r():
-    import jax
+    jax = _worker_jax()
 
     return jax.jit(L.qr_r)
 
 
 @functools.lru_cache(maxsize=None)
 def _jitted_combine_r():
-    import jax
+    jax = _worker_jax()
 
     return jax.jit(L.combine_r)
 

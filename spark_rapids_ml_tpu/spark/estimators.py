@@ -65,6 +65,9 @@ def _sql_mods(dataset):
     for a pyspark DataFrame, localspark's for the no-JVM engine. All plan
     construction below goes through this pair, so the two backends run the
     SAME estimator code."""
+    from spark_rapids_ml_tpu.utils.config import enable_compilation_cache
+
+    enable_compilation_cache()  # every Spark-path entry is compile-heavy
     mod = type(dataset).__module__ or ""
     if mod.startswith("pyspark."):
         _require_pyspark()

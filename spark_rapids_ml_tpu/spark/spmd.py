@@ -216,6 +216,11 @@ class _MeshReducePartitionFn:
         # docstring, point 3) — fresh barrier workers guarantee it.
         import jax
 
+        from spark_rapids_ml_tpu.utils.config import enable_compilation_cache
+
+        enable_compilation_cache()  # barrier workers are fresh interpreters:
+        # without the persistent XLA cache every barrier fit pays a cold
+        # compile of the whole SPMD program
         jax.distributed.initialize(
             coordinator_address=coord, num_processes=size, process_id=rank
         )

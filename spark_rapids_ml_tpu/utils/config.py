@@ -13,6 +13,13 @@ module is tier 2 for the TPU build — process-level knobs read from
   (the ``spark.task.maxFailures`` analog).
 - ``TPU_ML_DEFAULT_PRECISION`` ('highest'|'high'|'default') — estimator-level
   default for the Gram/projection matmul precision.
+- ``TPU_ML_COMPILE_CACHE``   (path, default ``~/.cache/spark_rapids_ml_tpu/
+  xla``; empty string disables) — persistent XLA compilation cache shared by
+  every process of a deployment. In-process executable reuse is handled by
+  the ``lru_cache``d program builders in ``parallel/``; this cache is what
+  saves the barrier-stage/executor WORKER processes (fresh interpreter per
+  job) and repeated driver runs from paying the multi-second XLA compile on
+  every fit.
 """
 
 from __future__ import annotations
@@ -51,6 +58,56 @@ class RuntimeConfig:
 
 
 _config: RuntimeConfig | None = None
+_compile_cache_enabled = False
+
+
+def enable_compilation_cache() -> str | None:
+    """Point JAX at the persistent XLA compilation cache (idempotent).
+
+    Returns the cache directory, or None when disabled
+    (``TPU_ML_COMPILE_CACHE=''``) or when this JAX build rejects the
+    options. Safe to call before or after backend initialization; callers
+    invoke it lazily right before the first compile-heavy path (estimator
+    fits, SPMD workers) so importing the package stays side-effect free.
+    """
+    global _compile_cache_enabled
+    cache_dir = os.environ.get(
+        "TPU_ML_COMPILE_CACHE",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "spark_rapids_ml_tpu", "xla"
+        ),
+    )
+    if not cache_dir:
+        return None
+    if _compile_cache_enabled:
+        return cache_dir
+    try:
+        import jax
+
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            # an embedding application (or the test harness) already chose a
+            # cache location — respect it
+            _compile_cache_enabled = True
+            return jax.config.jax_compilation_cache_dir
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except (ImportError, OSError, AttributeError, ValueError):
+        return None
+    _compile_cache_enabled = True
+    # Tuning knobs are best-effort per-knob: a JAX build that lacks or
+    # rejects one must not leave the just-applied cache dir looking like an
+    # external choice on the next call (half-applied-state trap).
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.5),
+        # cache regardless of backend: the CPU fallback deployments (worker
+        # ingestion processes, tests) recompile just as painfully
+        ("jax_persistent_cache_enable_xla_caches", "all"),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except (AttributeError, ValueError):
+            pass
+    return cache_dir
 
 
 def get_config() -> RuntimeConfig:
