@@ -210,9 +210,7 @@ class IncrementalStandardScaler(StandardScaler):
     """StandardScaler fitted by streaming batches."""
 
     def __init__(self, uid: str | None = None, **kwargs):
-        super().__init__(uid)
-        if kwargs:
-            self._set(**{k: v for k, v in kwargs.items() if v is not None})
+        super().__init__(uid, **kwargs)
         self._acc = None
         self._n_cols: int | None = None
 

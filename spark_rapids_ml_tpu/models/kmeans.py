@@ -74,8 +74,8 @@ class _KMeansParams(HasInputCol, HasOutputCol):
         str,
     )
 
-    def __init__(self, uid: str | None = None):
-        super().__init__(uid)
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
         self._setDefault(
             maxIter=20, tol=1e-4, seed=0, initMode="k-means++", initSteps=2,
             outputCol="prediction",

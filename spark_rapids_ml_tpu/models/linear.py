@@ -63,8 +63,8 @@ class _SupervisedParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         str,
     )
 
-    def __init__(self, uid: str | None = None):
-        super().__init__(uid)
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
         self._setDefault(
             featuresCol="features",
             labelCol="label",
@@ -246,8 +246,8 @@ class LogisticRegression(_SupervisedParams, Estimator):
     maxIter = Param("maxIter", "maximum Newton iterations", int)
     tol = Param("tol", "convergence tolerance on the Newton step norm", float)
 
-    def __init__(self, uid: str | None = None):
-        super().__init__(uid)
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
         self._setDefault(maxIter=25, tol=1e-6)
 
     def setMaxIter(self, value: int):

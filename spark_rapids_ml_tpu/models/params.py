@@ -38,10 +38,23 @@ class Params:
     identity, and ``uid`` identifies instances across save/load.
     """
 
-    def __init__(self, uid: str | None = None):
+    def __init__(self, uid: str | None = None, **kwargs):
         self.uid = uid or f"{type(self).__name__}_{uuid.uuid4().hex[:12]}"
         self._paramMap: dict[str, Any] = {}
         self._defaultParamMap: dict[str, Any] = {}
+        # pyspark.ml-style constructor params: PCA(k=3) == PCA().setK(3).
+        # Values route through the fluent setter when the class defines one,
+        # so setter-side validation (setInitMode's allowed values, ...) holds
+        # for both spellings; None means "leave unset", as in pyspark.
+        for name, value in kwargs.items():
+            if value is None:
+                continue
+            self._param(name)  # unknown params raise KeyError
+            setter = getattr(self, f"set{name[0].upper()}{name[1:]}", None)
+            if callable(setter):
+                setter(value)
+            else:
+                self._set(**{name: value})
 
     # -- param discovery ----------------------------------------------------
     @classmethod

@@ -31,8 +31,8 @@ class _ScalerParams(HasInputCol, HasOutputCol):
     withMean = Param("withMean", "center features before scaling", bool)
     withStd = Param("withStd", "scale features to unit sample std", bool)
 
-    def __init__(self, uid: str | None = None):
-        super().__init__(uid)
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
         self._setDefault(withMean=False, withStd=True, outputCol="scaled_features")
 
     def getWithMean(self) -> bool:
@@ -156,8 +156,8 @@ class Normalizer(HasInputCol, HasOutputCol, Transformer):
 
     p = Param("p", "norm order (p >= 1; inf supported)", float)
 
-    def __init__(self, uid: str | None = None):
-        super().__init__(uid)
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
         self._setDefault(p=2.0, outputCol="normalized_features")
 
     def setP(self, value: float) -> "Normalizer":

@@ -47,8 +47,8 @@ class TruncatedSVDParams(HasInputCol, HasOutputCol):
         str,
     )
 
-    def __init__(self, uid: str | None = None):
-        super().__init__(uid)
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
         from spark_rapids_ml_tpu.utils.config import get_config
 
         self._setDefault(
@@ -96,9 +96,7 @@ class TruncatedSVD(TruncatedSVDParams, Estimator):
     """
 
     def __init__(self, uid: str | None = None, **kwargs):
-        super().__init__(uid)
-        if kwargs:
-            self._set(**{k: v for k, v in kwargs.items() if v is not None})
+        super().__init__(uid, **kwargs)
 
     def setK(self, value: int) -> "TruncatedSVD":
         return self._set(k=value)

@@ -214,10 +214,8 @@ class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
     metricName = Param("metricName", "rmse|mse|mae|r2", str)
 
     def __init__(self, uid: str | None = None, **kwargs):
-        super().__init__(uid)
+        super().__init__(uid, **kwargs)
         self._setDefault(metricName="rmse", labelCol="label", predictionCol="prediction")
-        if kwargs:
-            self._set(**{k: v for k, v in kwargs.items() if v is not None})
 
     def setMetricName(self, value: str) -> "RegressionEvaluator":
         if value not in ("rmse", "mse", "mae", "r2"):
@@ -247,12 +245,10 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
     metricName = Param("metricName", "areaUnderROC|accuracy", str)
 
     def __init__(self, uid: str | None = None, **kwargs):
-        super().__init__(uid)
+        super().__init__(uid, **kwargs)
         self._setDefault(
             metricName="areaUnderROC", labelCol="label", predictionCol="prediction"
         )
-        if kwargs:
-            self._set(**{k: v for k, v in kwargs.items() if v is not None})
 
     def setMetricName(self, value: str) -> "BinaryClassificationEvaluator":
         if value not in ("areaUnderROC", "accuracy"):
@@ -292,10 +288,8 @@ class ClusteringEvaluator(Evaluator):
     maxRows = Param("maxRows", "subsample cap for the pairwise pass", int)
 
     def __init__(self, uid: str | None = None, **kwargs):
-        super().__init__(uid)
+        super().__init__(uid, **kwargs)
         self._setDefault(featuresCol="features", predictionCol="prediction", maxRows=2048)
-        if kwargs:
-            self._set(**{k: v for k, v in kwargs.items() if v is not None})
 
     def evaluate(self, dataset, predictions=None) -> float:
         feats = self.getOrDefault("featuresCol")

@@ -67,10 +67,11 @@ class TestFit:
         with pytest.raises(ValueError):
             TruncatedSVD().setSolver("eig")
 
-    def test_bad_solver_via_kwargs_raises_in_fit(self, x):
-        # constructor kwargs bypass setSolver validation; fit must still fail
-        with pytest.raises(ValueError, match="unknown solver"):
-            TruncatedSVD(solver="full").setInputCol("f").setK(2).fit(x)
+    def test_bad_solver_via_kwargs_rejected_at_ctor(self):
+        # constructor kwargs route through setSolver, so validation happens
+        # at construction time — same contract as the fluent setter
+        with pytest.raises(ValueError, match="solver"):
+            TruncatedSVD(solver="full")
 
 
 class TestModel:
