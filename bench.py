@@ -58,6 +58,13 @@ DF_N = 256
 
 
 def main() -> None:
+    # Bounded first-touch probe: if the accelerator transport is wedged,
+    # fail in 120s with a diagnosable error instead of hanging the whole
+    # bench pipeline indefinitely (utils/devicepolicy.py rationale).
+    from spark_rapids_ml_tpu.utils import devicepolicy
+
+    devicepolicy.probe_platform(expected=None, timeout=120.0)
+
     import jax
     import jax.numpy as jnp
     from jax import lax

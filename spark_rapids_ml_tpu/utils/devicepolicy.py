@@ -107,8 +107,61 @@ class DevicePolicyError(RuntimeError):
     """The worker process could not honor its assigned device platform."""
 
 
+def use_platform(platform: str, *, probe_timeout: float | None = None) -> str:
+    """Driver-side platform selection that actually wins, with a bounded
+    first-touch probe.
+
+    ``JAX_PLATFORMS=<p>`` in the environment is NOT a reliable way for the
+    *driver* process to choose its backend: an interpreter-start bootstrap
+    (sitecustomize/.pth) can call ``jax.config.update("jax_platforms", ...)``
+    AFTER the env var was read, silently overriding it — observed in
+    practice: a driver that asked for ``cpu`` still dialed the accelerator
+    plugin at its first ``device_put`` and, when the device transport was
+    unhealthy, hung indefinitely rather than erroring. This helper is the
+    in-process counterpart of the worker-side scrub+probe:
+
+    1. re-asserts ``jax.config.update("jax_platforms", platform)`` — an
+       explicit late update wins over any interpreter-start hook;
+    2. runs the bounded :func:`probe_platform` so a wedged transport
+       surfaces as a diagnosable :class:`DevicePolicyError` within
+       ``probe_timeout`` seconds instead of an unbounded hang;
+    3. if a backend was ALREADY initialized on the wrong platform (the
+       bootstrap dialed at interpreter start, or this is a late call) —
+       where the config update alone is a no-op — it drops the stale
+       backend set via ``jax.extend.backend.clear_backends`` and probes
+       once more. Arrays created before the switch stay on their original
+       client.
+
+    Returns the platform of ``jax.devices()[0]``. For a comma fallback
+    list ("axon,cpu") any entry may legitimately win and plugins may
+    canonicalize device ``.platform`` differently, so only single-platform
+    requests pin the probe's expected name.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    expected = platform if "," not in platform else None
+    try:
+        return probe_platform(expected=expected, timeout=probe_timeout)
+    except DevicePolicyError as first_err:
+        try:
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+        except Exception:  # noqa: BLE001 - keep the original diagnosis
+            raise first_err from None
+        return probe_platform(expected=expected, timeout=probe_timeout)
+
+
+# Default sentinel for probe_platform's ``expected``: resolve from the
+# TPU_ML_WORKER_PLATFORM env contract. Pass ``expected=None`` to accept
+# whatever platform initializes (bounded-time init check only) — an env
+# var must not be able to re-enable the check the caller opted out of.
+FROM_ENV = object()
+
+
 def probe_platform(
-    expected: str | None = None, timeout: float | None = None
+    expected: object = FROM_ENV, timeout: float | None = None
 ) -> str:
     """Initialize JAX and verify the backend platform, in bounded time.
 
@@ -121,12 +174,13 @@ def probe_platform(
     - JAX initialization raised;
     - the initialized platform differs from ``expected``.
 
-    Returns the platform name on success. ``expected``/``timeout`` default
-    from the TPU_ML_WORKER_* env contract.
+    Returns the platform name on success. ``expected`` defaults from the
+    TPU_ML_WORKER_PLATFORM env var (:data:`FROM_ENV`); ``None`` means any
+    platform is acceptable. ``timeout`` defaults from the env contract.
     """
     import threading
 
-    if expected is None:
+    if expected is FROM_ENV:
         expected = os.environ.get(PLATFORM_VAR) or None
     if timeout is None:
         raw = os.environ.get(PROBE_TIMEOUT_VAR, str(DEFAULT_PROBE_TIMEOUT))
@@ -153,23 +207,30 @@ def probe_platform(
         raise DevicePolicyError(
             f"device probe did not complete within {timeout}s: JAX backend "
             "initialization is blocked — most likely an accelerator plugin "
-            "registered at interpreter start is waiting for a device another "
-            "process owns. Scrub the bootstrap variables from the worker "
-            f"environment (see devicepolicy.ACCELERATOR_BOOTSTRAP_VARS / "
-            f"TPU_ML_WORKER_SCRUB_VARS) or raise {PROBE_TIMEOUT_VAR}."
+            "registered at interpreter start is waiting on a device grant "
+            "another process owns, or the device transport is unhealthy. "
+            "In a worker process: scrub the bootstrap variables from its "
+            "environment (devicepolicy.ACCELERATOR_BOOTSTRAP_VARS / "
+            "TPU_ML_WORKER_SCRUB_VARS). In a driver process: check device "
+            "health, or select a working platform via "
+            "devicepolicy.use_platform(). To wait longer, pass a larger "
+            f"timeout (workers: the {PROBE_TIMEOUT_VAR} env var)."
         )
     if "error" in result:
         raise DevicePolicyError(
-            f"JAX failed to initialize in the worker: {result['error']}"
+            f"JAX failed to initialize in this process: {result['error']}"
         )
     platform = result.get("platform", "<unknown>")
     if expected is not None and platform != expected:
         raise DevicePolicyError(
-            f"worker was assigned platform {expected!r} but JAX initialized "
-            f"{platform!r}. Under the one-device-owner-per-host policy the "
-            "driver owns the accelerator and workers must run on CPU; a "
-            "site-level bootstrap overrode the worker's JAX_PLATFORMS. "
-            "Remove the bootstrap trigger from the worker environment or run "
-            "the session with worker_platform=None to hand workers the device."
+            f"this process was assigned platform {expected!r} but JAX "
+            f"initialized {platform!r} — an interpreter-start bootstrap "
+            "overrode the platform choice, or a backend was already "
+            "initialized. In a worker under the one-device-owner-per-host "
+            "policy: remove the bootstrap trigger from the worker "
+            "environment, or run the session with worker_platform=None to "
+            "hand workers the device. In a driver: select the platform via "
+            "devicepolicy.use_platform(), which also swaps an "
+            "already-initialized backend."
         )
     return platform

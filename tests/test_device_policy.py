@@ -127,3 +127,41 @@ def test_probe_timeout_fails_fast():
         assert "did not complete within" in str(err.value)
     finally:
         session.stop()
+
+
+def test_use_platform_wins_and_probes():
+    # use_platform must (a) win over any interpreter-start hook by issuing a
+    # late jax.config.update, (b) bounded-probe, (c) return the platform
+    from spark_rapids_ml_tpu.utils import devicepolicy
+
+    assert devicepolicy.use_platform("cpu", probe_timeout=30) == "cpu"
+    import jax
+
+    assert jax.devices()[0].platform == "cpu"
+
+
+def test_use_platform_mismatch_raises():
+    import jax
+    import pytest
+
+    from spark_rapids_ml_tpu.utils import devicepolicy
+
+    try:
+        with pytest.raises(devicepolicy.DevicePolicyError):
+            # the CPU backend is already initialized: the first probe sees
+            # the platform mismatch, use_platform clears the stale backend
+            # set and re-probes, and the re-init with an unknown platform
+            # fails — a DevicePolicyError either way, never a hang
+            devicepolicy.use_platform("nonexistent_platform", probe_timeout=30)
+    finally:
+        jax.config.update("jax_platforms", "cpu")  # restore for later tests
+
+
+def test_probe_platform_none_accepts_any(monkeypatch):
+    # expected=None must mean "any platform is fine" even when the worker
+    # env contract var is present — an env var must not re-enable a check
+    # the caller explicitly opted out of
+    from spark_rapids_ml_tpu.utils import devicepolicy
+
+    monkeypatch.setenv(devicepolicy.PLATFORM_VAR, "tpu")
+    assert devicepolicy.probe_platform(expected=None, timeout=30) == "cpu"
