@@ -33,6 +33,13 @@ accuracy claim an artifact, not a comment):
   host oracle on a 200k×512 slice, executed on the real chip every round;
   ``accuracy_ok`` records the ≥0.9999 north-star bar (BASELINE.md); a miss
   also exits non-zero AFTER emitting the JSON line, so pipelines gate on it.
+- ``kmeans_lloyd_rows_per_s``: BASELINE config-5 proxy (the stretch
+  estimator: 50M×128 k=1000 scaled to one chip's HBM) — device rows/s of
+  one full Lloyd iteration (blocked pairwise distances + argmin + the
+  KMeansStats monoid) at 4M×128, k=1000, f32. The blocked kernel turns
+  the distance matrix into [block,128]×[128,1000] MXU matmuls
+  (ops/kmeans.py), so this measures the same roofline the RAFT
+  pairwise-distance kernel chases on the A100.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
 comparison point is the north-star proxy: an A100 running the RAFT f64 path
@@ -55,6 +62,9 @@ PAIRS = 5
 ACCURACY_ROWS = 200_000
 DF_ROWS = 100_000
 DF_N = 256
+KM_ROWS = 4_000_000
+KM_N = 128
+KM_K = 1000
 
 
 def main() -> None:
@@ -159,6 +169,50 @@ def main() -> None:
         tr_slopes.append((t_l - t_s) / 10)
     transform_rows_per_s = ROWS / statistics.median(tr_slopes)
 
+    # --- config-5 proxy: KMeans Lloyd iteration throughput ----------------
+    # chained REAL Lloyd iterations (update_centers feeds the next step's
+    # centers) so XLA can neither hoist nor elide any iteration; slope
+    # between chain lengths removes dispatch latency like the fit metric.
+    from spark_rapids_ml_tpu.ops import kmeans as KM
+
+    @jax.jit
+    def make_km_data(seed):
+        kb, kc = jax.random.split(jax.random.PRNGKey(seed))
+        pts = jax.random.normal(kb, (KM_ROWS, KM_N), jnp.float32)
+        # pull rows toward KM_K anchor points for a realistic cluster shape
+        anchors = 4.0 * jax.random.normal(kc, (KM_K, KM_N), jnp.float32)
+        return pts + anchors[jnp.arange(KM_ROWS) % KM_K]
+
+    xk = make_km_data(11)
+    centers0 = xk[:: KM_ROWS // KM_K][:KM_K]
+    w = jnp.ones((KM_ROWS,), jnp.float32)
+
+    def make_lloyd_chain(n_iter):
+        @jax.jit
+        def f(a, c0):
+            def step(c, _):
+                stats = KM.kmeans_stats(a, c, w)
+                return KM.update_centers(stats, c), stats.cost
+
+            c, costs = lax.scan(step, c0, None, length=n_iter)
+            return jnp.sum(c) + jnp.sum(costs)
+
+        return f
+
+    km_short, km_long = make_lloyd_chain(1), make_lloyd_chain(4)
+    float(km_short(xk, centers0)), float(km_long(xk, centers0))  # warm up
+    km_slopes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(km_short(xk, centers0))
+        t_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(km_long(xk, centers0))
+        t_l = time.perf_counter() - t0
+        km_slopes.append((t_l - t_s) / 3)
+    kmeans_rows_per_s = KM_ROWS / statistics.median(km_slopes)
+    del xk  # free ~2 GB of HBM before the accuracy pass
+
     # --- accuracy: bench program vs f64 host oracle, on THIS chip ---------
     min_cosine = L.min_cosine_vs_f64_oracle(
         x[:ACCURACY_ROWS], jax.jit(fit_pca)(x[:ACCURACY_ROWS])[0], K
@@ -194,6 +248,16 @@ def main() -> None:
                         "unit": "seconds",
                         "note": "localspark mesh-local: ingestion + worker "
                         "hop + Arrow collect + device Gram",
+                    },
+                    {
+                        "metric": (
+                            f"kmeans_lloyd_rows_per_s_{KM_N}f_k{KM_K}"
+                        ),
+                        "value": round(kmeans_rows_per_s),
+                        "unit": "rows/s",
+                        "note": "BASELINE config-5 proxy (one full device "
+                        "Lloyd iteration: blocked MXU distances + argmin + "
+                        "stats monoid)",
                     },
                     {
                         "metric": f"eigvec_min_cosine_vs_f64_oracle_{ACCURACY_ROWS}x{N}",

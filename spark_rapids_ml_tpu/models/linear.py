@@ -36,6 +36,13 @@ import jax.numpy as jnp
 
 _linear_stats = jax.jit(LIN.linear_stats)
 _solve_normal = jax.jit(LIN.solve_normal, static_argnames=("fit_intercept",))
+# elastic_net_param is static (it picks the closed-form vs FISTA branch);
+# reg_param/max_iter/tol stay traced so a CV sweep over λ reuses ONE
+# compiled program instead of recompiling per candidate value
+_solve_from_stats = jax.jit(
+    LIN.solve_from_stats,
+    static_argnames=("elastic_net_param", "fit_intercept"),
+)
 _newton_stats = jax.jit(LIN.logistic_newton_stats)
 _newton_update = jax.jit(LIN.newton_update, static_argnames=("fit_intercept",))
 _predict_linear = jax.jit(LIN.predict_linear)
@@ -144,13 +151,72 @@ class _GLMModel(_SupervisedParams, Model):
 # ---------------------------------------------------------------------------
 
 
-class LinearRegression(_SupervisedParams, Estimator):
-    """Closed-form (normal equations) least squares with optional L2.
+class _ElasticNetParams:
+    """elasticNetParam/maxIter/tol — shared by LinearRegression AND its
+    model (so a fitted model carries + persists the solver params, the
+    Spark ML estimator/model param-mirroring pattern)."""
+
+    elasticNetParam = Param(
+        "elasticNetParam",
+        "elastic-net mixing α in [0, 1]: 0 = pure L2 (closed form), "
+        "1 = lasso; the L1 solve is FISTA over the reduced statistics",
+        float,
+    )
+    maxIter = Param("maxIter", "maximum FISTA iterations (α > 0 only)", int)
+    tol = Param(
+        "tol",
+        "FISTA convergence tolerance on the relative coefficient change",
+        float,
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(elasticNetParam=0.0, maxIter=500, tol=1e-8)
+
+    def setElasticNetParam(self, value: float):
+        if not 0.0 <= value <= 1.0:
+            raise ValueError(f"elasticNetParam must be in [0, 1], got {value}")
+        return self._set(elasticNetParam=float(value))
+
+    def getElasticNetParam(self) -> float:
+        return self.getOrDefault("elasticNetParam")
+
+    def setMaxIter(self, value: int):
+        return self._set(maxIter=value)
+
+    def getMaxIter(self) -> int:
+        return self.getOrDefault("maxIter")
+
+    def setTol(self, value: float):
+        return self._set(tol=value)
+
+    def getTol(self) -> float:
+        return self.getOrDefault("tol")
+
+
+class LinearRegression(_ElasticNetParams, _SupervisedParams, Estimator):
+    """Least squares with optional L2 / L1 / elastic-net regularization.
 
     One MXU pass builds the (XᵀX, Xᵀy, …) monoid per partition; the [n, n]
-    solve runs once on the reduced statistics. λ scales with the row count,
-    so results match ``sklearn.linear_model.Ridge(alpha=regParam·rows)``.
+    solve runs once on the reduced statistics. With ``elasticNetParam=0``
+    (default) the solve is the closed-form normal equations and λ scales
+    with the row count (matches ``sklearn.linear_model.Ridge(
+    alpha=regParam·rows)``). With ``elasticNetParam=α>0`` the solve is
+    FISTA on the same reduced statistics (``ops.linear.solve_elastic_net``)
+    — still ONE distributed data pass, zero per-iteration communication —
+    matching ``sklearn.linear_model.ElasticNet(alpha=regParam,
+    l1_ratio=α)`` / Spark ML's (regParam, elasticNetParam) convention.
     """
+
+    def _solve_args(self) -> dict:
+        """Solver kwargs shared by every data path (core/Spark/mesh)."""
+        return dict(
+            reg_param=self.getRegParam(),
+            elastic_net_param=self.getElasticNetParam(),
+            fit_intercept=self.getFitIntercept(),
+            max_iter=self.getMaxIter(),
+            tol=self.getTol(),
+        )
 
     def fit(
         self, dataset: Any, num_partitions: int | None = None
@@ -166,11 +232,7 @@ class LinearRegression(_SupervisedParams, Estimator):
             partials = run_partition_tasks(task, parts)
             stats = tree_reduce(partials, LIN.combine_linear_stats)
         with trace_range("linreg solve"):
-            coef, intercept = _solve_normal(
-                stats,
-                reg_param=self.getRegParam(),
-                fit_intercept=self.getFitIntercept(),
-            )
+            coef, intercept = _solve_from_stats(stats, **self._solve_args())
         model = LinearRegressionModel(
             uid=self.uid,
             coefficients=np.asarray(coef),
@@ -179,7 +241,7 @@ class LinearRegression(_SupervisedParams, Estimator):
         return self._copyValues(model)
 
 
-class LinearRegressionModel(_GLMModel):
+class LinearRegressionModel(_ElasticNetParams, _GLMModel):
     def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
         padded, true_rows = columnar.pad_rows(mat)
         xd = jnp.asarray(padded)

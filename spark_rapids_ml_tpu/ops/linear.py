@@ -26,6 +26,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from spark_rapids_ml_tpu.ops.linalg import DEFAULT_PRECISION
 
@@ -115,6 +116,135 @@ def solve_normal(
         else jnp.zeros((), coef.dtype)
     )
     return coef, intercept
+
+
+def solve_elastic_net(
+    stats: LinearStats,
+    *,
+    reg_param: float,
+    elastic_net_param: float,
+    fit_intercept: bool = True,
+    max_iter: int = 500,
+    tol: float = 1e-8,
+) -> tuple[jax.Array, jax.Array]:
+    """(coefficients [n], intercept []) for the elastic-net objective, from
+    the SAME reduced statistics as the closed-form path.
+
+    Objective (Spark ML's convention, regParam=λ, elasticNetParam=α):
+
+        1/(2m)·‖y − Xw − b₀‖² + λ·(α‖w‖₁ + (1−α)/2·‖w‖²)
+
+    equivalently ``sklearn.linear_model.ElasticNet(alpha=λ, l1_ratio=α)``.
+    (Contrast with :func:`solve_normal`'s pure-L2, where the repo matches
+    ``Ridge(alpha=λ·m)`` — both are the Spark convention; Ridge's sklearn
+    loss is unnormalized, ElasticNet's is 1/(2m)-normalized.)
+
+    The L1 term has no closed form, but it does NOT need another data pass:
+    the smooth gradient is (Aw − b)/m + λ(1−α)w with A/b the centered
+    second moments already reduced over the cluster, so the whole FISTA
+    loop (accelerated proximal gradient, Beck & Teboulle) runs replicated
+    on the tiny [n, n] problem — one distributed statistics pass, zero
+    per-iteration communication. The step size is 1/L with
+    L = λmax(A)/m + λ(1−α) from a fixed power-iteration loop; everything is
+    one jittable ``lax.while_loop`` (no data-dependent Python control flow).
+
+    Not implemented in the reference family at all; pyspark.ml gets it via
+    breeze OWL-QN over full data passes per iteration.
+    """
+    if not 0.0 <= elastic_net_param <= 1.0:
+        raise ValueError(
+            f"elastic_net_param must be in [0, 1], got {elastic_net_param}"
+        )
+    m = jnp.maximum(stats.count, jnp.ones_like(stats.count))
+    n = stats.xtx.shape[0]
+    if fit_intercept:
+        mu = stats.x_sum / m
+        ybar = stats.y_sum / m
+        a = stats.xtx - m * jnp.outer(mu, mu)
+        b = stats.xty - m * mu * ybar
+    else:
+        a = stats.xtx
+        b = stats.xty
+    lam1 = reg_param * elastic_net_param
+    lam2 = reg_param * (1.0 - elastic_net_param)
+
+    # Lipschitz constant of the smooth part: λmax(A)/m + λ₂ via power
+    # iteration (d-sized matvecs; 32 rounds is plenty for a step size).
+    def power_body(_, v):
+        v = a @ v
+        return v / jnp.maximum(jnp.linalg.norm(v), 1e-30)
+
+    v0 = jnp.ones((n,), a.dtype) / jnp.sqrt(jnp.asarray(n, a.dtype))
+    v = lax.fori_loop(0, 32, power_body, v0)
+    ray = jnp.vdot(v, a @ v)  # Rayleigh estimate of λmax(A)
+    # λmax ≥ trace/n always holds for PSD A, so a Rayleigh estimate below
+    # that means the power iteration collapsed (v0 happened to be ⊥ the
+    # range of A — e.g. exactly-cancelling column pairs zero out A·1).
+    # Fall back to trace(A), a valid PSD upper bound on λmax: a smaller
+    # step, never a divergent one (an underestimated L makes FISTA blow
+    # up silently to ±inf).
+    tr = jnp.trace(a)
+    lam_max = jnp.where(ray >= tr / n, ray, tr)
+    lip = lam_max / m + lam2
+    eta = 1.0 / jnp.maximum(lip, 1e-30)
+
+    def soft(v, thresh):
+        return jnp.sign(v) * jnp.maximum(jnp.abs(v) - thresh, 0.0)
+
+    def grad(w):
+        return (a @ w - b) / m + lam2 * w
+
+    def cond(carry):
+        _, _, _, it, delta = carry
+        return (it < max_iter) & (delta > tol)
+
+    def body(carry):
+        w, z, t, it, _ = carry
+        w_new = soft(z - eta * grad(z), eta * lam1)
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        z_new = w_new + ((t - 1.0) / t_new) * (w_new - w)
+        delta = jnp.max(jnp.abs(w_new - w)) / jnp.maximum(
+            jnp.max(jnp.abs(w_new)), 1e-12
+        )
+        return w_new, z_new, t_new, it + 1, delta
+
+    w0 = jnp.zeros((n,), a.dtype)
+    init = (w0, w0, jnp.ones((), a.dtype), jnp.int32(0), jnp.asarray(jnp.inf, a.dtype))
+    coef, _, _, _, _ = lax.while_loop(cond, body, init)
+    intercept = (
+        stats.y_sum / m - jnp.dot(stats.x_sum / m, coef)
+        if fit_intercept
+        else jnp.zeros((), coef.dtype)
+    )
+    return coef, intercept
+
+
+def solve_from_stats(
+    stats: LinearStats,
+    *,
+    reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 500,
+    tol: float = 1e-8,
+) -> tuple[jax.Array, jax.Array]:
+    """Dispatch the linear solve on the reduced statistics: closed-form
+    normal equations for pure L2 (α=0), FISTA for any L1 mixture. Every
+    data path (core partitions, Spark driver-merge, barrier mesh, in-core
+    mesh) funnels through here, so elastic net works on all of them from
+    the same one-pass monoid."""
+    if elastic_net_param == 0.0:
+        return solve_normal(
+            stats, reg_param=reg_param, fit_intercept=fit_intercept
+        )
+    return solve_elastic_net(
+        stats,
+        reg_param=reg_param,
+        elastic_net_param=elastic_net_param,
+        fit_intercept=fit_intercept,
+        max_iter=max_iter,
+        tol=tol,
+    )
 
 
 def predict_linear(

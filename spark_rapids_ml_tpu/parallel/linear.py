@@ -42,16 +42,38 @@ def distributed_linreg_fit(
     mesh: Mesh,
     *,
     reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
     fit_intercept: bool = True,
+    max_iter: int = 500,
+    tol: float = 1e-8,
 ) -> tuple[jax.Array, jax.Array]:
-    """Full distributed least-squares fit: (coefficients, intercept)."""
+    """Full distributed least-squares / elastic-net fit: (coef, intercept).
+
+    The statistics pass is the sharded psum either way; α>0 only changes
+    the replicated solve (FISTA on the reduced moments, honoring
+    ``max_iter``/``tol`` like the host paths) — no extra collectives, no
+    extra data passes.
+    """
     stats = sharded_linear_stats(x, y, mesh)
-    return LIN.solve_normal(stats, reg_param=reg_param, fit_intercept=fit_intercept)
+    return LIN.solve_from_stats(
+        stats,
+        reg_param=reg_param,
+        elastic_net_param=elastic_net_param,
+        fit_intercept=fit_intercept,
+        max_iter=max_iter,
+        tol=tol,
+    )
 
 
 @lru_cache(maxsize=32)
 def make_distributed_linreg_fit(
-    mesh: Mesh, *, reg_param: float = 0.0, fit_intercept: bool = True
+    mesh: Mesh,
+    *,
+    reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 500,
+    tol: float = 1e-8,
 ):
     """jit with shardings bound: X/y data-sharded, outputs replicated."""
     return jax.jit(
@@ -59,7 +81,10 @@ def make_distributed_linreg_fit(
             distributed_linreg_fit,
             mesh=mesh,
             reg_param=reg_param,
+            elastic_net_param=elastic_net_param,
             fit_intercept=fit_intercept,
+            max_iter=max_iter,
+            tol=tol,
         ),
         in_shardings=(
             NamedSharding(mesh, P(DATA_AXIS, None)),

@@ -536,11 +536,10 @@ class SparkLinearRegression(_HasDistribution, LinearRegression):
             from spark_rapids_ml_tpu.ops import linear as LIN
 
             stats = LIN.LinearStats(**{k: jnp.asarray(v) for k, v in arrays.items()})
-            coef, intercept = LIN.solve_normal(
-                stats,
-                reg_param=self.getRegParam(),
-                fit_intercept=self.getFitIntercept(),
-            )
+            # solve_from_stats routes α=0 to the closed form and α>0 to the
+            # FISTA elastic-net path — same reduced stats either way, so
+            # every distribution mode supports the full regularizer family
+            coef, intercept = LIN.solve_from_stats(stats, **self._solve_args())
         model = SparkLinearRegressionModel(
             uid=self.uid, coefficients=np.asarray(coef), intercept=float(intercept)
         )

@@ -108,6 +108,135 @@ class TestLinearRegression:
             LinearRegression().fit((x, y[:-5]))
 
 
+class TestElasticNet:
+    """FISTA-on-reduced-stats elastic net vs sklearn coordinate descent.
+
+    Convention check (models/linear.py docstring): our (regParam=λ,
+    elasticNetParam=α) == sklearn ElasticNet(alpha=λ, l1_ratio=α)."""
+
+    def test_lasso_matches_sklearn(self, reg_data):
+        from sklearn.linear_model import Lasso as SkLasso
+
+        x, y = reg_data
+        lam = 0.1
+        m = (
+            LinearRegression(regParam=lam, elasticNetParam=1.0, tol=1e-12)
+            .fit((x, y))
+        )
+        sk = SkLasso(alpha=lam, tol=1e-12, max_iter=50_000).fit(x, y)
+        np.testing.assert_allclose(m.coefficients, sk.coef_, atol=1e-5)
+        np.testing.assert_allclose(m.intercept, sk.intercept_, atol=1e-5)
+
+    def test_elastic_net_matches_sklearn(self, reg_data):
+        from sklearn.linear_model import ElasticNet as SkEN
+
+        x, y = reg_data
+        m = (
+            LinearRegression(
+                regParam=0.05, elasticNetParam=0.4, tol=1e-12, maxIter=5000
+            ).fit((x, y))
+        )
+        sk = SkEN(alpha=0.05, l1_ratio=0.4, tol=1e-12, max_iter=50_000).fit(x, y)
+        np.testing.assert_allclose(m.coefficients, sk.coef_, atol=1e-5)
+        np.testing.assert_allclose(m.intercept, sk.intercept_, atol=1e-5)
+
+    def test_lasso_sparsity_and_kkt(self, rng):
+        # lasso at meaningful λ must zero some coefficients, and the
+        # survivors must satisfy the KKT stationarity conditions:
+        #   w_j != 0  ->  |g_j| == λα   (g = smooth gradient, sign opposes w)
+        #   w_j == 0  ->  |g_j| <= λα
+        x = rng.normal(size=(500, 10))
+        w_true = np.zeros(10)
+        w_true[[1, 4, 7]] = [2.0, -3.0, 1.5]
+        y = x @ w_true + 0.05 * rng.normal(size=500)
+        lam = 0.2
+        m = LinearRegression(
+            regParam=lam, elasticNetParam=1.0, tol=1e-12, maxIter=10_000
+        ).fit((x, y))
+        w = np.asarray(m.coefficients)
+        assert np.sum(np.abs(w) < 1e-9) >= 5  # noise coords zeroed
+        xc = x - x.mean(0)
+        yc = y - y.mean()
+        g = (xc.T @ (xc @ w - yc)) / len(y)
+        on = np.abs(w) > 1e-9
+        np.testing.assert_allclose(g[on], -lam * np.sign(w[on]), atol=1e-6)
+        assert np.all(np.abs(g[~on]) <= lam + 1e-6)
+
+    def test_alpha_zero_equals_closed_form(self, reg_data):
+        x, y = reg_data
+        a = LinearRegression(regParam=0.01).fit((x, y))
+        b = LinearRegression(regParam=0.01, elasticNetParam=0.0).fit((x, y))
+        np.testing.assert_allclose(a.coefficients, b.coefficients)
+
+    def test_no_intercept(self, reg_data):
+        from sklearn.linear_model import Lasso as SkLasso
+
+        x, y = reg_data
+        m = LinearRegression(
+            regParam=0.1, elasticNetParam=1.0, fitIntercept=False, tol=1e-12
+        ).fit((x, y))
+        sk = SkLasso(alpha=0.1, fit_intercept=False, tol=1e-12, max_iter=50_000).fit(x, y)
+        np.testing.assert_allclose(m.coefficients, sk.coef_, atol=1e-5)
+        assert m.intercept == 0.0
+
+    def test_multi_partition_equals_single(self, reg_data):
+        x, y = reg_data
+        a = LinearRegression(regParam=0.05, elasticNetParam=0.7).fit((x, y))
+        b = LinearRegression(regParam=0.05, elasticNetParam=0.7).fit(
+            (x, y), num_partitions=4
+        )
+        np.testing.assert_allclose(a.coefficients, b.coefficients, atol=1e-10)
+
+    def test_bad_alpha_rejected(self):
+        with pytest.raises(ValueError, match="elasticNetParam"):
+            LinearRegression(elasticNetParam=1.5)
+
+    def test_cancelling_columns_stay_finite(self, rng):
+        # x2 = -x1 makes A·1 exactly zero, collapsing the power-iteration
+        # Lipschitz estimate; the trace fallback must keep FISTA finite
+        # (the failure mode is a SILENT divergence to ±inf)
+        x1 = rng.normal(size=(300, 1))
+        x = np.concatenate([x1, -x1], axis=1)
+        y = x1[:, 0] + 0.01 * rng.normal(size=300)
+        m = LinearRegression(
+            regParam=0.1, elasticNetParam=1.0, fitIntercept=False
+        ).fit((x, y))
+        w = np.asarray(m.coefficients)
+        assert np.all(np.isfinite(w))
+        # KKT: the lasso subgradient bound must hold at the solution
+        g = (x.T @ (x @ w - y)) / len(y)
+        assert np.all(np.abs(g) <= 0.1 + 1e-6)
+
+    def test_persistence_roundtrip(self, reg_data, tmp_path):
+        x, y = reg_data
+        m = LinearRegression(regParam=0.1, elasticNetParam=1.0).fit((x, y))
+        m.write().save(str(tmp_path / "en"))
+        m2 = LinearRegressionModel.load(str(tmp_path / "en"))
+        np.testing.assert_allclose(m.coefficients, m2.coefficients)
+        assert m2.getOrDefault("elasticNetParam") == 1.0
+
+    def test_sharded_fit_matches_host(self, reg_data):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from spark_rapids_ml_tpu.parallel import linear as PL
+        from spark_rapids_ml_tpu.parallel import mesh as M
+
+        mesh8 = M.create_mesh()
+        x, y = reg_data
+        rows = (len(x) // mesh8.size) * mesh8.size
+        x, y = x[:rows], y[:rows]
+        host = LinearRegression(regParam=0.1, elasticNetParam=1.0).fit((x, y))
+        fit = PL.make_distributed_linreg_fit(
+            mesh8, reg_param=0.1, elastic_net_param=1.0
+        )
+        xs = jax.device_put(x, M.data_sharding(mesh8))
+        ys = jax.device_put(y, NamedSharding(mesh8, P(M.DATA_AXIS)))
+        coef, intercept = fit(xs, ys)
+        np.testing.assert_allclose(host.coefficients, np.asarray(coef), atol=1e-7)
+        np.testing.assert_allclose(host.intercept, float(intercept), atol=1e-7)
+
+
 class TestLogisticRegression:
     def test_matches_sklearn(self, cls_data):
         x, y = cls_data

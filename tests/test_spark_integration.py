@@ -373,6 +373,23 @@ class TestSparkGLMIntegration:
         preds = np.asarray([r["prediction"] for r in model.transform(df).collect()])
         np.testing.assert_allclose(preds, x @ core.coefficients + core.intercept, atol=1e-6)
 
+    def test_linreg_elastic_net(self, backend, rng_m):
+        # α>0 routes the driver-side solve through FISTA on the same
+        # reduced stats; both distribution modes must agree with the core
+        x = rng_m.normal(size=(400, 6))
+        coef = np.array([1.0, -2.0, 0.0, 3.0, 0.0, 0.5])
+        y = x @ coef + 1.5 + 0.01 * rng_m.normal(size=400)
+        df = self._labeled_df(backend, x, y)
+        est = SparkLinearRegression(regParam=0.1, elasticNetParam=1.0)
+        core = LinearRegression(regParam=0.1, elasticNetParam=1.0).fit((x, y))
+        model = est.fit(df)
+        np.testing.assert_allclose(model.coefficients, core.coefficients, atol=1e-6)
+        assert np.sum(np.abs(np.asarray(model.coefficients)) < 1e-9) >= 1
+        barrier = est.copy().setDistribution("mesh-barrier").fit(df)
+        np.testing.assert_allclose(
+            barrier.coefficients, core.coefficients, atol=1e-6
+        )
+
     def test_linreg_weighted(self, backend, rng_m):
         x = rng_m.normal(size=(300, 3))
         y = x @ np.ones(3)
