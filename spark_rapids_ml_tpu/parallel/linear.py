@@ -119,24 +119,34 @@ def distributed_newton_step(
     mesh: Mesh,
     *,
     reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
     fit_intercept: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
-    """One full distributed IRLS iteration: (new w, step-norm)."""
+    """One full distributed IRLS / proximal-Newton iteration."""
     stats = sharded_newton_stats(x_aug, y, w_full, mesh)
     return LIN.newton_update(
-        w_full, stats, reg_param=reg_param, fit_intercept=fit_intercept
+        w_full,
+        stats,
+        reg_param=reg_param,
+        elastic_net_param=elastic_net_param,
+        fit_intercept=fit_intercept,
     )
 
 
 @lru_cache(maxsize=32)
 def make_distributed_newton_step(
-    mesh: Mesh, *, reg_param: float = 0.0, fit_intercept: bool = True
+    mesh: Mesh,
+    *,
+    reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
+    fit_intercept: bool = True,
 ):
     return jax.jit(
         partial(
             distributed_newton_step,
             mesh=mesh,
             reg_param=reg_param,
+            elastic_net_param=elastic_net_param,
             fit_intercept=fit_intercept,
         ),
         in_shardings=(
@@ -153,6 +163,7 @@ def make_distributed_logreg_fit(
     mesh: Mesh,
     *,
     reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
     fit_intercept: bool = True,
     max_iter: int = 25,
     tol: float = 1e-6,
@@ -194,7 +205,11 @@ def make_distributed_logreg_fit(
                 lambda v: lax.psum(v, DATA_AXIS), stats
             )
             new_w, step = LIN.newton_update(
-                w_full, stats, reg_param=reg_param, fit_intercept=fit_intercept
+                w_full,
+                stats,
+                reg_param=reg_param,
+                elastic_net_param=elastic_net_param,
+                fit_intercept=fit_intercept,
             )
             return new_w, it + 1, step
 

@@ -357,9 +357,11 @@ class MeshLogRegFitFn(_MeshReducePartitionFn):
         fit_intercept: bool,
         max_iter: int,
         tol: float,
+        elastic_net_param: float = 0.0,
     ):
         super().__init__(features_col, label_col, weight_col)
         self.reg_param = float(reg_param)
+        self.elastic_net_param = float(elastic_net_param)
         self.fit_intercept = bool(fit_intercept)
         self.max_iter = int(max_iter)
         self.tol = float(tol)
@@ -379,6 +381,7 @@ class MeshLogRegFitFn(_MeshReducePartitionFn):
         return PL.make_distributed_logreg_fit(
             mesh,
             reg_param=self.reg_param,
+            elastic_net_param=self.elastic_net_param,
             fit_intercept=self.fit_intercept,
             max_iter=self.max_iter,
             tol=self.tol,

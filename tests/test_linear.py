@@ -295,6 +295,83 @@ class TestLogisticRegression:
         np.testing.assert_array_equal(loaded._predict_matrix(x), model._predict_matrix(x))
 
 
+class TestLogRegElasticNet:
+    """Proximal-Newton L1/elastic-net logistic vs sklearn.
+
+    Convention: objective (1/m)·Σ logloss + λ(α‖w‖₁ + (1−α)/2‖w‖²) — so
+    sklearn LogisticRegression(penalty="l1", C=1/(λ·m)) at α=1."""
+
+    def test_lasso_logistic_matches_sklearn(self, cls_data):
+        x, y = cls_data
+        lam = 0.01
+        m = LogisticRegression(
+            regParam=lam, elasticNetParam=1.0, maxIter=100, tol=1e-10
+        ).fit((x, y))
+        # saga, not liblinear: liblinear folds the intercept into the
+        # penalized features, saga leaves it unpenalized like this repo
+        sk = SkLogistic(
+            l1_ratio=1.0, C=1.0 / (lam * len(y)), solver="saga",
+            tol=1e-12, max_iter=100_000,
+        ).fit(x, y)
+        np.testing.assert_allclose(
+            m.coefficients, sk.coef_.ravel(), atol=2e-4
+        )
+        np.testing.assert_allclose(m.intercept, sk.intercept_[0], atol=2e-3)
+
+    def test_l1_zeroes_noise_features(self, rng):
+        x = rng.normal(size=(800, 8))
+        w_true = np.zeros(8)
+        w_true[[0, 3]] = [2.0, -1.5]
+        p = 1 / (1 + np.exp(-(x @ w_true)))
+        y = (rng.uniform(size=800) < p).astype(np.float64)
+        m = LogisticRegression(
+            regParam=0.05, elasticNetParam=1.0, maxIter=100, tol=1e-10
+        ).fit((x, y))
+        w = np.asarray(m.coefficients)
+        assert np.all(np.abs(w[[1, 2, 4, 5, 6, 7]]) < 1e-6)
+        assert np.all(np.abs(w[[0, 3]]) > 0.1)
+
+    def test_alpha_zero_unchanged(self, cls_data):
+        x, y = cls_data
+        a = LogisticRegression(regParam=0.01).fit((x, y))
+        b = LogisticRegression(regParam=0.01, elasticNetParam=0.0).fit((x, y))
+        np.testing.assert_allclose(a.coefficients, b.coefficients)
+
+    def test_multinomial_alpha_rejected(self, rng):
+        x = rng.normal(size=(90, 3))
+        y = np.repeat([0.0, 1.0, 2.0], 30)
+        with pytest.raises(ValueError, match="binary"):
+            LogisticRegression(elasticNetParam=0.5).fit((x, y))
+
+    def test_whole_loop_mesh_matches_host(self, cls_data):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from spark_rapids_ml_tpu.ops import linear as LIN
+        from spark_rapids_ml_tpu.parallel import linear as PL
+        from spark_rapids_ml_tpu.parallel import mesh as M
+
+        mesh = M.create_mesh()
+        x, y = cls_data
+        rows = (len(x) // mesh.size) * mesh.size
+        x, y = x[:rows], y[:rows]
+        host = LogisticRegression(
+            regParam=0.01, elasticNetParam=1.0, maxIter=50, tol=1e-10
+        ).fit((x, y))
+        fit = PL.make_distributed_logreg_fit(
+            mesh, reg_param=0.01, elastic_net_param=1.0,
+            max_iter=50, tol=1e-10,
+        )
+        xa = LIN.augment(jax.numpy.asarray(x))
+        xs = jax.device_put(np.asarray(xa), M.data_sharding(mesh))
+        ys = jax.device_put(y, NamedSharding(mesh, P(M.DATA_AXIS)))
+        ws = jax.device_put(np.ones(rows), NamedSharding(mesh, P(M.DATA_AXIS)))
+        w_fit, iters, _ = fit(xs, ys, ws)
+        w_fit = np.asarray(w_fit)
+        np.testing.assert_allclose(host.coefficients, w_fit[:-1], atol=1e-6)
+        np.testing.assert_allclose(host.intercept, w_fit[-1], atol=1e-6)
+
+
 class TestShardedGLM:
     @pytest.fixture
     def mesh8(self):

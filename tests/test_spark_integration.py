@@ -373,12 +373,15 @@ class TestSparkGLMIntegration:
         preds = np.asarray([r["prediction"] for r in model.transform(df).collect()])
         np.testing.assert_allclose(preds, x @ core.coefficients + core.intercept, atol=1e-6)
 
-    def test_linreg_elastic_net(self, backend, rng_m):
+    def test_linreg_elastic_net(self, backend):
         # α>0 routes the driver-side solve through FISTA on the same
-        # reduced stats; both distribution modes must agree with the core
-        x = rng_m.normal(size=(400, 6))
+        # reduced stats; both distribution modes must agree with the core.
+        # Local rng: consuming module-scoped rng_m here would shift the
+        # data stream of every test that runs after this one
+        rng = np.random.default_rng(55)
+        x = rng.normal(size=(400, 6))
         coef = np.array([1.0, -2.0, 0.0, 3.0, 0.0, 0.5])
-        y = x @ coef + 1.5 + 0.01 * rng_m.normal(size=400)
+        y = x @ coef + 1.5 + 0.01 * rng.normal(size=400)
         df = self._labeled_df(backend, x, y)
         est = SparkLinearRegression(regParam=0.1, elasticNetParam=1.0)
         core = LinearRegression(regParam=0.1, elasticNetParam=1.0).fit((x, y))
@@ -401,18 +404,47 @@ class TestSparkGLMIntegration:
         model = SparkLinearRegression().setWeightCol("wt").fit(df)
         np.testing.assert_allclose(model.coefficients, np.ones(3), atol=1e-4)
 
-    def test_logreg_newton_over_jobs(self, backend, rng_m):
-        x = rng_m.normal(size=(500, 4))
+    def test_logreg_elastic_net(self, backend):
+        # proximal-Newton L1 on the DataFrame paths must match the core fit.
+        # Local rng on purpose: rng_m is module-scoped and consuming its
+        # stream here would shift the data of every later test
+        rng = np.random.default_rng(77)
+        x = rng.normal(size=(400, 6))
+        true_w = np.array([2.0, -1.0, 0.0, 0.0, 1.5, 0.0])
+        p = 1.0 / (1.0 + np.exp(-(x @ true_w)))
+        y = (rng.uniform(size=400) < p).astype(np.float64)
+        df = self._labeled_df(backend, x, y)
+        core = LogisticRegression(
+            regParam=0.02, elasticNetParam=1.0, maxIter=60, tol=1e-10
+        ).fit((x, y))
+        est = SparkLogisticRegression(
+            regParam=0.02, elasticNetParam=1.0, maxIter=60, tol=1e-10
+        )
+        model = est.fit(df)
+        np.testing.assert_allclose(model.coefficients, core.coefficients, atol=1e-8)
+        barrier = est.copy().setDistribution("mesh-barrier").fit(df)
+        np.testing.assert_allclose(
+            barrier.coefficients, core.coefficients, atol=1e-6
+        )
+
+    def test_logreg_newton_over_jobs(self, backend):
+        # local rng: the train-accuracy threshold below is data-dependent,
+        # so this test must see the SAME data regardless of which other
+        # rng_m-consuming tests a -k selection ran before it
+        rng = np.random.default_rng(23)
+        x = rng.normal(size=(500, 4))
         true_w = np.array([2.0, -1.0, 0.5, 0.0])
         p = 1.0 / (1.0 + np.exp(-(x @ true_w - 0.3)))
-        y = (rng_m.random(500) < p).astype(float)
+        y = (rng.random(500) < p).astype(float)
         df = self._labeled_df(backend, x, y)
         est = SparkLogisticRegression().setRegParam(1e-4).setMaxIter(15)
         model = est.fit(df)
         core = LogisticRegression().setRegParam(1e-4).setMaxIter(15).fit((x, y))
         np.testing.assert_allclose(model.coefficients, core.coefficients, atol=1e-5)
         preds = np.asarray([r["prediction"] for r in model.transform(df).collect()])
-        assert np.mean(preds == y) > 0.8
+        # sanity bound only (labels are sigmoid-noisy: Bayes accuracy for
+        # this generator is ~0.8); the real check is the differential above
+        assert np.mean(preds == y) > 0.72
 
     def test_logreg_checkpoint_resume_matches_uninterrupted(
         self, backend, tmp_path, monkeypatch
