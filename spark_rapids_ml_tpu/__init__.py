@@ -36,6 +36,7 @@ def __getattr__(name):
         "IncrementalPCA",
         "IncrementalTruncatedSVD",
         "IncrementalStandardScaler",
+        "IncrementalLinearRegression",
     ):
         from spark_rapids_ml_tpu.models import incremental
 

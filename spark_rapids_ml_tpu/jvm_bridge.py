@@ -74,6 +74,30 @@ def fit_pca(args: argparse.Namespace) -> None:
     )
 
 
+def _assert_platform() -> None:
+    """Own the device policy for this fresh interpreter (it is a driver-side
+    entry point): honor an explicit ``JAX_PLATFORMS`` request even when a
+    site-level bootstrap would override it (devicepolicy.use_platform
+    rationale), and bounded-probe either way so an unhealthy device
+    transport exits with a diagnosable error instead of hanging the
+    invoking JVM indefinitely."""
+    import os
+
+    from spark_rapids_ml_tpu.utils import devicepolicy
+
+    requested = os.environ.get("JAX_PLATFORMS")
+    try:
+        if requested:
+            devicepolicy.use_platform(requested)
+        else:
+            # timeout=None: env-driven (TPU_ML_WORKER_PROBE_TIMEOUT), same
+            # knob the DevicePolicyError message recommends and the same
+            # default the use_platform branch waits
+            devicepolicy.probe_platform(expected=None, timeout=None)
+    except devicepolicy.DevicePolicyError as e:
+        raise SystemExit(f"jvm_bridge: {e}") from None
+
+
 def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(
         prog="spark_rapids_ml_tpu.jvm_bridge",
@@ -105,6 +129,8 @@ def main(argv: list[str] | None = None) -> None:
     )
     p.set_defaults(func=fit_pca)
     args = parser.parse_args(argv)
+    # after parsing: --help/usage errors must not pay (or hang on) JAX init
+    _assert_platform()
     args.func(args)
 
 

@@ -34,6 +34,12 @@ from spark_rapids_ml_tpu.models.pca import (
     _qr_r,
     _svd_from_r_jit,
 )
+from spark_rapids_ml_tpu.models.linear import (
+    LinearRegression,
+    LinearRegressionModel,
+    _linear_stats,
+    _solve_from_stats,
+)
 from spark_rapids_ml_tpu.models.scaler import (
     StandardScaler,
     StandardScalerModel,
@@ -50,8 +56,11 @@ from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.ops import scaler as S
 from spark_rapids_ml_tpu.utils import columnar
 
+from spark_rapids_ml_tpu.ops import linear as LIN
+
 _combine_gram = jax.jit(L.combine_gram_stats)
 _combine_moments = jax.jit(S.combine_moment_stats)
+_combine_linear = jax.jit(LIN.combine_linear_stats)
 
 
 def _as_matrix(est, batch: Any) -> np.ndarray:
@@ -237,4 +246,66 @@ class IncrementalStandardScaler(StandardScaler):
 
     def reset(self) -> "IncrementalStandardScaler":
         self._acc = self._n_cols = None
+        return self
+
+
+class IncrementalLinearRegression(LinearRegression):
+    """LinearRegression fitted by streaming labeled batches.
+
+    The running statistic is the same ``LinearStats`` monoid the batch fit
+    reduces (XᵀX, Xᵀy, Σx, Σy, Σy², m — O(n²) memory regardless of stream
+    length), so ``partial_fit(a); partial_fit(b); finalize()`` ==
+    ``fit(concat(a, b))`` — including the elastic-net solvers, which run on
+    the reduced statistics only. Batches are anything the one-shot fit
+    accepts: an ``(X, y)`` / ``(X, y, w)`` tuple or a DataFrame carrying
+    ``featuresCol``/``labelCol`` (and ``weightCol``).
+    """
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._acc = None
+        self._n_cols: int | None = None
+        self._rows_seen = 0
+
+    @property
+    def n_rows_seen(self) -> int:
+        # tracked separately from the monoid: LinearStats.count is the
+        # WEIGHT sum, which differs from the row count on weighted streams
+        return self._rows_seen
+
+    def partial_fit(self, batch: Any) -> "IncrementalLinearRegression":
+        parts = self._labeled(batch, 1)
+        for x, y, sw in parts:
+            if self._n_cols is None:
+                self._n_cols = x.shape[1]
+            elif x.shape[1] != self._n_cols:
+                raise ValueError(
+                    f"inconsistent feature dim: {x.shape[1]} != {self._n_cols}"
+                )
+            xp, yp, w = columnar.pad_labeled(x, y, sw)
+            stats = _linear_stats(
+                jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w)
+            )
+            self._acc = (
+                stats
+                if self._acc is None
+                else _combine_linear(self._acc, stats)
+            )
+            self._rows_seen += x.shape[0]
+        return self
+
+    def finalize(self):
+        if self._acc is None:
+            raise ValueError("finalize() before any partial_fit()")
+        coef, intercept = _solve_from_stats(self._acc, **self._solve_args())
+        model = LinearRegressionModel(
+            uid=self.uid,
+            coefficients=np.asarray(coef),
+            intercept=float(intercept),
+        )
+        return self._copyValues(model)
+
+    def reset(self) -> "IncrementalLinearRegression":
+        self._acc = self._n_cols = None
+        self._rows_seen = 0
         return self

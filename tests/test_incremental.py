@@ -9,9 +9,11 @@ import pytest
 
 from spark_rapids_ml_tpu import (
     PCA,
+    IncrementalLinearRegression,
     IncrementalPCA,
     IncrementalStandardScaler,
     IncrementalTruncatedSVD,
+    LinearRegression,
     StandardScaler,
     TruncatedSVD,
 )
@@ -130,3 +132,66 @@ class TestIncrementalScaler:
         inc = IncrementalStandardScaler().partial_fit(x)
         with pytest.raises(ValueError, match="inconsistent feature dim"):
             inc.partial_fit(x[:, :4])
+
+
+class TestIncrementalLinearRegression:
+    @pytest.fixture
+    def xy(self, rng):
+        x = rng.normal(size=(400, 8))
+        w = np.array([1.0, -2.0, 0.0, 3.0, 0.0, 0.5, 0.0, -1.0])
+        y = x @ w + 0.8 + 0.01 * rng.normal(size=400)
+        return x, y
+
+    def test_streaming_equals_batch(self, xy):
+        x, y = xy
+        inc = IncrementalLinearRegression(regParam=0.05)
+        for lo, hi in [(0, 150), (150, 280), (280, 400)]:
+            inc.partial_fit((x[lo:hi], y[lo:hi]))
+        assert inc.n_rows_seen == 400
+        m_inc = inc.finalize()
+        m_batch = LinearRegression(regParam=0.05).fit((x, y))
+        np.testing.assert_allclose(m_inc.coefficients, m_batch.coefficients, atol=1e-10)
+        np.testing.assert_allclose(m_inc.intercept, m_batch.intercept, atol=1e-10)
+
+    def test_streaming_elastic_net_equals_batch(self, xy):
+        x, y = xy
+        inc = IncrementalLinearRegression(
+            regParam=0.1, elasticNetParam=1.0, tol=1e-12
+        )
+        for lo, hi in [(0, 200), (200, 400)]:
+            inc.partial_fit((x[lo:hi], y[lo:hi]))
+        m_inc = inc.finalize()
+        m_batch = LinearRegression(
+            regParam=0.1, elasticNetParam=1.0, tol=1e-12
+        ).fit((x, y))
+        np.testing.assert_allclose(m_inc.coefficients, m_batch.coefficients, atol=1e-10)
+
+    def test_weighted_stream(self, xy):
+        x, y = xy
+        w = np.linspace(0.5, 2.0, len(x))
+        inc = IncrementalLinearRegression()
+        inc.partial_fit((x[:250], y[:250], w[:250]))
+        inc.partial_fit((x[250:], y[250:], w[250:]))
+        # rows, not the weight sum (LinearStats.count is the weight sum)
+        assert inc.n_rows_seen == len(x)
+        m_inc = inc.finalize()
+        m_batch = LinearRegression().fit((x, y, w))
+        np.testing.assert_allclose(m_inc.coefficients, m_batch.coefficients, atol=1e-10)
+
+    def test_unfinalized_raises(self):
+        with pytest.raises(ValueError, match="before any partial_fit"):
+            IncrementalLinearRegression().finalize()
+
+    def test_width_mismatch_rejected(self, xy):
+        x, y = xy
+        inc = IncrementalLinearRegression().partial_fit((x, y))
+        with pytest.raises(ValueError, match="inconsistent feature dim"):
+            inc.partial_fit((x[:, :4], y))
+
+    def test_reset(self, xy):
+        x, y = xy
+        inc = IncrementalLinearRegression().partial_fit((x, y))
+        inc.reset()
+        assert inc.n_rows_seen == 0
+        with pytest.raises(ValueError, match="before any partial_fit"):
+            inc.finalize()
