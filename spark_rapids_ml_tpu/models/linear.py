@@ -298,7 +298,33 @@ def _resume_newton_checkpoint(checkpoint_dir: str | None, n_params: int):
     return arrays["w"], step + 1, ckpt
 
 
-class LogisticRegression(_SupervisedParams, Estimator):
+class _HasProbabilityCol:
+    """probabilityCol — shared by LogisticRegression and its model so the
+    fitted model carries it (pyspark.ml's probability-vector output column).
+    Default '' = don't emit (this framework's transforms append only the
+    columns asked for); setProbabilityCol('probability') restores the stock
+    pyspark.ml surface."""
+
+    probabilityCol = Param(
+        "probabilityCol",
+        "optional output column for the per-class probability vector "
+        "([1-p, p] for binary, the softmax row for multinomial); '' = "
+        "don't emit",
+        str,
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(probabilityCol="")
+
+    def setProbabilityCol(self, value: str):
+        return self._set(probabilityCol=value)
+
+    def getProbabilityCol(self) -> str:
+        return self.getOrDefault("probabilityCol")
+
+
+class LogisticRegression(_HasProbabilityCol, _SupervisedParams, Estimator):
     """Binary logistic regression via IRLS/Newton, optionally elastic-net.
 
     Each iteration is one distributed monoid pass (XᵀWX, Xᵀ(y−p)) plus a
@@ -486,7 +512,7 @@ class LogisticRegression(_SupervisedParams, Estimator):
         return self._copyValues(model)
 
 
-class LogisticRegressionModel(_GLMModel):
+class LogisticRegressionModel(_HasProbabilityCol, _GLMModel):
     """Binary or multinomial fitted model.
 
     Binary: ``coefficients`` [n] + ``intercept`` (``predict_proba_matrix``
@@ -517,6 +543,32 @@ class LogisticRegressionModel(_GLMModel):
         if self.coefficientMatrix is not None:
             return self.coefficientMatrix.shape[0]
         return 2
+
+    def transform(self, dataset: Any) -> Any:
+        proba_col = self.getProbabilityCol()
+        if proba_col and columnar.has_named_columns(dataset):
+            # emit BOTH Spark-ML-style output columns on column-bearing
+            # containers (arrow/pandas); matrix/partition inputs have no
+            # named columns, so they keep the prediction-only contract
+            features_col = self.getOrDefault("featuresCol")
+            out = columnar.apply_column_transform(
+                dataset, features_col, proba_col, self._proba_vectors
+            )
+            return columnar.apply_column_transform(
+                out,
+                features_col,
+                self.getOrDefault("predictionCol"),
+                self._predict_matrix,
+            )
+        return super().transform(dataset)
+
+    def _proba_vectors(self, mat: np.ndarray) -> np.ndarray:
+        """[rows, C] probability vectors ([1−p, p] for binary) — the
+        pyspark.ml ``probability`` column shape."""
+        proba = self.predict_proba_matrix(mat)
+        if proba.ndim == 1:
+            return np.stack([1.0 - proba, proba], axis=1)
+        return proba
 
     def predict_proba_matrix(self, mat: np.ndarray) -> np.ndarray:
         padded, true_rows = columnar.pad_rows(mat)

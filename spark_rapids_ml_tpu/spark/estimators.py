@@ -429,18 +429,30 @@ def _resolve_col(obj, *names) -> str | None:
     return None
 
 
+def _resolve_input_col(model) -> str:
+    # Spark ML reads the "features" column when the param is unset
+    return _resolve_col(model, "inputCol", "featuresCol") or "features"
+
+
+def _spark_append(dataset, fn, fields):
+    """mapInArrow with the input schema plus ``fields`` appended — the one
+    dispatch site every model transform (single- or multi-output) uses."""
+    T, _ = _sql_mods(dataset)
+    schema = T.StructType(
+        dataset.schema.fields
+        + [T.StructField(name, typ) for name, typ in fields]
+    )
+    return dataset.mapInArrow(fn, schema=schema)
+
+
 def _spark_transform(model, dataset, matrix_fn, output_col, scalar: bool):
     T, _ = _sql_mods(dataset)
-    # Spark ML reads the "features" column when the param is unset
-    input_col = _resolve_col(model, "inputCol", "featuresCol") or "features"
+    input_col = _resolve_input_col(model)
     fn = arrow_fns.make_matrix_map_partition_fn(input_col, output_col, matrix_fn)
     out_type = (
         T.DoubleType() if scalar else T.ArrayType(T.DoubleType())
     )
-    schema = T.StructType(
-        dataset.schema.fields + [T.StructField(output_col, out_type)]
-    )
-    return dataset.mapInArrow(fn, schema=schema)
+    return _spark_append(dataset, fn, [(output_col, out_type)])
 
 
 def _parse_checkpoint_kwargs(kwargs: dict, default_every: int) -> tuple:
@@ -823,10 +835,28 @@ class SparkLogisticRegressionModel(LogisticRegressionModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)
-        return _spark_transform(
-            self, dataset, self._predict_matrix,
-            self.getOrDefault("predictionCol"), scalar=True,
+        proba_col = self.getProbabilityCol()
+        if not proba_col:
+            return _spark_transform(
+                self, dataset, self._predict_matrix,
+                self.getOrDefault("predictionCol"), scalar=True,
+            )
+        # one device pass emits BOTH Spark ML output columns
+        T, _ = _sql_mods(dataset)
+        pred_col = self.getOrDefault("predictionCol")
+        fn = arrow_fns.ProbaPredictionPartitionFn(
+            _resolve_input_col(self), proba_col, pred_col,
+            self.predict_proba_matrix,
         )
+        with trace_range("logreg transform"):
+            return _spark_append(
+                dataset,
+                fn,
+                [
+                    (proba_col, T.ArrayType(T.DoubleType())),
+                    (pred_col, T.DoubleType()),
+                ],
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -244,6 +244,15 @@ def apply_column_transform(dataset: Any, input_col: str | None, output_col: str,
     return np.asarray(fn(extract_matrix(dataset, input_col)))
 
 
+def has_named_columns(dataset: Any) -> bool:
+    """True for containers whose transform output carries named columns
+    (arrow tables/batches, pandas and pandas-likes) — the inputs where
+    appending more than one output column is meaningful."""
+    if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
+        return True
+    return hasattr(dataset, "columns") and hasattr(dataset, "assign")
+
+
 def extract_vector(data: Any, col: str) -> np.ndarray:
     """Extract a scalar column (labels) as a [rows] float vector."""
     if pa is not None and isinstance(data, (pa.Table, pa.RecordBatch)):

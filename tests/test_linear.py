@@ -372,6 +372,33 @@ class TestLogRegElasticNet:
         np.testing.assert_allclose(host.intercept, w_fit[-1], atol=1e-6)
 
 
+class TestProbabilityCol:
+    def test_pandas_emits_both_columns(self, cls_data):
+        import pandas as pd
+
+        x, y = cls_data
+        df = pd.DataFrame({"features": list(x), "label": y})
+        m = (
+            LogisticRegression().setRegParam(0.01)
+            .setProbabilityCol("probability").fit(df)
+        )
+        out = m.transform(df)
+        assert "probability" in out.columns and "prediction" in out.columns
+        proba = np.stack(out["probability"].to_numpy())
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-12)
+        np.testing.assert_allclose(
+            out["prediction"].to_numpy(), (proba[:, 1] >= 0.5).astype(float)
+        )
+
+    def test_matrix_input_keeps_prediction_only_contract(self, cls_data):
+        x, y = cls_data
+        m = (
+            LogisticRegression().setProbabilityCol("probability").fit((x, y))
+        )
+        out = m.transform(x)  # ndarray in, prediction vector out
+        assert isinstance(out, np.ndarray) and out.shape == (len(x),)
+
+
 class TestShardedGLM:
     @pytest.fixture
     def mesh8(self):

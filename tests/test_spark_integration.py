@@ -427,6 +427,44 @@ class TestSparkGLMIntegration:
             barrier.coefficients, core.coefficients, atol=1e-6
         )
 
+    def test_logreg_probability_col(self, backend):
+        rng = np.random.default_rng(31)
+        x = rng.normal(size=(200, 4))
+        p = 1.0 / (1.0 + np.exp(-(x @ np.array([2.0, -1.0, 0.5, 0.0]))))
+        y = (rng.random(200) < p).astype(float)
+        df = self._labeled_df(backend, x, y)
+        model = (
+            SparkLogisticRegression().setRegParam(0.01)
+            .setProbabilityCol("probability").fit(df)
+        )
+        rows = model.transform(df).collect()
+        proba = np.asarray([r["probability"] for r in rows])
+        preds = np.asarray([r["prediction"] for r in rows])
+        assert proba.shape == (200, 2)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-12)
+        want = model.predict_proba_matrix(x)
+        np.testing.assert_allclose(proba[:, 1], want, atol=1e-9)
+        np.testing.assert_allclose(preds, (want >= 0.5).astype(float))
+
+    def test_multinomial_probability_col(self, backend):
+        rng = np.random.default_rng(41)
+        x = np.concatenate([
+            rng.normal(size=(60, 3)) + off for off in ([0, 0, 0], [4, 0, 0], [0, 4, 0])
+        ])
+        y = np.repeat([0.0, 1.0, 2.0], 60)
+        df = self._labeled_df(backend, x, y)
+        model = (
+            SparkLogisticRegression().setRegParam(0.01)
+            .setProbabilityCol("probability").fit(df)
+        )
+        rows = model.transform(df).collect()
+        proba = np.asarray([r["probability"] for r in rows])
+        preds = np.asarray([r["prediction"] for r in rows])
+        assert proba.shape == (180, 3)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-12)
+        np.testing.assert_allclose(preds, np.argmax(proba, axis=1).astype(float))
+        assert np.mean(preds == y) > 0.9
+
     def test_logreg_newton_over_jobs(self, backend):
         # local rng: the train-accuracy threshold below is data-dependent,
         # so this test must see the SAME data regardless of which other
