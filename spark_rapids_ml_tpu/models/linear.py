@@ -547,28 +547,35 @@ class LogisticRegressionModel(_HasProbabilityCol, _GLMModel):
     def transform(self, dataset: Any) -> Any:
         proba_col = self.getProbabilityCol()
         if proba_col and columnar.has_named_columns(dataset):
-            # emit BOTH Spark-ML-style output columns on column-bearing
-            # containers (arrow/pandas); matrix/partition inputs have no
-            # named columns, so they keep the prediction-only contract
-            features_col = self.getOrDefault("featuresCol")
-            out = columnar.apply_column_transform(
-                dataset, features_col, proba_col, self._proba_vectors
+            # emit BOTH Spark-ML-style output columns from ONE forward pass
+            # on column-bearing containers (arrow/pandas); matrix/partition
+            # inputs have no named columns and keep the prediction-only
+            # contract
+            mat = columnar.extract_matrix(
+                dataset, self.getOrDefault("featuresCol")
             )
-            return columnar.apply_column_transform(
-                out,
-                features_col,
-                self.getOrDefault("predictionCol"),
-                self._predict_matrix,
+            vecs, preds = self.proba_and_predictions(mat)
+            return columnar.append_columns(
+                dataset,
+                [
+                    (proba_col, vecs),
+                    (self.getOrDefault("predictionCol"), preds),
+                ],
             )
         return super().transform(dataset)
 
-    def _proba_vectors(self, mat: np.ndarray) -> np.ndarray:
-        """[rows, C] probability vectors ([1−p, p] for binary) — the
-        pyspark.ml ``probability`` column shape."""
+    def proba_and_predictions(
+        self, mat: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """One forward pass → ([rows, C] probability vectors, [rows]
+        predictions). THE decision rule for both the local and Spark
+        transform paths: binary stacks [1−p, p] and thresholds at 0.5
+        inclusive; multinomial takes the argmax of the softmax row."""
         proba = self.predict_proba_matrix(mat)
         if proba.ndim == 1:
-            return np.stack([1.0 - proba, proba], axis=1)
-        return proba
+            preds = (proba >= 0.5).astype(np.float64)
+            return np.stack([1.0 - proba, proba], axis=1), preds
+        return proba, np.argmax(proba, axis=1).astype(np.float64)
 
     def predict_proba_matrix(self, mat: np.ndarray) -> np.ndarray:
         padded, true_rows = columnar.pad_rows(mat)

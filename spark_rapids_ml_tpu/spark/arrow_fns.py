@@ -786,8 +786,9 @@ class ProbaPredictionPartitionFn:
     one device pass: ``probabilityCol`` (the per-class probability vector —
     [1−p, p] for binary, the softmax row for multinomial, matching
     pyspark.ml's ``probability`` convention) and ``predictionCol`` (argmax /
-    threshold). ``proba_fn`` is the fitted model's bound
-    ``predict_proba_matrix``; serialization contract as MatrixMapPartitionFn.
+    threshold). ``proba_pred_fn`` is the fitted model's bound
+    ``proba_and_predictions``; serialization contract as
+    MatrixMapPartitionFn.
     """
 
     def __init__(
@@ -795,27 +796,24 @@ class ProbaPredictionPartitionFn:
         input_col: str,
         probability_col: str,
         prediction_col: str,
-        proba_fn: Callable[[np.ndarray], np.ndarray],
+        proba_pred_fn: Callable[[np.ndarray], tuple],
     ):
         self.input_col = input_col
         self.probability_col = probability_col
         self.prediction_col = prediction_col
-        self.proba_fn = proba_fn
+        #: the model's ``proba_and_predictions`` bound method — ONE decision
+        #: rule shared with the local transform path, one forward pass
+        self.proba_pred_fn = proba_pred_fn
 
     def __call__(self, batches):
         for batch in batches:
             if batch.num_rows == 0:
                 continue
-            proba = np.asarray(
-                self.proba_fn(columnar.extract_matrix(batch, self.input_col))
-            ).astype(np.float64, copy=False)
-            if proba.ndim == 1:  # binary: P(y=1) → Spark's [P0, P1] vector
-                # threshold at 0.5 inclusive, matching the core model's
-                # _predict_matrix (argmax would send p == 0.5 to class 0)
-                pred = (proba >= 0.5).astype(np.float64)
-                proba = np.stack([1.0 - proba, proba], axis=1)
-            else:
-                pred = np.argmax(proba, axis=1).astype(np.float64)
+            proba, pred = self.proba_pred_fn(
+                columnar.extract_matrix(batch, self.input_col)
+            )
+            proba = np.asarray(proba, dtype=np.float64)
+            pred = np.asarray(pred, dtype=np.float64)
             proba_col = _list_column(proba.reshape(-1), proba.shape[1])
             pred_col = pa.array(pred)
             schema = batch.schema.append(

@@ -846,7 +846,7 @@ class SparkLogisticRegressionModel(LogisticRegressionModel):
         pred_col = self.getOrDefault("predictionCol")
         fn = arrow_fns.ProbaPredictionPartitionFn(
             _resolve_input_col(self), proba_col, pred_col,
-            self.predict_proba_matrix,
+            self.proba_and_predictions,
         )
         with trace_range("logreg transform"):
             return _spark_append(

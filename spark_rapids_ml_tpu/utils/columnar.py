@@ -244,6 +244,30 @@ def apply_column_transform(dataset: Any, input_col: str | None, output_col: str,
     return np.asarray(fn(extract_matrix(dataset, input_col)))
 
 
+def append_columns(dataset: Any, columns) -> Any:
+    """Append precomputed output columns ([(name, ndarray)], 1-D scalar or
+    2-D array-valued) to a column-bearing container, preserving its type —
+    the multi-output sibling of ``apply_column_transform``."""
+    if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
+        if isinstance(dataset, pa.RecordBatch):
+            dataset = pa.Table.from_batches([dataset])
+        for name, out in columns:
+            out = np.asarray(out)
+            col = pa.array(out) if out.ndim == 1 else matrix_to_arrow_column(out)
+            dataset = dataset.append_column(name, col)
+        return dataset
+    if hasattr(dataset, "columns") and hasattr(dataset, "assign"):
+        return dataset.assign(
+            **{
+                name: (list(np.asarray(out)) if np.asarray(out).ndim > 1 else np.asarray(out))
+                for name, out in columns
+            }
+        )
+    raise TypeError(
+        f"cannot append named columns to {type(dataset).__name__}"
+    )
+
+
 def has_named_columns(dataset: Any) -> bool:
     """True for containers whose transform output carries named columns
     (arrow tables/batches, pandas and pandas-likes) — the inputs where

@@ -96,6 +96,23 @@ def test_maker_caches_return_same_executable():
     ) is PL.make_distributed_linreg_fit(mesh2, reg_param=0.1)
 
 
+def test_hyperparameter_sweep_reuses_one_program(rng):
+    # reg_param/max_iter/tol are traced (not static) in the jitted solver,
+    # so a CV sweep over λ compiles ONE executable — the design that keeps
+    # hyperparameter search cheap (models/linear.py jit wrapper comment)
+    import numpy as np
+
+    from spark_rapids_ml_tpu.models import linear as ML
+    from spark_rapids_ml_tpu.models.linear import LinearRegression
+
+    x = rng.normal(size=(200, 6))
+    y = x @ np.ones(6) + rng.normal(size=200)
+    before = ML._solve_from_stats._cache_size()
+    for lam in (0.011, 0.052, 0.13, 0.54):
+        LinearRegression(regParam=lam, elasticNetParam=1.0).fit((x, y))
+    assert ML._solve_from_stats._cache_size() - before <= 1
+
+
 def test_sharded_stats_program_cached(rng):
     import jax
 
