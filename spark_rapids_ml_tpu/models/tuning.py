@@ -41,6 +41,15 @@ def _is_spark_df(dataset: Any) -> bool:
     return columnar.is_spark_dataframe(dataset)
 
 
+def _column_names(dataset) -> list[str]:
+    """Column names of any supported container ([] when nameless)."""
+    schema = getattr(dataset, "schema", None)
+    if schema is not None and hasattr(schema, "names"):
+        return list(schema.names)  # Spark-likes AND arrow tables/batches
+    cols = getattr(dataset, "columns", None)  # pandas-likes
+    return list(cols) if cols is not None else []
+
+
 def _df_columns(df, *cols: str) -> list[np.ndarray]:
     """Collect the named DataFrame columns in ONE job (separate collects
     would re-execute the lineage per column and rely on cross-job row-order
@@ -240,14 +249,31 @@ class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
 
 
 class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
-    """areaUnderROC (default, rank statistic over scores) or accuracy."""
+    """areaUnderROC (default, rank statistic over scores) or accuracy.
+
+    For areaUnderROC, scores come from ``rawPredictionCol`` when the
+    dataset carries it — a probability or raw-margin VECTOR column (the
+    pyspark.ml convention; the last element is the positive-class score —
+    so a LogisticRegression ``probabilityCol`` output plugs in directly)
+    or a scalar score column. AUC is a rank statistic, invariant to any
+    monotone transform, so margins and probabilities score identically.
+    Falls back to ``predictionCol`` when absent (hard labels give the
+    degenerate two-level AUC). ``accuracy`` always uses ``predictionCol``.
+    """
 
     metricName = Param("metricName", "areaUnderROC|accuracy", str)
+    rawPredictionCol = Param(
+        "rawPredictionCol",
+        "score column for areaUnderROC: vector (last element used) or "
+        "scalar; falls back to predictionCol when the column is absent",
+        str,
+    )
 
     def __init__(self, uid: str | None = None, **kwargs):
         super().__init__(uid, **kwargs)
         self._setDefault(
-            metricName="areaUnderROC", labelCol="label", predictionCol="prediction"
+            metricName="areaUnderROC", labelCol="label",
+            predictionCol="prediction", rawPredictionCol="rawPrediction",
         )
 
     def setMetricName(self, value: str) -> "BinaryClassificationEvaluator":
@@ -255,10 +281,36 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
             raise ValueError("metricName must be areaUnderROC or accuracy")
         return self._set(metricName=value)
 
+    def setRawPredictionCol(self, value: str) -> "BinaryClassificationEvaluator":
+        return self._set(rawPredictionCol=value)
+
+    def _score_pair(self, dataset):
+        """(labels, scores) with rawPredictionCol preferred for ranking."""
+        raw_col = self.getOrDefault("rawPredictionCol")
+        label_col = self.getOrDefault("labelCol")
+        if raw_col and raw_col in _column_names(dataset):
+            if _is_spark_df(dataset):
+                y, s = _df_columns(dataset, label_col, raw_col)
+            else:
+                y = _labels_of(dataset, label_col)
+                try:  # vector column ([rows, C] probability/margins)...
+                    s = columnar.extract_matrix(dataset, raw_col)
+                except (TypeError, ValueError):  # ...or a scalar score
+                    s = columnar.extract_vector(dataset, raw_col)
+            s = np.asarray(s, dtype=np.float64)
+            if s.ndim == 2:
+                s = s[:, -1]  # positive-class score, pyspark.ml convention
+            return y, s
+        return self._labeled_pair(dataset, None)
+
     def evaluate(self, dataset, predictions=None) -> float:
-        y, p = self._labeled_pair(dataset, predictions)
         if self.getOrDefault("metricName") == "accuracy":
+            y, p = self._labeled_pair(dataset, predictions)
             return float(np.mean((p >= 0.5) == (y >= 0.5)))
+        if predictions is not None:
+            y, p = self._labeled_pair(dataset, predictions)
+        else:
+            y, p = self._score_pair(dataset)
         pos, neg = p[y >= 0.5], p[y < 0.5]
         if len(pos) == 0 or len(neg) == 0:
             return 0.5

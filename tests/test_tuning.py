@@ -317,3 +317,53 @@ class TestContainers:
         np.testing.assert_allclose(
             tm.bestModel.coefficients, [1.0, -2.0], atol=1e-3
         )
+
+
+class TestBinaryEvaluatorRawPrediction:
+    def _data(self):
+        rng = np.random.default_rng(8)
+        x = rng.normal(size=(400, 4))
+        p = 1 / (1 + np.exp(-(x @ np.array([2.0, -1.0, 0.5, 0.0]))))
+        y = (rng.uniform(size=400) < p).astype(float)
+        return x, y
+
+    def test_auc_uses_probability_vector_column(self):
+        import pandas as pd
+
+        from spark_rapids_ml_tpu import LogisticRegression
+        from spark_rapids_ml_tpu.models.tuning import (
+            BinaryClassificationEvaluator,
+        )
+
+        x, y = self._data()
+        df = pd.DataFrame({"features": list(x), "label": y})
+        m = (
+            LogisticRegression().setRegParam(0.01)
+            .setProbabilityCol("probability").fit(df)
+        )
+        out = m.transform(df)
+        ev = BinaryClassificationEvaluator().setRawPredictionCol("probability")
+        auc_vec = ev.evaluate(out)
+        # oracle: rank-based AUC over P(y=1)
+        proba = np.stack(out["probability"].to_numpy())[:, 1]
+        from sklearn.metrics import roc_auc_score
+
+        assert abs(auc_vec - roc_auc_score(y, proba)) < 1e-12
+        # hard predictions alone give a coarser (different) AUC
+        ev_hard = BinaryClassificationEvaluator().setRawPredictionCol("")
+        assert auc_vec >= ev_hard.evaluate(out)
+
+    def test_missing_raw_col_falls_back_to_prediction(self):
+        import pandas as pd
+
+        from spark_rapids_ml_tpu import LogisticRegression
+        from spark_rapids_ml_tpu.models.tuning import (
+            BinaryClassificationEvaluator,
+        )
+
+        x, y = self._data()
+        df = pd.DataFrame({"features": list(x), "label": y})
+        out = LogisticRegression().setRegParam(0.01).fit(df).transform(df)
+        # default rawPredictionCol="rawPrediction" is absent -> predictionCol
+        auc = BinaryClassificationEvaluator().evaluate(out)
+        assert 0.5 <= auc <= 1.0

@@ -108,6 +108,26 @@ class TestCrossValidatorOverDataFrames:
         fitted = cv.fit(df)
         assert fitted.avgMetrics[0] > 0.8  # AUC on ranked probabilities
 
+    def test_evaluator_reads_probability_col_on_dataframe(self, session):
+        from sklearn.metrics import roc_auc_score
+
+        rng = np.random.default_rng(37)
+        x = rng.normal(size=(300, 3))
+        p = 1.0 / (1.0 + np.exp(-(x @ np.array([2.0, -1.0, 0.5]))))
+        y = (rng.random(300) < p).astype(float)
+        df = _labeled_df(session, x, y)
+        model = (
+            SparkLogisticRegression().setRegParam(1e-3)
+            .setProbabilityCol("probability").fit(df)
+        )
+        out = model.transform(df)
+        ev = BinaryClassificationEvaluator().setRawPredictionCol("probability")
+        auc = ev.evaluate(out)
+        rows = out.collect()
+        got_y = np.asarray([r["label"] for r in rows])
+        got_p = np.asarray([r["probability"][1] for r in rows])
+        assert abs(auc - roc_auc_score(got_y, got_p)) < 1e-12
+
 
 class TestTrainValidationSplitOverDataFrames:
     def test_tvs_selects_and_refits(self, session):
