@@ -56,7 +56,8 @@ _MAX_CLASSES = 64
 
 _softmax_stats = jax.jit(LIN.softmax_newton_stats, static_argnames=("n_classes",))
 _softmax_update = jax.jit(
-    LIN.softmax_newton_update, static_argnames=("n_classes", "fit_intercept")
+    LIN.softmax_newton_update,
+    static_argnames=("n_classes", "elastic_net_param", "fit_intercept"),
 )
 _predict_softmax = jax.jit(LIN.predict_softmax_proba)
 
@@ -331,8 +332,8 @@ class LogisticRegression(_HasProbabilityCol, _SupervisedParams, Estimator):
     replicated [d, d] solve; convergence on the Newton step norm. With
     ``elasticNetParam=α>0`` the replicated solve becomes a proximal-Newton
     step (FISTA on the quadratic model — ``ops.linear.newton_update``);
-    the per-iteration distributed cost is identical. Binary only: a
-    multinomial fit with α>0 raises. Supports the same
+    the per-iteration distributed cost is identical — for BOTH the binary
+    sigmoid and the multinomial softmax paths. Supports the same
     ``checkpoint_dir``/``checkpoint_every`` mid-training checkpoint/resume
     contract as KMeans.
     """
@@ -370,15 +371,6 @@ class LogisticRegression(_HasProbabilityCol, _SupervisedParams, Estimator):
     def getElasticNetParam(self) -> float:
         return self.getOrDefault("elasticNetParam")
 
-    def _check_multiclass_supported(self, n_classes: int) -> None:
-        """Shared by the core and Spark fit paths: softmax is L2-only."""
-        if n_classes > 2 and self.getElasticNetParam() > 0.0:
-            raise ValueError(
-                "elasticNetParam > 0 is supported for binary logistic "
-                "regression only (proximal Newton); the multinomial "
-                "softmax path is L2-only"
-            )
-
     def fit(
         self,
         dataset: Any,
@@ -407,7 +399,6 @@ class LogisticRegression(_HasProbabilityCol, _SupervisedParams, Estimator):
                 "Check for mislabeled/ID-like rows, or re-encode labels "
                 "densely as 0..C-1"
             )
-        self._check_multiclass_supported(n_classes)
         if n_classes > 2:
             return self._fit_multinomial(
                 parts,
@@ -491,6 +482,7 @@ class LogisticRegression(_HasProbabilityCol, _SupervisedParams, Estimator):
                     stats,
                     n_classes,
                     reg_param=self.getRegParam(),
+                    elastic_net_param=self.getElasticNetParam(),
                     fit_intercept=fit_intercept,
                 )
                 w_flat = np.asarray(new_w)

@@ -210,10 +210,7 @@ def solve_elastic_net(
     Not implemented in the reference family at all; pyspark.ml gets it via
     breeze OWL-QN over full data passes per iteration.
     """
-    if not 0.0 <= elastic_net_param <= 1.0:
-        raise ValueError(
-            f"elastic_net_param must be in [0, 1], got {elastic_net_param}"
-        )
+    _check_alpha(elastic_net_param)
     m = jnp.maximum(stats.count, jnp.ones_like(stats.count))
     n = stats.xtx.shape[0]
     if fit_intercept:
@@ -331,6 +328,39 @@ def logistic_newton_stats(
     )
 
 
+def _check_alpha(elastic_net_param: float) -> None:
+    if not 0.0 <= elastic_net_param <= 1.0:
+        raise ValueError(
+            f"elastic_net_param must be in [0, 1], got {elastic_net_param}"
+        )
+
+
+def _regularized_newton_solve(
+    w: jax.Array,
+    hess: jax.Array,
+    grad: jax.Array,
+    pen: jax.Array,
+    m: jax.Array,
+    reg_param: float,
+    elastic_net_param: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Shared Newton-step tail for the binary AND softmax paths: closed-form
+    solve at α=0, warm-started FISTA prox step otherwise. ``hess``/``grad``
+    arrive with the L2 fold and the eps ridge already applied; ``grad`` is
+    the ASCENT direction of the smooth model."""
+    if elastic_net_param == 0.0:
+        delta = jax.scipy.linalg.solve(hess, grad, assume_a="pos")
+        return w + delta, jnp.linalg.norm(delta)
+    lam1 = reg_param * elastic_net_param * m
+    eta = 1.0 / jnp.maximum(_power_lam_max(hess), 1e-30)
+
+    def sub_grad(z):
+        return hess @ (z - w) - grad
+
+    z = _fista(sub_grad, eta * lam1 * pen, eta, w, 200, 1e-10)
+    return z, jnp.linalg.norm(z - w)
+
+
 def newton_update(
     w_full: jax.Array,
     stats: NewtonStats,
@@ -354,10 +384,7 @@ def newton_update(
     of an iteration (the NewtonStats psum) is UNCHANGED, so L1 logistic
     costs the same communication per iteration as L2.
     """
-    if not 0.0 <= elastic_net_param <= 1.0:
-        raise ValueError(
-            f"elastic_net_param must be in [0, 1], got {elastic_net_param}"
-        )
+    _check_alpha(elastic_net_param)
     d = w_full.shape[0]
     m = jnp.maximum(stats.count, jnp.ones_like(stats.count))
     pen = jnp.ones((d,), w_full.dtype)
@@ -371,21 +398,9 @@ def newton_update(
     # (√eps(f64) ≈ 1.5e-8 — f64 behavior unchanged)
     eps = jnp.sqrt(jnp.finfo(hess.dtype).eps) * jnp.trace(hess) / d
     hess = hess + eps * jnp.eye(d, dtype=hess.dtype)
-    if elastic_net_param == 0.0:
-        delta = jax.scipy.linalg.solve(hess, grad, assume_a="pos")
-        return w_full + delta, jnp.linalg.norm(delta)
-
-    # FISTA on the subproblem min_z −gradᵀ(z−w) + ½(z−w)ᵀH(z−w) + λ₁‖z_pen‖₁,
-    # warm-started at w (near the optimum it converges in a handful of
-    # iterations; the 200 cap only binds on ill-conditioned Hessians).
-    lam1 = reg_param * elastic_net_param * m
-    eta = 1.0 / jnp.maximum(_power_lam_max(hess), 1e-30)
-
-    def sub_grad(z):
-        return hess @ (z - w_full) - grad
-
-    z = _fista(sub_grad, eta * lam1 * pen, eta, w_full, 200, 1e-10)
-    return z, jnp.linalg.norm(z - w_full)
+    return _regularized_newton_solve(
+        w_full, hess, grad, pen, m, reg_param, elastic_net_param
+    )
 
 
 def predict_logistic_proba(
@@ -476,16 +491,26 @@ def softmax_newton_update(
     n_classes: int,
     *,
     reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
     fit_intercept: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
-    """One Newton step on the flattened [C·d] parameter: (new w, step norm).
+    """One Newton / proximal-Newton step on the flattened [C·d] parameter.
 
     L2 penalizes every coordinate except the per-class intercepts. The
     softmax parameterization has a flat direction (adding any vector to all
     classes leaves p unchanged); the L2 penalty pins the coefficients and the
     eps ridge pins the unpenalized intercept-shift direction — gradients are
     zero along it, so the regularized solve simply doesn't move there.
+    α>0 swaps the closed-form solve for the same warm-started FISTA
+    subproblem as the binary :func:`newton_update` — the per-class-coordinate
+    L1 prox is the elementwise soft-threshold on the flat vector, so nothing
+    about the C-class block structure changes. (With α=1 the L1 term alone
+    does NOT pin the flat direction, but the prox is applied to a Newton
+    model whose Hessian carries the eps ridge, and FISTA is warm-started at
+    the current w — the step stays well-posed the same way the L2 path's
+    ridge-only intercept direction does.)
     """
+    _check_alpha(elastic_net_param)
     cd = w_flat.shape[0]
     d = cd // n_classes
     m = jnp.maximum(stats.count, jnp.ones_like(stats.count))
@@ -493,19 +518,19 @@ def softmax_newton_update(
     if fit_intercept:
         pen = pen.at[:, -1].set(0.0)
     pen = pen.reshape(-1)
-    lam = reg_param * m * pen
-    hess = stats.hess + jnp.diag(lam)
-    grad = stats.grad - lam * w_flat
+    lam2 = reg_param * (1.0 - elastic_net_param) * m * pen
+    hess = stats.hess + jnp.diag(lam2)
+    grad = stats.grad - lam2 * w_flat
     # √eps-scaled ridge: the exact Fisher matrix is PSD with a ZERO
     # eigenvalue along the class-shift flat direction, and dtype rounding
     # makes it slightly indefinite (measured ~-5e-5 in f32) — a fixed 1e-8
     # ridge NaNs the f32 Cholesky on the first step. √eps(f64) ≈ 1.5e-8, so
     # f64 behavior is unchanged.
     eps = jnp.sqrt(jnp.finfo(hess.dtype).eps) * jnp.trace(hess) / cd
-    delta = jax.scipy.linalg.solve(
-        hess + eps * jnp.eye(cd, dtype=hess.dtype), grad, assume_a="pos"
+    hess = hess + eps * jnp.eye(cd, dtype=hess.dtype)
+    return _regularized_newton_solve(
+        w_flat, hess, grad, pen, m, reg_param, elastic_net_param
     )
-    return w_flat + delta, jnp.linalg.norm(delta)
 
 
 def predict_softmax_proba(

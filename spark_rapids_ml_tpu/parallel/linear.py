@@ -234,6 +234,7 @@ def make_distributed_softmax_fit(
     n_classes: int,
     *,
     reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
     fit_intercept: bool = True,
     max_iter: int = 25,
     tol: float = 1e-6,
@@ -273,6 +274,7 @@ def make_distributed_softmax_fit(
             stats = jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), stats)
             new_w, step = LIN.softmax_newton_update(
                 w_flat, stats, n_classes,
+                elastic_net_param=elastic_net_param,
                 reg_param=reg_param, fit_intercept=fit_intercept,
             )
             return new_w, it + 1, step

@@ -636,7 +636,6 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                 "Check for mislabeled/ID-like rows, or re-encode labels "
                 "densely as 0..C-1"
             )
-        self._check_multiclass_supported(n_classes)
         if distribution == "mesh-barrier":
             if n_classes > 2:
                 return self._fit_softmax_mesh_barrier(
@@ -724,6 +723,7 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                 spmd.MeshSoftmaxFitFn(
                     feats, label, weight_col, n_classes,
                     reg_param=self.getRegParam(),
+                    elastic_net_param=self.getElasticNetParam(),
                     fit_intercept=fit_intercept,
                     max_iter=self.getMaxIter(),
                     tol=self.getTol(),
@@ -811,7 +811,9 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                 )
                 new_w, step_norm = LIN.softmax_newton_update(
                     jnp.asarray(w_flat), stats, n_classes,
-                    reg_param=self.getRegParam(), fit_intercept=fit_intercept,
+                    reg_param=self.getRegParam(),
+                    elastic_net_param=self.getElasticNetParam(),
+                    fit_intercept=fit_intercept,
                 )
                 w_flat = np.asarray(new_w)
                 if ckpt is not None and (it + 1) % checkpoint_every == 0:

@@ -418,11 +418,13 @@ class MeshSoftmaxFitFn(MeshLogRegFitFn):
         fit_intercept: bool,
         max_iter: int,
         tol: float,
+        elastic_net_param: float = 0.0,
     ):
         super().__init__(
             features_col, label_col, weight_col,
             reg_param=reg_param, fit_intercept=fit_intercept,
             max_iter=max_iter, tol=tol,
+            elastic_net_param=elastic_net_param,
         )
         self.n_classes = int(n_classes)
 
@@ -433,6 +435,7 @@ class MeshSoftmaxFitFn(MeshLogRegFitFn):
             mesh,
             self.n_classes,
             reg_param=self.reg_param,
+            elastic_net_param=self.elastic_net_param,
             fit_intercept=self.fit_intercept,
             max_iter=self.max_iter,
             tol=self.tol,

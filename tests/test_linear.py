@@ -337,11 +337,41 @@ class TestLogRegElasticNet:
         b = LogisticRegression(regParam=0.01, elasticNetParam=0.0).fit((x, y))
         np.testing.assert_allclose(a.coefficients, b.coefficients)
 
-    def test_multinomial_alpha_rejected(self, rng):
+    def test_multinomial_lasso_matches_sklearn(self, rng):
+        # proximal Newton on the [C·d, C·d] softmax Fisher model vs sklearn
+        # saga multinomial L1 (same objective up to C = 1/(λ·m))
+        x = rng.normal(size=(450, 5))
+        w_true = np.zeros((3, 5))
+        w_true[0, 0], w_true[1, 1], w_true[2, 2] = 3.0, 3.0, -3.0
+        logits = x @ w_true.T
+        y = np.argmax(
+            logits + rng.gumbel(size=logits.shape), axis=1
+        ).astype(float)
+        lam = 0.01
+        m = LogisticRegression(
+            regParam=lam, elasticNetParam=1.0, maxIter=100, tol=1e-10
+        ).fit((x, y))
+        sk = SkLogistic(
+            l1_ratio=1.0, C=1.0 / (lam * len(y)), solver="saga",
+            tol=1e-12, max_iter=200_000,
+        ).fit(x, y)
+        # softmax has a per-coordinate-shift gauge freedom under L1 that
+        # sklearn resolves differently; compare class-margin DIFFERENCES
+        # via predicted probabilities instead of raw coefficients
+        ours = m.predict_proba_matrix(x)
+        theirs = sk.predict_proba(x)
+        np.testing.assert_allclose(ours, theirs, atol=5e-3)
+        # sparsity materialized: noise coordinates are exactly zero
+        w = np.asarray(m.coefficientMatrix)
+        assert np.sum(np.abs(w) < 1e-8) >= 6
+
+    def test_multinomial_alpha_accepted_all_paths(self, rng):
         x = rng.normal(size=(90, 3))
         y = np.repeat([0.0, 1.0, 2.0], 30)
-        with pytest.raises(ValueError, match="binary"):
-            LogisticRegression(elasticNetParam=0.5).fit((x, y))
+        m = LogisticRegression(
+            regParam=0.05, elasticNetParam=0.5, maxIter=40
+        ).fit((x, y))
+        assert m.coefficientMatrix.shape == (3, 3)
 
     def test_whole_loop_mesh_matches_host(self, cls_data):
         import jax

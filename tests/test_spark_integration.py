@@ -465,6 +465,31 @@ class TestSparkGLMIntegration:
         np.testing.assert_allclose(preds, np.argmax(proba, axis=1).astype(float))
         assert np.mean(preds == y) > 0.9
 
+    def test_multinomial_elastic_net_paths_agree(self, backend):
+        # softmax proximal Newton: driver-merge and mesh-barrier must match
+        # the core fit
+        rng = np.random.default_rng(67)
+        x = np.concatenate(
+            [rng.normal(size=(70, 4)) + off
+             for off in ([0, 0, 0, 0], [3, 0, 0, 0], [0, 3, 0, 0])]
+        )
+        y = np.repeat([0.0, 1.0, 2.0], 70)
+        df = self._labeled_df(backend, x, y)
+        core = LogisticRegression(
+            regParam=0.02, elasticNetParam=1.0, maxIter=60, tol=1e-10
+        ).fit((x, y))
+        est = SparkLogisticRegression(
+            regParam=0.02, elasticNetParam=1.0, maxIter=60, tol=1e-10
+        )
+        model = est.fit(df)
+        np.testing.assert_allclose(
+            model.coefficientMatrix, core.coefficientMatrix, atol=1e-8
+        )
+        barrier = est.copy().setDistribution("mesh-barrier").fit(df)
+        np.testing.assert_allclose(
+            barrier.coefficientMatrix, core.coefficientMatrix, atol=1e-6
+        )
+
     def test_logreg_newton_over_jobs(self, backend):
         # local rng: the train-accuracy threshold below is data-dependent,
         # so this test must see the SAME data regardless of which other
