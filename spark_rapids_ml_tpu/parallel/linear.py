@@ -95,6 +95,26 @@ def make_distributed_linreg_fit(
 
 
 @lru_cache(maxsize=None)
+def _linear_stats_weighted_prog(mesh: Mesh):
+    return jax.jit(
+        mapreduce_data_axis(
+            LIN.linear_stats,
+            mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        )
+    )
+
+
+def sharded_linear_stats_weighted(
+    x: jax.Array, y: jax.Array, w: jax.Array, mesh: Mesh
+) -> LIN.LinearStats:
+    """Weighted LinearStats over data-sharded operands — ``w`` carries
+    instance weights on true rows and 0.0 on pad rows (the framework-wide
+    masking convention), so padded shards reduce exactly."""
+    return _linear_stats_weighted_prog(mesh)(x, y, w)
+
+
+@lru_cache(maxsize=None)
 def _newton_stats_prog(mesh: Mesh):
     return jax.jit(
         mapreduce_data_axis(

@@ -488,6 +488,109 @@ def _infer_n(df, col: str) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _collect_labeled(selected, feats: str, label: str, weight_col):
+    """Stream (features, label[, weight]) columns to driver-side host
+    arrays — the ingestion step of the supervised 'mesh-local' deployment.
+    Returns (x [rows, n], y [rows], w [rows] instance weights or None)."""
+    if hasattr(selected, "toArrow"):
+        table = selected.toArrow()
+        x = columnar.extract_matrix(table, feats)
+        y = columnar.extract_vector(table, label)
+        w = None
+        if weight_col:
+            w = columnar.validate_weights(
+                columnar.extract_vector(table, weight_col),
+                len(x),
+                allow_all_zero=True,
+            )
+        return x, y, w
+    rows = selected.collect()  # PySpark 3.5 row fallback
+    x = np.stack([columnar.row_vector_to_ndarray(r[0]) for r in rows])
+    y = np.asarray([float(r[1]) for r in rows])
+    w = None
+    if weight_col:
+        w = columnar.validate_weights(
+            np.asarray([float(r[2]) for r in rows]), len(x),
+            allow_all_zero=True,
+        )
+    return x, y, w
+
+
+def _collect_weighted_matrix(selected, input_col: str, weight_col):
+    """Driver-side (x [rows, n], w [rows] or None) for unlabeled
+    weighted estimators (KMeans)."""
+    if hasattr(selected, "toArrow"):
+        table = selected.toArrow()
+        x = columnar.extract_matrix(table, input_col)
+        w = None
+        if weight_col:
+            w = columnar.validate_weights(
+                columnar.extract_vector(table, weight_col),
+                len(x),
+                allow_all_zero=True,
+            )
+        return x, w
+    rows = selected.collect()
+    x = np.stack([columnar.row_vector_to_ndarray(r[0]) for r in rows])
+    w = None
+    if weight_col:
+        w = columnar.validate_weights(
+            np.asarray([float(r[1]) for r in rows]), len(x),
+            allow_all_zero=True,
+        )
+    return x, w
+
+
+def _mesh_local_matrix(x, *, augment_intercept: bool = False):
+    """Pad a host [rows, n] matrix to the mesh-divisible bucket and shard
+    it over the driver's own device mesh — THE ingestion step every
+    'mesh-local' fit shares. Returns (xs, mesh, padded_rows, rows)."""
+    import jax
+
+    from spark_rapids_ml_tpu.parallel import mesh as M
+
+    if augment_intercept:
+        x = np.concatenate([x, np.ones((x.shape[0], 1), x.dtype)], axis=1)
+    mesh = M.create_mesh()
+    rows, n = x.shape
+    shard = columnar.bucket_rows(-(-rows // mesh.size))
+    padded_rows = shard * mesh.size
+    xp = np.zeros((padded_rows, n), dtype=np.float64)
+    xp[:rows] = x
+    xs = jax.device_put(xp, M.data_sharding(mesh))
+    return xs, mesh, padded_rows, rows
+
+
+def _mesh_local_vector(v, rows: int, padded_rows: int, mesh):
+    """Zero-pad + data-shard a per-row vector (labels, weights)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_ml_tpu.parallel import mesh as M
+
+    vp = np.zeros(padded_rows, dtype=np.float64)
+    vp[:rows] = v
+    return jax.device_put(vp, NamedSharding(mesh, P(M.DATA_AXIS)))
+
+
+def _mesh_local_labeled(x, y, w, *, augment_intercept: bool = False):
+    """Pad + shard labeled host arrays over the driver's own device mesh.
+
+    Returns (xs, ys, ws, mesh); ``ws`` carries instance weights (1.0
+    default) on true rows and 0.0 on pads — the framework-wide masking
+    convention, so every weighted mesh program reduces exactly on padded
+    shards.
+    """
+    xs, mesh, padded_rows, rows = _mesh_local_matrix(
+        x, augment_intercept=augment_intercept
+    )
+    ys = _mesh_local_vector(y, rows, padded_rows, mesh)
+    ws = _mesh_local_vector(
+        np.ones(rows) if w is None else w, rows, padded_rows, mesh
+    )
+    return xs, ys, ws, mesh
+
+
 class SparkLinearRegression(_HasDistribution, LinearRegression):
     """LinearRegression over pyspark DataFrames: one mapInArrow stats pass,
     driver-side normal-equations solve. Non-Spark inputs fall through.
@@ -496,7 +599,11 @@ class SparkLinearRegression(_HasDistribution, LinearRegression):
     one SPMD psum across the barrier stage's jax.distributed process group
     (spark/spmd.py MeshLinRegPartitionFn): the [n, n] normal-equations
     reductions ride the mesh interconnect and the driver receives a single
-    pre-reduced row."""
+    pre-reduced row. ``'mesh-local'`` streams rows to the driver and runs
+    the same psum program over ITS device mesh (the
+    one-device-owner-per-host deployment, utils/devicepolicy.py)."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-barrier", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
         if kwargs:
@@ -525,7 +632,21 @@ class SparkLinearRegression(_HasDistribution, LinearRegression):
             "y_sum": (), "y_sq": (), "count": (),
         }
         with trace_range("linreg stats"):
-            if self.getOrDefault("distribution") == "mesh-barrier":
+            distribution = self.getOrDefault("distribution")
+            if distribution == "mesh-local":
+                from spark_rapids_ml_tpu.parallel import linear as PL
+
+                x, y, w = _collect_labeled(
+                    dataset.select(*cols), feats, label, weight_col
+                )
+                if weight_col and float(np.sum(w)) == 0.0:
+                    raise ValueError("all instance weights are zero")
+                xs, ys, ws, mesh = _mesh_local_labeled(x, y, w)
+                stats = PL.sharded_linear_stats_weighted(xs, ys, ws, mesh)
+                arrays = {
+                    k: np.asarray(v) for k, v in zip(stats._fields, stats)
+                }
+            elif distribution == "mesh-barrier":
                 from spark_rapids_ml_tpu.spark import spmd
 
                 arrays = _barrier_single_row(
@@ -578,7 +699,12 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
     or >=3-class softmax, routed automatically — runs as one XLA program
     (lax.while_loop with the psum inside the body) across the barrier
     stage's jax.distributed mesh: zero driver round-trips during training
-    (spark/spmd.py MeshLogRegFitFn / MeshSoftmaxFitFn)."""
+    (spark/spmd.py MeshLogRegFitFn / MeshSoftmaxFitFn).
+    ``'mesh-local'``: rows stream to the driver, which runs the SAME
+    whole-loop program over its own device mesh - the
+    one-device-owner-per-host deployment."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-barrier", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
         if not _is_spark_df(dataset):
@@ -605,11 +731,11 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         selected = dataset.select(*cols)
         fit_intercept = self.getFitIntercept()
         distribution = self.getOrDefault("distribution")
-        if distribution == "mesh-barrier" and checkpoint_dir is not None:
+        if distribution != "driver-merge" and checkpoint_dir is not None:
             # params-only rejection: fail BEFORE any cluster job runs
             raise ValueError(
                 "checkpoint_dir requires distribution='driver-merge': "
-                "the mesh-barrier fit runs the whole training loop as "
+                f"the {distribution} fit runs the whole training loop as "
                 "one XLA program with no per-iteration driver hop to "
                 "checkpoint from"
             )
@@ -635,6 +761,10 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                 f"{_MAX_CLASSES} — the full-Newton Hessian is [C·d, C·d]. "
                 "Check for mislabeled/ID-like rows, or re-encode labels "
                 "densely as 0..C-1"
+            )
+        if distribution == "mesh-local":
+            return self._fit_mesh_local(
+                selected, feats, label, weight_col, n_classes, fit_intercept
             )
         if distribution == "mesh-barrier":
             if n_classes > 2:
@@ -744,6 +874,49 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
             interceptVector=intercepts,
         )
         return self._copyValues(model)
+
+    def _fit_mesh_local(
+        self, selected, feats, label, weight_col, n_classes, fit_intercept
+    ) -> "SparkLogisticRegressionModel":
+        """'mesh-local': ingest to the driver, run the whole-loop IRLS
+        program (binary or softmax) over the driver's own device mesh -
+        identical training program to the barrier path, minus the
+        process-group bootstrap."""
+        from spark_rapids_ml_tpu.parallel import linear as PL
+
+        x, y, w = _collect_labeled(selected, feats, label, weight_col)
+        if weight_col and float(np.sum(w)) == 0.0:
+            raise ValueError("all instance weights are zero")
+        xs, ys, ws, mesh = _mesh_local_labeled(
+            x, y, w, augment_intercept=fit_intercept
+        )
+        common = dict(
+            reg_param=self.getRegParam(),
+            elastic_net_param=self.getElasticNetParam(),
+            fit_intercept=fit_intercept,
+            max_iter=self.getMaxIter(),
+            tol=self.getTol(),
+        )
+        with trace_range("logreg mesh-local fit"):
+            if n_classes > 2:
+                fit_fn = PL.make_distributed_softmax_fit(
+                    mesh, n_classes, **common
+                )
+                w_flat, _, _ = fit_fn(xs, ys, ws)
+                w_mat = np.asarray(w_flat).reshape(n_classes, -1)
+                if fit_intercept:
+                    coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
+                else:
+                    coef_matrix, intercepts = w_mat, np.zeros(n_classes)
+                model = SparkLogisticRegressionModel(
+                    uid=self.uid,
+                    coefficientMatrix=coef_matrix,
+                    interceptVector=intercepts,
+                )
+                return self._copyValues(model)
+            fit_fn = PL.make_distributed_logreg_fit(mesh, **common)
+            w_full, _, _ = fit_fn(xs, ys, ws)
+            return self._binary_model(np.asarray(w_full), fit_intercept)
 
     def _binary_model(
         self, w_full: np.ndarray, fit_intercept: bool
@@ -873,7 +1046,11 @@ class SparkKMeans(_HasDistribution, KMeans):
     (``distribution='driver-merge'``, required for ``checkpoint_dir``) or
     as ONE barrier stage whose while_loop+psum program runs the entire
     Lloyd loop on the executor mesh (``'mesh-barrier'``, zero driver
-    round-trips during training — spark/spmd.py MeshKMeansFitFn)."""
+    round-trips during training — spark/spmd.py MeshKMeansFitFn), or with
+    rows streamed to the driver and the SAME while_loop+psum program run
+    over the driver's own mesh (``'mesh-local'``)."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-barrier", "mesh-local")
 
     _INIT_SAMPLE = 4096
 
@@ -900,13 +1077,11 @@ class SparkKMeans(_HasDistribution, KMeans):
         selected = dataset.select(*cols)
         k = self.getK()
 
-        if (
-            self.getOrDefault("distribution") == "mesh-barrier"
-            and checkpoint_dir is not None
-        ):
+        distribution = self.getOrDefault("distribution")
+        if distribution != "driver-merge" and checkpoint_dir is not None:
             raise ValueError(
                 "checkpoint_dir requires distribution='driver-merge': the "
-                "mesh-barrier fit runs the whole Lloyd loop as one XLA "
+                f"{distribution} fit runs the whole Lloyd loop as one XLA "
                 "program with no per-iteration driver hop to checkpoint from"
             )
         # resume BEFORE seeding: an interrupted Spark-path fit pointed at the
@@ -1013,6 +1188,27 @@ class SparkKMeans(_HasDistribution, KMeans):
         from spark_rapids_ml_tpu.ops import kmeans as KM
 
         k = self.getK()
+        if self.getOrDefault("distribution") == "mesh-local":
+            from spark_rapids_ml_tpu.parallel import kmeans as PK
+
+            x, w = _collect_weighted_matrix(selected, input_col, weight_col)
+            if weight_col and float(np.sum(w)) == 0.0:
+                raise ValueError("all instance weights are zero")
+            xs, mesh, padded_rows, rows = _mesh_local_matrix(x)
+            ws = _mesh_local_vector(
+                np.ones(rows) if w is None else w, rows, padded_rows, mesh
+            )
+            fit_fn = PK.make_distributed_kmeans_fit(
+                mesh, max_iter=self.getMaxIter(), tol=self.getTol()
+            )
+            with trace_range("kmeans mesh-local fit"):
+                centers_f, cost_f, _ = fit_fn(xs, ws, jnp.asarray(centers))
+            model = SparkKMeansModel(
+                uid=self.uid,
+                clusterCenters=np.asarray(centers_f),
+                trainingCost=float(cost_f),
+            )
+            return self._copyValues(model)
         if self.getOrDefault("distribution") == "mesh-barrier":
             from spark_rapids_ml_tpu.spark import spmd
 
@@ -1218,7 +1414,11 @@ class SparkKMeansModel(KMeansModel):
 class SparkStandardScaler(_HasDistribution, StandardScaler):
     """StandardScaler over pyspark DataFrames: one mapInArrow moments pass;
     ``distribution='mesh-barrier'`` reduces the moments as one SPMD psum
-    across the barrier stage's process group (spark/spmd.py)."""
+    across the barrier stage's process group (spark/spmd.py);
+    ``'mesh-local'`` streams rows to the driver and runs the same psum
+    program over its own device mesh."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-barrier", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         if not _is_spark_df(dataset):
@@ -1235,7 +1435,20 @@ class SparkStandardScaler(_HasDistribution, StandardScaler):
         n = _infer_n(dataset, input_col)
         shapes = {"count": (), "total": (n,), "total_sq": (n,)}
         with trace_range("scaler moments"):
-            if self.getOrDefault("distribution") == "mesh-barrier":
+            if self.getOrDefault("distribution") == "mesh-local":
+                from spark_rapids_ml_tpu.parallel import gram as G
+
+                x, _ = _collect_weighted_matrix(
+                    dataset.select(input_col), input_col, None
+                )
+                xs, mesh, _, rows = _mesh_local_matrix(x)
+                mstats = G.sharded_moment_stats(xs, mesh)
+                arrays = {
+                    "count": np.float64(rows),  # pads are zero rows
+                    "total": np.asarray(mstats.total),
+                    "total_sq": np.asarray(mstats.total_sq),
+                }
+            elif self.getOrDefault("distribution") == "mesh-barrier":
                 from spark_rapids_ml_tpu.spark import spmd
 
                 arrays = _barrier_single_row(
@@ -1277,7 +1490,11 @@ class SparkTruncatedSVD(_HasDistribution, TruncatedSVD):
     R-factor pass (solver 'svd', cond(X) accuracy) through mapInArrow, then
     the replicated decomposition on the driver; ``distribution=
     'mesh-barrier'`` reduces on the barrier stage's SPMD mesh instead (psum
-    Gram, or the butterfly-TSQR R merge for solver='svd')."""
+    Gram, or the butterfly-TSQR R merge for solver='svd');
+    ``'mesh-local'`` streams rows to the driver and runs the psum Gram (or
+    the pad-masked TSQR for solver='svd') over its own device mesh."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-barrier", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         if not _is_spark_df(dataset):
@@ -1300,6 +1517,8 @@ class SparkTruncatedSVD(_HasDistribution, TruncatedSVD):
             raise ValueError(f"k={k} must be <= number of features {n}")
         solver = self.getOrDefault("solver")
         distribution = self.getOrDefault("distribution")
+        if distribution == "mesh-local":
+            return self._fit_mesh_local(selected, input_col, n, k, solver)
         if distribution == "mesh-barrier" and solver == "svd":
             from spark_rapids_ml_tpu.spark import spmd
 
@@ -1346,6 +1565,45 @@ class SparkTruncatedSVD(_HasDistribution, TruncatedSVD):
             else:
                 components, sv = TSVD._decompose_gram_jit(
                     jnp.asarray(xtx), k, solver
+                )
+        model = SparkTruncatedSVDModel(
+            uid=self.uid,
+            components=np.asarray(components),
+            singularValues=np.asarray(sv[:k]),
+        )
+        return self._copyValues(model)
+
+
+    def _fit_mesh_local(
+        self, selected, input_col: str, n: int, k: int, solver: str
+    ) -> "SparkTruncatedSVDModel":
+        """'mesh-local': driver-side ingestion, then the sharded Gram
+        psum (gram-route solvers) or the pad-masked butterfly TSQR
+        (solver='svd') over the driver's own device mesh."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.models import truncated_svd as TSVD
+        from spark_rapids_ml_tpu.parallel import gram as G
+        from spark_rapids_ml_tpu.parallel import mesh as M
+        from spark_rapids_ml_tpu.parallel import tsqr as TSQR
+
+        x, _ = _collect_weighted_matrix(selected, input_col, None)
+        xs, mesh, _, _ = _mesh_local_matrix(x)
+        with trace_range("tsvd mesh-local fit"):
+            if solver == "svd":
+                # zero pad rows are exact for the UNcentered QR
+                # (R of [X; 0] == R of X), so the plain butterfly TSQR
+                # applies; the replicated SVD of R finishes on the driver
+                r = TSQR.tsqr_r(xs, mesh)
+                components, sv = L.svd_components_from_r(jnp.asarray(r), k)
+            else:
+                stats = G.sharded_gram_stats(
+                    xs, mesh,
+                    precision=L.PRECISIONS[self.getOrDefault("precision")],
+                )
+                components, sv = TSVD._decompose_gram_jit(
+                    stats.xtx, k, solver
                 )
         model = SparkTruncatedSVDModel(
             uid=self.uid,

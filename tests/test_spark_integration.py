@@ -927,3 +927,134 @@ class TestEmptyDataFrameCost:
         T = backend.T
         empty = backend.df([], backend.features_schema(), partitions=2)
         assert model.computeCost(empty) == 0.0
+
+
+class TestMeshLocalDistribution:
+    """'mesh-local' (driver-mesh psum programs) must match the core fits —
+    the r3 completion of the distribution x estimator matrix; PCA had it,
+    now the whole family does."""
+
+    def _fdf(self, backend, x, extra_cols=()):
+        rows = [
+            (xr.tolist(), *vals) for xr, *vals in zip(x, *extra_cols)
+        ] if extra_cols else [(xr.tolist(),) for xr in x]
+        T = backend.T
+        schema_fields = [T.StructField("features", T.ArrayType(T.DoubleType()))]
+        names = ["label", "wt"]
+        for i, _ in enumerate(extra_cols):
+            schema_fields.append(T.StructField(names[i], T.DoubleType()))
+        return backend.df(rows, T.StructType(schema_fields), partitions=3)
+
+    def test_linreg_mesh_local(self, backend):
+        rng = np.random.default_rng(91)
+        x = rng.normal(size=(300, 5))
+        y = x @ np.array([1.0, -2.0, 0.0, 0.5, 3.0]) + 1.0
+        df = self._fdf(backend, x, (y,))
+        core = LinearRegression(regParam=0.05).fit((x, y))
+        m = (
+            SparkLinearRegression(regParam=0.05)
+            .setDistribution("mesh-local").fit(df)
+        )
+        np.testing.assert_allclose(m.coefficients, core.coefficients, atol=1e-9)
+        np.testing.assert_allclose(m.intercept, core.intercept, atol=1e-9)
+
+    def test_linreg_mesh_local_weighted_elastic(self, backend):
+        rng = np.random.default_rng(92)
+        x = rng.normal(size=(240, 4))
+        y = x @ np.array([2.0, 0.0, -1.0, 0.0]) + 0.3
+        w = rng.uniform(0.2, 2.0, size=240)
+        df = self._fdf(backend, x, (y, w))
+        core = LinearRegression(
+            regParam=0.05, elasticNetParam=1.0, tol=1e-12
+        ).fit((x, y, w))
+        m = (
+            SparkLinearRegression(
+                regParam=0.05, elasticNetParam=1.0, tol=1e-12
+            )
+            .setWeightCol("wt").setDistribution("mesh-local").fit(df)
+        )
+        np.testing.assert_allclose(m.coefficients, core.coefficients, atol=1e-9)
+
+    def test_logreg_mesh_local_binary_and_multinomial(self, backend):
+        rng = np.random.default_rng(93)
+        x = rng.normal(size=(300, 4))
+        p = 1 / (1 + np.exp(-(x @ np.array([2.0, -1.0, 0.5, 0.0]))))
+        y = (rng.uniform(size=300) < p).astype(float)
+        df = self._fdf(backend, x, (y,))
+        core = LogisticRegression(regParam=0.01, maxIter=20, tol=1e-10).fit((x, y))
+        m = (
+            SparkLogisticRegression(regParam=0.01, maxIter=20, tol=1e-10)
+            .setDistribution("mesh-local").fit(df)
+        )
+        np.testing.assert_allclose(m.coefficients, core.coefficients, atol=1e-8)
+
+        x3 = np.concatenate(
+            [rng.normal(size=(60, 3)) + off
+             for off in ([0, 0, 0], [3, 0, 0], [0, 3, 0])]
+        )
+        y3 = np.repeat([0.0, 1.0, 2.0], 60)
+        df3 = self._fdf(backend, x3, (y3,))
+        core3 = LogisticRegression(regParam=0.02, maxIter=30, tol=1e-10).fit((x3, y3))
+        m3 = (
+            SparkLogisticRegression(regParam=0.02, maxIter=30, tol=1e-10)
+            .setDistribution("mesh-local").fit(df3)
+        )
+        np.testing.assert_allclose(
+            m3.coefficientMatrix, core3.coefficientMatrix, atol=1e-7
+        )
+
+    def test_kmeans_mesh_local(self, backend):
+        rng = np.random.default_rng(94)
+        x = np.concatenate(
+            [rng.normal(size=(80, 3)) + off
+             for off in ([0, 0, 0], [6, 0, 0], [0, 6, 0])]
+        )
+        df = self._fdf(backend, x)
+        core = KMeans(k=3, seed=5, maxIter=15).fit(x)
+        m = (
+            SparkKMeans(k=3, seed=5, maxIter=15)
+            .setInputCol("features").setDistribution("mesh-local").fit(df)
+        )
+        # seeding differs between the core and DataFrame paths (different
+        # samplers), but on well-separated clusters both Lloyd loops must
+        # converge to the same three centroids
+        a = np.asarray(sorted(np.asarray(core.clusterCenters).tolist()))
+        b = np.asarray(sorted(np.asarray(m.clusterCenters).tolist()))
+        np.testing.assert_allclose(a, b, atol=0.5)
+        assert abs(float(m.trainingCost) - float(core.trainingCost)) < 0.05 * float(
+            core.trainingCost
+        )
+
+    def test_scaler_mesh_local(self, backend):
+        rng = np.random.default_rng(95)
+        x = rng.normal(size=(200, 6)) * 3.0 + 1.0
+        df = self._fdf(backend, x)
+        core = StandardScaler().setInputCol("features").fit(x)
+        m = (
+            SparkStandardScaler().setInputCol("features")
+            .setDistribution("mesh-local").fit(df)
+        )
+        np.testing.assert_allclose(m.mean, core.mean, atol=1e-10)
+        np.testing.assert_allclose(m.std, core.std, atol=1e-10)
+
+    @pytest.mark.parametrize("solver", ["gram", "svd"])
+    def test_tsvd_mesh_local(self, backend, solver):
+        from spark_rapids_ml_tpu import TruncatedSVD
+        from spark_rapids_ml_tpu.spark import SparkTruncatedSVD
+
+        rng = np.random.default_rng(96)
+        x = rng.normal(size=(200, 8)) @ rng.normal(size=(8, 8))
+        df = self._fdf(backend, x)
+        core = (
+            TruncatedSVD(k=3).setInputCol("features").setSolver(solver).fit(x)
+        )
+        m = (
+            SparkTruncatedSVD(k=3).setInputCol("features").setSolver(solver)
+            .setDistribution("mesh-local").fit(df)
+        )
+        np.testing.assert_allclose(
+            np.abs(m.components), np.abs(core.components), atol=1e-8
+        )
+        np.testing.assert_allclose(
+            m.singularValues, core.singularValues, rtol=1e-10
+        )
