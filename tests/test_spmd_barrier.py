@@ -231,8 +231,9 @@ class TestMeshBarrierBeyondPCA:
             SparkStandardScaler,
         )
 
-        with pytest.raises(ValueError, match="distribution"):
-            SparkLinearRegression().setDistribution("mesh-local")
+        # mesh-local became family-wide in r3 — it must be ACCEPTED now
+        est = SparkLinearRegression().setDistribution("mesh-local")
+        assert est.getOrDefault("distribution") == "mesh-local"
         with pytest.raises(ValueError, match="distribution"):
             SparkStandardScaler().setDistribution("gossip")
 
