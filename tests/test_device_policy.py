@@ -157,6 +157,66 @@ def test_use_platform_mismatch_raises():
         jax.config.update("jax_platforms", "cpu")  # restore for later tests
 
 
+def test_probe_transport_subprocess_cpu_ok():
+    # CPU-scrubbed child: proves the subprocess probe mechanics (fresh
+    # interpreter, self-bounded exit, platform on stdout) without touching
+    # any accelerator transport
+    ok, detail = devicepolicy.probe_transport_subprocess(
+        timeout=60, env_overrides=devicepolicy.worker_env("cpu")
+    )
+    assert ok, detail
+    assert detail == "cpu"
+
+
+def test_probe_transport_subprocess_failure_is_returned_not_raised():
+    # a child whose probe must time out instantly reports (False, diagnosis)
+    ok, detail = devicepolicy.probe_transport_subprocess(
+        timeout=1e-6, env_overrides=devicepolicy.worker_env("cpu")
+    )
+    assert not ok
+    assert "did not complete within" in detail
+
+
+def test_wait_for_transport_recovers_after_transient_failure():
+    calls = []
+
+    def flaky_probe(timeout):
+        calls.append(timeout)
+        if len(calls) < 3:
+            return False, "wedged"
+        return True, "axon"
+
+    msgs = []
+    platform = devicepolicy.wait_for_transport(
+        window=60,
+        attempt_timeout=5,
+        backoff_start=0.01,
+        backoff_max=0.02,
+        log=msgs.append,
+        probe=flaky_probe,
+    )
+    assert platform == "axon"
+    assert len(calls) == 3
+    assert any("retrying" in m for m in msgs)
+
+
+def test_wait_for_transport_window_expiry_raises_with_attempt_log():
+    def dead_probe(timeout):
+        return False, "transport permanently wedged"
+
+    with pytest.raises(devicepolicy.DevicePolicyError) as err:
+        devicepolicy.wait_for_transport(
+            window=0.05,
+            attempt_timeout=1,
+            backoff_start=0.02,
+            backoff_max=0.02,
+            log=lambda m: None,
+            probe=dead_probe,
+        )
+    assert "did not become healthy" in str(err.value)
+    assert "permanently wedged" in str(err.value)
+
+
 def test_probe_platform_none_accepts_any(monkeypatch):
     # expected=None must mean "any platform is fine" even when the worker
     # env contract var is present — an env var must not re-enable a check
