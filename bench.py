@@ -61,8 +61,8 @@ K = 50
 A100_ESTIMATE_S = 0.092
 PAIRS = 5
 ACCURACY_ROWS = 200_000
-DF_ROWS = 100_000
-DF_N = 256
+DF_ROWS = 250_000  # streamed mesh-local ingest (r4): host RSS is O(shard),
+DF_N = 256         # so the end-to-end shape is no longer driver-RAM-bound
 KM_ROWS = 4_000_000
 KM_N = 128
 KM_K = 1000

@@ -30,7 +30,26 @@ class Param(Generic[T]):
         return f"Param({self.name})"
 
 
-class Params:
+class _ParamsMeta(type):
+    """Applies constructor param kwargs AFTER the whole ``__init__`` chain.
+
+    ``Params.__init__`` runs first in every subclass chain, so applying
+    kwargs there means setters fire before any subclass ``_setDefault`` —
+    a setter that reads a sibling param via ``getOrDefault`` during
+    validation would KeyError at construction. Deferring to post-``__init__``
+    gives setters the fully-defaulted instance the fluent spelling
+    (``PCA().setK(3)``) gives them.
+    """
+
+    def __call__(cls, *args, **kwargs):
+        obj = super().__call__(*args, **kwargs)
+        pending = obj.__dict__.pop("_pendingCtorKwargs", None)
+        if pending:
+            obj._applyCtorKwargs(pending)
+        return obj
+
+
+class Params(metaclass=_ParamsMeta):
     """Base class carrying a param map + default map keyed by param name.
 
     Mirrors Spark ML semantics: explicitly-set values shadow defaults
@@ -43,9 +62,15 @@ class Params:
         self._paramMap: dict[str, Any] = {}
         self._defaultParamMap: dict[str, Any] = {}
         # pyspark.ml-style constructor params: PCA(k=3) == PCA().setK(3).
+        # Stashed here and applied by _ParamsMeta once the full __init__
+        # chain (including every subclass _setDefault) has run.
+        self._pendingCtorKwargs = kwargs
+
+    def _applyCtorKwargs(self, kwargs: dict[str, Any]) -> None:
         # Values route through the fluent setter when the class defines one,
         # so setter-side validation (setInitMode's allowed values, ...) holds
         # for both spellings; None means "leave unset", as in pyspark.
+        # Applied in the caller's keyword order.
         for name, value in kwargs.items():
             if value is None:
                 continue

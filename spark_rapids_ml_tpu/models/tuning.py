@@ -13,6 +13,7 @@ k-fold × m-candidate sweep compiles each kernel once, not k·m times.
 
 from __future__ import annotations
 
+import warnings
 from typing import Any
 
 import numpy as np
@@ -285,22 +286,44 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         return self._set(rawPredictionCol=value)
 
     def _score_pair(self, dataset):
-        """(labels, scores) with rawPredictionCol preferred for ranking."""
-        raw_col = self.getOrDefault("rawPredictionCol")
+        """(labels, scores) with a score column preferred for ranking.
+
+        Column choice: ``rawPredictionCol`` if present, else a
+        ``probability`` column (this framework's classifiers emit
+        probabilityCol, conventionally named 'probability', and never a
+        'rawPrediction' column — without this fallback the out-of-the-box
+        evaluator would silently rank on hard labels), else degrade to
+        ``predictionCol`` with a warning (hard labels give the degenerate
+        two-level AUC)."""
         label_col = self.getOrDefault("labelCol")
-        if raw_col and raw_col in _column_names(dataset):
+        columns = _column_names(dataset)
+        score_col = None
+        for candidate in (self.getOrDefault("rawPredictionCol"), "probability"):
+            if candidate and candidate in columns:
+                score_col = candidate
+                break
+        if score_col is not None:
             if _is_spark_df(dataset):
-                y, s = _df_columns(dataset, label_col, raw_col)
+                y, s = _df_columns(dataset, label_col, score_col)
             else:
                 y = _labels_of(dataset, label_col)
                 try:  # vector column ([rows, C] probability/margins)...
-                    s = columnar.extract_matrix(dataset, raw_col)
+                    s = columnar.extract_matrix(dataset, score_col)
                 except (TypeError, ValueError):  # ...or a scalar score
-                    s = columnar.extract_vector(dataset, raw_col)
+                    s = columnar.extract_vector(dataset, score_col)
             s = np.asarray(s, dtype=np.float64)
             if s.ndim == 2:
                 s = s[:, -1]  # positive-class score, pyspark.ml convention
             return y, s
+        warnings.warn(
+            "BinaryClassificationEvaluator: no score column found (looked "
+            f"for {self.getOrDefault('rawPredictionCol')!r} and "
+            "'probability'); areaUnderROC degrades to the two-level AUC of "
+            "hard labels. Point rawPredictionCol at your model's "
+            "probability output (e.g. setRawPredictionCol('probability') "
+            "with LogisticRegression().setProbabilityCol('probability')).",
+            stacklevel=3,
+        )
         return self._labeled_pair(dataset, None)
 
     def evaluate(self, dataset, predictions=None) -> float:

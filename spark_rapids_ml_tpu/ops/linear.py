@@ -123,12 +123,19 @@ def _soft_threshold(v: jax.Array, thresh) -> jax.Array:
 
 
 def _power_lam_max(a: jax.Array) -> jax.Array:
-    """λmax estimate of PSD ``a`` via power iteration, with a trace
-    fallback: λmax ≥ trace/n always holds, so a Rayleigh estimate below
-    that means the iteration collapsed (v0 happened to be ⊥ range(a) —
-    e.g. exactly-cancelling column pairs zero out a·1). trace(a) is then a
-    valid PSD upper bound: a smaller step, never a divergent one (an
-    underestimated Lipschitz constant makes FISTA blow up silently)."""
+    """λmax estimate of PSD ``a`` via power iteration.
+
+    FISTA's step 1/L is only covered by the convergence guarantee when the
+    L estimate is ≥ λmax_true, and 32 fixed iterations can sit slightly
+    below it when the spectral gap is small. Defenses, in order: use
+    ‖a·v‖ of the final unit iterate (≥ the Rayleigh quotient, still ≤
+    λmax), inflate by 5% (a marginally smaller step costs a few
+    iterations; an underestimated L makes FISTA blow up silently), and
+    clamp into the always-valid PSD envelope [trace/n, trace] — the lower
+    edge catches a collapsed iteration (v0 ⊥ range(a), e.g.
+    exactly-cancelling column pairs zero out a·1) by falling back to the
+    trace upper bound, and the upper edge keeps the inflation from
+    overshooting past a bound we know holds."""
     n = a.shape[0]
 
     def power_body(_, v):
@@ -137,9 +144,10 @@ def _power_lam_max(a: jax.Array) -> jax.Array:
 
     v0 = jnp.ones((n,), a.dtype) / jnp.sqrt(jnp.asarray(n, a.dtype))
     v = lax.fori_loop(0, 32, power_body, v0)
-    ray = jnp.vdot(v, a @ v)
+    norm_bound = jnp.linalg.norm(a @ v)
     tr = jnp.trace(a)
-    return jnp.where(ray >= tr / n, ray, tr)
+    est = 1.05 * norm_bound
+    return jnp.where(est >= tr / n, jnp.minimum(est, tr), tr)
 
 
 def _fista(grad, thresh, eta, w0, max_iter, tol):

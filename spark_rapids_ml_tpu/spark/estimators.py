@@ -230,31 +230,27 @@ class SparkPCA(_HasDistribution, PCA):
 
         mean_centering = self.getMeanCentering()
         if distribution == "mesh-local":
-            import jax
-
-            from spark_rapids_ml_tpu.parallel import mesh as M
             from spark_rapids_ml_tpu.parallel import tsqr as TSQR
+            from spark_rapids_ml_tpu.spark import ingest
 
-            mat = self._collect_matrix(selected, input_col)
-            rows = mat.shape[0]
-            mesh = M.create_mesh()
-            ndev = mesh.size
-            shard = columnar.bucket_rows(-(-rows // ndev))
-            padded = np.zeros((shard * ndev, n), dtype=mat.dtype)
-            padded[:rows] = mat
+            # streamed O(shard)-host ingestion; centering happens in-program
+            # with the pad mask (zero pad rows are exact for the uncentered
+            # QR, but (x−μ) would turn them into −μ rows — the masked
+            # program re-masks after centering)
+            ing = ingest.stream_to_mesh(
+                selected, features_col=input_col, n=n,
+                with_weights=mean_centering,
+            )
             if mean_centering:
-                # center BEFORE padding-aware fit: the mesh TSQR centers by
-                # shard statistics of the padded array, whose pad rows would
-                # bias the mean — use the true-row mean here instead
-                padded[:rows] -= mat.mean(axis=0, dtype=np.float64).astype(
-                    mat.dtype
+                fit_svd = TSQR.make_distributed_fit_svd_masked(
+                    ing.mesh, k, mean_centering=True
                 )
-            fit_svd = TSQR.make_distributed_fit_svd(
-                mesh, k, mean_centering=False
-            )
-            pc, ev = fit_svd(
-                jax.device_put(jnp.asarray(padded), M.data_sharding(mesh))
-            )
+                pc, ev = fit_svd(ing.xs, ing.ws)
+            else:
+                fit_svd = TSQR.make_distributed_fit_svd(
+                    ing.mesh, k, mean_centering=False
+                )
+                pc, ev = fit_svd(ing.xs)
         elif distribution == "mesh-barrier":
             # butterfly TSQR across the barrier stage's process mesh: the
             # driver receives only the finished (pc, ev)
@@ -296,46 +292,26 @@ class SparkPCA(_HasDistribution, PCA):
         )
         return self._copyValues(model)
 
-    def _collect_matrix(self, selected, input_col: str) -> np.ndarray:
-        """Stream the input column to one driver-side [rows, n] ndarray —
-        the ingestion step of the 'mesh-local' deployment."""
-        if hasattr(selected, "toArrow"):
-            batches = selected.toArrow().to_batches()
-            mats = [
-                columnar.extract_matrix(b, input_col)
-                for b in batches
-                if b.num_rows
-            ]
-            return np.concatenate(mats, axis=0)
-        return np.stack(  # PySpark 3.5: row collect fallback
-            [columnar.row_vector_to_ndarray(r[0]) for r in selected.collect()]
-        )
-
     def _mesh_local_stats(self, selected, input_col: str, n: int) -> L.GramStats:
-        """'mesh-local': stream rows to the driver and run the psum Gram
-        program over the driver's own device mesh (parallel/gram.py) — the
-        deployment where one process owns every local chip and DataFrame
-        workers only do ingestion. Same XLA program as the in-core mesh
-        path; zero pad rows are exact, the true count overrides."""
-        import jax
+        """'mesh-local': stream rows shard-by-shard onto the driver's own
+        device mesh (spark/ingest.py — O(shard) host RSS) and run the psum
+        Gram program (parallel/gram.py) — the deployment where one process
+        owns every local chip and DataFrame workers only do ingestion. Same
+        XLA program as the in-core mesh path; zero pad rows are exact, the
+        true count overrides."""
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.parallel import gram as G
-        from spark_rapids_ml_tpu.parallel import mesh as M
+        from spark_rapids_ml_tpu.spark import ingest
 
-        mat = self._collect_matrix(selected, input_col)
-        rows = mat.shape[0]
-        mesh = M.create_mesh()
-        ndev = mesh.size
-        shard = columnar.bucket_rows(-(-rows // ndev))
-        padded = np.zeros((shard * ndev, n), dtype=mat.dtype)
-        padded[:rows] = mat
-        xs = jax.device_put(jnp.asarray(padded), M.data_sharding(mesh))
+        ing = ingest.stream_to_mesh(selected, features_col=input_col, n=n)
         stats = G.sharded_gram_stats(
-            xs, mesh, precision=L.PRECISIONS[self.getOrDefault("precision")]
+            ing.xs, ing.mesh,
+            precision=L.PRECISIONS[self.getOrDefault("precision")],
         )
         return L.GramStats(
-            stats.xtx, stats.col_sum, jnp.asarray(float(rows), stats.count.dtype)
+            stats.xtx, stats.col_sum,
+            jnp.asarray(float(ing.rows), stats.count.dtype),
         )
 
 
@@ -488,109 +464,6 @@ def _infer_n(df, col: str) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _collect_labeled(selected, feats: str, label: str, weight_col):
-    """Stream (features, label[, weight]) columns to driver-side host
-    arrays — the ingestion step of the supervised 'mesh-local' deployment.
-    Returns (x [rows, n], y [rows], w [rows] instance weights or None)."""
-    if hasattr(selected, "toArrow"):
-        table = selected.toArrow()
-        x = columnar.extract_matrix(table, feats)
-        y = columnar.extract_vector(table, label)
-        w = None
-        if weight_col:
-            w = columnar.validate_weights(
-                columnar.extract_vector(table, weight_col),
-                len(x),
-                allow_all_zero=True,
-            )
-        return x, y, w
-    rows = selected.collect()  # PySpark 3.5 row fallback
-    x = np.stack([columnar.row_vector_to_ndarray(r[0]) for r in rows])
-    y = np.asarray([float(r[1]) for r in rows])
-    w = None
-    if weight_col:
-        w = columnar.validate_weights(
-            np.asarray([float(r[2]) for r in rows]), len(x),
-            allow_all_zero=True,
-        )
-    return x, y, w
-
-
-def _collect_weighted_matrix(selected, input_col: str, weight_col):
-    """Driver-side (x [rows, n], w [rows] or None) for unlabeled
-    weighted estimators (KMeans)."""
-    if hasattr(selected, "toArrow"):
-        table = selected.toArrow()
-        x = columnar.extract_matrix(table, input_col)
-        w = None
-        if weight_col:
-            w = columnar.validate_weights(
-                columnar.extract_vector(table, weight_col),
-                len(x),
-                allow_all_zero=True,
-            )
-        return x, w
-    rows = selected.collect()
-    x = np.stack([columnar.row_vector_to_ndarray(r[0]) for r in rows])
-    w = None
-    if weight_col:
-        w = columnar.validate_weights(
-            np.asarray([float(r[1]) for r in rows]), len(x),
-            allow_all_zero=True,
-        )
-    return x, w
-
-
-def _mesh_local_matrix(x, *, augment_intercept: bool = False):
-    """Pad a host [rows, n] matrix to the mesh-divisible bucket and shard
-    it over the driver's own device mesh — THE ingestion step every
-    'mesh-local' fit shares. Returns (xs, mesh, padded_rows, rows)."""
-    import jax
-
-    from spark_rapids_ml_tpu.parallel import mesh as M
-
-    if augment_intercept:
-        x = np.concatenate([x, np.ones((x.shape[0], 1), x.dtype)], axis=1)
-    mesh = M.create_mesh()
-    rows, n = x.shape
-    shard = columnar.bucket_rows(-(-rows // mesh.size))
-    padded_rows = shard * mesh.size
-    xp = np.zeros((padded_rows, n), dtype=np.float64)
-    xp[:rows] = x
-    xs = jax.device_put(xp, M.data_sharding(mesh))
-    return xs, mesh, padded_rows, rows
-
-
-def _mesh_local_vector(v, rows: int, padded_rows: int, mesh):
-    """Zero-pad + data-shard a per-row vector (labels, weights)."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from spark_rapids_ml_tpu.parallel import mesh as M
-
-    vp = np.zeros(padded_rows, dtype=np.float64)
-    vp[:rows] = v
-    return jax.device_put(vp, NamedSharding(mesh, P(M.DATA_AXIS)))
-
-
-def _mesh_local_labeled(x, y, w, *, augment_intercept: bool = False):
-    """Pad + shard labeled host arrays over the driver's own device mesh.
-
-    Returns (xs, ys, ws, mesh); ``ws`` carries instance weights (1.0
-    default) on true rows and 0.0 on pads — the framework-wide masking
-    convention, so every weighted mesh program reduces exactly on padded
-    shards.
-    """
-    xs, mesh, padded_rows, rows = _mesh_local_matrix(
-        x, augment_intercept=augment_intercept
-    )
-    ys = _mesh_local_vector(y, rows, padded_rows, mesh)
-    ws = _mesh_local_vector(
-        np.ones(rows) if w is None else w, rows, padded_rows, mesh
-    )
-    return xs, ys, ws, mesh
-
-
 class SparkLinearRegression(_HasDistribution, LinearRegression):
     """LinearRegression over pyspark DataFrames: one mapInArrow stats pass,
     driver-side normal-equations solve. Non-Spark inputs fall through.
@@ -635,14 +508,18 @@ class SparkLinearRegression(_HasDistribution, LinearRegression):
             distribution = self.getOrDefault("distribution")
             if distribution == "mesh-local":
                 from spark_rapids_ml_tpu.parallel import linear as PL
+                from spark_rapids_ml_tpu.spark import ingest
 
-                x, y, w = _collect_labeled(
-                    dataset.select(*cols), feats, label, weight_col
+                ing = ingest.stream_to_mesh(
+                    dataset.select(*cols), features_col=feats, n=n,
+                    label_col=label, weight_col=weight_col,
+                    with_weights=True,
                 )
-                if weight_col and float(np.sum(w)) == 0.0:
+                if weight_col and float(ing.ws.sum()) == 0.0:
                     raise ValueError("all instance weights are zero")
-                xs, ys, ws, mesh = _mesh_local_labeled(x, y, w)
-                stats = PL.sharded_linear_stats_weighted(xs, ys, ws, mesh)
+                stats = PL.sharded_linear_stats_weighted(
+                    ing.xs, ing.ys, ing.ws, ing.mesh
+                )
                 arrays = {
                     k: np.asarray(v) for k, v in zip(stats._fields, stats)
                 }
@@ -764,7 +641,8 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
             )
         if distribution == "mesh-local":
             return self._fit_mesh_local(
-                selected, feats, label, weight_col, n_classes, fit_intercept
+                selected, feats, label, weight_col, n, n_classes,
+                fit_intercept,
             )
         if distribution == "mesh-barrier":
             if n_classes > 2:
@@ -876,20 +754,23 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         return self._copyValues(model)
 
     def _fit_mesh_local(
-        self, selected, feats, label, weight_col, n_classes, fit_intercept
+        self, selected, feats, label, weight_col, n, n_classes, fit_intercept
     ) -> "SparkLogisticRegressionModel":
-        """'mesh-local': ingest to the driver, run the whole-loop IRLS
-        program (binary or softmax) over the driver's own device mesh -
+        """'mesh-local': stream-ingest onto the driver's own device mesh,
+        run the whole-loop IRLS program (binary or softmax) over it -
         identical training program to the barrier path, minus the
         process-group bootstrap."""
         from spark_rapids_ml_tpu.parallel import linear as PL
+        from spark_rapids_ml_tpu.spark import ingest
 
-        x, y, w = _collect_labeled(selected, feats, label, weight_col)
-        if weight_col and float(np.sum(w)) == 0.0:
-            raise ValueError("all instance weights are zero")
-        xs, ys, ws, mesh = _mesh_local_labeled(
-            x, y, w, augment_intercept=fit_intercept
+        ing = ingest.stream_to_mesh(
+            selected, features_col=feats, n=n,
+            label_col=label, weight_col=weight_col, with_weights=True,
+            augment_intercept=fit_intercept,
         )
+        if weight_col and float(ing.ws.sum()) == 0.0:
+            raise ValueError("all instance weights are zero")
+        xs, ys, ws, mesh = ing.xs, ing.ys, ing.ws, ing.mesh
         common = dict(
             reg_param=self.getRegParam(),
             elastic_net_param=self.getElasticNetParam(),
@@ -1191,18 +1072,21 @@ class SparkKMeans(_HasDistribution, KMeans):
         if self.getOrDefault("distribution") == "mesh-local":
             from spark_rapids_ml_tpu.parallel import kmeans as PK
 
-            x, w = _collect_weighted_matrix(selected, input_col, weight_col)
-            if weight_col and float(np.sum(w)) == 0.0:
-                raise ValueError("all instance weights are zero")
-            xs, mesh, padded_rows, rows = _mesh_local_matrix(x)
-            ws = _mesh_local_vector(
-                np.ones(rows) if w is None else w, rows, padded_rows, mesh
+            from spark_rapids_ml_tpu.spark import ingest
+
+            ing = ingest.stream_to_mesh(
+                selected, features_col=input_col, n=centers.shape[1],
+                weight_col=weight_col, with_weights=True,
             )
+            if weight_col and float(ing.ws.sum()) == 0.0:
+                raise ValueError("all instance weights are zero")
             fit_fn = PK.make_distributed_kmeans_fit(
-                mesh, max_iter=self.getMaxIter(), tol=self.getTol()
+                ing.mesh, max_iter=self.getMaxIter(), tol=self.getTol()
             )
             with trace_range("kmeans mesh-local fit"):
-                centers_f, cost_f, _ = fit_fn(xs, ws, jnp.asarray(centers))
+                centers_f, cost_f, _ = fit_fn(
+                    ing.xs, ing.ws, jnp.asarray(centers)
+                )
             model = SparkKMeansModel(
                 uid=self.uid,
                 clusterCenters=np.asarray(centers_f),
@@ -1438,13 +1322,14 @@ class SparkStandardScaler(_HasDistribution, StandardScaler):
             if self.getOrDefault("distribution") == "mesh-local":
                 from spark_rapids_ml_tpu.parallel import gram as G
 
-                x, _ = _collect_weighted_matrix(
-                    dataset.select(input_col), input_col, None
+                from spark_rapids_ml_tpu.spark import ingest
+
+                ing = ingest.stream_to_mesh(
+                    dataset.select(input_col), features_col=input_col, n=n
                 )
-                xs, mesh, _, rows = _mesh_local_matrix(x)
-                mstats = G.sharded_moment_stats(xs, mesh)
+                mstats = G.sharded_moment_stats(ing.xs, ing.mesh)
                 arrays = {
-                    "count": np.float64(rows),  # pads are zero rows
+                    "count": np.float64(ing.rows),  # pads are zero rows
                     "total": np.asarray(mstats.total),
                     "total_sq": np.asarray(mstats.total_sq),
                 }
@@ -1577,19 +1462,18 @@ class SparkTruncatedSVD(_HasDistribution, TruncatedSVD):
     def _fit_mesh_local(
         self, selected, input_col: str, n: int, k: int, solver: str
     ) -> "SparkTruncatedSVDModel":
-        """'mesh-local': driver-side ingestion, then the sharded Gram
-        psum (gram-route solvers) or the pad-masked butterfly TSQR
+        """'mesh-local': streamed driver-side ingestion, then the sharded
+        Gram psum (gram-route solvers) or the butterfly TSQR
         (solver='svd') over the driver's own device mesh."""
-        import jax
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.models import truncated_svd as TSVD
         from spark_rapids_ml_tpu.parallel import gram as G
-        from spark_rapids_ml_tpu.parallel import mesh as M
         from spark_rapids_ml_tpu.parallel import tsqr as TSQR
+        from spark_rapids_ml_tpu.spark import ingest
 
-        x, _ = _collect_weighted_matrix(selected, input_col, None)
-        xs, mesh, _, _ = _mesh_local_matrix(x)
+        ing = ingest.stream_to_mesh(selected, features_col=input_col, n=n)
+        xs, mesh = ing.xs, ing.mesh
         with trace_range("tsvd mesh-local fit"):
             if solver == "svd":
                 # zero pad rows are exact for the UNcentered QR

@@ -1,0 +1,230 @@
+"""Streamed mesh-local ingestion (spark/ingest.py).
+
+The reference never lands data on the driver (ColumnarRdd hands fit()
+device-resident tables, RapidsRowMatrix.scala:118); the mesh-local
+deployment must, and the contract here is that it does so at O(shard) peak
+host memory — not O(dataset) like a collect-then-pad implementation.
+"""
+
+import os
+import tracemalloc
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_ml_tpu.parallel import mesh as M
+from spark_rapids_ml_tpu.spark import ingest
+
+
+def _features_batch(mat: np.ndarray, extra: dict | None = None) -> pa.RecordBatch:
+    n = mat.shape[1]
+    flat = pa.array(mat.reshape(-1))
+    offsets = pa.array(np.arange(0, mat.size + 1, n, dtype=np.int32))
+    arrays = [pa.ListArray.from_arrays(offsets, flat)]
+    names = ["features"]
+    for name, col in (extra or {}).items():
+        arrays.append(pa.array(col))
+        names.append(name)
+    return pa.RecordBatch.from_arrays(arrays, names=names)
+
+
+class _LazyFrame:
+    """localspark-shaped source whose partitions are GENERATED on demand —
+    the whole dataset never exists at once on the host."""
+
+    def __init__(self, rows: int, n: int, n_parts: int = 16, labeled: bool = False):
+        self.rows, self.n, self.n_parts, self.labeled = rows, n, n_parts, labeled
+
+    def count(self) -> int:
+        return self.rows
+
+    def _part_arrays(self, p: int):
+        lo = self.rows * p // self.n_parts
+        hi = self.rows * (p + 1) // self.n_parts
+        idx = np.arange(lo, hi, dtype=np.float64)
+        mat = idx[:, None] * 0.001 + np.arange(self.n)[None, :]
+        return idx, mat
+
+    def _parts(self):
+        for p in range(self.n_parts):
+            idx, mat = self._part_arrays(p)
+            extra = {"label": idx * 0.5, "w": 1.0 + (idx % 3)} if self.labeled else None
+            yield [_features_batch(mat, extra)]
+
+    def dense(self):
+        return np.concatenate(
+            [self._part_arrays(p)[1] for p in range(self.n_parts)]
+        )
+
+
+def test_stream_matches_collect_then_pad():
+    rows, n = 1000, 8
+    df = _LazyFrame(rows, n)
+    mesh = M.create_mesh()
+    ing = ingest.stream_to_mesh(df, features_col="features", n=n, mesh=mesh)
+    assert ing.rows == rows
+    assert ing.padded_rows % mesh.size == 0
+    got = np.asarray(ing.xs)
+    assert got.shape == (ing.padded_rows, n)
+    np.testing.assert_array_equal(got[:rows], df.dense())
+    assert not got[rows:].any()  # zero pads
+
+
+def test_stream_labeled_weighted_and_intercept():
+    rows, n = 700, 5
+    df = _LazyFrame(rows, n, labeled=True)
+    mesh = M.create_mesh()
+    ing = ingest.stream_to_mesh(
+        df, features_col="features", n=n, label_col="label", weight_col="w",
+        with_weights=True, augment_intercept=True, mesh=mesh,
+    )
+    x = np.asarray(ing.xs)
+    assert x.shape[1] == n + 1
+    np.testing.assert_array_equal(x[:rows, :n], df.dense())
+    np.testing.assert_array_equal(x[:rows, n], np.ones(rows))  # intercept col
+    assert not x[rows:].any()  # pads: zero INCLUDING the intercept column
+    idx = np.arange(rows, dtype=np.float64)
+    np.testing.assert_array_equal(np.asarray(ing.ys)[:rows], idx * 0.5)
+    np.testing.assert_array_equal(np.asarray(ing.ws)[:rows], 1.0 + (idx % 3))
+    assert not np.asarray(ing.ws)[rows:].any()  # pad mask
+
+
+def test_with_weights_without_weight_col_is_pad_mask():
+    df = _LazyFrame(300, 4)
+    ing = ingest.stream_to_mesh(
+        df, features_col="features", n=4, with_weights=True
+    )
+    w = np.asarray(ing.ws)
+    np.testing.assert_array_equal(w[:300], np.ones(300))
+    assert not w[300:].any()
+
+
+def test_negative_weights_raise():
+    rows, n = 64, 3
+    mat = np.ones((rows, n))
+    w = np.ones(rows)
+    w[10] = -1.0
+
+    class Neg(_LazyFrame):
+        def _parts(self):
+            yield [_features_batch(mat, {"w": w})]
+
+    with pytest.raises(ValueError, match="non-negative"):
+        ingest.stream_to_mesh(
+            Neg(rows, n), features_col="features", n=n, weight_col="w"
+        )
+
+
+def test_row_count_mismatch_raises():
+    class Lying(_LazyFrame):
+        def count(self):
+            return self.rows + 5
+
+    with pytest.raises(ValueError, match="cache"):
+        ingest.stream_to_mesh(
+            Lying(128, 4), features_col="features", n=4
+        )
+
+
+def test_size_guard_names_alternatives(monkeypatch):
+    monkeypatch.setenv(ingest.MAX_BYTES_VAR, "1024")
+    with pytest.raises(ValueError, match="mesh-barrier"):
+        ingest.stream_to_mesh(
+            _LazyFrame(4096, 16), features_col="features", n=16
+        )
+
+
+def test_wire_dtype_float32(monkeypatch):
+    monkeypatch.setenv(ingest.WIRE_DTYPE_VAR, "float32")
+    df = _LazyFrame(200, 4)
+    ing = ingest.stream_to_mesh(df, features_col="features", n=4)
+    assert np.asarray(ing.xs).dtype == np.float32
+    np.testing.assert_allclose(
+        np.asarray(ing.xs)[:200], df.dense(), rtol=1e-6
+    )
+
+
+def test_wire_dtype_rejects_unknown(monkeypatch):
+    monkeypatch.setenv(ingest.WIRE_DTYPE_VAR, "bfloat16")
+    with pytest.raises(ValueError, match="float32 or float64"):
+        ingest.wire_dtype()
+
+
+class _PysparkLike:
+    """toArrow/toLocalIterator surface without _parts (a real-Spark stand-in):
+    records which ingest strategy ran."""
+
+    def __init__(self, rows, n):
+        self.rows, self.n = rows, n
+        self.used = None
+
+    def count(self):
+        return self.rows
+
+    def _mat(self):
+        return np.arange(self.rows * self.n, dtype=np.float64).reshape(
+            self.rows, self.n
+        )
+
+    def toArrow(self):
+        self.used = "arrow"
+        return pa.Table.from_batches([_features_batch(self._mat())])
+
+    def toLocalIterator(self):
+        self.used = "rows"
+        for r in self._mat():
+            yield (list(r),)
+
+
+def test_pyspark_small_dataset_takes_arrow_fast_path():
+    df = _PysparkLike(500, 6)
+    ing = ingest.stream_to_mesh(df, features_col="features", n=6)
+    assert df.used == "arrow"
+    np.testing.assert_array_equal(np.asarray(ing.xs)[:500], df._mat())
+
+
+def test_pyspark_large_dataset_streams_rows(monkeypatch):
+    monkeypatch.setenv(ingest.ARROW_CUTOVER_VAR, "1000")  # force cutover
+    df = _PysparkLike(500, 6)
+    ing = ingest.stream_to_mesh(df, features_col="features", n=6)
+    assert df.used == "rows"
+    np.testing.assert_array_equal(np.asarray(ing.xs)[:500], df._mat())
+
+
+def test_host_memory_is_o_shard_not_o_dataset():
+    """The r3 verdict's bound: peak host allocation during a mesh-local
+    ingest must scale with ONE shard, not the dataset. 200k×64 f64 is
+    ~100 MB of data; with 8 devices a shard buffer is ~16 MB. tracemalloc
+    sees numpy/python host allocations (the ones the old concatenate+pad
+    implementation blew up) and not XLA device buffers — exactly the
+    boundary we are bounding."""
+    rows, n = 200_000, 64
+    df = _LazyFrame(rows, n, n_parts=16)
+    mesh = M.create_mesh()
+    dataset_bytes = rows * n * 8
+    tracemalloc.start()
+    try:
+        ing = ingest.stream_to_mesh(
+            df, features_col="features", n=n, mesh=mesh
+        )
+        _, peak = tracemalloc.get_traced_memory()
+    finally:
+        tracemalloc.stop()
+    shard_bytes = (ing.padded_rows // mesh.size) * n * 8
+    # On the CPU test backend device_put ALIASES the numpy shard buffers
+    # (zero-copy), so tracemalloc's peak includes the full device-resident
+    # padded dataset — bytes that live in HBM on a real TPU. The host-side
+    # bound is therefore on the TRANSIENT footprint above device residency:
+    # one inbound partition + the fill buffers + slack, O(shard).
+    device_resident = ing.padded_rows * n * 8
+    transient = peak - device_resident
+    assert transient < 4 * shard_bytes, (
+        f"transient host alloc {transient / 1e6:.1f} MB vs shard "
+        f"{shard_bytes / 1e6:.1f} MB, dataset {dataset_bytes / 1e6:.1f} MB"
+    )
+    # and nothing like the ≥2×dataset of the old concatenate+pad path
+    assert peak < 1.5 * dataset_bytes
+    np.testing.assert_array_equal(
+        np.asarray(ing.xs)[: rows // 100], df.dense()[: rows // 100]
+    )
