@@ -70,6 +70,7 @@ def __getattr__(name):
         "TrainValidationSplitModel",
         "RegressionEvaluator",
         "BinaryClassificationEvaluator",
+        "MulticlassClassificationEvaluator",
         "ClusteringEvaluator",
     ):
         from spark_rapids_ml_tpu.models import tuning

@@ -350,6 +350,121 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         return float(u / (len(pos) * len(neg)))
 
 
+class MulticlassClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
+    """Spark's ``pyspark.ml.evaluation.MulticlassClassificationEvaluator``
+    surface: f1 (default, class-frequency-weighted), accuracy,
+    weightedPrecision, weightedRecall on (labelCol, predictionCol), and
+    logLoss on (labelCol, probabilityCol) — the metric set that makes the
+    multinomial softmax estimator tunable by CV/TVS.
+
+    Weighted metrics follow Spark's definition: per-class scores averaged
+    with TRUE-label frequencies as weights (a class predicted but never
+    present contributes 0 weight). ``logLoss`` clips probabilities to
+    ``eps`` like Spark (MulticlassMetrics logLoss eps=1e-15).
+    """
+
+    metricName = Param(
+        "metricName",
+        "f1|accuracy|weightedPrecision|weightedRecall|logLoss",
+        str,
+    )
+    probabilityCol = Param(
+        "probabilityCol",
+        "[rows, C] class-probability vector column (logLoss only)",
+        str,
+    )
+    eps = Param("eps", "probability clip floor for logLoss", float)
+
+    _METRICS = ("f1", "accuracy", "weightedPrecision", "weightedRecall", "logLoss")
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            metricName="f1", labelCol="label", predictionCol="prediction",
+            probabilityCol="probability", eps=1e-15,
+        )
+
+    def setMetricName(self, value: str) -> "MulticlassClassificationEvaluator":
+        if value not in self._METRICS:
+            raise ValueError(f"metricName must be one of {self._METRICS}")
+        return self._set(metricName=value)
+
+    def setProbabilityCol(self, value: str) -> "MulticlassClassificationEvaluator":
+        return self._set(probabilityCol=value)
+
+    def isLargerBetter(self) -> bool:
+        return self.getOrDefault("metricName") != "logLoss"
+
+    def _prob_pair(self, dataset, predictions):
+        """(labels, [rows, C] probabilities) for logLoss."""
+        label_col = self.getOrDefault("labelCol")
+        prob_col = self.getOrDefault("probabilityCol")
+        if predictions is not None:
+            probs = np.asarray(predictions, dtype=np.float64)
+            if probs.ndim == 1 and probs.size and 0.0 <= probs.min() and probs.max() <= 1.0:
+                # binary models surface P(class 1) as a [rows] vector
+                # (LogisticRegressionModel.predict_proba_matrix's 2-class
+                # contract) — promote to the [rows, 2] layout Spark's
+                # probability column uses so logLoss works on binary data
+                probs = np.stack([1.0 - probs, probs], axis=1)
+            if probs.ndim != 2:
+                raise ValueError(
+                    "logLoss needs a [rows, C] probability matrix (or a "
+                    "[rows] binary P(class 1) vector); got shape "
+                    f"{probs.shape}. Pass the model's probability output, "
+                    "or evaluate the transformed DataFrame carrying "
+                    f"{prob_col!r}"
+                )
+            return _labels_of(dataset, label_col), probs
+        if prob_col not in _column_names(dataset):
+            raise ValueError(
+                f"logLoss needs probability column {prob_col!r}; set the "
+                "model's probabilityCol (e.g. "
+                "LogisticRegression().setProbabilityCol('probability')) or "
+                "this evaluator's setProbabilityCol"
+            )
+        if _is_spark_df(dataset):
+            y, probs = _df_columns(dataset, label_col, prob_col)
+        else:
+            y = _labels_of(dataset, label_col)
+            probs = columnar.extract_matrix(dataset, prob_col)
+        return y, np.asarray(probs, dtype=np.float64)
+
+    def evaluate(self, dataset, predictions=None) -> float:
+        metric = self.getOrDefault("metricName")
+        if metric == "logLoss":
+            y, probs = self._prob_pair(dataset, predictions)
+            cls = np.asarray(y, dtype=np.int64)
+            if cls.min() < 0 or cls.max() >= probs.shape[1]:
+                raise ValueError(
+                    f"labels span {cls.min()}..{cls.max()} but the "
+                    f"probability column has {probs.shape[1]} classes"
+                )
+            eps = self.getOrDefault("eps")
+            picked = np.clip(probs[np.arange(len(cls)), cls], eps, 1.0)
+            return float(-np.mean(np.log(picked)))
+        y, p = self._labeled_pair(dataset, predictions)
+        if metric == "accuracy":
+            return float(np.mean(y == p))
+        classes, counts = np.unique(y, return_counts=True)
+        weights = counts / counts.sum()
+        prec = np.zeros(len(classes))
+        rec = np.zeros(len(classes))
+        for i, c in enumerate(classes):
+            tp = float(np.sum((p == c) & (y == c)))
+            pred_c = float(np.sum(p == c))
+            true_c = float(counts[i])
+            prec[i] = tp / pred_c if pred_c > 0 else 0.0
+            rec[i] = tp / true_c if true_c > 0 else 0.0
+        if metric == "weightedPrecision":
+            return float(np.sum(weights * prec))
+        if metric == "weightedRecall":
+            return float(np.sum(weights * rec))
+        denom = prec + rec
+        f1 = np.where(denom > 0, 2.0 * prec * rec / np.maximum(denom, 1e-300), 0.0)
+        return float(np.sum(weights * f1))
+
+
 class ClusteringEvaluator(Evaluator):
     """Mean silhouette (squared-Euclidean) on (featuresCol, predictionCol).
 
@@ -428,11 +543,14 @@ def _fit_and_eval(estimator, params, evaluator, train, val):
     # balanced accuracy. When the model exposes a probability surface
     # (LogisticRegression), rank that instead — the Spark evaluator makes
     # the same choice by reading rawPrediction rather than prediction.
-    if (
+    wants_probability_surface = (
         isinstance(evaluator, BinaryClassificationEvaluator)
         and evaluator.getOrDefault("metricName") == "areaUnderROC"
-        and hasattr(model, "predict_proba_matrix")
-    ):
+    ) or (
+        isinstance(evaluator, MulticlassClassificationEvaluator)
+        and evaluator.getOrDefault("metricName") == "logLoss"
+    )
+    if wants_probability_surface and hasattr(model, "predict_proba_matrix"):
         fcol = model.getOrDefault("featuresCol")
         lcol = evaluator.getOrDefault("labelCol")
         if isinstance(val, tuple):

@@ -10,6 +10,7 @@ from spark_rapids_ml_tpu import (
     KMeans,
     LinearRegression,
     LogisticRegression,
+    MulticlassClassificationEvaluator,
     ParamGridBuilder,
     RegressionEvaluator,
     TrainValidationSplit,
@@ -85,6 +86,118 @@ class TestBinaryEvaluator:
         y = np.array([0, 1, 1, 0], dtype=float)
         ev = BinaryClassificationEvaluator().setMetricName("accuracy")
         assert ev.evaluate((None, y), predictions=np.array([0.1, 0.9, 0.4, 0.2])) == 0.75
+
+
+class TestMulticlassClassificationEvaluator:
+    # hand-checkable 3-class confusion: y true counts [3, 2, 1]
+    Y = np.array([0, 0, 0, 1, 1, 2], dtype=float)
+    P = np.array([0, 0, 1, 1, 2, 2], dtype=float)
+
+    def test_accuracy(self):
+        ev = MulticlassClassificationEvaluator(metricName="accuracy")
+        assert abs(ev.evaluate((None, self.Y), predictions=self.P) - 4 / 6) < 1e-12
+
+    def test_weighted_precision_recall_f1(self):
+        # per class: prec = [2/2, 1/2, 1/2], rec = [2/3, 1/2, 1/1],
+        # weights = [3/6, 2/6, 1/6]
+        ev = MulticlassClassificationEvaluator()
+        wp = ev.setMetricName("weightedPrecision").evaluate(
+            (None, self.Y), predictions=self.P
+        )
+        assert abs(wp - (0.5 * 1.0 + (2 / 6) * 0.5 + (1 / 6) * 0.5)) < 1e-12
+        wr = ev.setMetricName("weightedRecall").evaluate(
+            (None, self.Y), predictions=self.P
+        )
+        assert abs(wr - (0.5 * (2 / 3) + (2 / 6) * 0.5 + (1 / 6) * 1.0)) < 1e-12
+        f1c = [2 * 1.0 * (2 / 3) / (1.0 + 2 / 3), 0.5, 2 * 0.5 * 1.0 / 1.5]
+        f1 = ev.setMetricName("f1").evaluate((None, self.Y), predictions=self.P)
+        assert abs(f1 - (0.5 * f1c[0] + (2 / 6) * f1c[1] + (1 / 6) * f1c[2])) < 1e-12
+
+    def test_f1_default_and_larger_better(self):
+        ev = MulticlassClassificationEvaluator()
+        assert ev.getOrDefault("metricName") == "f1"
+        assert ev.isLargerBetter()
+        assert not ev.setMetricName("logLoss").isLargerBetter()
+
+    def test_bad_metric(self):
+        with pytest.raises(ValueError):
+            MulticlassClassificationEvaluator().setMetricName("recallByLabel")
+
+    def test_log_loss_matches_formula(self):
+        y = np.array([0.0, 1.0, 2.0])
+        probs = np.array(
+            [[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.25, 0.25, 0.5]]
+        )
+        ev = MulticlassClassificationEvaluator(metricName="logLoss")
+        got = ev.evaluate((None, y), predictions=probs)
+        want = -np.mean(np.log([0.7, 0.8, 0.5]))
+        assert abs(got - want) < 1e-12
+
+    def test_log_loss_clips_zero_probability(self):
+        y = np.array([0.0])
+        probs = np.array([[0.0, 1.0]])
+        got = MulticlassClassificationEvaluator(metricName="logLoss").evaluate(
+            (None, y), predictions=probs
+        )
+        assert np.isfinite(got) and got > 30  # -log(1e-15)
+
+    def test_log_loss_rejects_hard_predictions(self):
+        ev = MulticlassClassificationEvaluator(metricName="logLoss")
+        with pytest.raises(ValueError, match="probability matrix"):
+            ev.evaluate((None, self.Y), predictions=self.P)
+
+    def test_cv_selects_reg_param_on_three_classes(self, rng):
+        # 3 linearly-separable-ish clusters; crushing L2 must lose on f1
+        rows = 420
+        centers = np.array([[2.0, 0.0, 0.0], [0.0, 2.0, 0.0], [0.0, 0.0, 2.0]])
+        y = np.arange(rows, dtype=float) % 3
+        x = centers[y.astype(int)] + 0.6 * rng.normal(size=(rows, 3))
+        grid = ParamGridBuilder().addGrid("regParam", [0.001, 100.0]).build()
+        cv = CrossValidator(
+            estimator=LogisticRegression(maxIter=40),
+            estimatorParamMaps=grid,
+            evaluator=MulticlassClassificationEvaluator(),
+            numFolds=3,
+        )
+        cvm = cv.fit((x, y))
+        assert cvm.bestIndex == 0
+        assert cvm.avgMetrics[0] > cvm.avgMetrics[1]
+        assert cvm.bestModel.coefficientMatrix.shape == (3, 3)
+
+    def test_log_loss_on_binary_promotes_proba_vector(self, rng):
+        # binary predict_proba_matrix returns [rows] P(class 1); logLoss
+        # must promote it to the [rows, 2] layout, not crash mid-CV
+        rows = 200
+        y = (np.arange(rows) % 2).astype(float)
+        x = np.where(y[:, None] > 0, 1.5, -1.5) + 0.8 * rng.normal(
+            size=(rows, 3)
+        )
+        grid = ParamGridBuilder().addGrid("regParam", [0.01, 50.0]).build()
+        cv = CrossValidator(
+            estimator=LogisticRegression(maxIter=30),
+            estimatorParamMaps=grid,
+            evaluator=MulticlassClassificationEvaluator(metricName="logLoss"),
+            numFolds=2,
+        )
+        cvm = cv.fit((x, y))
+        assert cvm.bestIndex == 0
+        assert np.all(np.isfinite(cvm.avgMetrics))
+
+    def test_cv_log_loss_uses_probability_surface(self, rng):
+        rows = 300
+        centers = np.array([[2.5, 0.0], [0.0, 2.5], [-2.5, -2.5]])
+        y = np.arange(rows, dtype=float) % 3
+        x = centers[y.astype(int)] + 0.5 * rng.normal(size=(rows, 2))
+        grid = ParamGridBuilder().addGrid("regParam", [0.001, 50.0]).build()
+        cv = CrossValidator(
+            estimator=LogisticRegression(maxIter=40),
+            estimatorParamMaps=grid,
+            evaluator=MulticlassClassificationEvaluator(metricName="logLoss"),
+            numFolds=2,
+        )
+        cvm = cv.fit((x, y))
+        assert cvm.bestIndex == 0  # smaller logLoss wins (isLargerBetter=False)
+        assert cvm.avgMetrics[0] < cvm.avgMetrics[1]
 
 
 class TestClusteringEvaluator:
