@@ -431,7 +431,11 @@ class LogisticRegression(_HasProbabilityCol, _SupervisedParams, Estimator):
                 w_full = np.asarray(new_w)
                 if ckpt is not None and (it + 1) % checkpoint_every == 0:
                     ckpt.save(it, {"w": w_full}, {"loss": float(stats.loss)})
-                if float(step_norm) <= self.getTol():
+                if not float(step_norm) > self.getTol():
+                    # converged, or NaN-sentinel rejection (see
+                    # check_newton_outcome: raises on non-finite DATA,
+                    # accepts separable-divergence's last finite iterate)
+                    LIN.check_newton_outcome(step_norm, w_full)
                     break
 
         if fit_intercept:
@@ -488,7 +492,8 @@ class LogisticRegression(_HasProbabilityCol, _SupervisedParams, Estimator):
                 w_flat = np.asarray(new_w)
                 if ckpt is not None and (it + 1) % checkpoint_every == 0:
                     ckpt.save(it, {"w": w_flat}, {"loss": float(stats.loss)})
-                if float(step_norm) <= self.getTol():
+                if not float(step_norm) > self.getTol():
+                    LIN.check_newton_outcome(step_norm, w_flat)
                     break
 
         w_mat = w_flat.reshape(n_classes, d)

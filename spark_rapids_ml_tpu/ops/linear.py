@@ -355,18 +355,54 @@ def _regularized_newton_solve(
     """Shared Newton-step tail for the binary AND softmax paths: closed-form
     solve at α=0, warm-started FISTA prox step otherwise. ``hess``/``grad``
     arrive with the L2 fold and the eps ridge already applied; ``grad`` is
-    the ASCENT direction of the smooth model."""
+    the ASCENT direction of the smooth model.
+
+    Divergence guard: an unregularized fit on linearly separable data has
+    no finite maximizer — the iterates grow until z=x·w overflows and the
+    solve turns NaN. A non-finite proposal is rejected in favor of the
+    incoming iterate, with the step-norm set to **NaN as a sentinel**:
+    ``NaN > tol`` is False, so every tol-gated while_loop exits at the last
+    finite iterate (the same "big finite weights, no error" outcome
+    Spark's LBFGS gives separable data) — and the host can distinguish the
+    outcome from a clean converge (:func:`check_newton_outcome` raises when
+    the rejection happened on the very first step from the zero init, which
+    means the DATA carried non-finite values, not that the fit diverged)."""
     if elastic_net_param == 0.0:
         delta = jax.scipy.linalg.solve(hess, grad, assume_a="pos")
-        return w + delta, jnp.linalg.norm(delta)
-    lam1 = reg_param * elastic_net_param * m
-    eta = 1.0 / jnp.maximum(_power_lam_max(hess), 1e-30)
+        new_w, step = w + delta, jnp.linalg.norm(delta)
+    else:
+        lam1 = reg_param * elastic_net_param * m
+        eta = 1.0 / jnp.maximum(_power_lam_max(hess), 1e-30)
 
-    def sub_grad(z):
-        return hess @ (z - w) - grad
+        def sub_grad(z):
+            return hess @ (z - w) - grad
 
-    z = _fista(sub_grad, eta * lam1 * pen, eta, w, 200, 1e-10)
-    return z, jnp.linalg.norm(z - w)
+        new_w = _fista(sub_grad, eta * lam1 * pen, eta, w, 200, 1e-10)
+        step = jnp.linalg.norm(new_w - w)
+    ok = jnp.isfinite(step) & jnp.all(jnp.isfinite(new_w))
+    nan = jnp.asarray(jnp.nan, step.dtype)
+    return jnp.where(ok, new_w, w), jnp.where(ok, step, nan)
+
+
+def check_newton_outcome(step_norm, w) -> None:
+    """Host-side decode of the Newton loops' final (step, w).
+
+    NaN step + all-zero parameters means the FIRST step from the zero init
+    was already non-finite — the input data contains NaN/Inf (a zero
+    gradient at init would have produced step 0, not NaN) — so raise a
+    diagnosable error instead of returning an all-zero model that silently
+    predicts one class everywhere. NaN step with nonzero parameters is the
+    separable-divergence outcome: the model holds the last finite iterate,
+    which is the accepted behavior (see _regularized_newton_solve)."""
+    import numpy as np
+
+    if np.isnan(float(np.asarray(step_norm))) and not np.asarray(w).any():
+        raise ValueError(
+            "the first Newton step produced non-finite statistics from the "
+            "zero initialization — the features, labels, or instance "
+            "weights contain NaN/Inf values; clean or impute them before "
+            "fit"
+        )
 
 
 def newton_update(

@@ -760,6 +760,7 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         run the whole-loop IRLS program (binary or softmax) over it -
         identical training program to the barrier path, minus the
         process-group bootstrap."""
+        from spark_rapids_ml_tpu.ops import linear as LIN
         from spark_rapids_ml_tpu.parallel import linear as PL
         from spark_rapids_ml_tpu.spark import ingest
 
@@ -783,7 +784,8 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                 fit_fn = PL.make_distributed_softmax_fit(
                     mesh, n_classes, **common
                 )
-                w_flat, _, _ = fit_fn(xs, ys, ws)
+                w_flat, _, final_step = fit_fn(xs, ys, ws)
+                LIN.check_newton_outcome(final_step, w_flat)
                 w_mat = np.asarray(w_flat).reshape(n_classes, -1)
                 if fit_intercept:
                     coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
@@ -796,7 +798,8 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                 )
                 return self._copyValues(model)
             fit_fn = PL.make_distributed_logreg_fit(mesh, **common)
-            w_full, _, _ = fit_fn(xs, ys, ws)
+            w_full, _, final_step = fit_fn(xs, ys, ws)
+            LIN.check_newton_outcome(final_step, w_full)
             return self._binary_model(np.asarray(w_full), fit_intercept)
 
     def _binary_model(

@@ -114,6 +114,50 @@ class TestMultinomialFit:
             m_w.coefficientMatrix, m_r.coefficientMatrix, rtol=1e-4, atol=1e-6
         )
 
+    def test_separable_unregularized_stays_finite(self, rng):
+        # Separable data with regParam=0 has no finite MLE: the Newton
+        # iterates legitimately diverge, and the divergence guard must
+        # return the LAST FINITE iterate (big weights, correct decisions)
+        # — never NaN coefficients (ops/linear._regularized_newton_solve).
+        centers = np.array(
+            [[4.0, 0.0, 0.0], [0.0, 4.0, 0.0], [0.0, 0.0, 4.0]]
+        )
+        y = np.arange(240, dtype=float) % 3
+        x = centers[y.astype(int)] + 0.1 * rng.normal(size=(240, 3))
+        m = LogisticRegression(maxIter=60).fit((x, y))
+        assert np.all(np.isfinite(m.coefficientMatrix))
+        assert np.all(np.isfinite(m.interceptVector))
+        assert np.mean(np.asarray(m.transform(x)) == y) > 0.99
+        probs = m.predict_proba_matrix(x)
+        assert np.all(np.isfinite(probs))
+
+    def test_separable_unregularized_binary_stays_finite(self, rng):
+        y = (np.arange(300) % 2).astype(float)
+        x = np.where(y[:, None] > 0, 3.0, -3.0) + 0.1 * rng.normal(
+            size=(300, 4)
+        )
+        m = LogisticRegression(maxIter=60).fit((x, y))
+        assert np.all(np.isfinite(m.coefficients))
+        assert np.isfinite(m.intercept)
+        assert np.mean(np.asarray(m.transform(x)) == y) > 0.99
+
+    def test_nan_features_raise_not_silent_zero_model(self, rng):
+        # the divergence guard must NOT mask bad input data: a NaN feature
+        # makes the FIRST Newton step non-finite from the zero init, which
+        # check_newton_outcome turns into a diagnosable error rather than
+        # an all-zero model that predicts one class everywhere
+        x, y, _ = _make_multiclass(rng, rows=120)
+        x[7, 2] = np.nan
+        with pytest.raises(ValueError, match="NaN/Inf"):
+            LogisticRegression(maxIter=10).fit((x, y))
+
+    def test_nan_features_raise_binary(self, rng):
+        x = rng.normal(size=(100, 3))
+        y = (x[:, 0] > 0).astype(float)
+        x[3, 1] = np.inf
+        with pytest.raises(ValueError, match="NaN/Inf"):
+            LogisticRegression(maxIter=10).fit((x, y))
+
     def test_non_integer_labels_rejected(self, rng):
         x = rng.normal(size=(50, 2))
         with pytest.raises(ValueError, match="integer class labels"):
