@@ -80,7 +80,34 @@ def make_distributed_kmeans_fit(
     per-step estimator loop: stop when max squared centroid movement ≤ tol²
     or after ``max_iter`` iterations. Inputs: X [rows, n] and weights [rows]
     data-sharded, initial centers [k, n] replicated. Returns replicated
-    (centers, cost, iterations).
+    (centers, cost, iterations). One full-budget chunk of
+    :func:`make_distributed_kmeans_chunk` (single copy of the Lloyd body).
+    """
+    import jax.numpy as jnp
+
+    chunk = make_distributed_kmeans_chunk(
+        mesh, chunk_iters=max_iter, tol=tol, block_rows=block_rows
+    )
+
+    def fit(x, w, centers0):
+        centers, cost, done, _ = chunk(x, w, centers0, jnp.int32(max_iter))
+        return centers, cost, done
+
+    return fit
+
+
+@lru_cache(maxsize=32)
+def make_distributed_kmeans_chunk(
+    mesh: Mesh, *, chunk_iters: int = 5, tol: float = 1e-4, block_rows: int = 8192
+):
+    """Up to ``chunk_iters`` Lloyd iterations from CARRIED centers — the
+    resumable building block of the chunked-checkpoint mesh fit (see
+    parallel.linear.make_distributed_logreg_chunk for the rationale).
+
+    ``run(x, w, centers0, budget) -> (centers, cost, done, shift_sq)``:
+    same per-iteration body as :func:`make_distributed_kmeans_fit`; the
+    host loop stops when ``shift_sq <= tol²`` or the global budget runs
+    out, checkpointing centers between chunks.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -92,14 +119,16 @@ def make_distributed_kmeans_fit(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
-        out_specs=(P(), P(), P()),
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
+        out_specs=(P(), P(), P(), P()),
         check_rep=False,
     )
-    def run(x, w, centers0):
+    def run(x, w, centers0, budget):
+        limit = jnp.minimum(jnp.int32(chunk_iters), budget.astype(jnp.int32))
+
         def cond(carry):
             _, _, it, shift = carry
-            return (it < max_iter) & (shift > tol_sq)
+            return (it < limit) & (shift > tol_sq)
 
         def body(carry):
             centers, _, it, _ = carry
@@ -117,14 +146,14 @@ def make_distributed_kmeans_fit(
             jnp.int32(0),
             jnp.asarray(jnp.inf, x.dtype),
         )
-        centers, cost, it, _ = lax.while_loop(cond, body, init)
-        return centers, cost, it
+        return lax.while_loop(cond, body, init)
 
     return jax.jit(
         run,
         in_shardings=(
             NamedSharding(mesh, P(DATA_AXIS, None)),
             NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P()),
             NamedSharding(mesh, P()),
         ),
         out_shardings=NamedSharding(mesh, P()),

@@ -198,54 +198,28 @@ def make_distributed_logreg_fit(
     [rows, d] data-sharded WITH the intercept column already appended when
     ``fit_intercept``; ``y`` and the pad/instance-weight vector ``w`` sharded
     alike. Returns replicated (w_full [d], iterations, final step-norm).
+
+    Implemented as ONE full-budget chunk of
+    :func:`make_distributed_logreg_chunk` from the zero init — the
+    per-iteration body exists in exactly one place, so the chunked-resume
+    trajectory is the whole-loop trajectory by construction.
     """
     import jax.numpy as jnp
-    from jax import lax
 
-    from spark_rapids_ml_tpu.parallel.mesh import shard_map
-
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P(), P()),
-        check_rep=False,
+    chunk = make_distributed_logreg_chunk(
+        mesh,
+        reg_param=reg_param,
+        elastic_net_param=elastic_net_param,
+        fit_intercept=fit_intercept,
+        chunk_iters=max_iter,
+        tol=tol,
     )
-    def run(x_aug, y, w_vec):
-        d = x_aug.shape[1]
 
-        def cond(carry):
-            _, it, step = carry
-            return (it < max_iter) & (step > tol)
+    def fit(x_aug, y, w_vec):
+        w0 = jnp.zeros((x_aug.shape[1],), x_aug.dtype)
+        return chunk(x_aug, y, w_vec, w0, jnp.int32(max_iter))
 
-        def body(carry):
-            w_full, it, _ = carry
-            stats = LIN.logistic_newton_stats(x_aug, y, w_full, w_vec)
-            stats = jax.tree.map(
-                lambda v: lax.psum(v, DATA_AXIS), stats
-            )
-            new_w, step = LIN.newton_update(
-                w_full,
-                stats,
-                reg_param=reg_param,
-                elastic_net_param=elastic_net_param,
-                fit_intercept=fit_intercept,
-            )
-            return new_w, it + 1, step
-
-        w0 = jnp.zeros((d,), x_aug.dtype)
-        init = (w0, jnp.int32(0), jnp.asarray(jnp.inf, x_aug.dtype))
-        return lax.while_loop(cond, body, init)
-
-    return jax.jit(
-        run,
-        in_shardings=(
-            NamedSharding(mesh, P(DATA_AXIS, None)),
-            NamedSharding(mesh, P(DATA_AXIS)),
-            NamedSharding(mesh, P(DATA_AXIS)),
-        ),
-        out_shardings=NamedSharding(mesh, P()),
-    )
+    return fit
 
 
 @lru_cache(maxsize=32)
@@ -265,7 +239,51 @@ def make_distributed_softmax_fit(
     C(C+1)/2 MXU block matmuls per shard) and solves replicated. ``y``
     arrives as the float label vector (sharded like x) and is cast to class
     indices in-program. Returns replicated (w_flat [C·d], iterations,
-    final step-norm)."""
+    final step-norm). One full-budget chunk of
+    :func:`make_distributed_softmax_chunk` (single copy of the body)."""
+    import jax.numpy as jnp
+
+    chunk = make_distributed_softmax_chunk(
+        mesh,
+        n_classes,
+        reg_param=reg_param,
+        elastic_net_param=elastic_net_param,
+        fit_intercept=fit_intercept,
+        chunk_iters=max_iter,
+        tol=tol,
+    )
+
+    def fit(x_aug, y, w_vec):
+        w0 = jnp.zeros((n_classes * x_aug.shape[1],), x_aug.dtype)
+        return chunk(x_aug, y, w_vec, w0, jnp.int32(max_iter))
+
+    return fit
+
+
+@lru_cache(maxsize=32)
+def make_distributed_logreg_chunk(
+    mesh: Mesh,
+    *,
+    reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
+    fit_intercept: bool = True,
+    chunk_iters: int = 5,
+    tol: float = 1e-6,
+):
+    """Up to ``chunk_iters`` binary-Newton iterations from a CARRIED
+    parameter vector — the resumable building block of the chunked-
+    checkpoint mesh fit (r3 verdict #6: a preempted whole-loop pod fit
+    restarts from zero; K-iteration chunks with a host checkpoint between
+    them bound the loss while keeping driver round-trips 1-per-K).
+
+    ``run(x_aug, y, w_vec, w0, budget) -> (w, done, step)``: identical
+    per-iteration body to :func:`make_distributed_logreg_fit`, but the loop
+    starts at ``w0`` and stops at ``min(chunk_iters, budget)`` — ``budget``
+    (remaining GLOBAL iterations) is a traced scalar, so the final short
+    chunk reuses the same compiled program. ``done`` < chunk_iters means
+    converged (or budget exhausted); ``step`` carries the NaN divergence
+    sentinel exactly like the whole-loop program.
+    """
     import jax.numpy as jnp
     from jax import lax
 
@@ -274,17 +292,77 @@ def make_distributed_softmax_fit(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
         out_specs=(P(), P(), P()),
         check_rep=False,
     )
-    def run(x_aug, y, w_vec):
-        d = x_aug.shape[1]
-        y_idx = y.astype(jnp.int32)
+    def run(x_aug, y, w_vec, w0, budget):
+        limit = jnp.minimum(jnp.int32(chunk_iters), budget.astype(jnp.int32))
 
         def cond(carry):
             _, it, step = carry
-            return (it < max_iter) & (step > tol)
+            return (it < limit) & (step > tol)
+
+        def body(carry):
+            w_full, it, _ = carry
+            stats = LIN.logistic_newton_stats(x_aug, y, w_full, w_vec)
+            stats = jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), stats)
+            new_w, step = LIN.newton_update(
+                w_full, stats,
+                reg_param=reg_param,
+                elastic_net_param=elastic_net_param,
+                fit_intercept=fit_intercept,
+            )
+            return new_w, it + 1, step
+
+        init = (w0, jnp.int32(0), jnp.asarray(jnp.inf, x_aug.dtype))
+        return lax.while_loop(cond, body, init)
+
+    return jax.jit(
+        run,
+        in_shardings=(
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P()),
+            NamedSharding(mesh, P()),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+
+@lru_cache(maxsize=32)
+def make_distributed_softmax_chunk(
+    mesh: Mesh,
+    n_classes: int,
+    *,
+    reg_param: float = 0.0,
+    elastic_net_param: float = 0.0,
+    fit_intercept: bool = True,
+    chunk_iters: int = 5,
+    tol: float = 1e-6,
+):
+    """C-class sibling of :func:`make_distributed_logreg_chunk`:
+    ``run(x_aug, y, w_vec, w0_flat, budget) -> (w_flat, done, step)``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from spark_rapids_ml_tpu.parallel.mesh import shard_map
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=(P(), P(), P()),
+        check_rep=False,
+    )
+    def run(x_aug, y, w_vec, w0, budget):
+        y_idx = y.astype(jnp.int32)
+        limit = jnp.minimum(jnp.int32(chunk_iters), budget.astype(jnp.int32))
+
+        def cond(carry):
+            _, it, step = carry
+            return (it < limit) & (step > tol)
 
         def body(carry):
             w_flat, it, _ = carry
@@ -299,7 +377,6 @@ def make_distributed_softmax_fit(
             )
             return new_w, it + 1, step
 
-        w0 = jnp.zeros((n_classes * d,), x_aug.dtype)
         init = (w0, jnp.int32(0), jnp.asarray(jnp.inf, x_aug.dtype))
         return lax.while_loop(cond, body, init)
 
@@ -309,6 +386,8 @@ def make_distributed_softmax_fit(
             NamedSharding(mesh, P(DATA_AXIS, None)),
             NamedSharding(mesh, P(DATA_AXIS)),
             NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P()),
+            NamedSharding(mesh, P()),
         ),
         out_shardings=NamedSharding(mesh, P()),
     )

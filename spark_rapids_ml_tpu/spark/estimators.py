@@ -608,13 +608,17 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         selected = dataset.select(*cols)
         fit_intercept = self.getFitIntercept()
         distribution = self.getOrDefault("distribution")
-        if distribution != "driver-merge" and checkpoint_dir is not None:
-            # params-only rejection: fail BEFORE any cluster job runs
+        if distribution == "mesh-barrier" and checkpoint_dir is not None:
+            # params-only rejection: fail BEFORE any cluster job runs.
+            # mesh-local checkpoints via the chunked whole-loop program
+            # (K iterations per XLA program, host checkpoint between
+            # chunks); the barrier stage's workers have no shared durable
+            # store for a rank-0 save yet
             raise ValueError(
-                "checkpoint_dir requires distribution='driver-merge': "
-                f"the {distribution} fit runs the whole training loop as "
-                "one XLA program with no per-iteration driver hop to "
-                "checkpoint from"
+                "checkpoint_dir is not supported with "
+                "distribution='mesh-barrier': the barrier fit runs inside "
+                "executor workers with no driver hop; use 'mesh-local' "
+                "(chunked checkpointing) or 'driver-merge'"
             )
         n = _infer_n(dataset, feats)
         # class-count detection: one cheap distinct-label pass over the
@@ -642,7 +646,8 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         if distribution == "mesh-local":
             return self._fit_mesh_local(
                 selected, feats, label, weight_col, n, n_classes,
-                fit_intercept,
+                fit_intercept, checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
             )
         if distribution == "mesh-barrier":
             if n_classes > 2:
@@ -754,12 +759,20 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         return self._copyValues(model)
 
     def _fit_mesh_local(
-        self, selected, feats, label, weight_col, n, n_classes, fit_intercept
+        self, selected, feats, label, weight_col, n, n_classes, fit_intercept,
+        *, checkpoint_dir=None, checkpoint_every=5,
     ) -> "SparkLogisticRegressionModel":
         """'mesh-local': stream-ingest onto the driver's own device mesh,
         run the whole-loop IRLS program (binary or softmax) over it -
         identical training program to the barrier path, minus the
-        process-group bootstrap."""
+        process-group bootstrap. With ``checkpoint_dir`` the loop runs in
+        ``checkpoint_every``-iteration CHUNKS (one cached XLA program per
+        chunk, a durable host checkpoint between chunks) so a preempted fit
+        resumes instead of restarting — the r3 verdict's #6; driver
+        round-trips stay 1-per-K rather than the driver-merge path's
+        1-per-iteration."""
+        import jax.numpy as jnp
+
         from spark_rapids_ml_tpu.ops import linear as LIN
         from spark_rapids_ml_tpu.parallel import linear as PL
         from spark_rapids_ml_tpu.spark import ingest
@@ -772,35 +785,78 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         if weight_col and float(ing.ws.sum()) == 0.0:
             raise ValueError("all instance weights are zero")
         xs, ys, ws, mesh = ing.xs, ing.ys, ing.ws, ing.mesh
-        common = dict(
+        reg = dict(
             reg_param=self.getRegParam(),
             elastic_net_param=self.getElasticNetParam(),
             fit_intercept=fit_intercept,
-            max_iter=self.getMaxIter(),
-            tol=self.getTol(),
         )
-        with trace_range("logreg mesh-local fit"):
+        max_iter, tol = self.getMaxIter(), self.getTol()
+        if checkpoint_dir is not None:
+            from spark_rapids_ml_tpu.models.linear import (
+                _resume_newton_checkpoint,
+            )
+
+            d = n + 1 if fit_intercept else n
+            cd = n_classes * d if n_classes > 2 else d
+            w0, start_iter, ckpt = _resume_newton_checkpoint(
+                checkpoint_dir, cd
+            )
             if n_classes > 2:
-                fit_fn = PL.make_distributed_softmax_fit(
-                    mesh, n_classes, **common
+                chunk_fn = PL.make_distributed_softmax_chunk(
+                    mesh, n_classes, chunk_iters=checkpoint_every, tol=tol,
+                    **reg,
                 )
-                w_flat, _, final_step = fit_fn(xs, ys, ws)
-                LIN.check_newton_outcome(final_step, w_flat)
-                w_mat = np.asarray(w_flat).reshape(n_classes, -1)
-                if fit_intercept:
-                    coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
+            else:
+                chunk_fn = PL.make_distributed_logreg_chunk(
+                    mesh, chunk_iters=checkpoint_every, tol=tol, **reg
+                )
+            w = jnp.asarray(w0)
+            it = start_iter
+            with trace_range("logreg mesh-local chunked fit"):
+                while it < max_iter:
+                    w, done, step = chunk_fn(
+                        xs, ys, ws, w, jnp.int32(max_iter - it)
+                    )
+                    it += int(done)
+                    stop = not float(step) > tol
+                    if stop:
+                        # BEFORE the save: NaN-input rejection must not
+                        # leave a junk zeros checkpoint that a post-cleanup
+                        # re-fit would silently resume from one iteration in
+                        LIN.check_newton_outcome(step, w)
+                    ckpt.save(it - 1, {"w": np.asarray(w)}, {})
+                    if stop:
+                        break
+            w_final = np.asarray(w)
+        else:
+            with trace_range("logreg mesh-local fit"):
+                if n_classes > 2:
+                    fit_fn = PL.make_distributed_softmax_fit(
+                        mesh, n_classes, max_iter=max_iter, tol=tol, **reg
+                    )
+                    w_flat, _, final_step = fit_fn(xs, ys, ws)
+                    LIN.check_newton_outcome(final_step, w_flat)
+                    w_final = np.asarray(w_flat)
                 else:
-                    coef_matrix, intercepts = w_mat, np.zeros(n_classes)
-                model = SparkLogisticRegressionModel(
-                    uid=self.uid,
-                    coefficientMatrix=coef_matrix,
-                    interceptVector=intercepts,
-                )
-                return self._copyValues(model)
-            fit_fn = PL.make_distributed_logreg_fit(mesh, **common)
-            w_full, _, final_step = fit_fn(xs, ys, ws)
-            LIN.check_newton_outcome(final_step, w_full)
-            return self._binary_model(np.asarray(w_full), fit_intercept)
+                    fit_fn = PL.make_distributed_logreg_fit(
+                        mesh, max_iter=max_iter, tol=tol, **reg
+                    )
+                    w_full, _, final_step = fit_fn(xs, ys, ws)
+                    LIN.check_newton_outcome(final_step, w_full)
+                    w_final = np.asarray(w_full)
+        if n_classes > 2:
+            w_mat = w_final.reshape(n_classes, -1)
+            if fit_intercept:
+                coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
+            else:
+                coef_matrix, intercepts = w_mat, np.zeros(n_classes)
+            model = SparkLogisticRegressionModel(
+                uid=self.uid,
+                coefficientMatrix=coef_matrix,
+                interceptVector=intercepts,
+            )
+            return self._copyValues(model)
+        return self._binary_model(w_final, fit_intercept)
 
     def _binary_model(
         self, w_full: np.ndarray, fit_intercept: bool
@@ -962,11 +1018,12 @@ class SparkKMeans(_HasDistribution, KMeans):
         k = self.getK()
 
         distribution = self.getOrDefault("distribution")
-        if distribution != "driver-merge" and checkpoint_dir is not None:
+        if distribution == "mesh-barrier" and checkpoint_dir is not None:
             raise ValueError(
-                "checkpoint_dir requires distribution='driver-merge': the "
-                f"{distribution} fit runs the whole Lloyd loop as one XLA "
-                "program with no per-iteration driver hop to checkpoint from"
+                "checkpoint_dir is not supported with "
+                "distribution='mesh-barrier': the barrier fit runs inside "
+                "executor workers with no driver hop; use 'mesh-local' "
+                "(chunked checkpointing) or 'driver-merge'"
             )
         # resume BEFORE seeding: an interrupted Spark-path fit pointed at the
         # same checkpoint_dir continues mid-Lloyd (the SAME resume contract
@@ -1083,8 +1140,34 @@ class SparkKMeans(_HasDistribution, KMeans):
             )
             if weight_col and float(ing.ws.sum()) == 0.0:
                 raise ValueError("all instance weights are zero")
+            max_iter, tol = self.getMaxIter(), self.getTol()
+            if ckpt is not None:
+                # chunked whole-loop Lloyd: checkpoint_every iterations per
+                # cached XLA program, durable centers between chunks (the
+                # same resume contract as the driver-merge loop)
+                chunk_fn = PK.make_distributed_kmeans_chunk(
+                    ing.mesh, chunk_iters=checkpoint_every, tol=tol
+                )
+                c = jnp.asarray(centers)
+                it, cost, tol_sq = start_iter, cost0, tol * tol
+                with trace_range("kmeans mesh-local chunked fit"):
+                    while it < max_iter:
+                        c, cost_j, done, shift = chunk_fn(
+                            ing.xs, ing.ws, c, jnp.int32(max_iter - it)
+                        )
+                        it += int(done)
+                        cost = float(cost_j)
+                        ckpt.save(it - 1, {"centers": np.asarray(c)},
+                                  {"cost": cost})
+                        if float(shift) <= tol_sq:
+                            break
+                model = SparkKMeansModel(
+                    uid=self.uid, clusterCenters=np.asarray(c),
+                    trainingCost=cost,
+                )
+                return self._copyValues(model)
             fit_fn = PK.make_distributed_kmeans_fit(
-                ing.mesh, max_iter=self.getMaxIter(), tol=self.getTol()
+                ing.mesh, max_iter=max_iter, tol=tol
             )
             with trace_range("kmeans mesh-local fit"):
                 centers_f, cost_f, _ = fit_fn(
