@@ -158,3 +158,136 @@ def make_distributed_kmeans_chunk(
         ),
         out_shardings=NamedSharding(mesh, P()),
     )
+
+
+@lru_cache(maxsize=32)
+def make_distributed_kmeans_parallel_init(
+    mesh: Mesh, k: int, *, init_steps: int = 2, block_rows: int = 8192
+):
+    """k-means‖ oversampling as ONE SPMD mesh program — no driver hops.
+
+    The driver-pass implementation (models/kmeans.py
+    ``_kmeans_parallel_init`` and its Spark-jobs sibling) runs each
+    Bahmani round as host-orchestrated passes with candidates bouncing
+    through the driver; this program keeps the whole init on the mesh:
+    per round, every shard scores its rows by w·D² against the replicated
+    candidate buffer (blocked MXU distances), draws a FIXED ``s`` rows per
+    shard by Gumbel-top-s (sampling without replacement ∝ w·D² — the
+    static-shape counterpart of Bahmani's Bernoulli draw with expectation
+    ℓ=2k per round; XLA needs fixed shapes, and ndev·s ≥ 2k preserves the
+    oversampling rate), and an ``all_gather`` over the data axis appends
+    the round's candidates replicated. A final blocked assignment pass
+    psums the instance-weighted ownership counts.
+
+    Returns ``run(x, w, key) -> (candidates [cap, n], counts [cap])`` with
+    ``cap = 1 + init_steps·ndev·s``; never-filled slots carry count 0, so
+    :func:`ops.kmeans.weighted_kmeans_plus_plus_init` (which draws ∝
+    count·D²) consumes the buffers directly for the k-reduction. ``w`` is
+    the framework's pad-mask/instance-weight vector: zero-weight rows can
+    never be sampled.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from spark_rapids_ml_tpu.parallel.mesh import shard_map
+
+    ndev = mesh.shape[DATA_AXIS]
+    s = max(1, -(-2 * k // ndev))  # ndev*s >= ell = 2k candidates per round
+    cap = 1 + init_steps * ndev * s
+
+    def _blocked(fn, init, x, w=None):
+        """scan ``fn(carry, (x_block[, w_block]))`` over padded row blocks —
+        the ONE copy of the block/pad arithmetic both passes share. Pad rows
+        carry zero weight, so weighted consumers ignore them; unweighted
+        consumers must slice their [rows]-shaped outputs themselves."""
+        rows = x.shape[0]
+        blk = min(block_rows, rows)
+        nblk = -(-rows // blk)
+        xp = jnp.pad(x, ((0, nblk * blk - rows), (0, 0)))
+        xs = xp.reshape(nblk, blk, -1)
+        if w is None:
+            return lax.scan(fn, init, xs)
+        wp = jnp.pad(w, (0, nblk * blk - rows))
+        return lax.scan(fn, init, (xs, wp.reshape(nblk, blk)))
+
+    def _masked_d2(xb, buf, valid):
+        """[blk, cap] squared distances with invalid slots at +inf — a
+        where-mask, not an additive penalty, so no data magnitude can
+        defeat it."""
+        d2 = KM.pairwise_sq_dists(xb, buf)
+        return jnp.where(valid[None, :], d2, jnp.inf)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )
+    def run(x, w, key):
+        me = lax.axis_index(DATA_AXIS)
+        rows, n = x.shape
+        s_eff = min(s, rows)  # static: shards are equal-size padded
+        tiny = jnp.finfo(x.dtype).tiny
+
+        # first candidate: weight-proportional over ALL rows via Gumbel-max
+        # (local argmax per shard, replicated argmax across shards)
+        k0 = jax.random.fold_in(jax.random.fold_in(key, 17), me)
+        g0 = jax.random.gumbel(k0, (rows,), x.dtype)
+        score0 = jnp.where(w > 0, jnp.log(jnp.maximum(w, tiny)) + g0, -jnp.inf)
+        bi = jnp.argmax(score0)
+        all_best = lax.all_gather(score0[bi], DATA_AXIS)
+        winner = jnp.argmax(all_best)
+        cand0 = lax.psum(
+            jnp.where(winner == me, x[bi], jnp.zeros((n,), x.dtype)), DATA_AXIS
+        )
+        buf = jnp.zeros((cap, n), x.dtype).at[0].set(cand0)
+        valid = jnp.zeros((cap,), jnp.bool_).at[0].set(True)
+
+        for r in range(init_steps):
+
+            def min_d2_step(_, xb, buf=buf, valid=valid):
+                return None, jnp.min(_masked_d2(xb, buf, valid), axis=1)
+
+            _, mins = _blocked(min_d2_step, None, x)
+            d2 = mins.reshape(-1)[:rows]
+            score = jnp.where(
+                (w > 0) & (d2 > 0),
+                jnp.log(jnp.maximum(w * d2, tiny)),
+                -jnp.inf,
+            )
+            kr = jax.random.fold_in(jax.random.fold_in(key, 100 + r), me)
+            score = score + jax.random.gumbel(kr, (rows,), x.dtype)
+            top_vals, top_idx = lax.top_k(score, s_eff)
+            picked = x[top_idx]                         # [s_eff, n]
+            picked_ok = top_vals > -jnp.inf
+            gathered = lax.all_gather(picked, DATA_AXIS)      # [ndev, s_eff, n]
+            gathered_ok = lax.all_gather(picked_ok, DATA_AXIS)
+            at = 1 + r * ndev * s_eff
+            buf = lax.dynamic_update_slice(
+                buf, gathered.reshape(ndev * s_eff, n), (at, 0)
+            )
+            valid = lax.dynamic_update_slice(
+                valid, gathered_ok.reshape(-1), (at,)
+            )
+
+        # ownership counts: blocked argmin assignment; invalid slots sit at
+        # +inf so they can never win, zero-weight/pad rows contribute nothing
+        def count_step(counts, xw):
+            xb, wb = xw
+            lab = jnp.argmin(_masked_d2(xb, buf, valid), axis=1)
+            return counts.at[lab].add(wb), None
+
+        counts, _ = _blocked(count_step, jnp.zeros((cap,), x.dtype), x, w)
+        counts = lax.psum(counts, DATA_AXIS)
+        return buf, jnp.where(valid, counts, 0.0)
+
+    return jax.jit(
+        run,
+        in_shardings=(
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+            NamedSharding(mesh, P(DATA_AXIS)),
+            NamedSharding(mesh, P()),
+        ),
+        out_shardings=NamedSharding(mesh, P()),
+    )

@@ -1049,6 +1049,16 @@ class SparkKMeans(_HasDistribution, KMeans):
 
         with trace_range("kmeans init"):
             if self.getInitMode() == "k-means||":
+                if distribution == "mesh-local":
+                    # seed IN-PROGRAM on the mesh (r3 verdict #8): the
+                    # sampling rounds run as psum/all_gather passes over
+                    # the already-ingested shards inside _lloyd_df, so the
+                    # whole fit is driver-hop-free — no candidates bounce
+                    # through Spark jobs
+                    return self._lloyd_df(
+                        selected, input_col, weight_col, None,
+                        ckpt=ckpt, checkpoint_every=checkpoint_every,
+                    )
                 centers = self._kmeans_parallel_init_df(
                     selected, input_col, weight_col, k
                 )
@@ -1112,7 +1122,7 @@ class SparkKMeans(_HasDistribution, KMeans):
         selected,
         input_col: str,
         weight_col: str | None,
-        centers: np.ndarray,
+        centers: np.ndarray | None,
         *,
         ckpt=None,
         checkpoint_every: int = 1,
@@ -1123,23 +1133,64 @@ class SparkKMeans(_HasDistribution, KMeans):
         iteration, centers broadcast in the task state; with ``ckpt`` set,
         durable training-state checkpoints between Spark jobs. ``cost0``
         carries the checkpointed cost so a resume at maxIter (zero further
-        iterations) still reports the true trainingCost."""
+        iterations) still reports the true trainingCost.
+
+        ``centers=None`` means "seed on the mesh" (k-means|| rounds as one
+        SPMD program over the ingested shards) and is ONLY meaningful for
+        distribution='mesh-local'; every other mode requires concrete
+        centers."""
+        if centers is None and self.getOrDefault("distribution") != "mesh-local":
+            raise ValueError(
+                "centers=None (in-program k-means|| seeding) requires "
+                "distribution='mesh-local'"
+            )
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.ops import kmeans as KM
 
         k = self.getK()
         if self.getOrDefault("distribution") == "mesh-local":
+            import jax
+
             from spark_rapids_ml_tpu.parallel import kmeans as PK
 
             from spark_rapids_ml_tpu.spark import ingest
 
+            n = (
+                centers.shape[1]
+                if centers is not None
+                else _infer_n(selected, input_col)
+            )
             ing = ingest.stream_to_mesh(
-                selected, features_col=input_col, n=centers.shape[1],
+                selected, features_col=input_col, n=n,
                 weight_col=weight_col, with_weights=True,
             )
             if weight_col and float(ing.ws.sum()) == 0.0:
                 raise ValueError("all instance weights are zero")
+            if centers is None:
+                # k-means|| seeding ON the mesh: Bahmani rounds as one XLA
+                # program over the ingested shards, weighted k-means++
+                # k-reduction on-device — candidates never leave the mesh
+                with trace_range("kmeans mesh init"):
+                    init_fn = PK.make_distributed_kmeans_parallel_init(
+                        ing.mesh, k, init_steps=self.getInitSteps()
+                    )
+                    cand, counts = init_fn(
+                        ing.xs, ing.ws, jax.random.PRNGKey(self.getSeed())
+                    )
+                    if int((np.asarray(counts) > 0).sum()) <= k:
+                        # degenerate oversampling (tiny/collapsed data):
+                        # the driver-pass init has the uniform top-up logic
+                        centers = self._kmeans_parallel_init_df(
+                            selected, input_col, weight_col, k
+                        )
+                    else:
+                        centers = np.asarray(
+                            KM.weighted_kmeans_plus_plus_init(
+                                jax.random.PRNGKey(self.getSeed() + 1),
+                                cand, counts, k,
+                            )
+                        )
             max_iter, tol = self.getMaxIter(), self.getTol()
             if ckpt is not None:
                 # chunked whole-loop Lloyd: checkpoint_every iterations per

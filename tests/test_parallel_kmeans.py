@@ -63,3 +63,62 @@ class TestShardedMoments:
         mean, std = S.finalize_moments(got)
         np.testing.assert_allclose(np.asarray(mean), x.mean(0), rtol=1e-10)
         np.testing.assert_allclose(np.asarray(std), x.std(0, ddof=1), rtol=1e-8)
+
+
+class TestMeshKMeansParallelInit:
+    """k-means|| oversampling as one SPMD program (r3 verdict #8)."""
+
+    def _sharded(self, mesh, x, w):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        xs = jax.device_put(jnp.asarray(x), M.data_sharding(mesh))
+        ws = jax.device_put(
+            jnp.asarray(w), NamedSharding(mesh, P(M.DATA_AXIS))
+        )
+        return xs, ws
+
+    def test_counts_partition_the_weight_and_exclude_zero_weight(self, mesh, rng):
+        k = 6
+        anchors = rng.normal(size=(k, 8)) * 6
+        x = np.vstack(
+            [anchors[i] + 0.4 * rng.normal(size=(200, 8)) for i in range(k)]
+        )
+        poison = np.full((48, 8), 50.0)  # w=0: must never be sampled
+        xa = np.vstack([x, poison])
+        w = np.concatenate([np.ones(len(x)), np.zeros(48)])
+        xs, ws = self._sharded(mesh, xa, w)
+        init_fn = PK.make_distributed_kmeans_parallel_init(mesh, k, init_steps=2)
+        cand, counts = init_fn(xs, ws, jax.random.PRNGKey(3))
+        cand, counts = np.asarray(cand), np.asarray(counts)
+        # ownership counts partition the total instance weight exactly
+        assert counts.sum() == len(x)
+        assert (counts > 0).sum() > k  # oversampled
+        assert not (np.abs(cand - 50.0) < 1.0).all(axis=1).any()
+
+    def test_seeds_reach_driver_init_quality(self, mesh, rng):
+        k = 5
+        anchors = rng.normal(size=(k, 6)) * 8
+        x = np.vstack(
+            [anchors[i] + 0.3 * rng.normal(size=(160, 6)) for i in range(k)]
+        )
+        w = np.ones(len(x))
+        xs, ws = self._sharded(mesh, x, w)
+        init_fn = PK.make_distributed_kmeans_parallel_init(mesh, k, init_steps=2)
+        cand, counts = init_fn(xs, ws, jax.random.PRNGKey(9))
+        centers0 = KM.weighted_kmeans_plus_plus_init(
+            jax.random.PRNGKey(10), cand, counts, k
+        )
+        fit = PK.make_distributed_kmeans_fit(mesh, max_iter=25, tol=1e-8)
+        _, cost_mesh, _ = fit(xs, ws, centers0)
+        ref0 = KM.kmeans_plus_plus_init(jax.random.PRNGKey(10), jnp.asarray(x), k)
+        _, cost_ref, _ = fit(xs, ws, jnp.asarray(ref0))
+        # same final-cost ballpark as a full-data k-means++ seeding
+        assert float(cost_mesh) < 1.5 * float(cost_ref) + 1e-9
+
+    def test_replicated_outputs(self, mesh, rng):
+        x = rng.normal(size=(256, 4))
+        xs, ws = self._sharded(mesh, x, np.ones(256))
+        init_fn = PK.make_distributed_kmeans_parallel_init(mesh, 3, init_steps=1)
+        cand, counts = init_fn(xs, ws, jax.random.PRNGKey(0))
+        assert cand.sharding.is_fully_replicated
+        assert counts.sharding.is_fully_replicated

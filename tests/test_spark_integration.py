@@ -1078,3 +1078,39 @@ class TestMeshLocalDistribution:
         np.testing.assert_allclose(
             m.singularValues, core.singularValues, rtol=1e-10
         )
+
+
+class TestKMeansMeshLocalParallelInit:
+    """k-means|| + mesh-local seeds IN-PROGRAM (r3 verdict #8): the whole
+    fit — init rounds included — runs on the mesh with no candidate rows
+    bouncing through driver jobs, and lands at driver-init-quality cost."""
+
+    def test_mesh_init_quality_matches_driver_init(self, backend):
+        rng = np.random.default_rng(77)
+        k = 4
+        anchors = rng.normal(size=(k, 5)) * 8
+        x = np.vstack(
+            [anchors[i] + 0.4 * rng.normal(size=(90, 5)) for i in range(k)]
+        )
+        schema = backend.features_schema()
+        df = backend.df([(row.tolist(),) for row in x], schema)
+
+        def est(distribution):
+            return (
+                SparkKMeans(inputCol="features", k=k, seed=3, maxIter=20)
+                .setInitMode("k-means||")
+                .setDistribution(distribution)
+            )
+
+        mesh_model = est("mesh-local").fit(df)
+        driver_model = est("driver-merge").fit(df)
+        assert mesh_model.clusterCenters.shape == (k, 5)
+        # both inits recover the anchor structure: equal-cost ballpark
+        assert (
+            mesh_model.trainingCost < 1.3 * driver_model.trainingCost + 1e-9
+        )
+        # every anchor is represented by a nearby center
+        d = np.linalg.norm(
+            mesh_model.clusterCenters[:, None, :] - anchors[None, :, :], axis=2
+        )
+        assert d.min(axis=0).max() < 2.0
