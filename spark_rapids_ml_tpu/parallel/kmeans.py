@@ -291,3 +291,28 @@ def make_distributed_kmeans_parallel_init(
         ),
         out_shardings=NamedSharding(mesh, P()),
     )
+
+
+def run_chunked_lloyd(
+    chunk_fn, x, w_vec, centers0, *, start_iter, max_iter, tol, ckpt,
+    cost0=float("inf"),
+):
+    """THE host loop for chunked-checkpoint Lloyd fits (see
+    parallel.linear.run_chunked_newton — same sharing rationale; ``ckpt``
+    None on non-writing ranks). Returns (centers, cost, iterations)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    c = jnp.asarray(centers0)
+    it, cost, tol_sq = start_iter, cost0, tol * tol
+    while it < max_iter:
+        c, cost_j, done, shift = chunk_fn(
+            x, w_vec, c, jnp.int32(max_iter - it)
+        )
+        it += int(done)
+        cost = float(cost_j)
+        if ckpt is not None:
+            ckpt.save(it - 1, {"centers": np.asarray(c)}, {"cost": cost})
+        if float(shift) <= tol_sq:
+            break
+    return c, cost, it

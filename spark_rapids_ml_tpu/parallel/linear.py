@@ -391,3 +391,36 @@ def make_distributed_softmax_chunk(
         ),
         out_shardings=NamedSharding(mesh, P()),
     )
+
+
+def run_chunked_newton(
+    chunk_fn, x, y, w_vec, w0, *, start_iter, max_iter, tol, ckpt
+):
+    """THE host loop for chunked-checkpoint Newton fits — shared by the
+    mesh-local estimator paths and both barrier FitFns so the subtle parts
+    (budget arithmetic, the NaN-sentinel stop test, save-index convention)
+    exist once. ``ckpt`` is a TrainingCheckpointer or None (barrier ranks
+    other than 0 pass None but still run the identical loop, keeping the
+    replicated carry and stop decision group-consistent).
+
+    Returns (w [replicated device array], iterations_completed).
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    w = jnp.asarray(w0)
+    it = start_iter
+    while it < max_iter:
+        w, done, step = chunk_fn(x, y, w_vec, w, jnp.int32(max_iter - it))
+        it += int(done)
+        stop = not float(step) > tol  # NaN-sentinel stops too (step is NaN)
+        if stop:
+            # BEFORE the save: NaN-input rejection must not leave a junk
+            # zeros checkpoint that a post-cleanup re-fit would silently
+            # resume from one iteration in
+            LIN.check_newton_outcome(step, w)
+        if ckpt is not None:
+            ckpt.save(it - 1, {"w": np.asarray(w)}, {})
+        if stop:
+            break
+    return w, it

@@ -608,18 +608,6 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         selected = dataset.select(*cols)
         fit_intercept = self.getFitIntercept()
         distribution = self.getOrDefault("distribution")
-        if distribution == "mesh-barrier" and checkpoint_dir is not None:
-            # params-only rejection: fail BEFORE any cluster job runs.
-            # mesh-local checkpoints via the chunked whole-loop program
-            # (K iterations per XLA program, host checkpoint between
-            # chunks); the barrier stage's workers have no shared durable
-            # store for a rank-0 save yet
-            raise ValueError(
-                "checkpoint_dir is not supported with "
-                "distribution='mesh-barrier': the barrier fit runs inside "
-                "executor workers with no driver hop; use 'mesh-local' "
-                "(chunked checkpointing) or 'driver-merge'"
-            )
         n = _infer_n(dataset, feats)
         # class-count detection: one cheap distinct-label pass over the
         # label column (the DataFrame analog of the core path's np.unique,
@@ -653,10 +641,13 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
             if n_classes > 2:
                 return self._fit_softmax_mesh_barrier(
                     selected, feats, label, weight_col, n, n_classes,
-                    fit_intercept,
+                    fit_intercept, checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every,
                 )
             return self._fit_binary_mesh_barrier(
-                selected, feats, label, weight_col, n, fit_intercept
+                selected, feats, label, weight_col, n, fit_intercept,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
             )
         if n_classes > 2:
             return self._fit_multinomial_df(
@@ -697,12 +688,22 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         return self._binary_model(w_full, fit_intercept)
 
     def _fit_binary_mesh_barrier(
-        self, selected, feats, label, weight_col, n, fit_intercept
+        self, selected, feats, label, weight_col, n, fit_intercept,
+        *, checkpoint_dir=None, checkpoint_every=5,
     ) -> "SparkLogisticRegressionModel":
-        """One barrier stage = the whole binary Newton fit (spark/spmd.py)."""
+        """One barrier stage = the whole binary Newton fit (spark/spmd.py).
+
+        With ``checkpoint_dir`` (a path on a filesystem SHARED by the
+        driver and every executor — the jvm stagingDir contract) the stage
+        runs chunked with rank-0 saves; the driver resolves the resume
+        before launching, so a preempted fit restarts mid-loop."""
+        from spark_rapids_ml_tpu.models.linear import _resume_newton_checkpoint
         from spark_rapids_ml_tpu.spark import spmd
 
         d = n + 1 if fit_intercept else n
+        w0, start_iter, ckpt = _resume_newton_checkpoint(checkpoint_dir, d)
+        if ckpt is not None and start_iter >= self.getMaxIter():
+            return self._binary_model(np.asarray(w0), fit_intercept)
         with trace_range("logreg mesh fit"):
             arrays = _barrier_single_row(
                 selected,
@@ -713,6 +714,10 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                     fit_intercept=fit_intercept,
                     max_iter=self.getMaxIter(),
                     tol=self.getTol(),
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every,
+                    w0=w0 if ckpt is not None else None,
+                    start_iter=start_iter,
                 ),
                 spmd.LOGREG_FIT_FIELDS,
                 {"w": (d,), "iterations": (), "count": (), "mesh_size": ()},
@@ -722,14 +727,23 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
         return self._binary_model(arrays["w"], fit_intercept)
 
     def _fit_softmax_mesh_barrier(
-        self, selected, feats, label, weight_col, n, n_classes, fit_intercept
+        self, selected, feats, label, weight_col, n, n_classes, fit_intercept,
+        *, checkpoint_dir=None, checkpoint_every=5,
     ) -> "SparkLogisticRegressionModel":
         """One barrier stage = the whole softmax Newton fit (spark/spmd.py
-        MeshSoftmaxFitFn); mirrors _fit_multinomial_df's model surface."""
+        MeshSoftmaxFitFn); mirrors _fit_multinomial_df's model surface.
+        Checkpointing follows _fit_binary_mesh_barrier's shared-filesystem
+        rank-0 contract."""
+        from spark_rapids_ml_tpu.models.linear import _resume_newton_checkpoint
         from spark_rapids_ml_tpu.spark import spmd
 
         d = n + 1 if fit_intercept else n
         cd = n_classes * d
+        w0, start_iter, ckpt = _resume_newton_checkpoint(checkpoint_dir, cd)
+        if ckpt is not None and start_iter >= self.getMaxIter():
+            # resumed at the final iteration: build the model directly,
+            # like the binary sibling (no stage launch, no fake stats row)
+            return self._softmax_model(np.asarray(w0), n_classes, fit_intercept)
         with trace_range("softmax mesh fit"):
             arrays = _barrier_single_row(
                 selected,
@@ -740,23 +754,18 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                     fit_intercept=fit_intercept,
                     max_iter=self.getMaxIter(),
                     tol=self.getTol(),
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every,
+                    w0=w0 if ckpt is not None else None,
+                    start_iter=start_iter,
                 ),
                 spmd.LOGREG_FIT_FIELDS,
-                {"w": (cd,), "iterations": (), "count": (), "mesh_size": ()},
+                {"w": (cd,), "iterations": (), "count": (),
+                 "mesh_size": ()},
             )
         if weight_col and float(arrays["count"]) == 0.0:
             raise ValueError("all instance weights are zero")
-        w_mat = arrays["w"].reshape(n_classes, d)
-        if fit_intercept:
-            coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
-        else:
-            coef_matrix, intercepts = w_mat, np.zeros(n_classes)
-        model = SparkLogisticRegressionModel(
-            uid=self.uid,
-            coefficientMatrix=coef_matrix,
-            interceptVector=intercepts,
-        )
-        return self._copyValues(model)
+        return self._softmax_model(arrays["w"], n_classes, fit_intercept)
 
     def _fit_mesh_local(
         self, selected, feats, label, weight_col, n, n_classes, fit_intercept,
@@ -810,23 +819,12 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                 chunk_fn = PL.make_distributed_logreg_chunk(
                     mesh, chunk_iters=checkpoint_every, tol=tol, **reg
                 )
-            w = jnp.asarray(w0)
-            it = start_iter
             with trace_range("logreg mesh-local chunked fit"):
-                while it < max_iter:
-                    w, done, step = chunk_fn(
-                        xs, ys, ws, w, jnp.int32(max_iter - it)
-                    )
-                    it += int(done)
-                    stop = not float(step) > tol
-                    if stop:
-                        # BEFORE the save: NaN-input rejection must not
-                        # leave a junk zeros checkpoint that a post-cleanup
-                        # re-fit would silently resume from one iteration in
-                        LIN.check_newton_outcome(step, w)
-                    ckpt.save(it - 1, {"w": np.asarray(w)}, {})
-                    if stop:
-                        break
+                w, _ = PL.run_chunked_newton(
+                    chunk_fn, xs, ys, ws, w0,
+                    start_iter=start_iter, max_iter=max_iter, tol=tol,
+                    ckpt=ckpt,
+                )
             w_final = np.asarray(w)
         else:
             with trace_range("logreg mesh-local fit"):
@@ -845,17 +843,7 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                     LIN.check_newton_outcome(final_step, w_full)
                     w_final = np.asarray(w_full)
         if n_classes > 2:
-            w_mat = w_final.reshape(n_classes, -1)
-            if fit_intercept:
-                coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
-            else:
-                coef_matrix, intercepts = w_mat, np.zeros(n_classes)
-            model = SparkLogisticRegressionModel(
-                uid=self.uid,
-                coefficientMatrix=coef_matrix,
-                interceptVector=intercepts,
-            )
-            return self._copyValues(model)
+            return self._softmax_model(w_final, n_classes, fit_intercept)
         return self._binary_model(w_final, fit_intercept)
 
     def _binary_model(
@@ -869,6 +857,23 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
             coef, intercept = w_full, 0.0
         model = SparkLogisticRegressionModel(
             uid=self.uid, coefficients=coef, intercept=intercept
+        )
+        return self._copyValues(model)
+
+    def _softmax_model(
+        self, w_flat: np.ndarray, n_classes: int, fit_intercept: bool
+    ) -> "SparkLogisticRegressionModel":
+        """The multinomial sibling of ``_binary_model``: flattened [C·d]
+        parameter → coefficientMatrix/interceptVector model."""
+        w_mat = np.asarray(w_flat).reshape(n_classes, -1)
+        if fit_intercept:
+            coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
+        else:
+            coef_matrix, intercepts = w_mat, np.zeros(n_classes)
+        model = SparkLogisticRegressionModel(
+            uid=self.uid,
+            coefficientMatrix=coef_matrix,
+            interceptVector=intercepts,
         )
         return self._copyValues(model)
 
@@ -933,17 +938,7 @@ class SparkLogisticRegression(_HasDistribution, LogisticRegression):
                     ckpt.save(it, {"w": w_flat}, {"loss": float(stats.loss)})
                 if float(step_norm) <= self.getTol():
                     break
-        w_mat = w_flat.reshape(n_classes, d)
-        if fit_intercept:
-            coef_matrix, intercepts = w_mat[:, :-1], w_mat[:, -1]
-        else:
-            coef_matrix, intercepts = w_mat, np.zeros(n_classes)
-        model = SparkLogisticRegressionModel(
-            uid=self.uid,
-            coefficientMatrix=coef_matrix,
-            interceptVector=intercepts,
-        )
-        return self._copyValues(model)
+        return self._softmax_model(w_flat, n_classes, fit_intercept)
 
 
 class SparkLogisticRegressionModel(LogisticRegressionModel):
@@ -1018,13 +1013,6 @@ class SparkKMeans(_HasDistribution, KMeans):
         k = self.getK()
 
         distribution = self.getOrDefault("distribution")
-        if distribution == "mesh-barrier" and checkpoint_dir is not None:
-            raise ValueError(
-                "checkpoint_dir is not supported with "
-                "distribution='mesh-barrier': the barrier fit runs inside "
-                "executor workers with no driver hop; use 'mesh-local' "
-                "(chunked checkpointing) or 'driver-merge'"
-            )
         # resume BEFORE seeding: an interrupted Spark-path fit pointed at the
         # same checkpoint_dir continues mid-Lloyd (the SAME resume contract
         # and layout as the core path — shared helper)
@@ -1045,6 +1033,7 @@ class SparkKMeans(_HasDistribution, KMeans):
                 selected, input_col, weight_col, resumed_centers,
                 ckpt=ckpt, checkpoint_every=checkpoint_every,
                 start_iter=start_iter, cost0=cost0,
+                checkpoint_dir=checkpoint_dir,
             )
 
         with trace_range("kmeans init"):
@@ -1058,6 +1047,7 @@ class SparkKMeans(_HasDistribution, KMeans):
                     return self._lloyd_df(
                         selected, input_col, weight_col, None,
                         ckpt=ckpt, checkpoint_every=checkpoint_every,
+                        checkpoint_dir=checkpoint_dir,
                     )
                 centers = self._kmeans_parallel_init_df(
                     selected, input_col, weight_col, k
@@ -1065,6 +1055,7 @@ class SparkKMeans(_HasDistribution, KMeans):
                 return self._lloyd_df(
                     selected, input_col, weight_col, centers,
                     ckpt=ckpt, checkpoint_every=checkpoint_every,
+                    checkpoint_dir=checkpoint_dir,
                 )
             # zero-weight rows are excluded instances: filter them in the
             # PLAN so the bounded sample only sees seedable rows
@@ -1115,6 +1106,7 @@ class SparkKMeans(_HasDistribution, KMeans):
         return self._lloyd_df(
             selected, input_col, weight_col, centers,
             ckpt=ckpt, checkpoint_every=checkpoint_every,
+            checkpoint_dir=checkpoint_dir,
         )
 
     def _lloyd_df(
@@ -1128,6 +1120,7 @@ class SparkKMeans(_HasDistribution, KMeans):
         checkpoint_every: int = 1,
         start_iter: int = 0,
         cost0: float = np.inf,
+        checkpoint_dir: str | None = None,
     ) -> "SparkKMeansModel":
         """The Lloyd loop over DataFrames: one mapInArrow stats job per
         iteration, centers broadcast in the task state; with ``ckpt`` set,
@@ -1196,22 +1189,15 @@ class SparkKMeans(_HasDistribution, KMeans):
                 # chunked whole-loop Lloyd: checkpoint_every iterations per
                 # cached XLA program, durable centers between chunks (the
                 # same resume contract as the driver-merge loop)
-                chunk_fn = PK.make_distributed_kmeans_chunk(
-                    ing.mesh, chunk_iters=checkpoint_every, tol=tol
-                )
-                c = jnp.asarray(centers)
-                it, cost, tol_sq = start_iter, cost0, tol * tol
                 with trace_range("kmeans mesh-local chunked fit"):
-                    while it < max_iter:
-                        c, cost_j, done, shift = chunk_fn(
-                            ing.xs, ing.ws, c, jnp.int32(max_iter - it)
-                        )
-                        it += int(done)
-                        cost = float(cost_j)
-                        ckpt.save(it - 1, {"centers": np.asarray(c)},
-                                  {"cost": cost})
-                        if float(shift) <= tol_sq:
-                            break
+                    c, cost, _ = PK.run_chunked_lloyd(
+                        PK.make_distributed_kmeans_chunk(
+                            ing.mesh, chunk_iters=checkpoint_every, tol=tol
+                        ),
+                        ing.xs, ing.ws, centers,
+                        start_iter=start_iter, max_iter=max_iter, tol=tol,
+                        ckpt=ckpt, cost0=cost0,
+                    )
                 model = SparkKMeansModel(
                     uid=self.uid, clusterCenters=np.asarray(c),
                     trainingCost=cost,
@@ -1233,12 +1219,22 @@ class SparkKMeans(_HasDistribution, KMeans):
         if self.getOrDefault("distribution") == "mesh-barrier":
             from spark_rapids_ml_tpu.spark import spmd
 
+            if start_iter >= self.getMaxIter():
+                # resumed at the final iteration: nothing left to run
+                model = SparkKMeansModel(
+                    uid=self.uid, clusterCenters=centers,
+                    trainingCost=float(cost0),
+                )
+                return self._copyValues(model)
             with trace_range("kmeans mesh fit"):
                 arrays = _barrier_single_row(
                     selected,
                     spmd.MeshKMeansFitFn(
                         input_col, centers, weight_col,
                         max_iter=self.getMaxIter(), tol=self.getTol(),
+                        checkpoint_dir=checkpoint_dir,
+                        checkpoint_every=checkpoint_every,
+                        start_iter=start_iter,
                     ),
                     spmd.KMEANS_FIT_FIELDS,
                     {"centers": (k, centers.shape[1]), "cost": (),

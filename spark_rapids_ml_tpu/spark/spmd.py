@@ -358,6 +358,10 @@ class MeshLogRegFitFn(_MeshReducePartitionFn):
         max_iter: int,
         tol: float,
         elastic_net_param: float = 0.0,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 5,
+        w0: np.ndarray | None = None,
+        start_iter: int = 0,
     ):
         super().__init__(features_col, label_col, weight_col)
         self.reg_param = float(reg_param)
@@ -365,6 +369,16 @@ class MeshLogRegFitFn(_MeshReducePartitionFn):
         self.fit_intercept = bool(fit_intercept)
         self.max_iter = int(max_iter)
         self.tol = float(tol)
+        # Chunked rank-0 checkpointing (the mesh-local contract, barrier
+        # edition): ``checkpoint_dir`` MUST be on a filesystem shared by
+        # the driver and every executor (the jvm stagingDir contract) —
+        # process 0 of the jax.distributed group saves between chunks, and
+        # the DRIVER resolves the resume (w0/start_iter) before launching
+        # the stage so interrupted fits restart mid-loop.
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.w0 = None if w0 is None else np.asarray(w0)
+        self.start_iter = int(start_iter)
 
     def _prepare_matrix(self, mat: np.ndarray) -> np.ndarray:
         if self.fit_intercept:
@@ -387,17 +401,71 @@ class MeshLogRegFitFn(_MeshReducePartitionFn):
             tol=self.tol,
         )
 
+    def _make_chunk(self, mesh):
+        from spark_rapids_ml_tpu.parallel import linear as PL
+
+        return PL.make_distributed_logreg_chunk(
+            mesh,
+            reg_param=self.reg_param,
+            elastic_net_param=self.elastic_net_param,
+            fit_intercept=self.fit_intercept,
+            chunk_iters=self.checkpoint_every,
+            tol=self.tol,
+        )
+
+    def _param_dim(self, d: int) -> int:
+        return d
+
     def _run_on_mesh(self, mesh, gx, gw, gy):
         import jax
         import jax.numpy as jnp
 
-        w, iters, _ = self._make_fit(mesh)(gx, gy, gw)  # (x_aug, labels, w)
+        from spark_rapids_ml_tpu.ops import linear as LIN
+        from spark_rapids_ml_tpu.parallel import linear as PL
+
+        count = float(jnp.sum(gw))
+        if count == 0.0:
+            # all-zero weights: skip training (the stats are all zero and
+            # the solve would NaN for the wrong reason); the DRIVER raises
+            # its "all instance weights are zero" contract error on the
+            # returned count
+            cd = self._param_dim(gx.shape[1])
+            return {
+                "w": np.zeros(cd),
+                "iterations": np.float64(0.0),
+                "count": np.float64(0.0),
+            }
+        if self.checkpoint_dir is None:
+            w, iters, final_step = self._make_fit(mesh)(gx, gy, gw)
+            # same NaN-input diagnosis as every other Newton path
+            LIN.check_newton_outcome(final_step, w)
+        else:
+            from spark_rapids_ml_tpu.utils.checkpoint import (
+                TrainingCheckpointer,
+            )
+
+            # rank 0 of the process group owns the durable saves; every
+            # rank runs the identical replicated loop (parallel.linear
+            # run_chunked_newton), so the stop decision (and a NaN-input
+            # raise) is group-consistent
+            ckpt = (
+                TrainingCheckpointer(self.checkpoint_dir)
+                if jax.process_index() == 0
+                else None
+            )
+            cd = self._param_dim(gx.shape[1])
+            w, iters = PL.run_chunked_newton(
+                self._make_chunk(mesh), gx, gy, gw,
+                self.w0 if self.w0 is not None else np.zeros(cd),
+                start_iter=self.start_iter, max_iter=self.max_iter,
+                tol=self.tol, ckpt=ckpt,
+            )
         return {
             "w": np.asarray(jax.device_get(w)),
             "iterations": np.float64(int(iters)),
             # weighted count (pad rows weigh 0): the driver enforces the
             # same all-zero-weights contract as the driver-merge path
-            "count": np.float64(float(jnp.sum(gw))),
+            "count": np.float64(count),
         }
 
 
@@ -419,12 +487,19 @@ class MeshSoftmaxFitFn(MeshLogRegFitFn):
         max_iter: int,
         tol: float,
         elastic_net_param: float = 0.0,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 5,
+        w0: np.ndarray | None = None,
+        start_iter: int = 0,
     ):
         super().__init__(
             features_col, label_col, weight_col,
             reg_param=reg_param, fit_intercept=fit_intercept,
             max_iter=max_iter, tol=tol,
             elastic_net_param=elastic_net_param,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            w0=w0, start_iter=start_iter,
         )
         self.n_classes = int(n_classes)
 
@@ -440,6 +515,22 @@ class MeshSoftmaxFitFn(MeshLogRegFitFn):
             max_iter=self.max_iter,
             tol=self.tol,
         )
+
+    def _make_chunk(self, mesh):
+        from spark_rapids_ml_tpu.parallel import linear as PL
+
+        return PL.make_distributed_softmax_chunk(
+            mesh,
+            self.n_classes,
+            reg_param=self.reg_param,
+            elastic_net_param=self.elastic_net_param,
+            fit_intercept=self.fit_intercept,
+            chunk_iters=self.checkpoint_every,
+            tol=self.tol,
+        )
+
+    def _param_dim(self, d: int) -> int:
+        return self.n_classes * d
 
 
 class MeshSVDFitFn(_MeshReducePartitionFn):
@@ -520,11 +611,19 @@ class MeshKMeansFitFn(_MeshReducePartitionFn):
         *,
         max_iter: int,
         tol: float,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 5,
+        start_iter: int = 0,
     ):
         super().__init__(input_col, None, weight_col)
         self.centers = np.asarray(centers)
         self.max_iter = int(max_iter)
         self.tol = float(tol)
+        # rank-0 chunked checkpointing; shared-filesystem contract as in
+        # MeshLogRegFitFn (the driver resolves resumed centers/start_iter)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.start_iter = int(start_iter)
 
     def _run_on_mesh(self, mesh, gx, gw, gy):
         import jax
@@ -532,10 +631,29 @@ class MeshKMeansFitFn(_MeshReducePartitionFn):
 
         from spark_rapids_ml_tpu.parallel import kmeans as PK
 
-        fit = PK.make_distributed_kmeans_fit(
-            mesh, max_iter=self.max_iter, tol=self.tol
-        )
-        centers, cost, iters = fit(gx, gw, jnp.asarray(self.centers))
+        if self.checkpoint_dir is None:
+            fit = PK.make_distributed_kmeans_fit(
+                mesh, max_iter=self.max_iter, tol=self.tol
+            )
+            centers, cost, iters = fit(gx, gw, jnp.asarray(self.centers))
+        else:
+            from spark_rapids_ml_tpu.utils.checkpoint import (
+                TrainingCheckpointer,
+            )
+
+            ckpt = (
+                TrainingCheckpointer(self.checkpoint_dir)
+                if jax.process_index() == 0
+                else None
+            )
+            centers, cost, iters = PK.run_chunked_lloyd(
+                PK.make_distributed_kmeans_chunk(
+                    mesh, chunk_iters=self.checkpoint_every, tol=self.tol
+                ),
+                gx, gw, self.centers,
+                start_iter=self.start_iter, max_iter=self.max_iter,
+                tol=self.tol, ckpt=ckpt,
+            )
         return {
             "centers": np.asarray(jax.device_get(centers)),
             "cost": np.float64(float(cost)),

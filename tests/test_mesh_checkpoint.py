@@ -116,12 +116,50 @@ class TestLogRegMeshChunkedCheckpoint:
             atol=1e-10,
         )
 
-    def test_mesh_barrier_still_rejects(self, session, tmp_path):
+    def test_barrier_partial_then_resume_matches_uninterrupted(
+        self, session, tmp_path
+    ):
+        # mesh-barrier edition: rank 0 of the jax.distributed group saves
+        # between chunks (shared filesystem — one host here), the DRIVER
+        # resolves the resume before launching the next stage
         x, y = self._data()
         df = _labeled_df(session, x, y)
-        est = SparkLogisticRegression().setDistribution("mesh-barrier")
-        with pytest.raises(ValueError, match="mesh-local"):
-            est.fit(df, checkpoint_dir=str(tmp_path / "nope"))
+        ckdir = str(tmp_path / "lr_barrier_ck")
+
+        def est(iters):
+            return (
+                SparkLogisticRegression(maxIter=iters, regParam=1e-3)
+                .setTol(0.0)
+                .setDistribution("mesh-barrier")
+            )
+
+        uninterrupted = est(6).fit(df)
+        est(2).fit(df, checkpoint_dir=ckdir, checkpoint_every=2)
+        resumed = est(6).fit(df, checkpoint_dir=ckdir, checkpoint_every=2)
+        np.testing.assert_allclose(
+            resumed.coefficients, uninterrupted.coefficients, atol=1e-10
+        )
+
+    def test_barrier_resume_at_max_iter_skips_the_stage(self, session, tmp_path):
+        x, y = self._data()
+        df = _labeled_df(session, x, y)
+        ckdir = str(tmp_path / "lr_barrier_ck2")
+        full = self._est_barrier(4).fit(
+            df, checkpoint_dir=ckdir, checkpoint_every=1
+        )
+        resumed = self._est_barrier(4).fit(
+            df, checkpoint_dir=ckdir, checkpoint_every=1
+        )
+        np.testing.assert_allclose(
+            resumed.coefficients, full.coefficients, atol=1e-12
+        )
+
+    def _est_barrier(self, iters):
+        return (
+            SparkLogisticRegression(maxIter=iters, regParam=1e-3)
+            .setTol(0.0)
+            .setDistribution("mesh-barrier")
+        )
 
 
 class TestKMeansMeshChunkedCheckpoint:
@@ -162,8 +200,23 @@ class TestKMeansMeshChunkedCheckpoint:
             resumed.clusterCenters, full.clusterCenters, atol=1e-12
         )
 
-    def test_mesh_barrier_still_rejects(self, session, tmp_path):
-        df = _features_df(session, self._data())
-        est = SparkKMeans(k=3, seed=7).setDistribution("mesh-barrier")
-        with pytest.raises(ValueError, match="mesh-local"):
-            est.fit(df, checkpoint_dir=str(tmp_path / "nope"))
+    def test_barrier_partial_then_resume_matches_uninterrupted(
+        self, session, tmp_path
+    ):
+        x = self._data()
+        df = _features_df(session, x)
+        ckdir = str(tmp_path / "km_barrier_ck")
+
+        def est(iters):
+            return (
+                SparkKMeans(k=3, seed=7, maxIter=iters)
+                .setTol(0.0)
+                .setDistribution("mesh-barrier")
+            )
+
+        uninterrupted = est(6).fit(df)
+        est(2).fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        resumed = est(6).fit(df, checkpoint_dir=ckdir, checkpoint_every=1)
+        np.testing.assert_allclose(
+            resumed.clusterCenters, uninterrupted.clusterCenters, atol=1e-10
+        )
