@@ -259,6 +259,25 @@ def main() -> None:
 
     accuracy_ok = bool(min_cosine >= 0.9999)
     tag = "_SMOKE" if SMOKE else ""
+    # Raw throughput alongside the modeled vs_baseline (r3 verdict weak #4:
+    # "publishing the raw TF/s and MXU-utilization makes it harder to fool
+    # ourselves" — the A100 roofline model stays, but these numbers are
+    # model-free): logical FLOPs of the measured program's dominant term
+    # (the Gram GEMM, 2·rows·n²; the decomposition is O(n²·(k+l)) ≪ that),
+    # and utilization against the published v5e-1 bf16 peak with the 3-pass
+    # Precision.HIGH multiplier made explicit — the MXU executes 3 bf16
+    # passes per logical f32-accurate multiply on this configuration.
+    V5E_BF16_PEAK_TFLOPS = 197.0
+    logical_tflop = 2.0 * ROWS * N * N / 1e12
+    achieved_tflops = logical_tflop / per_fit
+    hw_tflops_high = 3.0 * achieved_tflops  # 3-pass bf16 split
+    derived = {
+        "gram_logical_tflop": round(logical_tflop, 4),
+        "achieved_logical_tflop_s": round(achieved_tflops, 2),
+        "hw_bf16_tflop_s_at_3pass": round(hw_tflops_high, 2),
+        "v5e1_bf16_peak_tflop_s": V5E_BF16_PEAK_TFLOPS,
+        "mxu_utilization": round(hw_tflops_high / V5E_BF16_PEAK_TFLOPS, 3),
+    }
     print(
         json.dumps(
             {
@@ -279,6 +298,7 @@ def main() -> None:
                     "max": round(max(slopes), 5),
                     "pairs": PAIRS,
                 },
+                "derived": derived,
                 "extra_metrics": [
                     {
                         "metric": f"pca_transform_throughput_{N}f_k{K}",
