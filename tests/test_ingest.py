@@ -192,6 +192,31 @@ def test_pyspark_large_dataset_streams_rows(monkeypatch):
     np.testing.assert_array_equal(np.asarray(ing.xs)[:500], df._mat())
 
 
+class _PysparkLikeWeighted(_PysparkLike):
+    """Row-iterator source with [features, weight] columns and NO label —
+    the positional layout KMeans selects (weight at index 1, not 2)."""
+
+    def toLocalIterator(self):
+        self.used = "rows"
+        for i, r in enumerate(self._mat()):
+            yield (list(r), float(1 + i % 3))
+
+
+def test_row_path_weight_position_without_label(monkeypatch):
+    monkeypatch.setenv(ingest.ARROW_CUTOVER_VAR, "1")  # force the row path
+    rows = 200
+    df = _PysparkLikeWeighted(rows, 4)
+    ing = ingest.stream_to_mesh(
+        df, features_col="features", n=4, weight_col="w"
+    )
+    assert df.used == "rows"
+    w = np.asarray(ing.ws)
+    np.testing.assert_array_equal(
+        w[:rows], 1.0 + (np.arange(rows) % 3)
+    )
+    assert not w[rows:].any()
+
+
 def test_host_memory_is_o_shard_not_o_dataset():
     """The r3 verdict's bound: peak host allocation during a mesh-local
     ingest must scale with ONE shard, not the dataset. 200k×64 f64 is

@@ -477,6 +477,8 @@ class TestBinaryEvaluatorRawPrediction:
         x, y = self._data()
         df = pd.DataFrame({"features": list(x), "label": y})
         out = LogisticRegression().setRegParam(0.01).fit(df).transform(df)
-        # default rawPredictionCol="rawPrediction" is absent -> predictionCol
-        auc = BinaryClassificationEvaluator().evaluate(out)
+        # default rawPredictionCol="rawPrediction" AND the 'probability'
+        # fallback are absent -> degrade to predictionCol, LOUDLY
+        with pytest.warns(UserWarning, match="degrades to the two-level"):
+            auc = BinaryClassificationEvaluator().evaluate(out)
         assert 0.5 <= auc <= 1.0
