@@ -75,17 +75,16 @@ def main() -> None:
     # ----- 2. CV selects regParam on a 3-class softmax problem -----------
     y = np.arange(900, dtype=float) % 3
     xc = anchors[y.astype(int)] + 0.8 * rng.normal(size=(900, 3))
+    grid = ParamGridBuilder().addGrid("regParam", [0.001, 100.0]).build()
     cv = CrossValidator(
         estimator=LogisticRegression(maxIter=30),
-        estimatorParamMaps=(
-            ParamGridBuilder().addGrid("regParam", [0.001, 100.0]).build()
-        ),
+        estimatorParamMaps=grid,
         evaluator=MulticlassClassificationEvaluator(),  # weighted f1
         numFolds=3,
     )
     fitted = cv.fit((xc, y))
     print(
-        f"CV picked regParam={cv._maps[fitted.bestIndex]['regParam']} "
+        f"CV picked regParam={grid[fitted.bestIndex]['regParam']} "
         f"(avg f1 {fitted.avgMetrics[fitted.bestIndex]:.3f} vs "
         f"{fitted.avgMetrics[1 - fitted.bestIndex]:.3f})"
     )

@@ -37,6 +37,7 @@ def __getattr__(name):
         "IncrementalTruncatedSVD",
         "IncrementalStandardScaler",
         "IncrementalLinearRegression",
+        "IncrementalKMeans",
     ):
         from spark_rapids_ml_tpu.models import incremental
 

@@ -34,6 +34,8 @@ from spark_rapids_ml_tpu.models.pca import (
     _qr_r,
     _svd_from_r_jit,
 )
+from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel
+from spark_rapids_ml_tpu.models.params import Param
 from spark_rapids_ml_tpu.models.linear import (
     LinearRegression,
     LinearRegressionModel,
@@ -309,3 +311,184 @@ class IncrementalLinearRegression(LinearRegression):
         self._acc = self._n_cols = None
         self._rows_seen = 0
         return self
+
+
+class IncrementalKMeans(KMeans):
+    """Mini-batch KMeans fitted by streaming batches (Sculley, WWW'10 —
+    the ``sklearn.cluster.MiniBatchKMeans`` shape).
+
+    Unlike the monoid streamers above, Lloyd is iterative, so streaming
+    CANNOT equal the one-shot fit; the honest contract is the mini-batch
+    one: each ``partial_fit(batch)`` runs one weighted assignment pass
+    (the same blocked-MXU ``kmeans_stats`` kernel every other path uses)
+    and a per-center ONLINE-MEAN update — center c moves with step size
+    1/n_c where n_c is its cumulative assigned weight, Sculley's
+    per-center learning rate. Memory is O(k·n) regardless of stream
+    length.
+
+    Seeding: rows buffer host-side until ``max(k, seedRows)`` arrive,
+    then the buffer seeds k centers and replays as the first mini-batch.
+    ``initMode`` semantics on a stream: ``'random'`` draws k uniform
+    positive-weight buffered rows; ``'k-means||'`` (the inherited
+    default) and ``'k-means++'`` both run k-means++ on the buffer — the
+    buffer plays the oversampled-candidate role the distributed rounds
+    play in the batch fit. A stream that ends before the threshold still
+    finalizes: ``finalize()`` seeds from whatever is buffered when it
+    holds at least k positive-weight rows. ``finalize()`` returns a
+    normal :class:`KMeansModel`; its ``trainingCost`` is the LAST batch's
+    assignment cost (a streaming proxy — there is no full-dataset pass to
+    measure true inertia on).
+
+    Stream-order caveat (inherent to mini-batch k-means, not this
+    implementation): a cluster-sorted stream seeds from whatever cluster
+    arrives first, and the 1/n_c rate then migrates centers only slowly.
+    Shuffle the stream, or raise ``seedRows`` past the sorted prefix.
+    """
+
+    seedRows = Param(
+        "seedRows", "rows buffered before k-means++ seeding", int
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(seedRows=4096)
+        self._centers = None       # jnp [k, n]
+        self._cum_weights = None   # jnp [k]
+        self._n_cols: int | None = None
+        self._rows_seen = 0
+        self._last_cost = float("nan")
+        self._seed_rows: list[np.ndarray] = []
+        self._seed_weights: list[np.ndarray] = []
+
+    @property
+    def n_rows_seen(self) -> int:
+        return self._rows_seen
+
+    def _batch_arrays(self, batch: Any, sample_weight):
+        mat = _as_matrix(self, batch)
+        w = None
+        if sample_weight is not None:
+            w = columnar.validate_weights(
+                sample_weight, len(mat), allow_all_zero=True
+            )
+        else:
+            weight_col = self._paramMap.get("weightCol")
+            if weight_col:
+                w = columnar.validate_weights(
+                    columnar.extract_vector(batch, weight_col),
+                    len(mat),
+                    allow_all_zero=True,
+                )
+        return mat, (np.ones(len(mat)) if w is None else w)
+
+    def partial_fit(
+        self, batch: Any, sample_weight=None
+    ) -> "IncrementalKMeans":
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        mat, w = self._batch_arrays(batch, sample_weight)
+        self._rows_seen += len(mat)
+        if self._centers is None:
+            self._seed_rows.append(mat)
+            self._seed_weights.append(w)
+            buffered = sum(len(m) for m in self._seed_rows)
+            if buffered < max(self.getK(), self.getOrDefault("seedRows")):
+                return self  # keep buffering
+            mat, w = self._seed_from_buffer()
+            # fall through: the seed buffer replays as the first mini-batch
+        xp, true_rows = columnar.pad_rows(mat)
+        wp = np.zeros(xp.shape[0])
+        wp[:true_rows] = w  # pad rows carry weight 0: excluded exactly
+        stats = KM.kmeans_stats(
+            jnp.asarray(xp), self._centers, jnp.asarray(wp)
+        )
+        self._centers, self._cum_weights = _minibatch_center_update(
+            self._centers, self._cum_weights, stats.sums, stats.counts
+        )
+        self._last_cost = float(stats.cost)
+        return self
+
+    def _seed_from_buffer(self) -> tuple[np.ndarray, np.ndarray]:
+        """Seed k centers from the buffered rows; returns (mat, w) so the
+        caller replays the buffer as the first mini-batch. Raises WITHOUT
+        consuming the buffer when it lacks k positive-weight rows, so the
+        stream can keep feeding partial_fit after the error."""
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        mat = np.concatenate(self._seed_rows)
+        w = np.concatenate(self._seed_weights)
+        keep = w > 0
+        if keep.sum() < self.getK():
+            raise ValueError(
+                f"k={self.getK()} but only {int(keep.sum())} buffered "
+                "rows with positive weight to seed from"
+            )
+        key = jax.random.PRNGKey(self.getSeed())
+        if self.getInitMode() == "random":
+            rng = np.random.default_rng(self.getSeed())
+            pool = mat[keep]
+            self._centers = jnp.asarray(
+                pool[rng.choice(len(pool), self.getK(), replace=False)]
+            )
+        else:  # 'k-means++' and 'k-means||' both: k-means++ on the buffer,
+            # which plays the oversampled-candidate role the distributed
+            # rounds play in the batch fit
+            self._centers = KM.kmeans_plus_plus_init(
+                key, jnp.asarray(mat[keep]), self.getK()
+            )
+        self._cum_weights = jnp.zeros((self.getK(),), self._centers.dtype)
+        self._seed_rows, self._seed_weights = [], []
+        return mat, w
+
+    def finalize(self) -> KMeansModel:
+        if self._centers is None and self._seed_rows:
+            # short stream (< max(k, seedRows) rows): seed from whatever
+            # arrived and run the buffer as the one-and-only mini-batch
+            from spark_rapids_ml_tpu.ops import kmeans as KM
+
+            mat, w = self._seed_from_buffer()
+            xp, true_rows = columnar.pad_rows(mat)
+            wp = np.zeros(xp.shape[0])
+            wp[:true_rows] = w
+            stats = KM.kmeans_stats(
+                jnp.asarray(xp), self._centers, jnp.asarray(wp)
+            )
+            self._centers, self._cum_weights = _minibatch_center_update(
+                self._centers, self._cum_weights, stats.sums, stats.counts
+            )
+            self._last_cost = float(stats.cost)
+        if self._centers is None:
+            raise ValueError(
+                "finalize() before seeding completed — no rows were "
+                "streamed through partial_fit()"
+            )
+        model = KMeansModel(
+            uid=self.uid,
+            clusterCenters=np.asarray(self._centers),
+            trainingCost=self._last_cost,
+        )
+        return self._copyValues(model)
+
+    def setSeedRows(self, value: int) -> "IncrementalKMeans":
+        if value < 1:
+            raise ValueError(f"seedRows must be >= 1, got {value}")
+        return self._set(seedRows=value)
+
+    def reset(self) -> "IncrementalKMeans":
+        self._centers = self._cum_weights = self._n_cols = None
+        self._rows_seen = 0
+        self._last_cost = float("nan")
+        self._seed_rows, self._seed_weights = [], []
+        return self
+
+
+@jax.jit
+def _minibatch_center_update(centers, cum_weights, batch_sums, batch_counts):
+    """Per-center online mean: c ← (W_c·c + Σ_batch) / (W_c + w_batch) —
+    Sculley's 1/n_c learning-rate update in its weighted form. Centers
+    that own nothing (cumulative weight still zero) stay put."""
+    new_cum = cum_weights + batch_counts
+    upd = (
+        centers * cum_weights[:, None] + batch_sums
+    ) / jnp.maximum(new_cum, 1e-300)[:, None]
+    return jnp.where((new_cum > 0)[:, None], upd, centers), new_cum

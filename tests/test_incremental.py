@@ -9,6 +9,7 @@ import pytest
 
 from spark_rapids_ml_tpu import (
     PCA,
+    IncrementalKMeans,
     IncrementalLinearRegression,
     IncrementalPCA,
     IncrementalStandardScaler,
@@ -195,3 +196,102 @@ class TestIncrementalLinearRegression:
         assert inc.n_rows_seen == 0
         with pytest.raises(ValueError, match="before any partial_fit"):
             inc.finalize()
+
+
+class TestIncrementalKMeans:
+    """Mini-batch semantics (Sculley) — NOT monoid-exact like the others:
+    the contract is convergence quality, seeding, weighting, lifecycle."""
+
+    def _blobs(self, rng, rows=1200):
+        anchors = np.array(
+            [[6.0, 0.0, 0.0], [0.0, 6.0, 0.0], [0.0, 0.0, 6.0]]
+        )
+        y = np.arange(rows) % 3
+        return anchors[y] + 0.5 * rng.normal(size=(rows, 3)), anchors
+
+    def test_streaming_recovers_blob_structure(self, rng):
+        x, anchors = self._blobs(rng)
+        inc = IncrementalKMeans(k=3, seed=5).setSeedRows(300)
+        for chunk in np.array_split(x, 8):
+            inc.partial_fit(chunk)
+        model = inc.finalize()
+        assert inc.n_rows_seen == len(x)
+        d = np.linalg.norm(
+            model.clusterCenters[:, None, :] - anchors[None, :, :], axis=2
+        )
+        assert d.min(axis=0).max() < 1.0  # every anchor has a nearby center
+        # the model is a NORMAL KMeansModel: transform works
+        preds = np.asarray(model.transform(x))
+        assert len(np.unique(preds)) == 3
+
+    def test_seed_buffering_and_short_stream_finalize(self, rng):
+        x, _ = self._blobs(rng, rows=600)
+        inc = IncrementalKMeans(k=3, seed=5).setSeedRows(500)
+        inc.partial_fit(x[:200])  # below the buffer threshold
+        # a short stream still finalizes: seeding happens from the buffer
+        m_short = inc.finalize()
+        assert np.isfinite(m_short.trainingCost)
+        assert m_short.clusterCenters.shape == (3, 3)
+        # nothing streamed at all -> a clear error
+        with pytest.raises(ValueError, match="no rows were streamed"):
+            IncrementalKMeans(k=3).finalize()
+
+    def test_seed_failure_keeps_the_buffer(self, rng):
+        # a buffer without k positive-weight rows raises WITHOUT consuming
+        # what was streamed; feeding more rows afterwards succeeds
+        x, _ = self._blobs(rng, rows=300)
+        inc = IncrementalKMeans(k=3, seed=5).setSeedRows(100)
+        with pytest.raises(ValueError, match="positive weight"):
+            inc.partial_fit(x[:150], sample_weight=np.zeros(150))
+        inc.partial_fit(x[150:])  # buffer crossed threshold again: seeds
+        m = inc.finalize()
+        assert np.isfinite(m.trainingCost)
+
+    def test_init_mode_random_honored(self, rng):
+        # the param must change the seeding (not silently run k-means++);
+        # quality bounds stay loose — uniform seeds can land two-in-a-blob
+        # and the 1/n mini-batch rate then separates them only slowly
+        x, anchors = self._blobs(rng, rows=900)
+
+        def run(mode):
+            inc = (
+                IncrementalKMeans(k=3, seed=5, initMode=mode)
+                .setSeedRows(300)
+            )
+            for chunk in np.array_split(x, 6):
+                inc.partial_fit(chunk)
+            return inc.finalize().clusterCenters
+
+        c_rand, c_kpp = run("random"), run("k-means++")
+        assert np.all(np.isfinite(c_rand))
+        assert not np.allclose(c_rand, c_kpp)  # different seeding ran
+        d = np.linalg.norm(c_rand[:, None, :] - anchors[None, :, :], axis=2)
+        assert d.min() < 1.0  # at least lands on the blob structure
+
+    def test_zero_weight_rows_never_seed_or_move_centers(self, rng):
+        x, anchors = self._blobs(rng, rows=900)
+        poison = np.full((100, 3), 40.0)
+        xa = np.vstack([x, poison])
+        w = np.concatenate([np.ones(len(x)), np.zeros(100)])
+        perm = rng.permutation(len(xa))
+        xa, w = xa[perm], w[perm]
+        inc = IncrementalKMeans(k=3, seed=5).setSeedRows(400)
+        for sl in np.array_split(np.arange(len(xa)), 5):
+            inc.partial_fit(xa[sl], sample_weight=w[sl])
+        centers = inc.finalize().clusterCenters
+        assert np.abs(centers).max() < 10.0  # nothing pulled toward 40
+
+    def test_reset_and_width_mismatch(self, rng):
+        x, _ = self._blobs(rng, rows=400)
+        inc = IncrementalKMeans(k=3, seed=5).setSeedRows(100)
+        inc.partial_fit(x)
+        with pytest.raises(ValueError, match="inconsistent feature dim"):
+            inc.partial_fit(x[:, :2])
+        inc.reset()
+        assert inc.n_rows_seen == 0
+        with pytest.raises(ValueError, match="seeding"):
+            inc.finalize()
+
+    def test_seed_rows_validation(self):
+        with pytest.raises(ValueError, match="seedRows"):
+            IncrementalKMeans().setSeedRows(0)
