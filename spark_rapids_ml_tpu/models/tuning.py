@@ -192,6 +192,22 @@ class ParamGridBuilder:
 
 
 class Evaluator(Params):
+    """Base evaluator. ``weightCol`` (Spark 3.0+ evaluator surface) weights
+    every metric by per-instance weights when set: DataFrames read the
+    named column, ``(X, y, w)`` tuples use their third slot, other
+    containers extract the column by name. Empty (default) = unweighted."""
+
+    weightCol = Param(
+        "weightCol", "instance-weight column ('' = unweighted)", str
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(weightCol="")
+
+    def setWeightCol(self, value: str):
+        return self._set(weightCol=value)
+
     def evaluate(self, dataset: Any, predictions: np.ndarray | None = None) -> float:
         raise NotImplementedError
 
@@ -199,23 +215,55 @@ class Evaluator(Params):
         return True
 
     def _labeled_pair(self, dataset, predictions):
-        """(labels, predictions) host vectors — ONE DataFrame job when both
-        columns come from the same DF (separate collects would re-execute
-        the transform lineage and risk cross-job row-order drift)."""
+        """(labels, predictions, weights-or-None) host vectors — ONE
+        DataFrame job for every column including ``weightCol`` (separate
+        collects would re-execute the transform lineage and could pair
+        weights with the wrong rows under a nondeterministic plan)."""
         label_col = self.getOrDefault("labelCol")
         pred_col = self.getOrDefault("predictionCol")
+        weight_col = self.getOrDefault("weightCol")
         if predictions is not None:
-            return (
-                _labels_of(dataset, label_col),
-                np.asarray(predictions, dtype=np.float64).reshape(-1),
-            )
+            y = _labels_of(dataset, label_col)
+            p = np.asarray(predictions, dtype=np.float64).reshape(-1)
+            return y, p, self._weights_of(dataset, len(y))
         if _is_spark_df(dataset):
-            y, p = _df_columns(dataset, label_col, pred_col)
-            return y, p
+            cols = [label_col, pred_col] + ([weight_col] if weight_col else [])
+            got = _df_columns(dataset, *cols)
+            w = (
+                columnar.validate_weights(got[2], len(got[0]))
+                if weight_col
+                else None
+            )
+            return got[0], got[1], w
+        y = _labels_of(dataset, label_col)
         return (
-            _labels_of(dataset, label_col),
+            y,
             columnar.extract_vector(dataset, pred_col),
+            self._weights_of(dataset, len(y)),
         )
+
+    def _weights_of(self, dataset, n: int) -> np.ndarray | None:
+        """[n] validated instance weights when ``weightCol`` is set, else
+        None. Tuple containers use their third slot (the framework's
+        ``(X, y, w)`` convention) regardless of the column name. For
+        DataFrames prefer the pair helpers, which fetch weights in the
+        SAME job as the metric columns; this standalone path is the
+        fallback for externally-supplied predictions."""
+        weight_col = self.getOrDefault("weightCol")
+        if not weight_col:
+            return None
+        if isinstance(dataset, tuple):
+            if len(dataset) < 3 or dataset[2] is None:
+                raise ValueError(
+                    f"weightCol={weight_col!r} is set but the (X, y) tuple "
+                    "carries no weight slot; pass (X, y, w)"
+                )
+            w = np.asarray(dataset[2], dtype=np.float64)
+        elif _is_spark_df(dataset):
+            w = _df_columns(dataset, weight_col)[0]
+        else:
+            w = columnar.extract_vector(dataset, weight_col)
+        return columnar.validate_weights(w, n)
 
 
 class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
@@ -236,17 +284,21 @@ class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         return self.getOrDefault("metricName") == "r2"
 
     def evaluate(self, dataset, predictions=None) -> float:
-        y, p = self._labeled_pair(dataset, predictions)
+        y, p, w = self._labeled_pair(dataset, predictions)
+        if w is None:
+            w = np.ones_like(y)
+        wsum = w.sum()
         err = y - p
         metric = self.getOrDefault("metricName")
         if metric == "mse":
-            return float(np.mean(err**2))
+            return float(np.sum(w * err**2) / wsum)
         if metric == "rmse":
-            return float(np.sqrt(np.mean(err**2)))
+            return float(np.sqrt(np.sum(w * err**2) / wsum))
         if metric == "mae":
-            return float(np.mean(np.abs(err)))
-        ss_tot = float(np.sum((y - y.mean()) ** 2))
-        return 1.0 - float(np.sum(err**2)) / (ss_tot if ss_tot > 0 else 1.0)
+            return float(np.sum(w * np.abs(err)) / wsum)
+        ybar = float(np.sum(w * y) / wsum)
+        ss_tot = float(np.sum(w * (y - ybar) ** 2))
+        return 1.0 - float(np.sum(w * err**2)) / (ss_tot if ss_tot > 0 else 1.0)
 
 
 class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
@@ -296,6 +348,7 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         ``predictionCol`` with a warning (hard labels give the degenerate
         two-level AUC)."""
         label_col = self.getOrDefault("labelCol")
+        weight_col = self.getOrDefault("weightCol")
         columns = _column_names(dataset)
         score_col = None
         for candidate in (self.getOrDefault("rawPredictionCol"), "probability"):
@@ -303,18 +356,26 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
                 score_col = candidate
                 break
         if score_col is not None:
+            w = None
             if _is_spark_df(dataset):
-                y, s = _df_columns(dataset, label_col, score_col)
+                cols = [label_col, score_col] + (
+                    [weight_col] if weight_col else []
+                )
+                got = _df_columns(dataset, *cols)  # ONE job incl. weights
+                y, s = got[0], got[1]
+                if weight_col:
+                    w = columnar.validate_weights(got[2], len(y))
             else:
                 y = _labels_of(dataset, label_col)
                 try:  # vector column ([rows, C] probability/margins)...
                     s = columnar.extract_matrix(dataset, score_col)
                 except (TypeError, ValueError):  # ...or a scalar score
                     s = columnar.extract_vector(dataset, score_col)
+                w = self._weights_of(dataset, len(y))
             s = np.asarray(s, dtype=np.float64)
             if s.ndim == 2:
                 s = s[:, -1]  # positive-class score, pyspark.ml convention
-            return y, s
+            return y, s, w
         warnings.warn(
             "BinaryClassificationEvaluator: no score column found (looked "
             f"for {self.getOrDefault('rawPredictionCol')!r} and "
@@ -328,26 +389,39 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
 
     def evaluate(self, dataset, predictions=None) -> float:
         if self.getOrDefault("metricName") == "accuracy":
-            y, p = self._labeled_pair(dataset, predictions)
-            return float(np.mean((p >= 0.5) == (y >= 0.5)))
+            y, p, w = self._labeled_pair(dataset, predictions)
+            hits = ((p >= 0.5) == (y >= 0.5)).astype(np.float64)
+            if w is None:
+                return float(np.mean(hits))
+            return float(np.sum(w * hits) / w.sum())
         if predictions is not None:
-            y, p = self._labeled_pair(dataset, predictions)
+            y, p, w = self._labeled_pair(dataset, predictions)
         else:
-            y, p = self._score_pair(dataset)
-        pos, neg = p[y >= 0.5], p[y < 0.5]
-        if len(pos) == 0 or len(neg) == 0:
+            y, p, w = self._score_pair(dataset)
+        if w is None:
+            w = np.ones_like(p)
+        pos_mask = y >= 0.5
+        w_pos_total = float(w[pos_mask].sum())
+        w_neg_total = float(w[~pos_mask].sum())
+        if w_pos_total == 0.0 or w_neg_total == 0.0:
             return 0.5
-        # Mann–Whitney U with tie correction: AUC = P(score⁺ > score⁻)
-        allp = np.concatenate([pos, neg])
-        order = np.argsort(allp, kind="mergesort")
-        sorted_p = allp[order]
-        _, inv, counts = np.unique(sorted_p, return_inverse=True, return_counts=True)
-        cum = np.cumsum(counts)
-        avg_rank_of_group = cum - (counts - 1) / 2.0  # tie-averaged ranks
-        ranks = np.empty(len(order))
-        ranks[order] = avg_rank_of_group[inv]
-        u = ranks[: len(pos)].sum() - len(pos) * (len(pos) + 1) / 2.0
-        return float(u / (len(pos) * len(neg)))
+        # Weighted Mann–Whitney with tie correction:
+        # AUC = Σ_{i∈pos} w_i·(W_neg(score<s_i) + ½·W_neg(score=s_i)) / (W⁺·W⁻)
+        # computed by one sort over tied-score groups.
+        order = np.argsort(p, kind="mergesort")
+        ps, ws, pm = p[order], w[order], pos_mask[order]
+        w_neg = np.where(~pm, ws, 0.0)
+        w_pos = np.where(pm, ws, 0.0)
+        # group boundaries of equal scores
+        _, group = np.unique(ps, return_inverse=True)
+        n_groups = group.max() + 1
+        gw_neg = np.zeros(n_groups)
+        gw_pos = np.zeros(n_groups)
+        np.add.at(gw_neg, group, w_neg)
+        np.add.at(gw_pos, group, w_pos)
+        cum_neg_before = np.concatenate([[0.0], np.cumsum(gw_neg)[:-1]])
+        auc_num = float(np.sum(gw_pos * (cum_neg_before + 0.5 * gw_neg)))
+        return auc_num / (w_pos_total * w_neg_total)
 
 
 class MulticlassClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
@@ -415,7 +489,8 @@ class MulticlassClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol
                     "or evaluate the transformed DataFrame carrying "
                     f"{prob_col!r}"
                 )
-            return _labels_of(dataset, label_col), probs
+            y = _labels_of(dataset, label_col)
+            return y, probs, self._weights_of(dataset, len(y))
         if prob_col not in _column_names(dataset):
             raise ValueError(
                 f"logLoss needs probability column {prob_col!r}; set the "
@@ -423,17 +498,26 @@ class MulticlassClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol
                 "LogisticRegression().setProbabilityCol('probability')) or "
                 "this evaluator's setProbabilityCol"
             )
+        weight_col = self.getOrDefault("weightCol")
         if _is_spark_df(dataset):
-            y, probs = _df_columns(dataset, label_col, prob_col)
+            cols = [label_col, prob_col] + ([weight_col] if weight_col else [])
+            got = _df_columns(dataset, *cols)  # ONE job incl. weights
+            y, probs = got[0], got[1]
+            w = (
+                columnar.validate_weights(got[2], len(y))
+                if weight_col
+                else None
+            )
         else:
             y = _labels_of(dataset, label_col)
             probs = columnar.extract_matrix(dataset, prob_col)
-        return y, np.asarray(probs, dtype=np.float64)
+            w = self._weights_of(dataset, len(y))
+        return y, np.asarray(probs, dtype=np.float64), w
 
     def evaluate(self, dataset, predictions=None) -> float:
         metric = self.getOrDefault("metricName")
         if metric == "logLoss":
-            y, probs = self._prob_pair(dataset, predictions)
+            y, probs, iw = self._prob_pair(dataset, predictions)
             cls = np.asarray(y, dtype=np.int64)
             if cls.min() < 0 or cls.max() >= probs.shape[1]:
                 raise ValueError(
@@ -442,20 +526,24 @@ class MulticlassClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol
                 )
             eps = self.getOrDefault("eps")
             picked = np.clip(probs[np.arange(len(cls)), cls], eps, 1.0)
-            return float(-np.mean(np.log(picked)))
-        y, p = self._labeled_pair(dataset, predictions)
+            if iw is None:
+                return float(-np.mean(np.log(picked)))
+            return float(-np.sum(iw * np.log(picked)) / iw.sum())
+        y, p, iw = self._labeled_pair(dataset, predictions)
+        if iw is None:
+            iw = np.ones_like(y, dtype=np.float64)
         if metric == "accuracy":
-            return float(np.mean(y == p))
-        classes, counts = np.unique(y, return_counts=True)
-        weights = counts / counts.sum()
+            return float(np.sum(iw * (y == p)) / iw.sum())
+        classes = np.unique(y)
+        true_w = np.array([float(iw[y == c].sum()) for c in classes])
+        weights = true_w / true_w.sum()  # class frequency, instance-weighted
         prec = np.zeros(len(classes))
         rec = np.zeros(len(classes))
         for i, c in enumerate(classes):
-            tp = float(np.sum((p == c) & (y == c)))
-            pred_c = float(np.sum(p == c))
-            true_c = float(counts[i])
+            tp = float(iw[(p == c) & (y == c)].sum())
+            pred_c = float(iw[p == c].sum())
             prec[i] = tp / pred_c if pred_c > 0 else 0.0
-            rec[i] = tp / true_c if true_c > 0 else 0.0
+            rec[i] = tp / true_w[i] if true_w[i] > 0 else 0.0
         if metric == "weightedPrecision":
             return float(np.sum(weights * prec))
         if metric == "weightedRecall":
@@ -470,7 +558,10 @@ class ClusteringEvaluator(Evaluator):
 
     Row pairs are O(rows²); rows are subsampled to ``maxRows`` (deterministic)
     above that — the Spark evaluator makes the same tradeoff via its
-    squared-Euclidean variant.
+    squared-Euclidean variant. With ``weightCol`` the per-row a/b means and
+    the final silhouette mean are instance-weighted (Spark 3.1 surface);
+    the subsample itself stays uniform, so a cap-exceeding weighted
+    evaluation is an estimate of the weighted metric.
     """
 
     featuresCol = Param("featuresCol", "features column", str)
@@ -484,7 +575,9 @@ class ClusteringEvaluator(Evaluator):
     def evaluate(self, dataset, predictions=None) -> float:
         feats = self.getOrDefault("featuresCol")
         pred_col = self.getOrDefault("predictionCol")
+        weight_col = self.getOrDefault("weightCol")
         cap = self.getOrDefault("maxRows")
+        w = None
         if _is_spark_df(dataset) and predictions is None:
             # push the subsample into the PLAN: never materialize more than
             # ~2*cap rows on the driver for a cap-bounded metric
@@ -493,23 +586,30 @@ class ClusteringEvaluator(Evaluator):
                 dataset = dataset.sample(
                     fraction=min(1.0, 2.0 * cap / total), seed=0
                 )
-            x, p = _df_columns(dataset, feats, pred_col)
-            p = p.astype(np.int64)
+            cols = [feats, pred_col] + ([weight_col] if weight_col else [])
+            got = _df_columns(dataset, *cols)  # ONE job incl. weights
+            x, p = got[0], got[1].astype(np.int64)
+            if weight_col:
+                w = columnar.validate_weights(got[2], len(x))
         else:
-            x = (
-                _df_columns(dataset, feats)[0]
-                if _is_spark_df(dataset)
-                else columnar.extract_matrix(dataset, feats)
-            )
+            if isinstance(dataset, tuple):  # (X, _, w?) container
+                x = np.asarray(dataset[0], dtype=np.float64)
+            elif _is_spark_df(dataset):
+                x = _df_columns(dataset, feats)[0]
+            else:
+                x = columnar.extract_matrix(dataset, feats)
             if predictions is not None:
                 p = np.asarray(predictions, dtype=np.float64).reshape(-1).astype(np.int64)
             elif _is_spark_df(dataset):
                 p = _df_columns(dataset, pred_col)[0].astype(np.int64)
             else:
                 p = columnar.extract_vector(dataset, pred_col).astype(np.int64)
+            w = self._weights_of(dataset, len(x))
+        if w is None:
+            w = np.ones(len(x))
         if len(x) > cap:
             sel = np.random.default_rng(0).choice(len(x), cap, replace=False)
-            x, p = x[sel], p[sel]
+            x, p, w = x[sel], p[sel], w[sel]
         # Gram identity keeps the pairwise pass at one [rows, rows] matrix
         # (the [rows, rows, dims] broadcast would be GBs at default maxRows).
         sq = (x * x).sum(-1)
@@ -521,12 +621,20 @@ class ClusteringEvaluator(Evaluator):
         for i in range(len(x)):
             same = p == p[i]
             same[i] = False
-            if not same.any():
-                continue  # singleton cluster: conventional silhouette is 0
-            a = d2[i, same].mean()
-            b = min(d2[i, p == c].mean() for c in labels if c != p[i])
+            w_same = float(w[same].sum())
+            if w_same <= 0:
+                continue  # (weighted-)singleton cluster: silhouette is 0
+            a = float(np.dot(w[same], d2[i, same])) / w_same
+            others = [
+                float(np.dot(w[p == c], d2[i, p == c])) / float(w[p == c].sum())
+                for c in labels
+                if c != p[i] and w[p == c].sum() > 0
+            ]
+            if not others:
+                continue  # every other cluster is weight-empty
+            b = min(others)
             sil[i] = 0.0 if max(a, b) == 0 else (b - a) / max(a, b)
-        return float(sil.mean())
+        return float(np.dot(w, sil) / w.sum())
 
 
 # ---------------------------------------------------------------------------
@@ -544,11 +652,20 @@ def _fit_and_eval(estimator, params, evaluator, train, val):
     # (LogisticRegression), rank that instead — the Spark evaluator makes
     # the same choice by reading rawPrediction rather than prediction.
     wants_probability_surface = (
-        isinstance(evaluator, BinaryClassificationEvaluator)
-        and evaluator.getOrDefault("metricName") == "areaUnderROC"
-    ) or (
-        isinstance(evaluator, MulticlassClassificationEvaluator)
-        and evaluator.getOrDefault("metricName") == "logLoss"
+        (
+            isinstance(evaluator, BinaryClassificationEvaluator)
+            and evaluator.getOrDefault("metricName") == "areaUnderROC"
+        )
+        or (
+            isinstance(evaluator, MulticlassClassificationEvaluator)
+            and evaluator.getOrDefault("metricName") == "logLoss"
+        )
+    ) and not (
+        # the fast path rebuilds (feats, labels) tuples that cannot carry a
+        # DataFrame's weight column; weighted evaluation must go through
+        # the transformed dataset (tuple containers carry w in slot 3 and
+        # are unaffected)
+        evaluator.getOrDefault("weightCol") and _is_spark_df(val)
     )
     if wants_probability_surface and hasattr(model, "predict_proba_matrix"):
         fcol = model.getOrDefault("featuresCol")

@@ -200,6 +200,105 @@ class TestMulticlassClassificationEvaluator:
         assert cvm.avgMetrics[0] < cvm.avgMetrics[1]
 
 
+class TestWeightedEvaluators:
+    """weightCol (Spark 3.0+ evaluator surface): the oracle is row
+    duplication — integer-weighted metrics must equal unweighted metrics
+    on a dataset with each row repeated weight-many times."""
+
+    def _weighted_and_duplicated(self, rng, rows=120):
+        y = (rng.random(rows) > 0.4).astype(float)
+        p = np.clip(y * 0.6 + rng.random(rows) * 0.5, 0, 1)
+        w = rng.integers(1, 5, size=rows).astype(float)
+        rep = np.repeat(np.arange(rows), w.astype(int))
+        return y, p, w, y[rep], p[rep]
+
+    def test_weighted_regression_matches_duplication(self, rng):
+        y, p, w, yd, pd_ = self._weighted_and_duplicated(rng)
+        for metric in ("rmse", "mse", "mae", "r2"):
+            ev = RegressionEvaluator(metricName=metric, weightCol="w")
+            got = ev.evaluate((None, y, w), predictions=p)
+            want = RegressionEvaluator(metricName=metric).evaluate(
+                (None, yd), predictions=pd_
+            )
+            assert abs(got - want) < 1e-12, metric
+
+    def test_weighted_auc_matches_duplication_with_ties(self, rng):
+        y, p, w, yd, pd_ = self._weighted_and_duplicated(rng)
+        p = np.round(p, 1)  # force tied scores through the tie correction
+        ev = BinaryClassificationEvaluator(weightCol="w")
+        got = ev.evaluate((None, y, w), predictions=p)
+        want = BinaryClassificationEvaluator().evaluate(
+            (None, yd), predictions=np.round(pd_, 1)
+        )
+        assert abs(got - want) < 1e-12
+
+    def test_weighted_binary_accuracy(self, rng):
+        y, p, w, yd, pd_ = self._weighted_and_duplicated(rng)
+        got = BinaryClassificationEvaluator(
+            metricName="accuracy", weightCol="w"
+        ).evaluate((None, y, w), predictions=p)
+        want = BinaryClassificationEvaluator(metricName="accuracy").evaluate(
+            (None, yd), predictions=pd_
+        )
+        assert abs(got - want) < 1e-12
+
+    def test_weighted_multiclass_matches_duplication(self, rng):
+        rows = 150
+        y = (np.arange(rows) % 3).astype(float)
+        p = y.copy()
+        flip = rng.random(rows) < 0.25
+        p[flip] = (p[flip] + 1) % 3
+        w = rng.integers(1, 4, size=rows).astype(float)
+        rep = np.repeat(np.arange(rows), w.astype(int))
+        for metric in ("f1", "accuracy", "weightedPrecision", "weightedRecall"):
+            got = MulticlassClassificationEvaluator(
+                metricName=metric, weightCol="w"
+            ).evaluate((None, y, w), predictions=p)
+            want = MulticlassClassificationEvaluator(metricName=metric).evaluate(
+                (None, y[rep]), predictions=p[rep]
+            )
+            assert abs(got - want) < 1e-12, metric
+
+    def test_weighted_log_loss_matches_duplication(self, rng):
+        rows = 90
+        y = (np.arange(rows) % 3).astype(float)
+        probs = rng.dirichlet(np.ones(3), size=rows)
+        w = rng.integers(1, 4, size=rows).astype(float)
+        rep = np.repeat(np.arange(rows), w.astype(int))
+        got = MulticlassClassificationEvaluator(
+            metricName="logLoss", weightCol="w"
+        ).evaluate((None, y, w), predictions=probs)
+        want = MulticlassClassificationEvaluator(metricName="logLoss").evaluate(
+            (None, y[rep]), predictions=probs[rep]
+        )
+        assert abs(got - want) < 1e-12
+
+    def test_weight_col_without_weight_slot_raises(self, rng):
+        y = np.array([0.0, 1.0])
+        ev = RegressionEvaluator(weightCol="w")
+        with pytest.raises(ValueError, match="weight slot"):
+            ev.evaluate((None, y), predictions=y)
+
+    def test_weighted_silhouette_matches_duplication(self, rng):
+        rows = 80
+        x = np.vstack(
+            [rng.normal(size=(rows // 2, 3)) + 3,
+             rng.normal(size=(rows // 2, 3)) - 3]
+        )
+        p = np.repeat([0.0, 1.0], rows // 2)
+        w = rng.integers(1, 4, size=rows).astype(float)
+        rep = np.repeat(np.arange(rows), w.astype(int))
+        # weighted a/b means differ from duplication only by the self-pair
+        # exclusion (a duplicated row keeps its copies at distance 0, which
+        # the weighted form counts for the OTHER copies) — compare loosely
+        got = ClusteringEvaluator(weightCol="w").evaluate(
+            (x, None, w), predictions=p
+        )
+        want = ClusteringEvaluator().evaluate((x[rep], None), predictions=p[rep])
+        assert abs(got - want) < 0.02
+        assert got > 0.8  # well-separated blobs
+
+
 class TestClusteringEvaluator:
     def test_well_separated_beats_random(self, rng):
         a = rng.normal(size=(50, 4)) + 10

@@ -139,6 +139,34 @@ class TestCrossValidatorOverDataFrames:
                 metricName="logLoss", probabilityCol="nope"
             ).evaluate(out)
 
+    def test_weighted_evaluator_reads_weight_column(self, session):
+        # weightCol on the evaluator: the DF carries per-row weights; the
+        # duplication oracle runs on an expanded unweighted DF
+        rng = np.random.default_rng(33)
+        rows = 120
+        x = rng.normal(size=(rows, 3))
+        y = x @ np.array([1.0, -1.0, 0.5]) + 0.1 * rng.normal(size=rows)
+        pred = y + 0.3 * rng.normal(size=rows)
+        w = rng.integers(1, 4, size=rows).astype(float)
+        schema = LT.StructType(
+            [
+                LT.StructField("label", LT.DoubleType()),
+                LT.StructField("prediction", LT.DoubleType()),
+                LT.StructField("w", LT.DoubleType()),
+            ]
+        )
+        df = session.createDataFrame(
+            [(float(a), float(b), float(c)) for a, b, c in zip(y, pred, w)],
+            schema,
+            numPartitions=3,
+        )
+        got = RegressionEvaluator(weightCol="w").evaluate(df)
+        rep = np.repeat(np.arange(rows), w.astype(int))
+        want = RegressionEvaluator().evaluate(
+            (None, y[rep]), predictions=pred[rep]
+        )
+        assert abs(got - want) < 1e-12
+
     def test_cv_auc_over_dataframes(self, session):
         rng = np.random.default_rng(31)
         x = rng.normal(size=(300, 3))
