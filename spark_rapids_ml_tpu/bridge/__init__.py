@@ -17,6 +17,7 @@ import numpy as np
 
 _NATIVE_DIR = Path(__file__).parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libtpuml_bridge.so"
+_MIN_VERSION = 11  # oldest library this module's wrappers can drive
 
 _lib = None
 
@@ -47,6 +48,28 @@ def get_lib() -> ctypes.CDLL:
     if not _LIB_PATH.exists():
         _build()
     lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.tpuml_version.restype = ctypes.c_int32
+    if lib.tpuml_version() < _MIN_VERSION:
+        # stale build from an older source tree (source checkouts only;
+        # wheels ship a matching .so). Rebuild, then load through a UNIQUE
+        # temp path: dlopen dedupes by name, so re-CDLL'ing the same path
+        # can hand back the already-mapped stale library.
+        import shutil
+        import tempfile
+
+        _build()
+        tmp = tempfile.NamedTemporaryFile(
+            prefix="tpuml_bridge_", suffix=".so", delete=False
+        )
+        tmp.close()
+        shutil.copy2(_LIB_PATH, tmp.name)
+        lib = ctypes.CDLL(tmp.name)
+        lib.tpuml_version.restype = ctypes.c_int32
+        if lib.tpuml_version() < _MIN_VERSION:
+            raise NativeBridgeError(
+                f"rebuilt bridge still reports version {lib.tpuml_version()} "
+                f"< required {_MIN_VERSION}; is the source tree stale?"
+            )
 
     i32, i64 = ctypes.c_int32, ctypes.c_int64
     dp = ctypes.POINTER(ctypes.c_double)
@@ -65,6 +88,8 @@ def get_lib() -> ctypes.CDLL:
     lib.tpuml_eigh_descending.restype = i32
     lib.tpuml_project.argtypes = [dp, dp, i64, i64, i64, dp]
     lib.tpuml_project.restype = i32
+    lib.tpuml_kmeans_assign.argtypes = [dp, dp, dp, i64, i64, i64, ip, dp, dp, dp]
+    lib.tpuml_kmeans_assign.restype = i32
 
     _lib = lib
     return lib
@@ -172,3 +197,90 @@ def pca_fit_host(x: np.ndarray, k: int, *, mean_centering: bool = False):
     total = sv.sum()
     ev = (sv / total if total > 0 else sv)[:k]
     return comps[:, :k], ev
+
+
+def kmeans_assign(
+    x: np.ndarray,
+    centers: np.ndarray,
+    w: np.ndarray | None = None,
+    *,
+    sums: np.ndarray | None = None,
+    counts: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, float]:
+    """One weighted Lloyd accumulation pass on the native threaded kernel.
+
+    The host-fallback analog of ``ops.kmeans.kmeans_stats`` (the reference
+    delegates this roofline to RAFT's pairwise-distance kernels). Pass
+    ``sums``/``counts`` to accumulate across batches like :func:`gram`.
+    Returns (labels [rows] int32, sums [k, n], counts [k], cost).
+    """
+    x, centers = _as_c(x), _as_c(centers)
+    rows, n = x.shape
+    k = centers.shape[0]
+    if centers.shape[1] != n:
+        raise ValueError(
+            f"centers have {centers.shape[1]} features, data has {n}"
+        )
+    labels = np.empty(rows, dtype=np.int32)
+    if sums is None:
+        sums = np.zeros((k, n), dtype=np.float64)
+    elif (
+        sums.shape != (k, n)
+        or sums.dtype != np.float64
+        or not sums.flags.c_contiguous
+    ):
+        raise ValueError(
+            f"sums accumulator must be C-contiguous float64 [{k}, {n}]"
+        )
+    if counts is None:
+        counts = np.zeros(k, dtype=np.float64)
+    elif counts.shape != (k,) or counts.dtype != np.float64:
+        raise ValueError(f"counts accumulator must be float64 [{k}]")
+    cost = np.zeros(1, dtype=np.float64)
+    wp = None if w is None else _as_c(np.asarray(w, dtype=np.float64))
+    if wp is not None and wp.shape != (rows,):
+        raise ValueError(
+            f"weights have shape {wp.shape}, expected ({rows},)"
+        )
+    _check(
+        get_lib().tpuml_kmeans_assign(
+            _dptr(x), _dptr(centers),
+            None if wp is None else _dptr(wp),
+            rows, n, k,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            _dptr(sums), _dptr(counts), _dptr(cost),
+        ),
+        "kmeans_assign",
+    )
+    return labels, sums, counts, float(cost[0])
+
+
+def kmeans_lloyd_host(
+    x: np.ndarray,
+    centers0: np.ndarray,
+    w: np.ndarray | None = None,
+    *,
+    max_iter: int = 20,
+    tol: float = 1e-4,
+) -> tuple[np.ndarray, float, int]:
+    """Pure-native Lloyd loop (no accelerator): the host-fallback sibling
+    of :func:`pca_fit_host`. Empty clusters keep their previous center
+    (the device kernel's convention). Returns (centers, cost, iterations)."""
+    centers = _as_c(centers0).copy()
+    it = 0
+    tol_sq = tol * tol
+    for it in range(1, max_iter + 1):
+        _, sums, counts, _ = kmeans_assign(x, centers, w)
+        new_centers = np.where(
+            (counts > 0)[:, None], sums / np.maximum(counts, 1e-300)[:, None],
+            centers,
+        )
+        shift = float(np.max(np.sum((new_centers - centers) ** 2, axis=1)))
+        centers = new_centers
+        if shift <= tol_sq:
+            break
+    # cost of the RETURNED centers (the in-loop cost describes the
+    # pre-update centers; returning that pair would over-report inertia by
+    # one Lloyd step and mis-rank restarts compared on cost)
+    _, _, _, cost = kmeans_assign(x, centers, w)
+    return centers, cost, it

@@ -34,12 +34,13 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <thread>
 #include <vector>
 
 extern "C" {
 
-int32_t tpuml_version() { return 10; }  // 0.1.0
+int32_t tpuml_version() { return 11; }  // 0.1.1: + tpuml_kmeans_assign
 
 // ---------------------------------------------------------------------------
 // (a) Columnar packing
@@ -269,6 +270,89 @@ int32_t tpuml_project(const double* a, const double* pc, int64_t rows,
     });
   }
   for (auto& w : workers) w.join();
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// (e) KMeans assignment pass: one weighted Lloyd accumulation
+//     (the host-fallback analog of ops/kmeans.kmeans_stats; the reference
+//      delegates this roofline to RAFT's pairwise-distance kernels)
+// ---------------------------------------------------------------------------
+
+// x [rows, n] row-major, centers [k, n] row-major, w nullable [rows].
+// Outputs: labels [rows] (nearest center), sums [k, n] and counts [k]
+// ACCUMULATED (caller zero-initializes for a fresh pass — the same
+// multi-batch accumulation semantics as tpuml_gram), cost += weighted sum
+// of squared distances to the assigned center.
+int32_t tpuml_kmeans_assign(const double* x, const double* centers,
+                            const double* w, int64_t rows, int64_t n,
+                            int64_t k, int32_t* labels, double* sums,
+                            double* counts, double* cost) {
+  if (!x || !centers || !labels || !sums || !counts || !cost || rows < 0 ||
+      n <= 0 || k <= 0)
+    return 1;
+  // |c|^2 once; per row the distance is |x|^2 - 2 x.c + |c|^2 and the
+  // |x|^2 term is rank-invariant, so argmin needs only (-2 x.c + |c|^2);
+  // the true cost adds |x|^2 back for the winner.
+  std::vector<double> csq(static_cast<size_t>(k));
+  for (int64_t c = 0; c < k; ++c) {
+    const double* crow = centers + c * n;
+    double s = 0.0;
+    for (int64_t i = 0; i < n; ++i) s += crow[i] * crow[i];
+    csq[static_cast<size_t>(c)] = s;
+  }
+  const int nt = std::max<int>(1, std::min<int64_t>(n_threads(), rows ? rows : 1));
+  std::vector<std::vector<double>> t_sums(nt), t_counts(nt);
+  std::vector<double> t_cost(static_cast<size_t>(nt), 0.0);
+  std::vector<std::thread> workers;
+  workers.reserve(nt);
+  const int64_t chunk = rows ? (rows + nt - 1) / nt : 0;
+  for (int t = 0; t < nt; ++t) {
+    const int64_t r0 = t * chunk, r1 = std::min<int64_t>(rows, r0 + chunk);
+    if (r0 >= r1) break;
+    workers.emplace_back([&, t, r0, r1] {
+      auto& ls = t_sums[t];
+      auto& lc = t_counts[t];
+      ls.assign(static_cast<size_t>(k * n), 0.0);
+      lc.assign(static_cast<size_t>(k), 0.0);
+      double local_cost = 0.0;
+      for (int64_t r = r0; r < r1; ++r) {
+        const double* row = x + r * n;
+        double best = std::numeric_limits<double>::infinity();
+        int64_t best_c = 0;
+        for (int64_t c = 0; c < k; ++c) {
+          const double* crow = centers + c * n;
+          double dot = 0.0;
+          for (int64_t i = 0; i < n; ++i) dot += row[i] * crow[i];
+          const double score = csq[static_cast<size_t>(c)] - 2.0 * dot;
+          if (score < best) {
+            best = score;
+            best_c = c;
+          }
+        }
+        labels[r] = static_cast<int32_t>(best_c);
+        const double wr = w ? w[r] : 1.0;
+        if (wr != 0.0) {
+          double* srow = ls.data() + best_c * n;
+          for (int64_t i = 0; i < n; ++i) srow[i] += wr * row[i];
+          lc[static_cast<size_t>(best_c)] += wr;
+          double xsq = 0.0;
+          for (int64_t i = 0; i < n; ++i) xsq += row[i] * row[i];
+          // clamp tiny negative rounding like the device kernel does
+          const double d2 = xsq + best;
+          local_cost += wr * (d2 > 0.0 ? d2 : 0.0);
+        }
+      }
+      t_cost[static_cast<size_t>(t)] = local_cost;
+    });
+  }
+  for (auto& th : workers) th.join();
+  for (int t = 0; t < nt; ++t) {
+    if (t_sums[t].empty()) continue;
+    for (int64_t i = 0; i < k * n; ++i) sums[i] += t_sums[t][static_cast<size_t>(i)];
+    for (int64_t c = 0; c < k; ++c) counts[c] += t_counts[t][static_cast<size_t>(c)];
+    *cost += t_cost[static_cast<size_t>(t)];
+  }
   return 0;
 }
 

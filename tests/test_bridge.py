@@ -12,7 +12,7 @@ if not bridge.available():  # pragma: no cover
 
 
 def test_version():
-    assert bridge.version() == 10
+    assert bridge.version() == 11
 
 
 class TestPacking:
@@ -119,3 +119,54 @@ class TestHostFit:
         pc_j, ev_j = L.pca_fit_local(jnp.asarray(x), 5, mean_centering=center)
         np.testing.assert_allclose(pc_n, np.asarray(pc_j), atol=1e-8)
         np.testing.assert_allclose(ev_n, np.asarray(ev_j), atol=1e-10)
+
+
+class TestKMeansAssign:
+    def test_matches_jax_kernel(self, rng):
+        """Native threaded assignment vs the device kmeans_stats monoid —
+        the dual-backend contract, weighted."""
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        x = rng.normal(size=(700, 12))
+        centers = x[:: 700 // 5][:5].copy()
+        w = rng.integers(0, 3, size=700).astype(float)  # incl. zero weights
+        labels, sums, counts, cost = bridge.kmeans_assign(x, centers, w)
+        ref = KM.kmeans_stats(jnp.asarray(x), jnp.asarray(centers), jnp.asarray(w))
+        np.testing.assert_allclose(sums, np.asarray(ref.sums), atol=1e-9)
+        np.testing.assert_allclose(counts, np.asarray(ref.counts), atol=1e-12)
+        np.testing.assert_allclose(cost, float(ref.cost), rtol=1e-10)
+        # labels match a NumPy argmin oracle
+        d2 = ((x[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(labels, d2.argmin(axis=1))
+
+    def test_accumulates_across_batches(self, rng):
+        x = rng.normal(size=(400, 8))
+        centers = x[:4].copy()
+        _, s1, c1, cost1 = bridge.kmeans_assign(x[:200], centers)
+        _, s1, c1, cost2 = bridge.kmeans_assign(
+            x[200:], centers, sums=s1, counts=c1
+        )
+        _, s_all, c_all, cost_all = bridge.kmeans_assign(x, centers)
+        np.testing.assert_allclose(s1, s_all, atol=1e-10)
+        np.testing.assert_allclose(c1, c_all)
+        assert abs((cost1 + cost2) - cost_all) < 1e-9 * max(1.0, cost_all)
+
+    def test_lloyd_host_matches_device_loop(self, rng):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        anchors = rng.normal(size=(3, 6)) * 6
+        x = np.vstack([anchors[i] + 0.4 * rng.normal(size=(150, 6)) for i in range(3)])
+        centers0 = x[[0, 150, 300]].copy()
+        c_native, cost_native, _ = bridge.kmeans_lloyd_host(
+            x, centers0, max_iter=15, tol=1e-10
+        )
+        c = jnp.asarray(centers0)
+        for _ in range(15):
+            stats = KM.kmeans_stats(jnp.asarray(x), c)
+            c = KM.update_centers(stats, c)
+        np.testing.assert_allclose(c_native, np.asarray(c), atol=1e-8)
+        assert cost_native > 0
