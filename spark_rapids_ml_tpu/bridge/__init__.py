@@ -54,6 +54,7 @@ def get_lib() -> ctypes.CDLL:
         # wheels ship a matching .so). Rebuild, then load through a UNIQUE
         # temp path: dlopen dedupes by name, so re-CDLL'ing the same path
         # can hand back the already-mapped stale library.
+        import atexit
         import shutil
         import tempfile
 
@@ -64,6 +65,9 @@ def get_lib() -> ctypes.CDLL:
         tmp.close()
         shutil.copy2(_LIB_PATH, tmp.name)
         lib = ctypes.CDLL(tmp.name)
+        # the copy exists only to defeat dlopen's path dedupe; once mapped
+        # it can go at exit (best-effort — the mapping outlives the unlink)
+        atexit.register(lambda p=tmp.name: Path(p).unlink(missing_ok=True))
         lib.tpuml_version.restype = ctypes.c_int32
         if lib.tpuml_version() < _MIN_VERSION:
             raise NativeBridgeError(

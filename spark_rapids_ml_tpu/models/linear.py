@@ -299,6 +299,23 @@ def _resume_newton_checkpoint(checkpoint_dir: str | None, n_params: int):
     return arrays["w"], step + 1, ckpt
 
 
+def _newton_step_bookkeeping(
+    w, step_norm, *, tol, ckpt, it, checkpoint_every, loss
+) -> bool:
+    """Shared post-update tail of the driver-merge Newton loops: the stop
+    test, the NaN-input raise BEFORE any save (run_chunked_newton's order —
+    a junk step checkpoint must never outlive the raise), then the cadenced
+    checkpoint save. Returns True when the loop should stop."""
+    stop = not float(step_norm) > tol
+    if stop:
+        # raises on non-finite DATA; accepts separable-divergence's last
+        # finite iterate (see ops.linear.check_newton_outcome)
+        LIN.check_newton_outcome(step_norm, w)
+    if ckpt is not None and (it + 1) % checkpoint_every == 0:
+        ckpt.save(it, {"w": w}, {"loss": loss})
+    return stop
+
+
 class _HasProbabilityCol:
     """probabilityCol — shared by LogisticRegression and its model so the
     fitted model carries it (pyspark.ml's probability-vector output column).
@@ -429,13 +446,11 @@ class LogisticRegression(_HasProbabilityCol, _SupervisedParams, Estimator):
                     fit_intercept=fit_intercept,
                 )
                 w_full = np.asarray(new_w)
-                if ckpt is not None and (it + 1) % checkpoint_every == 0:
-                    ckpt.save(it, {"w": w_full}, {"loss": float(stats.loss)})
-                if not float(step_norm) > self.getTol():
-                    # converged, or NaN-sentinel rejection (see
-                    # check_newton_outcome: raises on non-finite DATA,
-                    # accepts separable-divergence's last finite iterate)
-                    LIN.check_newton_outcome(step_norm, w_full)
+                if _newton_step_bookkeeping(
+                    w_full, step_norm, tol=self.getTol(), ckpt=ckpt, it=it,
+                    checkpoint_every=checkpoint_every,
+                    loss=float(stats.loss),
+                ):
                     break
 
         if fit_intercept:
@@ -490,10 +505,11 @@ class LogisticRegression(_HasProbabilityCol, _SupervisedParams, Estimator):
                     fit_intercept=fit_intercept,
                 )
                 w_flat = np.asarray(new_w)
-                if ckpt is not None and (it + 1) % checkpoint_every == 0:
-                    ckpt.save(it, {"w": w_flat}, {"loss": float(stats.loss)})
-                if not float(step_norm) > self.getTol():
-                    LIN.check_newton_outcome(step_norm, w_flat)
+                if _newton_step_bookkeeping(
+                    w_flat, step_norm, tol=self.getTol(), ckpt=ckpt, it=it,
+                    checkpoint_every=checkpoint_every,
+                    loss=float(stats.loss),
+                ):
                     break
 
         w_mat = w_flat.reshape(n_classes, d)

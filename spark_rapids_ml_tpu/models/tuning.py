@@ -660,12 +660,6 @@ def _fit_and_eval(estimator, params, evaluator, train, val):
             isinstance(evaluator, MulticlassClassificationEvaluator)
             and evaluator.getOrDefault("metricName") == "logLoss"
         )
-    ) and not (
-        # the fast path rebuilds (feats, labels) tuples that cannot carry a
-        # DataFrame's weight column; weighted evaluation must go through
-        # the transformed dataset (tuple containers carry w in slot 3 and
-        # are unaffected)
-        evaluator.getOrDefault("weightCol") and _is_spark_df(val)
     )
     if wants_probability_surface and hasattr(model, "predict_proba_matrix"):
         fcol = model.getOrDefault("featuresCol")
@@ -675,11 +669,15 @@ def _fit_and_eval(estimator, params, evaluator, train, val):
             scores = model.predict_proba_matrix(feats)
             return model, evaluator.evaluate(val, predictions=scores)
         if _is_spark_df(val):
-            feats, labels = _df_columns(val, fcol, lcol)  # one job
-            scores = model.predict_proba_matrix(feats)
-            return model, evaluator.evaluate(
-                (feats, labels), predictions=scores
-            )
+            # one job for every column INCLUDING weightCol, so weighted CV
+            # ranks on the same probability surface as unweighted CV (the
+            # (X, y, w) tuple container carries the weights through)
+            wcol = evaluator.getOrDefault("weightCol")
+            cols = [fcol, lcol] + ([wcol] if wcol else [])
+            got = _df_columns(val, *cols)
+            scores = model.predict_proba_matrix(got[0])
+            container = tuple(got)
+            return model, evaluator.evaluate(container, predictions=scores)
         feats = columnar.extract_matrix(val, fcol)
         scores = model.predict_proba_matrix(feats)
         return model, evaluator.evaluate(val, predictions=scores)

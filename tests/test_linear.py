@@ -286,6 +286,24 @@ class TestLogisticRegression:
         resumed = mk().fit((x, y), checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1)
         np.testing.assert_allclose(resumed.coefficients, full.coefficients, atol=1e-6)
 
+    def test_nan_input_does_not_persist_junk_checkpoint(self, cls_data, tmp_path):
+        # ADVICE r4: the NaN-input raise must run BEFORE the checkpoint
+        # save (run_chunked_newton's order) — otherwise checkpoint_every=1
+        # persists an all-zeros step-0 checkpoint and a post-cleanup re-fit
+        # silently resumes one iteration in.
+        x, y = cls_data
+        x_bad = x.copy()
+        x_bad[0, 0] = np.nan
+        ck = str(tmp_path / "ck")
+        mk = lambda: LogisticRegression().setRegParam(0.01).setMaxIter(20)
+        with pytest.raises(ValueError, match="NaN/Inf"):
+            mk().fit((x_bad, y), checkpoint_dir=ck, checkpoint_every=1)
+        fresh = mk().fit((x, y))
+        refit = mk().fit((x, y), checkpoint_dir=ck, checkpoint_every=1)
+        np.testing.assert_allclose(
+            refit.coefficients, fresh.coefficients, atol=1e-10
+        )
+
     def test_persistence_roundtrip(self, cls_data, tmp_path):
         x, y = cls_data
         model = LogisticRegression().setRegParam(0.01).fit((x, y))

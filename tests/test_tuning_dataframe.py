@@ -183,6 +183,50 @@ class TestCrossValidatorOverDataFrames:
         fitted = cv.fit(df)
         assert fitted.avgMetrics[0] > 0.8  # AUC on ranked probabilities
 
+    def test_weighted_df_cv_ranks_on_probability_surface(self, session):
+        # ADVICE r4: with weightCol set and a DataFrame validation set,
+        # _fit_and_eval must still rank AUC on the probability surface —
+        # weighted and unweighted CV score the same surface, and no
+        # degradation warning fires.
+        import warnings
+
+        from spark_rapids_ml_tpu.models.tuning import _fit_and_eval
+
+        rng = np.random.default_rng(38)
+        x = rng.normal(size=(300, 3))
+        p = 1.0 / (1.0 + np.exp(-(x @ np.array([2.0, -1.0, 0.5]))))
+        y = (rng.random(300) < p).astype(float)
+        w = rng.uniform(0.5, 3.0, size=300)
+        schema = LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+                LT.StructField("w", LT.DoubleType()),
+            ]
+        )
+        rows = [
+            (row.tolist(), float(lbl), float(wt))
+            for row, lbl, wt in zip(x, y, w)
+        ]
+        train = session.createDataFrame(rows[:200], schema, numPartitions=3)
+        val = session.createDataFrame(rows[200:], schema, numPartitions=3)
+        ev = BinaryClassificationEvaluator(weightCol="w")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any degradation warning fails
+            model, auc = _fit_and_eval(
+                SparkLogisticRegression(regParam=1e-3), {}, ev, train, val
+            )
+        # oracle: weighted AUC of the SAME model's probabilities on val
+        scores = model.predict_proba_matrix(x[200:])
+        want = ev.evaluate((x[200:], y[200:], w[200:]), predictions=scores)
+        assert abs(auc - want) < 1e-12
+        # and the surface genuinely differs from hard-label ranking
+        hard = (np.asarray(scores).reshape(len(scores), -1)[:, -1] >= 0.5).astype(float)
+        auc_hard = ev.evaluate(
+            (x[200:], y[200:], w[200:]), predictions=hard
+        )
+        assert auc > auc_hard
+
     def test_evaluator_reads_probability_col_on_dataframe(self, session):
         from sklearn.metrics import roc_auc_score
 
