@@ -1,0 +1,153 @@
+/*
+ * Accelerated batch transform for the JVM shim — the dual-path model the
+ * reference ships (RapidsPCA.scala:128-161: a GPU columnar UDF for batch
+ * inference, with a per-row CPU fallback). The engine here is the
+ * Python/JAX/XLA runtime, so the accelerated path crosses the same process
+ * boundary the fit does:
+ *
+ *   1. the dataset (row-id + input column only) is staged to parquet;
+ *   2. `python -m spark_rapids_ml_tpu.jvm_bridge transform-pca ...`
+ *      streams it batch-by-batch through the device projection and writes
+ *      (row-id, projection) parquet back;
+ *   3. the projection is joined back on the row id, so every passthrough
+ *      column keeps its exact JVM type (no UDT round-trips through foreign
+ *      parquet writers).
+ *
+ * Anything that breaks the batch path — no usable python, a multi-node
+ * master without a shared stagingDir — falls back to the stock JVM row
+ * projection, mirroring the reference's use_gemm_fallback contract.
+ */
+package com.nvidia.spark.ml.feature
+
+import java.nio.file.{Files, Path => JPath}
+import java.util.Comparator
+
+import scala.sys.process._
+import scala.util.control.NonFatal
+
+import org.apache.spark.ml.Model
+import org.apache.spark.ml.feature.PCAModel
+import org.apache.spark.ml.functions.array_to_vector
+import org.apache.spark.ml.linalg.{DenseMatrix, DenseVector}
+import org.apache.spark.ml.param.{Param, ParamMap}
+import org.apache.spark.ml.util.{Identifiable, MLWritable, MLWriter}
+import org.apache.spark.sql.{DataFrame, Dataset}
+import org.apache.spark.sql.functions.{col, monotonically_increasing_id}
+import org.apache.spark.sql.types.StructType
+
+class TpuPCAModel private[feature] (
+    override val uid: String,
+    val stock: PCAModel)
+  extends Model[TpuPCAModel] with MLWritable {
+
+  private val log = org.slf4j.LoggerFactory.getLogger(classOf[TpuPCAModel])
+
+  def pc: DenseMatrix = stock.pc
+  def explainedVariance: DenseVector = stock.explainedVariance
+  def getInputCol: String = stock.getInputCol
+  def getOutputCol: String = stock.getOutputCol
+
+  /** Python interpreter with spark_rapids_ml_tpu importable. */
+  final val pythonExec: Param[String] =
+    new Param[String](this, "pythonExec", "python interpreter for the bridge")
+
+  /** Shared staging dir — same contract as PCA.stagingDir: required on
+    * multi-node masters, driver-local temp otherwise. */
+  final val stagingDir: Param[String] =
+    new Param[String](this, "stagingDir", "shared staging dir for the handoff")
+
+  setDefault(pythonExec -> "python3", stagingDir -> "")
+
+  def setPythonExec(value: String): this.type = set(pythonExec, value)
+  def setStagingDir(value: String): this.type = set(stagingDir, value)
+
+  override def transform(dataset: Dataset[_]): DataFrame = {
+    transformSchema(dataset.schema, logging = true)
+    val master = dataset.sparkSession.sparkContext.master
+    val canBatch = master.startsWith("local") || $(stagingDir).nonEmpty
+    if (!canBatch) {
+      log.info("TpuPCAModel: multi-node master without stagingDir — using " +
+        "the stock JVM row projection")
+      return stock.transform(dataset)
+    }
+    try transformBatch(dataset.toDF()) catch {
+      case NonFatal(e) =>
+        log.warn("TpuPCAModel: bridge batch transform failed " +
+          s"(${e.getMessage}); falling back to the stock JVM row projection")
+        stock.transform(dataset)
+    }
+  }
+
+  private def transformBatch(df: DataFrame): DataFrame = {
+    val spark = df.sparkSession
+    val scratch: JPath =
+      if ($(stagingDir).nonEmpty) Files.createTempDirectory(
+        java.nio.file.Paths.get($(stagingDir)), "tpuml-pca-transform-")
+      else Files.createTempDirectory("tpuml-pca-transform-")
+    val idCol = "__tpuml_row_id"
+    require(!df.columns.contains(idCol),
+      s"input already carries the reserved column $idCol")
+    val inputDir = scratch.resolve("input").toString
+    val modelDir = scratch.resolve("model").toString
+    val resultDir = scratch.resolve("result").toString
+    // persist BEFORE branching the plan: monotonically_increasing_id is
+    // only deterministic on a fixed partitioning, and the id column is
+    // evaluated twice (once for the staged write, once for the join)
+    val withId = df.withColumn(idCol, monotonically_increasing_id()).persist()
+    try {
+      withId.select(col(idCol), col(getInputCol))
+        .write.mode("overwrite").parquet(inputDir)
+      // the stock writer emits the stock Spark ML layout, which the
+      // bridge's PCAModel.load auto-detects
+      stock.write.overwrite().save(modelDir)
+      val cmd = Seq(
+        $(pythonExec), "-m", "spark_rapids_ml_tpu.jvm_bridge", "transform-pca",
+        "--input", inputDir, "--model", modelDir, "--output", resultDir,
+        "--input-col", getInputCol, "--output-col", getOutputCol)
+      val exit = Process(cmd).!
+      require(exit == 0, s"jvm_bridge transform-pca failed with exit code $exit")
+      val proj = spark.read.parquet(resultDir).select(
+        col(idCol),
+        array_to_vector(col(getOutputCol)).as(getOutputCol))
+      val out = withId.join(proj, idCol).drop(idCol)
+      // the joined plan lazily reads the scratch parquet and the persisted
+      // id frame, so both must outlive this call: release them at JVM exit
+      // (Spark's ContextCleaner also reclaims the cache blocks earlier,
+      // once the plan becomes unreachable). The staged copy is id + input
+      // column + [rows, k] output, not the full dataset.
+      sys.addShutdownHook {
+        try withId.unpersist(blocking = false) catch { case NonFatal(_) => () }
+        Files.walk(scratch).sorted(Comparator.reverseOrder[JPath]())
+          .forEach(p => Files.deleteIfExists(p))
+      }
+      out
+    } catch {
+      case NonFatal(e) =>
+        withId.unpersist()
+        Files.walk(scratch).sorted(Comparator.reverseOrder[JPath]())
+          .forEach(p => Files.deleteIfExists(p))
+        throw e
+    }
+  }
+
+  override def transformSchema(schema: StructType): StructType =
+    stock.transformSchema(schema)
+
+  override def copy(extra: ParamMap): TpuPCAModel = {
+    val copied = new TpuPCAModel(uid, stock)
+    copyValues(copied, extra).setParent(parent)
+  }
+
+  /** Persists as a STOCK PCAModel save — loadable by stock Spark ML
+    * anywhere, and re-wrappable here via [[TpuPCAModel.load]]. */
+  override def write: MLWriter = stock.write
+}
+
+object TpuPCAModel {
+  /** Wrap a stock model (e.g. the one `new PCA().fit(df)` returns) with the
+    * bridge-accelerated batch transform. */
+  def wrap(stock: PCAModel): TpuPCAModel =
+    new TpuPCAModel(Identifiable.randomUID("tpu-pca-model"), stock)
+
+  def load(path: String): TpuPCAModel = wrap(PCAModel.load(path))
+}

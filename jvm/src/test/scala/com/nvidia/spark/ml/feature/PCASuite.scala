@@ -49,4 +49,33 @@ class PCASuite extends AnyFunSuite {
     assert(out.columns.contains("pca"))
     assert(out.count() == 300)
   }
+
+  test("TpuPCAModel batch transform matches the stock projection (1e-6)") {
+    val rng = new Random(12)
+    val rows = Seq.tabulate(250)(i =>
+      (i.toLong, Vectors.dense(Array.fill(6)(rng.nextGaussian()))))
+    import spark.implicits._
+    val df = rows.toDF("id", "features").repartition(3)
+
+    val stockModel = new SparkPCA()
+      .setInputCol("features").setOutputCol("pca").setK(3)
+      .fit(df)
+    val accel = TpuPCAModel.wrap(stockModel)
+
+    val want = stockModel.transform(df)
+      .select("id", "pca").as[(Long, org.apache.spark.ml.linalg.Vector)]
+      .collect().toMap
+    val got = accel.transform(df)
+      .select("id", "pca").as[(Long, org.apache.spark.ml.linalg.Vector)]
+      .collect().toMap
+    assert(got.size == want.size)
+    got.foreach { case (id, v) =>
+      v.toArray.zip(want(id).toArray).foreach { case (a, b) =>
+        assert(abs(a - b) < 1e-6, s"row $id: $a vs $b")
+      }
+    }
+    // passthrough columns keep their types and values
+    val cols = accel.transform(df).columns
+    assert(cols.sameElements(Array("id", "features", "pca")))
+  }
 }

@@ -89,7 +89,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def create_hybrid_mesh(feat: int = 1) -> Mesh:
+def create_hybrid_mesh(feat: int = 1, *, slice_groups=None) -> Mesh:
     """Multi-slice (data, feat) mesh laid out so ``feat`` rides ICI.
 
     On a multi-slice TPU deployment devices within a slice talk over ICI
@@ -100,10 +100,47 @@ def create_hybrid_mesh(feat: int = 1) -> Mesh:
     ordering) and collapses it to the package's (data, feat) axes with
     ``feat`` innermost — i.e. entirely inside a slice.
 
+    ``slice_groups`` overrides topology discovery with an explicit
+    partition of device indices into equal-size slices (outer list =
+    slices). Use it when the runtime does not report ``slice_index``
+    (multi-host CPU rehearsals, some plugin backends) but the operator
+    knows which devices share a fast interconnect — and to validate the
+    multi-slice layout on a virtual mesh (``__graft_entry__`` path 8).
+    The resulting grid places each slice's devices contiguously along the
+    data axis with ``feat`` entirely inside one slice, so every feat-axis
+    collective is intra-slice by construction and only the data-axis psum
+    spans slices.
+
     Falls back to the flat ``create_mesh`` when the runtime reports a single
-    slice/granule (e.g. CPU or single-host TPU).
+    slice/granule (e.g. CPU or single-host TPU) and no ``slice_groups``.
     """
     devices = jax.devices()
+    if slice_groups is not None:
+        groups = [list(g) for g in slice_groups]
+        sizes = {len(g) for g in groups}
+        if len(sizes) != 1 or 0 in sizes:
+            raise ValueError("slice_groups must be equal-size and non-empty")
+        seen = [i for g in groups for i in g]
+        if sorted(seen) != list(range(len(seen))):
+            raise ValueError(
+                "slice_groups must partition device indices 0..n-1 exactly"
+            )
+        if len(seen) > len(devices):
+            raise ValueError(
+                f"slice_groups name {len(seen)} devices but the runtime "
+                f"has {len(devices)}"
+            )
+        per_slice = sizes.pop()
+        if per_slice % feat:
+            raise ValueError(
+                f"feat={feat} must divide devices-per-slice={per_slice}"
+            )
+        rows = [
+            [devices[i] for i in g[r * feat : (r + 1) * feat]]
+            for g in groups
+            for r in range(per_slice // feat)
+        ]
+        return Mesh(np.array(rows), (DATA_AXIS, FEAT_AXIS))
     slice_ids = {getattr(d, "slice_index", None) for d in devices}
     if None in slice_ids or len(slice_ids) == 1:
         return create_mesh(feat=feat)

@@ -96,6 +96,54 @@ class TestJvmBridgeFitPCA:
             main(["fit-pca", "--input", str(inp), "--output",
                   str(tmp_path / "m"), "--k", "2"])
 
+    def test_transform_round_trip_matches_stock_projection(self, x, tmp_path):
+        # VERDICT r4 Next #3: the accelerated batch transform for the JVM
+        # path. fit-pca writes the stock-layout model; transform-pca must
+        # project a staged dataset to within 1e-6 of the stock pcᵀ·x
+        # projection, preserving every passthrough column in row order.
+        inp = tmp_path / "in"
+        out = tmp_path / "model"
+        _write_parquet(inp, x)
+        main(["fit-pca", "--input", str(inp), "--output", str(out), "--k", "3"])
+
+        staged = tmp_path / "staged"
+        staged.mkdir()
+        ids = np.arange(len(x), dtype=np.int64)
+        flat = pa.array(x.reshape(-1))
+        offsets = pa.array(
+            np.arange(0, x.size + 1, x.shape[1], dtype=np.int32)
+        )
+        pq.write_table(
+            pa.table({
+                "id": pa.array(ids),
+                "features": pa.ListArray.from_arrays(offsets, flat),
+            }),
+            staged / "part-00000.parquet",
+        )
+        result = tmp_path / "result"
+        main([
+            "transform-pca", "--input", str(staged), "--model", str(out),
+            "--output", str(result), "--input-col", "features",
+            "--output-col", "pca_features", "--batch-rows", "100",
+        ])
+        got = pq.read_table(result)
+        assert got.column_names == ["id", "features", "pca_features"]
+        np.testing.assert_array_equal(got.column("id").to_numpy(), ids)
+        proj = np.stack(got.column("pca_features").to_pylist())
+        model = PCAModel.load(str(out))
+        np.testing.assert_allclose(proj, x @ model.pc, atol=1e-6)
+
+    def test_transform_rejects_existing_output_col(self, x, tmp_path):
+        inp = tmp_path / "in"
+        out = tmp_path / "model"
+        _write_parquet(inp, x)
+        main(["fit-pca", "--input", str(inp), "--output", str(out), "--k", "2"])
+        with pytest.raises(SystemExit, match="already exists"):
+            main([
+                "transform-pca", "--input", str(inp), "--model", str(out),
+                "--output", str(tmp_path / "r"), "--output-col", "features",
+            ])
+
     def test_cli_subprocess_exactly_as_scala_invokes(self, x, tmp_path):
         # the Scala shim's literal invocation: python -m ... fit-pca ...
         inp = tmp_path / "in"

@@ -110,6 +110,27 @@ class TestMeshHelpers:
         assert mesh.axis_names == (M.DATA_AXIS, M.FEAT_AXIS)
         assert mesh.shape[M.FEAT_AXIS] == 2
 
+    def test_hybrid_mesh_explicit_slice_groups_layout(self):
+        # the DCN-aware layout contract: feat rows never cross a slice
+        # boundary; the data axis concatenates slices
+        import jax
+
+        devices = jax.devices()
+        groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+        mesh = M.create_hybrid_mesh(feat=2, slice_groups=groups)
+        assert mesh.shape[M.DATA_AXIS] == 4 and mesh.shape[M.FEAT_AXIS] == 2
+        by_slice = {devices[i]: s for s, g in enumerate(groups) for i in g}
+        for row in mesh.devices:
+            assert len({by_slice[d] for d in row}) == 1
+
+    def test_hybrid_mesh_slice_groups_validation(self):
+        with pytest.raises(ValueError, match="equal-size"):
+            M.create_hybrid_mesh(slice_groups=[[0, 1, 2], [3]])
+        with pytest.raises(ValueError, match="partition"):
+            M.create_hybrid_mesh(slice_groups=[[0, 1], [1, 2]])
+        with pytest.raises(ValueError, match="feat=3"):
+            M.create_hybrid_mesh(feat=3, slice_groups=[[0, 1, 2, 3]])
+
     def test_shard_map_shim_decorator_form(self, mesh8):
         import jax
         from jax import lax

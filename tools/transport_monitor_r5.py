@@ -136,13 +136,16 @@ def main() -> None:
         attempt += 1
         t0 = time.time()
         ok, detail = devicepolicy.probe_transport_subprocess(timeout=PROBE_TIMEOUT_S)
+        # last non-empty line: the child's stderr opens with harmless
+        # platform warnings; the diagnostic is at the end
+        lines = [l for l in (detail or "").splitlines() if l.strip()]
         append(LOG_PATH, {
             "t": now_iso(),
             "elapsed_s": round(time.time() - START, 1),
             "attempt": attempt,
             "ok": ok,
             "took_s": round(time.time() - t0, 1),
-            "detail": detail.splitlines()[0][:200] if detail else "",
+            "detail": (lines[-1] if lines else "")[:200],
         })
         print(f"[monitor] probe {attempt}: ok={ok} ({detail.splitlines()[0][:120] if detail else ''})",
               flush=True)
