@@ -1,0 +1,86 @@
+"""The JVM shim's process contract, driven end to end from Python — the
+exact subprocess invocations `com.nvidia.spark.ml.feature.PCA.fit` and
+`TpuPCAModel.transform` make (jvm/src/main/scala/.../PCA.scala,
+TpuPCAModel.scala), so the whole handoff is runnable without a JVM:
+
+  1. stage a features column as parquet (what the Scala estimator writes);
+  2. `jvm_bridge fit-pca` fits on the device mesh and saves the model in
+     the STOCK Spark ML layout (loadable by
+     org.apache.spark.ml.feature.PCAModel.load);
+  3. stage (row-id, features) and run `jvm_bridge transform-pca` — the
+     accelerated batch inference path — then check the projection against
+     the stock pcᵀ·x oracle.
+
+Run: python examples/05_jvm_handoff.py   (any JAX backend)
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def _write(path: str, table: pa.Table) -> None:
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(table, os.path.join(path, "part-00000.parquet"))
+
+
+def _bridge(*args: str) -> None:
+    cmd = [sys.executable, "-m", "spark_rapids_ml_tpu.jvm_bridge", *args]
+    print("  $", " ".join(cmd[2:]))
+    subprocess.run(cmd, check=True)
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5_000, 16)) @ rng.normal(size=(16, 24))
+    feats = pa.ListArray.from_arrays(
+        pa.array(np.arange(0, x.size + 1, x.shape[1], dtype=np.int32)),
+        pa.array(x.reshape(-1)),
+    )
+
+    work = tempfile.mkdtemp(prefix="tpuml-jvm-handoff-")
+    staged_fit = os.path.join(work, "input")
+    model_dir = os.path.join(work, "model")
+    staged_rows = os.path.join(work, "staged")
+    result = os.path.join(work, "result")
+
+    print("1. stage features (what the Scala estimator writes)")
+    _write(staged_fit, pa.table({"features": feats}))
+
+    print("2. fit on the device mesh -> stock Spark ML layout")
+    _bridge(
+        "fit-pca", "--input", staged_fit, "--output", model_dir, "--k", "4"
+    )
+
+    print("3. accelerated batch transform (TpuPCAModel's path)")
+    _write(
+        staged_rows,
+        pa.table({
+            "__tpuml_row_id": pa.array(np.arange(len(x), dtype=np.int64)),
+            "features": feats,
+        }),
+    )
+    _bridge(
+        "transform-pca", "--input", staged_rows, "--model", model_dir,
+        "--output", result, "--output-col", "pca",
+    )
+
+    from spark_rapids_ml_tpu.models.pca import PCAModel
+
+    model = PCAModel.load(model_dir)  # auto-detects the stock layout
+    got = pq.read_table(result)
+    proj = np.stack(got.column("pca").to_pylist())
+    ids = got.column("__tpuml_row_id").to_numpy()
+    np.testing.assert_array_equal(ids, np.arange(len(x)))
+    np.testing.assert_allclose(proj, x @ model.pc, atol=1e-6)
+    print(f"round trip ok: {proj.shape[0]} rows projected to k={proj.shape[1]}, "
+          "row ids intact, projection == stock pc^T x within 1e-6")
+
+
+if __name__ == "__main__":
+    main()

@@ -97,10 +97,11 @@ def _iter_chunks(
     localspark: ``_parts()`` partitions are produced by a generator —
     columnar AND genuinely streaming. Real pyspark has no public streaming
     Arrow API, so it's a size-gated tradeoff: small datasets
-    (≤ ARROW_CUTOVER) take ``toArrow()`` whole-table columnar extraction
-    (fast, O(dataset) Arrow memory); larger ones stream via
-    ``toLocalIterator()`` (one partition per job, rows converted in
-    ROW_CHUNK groups — columns by POSITION: callers select
+    (≤ ARROW_CUTOVER) take whole-table columnar extraction — ``toArrow()``
+    on pyspark 4.0+, arrow-enabled ``toPandas()`` on 3.x (both one driver
+    job, O(dataset) columnar memory, no per-row Python); larger ones
+    stream via ``toLocalIterator()`` (one partition per job, rows
+    converted in ROW_CHUNK groups — columns by POSITION: callers select
     [features, label?, weight?] in that order). Anything else: one-shot
     ``collect()``.
     """
@@ -114,19 +115,46 @@ def _iter_chunks(
                 w = columnar.extract_vector(b, weight_col) if weight_col else None
                 yield x, y, w
         return
-    to_arrow = getattr(selected, "toArrow", None)
     cutover = int(
         float(os.environ.get(ARROW_CUTOVER_VAR, DEFAULT_ARROW_CUTOVER))
     )
-    if callable(to_arrow) and est_bytes <= cutover:
-        for b in to_arrow().to_batches():
-            if not b.num_rows:
-                continue
-            x = columnar.extract_matrix(b, features_col)
-            y = columnar.extract_vector(b, label_col) if label_col else None
-            w = columnar.extract_vector(b, weight_col) if weight_col else None
-            yield x, y, w
-        return
+    if est_bytes <= cutover:
+        to_arrow = getattr(selected, "toArrow", None)
+        if callable(to_arrow):  # pyspark 4.0+
+            for b in to_arrow().to_batches():
+                if not b.num_rows:
+                    continue
+                x = columnar.extract_matrix(b, features_col)
+                y = columnar.extract_vector(b, label_col) if label_col else None
+                w = columnar.extract_vector(b, weight_col) if weight_col else None
+                yield x, y, w
+            return
+        if _pandas_columnar_ok(selected, features_col):
+            # pyspark 3.x (no toArrow): arrow-enabled toPandas IS a
+            # columnar one-job collect for ArrayType columns — but only
+            # then. VectorUDT columns and arrow-disabled sessions degrade
+            # toPandas to a pickled per-row collect at O(dataset) memory,
+            # strictly worse than the row iterator below, so the guard
+            # sends those there.
+            try:
+                pdf = selected.toPandas()
+            except ImportError:  # pandas went missing mid-probe
+                pdf = None
+            if pdf is not None:
+                if len(pdf):
+                    x = columnar.extract_matrix(pdf, features_col)
+                    y = (
+                        columnar.extract_vector(pdf, label_col)
+                        if label_col
+                        else None
+                    )
+                    w = (
+                        columnar.extract_vector(pdf, weight_col)
+                        if weight_col
+                        else None
+                    )
+                    yield x, y, w
+                return
     it = getattr(selected, "toLocalIterator", None)
     rows_iter = it() if callable(it) else iter(selected.collect())
     buf: list[Any] = []
@@ -137,6 +165,30 @@ def _iter_chunks(
             buf = []
     if buf:
         yield _chunk_from_rows(buf, label_col, weight_col)
+
+
+def _pandas_columnar_ok(selected, features_col: str) -> bool:
+    """True only when ``selected.toPandas()`` would actually be a columnar
+    arrow collect: pandas importable, the session's arrow transfer enabled,
+    and the features column an ArrayType (VectorUDT is not arrow-convertible
+    — pyspark silently falls back to pickled rows). Anything unverifiable
+    answers False; the row-iterator path is the safe default."""
+    if not callable(getattr(selected, "toPandas", None)):
+        return False
+    try:
+        import pandas  # noqa: F401
+    except ImportError:
+        return False
+    try:
+        dtype = selected.schema[features_col].dataType
+        if type(dtype).__name__ != "ArrayType":
+            return False
+        enabled = selected.sparkSession.conf.get(
+            "spark.sql.execution.arrow.pyspark.enabled"
+        )
+        return str(enabled).lower() == "true"
+    except Exception:
+        return False
 
 
 def _chunk_from_rows(rows: list, label_col, weight_col):

@@ -1,0 +1,12 @@
+"""Shared gating helper for the real-pyspark test legs (the modules CI's
+pyspark-integration matrix selects). One definition so a future change —
+e.g. a version floor — edits one place."""
+
+
+def have_pyspark() -> bool:
+    try:
+        import pyspark  # noqa: F401
+
+        return True
+    except Exception:
+        return False

@@ -215,3 +215,83 @@ class TestReviewRegressions:
             "values": [9.0, 8.0], "isTransposed": True,
         }
         np.testing.assert_allclose(P.struct_to_matrix(row), [[0.0, 9.0], [8.0, 0.0]])
+
+
+from pyspark_support import have_pyspark as _have_pyspark
+
+
+@pytest.mark.skipif(
+    not _have_pyspark(),
+    reason="pyspark not installed: STOCK Spark ML loading our spark-layout "
+    "saves NOT exercised locally — this is the Scala shim's load contract "
+    "(PCAModel.load); see CI pyspark-integration matrix, which selects "
+    "this module",
+)
+class TestStockSparkMLLoadsOurSaves:
+    """The interop claim behind the whole JVM story: a save produced by
+    ``layout="spark"`` must load in STOCK Spark ML (the same JVM reader
+    ``org.apache.spark.ml.feature.PCAModel.load`` the Scala shim calls,
+    driven here through pyspark) and transform identically."""
+
+    @pytest.fixture(scope="class")
+    def spark(self):
+        from pyspark.sql import SparkSession
+
+        s = (
+            SparkSession.builder.master("local[2]")
+            .appName("tpu-ml-persistence-it")
+            .getOrCreate()
+        )
+        yield s
+        s.stop()
+
+    def test_stock_pca_model_loads_and_transforms(self, spark, tmp_path):
+        from pyspark.ml.feature import PCAModel as StockPCAModel
+        from pyspark.ml.linalg import Vectors
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(150, 6))
+        ours = (
+            PCA().setInputCol("features").setOutputCol("pca").setK(3).fit(x)
+        )
+        p = str(tmp_path / "m")
+        ours.save(p, layout="spark")
+
+        stock = StockPCAModel.load(p)
+        assert stock.getK() == 3
+        np.testing.assert_allclose(
+            np.asarray(stock.pc.toArray()), ours.pc, atol=1e-12
+        )
+        df = spark.createDataFrame(
+            [(Vectors.dense(row),) for row in x], ["features"]
+        )
+        got = np.asarray(
+            [r["pca"].toArray() for r in stock.transform(df).collect()]
+        )
+        want = x @ ours.pc
+        np.testing.assert_allclose(got, want, atol=1e-9)
+
+    def test_stock_save_loads_back_here(self, spark, tmp_path):
+        # the reverse direction: a save written by STOCK Spark ML loads in
+        # this framework (cluster-trained model, local inference)
+        from pyspark.ml.feature import PCA as StockPCA
+        from pyspark.ml.linalg import Vectors
+
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(120, 5))
+        df = spark.createDataFrame(
+            [(Vectors.dense(row),) for row in x], ["features"]
+        )
+        stock = (
+            StockPCA()
+            .setInputCol("features")
+            .setOutputCol("pca")
+            .setK(2)
+            .fit(df)
+        )
+        p = str(tmp_path / "stock")
+        stock.save(p)
+        ours = PCAModel.load(p)
+        np.testing.assert_allclose(
+            ours.pc, np.asarray(stock.pc.toArray()), atol=1e-12
+        )

@@ -34,13 +34,7 @@ from spark_rapids_ml_tpu.spark import (
 from spark_rapids_ml_tpu.spark.estimators import SparkPCAModel
 
 
-def _have_pyspark() -> bool:
-    try:
-        import pyspark  # noqa: F401
-
-        return True
-    except ImportError:
-        return False
+from pyspark_support import have_pyspark as _have_pyspark
 
 
 if _have_pyspark():
