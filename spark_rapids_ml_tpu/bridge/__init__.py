@@ -17,7 +17,7 @@ import numpy as np
 
 _NATIVE_DIR = Path(__file__).parent / "native"
 _LIB_PATH = _NATIVE_DIR / "libtpuml_bridge.so"
-_MIN_VERSION = 11  # oldest library this module's wrappers can drive
+_MIN_VERSION = 12  # oldest library this module's wrappers can drive
 
 _lib = None
 
@@ -94,6 +94,10 @@ def get_lib() -> ctypes.CDLL:
     lib.tpuml_project.restype = i32
     lib.tpuml_kmeans_assign.argtypes = [dp, dp, dp, i64, i64, i64, ip, dp, dp, dp]
     lib.tpuml_kmeans_assign.restype = i32
+    lib.tpuml_linreg_accumulate.argtypes = [dp, dp, dp, i64, i64, dp, dp, dp]
+    lib.tpuml_linreg_accumulate.restype = i32
+    lib.tpuml_solve_spd.argtypes = [dp, dp, i64, dp]
+    lib.tpuml_solve_spd.restype = i32
 
     _lib = lib
     return lib
@@ -257,6 +261,115 @@ def kmeans_assign(
         "kmeans_assign",
     )
     return labels, sums, counts, float(cost[0])
+
+
+def linreg_accumulate(
+    x: np.ndarray,
+    y: np.ndarray,
+    w: np.ndarray | None = None,
+    *,
+    xtx: np.ndarray | None = None,
+    xty: np.ndarray | None = None,
+    moments: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One fused weighted-moments pass on the native threaded kernel —
+    the host-fallback analog of ``ops.linear.linear_stats``. Pass the
+    accumulators to fold multiple batches (the per-partition loop
+    semantics of :func:`gram`). Returns (xtx [n, n], xty [n],
+    moments [n + 2] = [x_sum, y_sum, count])."""
+    x = _as_c(x)
+    rows, n = x.shape
+    y = _as_c(np.asarray(y, dtype=np.float64).reshape(-1))
+    if y.shape != (rows,):
+        raise ValueError(f"y has shape {y.shape}, expected ({rows},)")
+    wp = None if w is None else _as_c(np.asarray(w, dtype=np.float64))
+    if wp is not None and wp.shape != (rows,):
+        raise ValueError(f"weights have shape {wp.shape}, expected ({rows},)")
+    if xtx is None:
+        xtx = np.zeros((n, n), dtype=np.float64)
+    if xty is None:
+        xty = np.zeros(n, dtype=np.float64)
+    if moments is None:
+        moments = np.zeros(n + 2, dtype=np.float64)
+    for name, acc, shape in (
+        ("xtx", xtx, (n, n)),
+        ("xty", xty, (n,)),
+        ("moments", moments, (n + 2,)),
+    ):
+        if acc.shape != shape or acc.dtype != np.float64 or not acc.flags.c_contiguous:
+            raise ValueError(
+                f"{name} accumulator must be C-contiguous float64 {shape}"
+            )
+    _check(
+        get_lib().tpuml_linreg_accumulate(
+            _dptr(x), _dptr(y), None if wp is None else _dptr(wp),
+            rows, n, _dptr(xtx), _dptr(xty), _dptr(moments),
+        ),
+        "linreg_accumulate",
+    )
+    return xtx, xty, moments
+
+
+def solve_spd(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Native Cholesky solve for SPD systems. Raises NativeBridgeError
+    (code 4) when ``a`` is not numerically positive definite."""
+    a = _as_c(a)
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError(f"a must be square, got {a.shape}")
+    b = _as_c(np.asarray(b, dtype=np.float64).reshape(-1))
+    if b.shape != (n,):
+        raise ValueError(f"b has shape {b.shape}, expected ({n},)")
+    out = np.empty(n, dtype=np.float64)
+    _check(get_lib().tpuml_solve_spd(_dptr(a), _dptr(b), n, _dptr(out)), "solve_spd")
+    return out
+
+
+def linreg_fit_host(
+    x: np.ndarray,
+    y: np.ndarray,
+    w: np.ndarray | None = None,
+    *,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+) -> tuple[np.ndarray, float]:
+    """Pure-native ridge/OLS fit (no accelerator): the GLM sibling of
+    :func:`pca_fit_host` / :func:`kmeans_lloyd_host`, with EXACTLY
+    ``ops.linear.solve_normal``'s semantics — centered moments (the
+    intercept is never penalized), λ scaled by the row count (Spark ML's
+    convention), and a least-squares fallback for rank-deficient designs.
+    Returns (coefficients [n], intercept)."""
+    xtx, xty, mom = linreg_accumulate(x, y, w)
+    n = xtx.shape[0]
+    m = max(float(mom[n + 1]), 1.0)
+    lam = reg_param * m
+    if fit_intercept:
+        mu = mom[:n] / m
+        ybar = float(mom[n]) / m
+        a = xtx - m * np.outer(mu, mu)
+        b = xty - m * mu * ybar
+    else:
+        a = xtx
+        b = xty
+    a = a + lam * np.eye(n)
+    if not (np.isfinite(a).all() and np.isfinite(b).all()):
+        # NaN/Inf moments: degrade to NaN coefficients like the device
+        # path (solve_normal never raises on non-finite input; LAPACK's
+        # lstsq would raise and spray DLASCL warnings)
+        coef = np.full(n, np.nan)
+    else:
+        try:
+            coef = solve_spd(a, b)
+            if not np.all(np.isfinite(coef)):
+                raise NativeBridgeError("non-finite solve")
+        except NativeBridgeError:
+            coef = np.linalg.lstsq(a, b, rcond=None)[0]
+    intercept = (
+        float(mom[n]) / m - float(np.dot(mom[:n] / m, coef))
+        if fit_intercept
+        else 0.0
+    )
+    return coef, intercept
 
 
 def kmeans_lloyd_host(

@@ -40,7 +40,7 @@
 
 extern "C" {
 
-int32_t tpuml_version() { return 11; }  // 0.1.1: + tpuml_kmeans_assign
+int32_t tpuml_version() { return 12; }  // 0.1.2: + linreg normal equations
 
 // ---------------------------------------------------------------------------
 // (a) Columnar packing
@@ -80,13 +80,15 @@ namespace {
 
 constexpr int64_t kBlock = 48;  // column tile; 48*48 doubles fit L1 nicely
 
-void gram_tile(const double* a, int64_t rows, int64_t n, int64_t i0,
-               int64_t i1, int64_t j0, int64_t j1, double* c) {
-  // C[i, j] = sum_r a[r, i] * a[r, j] over the tile, streaming rows.
+void gram_tile(const double* a, const double* w, int64_t rows, int64_t n,
+               int64_t i0, int64_t i1, int64_t j0, int64_t j1, double* c) {
+  // C[i, j] = sum_r w_r * a[r, i] * a[r, j] over the tile, streaming rows
+  // (w == nullptr means unit weights).
   for (int64_t r = 0; r < rows; ++r) {
     const double* row = a + r * n;
+    const double wr = w ? w[r] : 1.0;
     for (int64_t i = i0; i < i1; ++i) {
-      const double ai = row[i];
+      const double ai = wr * row[i];
       double* crow = c + i * n;
       for (int64_t j = std::max(j0, i); j < j1; ++j) {
         crow[j] += ai * row[j];
@@ -100,14 +102,11 @@ int n_threads() {
   return hw ? static_cast<int>(hw) : 4;
 }
 
-}  // namespace
-
-// Accumulates A^T A into `c` (must be zero-initialized by the caller for a
-// fresh Gram; repeated calls accumulate, which is exactly the multi-batch
-// partition semantics of the reference's per-partition cov loop).
-int32_t tpuml_gram(const double* a, int64_t rows, int64_t n, double* c) {
-  if (!a || !c || rows < 0 || n <= 0) return 1;
-  // Tile the upper triangle; distribute tiles round-robin over threads.
+// Shared engine for the Gram-shaped accumulations: upper-triangle tiles
+// round-robined over threads, then the mirror down. w == nullptr means
+// unit weights.
+void threaded_gram(const double* a, const double* w, int64_t rows, int64_t n,
+                   double* c) {
   struct Tile {
     int64_t i0, i1, j0, j1;
   };
@@ -115,7 +114,6 @@ int32_t tpuml_gram(const double* a, int64_t rows, int64_t n, double* c) {
   for (int64_t i0 = 0; i0 < n; i0 += kBlock)
     for (int64_t j0 = i0; j0 < n; j0 += kBlock)
       tiles.push_back({i0, std::min(i0 + kBlock, n), j0, std::min(j0 + kBlock, n)});
-
   const int nt = std::min<int>(n_threads(), static_cast<int>(tiles.size()));
   std::vector<std::thread> workers;
   workers.reserve(nt);
@@ -123,14 +121,23 @@ int32_t tpuml_gram(const double* a, int64_t rows, int64_t n, double* c) {
     workers.emplace_back([&, t] {
       for (size_t idx = t; idx < tiles.size(); idx += nt) {
         const Tile& tl = tiles[idx];
-        gram_tile(a, rows, n, tl.i0, tl.i1, tl.j0, tl.j1, c);
+        gram_tile(a, w, rows, n, tl.i0, tl.i1, tl.j0, tl.j1, c);
       }
     });
   }
-  for (auto& w : workers) w.join();
-  // mirror the upper triangle down
+  for (auto& wk : workers) wk.join();
   for (int64_t i = 0; i < n; ++i)
     for (int64_t j = i + 1; j < n; ++j) c[j * n + i] = c[i * n + j];
+}
+
+}  // namespace
+
+// Accumulates A^T A into `c` (must be zero-initialized by the caller for a
+// fresh Gram; repeated calls accumulate, which is exactly the multi-batch
+// partition semantics of the reference's per-partition cov loop).
+int32_t tpuml_gram(const double* a, int64_t rows, int64_t n, double* c) {
+  if (!a || !c || rows < 0 || n <= 0) return 1;
+  threaded_gram(a, nullptr, rows, n, c);
   return 0;
 }
 
@@ -240,6 +247,72 @@ int32_t tpuml_eigh_descending(const double* cov, int64_t n, double* components,
       components[i * n + j] = evecs[i * n + src];
   }
   return tpuml_sign_flip(components, n, n);
+}
+
+// ---------------------------------------------------------------------------
+// (e) GLM normal equations — host-fallback sibling of ops/linear.py's
+// linear_stats/solve_normal (the reference ships no GLM; this mirrors the
+// framework's device path so the no-accelerator backend covers the family)
+// ---------------------------------------------------------------------------
+
+// One fused pass accumulating the weighted moments of a row batch:
+//   xtx     += X^T W X            (row-major [n, n], threaded tiles)
+//   xty     += X^T W y            ([n])
+//   moments += [sum(WX) ([n]), sum(Wy), sum(w)]   (moments is [n + 2])
+// w == nullptr means unit weights. Repeated calls accumulate (multi-batch
+// partition semantics, like tpuml_gram).
+int32_t tpuml_linreg_accumulate(const double* x, const double* y,
+                                const double* w, int64_t rows, int64_t n,
+                                double* xtx, double* xty, double* moments) {
+  if (!x || !y || !xtx || !xty || !moments || rows < 0 || n <= 0) return 1;
+  threaded_gram(x, w, rows, n, xtx);
+  // the O(rows·n) vector moments (negligible next to the O(rows·n²) tiles)
+  for (int64_t r = 0; r < rows; ++r) {
+    const double* row = x + r * n;
+    const double wr = w ? w[r] : 1.0;
+    const double wy = wr * y[r];
+    for (int64_t i = 0; i < n; ++i) {
+      xty[i] += wy * row[i];
+      moments[i] += wr * row[i];
+    }
+    moments[n] += wy;
+    moments[n + 1] += wr;
+  }
+  return 0;
+}
+
+// Cholesky solve A out = b for a symmetric positive-definite A (row-major
+// [n, n]; the lower triangle is read). Returns 4 when A is not numerically
+// positive definite — callers fall back to a least-squares solve, matching
+// solve_normal's rank-deficiency contract (ops/linear.py).
+int32_t tpuml_solve_spd(const double* a, const double* b, int64_t n,
+                        double* out) {
+  if (!a || !b || !out || n <= 0) return 1;
+  std::vector<double> l(a, a + n * n);
+  for (int64_t j = 0; j < n; ++j) {
+    double d = l[j * n + j];
+    for (int64_t k = 0; k < j; ++k) d -= l[j * n + k] * l[j * n + k];
+    if (!(d > 0.0) || !std::isfinite(d)) return 4;
+    d = std::sqrt(d);
+    l[j * n + j] = d;
+    for (int64_t i = j + 1; i < n; ++i) {
+      double s = l[i * n + j];
+      for (int64_t k = 0; k < j; ++k) s -= l[i * n + k] * l[j * n + k];
+      l[i * n + j] = s / d;
+    }
+  }
+  // forward: L z = b (z in out), then backward: L^T out = z
+  for (int64_t i = 0; i < n; ++i) {
+    double s = b[i];
+    for (int64_t k = 0; k < i; ++k) s -= l[i * n + k] * out[k];
+    out[i] = s / l[i * n + i];
+  }
+  for (int64_t i = n - 1; i >= 0; --i) {
+    double s = out[i];
+    for (int64_t k = i + 1; k < n; ++k) s -= l[k * n + i] * out[k];
+    out[i] = s / l[i * n + i];
+  }
+  return 0;
 }
 
 // ---------------------------------------------------------------------------

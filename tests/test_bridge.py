@@ -12,7 +12,7 @@ if not bridge.available():  # pragma: no cover
 
 
 def test_version():
-    assert bridge.version() == 11
+    assert bridge.version() == 12
 
 
 class TestPacking:
@@ -170,3 +170,78 @@ class TestKMeansAssign:
             c = KM.update_centers(stats, c)
         np.testing.assert_allclose(c_native, np.asarray(c), atol=1e-8)
         assert cost_native > 0
+
+
+class TestLinregNative:
+    """Native normal-equations family vs NumPy oracles and the framework's
+    LinearRegression (ops.linear.solve_normal semantics)."""
+
+    def test_accumulate_matches_numpy(self, rng):
+        x = rng.normal(size=(300, 7))
+        y = rng.normal(size=300)
+        w = rng.uniform(0.5, 2.0, size=300)
+        xtx, xty, mom = bridge.linreg_accumulate(x, y, w)
+        np.testing.assert_allclose(xtx, (x * w[:, None]).T @ x, atol=1e-9)
+        np.testing.assert_allclose(xty, x.T @ (w * y), atol=1e-9)
+        np.testing.assert_allclose(mom[:7], (x * w[:, None]).sum(0), atol=1e-9)
+        assert abs(mom[7] - float(w @ y)) < 1e-9
+        assert abs(mom[8] - w.sum()) < 1e-12
+
+    def test_accumulate_batches_fold(self, rng):
+        x = rng.normal(size=(200, 5))
+        y = rng.normal(size=200)
+        xtx, xty, mom = bridge.linreg_accumulate(x[:90], y[:90])
+        bridge.linreg_accumulate(x[90:], y[90:], xtx=xtx, xty=xty, moments=mom)
+        xtx_all, xty_all, mom_all = bridge.linreg_accumulate(x, y)
+        np.testing.assert_allclose(xtx, xtx_all, atol=1e-10)
+        np.testing.assert_allclose(xty, xty_all, atol=1e-10)
+        np.testing.assert_allclose(mom, mom_all, atol=1e-10)
+
+    def test_solve_spd_matches_numpy(self, rng):
+        a = rng.normal(size=(10, 10))
+        spd = a @ a.T + 10 * np.eye(10)
+        b = rng.normal(size=10)
+        np.testing.assert_allclose(
+            bridge.solve_spd(spd, b), np.linalg.solve(spd, b), atol=1e-9
+        )
+
+    def test_solve_spd_rejects_indefinite(self):
+        a = np.diag([1.0, -1.0])
+        with pytest.raises(bridge.NativeBridgeError, match="code 4"):
+            bridge.solve_spd(a, np.ones(2))
+
+    def test_fit_matches_framework_estimator(self, rng):
+        from spark_rapids_ml_tpu import LinearRegression
+
+        x = rng.normal(size=(400, 6))
+        coef_true = rng.normal(size=6)
+        y = x @ coef_true + 1.5 + 0.05 * rng.normal(size=400)
+        for reg in (0.0, 0.3):
+            coef, intercept = bridge.linreg_fit_host(x, y, reg_param=reg)
+            m = LinearRegression(regParam=reg).fit((x, y))
+            np.testing.assert_allclose(coef, m.coefficients, atol=1e-7)
+            assert abs(intercept - m.intercept) < 1e-7
+
+    def test_weighted_fit_matches_duplication(self, rng):
+        x = rng.normal(size=(120, 3))
+        y = x @ np.array([1.0, -2.0, 0.5]) + 0.1 * rng.normal(size=120)
+        w = rng.integers(1, 4, size=120).astype(float)
+        coef_w, b_w = bridge.linreg_fit_host(x, y, w, reg_param=0.0)
+        rep = np.repeat(np.arange(120), w.astype(int))
+        coef_d, b_d = bridge.linreg_fit_host(x[rep], y[rep], reg_param=0.0)
+        np.testing.assert_allclose(coef_w, coef_d, atol=1e-9)
+        assert abs(b_w - b_d) < 1e-9
+
+    def test_rank_deficient_falls_back(self, rng):
+        x = rng.normal(size=(50, 2))
+        x3 = np.hstack([x, x[:, :1]])  # exactly collinear third column
+        y = x @ np.ones(2)
+        coef, intercept = bridge.linreg_fit_host(x3, y, reg_param=0.0)
+        # the min-norm solution still predicts exactly
+        np.testing.assert_allclose(x3 @ coef + intercept, y, atol=1e-6)
+
+    def test_nan_input_degrades_to_nan_like_device_path(self, rng):
+        x = rng.normal(size=(50, 3))
+        x[3, 1] = np.nan
+        coef, _ = bridge.linreg_fit_host(x, np.ones(50))
+        assert np.all(np.isnan(coef))
