@@ -50,7 +50,15 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import kmeans
 
         return getattr(kmeans, name)
-    if name in ("StandardScaler", "StandardScalerModel", "Normalizer"):
+    if name in (
+        "StandardScaler",
+        "StandardScalerModel",
+        "Normalizer",
+        "MinMaxScaler",
+        "MinMaxScalerModel",
+        "MaxAbsScaler",
+        "MaxAbsScalerModel",
+    ):
         from spark_rapids_ml_tpu.models import scaler
 
         return getattr(scaler, name)

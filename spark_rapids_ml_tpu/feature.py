@@ -11,6 +11,10 @@ becomes a one-line import swap.
 
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401
 from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    MinMaxScaler,
+    MinMaxScalerModel,
     Normalizer,
     StandardScaler,
     StandardScalerModel,
@@ -26,6 +30,10 @@ __all__ = [
     "StandardScaler",
     "StandardScalerModel",
     "Normalizer",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
+    "MaxAbsScaler",
+    "MaxAbsScalerModel",
     "TruncatedSVD",
     "TruncatedSVDModel",
 ]

@@ -27,6 +27,8 @@ from spark_rapids_ml_tpu.utils import persistence
 _SPARK_ML_CLASSES: dict[str, str] = {
     "org.apache.spark.ml.feature.PCAModel": "spark_rapids_ml_tpu.models.pca.PCAModel",
     "org.apache.spark.ml.feature.StandardScalerModel": "spark_rapids_ml_tpu.models.scaler.StandardScalerModel",
+    "org.apache.spark.ml.feature.MinMaxScalerModel": "spark_rapids_ml_tpu.models.scaler.MinMaxScalerModel",
+    "org.apache.spark.ml.feature.MaxAbsScalerModel": "spark_rapids_ml_tpu.models.scaler.MaxAbsScalerModel",
 }
 
 

@@ -27,6 +27,25 @@ _moment_stats = jax.jit(S.moment_stats)
 _finalize = jax.jit(S.finalize_moments)
 
 
+def _save_spark_ml_vectors(model, path: str, vectors: dict) -> None:
+    """One stock-layout writer for the scaler family: filtered params +
+    ordered dense-vector data row (see persistence.save_spark_ml_vector_model)."""
+    from spark_rapids_ml_tpu.models.base import spark_set_params
+    from spark_rapids_ml_tpu.utils import persistence as P
+
+    P.save_spark_ml_vector_model(
+        path,
+        class_name=model._SPARK_ML_CLASS,
+        uid=model.uid,
+        params={
+            k: v
+            for k, v in spark_set_params(model).items()
+            if k in model._SPARK_ML_PARAMS
+        },
+        vectors=vectors,
+    )
+
+
 class _ScalerParams(HasInputCol, HasOutputCol):
     withMean = Param("withMean", "center features before scaling", bool)
     withStd = Param("withStd", "scale features to unit sample std", bool)
@@ -114,31 +133,7 @@ class StandardScalerModel(_ScalerParams, Model):
     _SPARK_ML_PARAMS = ("withMean", "withStd", "inputCol", "outputCol")
 
     def _saveSparkML(self, path: str) -> None:
-        from spark_rapids_ml_tpu.models.base import spark_set_params
-        from spark_rapids_ml_tpu.utils import persistence as P
-
-        params = {
-            k: v
-            for k, v in spark_set_params(self).items()
-            if k in self._SPARK_ML_PARAMS
-        }
-        vec_field = lambda name: {  # noqa: E731 - tiny schema helper
-            "name": name,
-            "type": P._vector_udt_json(),
-            "nullable": True,
-            "metadata": {},
-        }
-        P.save_spark_ml_metadata(
-            path, class_name=self._SPARK_ML_CLASS, uid=self.uid, param_map=params
-        )
-        P.save_spark_ml_data(
-            path,
-            {
-                "std": P._dense_vector_struct(self.std),
-                "mean": P._dense_vector_struct(self.mean),
-            },
-            {"type": "struct", "fields": [vec_field("std"), vec_field("mean")]},
-        )
+        _save_spark_ml_vectors(self, path, {"std": self.std, "mean": self.mean})
 
     @classmethod
     def _fromSparkML(cls, meta: dict, table) -> "StandardScalerModel":
@@ -148,6 +143,186 @@ class StandardScalerModel(_ScalerParams, Model):
             uid=meta["uid"],
             mean=P.struct_to_vector(table.column("mean")[0].as_py()),
             std=P.struct_to_vector(table.column("std")[0].as_py()),
+        )
+
+
+_range_stats = jax.jit(S.range_stats)
+
+
+def _fit_range_stats(self, dataset: Any, num_partitions: int | None):
+    """Shared distributed fit for the range-summary scalers: one masked
+    reduction per partition, elementwise-min/max tree reduce — the same
+    monoid schedule as StandardScaler's moments."""
+    input_col = self._paramMap.get("inputCol")
+    ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
+    with trace_range("scaler range stats"):
+
+        def partition_task(mat):
+            padded, true_rows = columnar.pad_rows(mat)
+            return _range_stats(
+                jnp.asarray(padded), jnp.asarray(true_rows)
+            )
+
+        from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+
+        partials = run_partition_tasks(partition_task, list(ds.matrices()))
+        return tree_reduce(partials, S.combine_range_stats)
+
+
+class _MinMaxParams(HasInputCol, HasOutputCol):
+    min = Param("min", "lower bound of the output range", float)
+    max = Param("max", "upper bound of the output range", float)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(min=0.0, max=1.0, outputCol="scaled_features")
+
+    def getMin(self) -> float:
+        return self.getOrDefault("min")
+
+    def getMax(self) -> float:
+        return self.getOrDefault("max")
+
+
+class MinMaxScaler(_MinMaxParams, Estimator):
+    """Rescale each feature to [min, max] (Spark ``MinMaxScaler``): fit
+    learns per-feature observed E_min/E_max; constant features map to the
+    output midpoint."""
+
+    def setMin(self, value: float) -> "MinMaxScaler":
+        return self._set(min=float(value))
+
+    def setMax(self, value: float) -> "MinMaxScaler":
+        return self._set(max=float(value))
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "MinMaxScalerModel":
+        if not self.getMin() < self.getMax():
+            raise ValueError(
+                f"min={self.getMin()} must be < max={self.getMax()}"
+            )
+        stats = _fit_range_stats(self, dataset, num_partitions)
+        model = MinMaxScalerModel(
+            uid=self.uid,
+            originalMin=np.asarray(stats.min),
+            originalMax=np.asarray(stats.max),
+        )
+        return self._copyValues(model)
+
+
+class MinMaxScalerModel(_MinMaxParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        originalMin: np.ndarray | None = None,
+        originalMax: np.ndarray | None = None,
+    ):
+        super().__init__(uid)
+        self.originalMin = None if originalMin is None else np.asarray(originalMin)
+        self.originalMax = None if originalMax is None else np.asarray(originalMax)
+
+    def _scale(self, mat: np.ndarray) -> np.ndarray:
+        out = jax.jit(S.minmax_scale, static_argnames=("lo", "hi"))(
+            jnp.asarray(mat),
+            jnp.asarray(self.originalMin, dtype=mat.dtype),
+            jnp.asarray(self.originalMax, dtype=mat.dtype),
+            lo=self.getMin(),
+            hi=self.getMax(),
+        )
+        return np.asarray(out)
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("minmax transform"):
+            return columnar.apply_column_transform(
+                dataset, self._paramMap.get("inputCol"), self.getOutputCol(), self._scale
+            )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"originalMin": self.originalMin, "originalMax": self.originalMax}
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(
+            uid=uid,
+            originalMin=data["originalMin"],
+            originalMax=data["originalMax"],
+        )
+
+    # -- stock pyspark.ml interop: Row(originalMin, originalMax) ------------
+    _SPARK_ML_CLASS = "org.apache.spark.ml.feature.MinMaxScalerModel"
+    _SPARK_ML_PARAMS = ("min", "max", "inputCol", "outputCol")
+
+    def _saveSparkML(self, path: str) -> None:
+        _save_spark_ml_vectors(
+            self,
+            path,
+            {"originalMin": self.originalMin, "originalMax": self.originalMax},
+        )
+
+    @classmethod
+    def _fromSparkML(cls, meta: dict, table) -> "MinMaxScalerModel":
+        from spark_rapids_ml_tpu.utils import persistence as P
+
+        return cls(
+            uid=meta["uid"],
+            originalMin=P.struct_to_vector(table.column("originalMin")[0].as_py()),
+            originalMax=P.struct_to_vector(table.column("originalMax")[0].as_py()),
+        )
+
+
+class _MaxAbsParams(HasInputCol, HasOutputCol):
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(outputCol="scaled_features")
+
+
+class MaxAbsScaler(_MaxAbsParams, Estimator):
+    """Scale each feature to [-1, 1] by its max |x| (Spark ``MaxAbsScaler``)
+    — sparsity-preserving: no centering, zeros stay zero."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "MaxAbsScalerModel":
+        stats = _fit_range_stats(self, dataset, num_partitions)
+        model = MaxAbsScalerModel(uid=self.uid, maxAbs=np.asarray(stats.max_abs))
+        return self._copyValues(model)
+
+
+class MaxAbsScalerModel(_MaxAbsParams, Model):
+    def __init__(self, uid: str | None = None, maxAbs: np.ndarray | None = None):
+        super().__init__(uid)
+        self.maxAbs = None if maxAbs is None else np.asarray(maxAbs)
+
+    def _scale(self, mat: np.ndarray) -> np.ndarray:
+        out = jax.jit(S.maxabs_scale)(
+            jnp.asarray(mat), jnp.asarray(self.maxAbs, dtype=mat.dtype)
+        )
+        return np.asarray(out)
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("maxabs transform"):
+            return columnar.apply_column_transform(
+                dataset, self._paramMap.get("inputCol"), self.getOutputCol(), self._scale
+            )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"maxAbs": self.maxAbs}
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(uid=uid, maxAbs=data["maxAbs"])
+
+    # -- stock pyspark.ml interop: Row(maxAbs) ------------------------------
+    _SPARK_ML_CLASS = "org.apache.spark.ml.feature.MaxAbsScalerModel"
+    _SPARK_ML_PARAMS = ("inputCol", "outputCol")
+
+    def _saveSparkML(self, path: str) -> None:
+        _save_spark_ml_vectors(self, path, {"maxAbs": self.maxAbs})
+
+    @classmethod
+    def _fromSparkML(cls, meta: dict, table) -> "MaxAbsScalerModel":
+        from spark_rapids_ml_tpu.utils import persistence as P
+
+        return cls(
+            uid=meta["uid"],
+            maxAbs=P.struct_to_vector(table.column("maxAbs")[0].as_py()),
         )
 
 

@@ -77,3 +77,59 @@ def normalize(x: jax.Array, p: float = 2.0) -> jax.Array:
         norms = jnp.sum(jnp.abs(x) ** p, axis=1) ** (1.0 / p)
     safe = jnp.where(norms > 0, norms, jnp.ones_like(norms))
     return x / safe[:, None]
+
+
+class RangeStats(NamedTuple):
+    """Per-feature min / max / max-|x| — the monoid behind MinMaxScaler and
+    MaxAbsScaler (Spark computes the same summary via MultivariateOnlineSummarizer;
+    here it is one masked reduction per shard + an elementwise combine)."""
+
+    count: jax.Array  # []
+    min: jax.Array  # [n]
+    max: jax.Array  # [n]
+    max_abs: jax.Array  # [n]
+
+
+def range_stats(x: jax.Array, true_rows: jax.Array) -> RangeStats:
+    """Stats over the first ``true_rows`` rows of a (possibly zero-padded)
+    shard — pad rows must not clamp the min/max, so they are masked to
+    ±inf (and 0 for max-|x|, which zero pads cannot raise)."""
+    mask = (jnp.arange(x.shape[0]) < true_rows)[:, None]
+    inf = jnp.asarray(jnp.inf, x.dtype)
+    return RangeStats(
+        count=jnp.asarray(true_rows, x.dtype),
+        min=jnp.min(jnp.where(mask, x, inf), axis=0),
+        max=jnp.max(jnp.where(mask, x, -inf), axis=0),
+        max_abs=jnp.max(jnp.where(mask, jnp.abs(x), 0.0), axis=0),
+    )
+
+
+def combine_range_stats(a: RangeStats, b: RangeStats) -> RangeStats:
+    return RangeStats(
+        a.count + b.count,
+        jnp.minimum(a.min, b.min),
+        jnp.maximum(a.max, b.max),
+        jnp.maximum(a.max_abs, b.max_abs),
+    )
+
+
+def minmax_scale(
+    x: jax.Array,
+    original_min: jax.Array,
+    original_max: jax.Array,
+    lo: float,
+    hi: float,
+) -> jax.Array:
+    """Spark MinMaxScalerModel semantics: rescale each feature's observed
+    [E_min, E_max] onto [lo, hi]; a constant feature (zero range) maps to
+    the midpoint 0.5*(lo+hi)."""
+    span = original_max - original_min
+    safe = jnp.where(span != 0, span, 1.0)
+    raw = jnp.where(span != 0, (x - original_min) / safe, 0.5)
+    return raw * (hi - lo) + lo
+
+
+def maxabs_scale(x: jax.Array, max_abs: jax.Array) -> jax.Array:
+    """Spark MaxAbsScalerModel semantics: divide by max |x| per feature
+    (all-zero features pass through unscaled), landing in [-1, 1]."""
+    return x / jnp.where(max_abs != 0, max_abs, 1.0)

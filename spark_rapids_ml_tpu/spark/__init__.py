@@ -26,6 +26,10 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkNormalizer,
     SparkPCA,
     SparkPCAModel,
+    SparkMaxAbsScaler,
+    SparkMaxAbsScalerModel,
+    SparkMinMaxScaler,
+    SparkMinMaxScalerModel,
     SparkStandardScaler,
     SparkStandardScalerModel,
     SparkTruncatedSVD,
@@ -42,6 +46,10 @@ __all__ = [
     "SparkLinearRegressionModel",
     "SparkLogisticRegression",
     "SparkLogisticRegressionModel",
+    "SparkMaxAbsScaler",
+    "SparkMaxAbsScalerModel",
+    "SparkMinMaxScaler",
+    "SparkMinMaxScalerModel",
     "SparkStandardScaler",
     "SparkStandardScalerModel",
     "SparkTruncatedSVD",

@@ -171,34 +171,52 @@ def arrays_to_batch(arrays: dict[str, np.ndarray]) -> pa.RecordBatch:
 
 
 def arrays_from_batches(
-    batches: Iterable[pa.RecordBatch], shapes: dict[str, tuple]
+    batches: Iterable[pa.RecordBatch],
+    shapes: dict[str, tuple],
+    combine: dict[str, Callable] | None = None,
 ) -> dict[str, np.ndarray]:
-    """Sum-merge serialized stats rows back into named arrays of ``shapes``."""
-    acc = {name: np.zeros(shape) for name, shape in shapes.items()}
-    got = False
+    """Merge serialized stats rows back into named arrays of ``shapes``.
+
+    Per-field fold defaults to ``np.add`` (every additive monoid in the
+    family); ``combine`` overrides it by field — e.g. the range-summary
+    scalers fold min/max with ``np.minimum``/``np.maximum``."""
+    acc: dict[str, np.ndarray | None] = {name: None for name in shapes}
+    fold = combine or {}
     for batch in batches:
         t = pa.Table.from_batches([batch]) if isinstance(batch, pa.RecordBatch) else batch
         for i in range(t.num_rows):
-            got = True
             for name, shape in shapes.items():
                 flat = np.asarray(
                     t.column(name)[i].values.to_numpy(zero_copy_only=False)
                 )
-                acc[name] += flat.reshape(shape)
-    if not got:
+                cur = flat.reshape(shape)
+                prev = acc[name]
+                acc[name] = (
+                    cur.copy()
+                    if prev is None
+                    else fold.get(name, np.add)(prev, cur)
+                )
+    if any(v is None for v in acc.values()):
         raise ValueError("no partition statistics received")
     return acc
 
 
-def arrays_from_rows(rows: Iterable, shapes: dict[str, tuple]) -> dict[str, np.ndarray]:
+def arrays_from_rows(
+    rows: Iterable,
+    shapes: dict[str, tuple],
+    combine: dict[str, Callable] | None = None,
+) -> dict[str, np.ndarray]:
     """The PySpark <4.0 ``collect()`` fallback for ``arrays_from_batches``."""
-    acc = {name: np.zeros(shape) for name, shape in shapes.items()}
-    got = False
+    acc: dict[str, np.ndarray | None] = {name: None for name in shapes}
+    fold = combine or {}
     for r in rows:
-        got = True
         for name, shape in shapes.items():
-            acc[name] += np.asarray(r[name], dtype=np.float64).reshape(shape)
-    if not got:
+            cur = np.asarray(r[name], dtype=np.float64).reshape(shape)
+            prev = acc[name]
+            acc[name] = (
+                cur.copy() if prev is None else fold.get(name, np.add)(prev, cur)
+            )
+    if any(v is None for v in acc.values()):
         raise ValueError("no partition statistics received")
     return acc
 
@@ -740,6 +758,28 @@ class MomentsPartitionFn(_StatsAccumulatorFn):
         return S.combine_moment_stats(a, b)
 
 
+class RangeStatsPartitionFn(_StatsAccumulatorFn):
+    """mapInArrow body for the range-summary scalers (MinMax/MaxAbs): the
+    per-feature min/max/max-|x| monoid with zero-pad masking."""
+
+    def __init__(self, input_col: str):
+        self.input_col = input_col
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        mat = columnar.extract_matrix(batch, self.input_col)
+        pm, true_rows = columnar.pad_rows(mat)
+        return S.range_stats(jnp.asarray(pm), jnp.asarray(true_rows))
+
+    def _combine(self, a, b):
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        return S.combine_range_stats(a, b)
+
+
 class MatrixMapPartitionFn:
     """Generic mapInArrow transform body: apply ``matrix_fn`` to the input
     column's [rows, n] matrix and append the result — a float64 list column
@@ -925,6 +965,37 @@ def make_kmeans_partition_fn(
 
 def make_moments_partition_fn(input_col: str):
     return MomentsPartitionFn(input_col)
+
+
+def make_range_stats_partition_fn(input_col: str):
+    return RangeStatsPartitionFn(input_col)
+
+
+RANGE_STATS_FIELDS = ["count", "min", "max", "max_abs"]
+
+
+def range_stats_shapes(n: int) -> dict[str, tuple]:
+    return {"count": (), "min": (n,), "max": (n,), "max_abs": (n,)}
+
+
+_RANGE_COMBINE = {"min": np.minimum, "max": np.maximum, "max_abs": np.maximum}
+
+
+def range_stats_from_batches(batches: Iterable[pa.RecordBatch], n: int):
+    """Merge per-partition RangeStats rows — count sums, the rest fold by
+    elementwise min/max (the one non-additive monoid in the family)."""
+    from spark_rapids_ml_tpu.ops import scaler as S
+
+    arr = arrays_from_batches(batches, range_stats_shapes(n), _RANGE_COMBINE)
+    return S.RangeStats(arr["count"], arr["min"], arr["max"], arr["max_abs"])
+
+
+def range_stats_from_rows(rows: Iterable, n: int):
+    """Row-object variant (pyspark < 4.0 ``collect()``)."""
+    from spark_rapids_ml_tpu.ops import scaler as S
+
+    arr = arrays_from_rows(rows, range_stats_shapes(n), _RANGE_COMBINE)
+    return S.RangeStats(arr["count"], arr["min"], arr["max"], arr["max_abs"])
 
 
 def make_matrix_map_partition_fn(

@@ -34,6 +34,10 @@ from spark_rapids_ml_tpu.models.linear import (
 )
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
 from spark_rapids_ml_tpu.models.scaler import (
+    MaxAbsScaler,
+    MaxAbsScalerModel,
+    MinMaxScaler,
+    MinMaxScalerModel,
     Normalizer,
     StandardScaler,
     StandardScalerModel,
@@ -1496,6 +1500,89 @@ class SparkStandardScalerModel(StandardScalerModel):
         return _spark_transform(
             self, dataset, self._scale, self.getOutputCol(), scalar=False
         )
+
+class SparkMinMaxScaler(_HasDistribution, MinMaxScaler):
+    """MinMaxScaler over pyspark DataFrames: one mapInArrow range-stats pass
+    per fit; the driver folds the per-partition rows with the min/max monoid
+    (the one non-additive statistic in the family, so the merge is its own —
+    ``arrow_fns.range_stats_from_batches``)."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkMinMaxScalerModel(
+                uid=core.uid,
+                originalMin=core.originalMin,
+                originalMax=core.originalMax,
+            )
+            return self._copyValues(model)
+        if not self.getMin() < self.getMax():
+            raise ValueError(
+                f"min={self.getMin()} must be < max={self.getMax()}"
+            )
+        stats = _collect_range_stats(self, dataset)
+        model = SparkMinMaxScalerModel(
+            uid=self.uid,
+            originalMin=np.asarray(stats.min),
+            originalMax=np.asarray(stats.max),
+        )
+        return self._copyValues(model)
+
+
+class SparkMinMaxScalerModel(MinMaxScalerModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._scale, self.getOutputCol(), scalar=False
+        )
+
+
+class SparkMaxAbsScaler(_HasDistribution, MaxAbsScaler):
+    """MaxAbsScaler over pyspark DataFrames (same range-stats pass)."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkMaxAbsScalerModel(uid=core.uid, maxAbs=core.maxAbs)
+            return self._copyValues(model)
+        stats = _collect_range_stats(self, dataset)
+        model = SparkMaxAbsScalerModel(
+            uid=self.uid, maxAbs=np.asarray(stats.max_abs)
+        )
+        return self._copyValues(model)
+
+
+class SparkMaxAbsScalerModel(MaxAbsScalerModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._scale, self.getOutputCol(), scalar=False
+        )
+
+
+def _collect_range_stats(est, dataset):
+    """One mapInArrow range-stats pass + min/max driver fold."""
+    input_col = _resolve_col(est, "inputCol") or "features"
+    n = _infer_n(dataset, input_col)
+    with trace_range("scaler range stats"):
+        selected = dataset.select(input_col)
+        T, _ = _sql_mods(selected)
+        stats_df = selected.mapInArrow(
+            arrow_fns.make_range_stats_partition_fn(input_col),
+            schema=_spark_arrays_type(T, arrow_fns.RANGE_STATS_FIELDS),
+        )
+        if hasattr(stats_df, "toArrow"):
+            return arrow_fns.range_stats_from_batches(
+                stats_df.toArrow().to_batches(), n
+            )
+        return arrow_fns.range_stats_from_rows(stats_df.collect(), n)
+
 
 # ---------------------------------------------------------------------------
 # TruncatedSVD / Normalizer

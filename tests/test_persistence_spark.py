@@ -295,3 +295,53 @@ class TestStockSparkMLLoadsOurSaves:
         np.testing.assert_allclose(
             ours.pc, np.asarray(stock.pc.toArray()), atol=1e-12
         )
+
+    def test_stock_minmax_scaler_model_loads_ours(self, spark, tmp_path):
+        from pyspark.ml.feature import MinMaxScalerModel as StockMinMax
+        from pyspark.ml.linalg import Vectors
+
+        from spark_rapids_ml_tpu.models.scaler import MinMaxScaler
+
+        rng = np.random.default_rng(5)
+        x = rng.uniform(1.0, 9.0, size=(80, 4))
+        ours = (
+            MinMaxScaler()
+            .setInputCol("features")
+            .setOutputCol("scaled")
+            .setMax(2.0)
+            .fit(x)
+        )
+        p = str(tmp_path / "mm")
+        ours.save(p, layout="spark")
+        stock = StockMinMax.load(p)
+        np.testing.assert_allclose(
+            np.asarray(stock.originalMin.toArray()), ours.originalMin, atol=1e-12
+        )
+        np.testing.assert_allclose(
+            np.asarray(stock.originalMax.toArray()), ours.originalMax, atol=1e-12
+        )
+        assert stock.getMax() == 2.0
+        df = spark.createDataFrame(
+            [(Vectors.dense(row),) for row in x], ["features"]
+        )
+        got = np.asarray(
+            [r["scaled"].toArray() for r in stock.transform(df).collect()]
+        )
+        np.testing.assert_allclose(
+            np.sort(got, 0), np.sort(ours.transform(x), 0), atol=1e-9
+        )
+
+    def test_stock_maxabs_scaler_model_loads_ours(self, spark, tmp_path):
+        from pyspark.ml.feature import MaxAbsScalerModel as StockMaxAbs
+
+        from spark_rapids_ml_tpu.models.scaler import MaxAbsScaler
+
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(60, 3)) * 4
+        ours = MaxAbsScaler().setInputCol("features").fit(x)
+        p = str(tmp_path / "ma")
+        ours.save(p, layout="spark")
+        stock = StockMaxAbs.load(p)
+        np.testing.assert_allclose(
+            np.asarray(stock.maxAbs.toArray()), ours.maxAbs, atol=1e-12
+        )

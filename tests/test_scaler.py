@@ -71,3 +71,108 @@ class TestNormalizer:
         out = Normalizer().setInputCol("f").transform(x)
         np.testing.assert_array_equal(out[0], [0.0, 0.0])
         np.testing.assert_allclose(out[1], [0.6, 0.8], rtol=1e-9)
+
+
+class TestMinMaxScaler:
+    def test_matches_sklearn(self, data):
+        from sklearn.preprocessing import MinMaxScaler as SkMinMax
+
+        from spark_rapids_ml_tpu.models.scaler import MinMaxScaler
+
+        model = MinMaxScaler().setInputCol("f").fit(data, num_partitions=3)
+        out = model.transform(data)
+        want = SkMinMax().fit_transform(data)
+        np.testing.assert_allclose(out, want, atol=1e-12)
+        np.testing.assert_allclose(model.originalMin, data.min(axis=0))
+        np.testing.assert_allclose(model.originalMax, data.max(axis=0))
+
+    def test_custom_range(self, data):
+        from spark_rapids_ml_tpu.models.scaler import MinMaxScaler
+
+        model = (
+            MinMaxScaler().setInputCol("f").setMin(-2.0).setMax(3.0).fit(data)
+        )
+        out = model.transform(data)
+        assert out.min() >= -2.0 - 1e-12 and out.max() <= 3.0 + 1e-12
+        np.testing.assert_allclose(out.min(axis=0), -2.0, atol=1e-12)
+        np.testing.assert_allclose(out.max(axis=0), 3.0, atol=1e-12)
+
+    def test_constant_feature_maps_to_midpoint(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import MinMaxScaler
+
+        x = rng.normal(size=(50, 3))
+        x[:, 1] = 7.0
+        out = MinMaxScaler().setInputCol("f").fit(x).transform(x)
+        np.testing.assert_allclose(out[:, 1], 0.5)  # 0.5*(0+1)
+
+    def test_positive_data_multi_partition_pads_do_not_pollute(self, rng):
+        # all-positive data: a zero pad row would fake a 0.0 minimum if the
+        # pad mask were missing (the bug class range_stats masks against)
+        from spark_rapids_ml_tpu.models.scaler import MinMaxScaler
+
+        x = rng.uniform(5.0, 9.0, size=(257, 4))  # odd size: ragged buckets
+        model = MinMaxScaler().setInputCol("f").fit(x, num_partitions=4)
+        np.testing.assert_allclose(model.originalMin, x.min(axis=0))
+        m1 = MinMaxScaler().setInputCol("f").fit(x, num_partitions=1)
+        np.testing.assert_allclose(model.originalMin, m1.originalMin)
+        np.testing.assert_allclose(model.originalMax, m1.originalMax)
+
+    def test_bad_range_rejected(self, data):
+        from spark_rapids_ml_tpu.models.scaler import MinMaxScaler
+
+        with pytest.raises(ValueError, match="must be <"):
+            MinMaxScaler().setInputCol("f").setMin(1.0).setMax(1.0).fit(data)
+
+    def test_persistence_roundtrip_both_layouts(self, data, tmp_path):
+        from spark_rapids_ml_tpu.models.scaler import (
+            MinMaxScaler,
+            MinMaxScalerModel,
+        )
+
+        model = MinMaxScaler().setInputCol("f").setMax(2.0).fit(data)
+        model.save(tmp_path / "native")
+        loaded = MinMaxScalerModel.load(tmp_path / "native")
+        np.testing.assert_array_equal(loaded.originalMin, model.originalMin)
+        assert loaded.getMax() == 2.0
+        model.save(tmp_path / "spark", layout="spark")
+        loaded2 = MinMaxScalerModel.load(str(tmp_path / "spark"))
+        np.testing.assert_array_equal(loaded2.originalMax, model.originalMax)
+        np.testing.assert_allclose(
+            loaded2.transform(data), model.transform(data), atol=0
+        )
+
+
+class TestMaxAbsScaler:
+    def test_matches_sklearn(self, data):
+        from sklearn.preprocessing import MaxAbsScaler as SkMaxAbs
+
+        from spark_rapids_ml_tpu.models.scaler import MaxAbsScaler
+
+        model = MaxAbsScaler().setInputCol("f").fit(data, num_partitions=3)
+        np.testing.assert_allclose(
+            model.transform(data), SkMaxAbs().fit_transform(data), atol=1e-12
+        )
+
+    def test_zero_feature_passes_through(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import MaxAbsScaler
+
+        x = rng.normal(size=(40, 3))
+        x[:, 2] = 0.0
+        out = MaxAbsScaler().setInputCol("f").fit(x).transform(x)
+        np.testing.assert_array_equal(out[:, 2], 0.0)
+        assert np.abs(out).max() <= 1.0 + 1e-12
+
+    def test_persistence_roundtrip_both_layouts(self, data, tmp_path):
+        from spark_rapids_ml_tpu.models.scaler import (
+            MaxAbsScaler,
+            MaxAbsScalerModel,
+        )
+
+        model = MaxAbsScaler().setInputCol("f").fit(data)
+        model.save(tmp_path / "native")
+        np.testing.assert_array_equal(
+            MaxAbsScalerModel.load(tmp_path / "native").maxAbs, model.maxAbs
+        )
+        model.save(tmp_path / "spark", layout="spark")
+        loaded = MaxAbsScalerModel.load(str(tmp_path / "spark"))
+        np.testing.assert_array_equal(loaded.maxAbs, model.maxAbs)

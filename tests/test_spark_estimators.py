@@ -309,3 +309,38 @@ class TestWrapperFallThrough:
         )
         cvm = cv.fit((x, y, None))
         assert cvm.avgMetrics[0] < 0.1
+
+
+class TestRangeStatsPlan:
+    def test_partition_rows_fold_with_min_max_monoid(self, rng):
+        from spark_rapids_ml_tpu.spark.estimators import (
+            SparkMaxAbsScaler,
+            SparkMinMaxScaler,
+        )
+
+        # all-positive data across RAGGED partitions: a sum-merge or an
+        # unmasked pad would corrupt the min; the fold must be min/max
+        x = rng.uniform(2.0, 9.0, size=(231, 6))
+        fn = arrow_fns.make_range_stats_partition_fn("features")
+        batches = [
+            pa.RecordBatch.from_arrays(
+                [pa.FixedSizeListArray.from_arrays(pa.array(c.reshape(-1)), 6)],
+                names=["features"],
+            )
+            for c in (x[:97], x[97:])
+        ]
+        # two separate partition invocations -> two stats rows to fold
+        rows = list(fn(iter(batches[:1]))) + list(fn(iter(batches[1:])))
+        stats = arrow_fns.range_stats_from_batches(rows, 6)
+        np.testing.assert_allclose(np.asarray(stats.min), x.min(0), atol=0)
+        np.testing.assert_allclose(np.asarray(stats.max), x.max(0), atol=0)
+        np.testing.assert_allclose(
+            np.asarray(stats.max_abs), np.abs(x).max(0), atol=0
+        )
+        assert float(np.asarray(stats.count)) == 231
+
+        # wrapper fall-through on local data matches the core estimators
+        m = SparkMinMaxScaler().setInputCol("f").fit(x)
+        np.testing.assert_allclose(m.originalMin, x.min(0))
+        out = SparkMaxAbsScaler().setInputCol("f").fit(x).transform(x)
+        np.testing.assert_allclose(out, x / np.abs(x).max(0), atol=1e-12)

@@ -1108,3 +1108,55 @@ class TestKMeansMeshLocalParallelInit:
             mesh_model.clusterCenters[:, None, :] - anchors[None, :, :], axis=2
         )
         assert d.min(axis=0).max() < 2.0
+
+
+class TestRangeScalersIntegration:
+    """MinMax/MaxAbs scalers through live mapInArrow — the min/max monoid
+    rides the same stats-row plumbing but folds with its OWN driver merge
+    (sum-merge would corrupt it)."""
+
+    def test_minmax_fit_transform_differential(self, backend):
+        from spark_rapids_ml_tpu.spark import SparkMinMaxScaler
+
+        rng = np.random.default_rng(61)
+        x = rng.uniform(3.0, 11.0, size=(240, 5))  # positive: pads would fake min=0
+        df = backend.df(
+            [(row.tolist(),) for row in x],
+            backend.features_schema(),
+            partitions=4,
+        )
+        model = (
+            SparkMinMaxScaler()
+            .setInputCol("features")
+            .setOutputCol("scaled")
+            .setMin(-1.0)
+            .setMax(1.0)
+            .fit(df)
+        )
+        np.testing.assert_allclose(model.originalMin, x.min(0), atol=1e-12)
+        np.testing.assert_allclose(model.originalMax, x.max(0), atol=1e-12)
+        rows = model.transform(df).collect()
+        got = np.asarray([r["scaled"] for r in rows])
+        span = x.max(0) - x.min(0)
+        want = (x - x.min(0)) / span * 2.0 - 1.0
+        np.testing.assert_allclose(np.sort(got, 0), np.sort(want, 0), atol=1e-9)
+
+    def test_maxabs_fit_transform_differential(self, backend):
+        from spark_rapids_ml_tpu.spark import SparkMaxAbsScaler
+
+        rng = np.random.default_rng(62)
+        x = rng.normal(size=(180, 4)) * 7
+        df = backend.df(
+            [(row.tolist(),) for row in x],
+            backend.features_schema(),
+            partitions=3,
+        )
+        model = (
+            SparkMaxAbsScaler().setInputCol("features").setOutputCol("s").fit(df)
+        )
+        np.testing.assert_allclose(model.maxAbs, np.abs(x).max(0), atol=1e-12)
+        rows = model.transform(df).collect()
+        got = np.asarray([r["s"] for r in rows])
+        np.testing.assert_allclose(
+            np.sort(got, 0), np.sort(x / np.abs(x).max(0), 0), atol=1e-9
+        )
