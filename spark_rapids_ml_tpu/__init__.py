@@ -58,6 +58,9 @@ def __getattr__(name):
         "MinMaxScalerModel",
         "MaxAbsScaler",
         "MaxAbsScalerModel",
+        "Binarizer",
+        "RobustScaler",
+        "RobustScalerModel",
     ):
         from spark_rapids_ml_tpu.models import scaler
 

@@ -11,11 +11,14 @@ becomes a one-line import swap.
 
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401
 from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
+    Binarizer,
     MaxAbsScaler,
     MaxAbsScalerModel,
     MinMaxScaler,
     MinMaxScalerModel,
     Normalizer,
+    RobustScaler,
+    RobustScalerModel,
     StandardScaler,
     StandardScalerModel,
 )
@@ -34,6 +37,9 @@ __all__ = [
     "MinMaxScalerModel",
     "MaxAbsScaler",
     "MaxAbsScalerModel",
+    "Binarizer",
+    "RobustScaler",
+    "RobustScalerModel",
     "TruncatedSVD",
     "TruncatedSVDModel",
 ]

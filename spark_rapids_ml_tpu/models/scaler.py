@@ -25,6 +25,17 @@ from spark_rapids_ml_tpu.utils.tracing import trace_range
 
 _moment_stats = jax.jit(S.moment_stats)
 _finalize = jax.jit(S.finalize_moments)
+# transform kernels hoisted once per process (the repo's jit-caching
+# convention): a per-call jax.jit wrapper would retrace on every Arrow
+# batch in the Spark mapInArrow transform path
+_standardize = jax.jit(S.standardize, static_argnames=("with_mean", "with_std"))
+_minmax_scale = jax.jit(S.minmax_scale, static_argnames=("lo", "hi"))
+_maxabs_scale = jax.jit(S.maxabs_scale)
+_robust_scale = jax.jit(
+    S.robust_scale, static_argnames=("with_centering", "with_scaling")
+)
+_binarize = jax.jit(S.binarize, static_argnames=("threshold",))
+_normalize = jax.jit(S.normalize, static_argnums=(1,))
 
 
 def _save_spark_ml_vectors(model, path: str, vectors: dict) -> None:
@@ -103,9 +114,7 @@ class StandardScalerModel(_ScalerParams, Model):
         self.std = None if std is None else np.asarray(std)
 
     def _scale(self, mat: np.ndarray) -> np.ndarray:
-        out = jax.jit(
-            S.standardize, static_argnames=("with_mean", "with_std")
-        )(
+        out = _standardize(
             jnp.asarray(mat),
             jnp.asarray(self.mean, dtype=mat.dtype),
             jnp.asarray(self.std, dtype=mat.dtype),
@@ -183,6 +192,12 @@ class _MinMaxParams(HasInputCol, HasOutputCol):
     def getMax(self) -> float:
         return self.getOrDefault("max")
 
+    def _check_range(self) -> None:
+        if not self.getMin() < self.getMax():
+            raise ValueError(
+                f"min={self.getMin()} must be < max={self.getMax()}"
+            )
+
 
 class MinMaxScaler(_MinMaxParams, Estimator):
     """Rescale each feature to [min, max] (Spark ``MinMaxScaler``): fit
@@ -196,10 +211,7 @@ class MinMaxScaler(_MinMaxParams, Estimator):
         return self._set(max=float(value))
 
     def fit(self, dataset: Any, num_partitions: int | None = None) -> "MinMaxScalerModel":
-        if not self.getMin() < self.getMax():
-            raise ValueError(
-                f"min={self.getMin()} must be < max={self.getMax()}"
-            )
+        self._check_range()
         stats = _fit_range_stats(self, dataset, num_partitions)
         model = MinMaxScalerModel(
             uid=self.uid,
@@ -221,7 +233,7 @@ class MinMaxScalerModel(_MinMaxParams, Model):
         self.originalMax = None if originalMax is None else np.asarray(originalMax)
 
     def _scale(self, mat: np.ndarray) -> np.ndarray:
-        out = jax.jit(S.minmax_scale, static_argnames=("lo", "hi"))(
+        out = _minmax_scale(
             jnp.asarray(mat),
             jnp.asarray(self.originalMin, dtype=mat.dtype),
             jnp.asarray(self.originalMax, dtype=mat.dtype),
@@ -291,7 +303,7 @@ class MaxAbsScalerModel(_MaxAbsParams, Model):
         self.maxAbs = None if maxAbs is None else np.asarray(maxAbs)
 
     def _scale(self, mat: np.ndarray) -> np.ndarray:
-        out = jax.jit(S.maxabs_scale)(
+        out = _maxabs_scale(
             jnp.asarray(mat), jnp.asarray(self.maxAbs, dtype=mat.dtype)
         )
         return np.asarray(out)
@@ -344,11 +356,7 @@ class Normalizer(HasInputCol, HasOutputCol, Transformer):
     def _normalize_matrix(self, mat: np.ndarray) -> np.ndarray:
         """[rows, n] → row-p-normalized [rows, n]; the one matrix fn both the
         local and the Spark (mapInArrow) transform paths run."""
-        return np.asarray(
-            jax.jit(S.normalize, static_argnums=(1,))(
-                jnp.asarray(mat), self.getP()
-            )
-        )
+        return np.asarray(_normalize(jnp.asarray(mat), self.getP()))
 
     def transform(self, dataset: Any) -> Any:
         with trace_range("normalize"):
@@ -358,3 +366,204 @@ class Normalizer(HasInputCol, HasOutputCol, Transformer):
                 self.getOutputCol(),
                 self._normalize_matrix,
             )
+
+
+class Binarizer(HasInputCol, HasOutputCol, Transformer):
+    """Stateless thresholding (Spark ``Binarizer`` semantics): 1.0 where
+    x > threshold, else 0.0 — strict inequality, like Spark's."""
+
+    threshold = Param("threshold", "binarization threshold (strict >)", float)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(threshold=0.0, outputCol="binarized_features")
+
+    def setThreshold(self, value: float) -> "Binarizer":
+        return self._set(threshold=float(value))
+
+    def getThreshold(self) -> float:
+        return self.getOrDefault("threshold")
+
+    def _binarize(self, mat: np.ndarray) -> np.ndarray:
+        out = _binarize(jnp.asarray(mat), threshold=self.getThreshold())
+        return np.asarray(out)
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("binarize"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                self._binarize,
+            )
+
+
+_histogram_stats = jax.jit(S.histogram_stats, static_argnames=("bins",))
+_quantile = jax.jit(S.quantile_from_histogram, static_argnames=())
+
+
+class _RobustParams(HasInputCol, HasOutputCol):
+    lower = Param("lower", "lower quantile of the scaling range", float)
+    upper = Param("upper", "upper quantile of the scaling range", float)
+    withCentering = Param("withCentering", "subtract the median", bool)
+    withScaling = Param("withScaling", "divide by the quantile range", bool)
+    numBins = Param(
+        "numBins",
+        "histogram resolution of the distributed quantile sketch "
+        "(value-resolution error = feature range / numBins)",
+        int,
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            lower=0.25,
+            upper=0.75,
+            withCentering=False,
+            withScaling=True,
+            numBins=4096,
+            outputCol="scaled_features",
+        )
+
+    def getLower(self) -> float:
+        return self.getOrDefault("lower")
+
+    def getUpper(self) -> float:
+        return self.getOrDefault("upper")
+
+    def getWithCentering(self) -> bool:
+        return self.getOrDefault("withCentering")
+
+    def getWithScaling(self) -> bool:
+        return self.getOrDefault("withScaling")
+
+    def getNumBins(self) -> int:
+        return self.getOrDefault("numBins")
+
+    def _check_quantile_bounds(self) -> None:
+        if not 0.0 <= self.getLower() < self.getUpper() <= 1.0:
+            raise ValueError(
+                f"need 0 <= lower < upper <= 1, got "
+                f"[{self.getLower()}, {self.getUpper()}]"
+            )
+
+
+class RobustScaler(_RobustParams, Estimator):
+    """Quantile-based scaling (Spark ``RobustScaler`` surface: lower/upper
+    default [0.25, 0.75], withCentering=False, withScaling=True).
+
+    Distributed fit is TWO monoid passes, both mesh-reducible: the
+    min/max range pass, then a per-feature fixed-bin histogram
+    (``ops.scaler.histogram_stats`` — one scatter-add per column, additive
+    across partitions) from which median and quantile range interpolate.
+    Spark bounds quantile RANK error (approxQuantile's relativeError);
+    this sketch bounds quantile VALUE error at range/numBins — a
+    TPU-shaped trade (static shapes, no sorting) documented on the param.
+    """
+
+    def setLower(self, value: float) -> "RobustScaler":
+        return self._set(lower=float(value))
+
+    def setUpper(self, value: float) -> "RobustScaler":
+        return self._set(upper=float(value))
+
+    def setWithCentering(self, value: bool) -> "RobustScaler":
+        return self._set(withCentering=bool(value))
+
+    def setWithScaling(self, value: bool) -> "RobustScaler":
+        return self._set(withScaling=bool(value))
+
+    def setNumBins(self, value: int) -> "RobustScaler":
+        if value < 2:
+            raise ValueError(f"numBins must be >= 2, got {value}")
+        return self._set(numBins=int(value))
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "RobustScalerModel":
+        self._check_quantile_bounds()
+        input_col = self._paramMap.get("inputCol")
+        rstats = _fit_range_stats(self, dataset, num_partitions)
+        mins = jnp.asarray(rstats.min)
+        maxs = jnp.asarray(rstats.max)
+        bins = self.getNumBins()
+        ds = columnar.PartitionedDataset.from_any(
+            dataset, input_col, num_partitions
+        )
+        with trace_range("robust scaler histogram"):
+
+            def partition_task(mat):
+                padded, true_rows = columnar.pad_rows(mat)
+                return _histogram_stats(
+                    jnp.asarray(padded),
+                    jnp.asarray(true_rows),
+                    mins,
+                    maxs,
+                    bins=bins,
+                )
+
+            from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+
+            partials = run_partition_tasks(partition_task, list(ds.matrices()))
+            hist = tree_reduce(partials, lambda a, b: a + b)
+        median = np.asarray(_quantile(hist, mins, maxs, 0.5))
+        lo = np.asarray(_quantile(hist, mins, maxs, self.getLower()))
+        hi = np.asarray(_quantile(hist, mins, maxs, self.getUpper()))
+        model = RobustScalerModel(
+            uid=self.uid, median=median, range=hi - lo
+        )
+        return self._copyValues(model)
+
+
+class RobustScalerModel(_RobustParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        median: np.ndarray | None = None,
+        range: np.ndarray | None = None,
+    ):
+        super().__init__(uid)
+        self.median = None if median is None else np.asarray(median)
+        self.range = None if range is None else np.asarray(range)
+
+    def _scale(self, mat: np.ndarray) -> np.ndarray:
+        out = _robust_scale(
+            jnp.asarray(mat),
+            jnp.asarray(self.median, dtype=mat.dtype),
+            jnp.asarray(self.range, dtype=mat.dtype),
+            with_centering=self.getWithCentering(),
+            with_scaling=self.getWithScaling(),
+        )
+        return np.asarray(out)
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("robust transform"):
+            return columnar.apply_column_transform(
+                dataset, self._paramMap.get("inputCol"), self.getOutputCol(), self._scale
+            )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"median": self.median, "range": self.range}
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(uid=uid, median=data["median"], range=data["range"])
+
+    # -- stock pyspark.ml interop: Row(range, median) -----------------------
+    _SPARK_ML_CLASS = "org.apache.spark.ml.feature.RobustScalerModel"
+    _SPARK_ML_PARAMS = (
+        "lower", "upper", "withCentering", "withScaling", "inputCol", "outputCol",
+    )
+
+    def _saveSparkML(self, path: str) -> None:
+        _save_spark_ml_vectors(
+            self, path, {"range": self.range, "median": self.median}
+        )
+
+    @classmethod
+    def _fromSparkML(cls, meta: dict, table) -> "RobustScalerModel":
+        from spark_rapids_ml_tpu.utils import persistence as P
+
+        return cls(
+            uid=meta["uid"],
+            median=P.struct_to_vector(table.column("median")[0].as_py()),
+            range=P.struct_to_vector(table.column("range")[0].as_py()),
+        )

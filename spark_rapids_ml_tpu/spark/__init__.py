@@ -29,6 +29,8 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkMaxAbsScaler,
     SparkMaxAbsScalerModel,
     SparkMinMaxScaler,
+    SparkRobustScaler,
+    SparkRobustScalerModel,
     SparkMinMaxScalerModel,
     SparkStandardScaler,
     SparkStandardScalerModel,
@@ -49,6 +51,8 @@ __all__ = [
     "SparkMaxAbsScaler",
     "SparkMaxAbsScalerModel",
     "SparkMinMaxScaler",
+    "SparkRobustScaler",
+    "SparkRobustScalerModel",
     "SparkMinMaxScalerModel",
     "SparkStandardScaler",
     "SparkStandardScalerModel",

@@ -34,7 +34,7 @@ instead of JVM serialization.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Iterable, Iterator
+from typing import Callable, Iterable, Iterator, NamedTuple
 
 import numpy as np
 import pyarrow as pa
@@ -780,6 +780,42 @@ class RangeStatsPartitionFn(_StatsAccumulatorFn):
         return S.combine_range_stats(a, b)
 
 
+class HistStats(NamedTuple):
+    hist: object  # [n, bins] per-feature counts
+
+
+class HistogramPartitionFn(_StatsAccumulatorFn):
+    """mapInArrow body for RobustScaler's quantile sketch: per-feature
+    fixed-bin histogram over driver-supplied [mins, maxs] (from the range
+    pass). Additive — the generic sum-merge decoders fold it."""
+
+    def __init__(self, input_col: str, mins, maxs, bins: int):
+        self.input_col = input_col
+        self.mins = np.asarray(mins, dtype=np.float64)
+        self.maxs = np.asarray(maxs, dtype=np.float64)
+        self.bins = int(bins)
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        mat = columnar.extract_matrix(batch, self.input_col)
+        pm, true_rows = columnar.pad_rows(mat)
+        return HistStats(
+            S.histogram_stats(
+                jnp.asarray(pm),
+                jnp.asarray(true_rows),
+                jnp.asarray(self.mins),
+                jnp.asarray(self.maxs),
+                bins=self.bins,
+            )
+        )
+
+    def _combine(self, a, b):
+        return HistStats(a.hist + b.hist)
+
+
 class MatrixMapPartitionFn:
     """Generic mapInArrow transform body: apply ``matrix_fn`` to the input
     column's [rows, n] matrix and append the result — a float64 list column
@@ -978,7 +1014,7 @@ def range_stats_shapes(n: int) -> dict[str, tuple]:
     return {"count": (), "min": (n,), "max": (n,), "max_abs": (n,)}
 
 
-_RANGE_COMBINE = {"min": np.minimum, "max": np.maximum, "max_abs": np.maximum}
+RANGE_COMBINE = {"min": np.minimum, "max": np.maximum, "max_abs": np.maximum}
 
 
 def range_stats_from_batches(batches: Iterable[pa.RecordBatch], n: int):
@@ -986,7 +1022,7 @@ def range_stats_from_batches(batches: Iterable[pa.RecordBatch], n: int):
     elementwise min/max (the one non-additive monoid in the family)."""
     from spark_rapids_ml_tpu.ops import scaler as S
 
-    arr = arrays_from_batches(batches, range_stats_shapes(n), _RANGE_COMBINE)
+    arr = arrays_from_batches(batches, range_stats_shapes(n), RANGE_COMBINE)
     return S.RangeStats(arr["count"], arr["min"], arr["max"], arr["max_abs"])
 
 
@@ -994,7 +1030,7 @@ def range_stats_from_rows(rows: Iterable, n: int):
     """Row-object variant (pyspark < 4.0 ``collect()``)."""
     from spark_rapids_ml_tpu.ops import scaler as S
 
-    arr = arrays_from_rows(rows, range_stats_shapes(n), _RANGE_COMBINE)
+    arr = arrays_from_rows(rows, range_stats_shapes(n), RANGE_COMBINE)
     return S.RangeStats(arr["count"], arr["min"], arr["max"], arr["max_abs"])
 
 

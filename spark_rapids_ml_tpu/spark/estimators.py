@@ -35,6 +35,8 @@ from spark_rapids_ml_tpu.models.linear import (
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
 from spark_rapids_ml_tpu.models.scaler import (
     MaxAbsScaler,
+    RobustScaler,
+    RobustScalerModel,
     MaxAbsScalerModel,
     MinMaxScaler,
     MinMaxScalerModel,
@@ -390,14 +392,20 @@ def _mesh_gram_arrays(selected, input_col: str, precision: str, n: int) -> dict:
     )
 
 
-def _collect_stats(df, partition_fn, fields: list[str], shapes: dict[str, tuple]):
-    """Run a stats mapInArrow pass and sum-merge the per-partition rows on
-    the driver (toArrow on PySpark >= 4, collect() fallback below)."""
+def _collect_stats(
+    df, partition_fn, fields: list[str], shapes: dict[str, tuple], combine=None
+):
+    """Run a stats mapInArrow pass and fold the per-partition rows on the
+    driver (toArrow on PySpark >= 4, collect() fallback below). The fold is
+    per-field np.add unless ``combine`` overrides it (the range scalers'
+    min/max monoid)."""
     T, _ = _sql_mods(df)
     stats_df = df.mapInArrow(partition_fn, schema=_spark_arrays_type(T, fields))
     if hasattr(stats_df, "toArrow"):
-        return arrow_fns.arrays_from_batches(stats_df.toArrow().to_batches(), shapes)
-    return arrow_fns.arrays_from_rows(stats_df.collect(), shapes)
+        return arrow_fns.arrays_from_batches(
+            stats_df.toArrow().to_batches(), shapes, combine
+        )
+    return arrow_fns.arrays_from_rows(stats_df.collect(), shapes, combine)
 
 
 def _resolve_col(obj, *names) -> str | None:
@@ -1518,10 +1526,7 @@ class SparkMinMaxScaler(_HasDistribution, MinMaxScaler):
                 originalMax=core.originalMax,
             )
             return self._copyValues(model)
-        if not self.getMin() < self.getMax():
-            raise ValueError(
-                f"min={self.getMin()} must be < max={self.getMax()}"
-            )
+        self._check_range()
         stats = _collect_range_stats(self, dataset)
         model = SparkMinMaxScalerModel(
             uid=self.uid,
@@ -1566,22 +1571,75 @@ class SparkMaxAbsScalerModel(MaxAbsScalerModel):
         )
 
 
+class SparkRobustScaler(_HasDistribution, RobustScaler):
+    """RobustScaler over pyspark DataFrames: the range pass then the
+    histogram pass, each one mapInArrow job; the histogram monoid is
+    additive so the generic sum-merge decoders fold it."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkRobustScalerModel(
+                uid=core.uid, median=core.median, range=core.range
+            )
+            return self._copyValues(model)
+        self._check_quantile_bounds()
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        input_col = _resolve_col(self, "inputCol") or "features"
+        n = _infer_n(dataset, input_col)
+        rstats = _collect_range_stats(self, dataset)
+        mins = np.asarray(rstats.min)
+        maxs = np.asarray(rstats.max)
+        bins = self.getNumBins()
+        with trace_range("robust scaler histogram"):
+            fn = arrow_fns.HistogramPartitionFn(input_col, mins, maxs, bins)
+            arrays = _collect_stats(
+                dataset.select(input_col), fn, ["hist"], {"hist": (n, bins)}
+            )
+        hist = jnp.asarray(arrays["hist"])
+        jm, jmin, jmax = (jnp.asarray(v) for v in (hist, mins, maxs))
+        med = np.asarray(S.quantile_from_histogram(jm, jmin, jmax, 0.5))
+        lo = np.asarray(
+            S.quantile_from_histogram(jm, jmin, jmax, self.getLower())
+        )
+        hi = np.asarray(
+            S.quantile_from_histogram(jm, jmin, jmax, self.getUpper())
+        )
+        model = SparkRobustScalerModel(
+            uid=self.uid, median=med, range=hi - lo
+        )
+        return self._copyValues(model)
+
+
+class SparkRobustScalerModel(RobustScalerModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._scale, self.getOutputCol(), scalar=False
+        )
+
+
 def _collect_range_stats(est, dataset):
     """One mapInArrow range-stats pass + min/max driver fold."""
+    from spark_rapids_ml_tpu.ops import scaler as S
+
     input_col = _resolve_col(est, "inputCol") or "features"
     n = _infer_n(dataset, input_col)
     with trace_range("scaler range stats"):
-        selected = dataset.select(input_col)
-        T, _ = _sql_mods(selected)
-        stats_df = selected.mapInArrow(
+        arrays = _collect_stats(
+            dataset.select(input_col),
             arrow_fns.make_range_stats_partition_fn(input_col),
-            schema=_spark_arrays_type(T, arrow_fns.RANGE_STATS_FIELDS),
+            arrow_fns.RANGE_STATS_FIELDS,
+            arrow_fns.range_stats_shapes(n),
+            combine=arrow_fns.RANGE_COMBINE,
         )
-        if hasattr(stats_df, "toArrow"):
-            return arrow_fns.range_stats_from_batches(
-                stats_df.toArrow().to_batches(), n
-            )
-        return arrow_fns.range_stats_from_rows(stats_df.collect(), n)
+    return S.RangeStats(**arrays)
 
 
 # ---------------------------------------------------------------------------

@@ -345,3 +345,28 @@ class TestStockSparkMLLoadsOurSaves:
         np.testing.assert_allclose(
             np.asarray(stock.maxAbs.toArray()), ours.maxAbs, atol=1e-12
         )
+
+    def test_stock_robust_scaler_model_loads_ours(self, spark, tmp_path):
+        from pyspark.ml.feature import RobustScalerModel as StockRobust
+
+        from spark_rapids_ml_tpu.models.scaler import RobustScaler
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(3_000, 3)) * 2 + 1
+        ours = (
+            RobustScaler()
+            .setInputCol("features")
+            .setOutputCol("scaled")
+            .setWithCentering(True)
+            .fit(x)
+        )
+        p = str(tmp_path / "rs")
+        ours.save(p, layout="spark")
+        stock = StockRobust.load(p)
+        np.testing.assert_allclose(
+            np.asarray(stock.median.toArray()), ours.median, atol=1e-12
+        )
+        np.testing.assert_allclose(
+            np.asarray(stock.range.toArray()), ours.range, atol=1e-12
+        )
+        assert stock.getWithCentering() is True

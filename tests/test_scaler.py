@@ -176,3 +176,129 @@ class TestMaxAbsScaler:
         model.save(tmp_path / "spark", layout="spark")
         loaded = MaxAbsScalerModel.load(str(tmp_path / "spark"))
         np.testing.assert_array_equal(loaded.maxAbs, model.maxAbs)
+
+
+class TestBinarizer:
+    def test_matches_sklearn(self, data):
+        from sklearn.preprocessing import Binarizer as SkBin
+
+        from spark_rapids_ml_tpu.models.scaler import Binarizer
+
+        out = Binarizer().setInputCol("f").setThreshold(0.5).transform(data)
+        np.testing.assert_array_equal(
+            out, SkBin(threshold=0.5).transform(data)
+        )
+
+    def test_strict_inequality_at_threshold(self):
+        from spark_rapids_ml_tpu.models.scaler import Binarizer
+
+        x = np.array([[0.0, 0.5, 1.0]])
+        out = Binarizer().setInputCol("f").setThreshold(0.5).transform(x)
+        np.testing.assert_array_equal(out, [[0.0, 0.0, 1.0]])  # 0.5 -> 0
+
+    def test_in_pipeline_with_minmax(self, rng):
+        from spark_rapids_ml_tpu.models.pipeline import Pipeline
+        from spark_rapids_ml_tpu.models.scaler import Binarizer, MinMaxScaler
+
+        x = rng.uniform(-4, 4, size=(120, 5))
+        pipe = Pipeline(stages=[
+            MinMaxScaler().setInputCol("f").setOutputCol("s"),
+            Binarizer().setInputCol("s").setOutputCol("b").setThreshold(0.5),
+        ])
+        # ndarray containers: each stage transforms the matrix in sequence
+        out = pipe.fit(x).transform(x)
+        b = out["b"] if hasattr(out, "keys") else out
+        vals = np.stack(b.to_numpy()) if hasattr(b, "to_numpy") else np.asarray(b)
+        assert set(np.unique(vals)) <= {0.0, 1.0}
+        span = x.max(0) - x.min(0)
+        want = ((x - x.min(0)) / span > 0.5).astype(float)
+        np.testing.assert_array_equal(vals.reshape(want.shape), want)
+
+
+class TestRobustScaler:
+    def test_matches_sklearn_within_sketch_resolution(self, rng):
+        from sklearn.preprocessing import RobustScaler as SkRobust
+
+        from spark_rapids_ml_tpu.models.scaler import RobustScaler
+
+        x = rng.normal(size=(20_000, 4)) * np.array([1.0, 5.0, 0.3, 10.0])
+        model = (
+            RobustScaler()
+            .setInputCol("f")
+            .setWithCentering(True)
+            .fit(x, num_partitions=3)
+        )
+        sk = SkRobust(with_centering=True).fit(x)
+        span = x.max(0) - x.min(0)
+        tol = 2 * span / 4096  # the documented value-resolution bound
+        np.testing.assert_allclose(model.median, sk.center_, atol=tol.max())
+        np.testing.assert_allclose(model.range, sk.scale_, atol=2 * tol.max())
+        out = model.transform(x)
+        want = sk.transform(x)
+        np.testing.assert_allclose(out, want, atol=0.02)
+
+    def test_exact_on_grid_data(self):
+        # integer-grid data with bins aligned: quantiles are exact
+        from spark_rapids_ml_tpu.models.scaler import RobustScaler
+
+        x = np.tile(np.arange(101, dtype=float)[:, None], (1, 2))  # 0..100
+        m = RobustScaler().setInputCol("f").setNumBins(101).fit(x)
+        # 25th/75th percentile of 0..100 -> ~25/~75, range ~50; median ~50
+        assert abs(m.median[0] - 50.0) <= 1.0
+        assert abs(m.range[0] - 50.0) <= 2.0
+
+    def test_spark_defaults_no_centering(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import RobustScaler
+
+        x = rng.normal(size=(5_000, 3)) + 100.0
+        m = RobustScaler().setInputCol("f").fit(x)
+        out = m.transform(x)
+        # withCentering=False (Spark default): the offset survives scaling
+        assert out.mean() > 10.0
+
+    def test_constant_feature_passes_through(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import RobustScaler
+
+        x = rng.normal(size=(200, 3))
+        x[:, 1] = 4.2
+        out = (
+            RobustScaler().setInputCol("f").setWithCentering(True)
+            .fit(x).transform(x)
+        )
+        np.testing.assert_allclose(out[:, 1], 0.0, atol=1e-12)  # centered, /1
+
+    def test_multi_partition_parity(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import RobustScaler
+
+        x = rng.uniform(2.0, 9.0, size=(1001, 4))
+        m1 = RobustScaler().setInputCol("f").fit(x, num_partitions=1)
+        m4 = RobustScaler().setInputCol("f").fit(x, num_partitions=4)
+        np.testing.assert_allclose(m1.median, m4.median, atol=1e-12)
+        np.testing.assert_allclose(m1.range, m4.range, atol=1e-12)
+
+    def test_bad_quantile_bounds_rejected(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import RobustScaler
+
+        with pytest.raises(ValueError, match="lower < upper"):
+            RobustScaler().setInputCol("f").setLower(0.8).setUpper(0.2).fit(
+                rng.normal(size=(10, 2))
+            )
+
+    def test_persistence_roundtrip_both_layouts(self, rng, tmp_path):
+        from spark_rapids_ml_tpu.models.scaler import (
+            RobustScaler,
+            RobustScalerModel,
+        )
+
+        x = rng.normal(size=(500, 3))
+        model = RobustScaler().setInputCol("f").setWithCentering(True).fit(x)
+        model.save(tmp_path / "native")
+        loaded = RobustScalerModel.load(tmp_path / "native")
+        np.testing.assert_array_equal(loaded.median, model.median)
+        assert loaded.getWithCentering() is True
+        model.save(tmp_path / "spark", layout="spark")
+        loaded2 = RobustScalerModel.load(str(tmp_path / "spark"))
+        np.testing.assert_array_equal(loaded2.range, model.range)
+        np.testing.assert_allclose(
+            loaded2.transform(x), model.transform(x), atol=0
+        )

@@ -1160,3 +1160,33 @@ class TestRangeScalersIntegration:
         np.testing.assert_allclose(
             np.sort(got, 0), np.sort(x / np.abs(x).max(0), 0), atol=1e-9
         )
+
+    def test_robust_scaler_fit_transform_differential(self, backend):
+        from sklearn.preprocessing import RobustScaler as SkRobust
+
+        from spark_rapids_ml_tpu.spark import SparkRobustScaler
+
+        rng = np.random.default_rng(63)
+        x = rng.normal(size=(4_000, 3)) * np.array([1.0, 6.0, 0.5]) + 2.0
+        df = backend.df(
+            [(row.tolist(),) for row in x],
+            backend.features_schema(),
+            partitions=4,
+        )
+        model = (
+            SparkRobustScaler()
+            .setInputCol("features")
+            .setOutputCol("r")
+            .setWithCentering(True)
+            .fit(df)
+        )
+        sk = SkRobust(with_centering=True).fit(x)
+        span = x.max(0) - x.min(0)
+        tol = 2 * (span / 4096).max()
+        np.testing.assert_allclose(model.median, sk.center_, atol=tol)
+        np.testing.assert_allclose(model.range, sk.scale_, atol=2 * tol)
+        rows = model.transform(df).collect()
+        got = np.asarray([r["r"] for r in rows])
+        np.testing.assert_allclose(
+            np.sort(got, 0), np.sort(sk.transform(x), 0), atol=0.05
+        )
