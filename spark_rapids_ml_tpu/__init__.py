@@ -61,6 +61,8 @@ def __getattr__(name):
         "Binarizer",
         "RobustScaler",
         "RobustScalerModel",
+        "Imputer",
+        "ImputerModel",
     ):
         from spark_rapids_ml_tpu.models import scaler
 

@@ -12,6 +12,8 @@ becomes a one-line import swap.
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401
 from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
     Binarizer,
+    Imputer,
+    ImputerModel,
     MaxAbsScaler,
     MaxAbsScalerModel,
     MinMaxScaler,
@@ -40,6 +42,8 @@ __all__ = [
     "Binarizer",
     "RobustScaler",
     "RobustScalerModel",
+    "Imputer",
+    "ImputerModel",
     "TruncatedSVD",
     "TruncatedSVDModel",
 ]

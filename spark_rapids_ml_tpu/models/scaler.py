@@ -567,3 +567,195 @@ class RobustScalerModel(_RobustParams, Model):
             median=P.struct_to_vector(table.column("median")[0].as_py()),
             range=P.struct_to_vector(table.column("range")[0].as_py()),
         )
+
+
+_nan_moment_stats = jax.jit(S.nan_moment_stats, static_argnames=("missing",))
+_nan_range_stats = jax.jit(S.nan_range_stats, static_argnames=("missing",))
+_impute = jax.jit(S.impute, static_argnames=("missing",))
+
+
+def _histogram_with_missing_fn(x, true_rows, mins, maxs, *, bins, missing):
+    return S.histogram_stats(
+        x, true_rows, mins, maxs, bins=bins,
+        valid=S.valid_mask(x, true_rows, missing),
+    )
+
+
+_histogram_with_missing = jax.jit(
+    _histogram_with_missing_fn, static_argnames=("bins", "missing")
+)
+
+
+class _ImputerParams(HasInputCol, HasOutputCol):
+    strategy = Param("strategy", "imputation strategy: mean | median", str)
+    missingValue = Param(
+        "missingValue",
+        "the placeholder for missing entries (default NaN)",
+        float,
+    )
+    numBins = Param(
+        "numBins",
+        "histogram resolution of the median sketch (see RobustScaler)",
+        int,
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            strategy="mean",
+            missingValue=float("nan"),
+            numBins=4096,
+            outputCol="imputed_features",
+        )
+
+    def getStrategy(self) -> str:
+        return self.getOrDefault("strategy")
+
+    def getMissingValue(self) -> float:
+        return self.getOrDefault("missingValue")
+
+    def getNumBins(self) -> int:
+        return self.getOrDefault("numBins")
+
+
+class Imputer(_ImputerParams, Estimator):
+    """Per-feature missing-value imputation over the features vector
+    column (Spark ``Imputer`` strategies ``mean``/``median``, default
+    missingValue NaN — surface adapted to this framework's vector-column
+    convention; Spark's operates on separate numeric columns).
+
+    Distributed fit: ``mean`` is one NaN-aware moments pass; ``median``
+    reuses RobustScaler's histogram sketch with missing entries routed to
+    the dropped overflow bin. Features with NO valid entries surrogate to
+    0.0 (imputing from nothing is undefined; 0 is Spark ML's empty-stat
+    convention) — a warning names them.
+    """
+
+    def setStrategy(self, value: str) -> "Imputer":
+        if value not in ("mean", "median"):
+            raise ValueError(
+                f"strategy must be 'mean' or 'median', got {value!r} "
+                "('mode' needs exact value counts, which the histogram "
+                "sketch deliberately does not keep)"
+            )
+        return self._set(strategy=value)
+
+    def setMissingValue(self, value: float) -> "Imputer":
+        return self._set(missingValue=float(value))
+
+    def setNumBins(self, value: int) -> "Imputer":
+        if value < 2:
+            raise ValueError(f"numBins must be >= 2, got {value}")
+        return self._set(numBins=int(value))
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "ImputerModel":
+        input_col = self._paramMap.get("inputCol")
+        missing = self.getMissingValue()
+        ds = columnar.PartitionedDataset.from_any(
+            dataset, input_col, num_partitions
+        )
+        mats = list(ds.matrices())
+        from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+
+        with trace_range("imputer fit"):
+            if self.getStrategy() == "mean":
+
+                def task(mat):
+                    padded, true_rows = columnar.pad_rows(mat)
+                    return _nan_moment_stats(
+                        jnp.asarray(padded),
+                        jnp.asarray(true_rows),
+                        missing=missing,
+                    )
+
+                stats = tree_reduce(
+                    run_partition_tasks(task, mats), S.combine_nan_moment_stats
+                )
+                count = np.asarray(stats.count)
+                surrogate = np.asarray(stats.total) / np.maximum(count, 1.0)
+            else:  # median
+
+                def rtask(mat):
+                    padded, true_rows = columnar.pad_rows(mat)
+                    return _nan_range_stats(
+                        jnp.asarray(padded),
+                        jnp.asarray(true_rows),
+                        missing=missing,
+                    )
+
+                rstats = tree_reduce(
+                    run_partition_tasks(rtask, mats), S.combine_nan_range_stats
+                )
+                count = np.asarray(rstats.count)
+                # all-missing features carry +/-inf bounds; neutralize any
+                # non-finite bound so the histogram pass stays finite (the
+                # resulting quantile is overwritten by the empty-surrogate
+                # epilogue below)
+                mins = jnp.asarray(
+                    np.where(np.isfinite(rstats.min), rstats.min, 0.0)
+                )
+                maxs = jnp.asarray(
+                    np.where(np.isfinite(rstats.max), rstats.max, 0.0)
+                )
+                bins = self.getNumBins()
+
+                def htask(mat):
+                    padded, true_rows = columnar.pad_rows(mat)
+                    return _histogram_with_missing(
+                        jnp.asarray(padded), jnp.asarray(true_rows),
+                        mins, maxs, bins=bins, missing=missing,
+                    )
+
+                hist = tree_reduce(
+                    run_partition_tasks(htask, mats), lambda a, b: a + b
+                )
+                surrogate = np.asarray(
+                    _quantile(hist, mins, maxs, 0.5)
+                )
+            empty = count == 0
+            if empty.any():
+                import warnings
+
+                warnings.warn(
+                    f"imputer: feature(s) {np.flatnonzero(empty).tolist()} "
+                    "have no valid entries; their surrogate is 0.0",
+                    UserWarning,
+                    stacklevel=2,
+                )
+                surrogate = np.where(empty, 0.0, surrogate)
+        model = ImputerModel(uid=self.uid, surrogate=surrogate)
+        return self._copyValues(model)
+
+
+class ImputerModel(_ImputerParams, Model):
+    def __init__(self, uid: str | None = None, surrogate: np.ndarray | None = None):
+        super().__init__(uid)
+        self.surrogate = None if surrogate is None else np.asarray(surrogate)
+
+    def _fill(self, mat: np.ndarray) -> np.ndarray:
+        out = _impute(
+            jnp.asarray(mat),
+            jnp.asarray(self.surrogate, dtype=mat.dtype),
+            missing=self.getMissingValue(),
+        )
+        return np.asarray(out)
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("impute"):
+            return columnar.apply_column_transform(
+                dataset, self._paramMap.get("inputCol"), self.getOutputCol(), self._fill
+            )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"surrogate": self.surrogate}
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(uid=uid, surrogate=data["surrogate"])
+
+    def _saveSparkML(self, path: str) -> None:
+        raise NotImplementedError(
+            "stock Spark ML's Imputer operates on separate numeric input "
+            "columns (surrogateDF layout), which cannot represent this "
+            "vector-column model; use the native layout"
+        )

@@ -147,6 +147,7 @@ def histogram_stats(
     maxs: jax.Array,
     *,
     bins: int,
+    valid: jax.Array | None = None,
 ) -> jax.Array:
     """Per-feature fixed-bin histogram over [mins, maxs] — the additive
     monoid behind RobustScaler's distributed quantiles. TPU-shaped: the
@@ -165,12 +166,15 @@ def histogram_stats(
     idx = jnp.clip((x - mins[None, :]) / safe_w[None, :], 0, bins - 1).astype(
         jnp.int32
     )
+    if valid is None:
+        valid = jnp.ones(x.shape, dtype=bool)
 
-    def col_hist(col_idx):
-        routed = jnp.where(mask, col_idx, bins)  # pads -> overflow bin
+    def col_hist(col_idx, col_valid):
+        # pads AND invalid entries -> overflow bin (dropped)
+        routed = jnp.where(mask & col_valid, col_idx, bins)
         return jnp.bincount(routed, length=bins + 1)[:bins]
 
-    return jax.vmap(col_hist, in_axes=1)(idx)
+    return jax.vmap(col_hist, in_axes=(1, 1))(idx, valid)
 
 
 def quantile_from_histogram(
@@ -213,3 +217,77 @@ def robust_scale(
     if with_scaling:
         out = out / jnp.where(qrange > 0, qrange, 1.0)[None, :]
     return out
+
+
+class NanMomentStats(NamedTuple):
+    """Per-feature NaN-aware moments: the Imputer's mean-strategy monoid
+    (missing entries contribute to neither sum nor count)."""
+
+    count: jax.Array  # [n] — VALID entries per feature
+    total: jax.Array  # [n] — sum over valid entries
+
+
+def nan_moment_stats(
+    x: jax.Array, true_rows: jax.Array, missing: float
+) -> NanMomentStats:
+    """Moments over entries that are present (row < true_rows) and not
+    ``missing`` — ONE validity predicate (:func:`valid_mask`) shared with
+    the median path so the strategies can never diverge on what counts as
+    missing."""
+    valid = valid_mask(x, true_rows, missing)
+    xz = jnp.where(valid, x, 0.0)
+    return NanMomentStats(
+        count=jnp.sum(valid, axis=0).astype(x.dtype),
+        total=jnp.sum(xz, axis=0),
+    )
+
+
+def combine_nan_moment_stats(a: NanMomentStats, b: NanMomentStats) -> NanMomentStats:
+    return NanMomentStats(a.count + b.count, a.total + b.total)
+
+
+def _is_missing(x: jax.Array, missing: float) -> jax.Array:
+    """Elementwise missing-sentinel predicate (NaN via isnan, else ==) —
+    the single definition every Imputer kernel shares."""
+    return jnp.isnan(x) if missing != missing else x == missing
+
+
+def impute(x: jax.Array, fill: jax.Array, missing: float) -> jax.Array:
+    """Replace missing entries with the per-feature fill value."""
+    return jnp.where(_is_missing(x, missing), fill[None, :], x)
+
+
+class NanRangeStats(NamedTuple):
+    """NaN-aware min/max + valid counts — the Imputer's median-strategy
+    range pass (missing entries must not clamp the bounds)."""
+
+    count: jax.Array  # [n] valid entries per feature
+    min: jax.Array  # [n]
+    max: jax.Array  # [n]
+
+
+def valid_mask(x: jax.Array, true_rows: jax.Array, missing: float) -> jax.Array:
+    """[rows, n] bool: present (row < true_rows) and not the missing
+    sentinel (:func:`_is_missing`)."""
+    row_ok = (jnp.arange(x.shape[0]) < true_rows)[:, None]
+    return row_ok & ~_is_missing(x, missing)
+
+
+def nan_range_stats(
+    x: jax.Array, true_rows: jax.Array, missing: float
+) -> NanRangeStats:
+    valid = valid_mask(x, true_rows, missing)
+    inf = jnp.asarray(jnp.inf, x.dtype)
+    return NanRangeStats(
+        count=jnp.sum(valid, axis=0).astype(x.dtype),
+        min=jnp.min(jnp.where(valid, x, inf), axis=0),
+        max=jnp.max(jnp.where(valid, x, -inf), axis=0),
+    )
+
+
+def combine_nan_range_stats(a: NanRangeStats, b: NanRangeStats) -> NanRangeStats:
+    return NanRangeStats(
+        a.count + b.count,
+        jnp.minimum(a.min, b.min),
+        jnp.maximum(a.max, b.max),
+    )

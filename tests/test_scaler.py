@@ -302,3 +302,100 @@ class TestRobustScaler:
         np.testing.assert_allclose(
             loaded2.transform(x), model.transform(x), atol=0
         )
+
+
+class TestImputer:
+    def test_mean_matches_sklearn(self, rng):
+        from sklearn.impute import SimpleImputer
+
+        from spark_rapids_ml_tpu.models.scaler import Imputer
+
+        x = rng.normal(size=(400, 5))
+        mask = rng.random(x.shape) < 0.15
+        x[mask] = np.nan
+        model = Imputer().setInputCol("f").fit(x, num_partitions=3)
+        out = model.transform(x)
+        want = SimpleImputer(strategy="mean").fit_transform(x)
+        np.testing.assert_allclose(out, want, atol=1e-10)
+
+    def test_median_matches_sklearn_within_sketch(self, rng):
+        from sklearn.impute import SimpleImputer
+
+        from spark_rapids_ml_tpu.models.scaler import Imputer
+
+        x = rng.normal(size=(10_000, 4)) * np.array([1, 5, 0.5, 8])
+        mask = rng.random(x.shape) < 0.2
+        x[mask] = np.nan
+        model = (
+            Imputer().setInputCol("f").setStrategy("median")
+            .fit(x, num_partitions=4)
+        )
+        sk = SimpleImputer(strategy="median").fit(x)
+        span = np.nanmax(x, 0) - np.nanmin(x, 0)
+        np.testing.assert_allclose(
+            model.surrogate, sk.statistics_, atol=(2 * span / 4096).max()
+        )
+
+    def test_custom_missing_sentinel(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import Imputer
+
+        x = rng.normal(size=(200, 3))
+        x[x[:, 0] > 1.0, 0] = -999.0
+        model = (
+            Imputer().setInputCol("f").setMissingValue(-999.0).fit(x)
+        )
+        out = model.transform(x)
+        assert not (out == -999.0).any()
+        clean = x[x[:, 0] != -999.0, 0]
+        np.testing.assert_allclose(
+            model.surrogate[0], clean.mean(), atol=1e-12
+        )
+
+    @pytest.mark.parametrize("strategy", ["mean", "median"])
+    def test_all_missing_feature_warns_and_zeroes(self, rng, strategy):
+        # the median leg also covers the +/-inf bound neutralization that
+        # keeps the histogram pass finite for an all-missing feature
+        from spark_rapids_ml_tpu.models.scaler import Imputer
+
+        x = rng.normal(size=(50, 3))
+        x[:, 1] = np.nan
+        with pytest.warns(UserWarning, match="no valid entries"):
+            model = Imputer().setInputCol("f").setStrategy(strategy).fit(x)
+        assert model.surrogate[1] == 0.0
+        assert np.all(np.isfinite(model.surrogate))
+        out = model.transform(x)
+        np.testing.assert_array_equal(out[:, 1], 0.0)
+
+    def test_mode_strategy_rejected_with_reason(self):
+        from spark_rapids_ml_tpu.models.scaler import Imputer
+
+        with pytest.raises(ValueError, match="mode"):
+            Imputer().setStrategy("mode")
+
+    def test_multi_partition_parity(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import Imputer
+
+        x = rng.normal(size=(999, 4))
+        x[rng.random(x.shape) < 0.1] = np.nan
+        for strategy in ("mean", "median"):
+            m1 = (
+                Imputer().setInputCol("f").setStrategy(strategy)
+                .fit(x, num_partitions=1)
+            )
+            m4 = (
+                Imputer().setInputCol("f").setStrategy(strategy)
+                .fit(x, num_partitions=4)
+            )
+            np.testing.assert_allclose(m1.surrogate, m4.surrogate, atol=1e-12)
+
+    def test_persistence_native_roundtrip(self, rng, tmp_path):
+        from spark_rapids_ml_tpu.models.scaler import Imputer, ImputerModel
+
+        x = rng.normal(size=(100, 3))
+        x[0, 0] = np.nan
+        model = Imputer().setInputCol("f").fit(x)
+        model.save(tmp_path / "imp")
+        loaded = ImputerModel.load(tmp_path / "imp")
+        np.testing.assert_array_equal(loaded.surrogate, model.surrogate)
+        with pytest.raises(NotImplementedError, match="native layout"):
+            model.save(tmp_path / "sp", layout="spark")
