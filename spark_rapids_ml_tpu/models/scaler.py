@@ -586,6 +586,24 @@ _histogram_with_missing = jax.jit(
 )
 
 
+def _apply_empty_surrogate(count: np.ndarray, surrogate: np.ndarray) -> np.ndarray:
+    """All-missing features cannot be imputed from data: surrogate 0.0
+    (Spark ML's empty-stat convention) with a warning naming them — ONE
+    definition shared by the local and Spark fit paths."""
+    empty = count == 0
+    if empty.any():
+        import warnings
+
+        warnings.warn(
+            f"imputer: feature(s) {np.flatnonzero(empty).tolist()} "
+            "have no valid entries; their surrogate is 0.0",
+            UserWarning,
+            stacklevel=3,
+        )
+        return np.where(empty, 0.0, surrogate)
+    return surrogate
+
+
 class _ImputerParams(HasInputCol, HasOutputCol):
     strategy = Param("strategy", "imputation strategy: mean | median", str)
     missingValue = Param(
@@ -712,17 +730,7 @@ class Imputer(_ImputerParams, Estimator):
                 surrogate = np.asarray(
                     _quantile(hist, mins, maxs, 0.5)
                 )
-            empty = count == 0
-            if empty.any():
-                import warnings
-
-                warnings.warn(
-                    f"imputer: feature(s) {np.flatnonzero(empty).tolist()} "
-                    "have no valid entries; their surrogate is 0.0",
-                    UserWarning,
-                    stacklevel=2,
-                )
-                surrogate = np.where(empty, 0.0, surrogate)
+            surrogate = _apply_empty_surrogate(count, surrogate)
         model = ImputerModel(uid=self.uid, surrogate=surrogate)
         return self._copyValues(model)
 

@@ -26,6 +26,8 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkNormalizer,
     SparkPCA,
     SparkPCAModel,
+    SparkImputer,
+    SparkImputerModel,
     SparkMaxAbsScaler,
     SparkMaxAbsScalerModel,
     SparkMinMaxScaler,
@@ -48,6 +50,8 @@ __all__ = [
     "SparkLinearRegressionModel",
     "SparkLogisticRegression",
     "SparkLogisticRegressionModel",
+    "SparkImputer",
+    "SparkImputerModel",
     "SparkMaxAbsScaler",
     "SparkMaxAbsScalerModel",
     "SparkMinMaxScaler",

@@ -785,15 +785,18 @@ class HistStats(NamedTuple):
 
 
 class HistogramPartitionFn(_StatsAccumulatorFn):
-    """mapInArrow body for RobustScaler's quantile sketch: per-feature
-    fixed-bin histogram over driver-supplied [mins, maxs] (from the range
-    pass). Additive — the generic sum-merge decoders fold it."""
+    """mapInArrow body for the histogram quantile sketch (RobustScaler;
+    Imputer's median strategy passes ``missing`` so those entries route to
+    the dropped overflow bin). Per-feature fixed-bin histogram over
+    driver-supplied [mins, maxs] from the range pass. Additive — the
+    generic sum-merge decoders fold it."""
 
-    def __init__(self, input_col: str, mins, maxs, bins: int):
+    def __init__(self, input_col: str, mins, maxs, bins: int, missing=None):
         self.input_col = input_col
         self.mins = np.asarray(mins, dtype=np.float64)
         self.maxs = np.asarray(maxs, dtype=np.float64)
         self.bins = int(bins)
+        self.missing = None if missing is None else float(missing)
 
     def _batch_stats(self, batch):
         import jax.numpy as jnp
@@ -802,18 +805,76 @@ class HistogramPartitionFn(_StatsAccumulatorFn):
 
         mat = columnar.extract_matrix(batch, self.input_col)
         pm, true_rows = columnar.pad_rows(mat)
+        pj, tr = jnp.asarray(pm), jnp.asarray(true_rows)
+        valid = (
+            None
+            if self.missing is None
+            else S.valid_mask(pj, tr, self.missing)
+        )
         return HistStats(
             S.histogram_stats(
-                jnp.asarray(pm),
-                jnp.asarray(true_rows),
+                pj, tr,
                 jnp.asarray(self.mins),
                 jnp.asarray(self.maxs),
                 bins=self.bins,
+                valid=valid,
             )
         )
 
     def _combine(self, a, b):
         return HistStats(a.hist + b.hist)
+
+
+class NanMomentsPartitionFn(_StatsAccumulatorFn):
+    """mapInArrow body for the Imputer's NaN-aware per-feature moments."""
+
+    def __init__(self, input_col: str, missing: float):
+        self.input_col = input_col
+        self.missing = float(missing)
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        mat = columnar.extract_matrix(batch, self.input_col)
+        pm, true_rows = columnar.pad_rows(mat)
+        return S.nan_moment_stats(
+            jnp.asarray(pm), jnp.asarray(true_rows), self.missing
+        )
+
+    def _combine(self, a, b):
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        return S.combine_nan_moment_stats(a, b)
+
+
+class NanRangePartitionFn(_StatsAccumulatorFn):
+    """mapInArrow body for the Imputer median strategy's NaN-aware range
+    pass — folds with min/max (NAN_RANGE_COMBINE), not sum."""
+
+    def __init__(self, input_col: str, missing: float):
+        self.input_col = input_col
+        self.missing = float(missing)
+
+    def _batch_stats(self, batch):
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        mat = columnar.extract_matrix(batch, self.input_col)
+        pm, true_rows = columnar.pad_rows(mat)
+        return S.nan_range_stats(
+            jnp.asarray(pm), jnp.asarray(true_rows), self.missing
+        )
+
+    def _combine(self, a, b):
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        return S.combine_nan_range_stats(a, b)
+
+
+NAN_RANGE_COMBINE = {"min": np.minimum, "max": np.maximum}
 
 
 class MatrixMapPartitionFn:

@@ -33,7 +33,10 @@ from spark_rapids_ml_tpu.models.linear import (
     LogisticRegressionModel,
 )
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+from spark_rapids_ml_tpu.models import scaler as _scaler_mod
 from spark_rapids_ml_tpu.models.scaler import (
+    Imputer,
+    ImputerModel,
     MaxAbsScaler,
     RobustScaler,
     RobustScalerModel,
@@ -1622,6 +1625,80 @@ class SparkRobustScalerModel(RobustScalerModel):
             return super().transform(dataset)
         return _spark_transform(
             self, dataset, self._scale, self.getOutputCol(), scalar=False
+        )
+
+
+class SparkImputer(_HasDistribution, Imputer):
+    """Imputer over pyspark DataFrames: mean is one NaN-aware moments
+    mapInArrow pass; median is the NaN-aware range pass + the missing-
+    routed histogram pass."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkImputerModel(uid=core.uid, surrogate=core.surrogate)
+            return self._copyValues(model)
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        input_col = _resolve_col(self, "inputCol") or "features"
+        n = _infer_n(dataset, input_col)
+        missing = self.getMissingValue()
+        selected = dataset.select(input_col)
+        with trace_range("imputer fit"):
+            if self.getStrategy() == "mean":
+                arrays = _collect_stats(
+                    selected,
+                    arrow_fns.NanMomentsPartitionFn(input_col, missing),
+                    ["count", "total"],
+                    {"count": (n,), "total": (n,)},
+                )
+                count = arrays["count"]
+                surrogate = arrays["total"] / np.maximum(count, 1.0)
+            else:  # median
+                arrays = _collect_stats(
+                    selected,
+                    arrow_fns.NanRangePartitionFn(input_col, missing),
+                    list(S.NanRangeStats._fields),
+                    {f: (n,) for f in S.NanRangeStats._fields},
+                    combine=arrow_fns.NAN_RANGE_COMBINE,
+                )
+                count = arrays["count"]
+                mins = np.where(np.isfinite(arrays["min"]), arrays["min"], 0.0)
+                maxs = np.where(np.isfinite(arrays["max"]), arrays["max"], 0.0)
+                bins = self.getNumBins()
+                harr = _collect_stats(
+                    selected,
+                    arrow_fns.HistogramPartitionFn(
+                        input_col, mins, maxs, bins, missing=missing
+                    ),
+                    ["hist"],
+                    {"hist": (n, bins)},
+                )
+                surrogate = np.asarray(
+                    S.quantile_from_histogram(
+                        jnp.asarray(harr["hist"]),
+                        jnp.asarray(mins),
+                        jnp.asarray(maxs),
+                        0.5,
+                    )
+                )
+            surrogate = _scaler_mod._apply_empty_surrogate(
+                count, np.asarray(surrogate)
+            )
+        model = SparkImputerModel(uid=self.uid, surrogate=np.asarray(surrogate))
+        return self._copyValues(model)
+
+
+class SparkImputerModel(ImputerModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._fill, self.getOutputCol(), scalar=False
         )
 
 

@@ -1190,3 +1190,35 @@ class TestRangeScalersIntegration:
         np.testing.assert_allclose(
             np.sort(got, 0), np.sort(sk.transform(x), 0), atol=0.05
         )
+
+    def test_imputer_fit_transform_differential(self, backend):
+        from sklearn.impute import SimpleImputer
+
+        from spark_rapids_ml_tpu.spark import SparkImputer
+
+        rng = np.random.default_rng(64)
+        x = rng.normal(size=(2_000, 4)) * np.array([1, 5, 0.5, 3]) + 1
+        x[rng.random(x.shape) < 0.15] = np.nan
+        df = backend.df(
+            [(row.tolist(),) for row in x],
+            backend.features_schema(),
+            partitions=4,
+        )
+        for strategy, atol in (("mean", 1e-9), ("median", None)):
+            model = (
+                SparkImputer()
+                .setInputCol("features")
+                .setOutputCol("i")
+                .setStrategy(strategy)
+                .fit(df)
+            )
+            sk = SimpleImputer(strategy=strategy).fit(x)
+            if atol is None:  # sketch bound for the median
+                span = np.nanmax(x, 0) - np.nanmin(x, 0)
+                atol = (2 * span / 4096).max()
+            np.testing.assert_allclose(
+                model.surrogate, sk.statistics_, atol=atol
+            )
+            rows = model.transform(df).collect()
+            got = np.asarray([r["i"] for r in rows])
+            assert not np.isnan(got).any()
