@@ -42,6 +42,13 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import incremental
 
         return getattr(incremental, name)
+    if name in (
+        "VarianceThresholdSelector",
+        "VarianceThresholdSelectorModel",
+    ):
+        from spark_rapids_ml_tpu.models import selector
+
+        return getattr(selector, name)
     if name in ("TruncatedSVD", "TruncatedSVDModel"):
         from spark_rapids_ml_tpu.models import truncated_svd
 

@@ -24,6 +24,10 @@ from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
     StandardScaler,
     StandardScalerModel,
 )
+from spark_rapids_ml_tpu.models.selector import (  # noqa: F401
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+)
 from spark_rapids_ml_tpu.models.truncated_svd import (  # noqa: F401
     TruncatedSVD,
     TruncatedSVDModel,
@@ -44,6 +48,8 @@ __all__ = [
     "RobustScalerModel",
     "Imputer",
     "ImputerModel",
+    "VarianceThresholdSelector",
+    "VarianceThresholdSelectorModel",
     "TruncatedSVD",
     "TruncatedSVDModel",
 ]

@@ -30,6 +30,7 @@ _SPARK_ML_CLASSES: dict[str, str] = {
     "org.apache.spark.ml.feature.MinMaxScalerModel": "spark_rapids_ml_tpu.models.scaler.MinMaxScalerModel",
     "org.apache.spark.ml.feature.MaxAbsScalerModel": "spark_rapids_ml_tpu.models.scaler.MaxAbsScalerModel",
     "org.apache.spark.ml.feature.RobustScalerModel": "spark_rapids_ml_tpu.models.scaler.RobustScalerModel",
+    "org.apache.spark.ml.feature.VarianceThresholdSelectorModel": "spark_rapids_ml_tpu.models.selector.VarianceThresholdSelectorModel",
 }
 
 

@@ -1,0 +1,184 @@
+"""VarianceThresholdSelector — feature selection on the moments monoid.
+
+Spark 3.1+ surface (``featuresCol``/``outputCol``/``varianceThreshold``,
+default 0.0): keep features whose SAMPLE variance is strictly greater than
+the threshold. The fit is the same one-pass distributed moments statistic
+StandardScaler reduces (ops/scaler.py MomentStats), so selection costs one
+data pass on any distribution; transform is a column gather.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import (
+    HasFeaturesCol,
+    HasOutputCol,
+    Param,
+)
+from spark_rapids_ml_tpu.ops import scaler as S
+from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+_moment_stats = jax.jit(S.moment_stats)
+_finalize = jax.jit(S.finalize_moments)
+
+
+def select_by_variance(variances: np.ndarray, threshold: float) -> np.ndarray:
+    """variances -> sorted selected indices; raises when nothing survives —
+    ONE rule shared by the local and Spark fit paths."""
+    selected = np.flatnonzero(variances > threshold).astype(np.int32)
+    if len(selected) == 0:
+        raise ValueError(
+            f"varianceThreshold={threshold} rejects every feature (max "
+            f"sample variance {variances.max():.6g}); lower the threshold"
+        )
+    return selected
+
+
+class _SelectorParams(HasFeaturesCol, HasOutputCol):
+    varianceThreshold = Param(
+        "varianceThreshold",
+        "keep features with sample variance strictly greater than this",
+        float,
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(varianceThreshold=0.0, outputCol="selected_features")
+
+    def getVarianceThreshold(self) -> float:
+        return self.getOrDefault("varianceThreshold")
+
+
+class VarianceThresholdSelector(_SelectorParams, Estimator):
+    def setVarianceThreshold(self, value: float) -> "VarianceThresholdSelector":
+        if value < 0:
+            raise ValueError(f"varianceThreshold must be >= 0, got {value}")
+        return self._set(varianceThreshold=float(value))
+
+    def setFeaturesCol(self, value: str) -> "VarianceThresholdSelector":
+        return self._set(featuresCol=value)
+
+    def fit(
+        self, dataset: Any, num_partitions: int | None = None
+    ) -> "VarianceThresholdSelectorModel":
+        features_col = self._paramMap.get("featuresCol")
+        ds = columnar.PartitionedDataset.from_any(
+            dataset, features_col, num_partitions
+        )
+        with trace_range("variance selector fit"):
+
+            def task(mat):
+                padded, true_rows = columnar.pad_rows(mat)
+                st = _moment_stats(jnp.asarray(padded))
+                return S.MomentStats(
+                    jnp.asarray(true_rows, st.count.dtype),
+                    st.total,
+                    st.total_sq,
+                )
+
+            from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+
+            partials = run_partition_tasks(task, list(ds.matrices()))
+            stats = tree_reduce(partials, S.combine_moment_stats)
+            _, std = _finalize(stats)
+        selected = select_by_variance(
+            np.asarray(std) ** 2, self.getVarianceThreshold()
+        )
+        model = VarianceThresholdSelectorModel(
+            uid=self.uid, selectedFeatures=selected
+        )
+        return self._copyValues(model)
+
+
+class VarianceThresholdSelectorModel(_SelectorParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        selectedFeatures: np.ndarray | None = None,
+    ):
+        super().__init__(uid)
+        self.selectedFeatures = (
+            None
+            if selectedFeatures is None
+            else np.asarray(selectedFeatures, dtype=np.int32)
+        )
+
+    def _select(self, mat: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(mat[:, self.selectedFeatures])
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("variance selector transform"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("featuresCol"),
+                self.getOutputCol(),
+                self._select,
+            )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"selectedFeatures": self.selectedFeatures}
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(uid=uid, selectedFeatures=data["selectedFeatures"])
+
+    # -- stock pyspark.ml interop: Row(selectedFeatures: array<int>) --------
+    _SPARK_ML_CLASS = (
+        "org.apache.spark.ml.feature.VarianceThresholdSelectorModel"
+    )
+    _SPARK_ML_PARAMS = ("varianceThreshold", "featuresCol", "outputCol")
+
+    def _saveSparkML(self, path: str) -> None:
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.models.base import spark_set_params
+        from spark_rapids_ml_tpu.utils import persistence as P
+
+        params = {
+            k: v
+            for k, v in spark_set_params(self).items()
+            if k in self._SPARK_ML_PARAMS
+        }
+        P.save_spark_ml_metadata(
+            path, class_name=self._SPARK_ML_CLASS, uid=self.uid, param_map=params
+        )
+        P.save_spark_ml_data(
+            path,
+            {
+                "selectedFeatures": pa.array(
+                    [self.selectedFeatures.tolist()], pa.list_(pa.int32())
+                )
+            },
+            {
+                "type": "struct",
+                "fields": [
+                    {
+                        "name": "selectedFeatures",
+                        "type": {
+                            "type": "array",
+                            "elementType": "integer",
+                            "containsNull": False,
+                        },
+                        "nullable": True,
+                        "metadata": {},
+                    }
+                ],
+            },
+        )
+
+    @classmethod
+    def _fromSparkML(cls, meta: dict, table) -> "VarianceThresholdSelectorModel":
+        return cls(
+            uid=meta["uid"],
+            selectedFeatures=np.asarray(
+                table.column("selectedFeatures")[0].as_py(), dtype=np.int32
+            ),
+        )

@@ -35,6 +35,8 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkRobustScalerModel,
     SparkMinMaxScalerModel,
     SparkStandardScaler,
+    SparkVarianceThresholdSelector,
+    SparkVarianceThresholdSelectorModel,
     SparkStandardScalerModel,
     SparkTruncatedSVD,
     SparkTruncatedSVDModel,
@@ -59,6 +61,8 @@ __all__ = [
     "SparkRobustScalerModel",
     "SparkMinMaxScalerModel",
     "SparkStandardScaler",
+    "SparkVarianceThresholdSelector",
+    "SparkVarianceThresholdSelectorModel",
     "SparkStandardScalerModel",
     "SparkTruncatedSVD",
     "SparkTruncatedSVDModel",

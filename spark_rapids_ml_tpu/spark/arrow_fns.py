@@ -874,7 +874,6 @@ class NanRangePartitionFn(_StatsAccumulatorFn):
         return S.combine_nan_range_stats(a, b)
 
 
-NAN_RANGE_COMBINE = {"min": np.minimum, "max": np.maximum}
 
 
 class MatrixMapPartitionFn:

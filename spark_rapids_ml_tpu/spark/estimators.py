@@ -34,6 +34,10 @@ from spark_rapids_ml_tpu.models.linear import (
 )
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
 from spark_rapids_ml_tpu.models import scaler as _scaler_mod
+from spark_rapids_ml_tpu.models.selector import (
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+)
 from spark_rapids_ml_tpu.models.scaler import (
     Imputer,
     ImputerModel,
@@ -1664,7 +1668,7 @@ class SparkImputer(_HasDistribution, Imputer):
                     arrow_fns.NanRangePartitionFn(input_col, missing),
                     list(S.NanRangeStats._fields),
                     {f: (n,) for f in S.NanRangeStats._fields},
-                    combine=arrow_fns.NAN_RANGE_COMBINE,
+                    combine=arrow_fns.RANGE_COMBINE,
                 )
                 count = arrays["count"]
                 mins = np.where(np.isfinite(arrays["min"]), arrays["min"], 0.0)
@@ -1699,6 +1703,57 @@ class SparkImputerModel(ImputerModel):
             return super().transform(dataset)
         return _spark_transform(
             self, dataset, self._fill, self.getOutputCol(), scalar=False
+        )
+
+
+class SparkVarianceThresholdSelector(_HasDistribution, VarianceThresholdSelector):
+    """VarianceThresholdSelector over pyspark DataFrames: one mapInArrow
+    moments pass (the same statistic SparkStandardScaler reduces)."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkVarianceThresholdSelectorModel(
+                uid=core.uid, selectedFeatures=core.selectedFeatures
+            )
+            return self._copyValues(model)
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.ops import scaler as S
+
+        features_col = _resolve_col(self, "featuresCol") or "features"
+        n = _infer_n(dataset, features_col)
+        shapes = {"count": (), "total": (n,), "total_sq": (n,)}
+        with trace_range("variance selector fit"):
+            arrays = _collect_stats(
+                dataset.select(features_col),
+                arrow_fns.make_moments_partition_fn(features_col),
+                list(shapes),
+                shapes,
+            )
+            stats = S.MomentStats(
+                **{f: jnp.asarray(v) for f, v in arrays.items()}
+            )
+            _, std = S.finalize_moments(stats)
+        from spark_rapids_ml_tpu.models.selector import select_by_variance
+
+        selected = select_by_variance(
+            np.asarray(std) ** 2, self.getVarianceThreshold()
+        )
+        model = SparkVarianceThresholdSelectorModel(
+            uid=self.uid, selectedFeatures=selected
+        )
+        return self._copyValues(model)
+
+
+class SparkVarianceThresholdSelectorModel(VarianceThresholdSelectorModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._select, self.getOutputCol(), scalar=False
         )
 
 

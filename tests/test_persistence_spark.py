@@ -370,3 +370,40 @@ class TestStockSparkMLLoadsOurSaves:
             np.asarray(stock.range.toArray()), ours.range, atol=1e-12
         )
         assert stock.getWithCentering() is True
+
+    def test_stock_variance_selector_model_loads_ours(self, spark, tmp_path):
+        from pyspark.ml.feature import (
+            VarianceThresholdSelectorModel as StockSel,
+        )
+        from pyspark.ml.linalg import Vectors
+
+        from spark_rapids_ml_tpu.models.selector import (
+            VarianceThresholdSelector,
+        )
+
+        rng = np.random.default_rng(8)
+        x = rng.normal(size=(100, 4)) * np.array([0.01, 1.0, 2.0, 0.02])
+        ours = (
+            VarianceThresholdSelector()
+            .setFeaturesCol("features")
+            .setVarianceThreshold(0.1)
+            .fit(x)
+        )
+        p = str(tmp_path / "sel")
+        ours.save(p, layout="spark")
+        stock = StockSel.load(p)
+        np.testing.assert_array_equal(
+            np.asarray(stock.selectedFeatures), ours.selectedFeatures
+        )
+        df = spark.createDataFrame(
+            [(Vectors.dense(row),) for row in x], ["features"]
+        )
+        got = np.asarray(
+            [
+                r["selected_features"].toArray()
+                for r in stock.transform(df).collect()
+            ]
+        )
+        np.testing.assert_allclose(
+            got, x[:, ours.selectedFeatures], atol=1e-12
+        )

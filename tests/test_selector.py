@@ -1,0 +1,92 @@
+"""VarianceThresholdSelector — differential vs sklearn VarianceThreshold
+(with the sample-vs-population variance correction Spark uses)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models.selector import (
+    VarianceThresholdSelector,
+    VarianceThresholdSelectorModel,
+)
+
+
+@pytest.fixture
+def data(rng):
+    x = rng.normal(size=(300, 6)) * np.array([0.01, 2.0, 0.5, 3.0, 0.05, 1.0])
+    x[:, 4] = 7.0  # constant feature: zero variance
+    return x
+
+
+class TestVarianceThresholdSelector:
+    def test_matches_sample_variance_rule(self, data):
+        model = (
+            VarianceThresholdSelector()
+            .setFeaturesCol("f")
+            .setVarianceThreshold(0.1)
+            .fit(data, num_partitions=3)
+        )
+        want = np.flatnonzero(data.var(axis=0, ddof=1) > 0.1)
+        np.testing.assert_array_equal(model.selectedFeatures, want)
+        out = model.transform(data)
+        np.testing.assert_array_equal(out, data[:, want])
+
+    def test_default_threshold_drops_constant_only(self, data):
+        model = VarianceThresholdSelector().setFeaturesCol("f").fit(data)
+        np.testing.assert_array_equal(
+            model.selectedFeatures, [0, 1, 2, 3, 5]
+        )
+
+    def test_matches_sklearn(self, data):
+        from sklearn.feature_selection import VarianceThreshold
+
+        # sklearn thresholds POPULATION variance; feed it the equivalent
+        # threshold so the selections agree
+        thr = 0.1
+        n = len(data)
+        sk = VarianceThreshold(threshold=thr * (n - 1) / n).fit(data)
+        model = (
+            VarianceThresholdSelector()
+            .setFeaturesCol("f")
+            .setVarianceThreshold(thr)
+            .fit(data)
+        )
+        np.testing.assert_array_equal(
+            model.selectedFeatures, np.flatnonzero(sk.get_support())
+        )
+
+    def test_all_rejected_is_actionable(self, data):
+        with pytest.raises(ValueError, match="rejects every feature"):
+            VarianceThresholdSelector().setFeaturesCol("f").setVarianceThreshold(
+                1e9
+            ).fit(data)
+
+    def test_multi_partition_parity(self, data):
+        m1 = VarianceThresholdSelector().setFeaturesCol("f").fit(
+            data, num_partitions=1
+        )
+        m4 = VarianceThresholdSelector().setFeaturesCol("f").fit(
+            data, num_partitions=4
+        )
+        np.testing.assert_array_equal(m1.selectedFeatures, m4.selectedFeatures)
+
+    def test_persistence_roundtrip_both_layouts(self, data, tmp_path):
+        model = (
+            VarianceThresholdSelector()
+            .setFeaturesCol("f")
+            .setVarianceThreshold(0.1)
+            .fit(data)
+        )
+        model.save(tmp_path / "native")
+        loaded = VarianceThresholdSelectorModel.load(tmp_path / "native")
+        np.testing.assert_array_equal(
+            loaded.selectedFeatures, model.selectedFeatures
+        )
+        assert loaded.getVarianceThreshold() == 0.1
+        model.save(tmp_path / "spark", layout="spark")
+        loaded2 = VarianceThresholdSelectorModel.load(str(tmp_path / "spark"))
+        np.testing.assert_array_equal(
+            loaded2.selectedFeatures, model.selectedFeatures
+        )
+        np.testing.assert_array_equal(
+            loaded2.transform(data), model.transform(data)
+        )

@@ -1222,3 +1222,27 @@ class TestRangeScalersIntegration:
             rows = model.transform(df).collect()
             got = np.asarray([r["i"] for r in rows])
             assert not np.isnan(got).any()
+
+    def test_variance_selector_fit_transform(self, backend):
+        from spark_rapids_ml_tpu.spark import SparkVarianceThresholdSelector
+
+        rng = np.random.default_rng(65)
+        x = rng.normal(size=(500, 5)) * np.array([0.01, 2, 0.5, 3, 1])
+        x[:, 0] *= 0.0  # near-then-exactly-zero variance feature
+        df = backend.df(
+            [(row.tolist(),) for row in x],
+            backend.features_schema(),
+            partitions=3,
+        )
+        model = (
+            SparkVarianceThresholdSelector()
+            .setFeaturesCol("features")
+            .setOutputCol("sel")
+            .setVarianceThreshold(0.1)
+            .fit(df)
+        )
+        want = np.flatnonzero(x.var(axis=0, ddof=1) > 0.1)
+        np.testing.assert_array_equal(model.selectedFeatures, want)
+        rows = model.transform(df).collect()
+        got = np.asarray([r["sel"] for r in rows])
+        assert got.shape == (500, len(want))
