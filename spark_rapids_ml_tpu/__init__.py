@@ -43,6 +43,14 @@ def __getattr__(name):
 
         return getattr(incremental, name)
     if name in (
+        "Bucketizer",
+        "QuantileDiscretizer",
+        "QuantileDiscretizerModel",
+    ):
+        from spark_rapids_ml_tpu.models import discretizer
+
+        return getattr(discretizer, name)
+    if name in (
         "VarianceThresholdSelector",
         "VarianceThresholdSelectorModel",
     ):
@@ -66,6 +74,8 @@ def __getattr__(name):
         "MaxAbsScaler",
         "MaxAbsScalerModel",
         "Binarizer",
+        "ElementwiseProduct",
+        "VectorSlicer",
         "RobustScaler",
         "RobustScalerModel",
         "Imputer",

@@ -12,6 +12,7 @@ becomes a one-line import swap.
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401
 from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
     Binarizer,
+    ElementwiseProduct,
     Imputer,
     ImputerModel,
     MaxAbsScaler,
@@ -20,9 +21,15 @@ from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
     MinMaxScalerModel,
     Normalizer,
     RobustScaler,
+    VectorSlicer,
     RobustScalerModel,
     StandardScaler,
     StandardScalerModel,
+)
+from spark_rapids_ml_tpu.models.discretizer import (  # noqa: F401
+    Bucketizer,
+    QuantileDiscretizer,
+    QuantileDiscretizerModel,
 )
 from spark_rapids_ml_tpu.models.selector import (  # noqa: F401
     VarianceThresholdSelector,
@@ -44,6 +51,11 @@ __all__ = [
     "MaxAbsScaler",
     "MaxAbsScalerModel",
     "Binarizer",
+    "ElementwiseProduct",
+    "VectorSlicer",
+    "Bucketizer",
+    "QuantileDiscretizer",
+    "QuantileDiscretizerModel",
     "RobustScaler",
     "RobustScalerModel",
     "Imputer",

@@ -1,0 +1,231 @@
+"""QuantileDiscretizer / Bucketizer — binning on the histogram sketch.
+
+Spark's pair operates on a single Double column (``QuantileDiscretizer.fit``
+returns a ``Bucketizer`` with one splits array). This framework's data unit
+is the features VECTOR column, so the adaptation mirrors ``Imputer``'s:
+``Bucketizer`` applies ONE splits array elementwise across the vector, and
+``QuantileDiscretizer`` learns PER-FEATURE splits (a [n, buckets+1] matrix —
+each feature gets its own quantile grid, which a single-splits Bucketizer
+cannot represent, hence the dedicated model class). Quantiles come from the
+same distributed fixed-bin histogram sketch RobustScaler uses
+(ops/scaler.py ``histogram_stats``), so the fit is two mesh-reducible
+passes at any scale. Skewed data can collapse adjacent quantiles into
+duplicate split points; those become EMPTY buckets (ids stay valid and
+dense in [0, numBuckets)), where Spark instead reduces the bucket count
+with a warning — both are lossless, this one keeps the output arity static
+(XLA-friendly).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model, Transformer
+from spark_rapids_ml_tpu.models.params import (
+    HasInputCol,
+    HasOutputCol,
+    Param,
+)
+from spark_rapids_ml_tpu.ops import scaler as S
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+_bucketize = jax.jit(S.bucketize)
+
+
+class Bucketizer(HasInputCol, HasOutputCol, Transformer):
+    """Stateless binning of every feature against ONE sorted splits array
+    (see module docstring for the vector adaptation). ``handleInvalid``:
+    ``'error'`` (default) raises on values outside [splits[0], splits[-1]];
+    ``'keep'`` routes them to an extra bucket with id ``len(splits) - 1``.
+    Use ±inf endpoints to make every value in-range, like Spark.
+    """
+
+    splits = Param("splits", "sorted bucket boundaries (len >= 3)", None)
+    handleInvalid = Param(
+        "handleInvalid", "out-of-range policy: error | keep", str
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(handleInvalid="error", outputCol="bucketed_features")
+
+    def setSplits(self, value) -> "Bucketizer":
+        sp = np.asarray(value, dtype=np.float64)
+        if sp.ndim != 1 or len(sp) < 3:
+            raise ValueError(
+                "splits must be a 1-D sequence of at least 3 boundaries"
+            )
+        if not np.all(np.diff(sp) > 0):
+            raise ValueError(f"splits must be strictly increasing, got {sp}")
+        return self._set(splits=sp)
+
+    def getSplits(self) -> np.ndarray:
+        return np.asarray(self.getOrDefault("splits"))
+
+    def setHandleInvalid(self, value: str) -> "Bucketizer":
+        if value not in ("error", "keep"):
+            raise ValueError(
+                "handleInvalid must be 'error' or 'keep' ('skip' would "
+                "drop rows, which a columnar map cannot do)"
+            )
+        return self._set(handleInvalid=value)
+
+    def _bucket(self, mat: np.ndarray) -> np.ndarray:
+        sp = self.getSplits()
+        lo, hi = sp[0], sp[-1]
+        # NaN is invalid too (comparisons are NaN-blind): Spark raises on
+        # it in 'error' mode and routes it to the invalid bucket in 'keep'
+        invalid = np.isnan(mat) | (mat < lo) | (mat > hi)
+        if invalid.any():
+            if self.getOrDefault("handleInvalid") == "error":
+                bad = np.argwhere(invalid)[0]
+                raise ValueError(
+                    f"value {mat[tuple(bad)]} at row {bad[0]} feature "
+                    f"{bad[1]} is outside [{lo}, {hi}] (or NaN); widen "
+                    "splits (±inf endpoints) or setHandleInvalid('keep')"
+                )
+        splits = np.broadcast_to(sp, (mat.shape[1], len(sp)))
+        ids = np.asarray(_bucketize(jnp.asarray(mat), jnp.asarray(splits)))
+        if invalid.any():  # handleInvalid == "keep"
+            ids = np.where(invalid, float(len(sp) - 1), ids)
+        return ids
+
+    def transform(self, dataset: Any) -> Any:
+        if not self.isSet("splits"):
+            raise ValueError("splits must be set before transform")
+        with trace_range("bucketize"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                self._bucket,
+            )
+
+
+class _DiscretizerParams(HasInputCol, HasOutputCol):
+    numBuckets = Param("numBuckets", "number of quantile buckets (>= 2)", int)
+    numBins = Param(
+        "numBins",
+        "histogram resolution of the quantile sketch (see RobustScaler)",
+        int,
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            numBuckets=2, numBins=4096, outputCol="bucketed_features"
+        )
+
+    def getNumBuckets(self) -> int:
+        return self.getOrDefault("numBuckets")
+
+    def getNumBins(self) -> int:
+        return self.getOrDefault("numBins")
+
+
+class QuantileDiscretizer(_DiscretizerParams, Estimator):
+    """Learn per-feature quantile splits (numBuckets equal-frequency bins)
+    from the distributed histogram sketch, then bin like Bucketizer with
+    ±inf outer edges (every value lands in a bucket, matching Spark's
+    fitted behavior)."""
+
+    def setNumBuckets(self, value: int) -> "QuantileDiscretizer":
+        if value < 2:
+            raise ValueError(f"numBuckets must be >= 2, got {value}")
+        return self._set(numBuckets=int(value))
+
+    def setNumBins(self, value: int) -> "QuantileDiscretizer":
+        if value < 2:
+            raise ValueError(f"numBins must be >= 2, got {value}")
+        return self._set(numBins=int(value))
+
+    def fit(
+        self, dataset: Any, num_partitions: int | None = None
+    ) -> "QuantileDiscretizerModel":
+        from spark_rapids_ml_tpu.models.scaler import (
+            _fit_histogram,
+            _fit_range_stats,
+            _quantile,
+        )
+
+        b = self.getNumBuckets()
+        rstats = _fit_range_stats(self, dataset, num_partitions)
+        if not (
+            np.isfinite(np.asarray(rstats.min)).all()
+            and np.isfinite(np.asarray(rstats.max)).all()
+        ):
+            # NaN anywhere poisons min/max and therefore every split;
+            # Spark's QuantileDiscretizer (handleInvalid='error' default)
+            # raises too — impute first (models.scaler.Imputer)
+            bad = np.flatnonzero(
+                ~np.isfinite(np.asarray(rstats.min))
+                | ~np.isfinite(np.asarray(rstats.max))
+            )
+            raise ValueError(
+                f"feature(s) {bad.tolist()} contain NaN/Inf values; "
+                "QuantileDiscretizer needs finite data — impute first "
+                "(spark_rapids_ml_tpu.Imputer)"
+            )
+        mins = jnp.asarray(rstats.min)
+        maxs = jnp.asarray(rstats.max)
+        with trace_range("quantile discretizer histogram"):
+            hist = _fit_histogram(
+                self, dataset, num_partitions, mins, maxs, self.getNumBins()
+            )
+        n = hist.shape[0]
+        splits = np.empty((n, b + 1))
+        splits[:, 0] = -np.inf
+        splits[:, b] = np.inf
+        for i in range(1, b):
+            splits[:, i] = np.asarray(_quantile(hist, mins, maxs, i / b))
+        model = QuantileDiscretizerModel(uid=self.uid, splits=splits)
+        return self._copyValues(model)
+
+
+class QuantileDiscretizerModel(_DiscretizerParams, Model):
+    """Per-feature splits matrix [n, numBuckets+1] with ±inf outer edges.
+    Duplicate interior splits (collapsed quantiles) leave empty buckets —
+    see the module docstring for the trade vs Spark's bucket-count
+    reduction."""
+
+    def __init__(self, uid: str | None = None, splits: np.ndarray | None = None):
+        super().__init__(uid)
+        self.splits = None if splits is None else np.asarray(splits)
+
+    def _bucket(self, mat: np.ndarray) -> np.ndarray:
+        if mat.shape[1] != self.splits.shape[0]:
+            raise ValueError(
+                f"model learned {self.splits.shape[0]} features, input has "
+                f"{mat.shape[1]}"
+            )
+        return np.asarray(
+            _bucketize(jnp.asarray(mat), jnp.asarray(self.splits))
+        )
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("quantile bucketize"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                self._bucket,
+            )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"splits": self.splits}
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(uid=uid, splits=data["splits"])
+
+    def _saveSparkML(self, path: str) -> None:
+        raise NotImplementedError(
+            "stock Spark ML's QuantileDiscretizer fits a single-column "
+            "Bucketizer; the per-feature splits matrix has no stock "
+            "layout — use the native layout"
+        )

@@ -402,6 +402,24 @@ _histogram_stats = jax.jit(S.histogram_stats, static_argnames=("bins",))
 _quantile = jax.jit(S.quantile_from_histogram, static_argnames=())
 
 
+def _fit_histogram(self, dataset, num_partitions, mins, maxs, bins: int):
+    """Shared partitioned histogram pass (RobustScaler, QuantileDiscretizer):
+    pad, jitted sketch, tree-reduced additive fold."""
+    input_col = self._paramMap.get("inputCol")
+    ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
+
+    def task(mat):
+        padded, true_rows = columnar.pad_rows(mat)
+        return _histogram_stats(
+            jnp.asarray(padded), jnp.asarray(true_rows), mins, maxs, bins=bins
+        )
+
+    from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+
+    partials = run_partition_tasks(task, list(ds.matrices()))
+    return tree_reduce(partials, lambda a, b: a + b)
+
+
 class _RobustParams(HasInputCol, HasOutputCol):
     lower = Param("lower", "lower quantile of the scaling range", float)
     upper = Param("upper", "upper quantile of the scaling range", float)
@@ -480,30 +498,13 @@ class RobustScaler(_RobustParams, Estimator):
 
     def fit(self, dataset: Any, num_partitions: int | None = None) -> "RobustScalerModel":
         self._check_quantile_bounds()
-        input_col = self._paramMap.get("inputCol")
         rstats = _fit_range_stats(self, dataset, num_partitions)
         mins = jnp.asarray(rstats.min)
         maxs = jnp.asarray(rstats.max)
-        bins = self.getNumBins()
-        ds = columnar.PartitionedDataset.from_any(
-            dataset, input_col, num_partitions
-        )
         with trace_range("robust scaler histogram"):
-
-            def partition_task(mat):
-                padded, true_rows = columnar.pad_rows(mat)
-                return _histogram_stats(
-                    jnp.asarray(padded),
-                    jnp.asarray(true_rows),
-                    mins,
-                    maxs,
-                    bins=bins,
-                )
-
-            from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
-
-            partials = run_partition_tasks(partition_task, list(ds.matrices()))
-            hist = tree_reduce(partials, lambda a, b: a + b)
+            hist = _fit_histogram(
+                self, dataset, num_partitions, mins, maxs, self.getNumBins()
+            )
         median = np.asarray(_quantile(hist, mins, maxs, 0.5))
         lo = np.asarray(_quantile(hist, mins, maxs, self.getLower()))
         hi = np.asarray(_quantile(hist, mins, maxs, self.getUpper()))
@@ -767,3 +768,87 @@ class ImputerModel(_ImputerParams, Model):
             "columns (surrogateDF layout), which cannot represent this "
             "vector-column model; use the native layout"
         )
+
+
+class ElementwiseProduct(HasInputCol, HasOutputCol, Transformer):
+    """Stateless per-feature rescaling by a fixed weight vector (Spark
+    ``ElementwiseProduct``: output = x ∘ scalingVec)."""
+
+    scalingVec = Param("scalingVec", "the componentwise multiplier", None)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(outputCol="scaled_features")
+
+    def setScalingVec(self, value) -> "ElementwiseProduct":
+        return self._set(scalingVec=np.asarray(value, dtype=np.float64))
+
+    def getScalingVec(self) -> np.ndarray:
+        return np.asarray(self.getOrDefault("scalingVec"))
+
+    def _apply(self, mat: np.ndarray) -> np.ndarray:
+        w = self.getScalingVec()
+        if mat.shape[1] != len(w):
+            raise ValueError(
+                f"scalingVec has {len(w)} entries, features have "
+                f"{mat.shape[1]}"
+            )
+        # multiply in float64 like Spark: downcasting w to an integer
+        # input dtype would truncate fractional weights to zero
+        return mat * w[None, :]
+
+    def transform(self, dataset: Any) -> Any:
+        if not self.isSet("scalingVec"):
+            raise ValueError("scalingVec must be set before transform")
+        with trace_range("elementwise product"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                self._apply,
+            )
+
+
+class VectorSlicer(HasInputCol, HasOutputCol, Transformer):
+    """Stateless feature subsetting by indices (Spark ``VectorSlicer``'s
+    ``indices`` surface; name-based slicing needs column metadata this
+    framework's ArrayType convention does not carry)."""
+
+    indices = Param("indices", "feature indices to keep, in output order", None)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(outputCol="sliced_features")
+
+    def setIndices(self, value) -> "VectorSlicer":
+        idx = np.asarray(value, dtype=np.int32)
+        if idx.ndim != 1 or len(idx) == 0:
+            raise ValueError("indices must be a non-empty 1-D sequence")
+        if len(np.unique(idx)) != len(idx):
+            raise ValueError(f"indices must be unique, got {idx.tolist()}")
+        if (idx < 0).any():
+            raise ValueError(f"indices must be non-negative, got {idx.tolist()}")
+        return self._set(indices=idx)
+
+    def getIndices(self) -> np.ndarray:
+        return np.asarray(self.getOrDefault("indices"))
+
+    def _slice(self, mat: np.ndarray) -> np.ndarray:
+        idx = self.getIndices()
+        if idx.max() >= mat.shape[1]:
+            raise ValueError(
+                f"index {int(idx.max())} out of bounds for "
+                f"{mat.shape[1]} features"
+            )
+        return np.ascontiguousarray(mat[:, idx])
+
+    def transform(self, dataset: Any) -> Any:
+        if not self.isSet("indices"):
+            raise ValueError("indices must be set before transform")
+        with trace_range("vector slicer"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                self._slice,
+            )

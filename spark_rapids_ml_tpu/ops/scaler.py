@@ -291,3 +291,20 @@ def combine_nan_range_stats(a: NanRangeStats, b: NanRangeStats) -> NanRangeStats
         jnp.minimum(a.min, b.min),
         jnp.maximum(a.max, b.max),
     )
+
+
+def bucketize(x: jax.Array, splits: jax.Array) -> jax.Array:
+    """Per-feature bucket ids from sorted split points.
+
+    ``splits`` is [n, b+1] (±inf endpoints make every value in-range);
+    bucket i is [splits[i], splits[i+1]) with the top edge inclusive
+    (Spark Bucketizer's rule). Duplicate split points (collapsed
+    quantiles on skewed data) yield empty buckets, never invalid ids.
+    Output dtype follows x (Spark emits the id as a double).
+    """
+
+    def col(colx, cols):
+        idx = jnp.searchsorted(cols, colx, side="right") - 1
+        return jnp.clip(idx, 0, cols.shape[0] - 2)
+
+    return jax.vmap(col, in_axes=(1, 0), out_axes=1)(x, splits).astype(x.dtype)

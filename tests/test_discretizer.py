@@ -1,0 +1,142 @@
+"""QuantileDiscretizer / Bucketizer — equal-frequency binning vs NumPy
+quantile oracles, Spark edge semantics (top-edge inclusive, handleInvalid)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models.discretizer import (
+    Bucketizer,
+    QuantileDiscretizer,
+    QuantileDiscretizerModel,
+)
+
+
+class TestBucketizer:
+    def test_spark_edge_semantics(self):
+        b = (
+            Bucketizer()
+            .setInputCol("f")
+            .setSplits([0.0, 1.0, 2.0])
+        )
+        x = np.array([[0.0, 0.5, 1.0, 1.5, 2.0]]).T
+        out = b.transform(x).reshape(-1)
+        # [0,1) -> 0; [1,2] -> 1 with the TOP EDGE INCLUSIVE (2.0 -> 1)
+        np.testing.assert_array_equal(out, [0, 0, 1, 1, 1])
+
+    def test_error_then_keep_on_out_of_range(self):
+        x = np.array([[-1.0], [0.5], [3.0]])
+        b = Bucketizer().setInputCol("f").setSplits([0.0, 1.0, 2.0])
+        with pytest.raises(ValueError, match="outside"):
+            b.transform(x)
+        out = b.setHandleInvalid("keep").transform(x).reshape(-1)
+        np.testing.assert_array_equal(out, [2, 0, 2])  # invalid bucket id 2
+
+    def test_inf_endpoints_accept_everything(self, rng):
+        x = rng.normal(size=(200, 3)) * 100
+        b = (
+            Bucketizer()
+            .setInputCol("f")
+            .setSplits([-np.inf, 0.0, np.inf])
+        )
+        out = b.transform(x)
+        np.testing.assert_array_equal(out, (x >= 0).astype(float))
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="at least 3"):
+            Bucketizer().setSplits([0.0, 1.0])
+        with pytest.raises(ValueError, match="strictly increasing"):
+            Bucketizer().setSplits([0.0, 0.0, 1.0])
+        with pytest.raises(ValueError, match="'skip' would"):
+            Bucketizer().setHandleInvalid("skip")
+        with pytest.raises(ValueError, match="must be set"):
+            Bucketizer().setInputCol("f").transform(np.ones((2, 2)))
+
+
+class TestQuantileDiscretizer:
+    def test_equal_frequency_buckets(self, rng):
+        x = rng.normal(size=(20_000, 3)) * np.array([1.0, 5.0, 0.2])
+        model = (
+            QuantileDiscretizer()
+            .setInputCol("f")
+            .setNumBuckets(4)
+            .fit(x, num_partitions=3)
+        )
+        out = model.transform(x)
+        assert set(np.unique(out)) == {0.0, 1.0, 2.0, 3.0}
+        # equal-frequency: each bucket holds ~25% per feature
+        for j in range(3):
+            frac = np.bincount(out[:, j].astype(int), minlength=4) / len(x)
+            np.testing.assert_allclose(frac, 0.25, atol=0.02)
+
+    def test_splits_match_numpy_quantiles(self, rng):
+        x = rng.uniform(0.0, 10.0, size=(50_000, 2))
+        model = (
+            QuantileDiscretizer().setInputCol("f").setNumBuckets(5).fit(x)
+        )
+        want = np.quantile(x, [0.2, 0.4, 0.6, 0.8], axis=0).T
+        got = model.splits[:, 1:5]
+        np.testing.assert_allclose(got, want, atol=2 * 10.0 / 4096)
+        assert np.isneginf(model.splits[:, 0]).all()
+        assert np.isposinf(model.splits[:, -1]).all()
+
+    def test_multi_partition_parity(self, rng):
+        x = rng.normal(size=(999, 3))
+        m1 = QuantileDiscretizer().setInputCol("f").setNumBuckets(3).fit(
+            x, num_partitions=1
+        )
+        m4 = QuantileDiscretizer().setInputCol("f").setNumBuckets(3).fit(
+            x, num_partitions=4
+        )
+        np.testing.assert_allclose(m1.splits, m4.splits, atol=1e-12)
+
+    def test_skewed_duplicate_splits_stay_valid(self):
+        # 90% of mass at one value: adjacent quantiles collapse
+        x = np.concatenate([np.full(900, 5.0), np.arange(100, dtype=float)])
+        x = x[:, None]
+        model = (
+            QuantileDiscretizer().setInputCol("f").setNumBuckets(4).fit(x)
+        )
+        out = model.transform(x)
+        assert out.min() >= 0 and out.max() <= 3
+        # every row with the modal value lands in ONE bucket
+        assert len(np.unique(out[:900])) == 1
+
+    def test_feature_count_mismatch_rejected(self, rng):
+        x = rng.normal(size=(100, 3))
+        model = QuantileDiscretizer().setInputCol("f").fit(x)
+        with pytest.raises(ValueError, match="learned 3 features"):
+            model.transform(rng.normal(size=(10, 5)))
+
+    def test_persistence_native_roundtrip(self, rng, tmp_path):
+        x = rng.normal(size=(500, 2))
+        model = (
+            QuantileDiscretizer().setInputCol("f").setNumBuckets(3).fit(x)
+        )
+        model.save(tmp_path / "qd")
+        loaded = QuantileDiscretizerModel.load(tmp_path / "qd")
+        np.testing.assert_array_equal(loaded.splits, model.splits)
+        np.testing.assert_array_equal(
+            loaded.transform(x), model.transform(x)
+        )
+        with pytest.raises(NotImplementedError, match="native layout"):
+            model.save(tmp_path / "sp", layout="spark")
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="numBuckets"):
+            QuantileDiscretizer().setNumBuckets(1)
+
+
+class TestNaNHandling:
+    def test_bucketizer_nan_error_and_keep(self):
+        x = np.array([[0.5], [np.nan]])
+        b = Bucketizer().setInputCol("f").setSplits([0.0, 1.0, 2.0])
+        with pytest.raises(ValueError, match="NaN"):
+            b.transform(x)
+        out = b.setHandleInvalid("keep").transform(x).reshape(-1)
+        np.testing.assert_array_equal(out, [0.0, 2.0])  # NaN -> invalid bucket
+
+    def test_discretizer_rejects_nan_with_imputer_hint(self, rng):
+        x = rng.normal(size=(100, 3))
+        x[5, 1] = np.nan
+        with pytest.raises(ValueError, match="impute first"):
+            QuantileDiscretizer().setInputCol("f").fit(x)

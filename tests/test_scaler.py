@@ -399,3 +399,50 @@ class TestImputer:
         np.testing.assert_array_equal(loaded.surrogate, model.surrogate)
         with pytest.raises(NotImplementedError, match="native layout"):
             model.save(tmp_path / "sp", layout="spark")
+
+
+class TestElementwiseProduct:
+    def test_matches_numpy(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import ElementwiseProduct
+
+        x = rng.normal(size=(100, 4))
+        w = np.array([0.0, 1.0, -2.0, 0.5])
+        out = (
+            ElementwiseProduct().setInputCol("f").setScalingVec(w).transform(x)
+        )
+        np.testing.assert_array_equal(out, x * w)
+
+    def test_dim_mismatch_and_unset_rejected(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import ElementwiseProduct
+
+        x = rng.normal(size=(10, 3))
+        with pytest.raises(ValueError, match="must be set"):
+            ElementwiseProduct().setInputCol("f").transform(x)
+        with pytest.raises(ValueError, match="2 entries"):
+            ElementwiseProduct().setInputCol("f").setScalingVec(
+                [1.0, 2.0]
+            ).transform(x)
+
+
+class TestVectorSlicer:
+    def test_selects_in_given_order(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import VectorSlicer
+
+        x = rng.normal(size=(50, 5))
+        out = (
+            VectorSlicer().setInputCol("f").setIndices([3, 0]).transform(x)
+        )
+        np.testing.assert_array_equal(out, x[:, [3, 0]])
+
+    def test_validation(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import VectorSlicer
+
+        x = rng.normal(size=(10, 3))
+        with pytest.raises(ValueError, match="unique"):
+            VectorSlicer().setIndices([1, 1])
+        with pytest.raises(ValueError, match="non-negative"):
+            VectorSlicer().setIndices([-1])
+        with pytest.raises(ValueError, match="out of bounds"):
+            VectorSlicer().setInputCol("f").setIndices([7]).transform(x)
+        with pytest.raises(ValueError, match="must be set"):
+            VectorSlicer().setInputCol("f").transform(x)
