@@ -401,3 +401,68 @@ def kmeans_lloyd_host(
     # one Lloyd step and mis-rank restarts compared on cost)
     _, _, _, cost = kmeans_assign(x, centers, w)
     return centers, cost, it
+
+
+def logreg_fit_host(
+    x: np.ndarray,
+    y: np.ndarray,
+    w: np.ndarray | None = None,
+    *,
+    reg_param: float = 0.0,
+    fit_intercept: bool = True,
+    max_iter: int = 25,
+    tol: float = 1e-6,
+) -> tuple[np.ndarray, float]:
+    """Pure-host binary logistic IRLS/Newton — the classifier completing
+    the native GLM family (:func:`linreg_fit_host`'s sibling), with the
+    device path's exact conventions (ops/linear.py ``newton_update``):
+    λ·m L2 scaling, intercept unpenalized, √eps·trace/d jitter so
+    separable data stays solvable. The O(rows·d²) Hessian runs on the
+    native threaded kernel; margins on the native GEMM; the [d, d] solve
+    on the native Cholesky. Returns (coefficients [n], intercept).
+    """
+    x = _as_c(x)
+    y = np.asarray(y, dtype=np.float64).reshape(-1)
+    if set(np.unique(y)) - {0.0, 1.0}:
+        raise ValueError(
+            f"binary logistic requires 0/1 labels, got {np.unique(y)[:8]}"
+        )
+    rows, n = x.shape
+    xa = np.hstack([x, np.ones((rows, 1))]) if fit_intercept else x
+    d = xa.shape[1]
+    wv = (
+        np.ones(rows)
+        if w is None
+        else _as_c(np.asarray(w, dtype=np.float64))
+    )
+    m = max(float(wv.sum()), 1.0)
+    pen = np.ones(d)
+    if fit_intercept:
+        pen[-1] = 0.0
+    lam2 = reg_param * m * pen
+    beta = np.zeros(d)
+    for _ in range(max_iter):
+        z = project(xa, beta.reshape(-1, 1)).reshape(-1)  # native GEMM
+        p = 1.0 / (1.0 + np.exp(-z))
+        curv = p * (1.0 - p) * wv
+        hess = np.zeros((d, d))
+        linreg_accumulate(xa, y, curv, xtx=hess)  # native threaded X^T W X
+        grad = xa.T @ ((y - p) * wv) - lam2 * beta
+        hess[np.diag_indices(d)] += lam2
+        eps = np.sqrt(np.finfo(np.float64).eps) * np.trace(hess) / d
+        hess[np.diag_indices(d)] += eps
+        if not (np.isfinite(hess).all() and np.isfinite(grad).all()):
+            raise ValueError(
+                "Newton statistics are non-finite — the features, labels, "
+                "or weights contain NaN/Inf values; clean or impute first"
+            )
+        try:
+            step = solve_spd(hess, grad)
+        except NativeBridgeError:
+            step = np.linalg.lstsq(hess, grad, rcond=None)[0]
+        beta = beta + step
+        if float(np.linalg.norm(step)) <= tol:
+            break
+    if fit_intercept:
+        return beta[:-1], float(beta[-1])
+    return beta, 0.0

@@ -150,7 +150,7 @@ class QuantileDiscretizer(_DiscretizerParams, Estimator):
         from spark_rapids_ml_tpu.models.scaler import (
             _fit_histogram,
             _fit_range_stats,
-            _quantile,
+            _quantiles_multi,
         )
 
         b = self.getNumBuckets()
@@ -181,8 +181,10 @@ class QuantileDiscretizer(_DiscretizerParams, Estimator):
         splits = np.empty((n, b + 1))
         splits[:, 0] = -np.inf
         splits[:, b] = np.inf
-        for i in range(1, b):
-            splits[:, i] = np.asarray(_quantile(hist, mins, maxs, i / b))
+        qs = jnp.asarray(np.arange(1, b) / b)
+        splits[:, 1:b] = np.asarray(
+            _quantiles_multi(hist, mins, maxs, qs)
+        ).T
         model = QuantileDiscretizerModel(uid=self.uid, splits=splits)
         return self._copyValues(model)
 
@@ -202,6 +204,15 @@ class QuantileDiscretizerModel(_DiscretizerParams, Model):
             raise ValueError(
                 f"model learned {self.splits.shape[0]} features, input has "
                 f"{mat.shape[1]}"
+            )
+        if np.isnan(mat).any():
+            # searchsorted would silently sort NaN past +inf into the top
+            # bucket; Spark's fitted discretizer raises on NaN by default
+            bad = np.argwhere(np.isnan(mat))[0]
+            raise ValueError(
+                f"NaN at row {bad[0]} feature {bad[1]}; "
+                "QuantileDiscretizer bins finite data — impute first "
+                "(spark_rapids_ml_tpu.Imputer)"
             )
         return np.asarray(
             _bucketize(jnp.asarray(mat), jnp.asarray(self.splits))

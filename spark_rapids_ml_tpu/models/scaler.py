@@ -402,6 +402,17 @@ _histogram_stats = jax.jit(S.histogram_stats, static_argnames=("bins",))
 _quantile = jax.jit(S.quantile_from_histogram, static_argnames=())
 
 
+def _quantiles_multi_fn(hist, mins, maxs, qs):
+    # one program for a whole quantile grid (vmap shares the cumsum work
+    # via XLA CSE instead of one dispatch per q)
+    return jax.vmap(
+        lambda q: S.quantile_from_histogram(hist, mins, maxs, q)
+    )(qs)
+
+
+_quantiles_multi = jax.jit(_quantiles_multi_fn)
+
+
 def _fit_histogram(self, dataset, num_partitions, mins, maxs, bins: int):
     """Shared partitioned histogram pass (RobustScaler, QuantileDiscretizer):
     pad, jitted sketch, tree-reduced additive fold."""

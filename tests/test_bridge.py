@@ -245,3 +245,39 @@ class TestLinregNative:
         x[3, 1] = np.nan
         coef, _ = bridge.linreg_fit_host(x, np.ones(50))
         assert np.all(np.isnan(coef))
+
+
+class TestLogregNative:
+    def test_matches_framework_estimator(self, rng):
+        from spark_rapids_ml_tpu import LogisticRegression
+
+        x = rng.normal(size=(400, 5))
+        p = 1 / (1 + np.exp(-(x @ rng.normal(size=5) + 0.5)))
+        y = (rng.uniform(size=400) < p).astype(float)
+        for reg in (0.01, 0.3):
+            coef, b = bridge.logreg_fit_host(
+                x, y, reg_param=reg, max_iter=50, tol=1e-10
+            )
+            m = LogisticRegression(
+                regParam=reg, maxIter=50, tol=1e-10
+            ).fit((x, y))
+            np.testing.assert_allclose(coef, m.coefficients, atol=1e-7)
+            assert abs(b - m.intercept) < 1e-7
+
+    def test_weighted_matches_duplication(self, rng):
+        x = rng.normal(size=(150, 3))
+        y = (x[:, 0] + 0.3 * rng.normal(size=150) > 0).astype(float)
+        w = rng.integers(1, 4, size=150).astype(float)
+        cw, bw = bridge.logreg_fit_host(x, y, w, reg_param=0.01)
+        rep = np.repeat(np.arange(150), w.astype(int))
+        cd, bd = bridge.logreg_fit_host(x[rep], y[rep], reg_param=0.01)
+        np.testing.assert_allclose(cw, cd, atol=1e-8)
+        assert abs(bw - bd) < 1e-8
+
+    def test_bad_labels_and_nan_rejected(self, rng):
+        x = rng.normal(size=(50, 2))
+        with pytest.raises(ValueError, match="0/1 labels"):
+            bridge.logreg_fit_host(x, np.full(50, 2.0))
+        xb = x.copy(); xb[0, 0] = np.nan
+        with pytest.raises(ValueError, match="NaN/Inf"):
+            bridge.logreg_fit_host(xb, (x[:, 0] > 0).astype(float))

@@ -140,3 +140,11 @@ class TestNaNHandling:
         x[5, 1] = np.nan
         with pytest.raises(ValueError, match="impute first"):
             QuantileDiscretizer().setInputCol("f").fit(x)
+
+    def test_model_transform_rejects_nan(self, rng):
+        x = rng.normal(size=(100, 2))
+        model = QuantileDiscretizer().setInputCol("f").setNumBuckets(4).fit(x)
+        xb = x.copy()
+        xb[7, 1] = np.nan
+        with pytest.raises(ValueError, match="impute first"):
+            model.transform(xb)
