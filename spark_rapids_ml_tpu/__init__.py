@@ -74,6 +74,7 @@ def __getattr__(name):
         "MaxAbsScaler",
         "MaxAbsScalerModel",
         "Binarizer",
+        "DCT",
         "ElementwiseProduct",
         "VectorSlicer",
         "RobustScaler",

@@ -12,6 +12,7 @@ becomes a one-line import swap.
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401
 from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
     Binarizer,
+    DCT,
     ElementwiseProduct,
     Imputer,
     ImputerModel,
@@ -51,6 +52,7 @@ __all__ = [
     "MaxAbsScaler",
     "MaxAbsScalerModel",
     "Binarizer",
+    "DCT",
     "ElementwiseProduct",
     "VectorSlicer",
     "Bucketizer",

@@ -10,6 +10,7 @@ design as PCA's GramStats, so the distributed story is identical.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -863,3 +864,51 @@ class VectorSlicer(HasInputCol, HasOutputCol, Transformer):
                 self.getOutputCol(),
                 self._slice,
             )
+
+
+_dct2 = jax.jit(S.dct2, static_argnames=("inverse",))
+
+
+class DCT(HasInputCol, HasOutputCol, Transformer):
+    """Row-wise unitary Discrete Cosine Transform (Spark ``DCT``: DCT-II
+    scaled so the representing matrix is orthonormal; ``inverse=True``
+    applies DCT-III, the exact inverse). One [n, n] cosine-basis matmul
+    per batch — MXU-shaped, basis cached per feature count."""
+
+    inverse = Param("inverse", "apply the inverse transform (DCT-III)", bool)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(inverse=False, outputCol="dct_features")
+
+    def setInverse(self, value: bool) -> "DCT":
+        return self._set(inverse=bool(value))
+
+    def getInverse(self) -> bool:
+        return self.getOrDefault("inverse")
+
+    def _apply_dct(self, mat: np.ndarray) -> np.ndarray:
+        # promote to float BEFORE casting the basis to the input dtype:
+        # unitary-DCT coefficients are all |b| < 1, so an integer input
+        # dtype would truncate the whole basis to zero (the same trap
+        # ElementwiseProduct guards)
+        if not np.issubdtype(mat.dtype, np.floating):
+            mat = mat.astype(np.float64)
+        xm = jnp.asarray(mat)  # one H2D transfer per batch
+        basis = _dct_basis(mat.shape[1])
+        out = _dct2(xm, basis.astype(xm.dtype), inverse=self.getInverse())
+        return np.asarray(out)
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("dct"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOutputCol(),
+                self._apply_dct,
+            )
+
+
+@functools.lru_cache(maxsize=32)
+def _dct_basis(n: int):
+    return S.dct2_matrix(n)

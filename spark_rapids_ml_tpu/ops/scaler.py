@@ -308,3 +308,20 @@ def bucketize(x: jax.Array, splits: jax.Array) -> jax.Array:
         return jnp.clip(idx, 0, cols.shape[0] - 2)
 
     return jax.vmap(col, in_axes=(1, 0), out_axes=1)(x, splits).astype(x.dtype)
+
+
+def dct2_matrix(n: int, dtype=jnp.float64) -> jax.Array:
+    """The unitary DCT-II basis [n, n] (Spark DCT semantics: DCT-II scaled
+    so the representing matrix is orthonormal — scipy's ``norm='ortho'``).
+    Materialized once per n; the transform is then one MXU matmul."""
+    k = jnp.arange(n, dtype=dtype)
+    basis = jnp.cos(jnp.pi * (2.0 * k[None, :] + 1.0) * k[:, None] / (2.0 * n))
+    scale = jnp.full((n,), jnp.sqrt(2.0 / n), dtype=dtype).at[0].set(
+        jnp.sqrt(1.0 / n)
+    )
+    return basis * scale[:, None]
+
+
+def dct2(x: jax.Array, basis: jax.Array, *, inverse: bool = False) -> jax.Array:
+    """Row-wise unitary DCT-II (or its inverse, DCT-III) as one matmul."""
+    return x @ (basis if inverse else basis.T)

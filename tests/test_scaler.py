@@ -446,3 +446,41 @@ class TestVectorSlicer:
             VectorSlicer().setInputCol("f").setIndices([7]).transform(x)
         with pytest.raises(ValueError, match="must be set"):
             VectorSlicer().setInputCol("f").transform(x)
+
+
+class TestDCT:
+    def test_matches_scipy_ortho(self, rng):
+        from scipy.fft import dct as scipy_dct
+
+        from spark_rapids_ml_tpu.models.scaler import DCT
+
+        x = rng.normal(size=(50, 16))
+        out = DCT().setInputCol("f").transform(x)
+        want = scipy_dct(x, type=2, norm="ortho", axis=1)
+        np.testing.assert_allclose(out, want, atol=1e-10)
+
+    def test_inverse_round_trips(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import DCT
+
+        x = rng.normal(size=(40, 9))
+        fwd = DCT().setInputCol("f").transform(x)
+        back = DCT().setInputCol("f").setInverse(True).transform(fwd)
+        np.testing.assert_allclose(back, x, atol=1e-10)
+
+    def test_basis_is_orthonormal(self):
+        from spark_rapids_ml_tpu.ops.scaler import dct2_matrix
+
+        b = np.asarray(dct2_matrix(12))
+        np.testing.assert_allclose(b @ b.T, np.eye(12), atol=1e-12)
+
+    def test_integer_input_promotes(self):
+        from spark_rapids_ml_tpu.models.scaler import DCT
+
+        xi = np.arange(24).reshape(3, 8)
+        out = DCT().setInputCol("f").transform(xi)
+        from scipy.fft import dct as scipy_dct
+
+        np.testing.assert_allclose(
+            out, scipy_dct(xi.astype(float), type=2, norm="ortho", axis=1),
+            atol=1e-10,
+        )
