@@ -600,3 +600,46 @@ def test_host_memory_is_o_shard_not_o_dataset():
     np.testing.assert_array_equal(
         np.asarray(ing.xs)[: rows // 100], df.dense()[: rows // 100]
     )
+
+
+@pytest.mark.slow
+def test_mesh_local_training_at_gb_scale():
+    """The training-side sibling of the 8 GB ingest proof: stream a ~2 GB
+    float32 dataset onto the mesh and run the WHOLE-LOOP Lloyd program on
+    it — the full mesh-local deployment path (ingest + in-program k-means++
+    reduction + while_loop Lloyd) at a scale the old concatenate path
+    could not stage."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel import kmeans as PK
+
+    rows, n, k = 8_000_000, 64, 16
+    os.environ[ingest.WIRE_DTYPE_VAR] = "float32"
+    try:
+        df = _LazyFrame(rows, n, n_parts=64)
+        mesh = M.create_mesh()
+        ing = ingest.stream_to_mesh(
+            df, features_col="features", n=n, with_weights=True, mesh=mesh
+        )
+    finally:
+        del os.environ[ingest.WIRE_DTYPE_VAR]
+    # deterministic seeds from the first shard (seeding quality is not the
+    # subject here; the whole-loop program at scale is)
+    shard0 = ing.xs.addressable_shards[0].data
+    centers0 = jnp.asarray(np.asarray(shard0[:k]))
+    cfit, cost, iters = PK.make_distributed_kmeans_fit(
+        mesh, max_iter=5, tol=1e-6
+    )(ing.xs, ing.ws, centers0)
+    jax.block_until_ready(cfit)
+    assert cfit.shape == (k, n)
+    assert np.isfinite(np.asarray(cfit)).all()
+    assert float(cost) > 0.0 and int(iters) >= 1
+    # the data is a linear ramp (row*0.001 + arange(n)): centers must land
+    # inside the data's bounding box, not at pads/zeros
+    lo, hi = 0.0, (rows - 1) * 0.001 + (n - 1)
+    c = np.asarray(cfit)
+    assert (c >= lo - 1e-3).all() and (c <= hi + 1e-3).all()
+    # pads carry zero weight, so no center collapses onto the zero pad rows
+    # unless the data actually lives there (feature j floor is j)
+    assert (c[:, -1] >= (n - 1) - 1e-3).all()
