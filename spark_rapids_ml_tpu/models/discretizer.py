@@ -37,6 +37,40 @@ from spark_rapids_ml_tpu.utils.tracing import trace_range
 _bucketize = jax.jit(S.bucketize)
 
 
+def check_finite_range(mins: np.ndarray, maxs: np.ndarray) -> None:
+    """Reject NaN/Inf-poisoned feature ranges — ONE message shared by the
+    local and Spark fit paths."""
+    mins, maxs = np.asarray(mins), np.asarray(maxs)
+    if np.isfinite(mins).all() and np.isfinite(maxs).all():
+        return
+    bad = np.flatnonzero(~np.isfinite(mins) | ~np.isfinite(maxs))
+    raise ValueError(
+        f"feature(s) {bad.tolist()} contain NaN/Inf values; "
+        "QuantileDiscretizer needs finite data — impute first "
+        "(spark_rapids_ml_tpu.Imputer)"
+    )
+
+
+def splits_from_histogram(hist, mins, maxs, num_buckets: int) -> np.ndarray:
+    """[n, num_buckets+1] per-feature quantile grid with ±inf outer edges,
+    interior splits from one vmapped quantile program — the split assembly
+    both fit paths share."""
+    from spark_rapids_ml_tpu.models.scaler import _quantiles_multi
+
+    b = num_buckets
+    n = hist.shape[0]
+    splits = np.empty((n, b + 1))
+    splits[:, 0] = -np.inf
+    splits[:, b] = np.inf
+    qs = jnp.asarray(np.arange(1, b) / b)
+    splits[:, 1:b] = np.asarray(
+        _quantiles_multi(
+            jnp.asarray(hist), jnp.asarray(mins), jnp.asarray(maxs), qs
+        )
+    ).T
+    return splits
+
+
 class Bucketizer(HasInputCol, HasOutputCol, Transformer):
     """Stateless binning of every feature against ONE sorted splits array
     (see module docstring for the vector adaptation). ``handleInvalid``:
@@ -150,41 +184,17 @@ class QuantileDiscretizer(_DiscretizerParams, Estimator):
         from spark_rapids_ml_tpu.models.scaler import (
             _fit_histogram,
             _fit_range_stats,
-            _quantiles_multi,
         )
 
-        b = self.getNumBuckets()
         rstats = _fit_range_stats(self, dataset, num_partitions)
-        if not (
-            np.isfinite(np.asarray(rstats.min)).all()
-            and np.isfinite(np.asarray(rstats.max)).all()
-        ):
-            # NaN anywhere poisons min/max and therefore every split;
-            # Spark's QuantileDiscretizer (handleInvalid='error' default)
-            # raises too — impute first (models.scaler.Imputer)
-            bad = np.flatnonzero(
-                ~np.isfinite(np.asarray(rstats.min))
-                | ~np.isfinite(np.asarray(rstats.max))
-            )
-            raise ValueError(
-                f"feature(s) {bad.tolist()} contain NaN/Inf values; "
-                "QuantileDiscretizer needs finite data — impute first "
-                "(spark_rapids_ml_tpu.Imputer)"
-            )
+        check_finite_range(rstats.min, rstats.max)
         mins = jnp.asarray(rstats.min)
         maxs = jnp.asarray(rstats.max)
         with trace_range("quantile discretizer histogram"):
             hist = _fit_histogram(
                 self, dataset, num_partitions, mins, maxs, self.getNumBins()
             )
-        n = hist.shape[0]
-        splits = np.empty((n, b + 1))
-        splits[:, 0] = -np.inf
-        splits[:, b] = np.inf
-        qs = jnp.asarray(np.arange(1, b) / b)
-        splits[:, 1:b] = np.asarray(
-            _quantiles_multi(hist, mins, maxs, qs)
-        ).T
+        splits = splits_from_histogram(hist, mins, maxs, self.getNumBuckets())
         model = QuantileDiscretizerModel(uid=self.uid, splits=splits)
         return self._copyValues(model)
 

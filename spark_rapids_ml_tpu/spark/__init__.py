@@ -26,6 +26,10 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkNormalizer,
     SparkPCA,
     SparkPCAModel,
+    SparkBinarizer,
+    SparkBucketizer,
+    SparkDCT,
+    SparkElementwiseProduct,
     SparkImputer,
     SparkImputerModel,
     SparkMaxAbsScaler,
@@ -35,6 +39,9 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkRobustScalerModel,
     SparkMinMaxScalerModel,
     SparkStandardScaler,
+    SparkVectorSlicer,
+    SparkQuantileDiscretizer,
+    SparkQuantileDiscretizerModel,
     SparkVarianceThresholdSelector,
     SparkVarianceThresholdSelectorModel,
     SparkStandardScalerModel,
@@ -52,6 +59,10 @@ __all__ = [
     "SparkLinearRegressionModel",
     "SparkLogisticRegression",
     "SparkLogisticRegressionModel",
+    "SparkBinarizer",
+    "SparkBucketizer",
+    "SparkDCT",
+    "SparkElementwiseProduct",
     "SparkImputer",
     "SparkImputerModel",
     "SparkMaxAbsScaler",
@@ -61,6 +72,9 @@ __all__ = [
     "SparkRobustScalerModel",
     "SparkMinMaxScalerModel",
     "SparkStandardScaler",
+    "SparkVectorSlicer",
+    "SparkQuantileDiscretizer",
+    "SparkQuantileDiscretizerModel",
     "SparkVarianceThresholdSelector",
     "SparkVarianceThresholdSelectorModel",
     "SparkStandardScalerModel",

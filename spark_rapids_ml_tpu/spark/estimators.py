@@ -38,18 +38,27 @@ from spark_rapids_ml_tpu.models.selector import (
     VarianceThresholdSelector,
     VarianceThresholdSelectorModel,
 )
+from spark_rapids_ml_tpu.models.discretizer import (
+    Bucketizer,
+    QuantileDiscretizer,
+    QuantileDiscretizerModel,
+)
 from spark_rapids_ml_tpu.models.scaler import (
+    DCT,
+    Binarizer,
+    ElementwiseProduct,
     Imputer,
     ImputerModel,
     MaxAbsScaler,
-    RobustScaler,
-    RobustScalerModel,
     MaxAbsScalerModel,
     MinMaxScaler,
     MinMaxScalerModel,
     Normalizer,
+    RobustScaler,
+    RobustScalerModel,
     StandardScaler,
     StandardScalerModel,
+    VectorSlicer,
 )
 from spark_rapids_ml_tpu.models.truncated_svd import TruncatedSVD, TruncatedSVDModel
 from spark_rapids_ml_tpu.models.params import Param
@@ -1914,6 +1923,116 @@ class SparkTruncatedSVDModel(TruncatedSVDModel):
         return _spark_transform(
             self, dataset, self._project_matrix, self.getOutputCol(),
             scalar=False,
+        )
+
+
+class SparkBinarizer(Binarizer):
+    """Stateless thresholding over pyspark DataFrames (one mapInArrow pass,
+    same matrix fn as the local path)."""
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._binarize, self.getOutputCol(), scalar=False
+        )
+
+
+class SparkDCT(DCT):
+    """Row-wise unitary DCT over pyspark DataFrames."""
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._apply_dct, self.getOutputCol(), scalar=False
+        )
+
+
+class SparkElementwiseProduct(ElementwiseProduct):
+    """Componentwise rescaling over pyspark DataFrames."""
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        if not self.isSet("scalingVec"):
+            raise ValueError("scalingVec must be set before transform")
+        return _spark_transform(
+            self, dataset, self._apply, self.getOutputCol(), scalar=False
+        )
+
+
+class SparkVectorSlicer(VectorSlicer):
+    """Feature subsetting over pyspark DataFrames."""
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        if not self.isSet("indices"):
+            raise ValueError("indices must be set before transform")
+        return _spark_transform(
+            self, dataset, self._slice, self.getOutputCol(), scalar=False
+        )
+
+
+class SparkBucketizer(Bucketizer):
+    """Elementwise binning over pyspark DataFrames."""
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        if not self.isSet("splits"):
+            raise ValueError("splits must be set before transform")
+        return _spark_transform(
+            self, dataset, self._bucket, self.getOutputCol(), scalar=False
+        )
+
+
+class SparkQuantileDiscretizer(_HasDistribution, QuantileDiscretizer):
+    """QuantileDiscretizer over pyspark DataFrames: the range pass then the
+    histogram pass (both mapInArrow), quantile grid resolved on the driver."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkQuantileDiscretizerModel(
+                uid=core.uid, splits=core.splits
+            )
+            return self._copyValues(model)
+        from spark_rapids_ml_tpu.models.discretizer import (
+            check_finite_range,
+            splits_from_histogram,
+        )
+
+        input_col = _resolve_col(self, "inputCol") or "features"
+        n = _infer_n(dataset, input_col)
+        rstats = _collect_range_stats(self, dataset)
+        check_finite_range(rstats.min, rstats.max)
+        mins = np.asarray(rstats.min)
+        maxs = np.asarray(rstats.max)
+        bins = self.getNumBins()
+        with trace_range("quantile discretizer histogram"):
+            harr = _collect_stats(
+                dataset.select(input_col),
+                arrow_fns.HistogramPartitionFn(input_col, mins, maxs, bins),
+                ["hist"],
+                {"hist": (n, bins)},
+            )
+        splits = splits_from_histogram(
+            harr["hist"], mins, maxs, self.getNumBuckets()
+        )
+        model = SparkQuantileDiscretizerModel(uid=self.uid, splits=splits)
+        return self._copyValues(model)
+
+
+class SparkQuantileDiscretizerModel(QuantileDiscretizerModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._bucket, self.getOutputCol(), scalar=False
         )
 
 

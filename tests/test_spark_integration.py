@@ -1246,3 +1246,85 @@ class TestRangeScalersIntegration:
         rows = model.transform(df).collect()
         got = np.asarray([r["sel"] for r in rows])
         assert got.shape == (500, len(want))
+
+    def test_stateless_transformers_over_dataframes(self, backend):
+        from scipy.fft import dct as scipy_dct
+
+        from spark_rapids_ml_tpu.spark import (
+            SparkBinarizer,
+            SparkBucketizer,
+            SparkDCT,
+            SparkElementwiseProduct,
+            SparkVectorSlicer,
+        )
+
+        rng = np.random.default_rng(66)
+        x = rng.normal(size=(120, 8))
+        df = backend.df(
+            [(row.tolist(),) for row in x],
+            backend.features_schema(),
+            partitions=3,
+        )
+
+        def col(out_df, name):
+            return np.asarray([r[name] for r in out_df.collect()])
+
+        got = col(
+            SparkDCT().setInputCol("features").setOutputCol("d").transform(df),
+            "d",
+        )
+        np.testing.assert_allclose(
+            np.sort(got, 0),
+            np.sort(scipy_dct(x, type=2, norm="ortho", axis=1), 0),
+            atol=1e-9,
+        )
+        got = col(
+            SparkBinarizer().setInputCol("features").setOutputCol("b")
+            .setThreshold(0.0).transform(df),
+            "b",
+        )
+        assert set(np.unique(got)) <= {0.0, 1.0}
+        w = np.arange(1.0, 9.0)
+        got = col(
+            SparkElementwiseProduct().setInputCol("features")
+            .setOutputCol("e").setScalingVec(w).transform(df),
+            "e",
+        )
+        np.testing.assert_allclose(
+            np.sort(got, 0), np.sort(x * w, 0), atol=1e-9
+        )
+        got = col(
+            SparkVectorSlicer().setInputCol("features").setOutputCol("s")
+            .setIndices([5, 1]).transform(df),
+            "s",
+        )
+        assert got.shape == (120, 2)
+        got = col(
+            SparkBucketizer().setInputCol("features").setOutputCol("k")
+            .setSplits([-np.inf, 0.0, np.inf]).transform(df),
+            "k",
+        )
+        np.testing.assert_allclose(np.sort(got, 0), np.sort((x >= 0).astype(float), 0))
+
+    def test_quantile_discretizer_over_dataframes(self, backend):
+        from spark_rapids_ml_tpu.spark import SparkQuantileDiscretizer
+
+        rng = np.random.default_rng(67)
+        x = rng.normal(size=(3_000, 3)) * np.array([1, 5, 0.3])
+        df = backend.df(
+            [(row.tolist(),) for row in x],
+            backend.features_schema(),
+            partitions=4,
+        )
+        model = (
+            SparkQuantileDiscretizer()
+            .setInputCol("features")
+            .setOutputCol("q")
+            .setNumBuckets(4)
+            .fit(df)
+        )
+        rows = model.transform(df).collect()
+        got = np.asarray([r["q"] for r in rows])
+        for j in range(3):
+            frac = np.bincount(got[:, j].astype(int), minlength=4) / len(x)
+            np.testing.assert_allclose(frac, 0.25, atol=0.03)
