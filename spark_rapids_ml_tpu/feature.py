@@ -6,7 +6,11 @@ give users a familiarly-pathed class (PCA.scala:27-37, SURVEY.md §1 L6).
 This module is the same idea for the Python/Spark-ML package layout —
 ``spark_rapids_ml_tpu.feature`` mirrors ``pyspark.ml.feature``'s naming, so
 a user's ``from pyspark.ml.feature import PCA, StandardScaler, Normalizer``
-becomes a one-line import swap.
+becomes a one-line import swap. As of r5 the mirrored surface spans the
+preprocessing family end to end: PCA/TruncatedSVD, the scaler quartet
+(Standard/MinMax/MaxAbs/Robust), Imputer, QuantileDiscretizer/Bucketizer,
+VarianceThresholdSelector, and the stateless Normalizer/Binarizer/DCT/
+ElementwiseProduct/VectorSlicer.
 """
 
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401

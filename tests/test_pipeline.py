@@ -75,3 +75,54 @@ class TestPipeline:
         assert isinstance(loaded.getStages()[0], StandardScaler)
         assert isinstance(loaded.getStages()[1], PCA)
         assert loaded.getStages()[1].getK() == 2
+
+
+class TestPreprocessingPipelinePersistence:
+    def test_round_trip_with_r5_stages(self, rng, tmp_path):
+        """The r5 preprocessing family inside one PipelineModel: every
+        stage (stateful models AND params-only transformers) must
+        save/load through the pipeline persistence layer and transform
+        identically."""
+        from spark_rapids_ml_tpu.models.discretizer import QuantileDiscretizer
+        from spark_rapids_ml_tpu.models.pipeline import Pipeline, PipelineModel
+        from spark_rapids_ml_tpu.models.scaler import (
+            Binarizer,
+            Imputer,
+            MinMaxScaler,
+            RobustScaler,
+        )
+        from spark_rapids_ml_tpu.models.selector import (
+            VarianceThresholdSelector,
+        )
+
+        x = rng.normal(size=(500, 6)) * np.array([1, 4, 0.01, 2, 5, 3])
+        x[rng.random(x.shape) < 0.1] = np.nan
+        df = pd.DataFrame({"features": list(x)})
+        pipe = Pipeline(stages=[
+            Imputer(inputCol="features", outputCol="dense",
+                    strategy="median"),
+            VarianceThresholdSelector(featuresCol="dense",
+                                      outputCol="kept",
+                                      varianceThreshold=0.1),
+            RobustScaler(inputCol="kept", outputCol="robust",
+                         withCentering=True),
+            MinMaxScaler(inputCol="robust", outputCol="unit"),
+            QuantileDiscretizer(inputCol="unit", outputCol="binned",
+                                numBuckets=3),
+            Binarizer(inputCol="unit", outputCol="bits", threshold=0.5),
+        ])
+        model = pipe.fit(df)
+        out1 = model.transform(df)
+        model.save(tmp_path / "prep")
+        loaded = PipelineModel.load(tmp_path / "prep")
+        out2 = loaded.transform(df)
+        for col in ("dense", "kept", "robust", "unit", "binned", "bits"):
+            np.testing.assert_allclose(
+                np.stack(out1[col].to_numpy()),
+                np.stack(out2[col].to_numpy()),
+                atol=0,
+                err_msg=col,
+            )
+        binned = np.stack(out2["binned"].to_numpy())
+        assert set(np.unique(binned)) <= {0.0, 1.0, 2.0}
+        assert not np.isnan(np.stack(out2["dense"].to_numpy())).any()
