@@ -90,17 +90,30 @@ class RangeStats(NamedTuple):
     max_abs: jax.Array  # [n]
 
 
-def range_stats(x: jax.Array, true_rows: jax.Array) -> RangeStats:
-    """Stats over the first ``true_rows`` rows of a (possibly zero-padded)
-    shard — pad rows must not clamp the min/max, so they are masked to
-    ±inf (and 0 for max-|x|, which zero pads cannot raise)."""
-    mask = (jnp.arange(x.shape[0]) < true_rows)[:, None]
+def range_stats(
+    x: jax.Array,
+    true_rows: jax.Array | None = None,
+    *,
+    valid: jax.Array | None = None,
+) -> RangeStats:
+    """Masked per-feature min/max/max-|x| — ONE masking convention for both
+    mask shapes the framework uses: a row-prefix count (``true_rows``, the
+    partition-task shape) or an explicit [rows, 1]/[rows, n] ``valid`` mask
+    (the mesh path's weight-derived pad mask). Masked entries go to ±inf
+    (and 0 for max-|x|) so they can never clamp the fold."""
+    if valid is None:
+        valid = (jnp.arange(x.shape[0]) < true_rows)[:, None]
+        count = jnp.asarray(true_rows, x.dtype)
+    else:
+        if valid.ndim == 1:
+            valid = valid[:, None]
+        count = jnp.sum(jnp.any(valid, axis=1)).astype(x.dtype)
     inf = jnp.asarray(jnp.inf, x.dtype)
     return RangeStats(
-        count=jnp.asarray(true_rows, x.dtype),
-        min=jnp.min(jnp.where(mask, x, inf), axis=0),
-        max=jnp.max(jnp.where(mask, x, -inf), axis=0),
-        max_abs=jnp.max(jnp.where(mask, jnp.abs(x), 0.0), axis=0),
+        count=count,
+        min=jnp.min(jnp.where(valid, x, inf), axis=0),
+        max=jnp.max(jnp.where(valid, x, -inf), axis=0),
+        max_abs=jnp.max(jnp.where(valid, jnp.abs(x), 0.0), axis=0),
     )
 
 

@@ -182,3 +182,70 @@ def make_distributed_fit(
         in_shardings=NamedSharding(mesh, in_spec),
         out_shardings=NamedSharding(mesh, P()),
     )
+
+
+@lru_cache(maxsize=None)
+def _range_stats_prog(mesh: Mesh):
+    from spark_rapids_ml_tpu.ops import scaler as S
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def _run(xl, wl):
+        # ws pad-mask convention: 0 on pad rows; ONE masking kernel shared
+        # with the partition-task path (ops.scaler.range_stats)
+        local = S.range_stats(xl, valid=wl > 0)
+        return S.RangeStats(
+            count=lax.psum(local.count, DATA_AXIS),
+            min=lax.pmin(local.min, DATA_AXIS),
+            max=lax.pmax(local.max, DATA_AXIS),
+            max_abs=lax.pmax(local.max_abs, DATA_AXIS),
+        )
+
+    return jax.jit(_run)
+
+
+def sharded_range_stats(x: jax.Array, w: jax.Array, mesh: Mesh):
+    """Data-parallel per-feature min/max/max-|x| over the mesh — the
+    MinMax/MaxAbs/Robust/QuantileDiscretizer statistic: local masked
+    reductions, then pmin/pmax (the family's one non-additive fold) over
+    ICI. ``w`` is the ingest pad mask (0 on pad rows)."""
+    return _range_stats_prog(mesh)(x, w)
+
+
+@lru_cache(maxsize=None)
+def _histogram_prog(mesh: Mesh, bins: int):
+    from spark_rapids_ml_tpu.ops import scaler as S
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def _run(xl, wl, mins, maxs):
+        hist = S.histogram_stats(
+            xl,
+            jnp.asarray(xl.shape[0]),  # row mask handled via `valid`
+            mins,
+            maxs,
+            bins=bins,
+            valid=jnp.broadcast_to((wl > 0)[:, None], xl.shape),
+        )
+        return lax.psum(hist, DATA_AXIS)
+
+    return jax.jit(_run)
+
+
+def sharded_histogram(
+    x: jax.Array, w: jax.Array, mins, maxs, *, bins: int, mesh: Mesh
+):
+    """Data-parallel fixed-bin histograms (the quantile sketch) over the
+    mesh: one scatter-add per column per shard + a psum — pad rows carry
+    zero weight and never count."""
+    return _histogram_prog(mesh, bins)(x, w, mins, maxs)

@@ -1526,12 +1526,12 @@ class SparkStandardScalerModel(StandardScalerModel):
         )
 
 class SparkMinMaxScaler(_HasDistribution, MinMaxScaler):
-    """MinMaxScaler over pyspark DataFrames: one mapInArrow range-stats pass
-    per fit; the driver folds the per-partition rows with the min/max monoid
-    (the one non-additive statistic in the family, so the merge is its own —
-    ``arrow_fns.range_stats_from_batches``)."""
+    """MinMaxScaler over pyspark DataFrames: one range-stats pass per fit —
+    mapInArrow rows folded on the driver with the min/max monoid
+    ('driver-merge'), or streamed onto the driver's device mesh and folded
+    with pmin/pmax collectives ('mesh-local')."""
 
-    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         if not _is_spark_df(dataset):
@@ -1562,9 +1562,10 @@ class SparkMinMaxScalerModel(MinMaxScalerModel):
 
 
 class SparkMaxAbsScaler(_HasDistribution, MaxAbsScaler):
-    """MaxAbsScaler over pyspark DataFrames (same range-stats pass)."""
+    """MaxAbsScaler over pyspark DataFrames (same range-stats pass, both
+    distributions)."""
 
-    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         if not _is_spark_df(dataset):
@@ -1589,10 +1590,12 @@ class SparkMaxAbsScalerModel(MaxAbsScalerModel):
 
 class SparkRobustScaler(_HasDistribution, RobustScaler):
     """RobustScaler over pyspark DataFrames: the range pass then the
-    histogram pass, each one mapInArrow job; the histogram monoid is
-    additive so the generic sum-merge decoders fold it."""
+    histogram pass. 'driver-merge': two mapInArrow jobs (the histogram
+    monoid is additive, so the generic sum-merge decoders fold it).
+    'mesh-local': one ingest onto the driver mesh serves BOTH passes —
+    pmin/pmax collectives, then psum'd per-shard scatter-add histograms."""
 
-    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         if not _is_spark_df(dataset):
@@ -1608,16 +1611,13 @@ class SparkRobustScaler(_HasDistribution, RobustScaler):
 
         input_col = _resolve_col(self, "inputCol") or "features"
         n = _infer_n(dataset, input_col)
-        rstats = _collect_range_stats(self, dataset)
+        rstats, ing = _collect_range_stats(self, dataset, return_ingest=True)
         mins = np.asarray(rstats.min)
         maxs = np.asarray(rstats.max)
         bins = self.getNumBins()
-        with trace_range("robust scaler histogram"):
-            fn = arrow_fns.HistogramPartitionFn(input_col, mins, maxs, bins)
-            arrays = _collect_stats(
-                dataset.select(input_col), fn, ["hist"], {"hist": (n, bins)}
-            )
-        hist = jnp.asarray(arrays["hist"])
+        hist = _collect_histogram(
+            dataset, ing, input_col, n, mins, maxs, bins
+        )
         jm, jmin, jmax = (jnp.asarray(v) for v in (hist, mins, maxs))
         med = np.asarray(S.quantile_from_histogram(jm, jmin, jmax, 0.5))
         lo = np.asarray(
@@ -1630,6 +1630,30 @@ class SparkRobustScaler(_HasDistribution, RobustScaler):
             uid=self.uid, median=med, range=hi - lo
         )
         return self._copyValues(model)
+
+
+def _collect_histogram(dataset, ing, input_col, n, mins, maxs, bins):
+    """The sketch's second pass: psum'd on-mesh when the range pass already
+    ingested the shards ('mesh-local'), one mapInArrow job otherwise."""
+    with trace_range("quantile sketch histogram"):
+        if ing is not None:
+            import jax.numpy as jnp
+
+            from spark_rapids_ml_tpu.parallel import gram as G
+
+            return np.asarray(
+                G.sharded_histogram(
+                    ing.xs, ing.ws, jnp.asarray(mins), jnp.asarray(maxs),
+                    bins=bins, mesh=ing.mesh,
+                )
+            )
+        arrays = _collect_stats(
+            dataset.select(input_col),
+            arrow_fns.HistogramPartitionFn(input_col, mins, maxs, bins),
+            ["hist"],
+            {"hist": (n, bins)},
+        )
+        return arrays["hist"]
 
 
 class SparkRobustScalerModel(RobustScalerModel):
@@ -1766,13 +1790,32 @@ class SparkVarianceThresholdSelectorModel(VarianceThresholdSelectorModel):
         )
 
 
-def _collect_range_stats(est, dataset):
-    """One mapInArrow range-stats pass + min/max driver fold."""
+def _collect_range_stats(est, dataset, *, return_ingest: bool = False):
+    """The range-statistic pass behind MinMax/MaxAbs/Robust/Discretizer
+    DataFrame fits. ``distribution='driver-merge'``: one mapInArrow pass,
+    min/max driver fold. ``'mesh-local'``: rows stream onto the driver's
+    device mesh and the fold is pmin/pmax collectives in one SPMD program
+    (`parallel.gram.sharded_range_stats`). With ``return_ingest`` the
+    mesh-local ingest is handed back so histogram-needing callers reuse
+    the already-device-resident shards for their second pass."""
     from spark_rapids_ml_tpu.ops import scaler as S
 
     input_col = _resolve_col(est, "inputCol") or "features"
     n = _infer_n(dataset, input_col)
     with trace_range("scaler range stats"):
+        if est.getOrDefault("distribution") == "mesh-local":
+            from spark_rapids_ml_tpu.parallel import gram as G
+
+            from spark_rapids_ml_tpu.spark import ingest as ING
+
+            ing = ING.stream_to_mesh(
+                dataset.select(input_col),
+                features_col=input_col,
+                n=n,
+                with_weights=True,
+            )
+            stats = G.sharded_range_stats(ing.xs, ing.ws, ing.mesh)
+            return (stats, ing) if return_ingest else stats
         arrays = _collect_stats(
             dataset.select(input_col),
             arrow_fns.make_range_stats_partition_fn(input_col),
@@ -1780,7 +1823,8 @@ def _collect_range_stats(est, dataset):
             arrow_fns.range_stats_shapes(n),
             combine=arrow_fns.RANGE_COMBINE,
         )
-    return S.RangeStats(**arrays)
+        stats = S.RangeStats(**arrays)
+    return (stats, None) if return_ingest else stats
 
 
 # ---------------------------------------------------------------------------
@@ -1990,9 +2034,10 @@ class SparkBucketizer(Bucketizer):
 
 class SparkQuantileDiscretizer(_HasDistribution, QuantileDiscretizer):
     """QuantileDiscretizer over pyspark DataFrames: the range pass then the
-    histogram pass (both mapInArrow), quantile grid resolved on the driver."""
+    histogram pass (mapInArrow under 'driver-merge'; one shared mesh ingest
+    under 'mesh-local'), quantile grid resolved on the driver."""
 
-    _ALLOWED_DISTRIBUTIONS = ("driver-merge",)
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         if not _is_spark_df(dataset):
@@ -2008,20 +2053,15 @@ class SparkQuantileDiscretizer(_HasDistribution, QuantileDiscretizer):
 
         input_col = _resolve_col(self, "inputCol") or "features"
         n = _infer_n(dataset, input_col)
-        rstats = _collect_range_stats(self, dataset)
+        rstats, ing = _collect_range_stats(self, dataset, return_ingest=True)
         check_finite_range(rstats.min, rstats.max)
         mins = np.asarray(rstats.min)
         maxs = np.asarray(rstats.max)
-        bins = self.getNumBins()
-        with trace_range("quantile discretizer histogram"):
-            harr = _collect_stats(
-                dataset.select(input_col),
-                arrow_fns.HistogramPartitionFn(input_col, mins, maxs, bins),
-                ["hist"],
-                {"hist": (n, bins)},
-            )
+        hist = _collect_histogram(
+            dataset, ing, input_col, n, mins, maxs, self.getNumBins()
+        )
         splits = splits_from_histogram(
-            harr["hist"], mins, maxs, self.getNumBuckets()
+            hist, mins, maxs, self.getNumBuckets()
         )
         model = SparkQuantileDiscretizerModel(uid=self.uid, splits=splits)
         return self._copyValues(model)

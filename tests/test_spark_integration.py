@@ -1328,3 +1328,54 @@ class TestRangeScalersIntegration:
         for j in range(3):
             frac = np.bincount(got[:, j].astype(int), minlength=4) / len(x)
             np.testing.assert_allclose(frac, 0.25, atol=0.03)
+
+    def test_range_scalers_mesh_local_equals_driver_merge(self, backend):
+        from spark_rapids_ml_tpu.spark import (
+            SparkMaxAbsScaler,
+            SparkMinMaxScaler,
+            SparkQuantileDiscretizer,
+            SparkRobustScaler,
+        )
+
+        rng = np.random.default_rng(68)
+        x = rng.uniform(3.0, 9.0, size=(700, 4))  # positive: pads would fake min=0
+        df = backend.df(
+            [(row.tolist(),) for row in x],
+            backend.features_schema(),
+            partitions=3,
+        )
+
+        mm_d = SparkMinMaxScaler().setInputCol("features").fit(df)
+        mm_m = (
+            SparkMinMaxScaler().setInputCol("features")
+            .setDistribution("mesh-local").fit(df)
+        )
+        np.testing.assert_allclose(mm_m.originalMin, mm_d.originalMin, atol=0)
+        np.testing.assert_allclose(mm_m.originalMax, mm_d.originalMax, atol=0)
+
+        ma_m = (
+            SparkMaxAbsScaler().setInputCol("features")
+            .setDistribution("mesh-local").fit(df)
+        )
+        np.testing.assert_allclose(ma_m.maxAbs, np.abs(x).max(0), atol=1e-12)
+
+        rs_d = (
+            SparkRobustScaler().setInputCol("features")
+            .setWithCentering(True).fit(df)
+        )
+        rs_m = (
+            SparkRobustScaler().setInputCol("features")
+            .setWithCentering(True).setDistribution("mesh-local").fit(df)
+        )
+        np.testing.assert_allclose(rs_m.median, rs_d.median, atol=1e-9)
+        np.testing.assert_allclose(rs_m.range, rs_d.range, atol=1e-9)
+
+        qd_d = (
+            SparkQuantileDiscretizer().setInputCol("features")
+            .setNumBuckets(4).fit(df)
+        )
+        qd_m = (
+            SparkQuantileDiscretizer().setInputCol("features")
+            .setNumBuckets(4).setDistribution("mesh-local").fit(df)
+        )
+        np.testing.assert_allclose(qd_m.splits, qd_d.splits, atol=1e-9)
