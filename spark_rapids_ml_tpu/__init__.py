@@ -76,6 +76,7 @@ def __getattr__(name):
         "Binarizer",
         "DCT",
         "ElementwiseProduct",
+        "PolynomialExpansion",
         "VectorSlicer",
         "RobustScaler",
         "RobustScalerModel",

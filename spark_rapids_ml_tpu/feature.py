@@ -25,6 +25,7 @@ from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
     MinMaxScaler,
     MinMaxScalerModel,
     Normalizer,
+    PolynomialExpansion,
     RobustScaler,
     VectorSlicer,
     RobustScalerModel,
@@ -58,6 +59,7 @@ __all__ = [
     "Binarizer",
     "DCT",
     "ElementwiseProduct",
+    "PolynomialExpansion",
     "VectorSlicer",
     "Bucketizer",
     "QuantileDiscretizer",

@@ -24,6 +24,7 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkLogisticRegression,
     SparkLogisticRegressionModel,
     SparkNormalizer,
+    SparkPolynomialExpansion,
     SparkPCA,
     SparkPCAModel,
     SparkBinarizer,
@@ -81,4 +82,5 @@ __all__ = [
     "SparkTruncatedSVD",
     "SparkTruncatedSVDModel",
     "SparkNormalizer",
+    "SparkPolynomialExpansion",
 ]

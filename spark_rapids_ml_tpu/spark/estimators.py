@@ -54,6 +54,7 @@ from spark_rapids_ml_tpu.models.scaler import (
     MinMaxScaler,
     MinMaxScalerModel,
     Normalizer,
+    PolynomialExpansion,
     RobustScaler,
     RobustScalerModel,
     StandardScaler,
@@ -2003,6 +2004,18 @@ class SparkElementwiseProduct(ElementwiseProduct):
             raise ValueError("scalingVec must be set before transform")
         return _spark_transform(
             self, dataset, self._apply, self.getOutputCol(), scalar=False
+        )
+
+
+class SparkPolynomialExpansion(PolynomialExpansion):
+    """Polynomial expansion over pyspark DataFrames (Spark's exact output
+    ordering — differential-tested against stock MLlib in the CI matrix)."""
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._expand, self.getOutputCol(), scalar=False
         )
 
 

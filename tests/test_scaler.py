@@ -484,3 +484,61 @@ class TestDCT:
             out, scipy_dct(xi.astype(float), type=2, norm="ortho", axis=1),
             atol=1e-10,
         )
+
+
+class TestPolynomialExpansion:
+    def test_spark_documented_ordering(self):
+        # the MLlib doc example: degree 2 on (x, y) -> (x, x*x, y, x*y, y*y)
+        from spark_rapids_ml_tpu.models.scaler import PolynomialExpansion
+
+        out = (
+            PolynomialExpansion().setInputCol("f").setDegree(2)
+            .transform(np.array([[2.0, 3.0]]))
+        )
+        np.testing.assert_array_equal(out, [[2, 4, 3, 6, 9]])
+
+    def test_monomial_set_matches_sklearn(self, rng):
+        from sklearn.preprocessing import PolynomialFeatures
+
+        from spark_rapids_ml_tpu.models.scaler import PolynomialExpansion
+
+        x = rng.normal(size=(50, 4))
+        ours = (
+            PolynomialExpansion().setInputCol("f").setDegree(3).transform(x)
+        )
+        sk = PolynomialFeatures(degree=3, include_bias=False).fit_transform(x)
+        assert ours.shape == sk.shape
+        # same monomial VALUES per row (ordering conventions differ)
+        np.testing.assert_allclose(
+            np.sort(ours, axis=1), np.sort(sk, axis=1), atol=1e-9
+        )
+
+    def test_width_and_cap(self, rng):
+        import math
+
+        from spark_rapids_ml_tpu.models.scaler import PolynomialExpansion
+
+        x = rng.normal(size=(10, 6))
+        out = PolynomialExpansion().setInputCol("f").setDegree(2).transform(x)
+        assert out.shape[1] == math.comb(8, 2) - 1  # C(n+d, d) - 1 = 27
+        with pytest.raises(ValueError, match="cap is 100000"):
+            PolynomialExpansion().setInputCol("f").setDegree(5).transform(
+                rng.normal(size=(2, 64))
+            )
+        with pytest.raises(ValueError, match="degree"):
+            PolynomialExpansion().setDegree(0)
+
+    def test_degree_one_is_identity(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import PolynomialExpansion
+
+        x = rng.normal(size=(20, 5))
+        np.testing.assert_array_equal(
+            PolynomialExpansion().setInputCol("f").setDegree(1).transform(x), x
+        )
+
+    def test_wide_input_no_recursion_limit(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import PolynomialExpansion
+
+        x = rng.normal(size=(3, 1500))
+        out = PolynomialExpansion().setInputCol("f").setDegree(1).transform(x)
+        np.testing.assert_array_equal(out, x)

@@ -1379,3 +1379,49 @@ class TestRangeScalersIntegration:
             .setNumBuckets(4).setDistribution("mesh-local").fit(df)
         )
         np.testing.assert_allclose(qd_m.splits, qd_d.splits, atol=1e-9)
+
+    def test_polynomial_expansion_matches_stock_mllib(self, backend):
+        """The ordering oracle: on the pyspark backend this compares our
+        expansion ELEMENTWISE (order included) against stock MLlib's
+        PolynomialExpansion; on localspark it pins the documented order."""
+        from spark_rapids_ml_tpu.spark import SparkPolynomialExpansion
+
+        rng = np.random.default_rng(69)
+        x = rng.normal(size=(60, 3))
+        df = backend.df(
+            [(row.tolist(),) for row in x],
+            backend.features_schema(),
+            partitions=2,
+        )
+        ours_df = (
+            SparkPolynomialExpansion().setInputCol("features")
+            .setOutputCol("poly").setDegree(3).transform(df)
+        )
+        ours = {
+            tuple(np.round(r["features"], 9)): np.asarray(r["poly"])
+            for r in ours_df.collect()
+        }
+        if backend.name == "pyspark":
+            from pyspark.ml.feature import (
+                PolynomialExpansion as StockPoly,
+            )
+            from pyspark.ml.functions import array_to_vector
+
+            vdf = backend.session.createDataFrame(
+                [(row.tolist(),) for row in x], ["arr"]
+            ).select(array_to_vector("arr").alias("features"))
+            stock = (
+                StockPoly(degree=3, inputCol="features", outputCol="poly")
+                .transform(vdf)
+            )
+            for r in stock.collect():
+                key = tuple(np.round(np.asarray(r["features"].toArray()), 9))
+                np.testing.assert_allclose(
+                    ours[key], np.asarray(r["poly"].toArray()), atol=1e-9,
+                    err_msg="ordering or values diverge from stock MLlib",
+                )
+        else:
+            row0 = x[0]
+            want = [row0[0], row0[0] ** 2, row0[0] ** 3]
+            key = tuple(np.round(row0, 9))
+            np.testing.assert_allclose(ours[key][:3], want, atol=1e-9)
