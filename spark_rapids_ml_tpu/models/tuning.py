@@ -269,19 +269,19 @@ class Evaluator(Params):
 class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
     """rmse (default) / mse / mae / r2 on (labelCol, predictionCol)."""
 
-    metricName = Param("metricName", "rmse|mse|mae|r2", str)
+    metricName = Param("metricName", "rmse|mse|mae|r2|var", str)
 
     def __init__(self, uid: str | None = None, **kwargs):
         super().__init__(uid, **kwargs)
         self._setDefault(metricName="rmse", labelCol="label", predictionCol="prediction")
 
     def setMetricName(self, value: str) -> "RegressionEvaluator":
-        if value not in ("rmse", "mse", "mae", "r2"):
-            raise ValueError("metricName must be rmse, mse, mae, or r2")
+        if value not in ("rmse", "mse", "mae", "r2", "var"):
+            raise ValueError("metricName must be rmse, mse, mae, r2, or var")
         return self._set(metricName=value)
 
     def isLargerBetter(self) -> bool:
-        return self.getOrDefault("metricName") == "r2"
+        return self.getOrDefault("metricName") in ("r2", "var")
 
     def evaluate(self, dataset, predictions=None) -> float:
         y, p, w = self._labeled_pair(dataset, predictions)
@@ -297,12 +297,35 @@ class RegressionEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         if metric == "mae":
             return float(np.sum(w * np.abs(err)) / wsum)
         ybar = float(np.sum(w * y) / wsum)
+        if metric == "var":
+            # Spark's explainedVariance: mean (pred - label-mean)^2
+            return float(np.sum(w * (p - ybar) ** 2) / wsum)
         ss_tot = float(np.sum(w * (y - ybar) ** 2))
         return 1.0 - float(np.sum(w * err**2)) / (ss_tot if ss_tot > 0 else 1.0)
 
 
+def _tied_group_weights(
+    p: np.ndarray, w: np.ndarray, pos_mask: np.ndarray, *, descending: bool
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-tied-score-group (positive-weight, negative-weight) sums in
+    score order — the ONE sort/group/accumulate kernel both binary curve
+    metrics (ROC's Mann–Whitney, PR's threshold sweep) share, so tie and
+    weight handling can never diverge between them."""
+    key = -p if descending else p
+    order = np.argsort(key, kind="mergesort")
+    ks, ws, pm = key[order], w[order], pos_mask[order]
+    _, group = np.unique(ks, return_inverse=True)
+    n_groups = group.max() + 1
+    g_pos = np.zeros(n_groups)
+    g_neg = np.zeros(n_groups)
+    np.add.at(g_pos, group, np.where(pm, ws, 0.0))
+    np.add.at(g_neg, group, np.where(~pm, ws, 0.0))
+    return g_pos, g_neg
+
+
 class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
-    """areaUnderROC (default, rank statistic over scores) or accuracy.
+    """areaUnderROC (default, rank statistic over scores), areaUnderPR
+    (trapezoid over the per-threshold precision/recall curve), or accuracy.
 
     For areaUnderROC, scores come from ``rawPredictionCol`` when the
     dataset carries it — a probability or raw-margin VECTOR column (the
@@ -314,7 +337,9 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
     degenerate two-level AUC). ``accuracy`` always uses ``predictionCol``.
     """
 
-    metricName = Param("metricName", "areaUnderROC|accuracy", str)
+    metricName = Param(
+        "metricName", "areaUnderROC|areaUnderPR|accuracy", str
+    )
     rawPredictionCol = Param(
         "rawPredictionCol",
         "score column for areaUnderROC: vector (last element used) or "
@@ -330,8 +355,10 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         )
 
     def setMetricName(self, value: str) -> "BinaryClassificationEvaluator":
-        if value not in ("areaUnderROC", "accuracy"):
-            raise ValueError("metricName must be areaUnderROC or accuracy")
+        if value not in ("areaUnderROC", "areaUnderPR", "accuracy"):
+            raise ValueError(
+                "metricName must be areaUnderROC, areaUnderPR, or accuracy"
+            )
         return self._set(metricName=value)
 
     def setRawPredictionCol(self, value: str) -> "BinaryClassificationEvaluator":
@@ -379,7 +406,8 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         warnings.warn(
             "BinaryClassificationEvaluator: no score column found (looked "
             f"for {self.getOrDefault('rawPredictionCol')!r} and "
-            "'probability'); areaUnderROC degrades to the two-level AUC of "
+            "'probability'); areaUnderROC/areaUnderPR degrade to the "
+            "two-level curve of "
             "hard labels. Point rawPredictionCol at your model's "
             "probability output (e.g. setRawPredictionCol('probability') "
             "with LogisticRegression().setProbabilityCol('probability')).",
@@ -400,6 +428,8 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
             y, p, w = self._score_pair(dataset)
         if w is None:
             w = np.ones_like(p)
+        if self.getOrDefault("metricName") == "areaUnderPR":
+            return self._area_under_pr(y, p, w)
         pos_mask = y >= 0.5
         w_pos_total = float(w[pos_mask].sum())
         w_neg_total = float(w[~pos_mask].sum())
@@ -408,20 +438,32 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         # Weighted Mann–Whitney with tie correction:
         # AUC = Σ_{i∈pos} w_i·(W_neg(score<s_i) + ½·W_neg(score=s_i)) / (W⁺·W⁻)
         # computed by one sort over tied-score groups.
-        order = np.argsort(p, kind="mergesort")
-        ps, ws, pm = p[order], w[order], pos_mask[order]
-        w_neg = np.where(~pm, ws, 0.0)
-        w_pos = np.where(pm, ws, 0.0)
-        # group boundaries of equal scores
-        _, group = np.unique(ps, return_inverse=True)
-        n_groups = group.max() + 1
-        gw_neg = np.zeros(n_groups)
-        gw_pos = np.zeros(n_groups)
-        np.add.at(gw_neg, group, w_neg)
-        np.add.at(gw_pos, group, w_pos)
+        gw_pos, gw_neg = _tied_group_weights(p, w, pos_mask, descending=False)
         cum_neg_before = np.concatenate([[0.0], np.cumsum(gw_neg)[:-1]])
         auc_num = float(np.sum(gw_pos * (cum_neg_before + 0.5 * gw_neg)))
         return auc_num / (w_pos_total * w_neg_total)
+
+    @staticmethod
+    def _area_under_pr(y, p, w) -> float:
+        """Weighted PR AUC by trapezoid over the per-threshold
+        (recall, precision) points, descending thresholds, with the curve
+        anchored at (0, precision-of-first-group) — Spark's linear
+        interpolation convention (BinaryClassificationMetrics.pr), vs the
+        step interpolation some libraries use; differences show up only in
+        the last decimals on tied-score data. A positive-free dataset
+        scores 0.0."""
+        pos = y >= 0.5
+        w_pos_total = float(w[pos].sum())
+        if w_pos_total == 0.0:
+            return 0.0
+        g_tp, g_neg = _tied_group_weights(p, w, pos, descending=True)
+        tp = np.cumsum(g_tp)
+        retrieved = np.cumsum(g_tp + g_neg)
+        recall = tp / w_pos_total
+        precision = tp / retrieved
+        r = np.concatenate([[0.0], recall])
+        pr = np.concatenate([[precision[0]], precision])
+        return float(np.sum(np.diff(r) * 0.5 * (pr[1:] + pr[:-1])))
 
 
 class MulticlassClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):

@@ -578,6 +578,52 @@ class TestBinaryEvaluatorRawPrediction:
         out = LogisticRegression().setRegParam(0.01).fit(df).transform(df)
         # default rawPredictionCol="rawPrediction" AND the 'probability'
         # fallback are absent -> degrade to predictionCol, LOUDLY
-        with pytest.warns(UserWarning, match="degrades to the two-level"):
+        with pytest.warns(UserWarning, match="degrade to the two-level"):
             auc = BinaryClassificationEvaluator().evaluate(out)
         assert 0.5 <= auc <= 1.0
+
+
+class TestNewEvaluatorMetrics:
+    def test_regression_var_matches_spark_definition(self, rng):
+        x = rng.normal(size=200)
+        y = 2 * x + rng.normal(size=200) * 0.1
+        pred = 2 * x
+        got = RegressionEvaluator(metricName="var").evaluate(
+            (None, y), predictions=pred
+        )
+        want = np.mean((pred - y.mean()) ** 2)
+        assert abs(got - want) < 1e-12
+        assert RegressionEvaluator(metricName="var").isLargerBetter()
+
+    def test_weighted_var_matches_duplication(self, rng):
+        y = rng.normal(size=60)
+        pred = y + rng.normal(size=60) * 0.2
+        w = rng.integers(1, 4, size=60).astype(float)
+        got = RegressionEvaluator(metricName="var", weightCol="w").evaluate(
+            (None, y, w), predictions=pred
+        )
+        rep = np.repeat(np.arange(60), w.astype(int))
+        want = RegressionEvaluator(metricName="var").evaluate(
+            (None, y[rep]), predictions=pred[rep]
+        )
+        assert abs(got - want) < 1e-12
+
+    def test_area_under_pr_perfect_and_sklearn_close(self, rng):
+        from sklearn.metrics import auc as sk_auc
+        from sklearn.metrics import precision_recall_curve
+
+        y = (rng.uniform(size=500) < 0.3).astype(float)
+        ev = BinaryClassificationEvaluator(metricName="areaUnderPR")
+        # perfect ranking -> 1.0
+        assert abs(ev.evaluate((None, y), predictions=y) - 1.0) < 1e-12
+        # noisy scores: trapezoid over the same curve sklearn computes
+        scores = y + rng.normal(size=500) * 0.8
+        got = ev.evaluate((None, y), predictions=scores)
+        prec, rec, _ = precision_recall_curve(y, scores)
+        want = sk_auc(rec, prec)  # sklearn's trapezoid over its PR points
+        assert abs(got - want) < 0.01
+        assert 0.3 < got <= 1.0
+
+    def test_area_under_pr_no_positives_is_zero(self):
+        ev = BinaryClassificationEvaluator(metricName="areaUnderPR")
+        assert ev.evaluate((None, np.zeros(10)), predictions=np.arange(10.0)) == 0.0
