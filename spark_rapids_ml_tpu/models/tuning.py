@@ -459,6 +459,11 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol, HasPredictionCol):
         g_tp, g_neg = _tied_group_weights(p, w, pos, descending=True)
         tp = np.cumsum(g_tp)
         retrieved = np.cumsum(g_tp + g_neg)
+        # leading groups made ENTIRELY of zero-weight rows carry no mass:
+        # keeping them would anchor the curve at 0/0 = NaN and poison the
+        # trapezoid (validate_weights allows individual zero weights)
+        nz = retrieved > 0
+        tp, retrieved = tp[nz], retrieved[nz]
         recall = tp / w_pos_total
         precision = tp / retrieved
         r = np.concatenate([[0.0], recall])
@@ -696,7 +701,8 @@ def _fit_and_eval(estimator, params, evaluator, train, val):
     wants_probability_surface = (
         (
             isinstance(evaluator, BinaryClassificationEvaluator)
-            and evaluator.getOrDefault("metricName") == "areaUnderROC"
+            and evaluator.getOrDefault("metricName")
+            in ("areaUnderROC", "areaUnderPR")
         )
         or (
             isinstance(evaluator, MulticlassClassificationEvaluator)

@@ -627,3 +627,27 @@ class TestNewEvaluatorMetrics:
     def test_area_under_pr_no_positives_is_zero(self):
         ev = BinaryClassificationEvaluator(metricName="areaUnderPR")
         assert ev.evaluate((None, np.zeros(10)), predictions=np.arange(10.0)) == 0.0
+
+    def test_area_under_pr_zero_weight_leading_group(self):
+        ev = BinaryClassificationEvaluator(
+            metricName="areaUnderPR", weightCol="w"
+        )
+        got = ev.evaluate(
+            (None, np.array([0.0, 1.0, 0.0]), np.array([0.0, 1.0, 1.0])),
+            predictions=np.array([3.0, 2.0, 1.0]),
+        )
+        assert np.isfinite(got)
+        assert abs(got - 1.0) < 1e-12  # w>0 subset ranks perfectly
+
+    def test_cv_area_under_pr_ranks_on_probability_surface(self, rng):
+        from spark_rapids_ml_tpu.models.tuning import _fit_and_eval
+
+        x = rng.normal(size=(400, 4))
+        y = (x[:, 0] + rng.normal(size=400) > 0).astype(float)
+        ev = BinaryClassificationEvaluator(metricName="areaUnderPR")
+        model, pr_scores = _fit_and_eval(
+            LogisticRegression(), {}, ev, (x[:300], y[:300]), (x[300:], y[300:])
+        )
+        hard = (model.predict_proba_matrix(x[300:]) >= 0.5).astype(float)
+        pr_hard = ev.evaluate((None, y[300:]), predictions=hard)
+        assert pr_scores > pr_hard  # probability surface, not 0/1 labels
