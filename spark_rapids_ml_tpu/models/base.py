@@ -34,6 +34,19 @@ _SPARK_ML_CLASSES: dict[str, str] = {
 }
 
 
+def _resolve_load_class(cls, klass, path: str):
+    """THE load-time class policy, shared by both layouts: the recorded
+    (or mapped) class wins when it satisfies the caller; a caller that is
+    a RICHER subclass upgrades the load (wrappers add behavior, not state
+    — the train-local / serve-on-Spark handoff depends on this); anything
+    else is a mismatch. ``Saveable`` itself accepts everything."""
+    if cls is Saveable or issubclass(klass, cls):
+        return klass
+    if issubclass(cls, klass):
+        return cls
+    raise TypeError(f"{path} holds a {klass.__name__}, not a {cls.__name__}")
+
+
 class MLWriter:
     """Spark-style fluent writer: ``model.write().overwrite().save(path)``.
 
@@ -117,8 +130,7 @@ class Saveable(Params):
         meta = persistence.load_metadata(path)
         module, _, qualname = meta["class"].rpartition(".")
         klass = getattr(importlib.import_module(module), qualname)
-        if not issubclass(klass, cls) and cls is not Saveable:
-            raise TypeError(f"{path} holds a {klass.__name__}, not a {cls.__name__}")
+        klass = _resolve_load_class(cls, klass, path)
         data = {}
         if persistence._FS(path).exists("data.parquet"):
             data = persistence.load_arrays(path)
@@ -139,13 +151,7 @@ class Saveable(Params):
             )
         module, _, qualname = target.rpartition(".")
         klass = getattr(importlib.import_module(module), qualname)
-        # called through a SUBCLASS of the mapped class (SparkPCAModel.load
-        # on a stock pyspark save), instantiate that subclass — the mapping
-        # names the base implementation, not the only legal receiver
-        if cls is not Saveable and issubclass(cls, klass):
-            klass = cls
-        elif not issubclass(klass, cls) and cls is not Saveable:
-            raise TypeError(f"{path} holds a {klass.__name__}, not a {cls.__name__}")
+        klass = _resolve_load_class(cls, klass, path)
         instance = klass._fromSparkML(meta, persistence.load_spark_ml_data(path))
         _restore_spark_params(instance, meta)
         return instance

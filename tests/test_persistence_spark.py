@@ -407,3 +407,52 @@ class TestStockSparkMLLoadsOurSaves:
         np.testing.assert_allclose(
             got, x[:, ours.selectedFeatures], atol=1e-12
         )
+
+
+class TestWrapperUpgradeLoad:
+    def test_core_native_save_loads_as_spark_wrapper(self, tmp_path, rng):
+        """The train-local / serve-on-Spark handoff: a native save written
+        by a CORE model must load through its Spark wrapper class (which
+        only adds DataFrame behavior), across the whole family."""
+        from spark_rapids_ml_tpu.models.discretizer import QuantileDiscretizer
+        from spark_rapids_ml_tpu.models.scaler import (
+            Imputer,
+            MinMaxScaler,
+            RobustScaler,
+        )
+        from spark_rapids_ml_tpu.spark import (
+            SparkImputerModel,
+            SparkMinMaxScalerModel,
+            SparkPCAModel,
+            SparkQuantileDiscretizerModel,
+            SparkRobustScalerModel,
+        )
+
+        x = rng.uniform(1, 9, size=(150, 4))
+        cases = [
+            (PCA().setInputCol("f").setK(2).fit(x), SparkPCAModel),
+            (MinMaxScaler().setInputCol("f").fit(x), SparkMinMaxScalerModel),
+            (RobustScaler().setInputCol("f").fit(x), SparkRobustScalerModel),
+            (Imputer().setInputCol("f").fit(x), SparkImputerModel),
+            (
+                QuantileDiscretizer().setInputCol("f").setNumBuckets(3).fit(x),
+                SparkQuantileDiscretizerModel,
+            ),
+        ]
+        for i, (model, SparkCls) in enumerate(cases):
+            p = str(tmp_path / f"m{i}")
+            model.save(p)  # native layout, core class recorded
+            loaded = SparkCls.load(p)
+            assert isinstance(loaded, SparkCls), SparkCls.__name__
+            np.testing.assert_allclose(
+                loaded.transform(x), model.transform(x), atol=0,
+                err_msg=SparkCls.__name__,
+            )
+
+    def test_mismatched_class_still_rejected(self, pca_model, tmp_path, rng):
+        from spark_rapids_ml_tpu.models.scaler import MinMaxScalerModel
+
+        p = str(tmp_path / "pca")
+        pca_model.save(p)
+        with pytest.raises(TypeError, match="not a MinMaxScalerModel"):
+            MinMaxScalerModel.load(p)
