@@ -65,6 +65,10 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import kmeans
 
         return getattr(kmeans, name)
+    if name in ("NearestNeighbors", "NearestNeighborsModel"):
+        from spark_rapids_ml_tpu.models import neighbors
+
+        return getattr(neighbors, name)
     if name in (
         "StandardScaler",
         "StandardScalerModel",

@@ -1,0 +1,13 @@
+"""Drop-in k-NN namespace mirroring ``spark_rapids_ml.knn``.
+
+The modern spark-rapids-ml package exposes its exact brute-force
+NearestNeighbors under ``spark_rapids_ml.knn``; this shim gives users of
+that API the same import path here.
+"""
+
+from spark_rapids_ml_tpu.models.neighbors import (  # noqa: F401
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
+
+__all__ = ["NearestNeighbors", "NearestNeighborsModel"]

@@ -1,0 +1,233 @@
+"""NearestNeighbors differential tests — NumPy full-matrix oracle.
+
+Strategy per SURVEY.md §4: differential against an exhaustive host oracle
+(full [q, rows] distance matrix + argsort), the same role CPU Spark MLlib
+plays for PCA. Random float data makes distance ties measure-zero, so
+index equality is exact.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.models.neighbors import (
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
+from spark_rapids_ml_tpu.ops import neighbors as NN
+
+
+def _oracle(queries, corpus, k, metric):
+    """Exhaustive k-NN on the host: (distances, indices), best-first."""
+    if metric == "cosine":
+        qn = queries / np.maximum(np.linalg.norm(queries, axis=1, keepdims=True), 1e-300)
+        cn = corpus / np.maximum(np.linalg.norm(corpus, axis=1, keepdims=True), 1e-300)
+        d = 1.0 - qn @ cn.T
+        order = np.argsort(d, axis=1)[:, :k]
+    elif metric == "inner_product":
+        d = queries @ corpus.T
+        order = np.argsort(-d, axis=1)[:, :k]
+    else:
+        d = ((queries[:, None, :] - corpus[None, :, :]) ** 2).sum(-1)
+        if metric == "euclidean":
+            d = np.sqrt(d)
+        order = np.argsort(d, axis=1)[:, :k]
+    return np.take_along_axis(d, order, axis=1), order
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    corpus = rng.normal(size=(500, 24))
+    queries = rng.normal(size=(73, 24))
+    return corpus, queries
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "sqeuclidean", "cosine", "inner_product"])
+def test_kneighbors_matches_oracle(data, metric):
+    corpus, queries = data
+    k = 9
+    model = (
+        NearestNeighbors().setK(k).setMetric(metric).fit(corpus)
+    )
+    dists, idx = model.kneighbors(queries)
+    ref_d, ref_i = _oracle(queries, corpus, k, metric)
+    np.testing.assert_array_equal(idx, ref_i)
+    np.testing.assert_allclose(dists, ref_d, rtol=1e-8, atol=1e-10)
+
+
+def test_kernel_blocked_scan_matches_single_block(data):
+    """The streaming tournament must be block-size invariant."""
+    corpus, queries = data
+    valid = np.ones(corpus.shape[0], dtype=bool)
+    s1, i1 = NN.knn_topk(
+        jnp.asarray(queries), jnp.asarray(corpus), jnp.asarray(valid), 7,
+        block_rows=64,
+    )
+    s2, i2 = NN.knn_topk(
+        jnp.asarray(queries), jnp.asarray(corpus), jnp.asarray(valid), 7,
+        block_rows=500,
+    )
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-12)
+
+
+def test_kernel_valid_mask_excludes_rows(data):
+    corpus, queries = data
+    valid = np.ones(corpus.shape[0], dtype=bool)
+    valid[::2] = False  # half the corpus is padding/excluded
+    _, idx = NN.knn_topk(
+        jnp.asarray(queries), jnp.asarray(corpus), jnp.asarray(valid), 5,
+    )
+    assert np.all(np.asarray(idx) % 2 == 1)
+
+
+def test_cosine_anticorrelated_and_zero_rows():
+    """Cosine edge semantics: anti-parallel → 2, zero row → exactly 1 from
+    everything (ranked behind orthogonal-but-nonzero only by tie order)."""
+    corpus = np.array([[1.0, 0.0], [-1.0, 0.0], [0.0, 1.0], [0.0, 0.0]])
+    model = NearestNeighbors().setMetric("cosine").setK(4).fit(corpus)
+    d, i = model.kneighbors(np.array([[2.0, 0.0]]))
+    by_item = dict(zip(i[0], d[0]))
+    assert by_item[0] == pytest.approx(0.0)
+    assert by_item[1] == pytest.approx(2.0)
+    assert by_item[2] == pytest.approx(1.0)
+    assert by_item[3] == pytest.approx(1.0)
+    # ordering is best-first: parallel, then the two at 1, then anti-parallel
+    assert i[0, 0] == 0 and i[0, 3] == 1
+
+
+def test_id_col_with_partition_list():
+    """idCol extraction must work for the list-of-Arrow-partitions input
+    form that PartitionedDataset.from_any supports."""
+    pa = pytest.importorskip("pyarrow")
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(30, 4))
+    ids = np.arange(30) + 100
+    parts = [
+        pa.table({"features": list(x[:17]), "id": ids[:17]}),
+        pa.table({"features": list(x[17:]), "id": ids[17:]}),
+    ]
+    model = (
+        NearestNeighbors()
+        .setInputCol("features")
+        .setIdCol("id")
+        .setK(1)
+        .fit(parts)
+    )
+    _, got = model.kneighbors(x + 1e-9)
+    np.testing.assert_array_equal(got[:, 0], ids)
+
+
+def test_kneighbors_k_override_and_validation(data):
+    corpus, queries = data
+    model = NearestNeighbors().setK(3).fit(corpus)
+    d5, i5 = model.kneighbors(queries, k=5)
+    assert d5.shape == (len(queries), 5)
+    d3, _ = model.kneighbors(queries)
+    np.testing.assert_allclose(d3, d5[:, :3])
+    with pytest.raises(ValueError, match="k="):
+        model.kneighbors(queries, k=len(corpus) + 1)
+    with pytest.raises(ValueError, match="features"):
+        model.kneighbors(np.zeros((2, 3)))
+    with pytest.raises(ValueError, match="exceeds the fitted item count"):
+        NearestNeighbors().setK(10).fit(corpus[:4])
+
+
+def test_id_col_maps_indices():
+    rng = np.random.default_rng(3)
+    pd = pytest.importorskip("pandas")
+    corpus = rng.normal(size=(40, 8))
+    ids = rng.permutation(1000)[:40]
+    df = pd.DataFrame(
+        {"features": list(corpus), "item_id": ids}
+    )
+    model = (
+        NearestNeighbors()
+        .setInputCol("features")
+        .setIdCol("item_id")
+        .setK(4)
+        .fit(df)
+    )
+    queries = corpus[:6] + 1e-9
+    _, got = model.kneighbors(pd.DataFrame({"features": list(queries)}))
+    assert got.dtype == np.int64
+    assert np.array_equal(got[:, 0], ids[:6])  # self is its own 1-NN
+
+
+def test_transform_appends_arrays(data):
+    pd = pytest.importorskip("pandas")
+    corpus, queries = data
+    model = NearestNeighbors().setInputCol("features").setK(4).fit(
+        pd.DataFrame({"features": list(corpus)})
+    )
+    out = model.transform(pd.DataFrame({"features": list(queries)}))
+    assert "indices" in out.columns and "distances" in out.columns
+    ref_d, ref_i = _oracle(queries, corpus, 4, "euclidean")
+    np.testing.assert_array_equal(np.stack(out["indices"]), ref_i)
+    np.testing.assert_allclose(np.stack(out["distances"]), ref_d, rtol=1e-8)
+
+
+def test_persistence_roundtrip(tmp_path, data):
+    corpus, queries = data
+    model = NearestNeighbors().setK(6).setMetric("cosine").fit(corpus)
+    path = str(tmp_path / "nn")
+    model.save(path)
+    loaded = NearestNeighborsModel.load(path)
+    assert loaded.getMetric() == "cosine"
+    d0, i0 = model.kneighbors(queries)
+    d1, i1 = loaded.kneighbors(queries)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_allclose(d0, d1)
+
+
+def test_sharded_knn_matches_local(data):
+    """Mesh-sharded corpus (8 virtual devices) must agree with the
+    single-device kernel exactly — the distributed top-k merge is lossless."""
+    import jax
+    from spark_rapids_ml_tpu.parallel.mesh import create_mesh
+    from spark_rapids_ml_tpu.parallel.neighbors import make_sharded_knn
+
+    corpus, queries = data
+    k = 11
+    ndev = len(jax.devices())
+    mesh = create_mesh(data=ndev)
+    # equal shards with per-shard pad rows (valid=0) — the wrapper's layout
+    per = -(-corpus.shape[0] // ndev)
+    padded = np.zeros((per * ndev, corpus.shape[1]))
+    padded[: corpus.shape[0]] = corpus
+    valid = np.zeros(per * ndev, dtype=bool)
+    valid[: corpus.shape[0]] = True
+    # interleave so every shard holds a contiguous slice of the padded array
+    run = make_sharded_knn(mesh, k)
+    scores, idx = run(
+        jnp.asarray(padded), jnp.asarray(valid), jnp.asarray(queries)
+    )
+    ref_d, ref_i = _oracle(queries, corpus, k, "sqeuclidean")
+    np.testing.assert_array_equal(np.asarray(idx), ref_i)
+    np.testing.assert_allclose(-np.asarray(scores), ref_d, rtol=1e-9, atol=1e-12)
+
+
+def test_sharded_knn_k_larger_than_shard():
+    """k greater than any single shard's rows: shards pad candidates with
+    −inf and the merge still returns the global exact set."""
+    import jax
+    from spark_rapids_ml_tpu.parallel.mesh import create_mesh
+    from spark_rapids_ml_tpu.parallel.neighbors import make_sharded_knn
+
+    rng = np.random.default_rng(11)
+    ndev = len(jax.devices())
+    corpus = rng.normal(size=(ndev * 3, 5))  # 3 rows per shard
+    queries = rng.normal(size=(9, 5))
+    k = 7  # > 3 per-shard rows
+    mesh = create_mesh(data=ndev)
+    run = make_sharded_knn(mesh, k)
+    scores, idx = run(
+        jnp.asarray(corpus),
+        jnp.asarray(np.ones(len(corpus), dtype=bool)),
+        jnp.asarray(queries),
+    )
+    ref_d, ref_i = _oracle(queries, corpus, k, "sqeuclidean")
+    np.testing.assert_array_equal(np.asarray(idx), ref_i)
+    np.testing.assert_allclose(-np.asarray(scores), ref_d, rtol=1e-9, atol=1e-12)
