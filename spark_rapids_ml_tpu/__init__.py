@@ -69,6 +69,10 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import neighbors
 
         return getattr(neighbors, name)
+    if name in ("DBSCAN", "DBSCANModel"):
+        from spark_rapids_ml_tpu.models import dbscan
+
+        return getattr(dbscan, name)
     if name in (
         "StandardScaler",
         "StandardScalerModel",

@@ -1,0 +1,153 @@
+"""DBSCAN estimator/model — the spark-rapids-ml density-clustering family.
+
+API mirrors spark-rapids-ml's cuML-backed DBSCAN: ``eps`` /
+``minSamples`` / ``metric`` params, ``fit`` is parameter capture (density
+clustering has no training phase separate from inference), and
+``DBSCANModel.transform(dataset)`` runs the clustering on the dataset it is
+given, appending an integer cluster column (−1 = noise) — spark-rapids-ml
+documents the same "call transform on the dataframe you fit" contract.
+Kernels: ops/dbscan.py (blocked MXU eps-neighborhood + min-label
+propagation); parallel/dbscan.py runs the identical recursion mesh-sharded.
+
+Determinism note: cluster ids are assigned by smallest member core-row
+index and border rows join their smallest core neighbor's cluster, so
+output is invariant to partitioning/order — stricter than sklearn, whose
+border assignment is scan-order dependent.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import HasInputCol, Param
+from spark_rapids_ml_tpu.ops import dbscan as DB
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+_METRICS = ("euclidean", "sqeuclidean")
+
+
+class _DBSCANParams(HasInputCol):
+    eps = Param("eps", "neighborhood radius", float)
+    minSamples = Param(
+        "minSamples",
+        "weighted neighbor mass (self included) required for a core point",
+        float,
+    )
+    metric = Param("metric", "'euclidean' (default) or 'sqeuclidean'", str)
+    predictionCol = Param("predictionCol", "output cluster-id column", str)
+    weightCol = Param(
+        "weightCol",
+        "optional sample-weight column: a point is core when the WEIGHT SUM "
+        "of its eps-neighborhood reaches minSamples; weights gate core "
+        "status only, so zero-weight points still receive border labels "
+        "(sklearn sample_weight semantics)",
+        str,
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            eps=0.5, minSamples=5.0, metric="euclidean",
+            predictionCol="prediction",
+        )
+
+    def getEps(self) -> float:
+        return self.getOrDefault("eps")
+
+    def getMinSamples(self) -> float:
+        return self.getOrDefault("minSamples")
+
+    def getMetric(self) -> str:
+        return self.getOrDefault("metric")
+
+    def getPredictionCol(self) -> str:
+        return self.getOrDefault("predictionCol")
+
+
+class DBSCAN(_DBSCANParams, Estimator):
+    def setEps(self, value: float) -> "DBSCAN":
+        if value <= 0:
+            raise ValueError(f"eps must be > 0, got {value}")
+        return self._set(eps=float(value))
+
+    def setMinSamples(self, value: float) -> "DBSCAN":
+        if value < 1:
+            raise ValueError(f"minSamples must be >= 1, got {value}")
+        return self._set(minSamples=float(value))
+
+    def setMetric(self, value: str) -> "DBSCAN":
+        if value not in _METRICS:
+            raise ValueError(f"metric must be one of {_METRICS}, got {value!r}")
+        return self._set(metric=value)
+
+    def setPredictionCol(self, value: str) -> "DBSCAN":
+        return self._set(predictionCol=value)
+
+    def setWeightCol(self, value: str) -> "DBSCAN":
+        return self._set(weightCol=value)
+
+    def fit(self, dataset: Any = None) -> "DBSCANModel":
+        """Parameter capture (the spark-rapids-ml shape: the clustering
+        itself runs in ``DBSCANModel.transform``); ``dataset`` is accepted
+        for Estimator-contract compatibility and ignored."""
+        return self._copyValues(DBSCANModel(uid=self.uid))
+
+
+class DBSCANModel(_DBSCANParams, Model):
+    def _cluster_matrix(
+        self, mat: np.ndarray, weights: np.ndarray | None
+    ) -> np.ndarray:
+        fdt = columnar.float_dtype_for(mat.dtype)
+        x = mat.astype(fdt, copy=False)
+        eps = self.getEps()
+        eps_sq = eps * eps if self.getMetric() == "euclidean" else eps
+        padded, true_rows = columnar.pad_rows(x)
+        w = np.zeros(padded.shape[0], fdt)
+        w[:true_rows] = 1.0 if weights is None else weights
+        valid = np.zeros(padded.shape[0], bool)
+        valid[:true_rows] = True
+        labels = np.asarray(
+            DB.dbscan_labels(
+                jnp.asarray(padded),
+                jnp.asarray(w),
+                jnp.asarray(valid),
+                jnp.asarray(np.asarray(eps_sq, fdt)),
+                jnp.asarray(np.asarray(self.getMinSamples(), fdt)),
+            )
+        )[:true_rows]
+        return _relabel_consecutive(labels)
+
+    def clusterLabels(self, dataset: Any) -> np.ndarray:
+        """[rows] int32 cluster ids (−1 = noise) for ``dataset`` — the
+        ndarray spelling of ``transform``."""
+        mat = columnar.extract_matrix(dataset, self._paramMap.get("inputCol"))
+        weight_col = self._paramMap.get("weightCol")
+        weights = None
+        if weight_col is not None:
+            weights = columnar.validate_weights(
+                columnar.extract_vector(dataset, weight_col), mat.shape[0]
+            )
+        with trace_range("dbscan cluster"):
+            return self._cluster_matrix(mat, weights)
+
+    def transform(self, dataset: Any) -> Any:
+        labels = self.clusterLabels(dataset)
+        return columnar.append_columns(
+            dataset, [(self.getPredictionCol(), labels)]
+        )
+
+
+def _relabel_consecutive(labels: np.ndarray) -> np.ndarray:
+    """Map cluster ids (smallest-core-index values) onto 0..C−1, ascending —
+    deterministic regardless of data scale; −1 noise passes through."""
+    ids = np.unique(labels[labels >= 0])
+    remap = np.full(int(ids.max()) + 1 if len(ids) else 0, -1, dtype=np.int32)
+    remap[ids] = np.arange(len(ids), dtype=np.int32)
+    out = labels.copy()
+    out[labels >= 0] = remap[labels[labels >= 0]]
+    return out

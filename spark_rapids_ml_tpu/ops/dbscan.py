@@ -1,0 +1,194 @@
+"""DBSCAN device kernels — density clustering without an n×n adjacency.
+
+The modern spark-rapids-ml family ships DBSCAN on cuML's GPU kernels
+(pairwise eps-neighborhood + BFS over the core graph); the 22.12 reference
+this framework re-designs stops at PCA (SURVEY.md §2), so this is a
+capability-add in the KMeans/NearestNeighbors spirit.
+
+TPU-first formulation — three observations drive the design:
+
+1. the eps-neighborhood test is the same ‖x−y‖² cross-term expansion every
+   other kernel here uses: one MXU matmul per (row block, corpus block)
+   tile pair, double-blocked under ``lax.scan`` so only [blk, blk] tiles
+   ever exist — no n×n adjacency in HBM;
+2. BFS (the GPU formulation) is hostile to XLA's static control flow, but
+   connected components over the core-point graph are equally reachable by
+   MIN-LABEL PROPAGATION: every core point repeatedly takes the smallest
+   label among its core eps-neighbors. Each sweep is the same blocked
+   distance pass with a masked min instead of a count;
+3. plain propagation needs O(graph diameter) sweeps; pointer jumping
+   (``labels = labels[labels]``, the Shiloach–Vishkin shortcut) after each
+   sweep collapses label chains logarithmically, because a label is always
+   the INDEX of another core row in the same cluster.
+
+Labels out: cluster id = smallest core-row index in the cluster (relabeled
+consecutively by the model layer), border rows take the smallest core
+neighbor's cluster (deterministic, where sklearn's scan-order assignment is
+not), noise = −1. ``w`` is sklearn-style sample_weight: a row is core when
+the WEIGHT SUM of its eps-neighborhood (self included) reaches ``min_pts``
+— weights gate CORE status only, so a zero-weight row within eps of a core
+point is still labeled (sklearn semantics). ``valid`` is the separate pad
+mask: invalid rows contribute nothing, can't be core, and come out −1.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_ml_tpu.ops.kmeans import pairwise_sq_dists
+
+
+def _block_pairs(x: jax.Array, block_rows: int):
+    """Pad rows to a block multiple and reshape to [nblk, blk, n]."""
+    rows, n = x.shape
+    blk = min(block_rows, rows)
+    nblk = -(-rows // blk)
+    xp = jnp.pad(x, ((0, nblk * blk - rows), (0, 0)))
+    return xp.reshape(nblk, blk, n), blk, nblk
+
+
+def make_count_fn(eps_sq):
+    """Tile accumulator: weighted eps-neighborhood mass. Shared by the
+    single-device kernel and the mesh shards (parallel/dbscan.py)."""
+
+    def count_fn(acc, d, extras):
+        return acc + jnp.sum(
+            jnp.where(d <= eps_sq, extras["w"][None, :], 0.0), axis=1
+        )
+
+    return count_fn
+
+
+def make_min_fn(eps_sq, sentinel):
+    """Tile accumulator: smallest label among core eps-neighbors. Shared by
+    the single-device kernel and the mesh shards."""
+
+    def min_fn(acc, d, extras):
+        cand = jnp.where(
+            (d <= eps_sq) & extras["core"].astype(bool)[None, :],
+            extras["labels"][None, :],
+            sentinel,
+        )
+        return jnp.minimum(acc, jnp.min(cand, axis=1))
+
+    return min_fn
+
+
+def _blocked_rowpass(
+    queries: jax.Array,
+    corpus_x: jax.Array,
+    row_fn,
+    init_row,
+    *,
+    block_rows: int,
+    corpus=None,
+):
+    """Run ``row_fn(acc_tile, d_tile, corpus_slice) -> acc_tile`` over every
+    (query block × corpus block) tile of the pairwise distance matrix,
+    returning the [q_rows]-shaped accumulators — THE shared skeleton of the
+    count pass and every propagation sweep, for both the single-device
+    kernels (queries IS the corpus) and the mesh shards (shard rows vs the
+    gathered full corpus, parallel/dbscan.py). ``corpus`` carries the
+    per-corpus-row extras (weights, labels, core mask), delivered to
+    ``row_fn`` as [blk]-shaped slices."""
+    q_rows = queries.shape[0]
+    c_rows = corpus_x.shape[0]
+    qb, _, _ = _block_pairs(queries, block_rows)
+    xb, blk, nblk = _block_pairs(corpus_x, block_rows)
+    corpus = corpus or {}
+    cb = {
+        k: jnp.pad(v, (0, nblk * blk - c_rows)).reshape(nblk, blk)
+        for k, v in corpus.items()
+    }
+
+    def outer(_, qi):
+        def inner(acc, blk_slices):
+            xj = blk_slices["_x"]
+            extras = {k: v for k, v in blk_slices.items() if k != "_x"}
+            d = pairwise_sq_dists(qi, xj)
+            return row_fn(acc, d, extras), None
+
+        acc0 = jnp.full((qi.shape[0],), init_row[0], init_row[1])
+        acc, _ = lax.scan(inner, acc0, {"_x": xb, **cb})
+        return None, acc
+
+    _, out = lax.scan(outer, None, qb)
+    return out.reshape(-1)[:q_rows]
+
+
+@partial(jax.jit, static_argnames=("block_rows",))
+def dbscan_core_mask(
+    x: jax.Array,
+    w: jax.Array,
+    valid: jax.Array,
+    eps_sq: jax.Array,
+    min_pts: jax.Array,
+    *,
+    block_rows: int = 2048,
+) -> jax.Array:
+    """[rows] bool: valid, and weighted eps-neighborhood mass (self
+    included) ≥ min_pts. Weight gates core status only — a zero-weight
+    valid row is core when its neighbors' mass suffices (sklearn)."""
+    wv = jnp.where(valid.astype(bool), w, 0.0)
+    counts = _blocked_rowpass(
+        x, x, make_count_fn(eps_sq), (0.0, x.dtype),
+        block_rows=block_rows, corpus={"w": wv},
+    )
+    return (counts >= min_pts) & valid.astype(bool)
+
+
+@partial(jax.jit, static_argnames=("block_rows",))
+def dbscan_labels(
+    x: jax.Array,
+    w: jax.Array,
+    valid: jax.Array,
+    eps_sq: jax.Array,
+    min_pts: jax.Array,
+    *,
+    block_rows: int = 2048,
+) -> jax.Array:
+    """Full DBSCAN on one device: [rows] int32 labels (smallest core index
+    per cluster; border → smallest core neighbor's cluster; noise/pad −1)."""
+    rows = x.shape[0]
+    core = dbscan_core_mask(
+        x, w, valid, eps_sq, min_pts, block_rows=block_rows
+    )
+    sentinel = jnp.int32(rows)
+
+    def donated_min(labels):
+        """[rows] smallest label among each row's CORE eps-neighbors."""
+        return _blocked_rowpass(
+            x,
+            x,
+            make_min_fn(eps_sq, sentinel),
+            (sentinel, jnp.int32),
+            block_rows=block_rows,
+            corpus={"core": core.astype(jnp.int32), "labels": labels},
+        )
+
+    labels0 = jnp.where(core, jnp.arange(rows, dtype=jnp.int32), sentinel)
+
+    def cond(carry):
+        _, changed = carry
+        return changed
+
+    def body(carry):
+        labels, _ = carry
+        new = jnp.where(core, jnp.minimum(labels, donated_min(labels)), labels)
+        # pointer jumping: a core label is the index of a core row in the
+        # same cluster, so chasing it twice collapses chains logarithmically
+        for _ in range(2):
+            new = jnp.where(core, new[jnp.clip(new, 0, rows - 1)], new)
+        return (new, jnp.any(new != labels))
+
+    labels, _ = lax.while_loop(cond, body, (labels0, jnp.bool_(True)))
+
+    # border pass: non-core rows adopt the smallest core neighbor's
+    # (converged) cluster; no core neighbor ⇒ noise. Invalid (pad) rows −1.
+    donated = donated_min(labels)
+    out = jnp.where(core, labels, jnp.where(donated < sentinel, donated, -1))
+    return jnp.where(valid.astype(bool), out, -1).astype(jnp.int32)

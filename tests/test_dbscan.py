@@ -1,0 +1,201 @@
+"""DBSCAN differential tests — NumPy BFS oracle.
+
+The oracle replicates the kernel's deterministic spec exactly (cluster =
+connected component of the core graph, id by smallest member core index
+relabeled ascending; border → smallest core-neighbor cluster; noise −1),
+so label equality is exact — stronger than a partition-equivalence check.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.models.dbscan import DBSCAN
+from spark_rapids_ml_tpu.ops import dbscan as DB
+
+
+def _oracle(x, eps, min_samples, w=None):
+    n = len(x)
+    w = np.ones(n) if w is None else np.asarray(w, float)
+    d = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    nbr = d <= eps * eps
+    # sklearn sample_weight: weights gate CORE status only — a zero-weight
+    # point is core when its neighbors' mass suffices, and still gets labels
+    core = nbr @ w >= min_samples
+    labels = np.full(n, -1, dtype=np.int64)
+    comp_min = {}
+    for i in range(n):
+        if core[i] and labels[i] < 0:
+            stack, members = [i], []
+            labels[i] = i
+            while stack:
+                j = stack.pop()
+                members.append(j)
+                for m in np.flatnonzero(nbr[j] & core):
+                    if labels[m] < 0:
+                        labels[m] = i
+                        stack.append(m)
+            comp_min[i] = min(members)
+    for seed, mn in comp_min.items():
+        labels[labels == seed] = mn
+    # border: smallest core-neighbor component id
+    for i in range(n):
+        if not core[i]:
+            cands = labels[np.flatnonzero(nbr[i] & core)]
+            labels[i] = cands.min() if len(cands) else -1
+    ids = np.unique(labels[labels >= 0])
+    remap = {v: k for k, v in enumerate(ids)}
+    return np.array([remap.get(v, -1) for v in labels], dtype=np.int32)
+
+
+def _blobs(seed=0, n_out=25):
+    rng = np.random.default_rng(seed)
+    blobs = [
+        rng.normal(loc, 0.25, size=(60, 3))
+        for loc in ([0, 0, 0], [5, 5, 5], [-5, 5, 0])
+    ]
+    outliers = rng.uniform(-10, 10, size=(n_out, 3))
+    x = np.concatenate(blobs + [outliers])
+    return x[rng.permutation(len(x))]
+
+
+def test_blobs_match_oracle():
+    x = _blobs()
+    got = DBSCAN().setEps(1.0).setMinSamples(5).fit().clusterLabels(x)
+    np.testing.assert_array_equal(got, _oracle(x, 1.0, 5))
+    assert len(np.unique(got[got >= 0])) == 3
+
+
+def test_chain_cluster_long_diameter():
+    """A 400-point line spaced under eps: one cluster, graph diameter 399 —
+    the pointer-jumping shortcut must converge it (plain propagation would
+    need 399 sweeps; the test would time out without the jumps)."""
+    x = np.stack([np.arange(400) * 0.5, np.zeros(400)], axis=1)
+    got = DBSCAN().setEps(0.6).setMinSamples(2).fit().clusterLabels(x)
+    assert np.all(got == 0)
+
+
+def test_weighted_core_points():
+    """A weight-5 point makes its sparse neighborhood core (sklearn
+    sample_weight semantics)."""
+    pd = pytest.importorskip("pandas")
+    x = np.array([[0.0, 0.0], [0.4, 0.0], [10.0, 10.0]])
+    w = np.array([5.0, 1.0, 1.0])
+    df = pd.DataFrame({"features": list(x), "w": w})
+    model = (
+        DBSCAN().setInputCol("features").setWeightCol("w")
+        .setEps(0.5).setMinSamples(5).fit()
+    )
+    got = model.clusterLabels(df)
+    np.testing.assert_array_equal(got, _oracle(x, 0.5, 5, w))
+    assert got[0] == 0 and got[1] == 0 and got[2] == -1
+
+
+def test_zero_weight_point_still_labeled():
+    """Weights gate core status only: a zero-weight point inside a cluster
+    is labeled border, not noise — and contributes nothing to core mass."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.default_rng(2)
+    blob = rng.normal(0, 0.2, size=(20, 2))
+    x = np.concatenate([blob, [[0.05, 0.0]], [[9.0, 9.0]]])
+    w = np.ones(len(x))
+    w[20] = 0.0  # zero-weight point sitting inside the blob
+    df = pd.DataFrame({"features": list(x), "w": w})
+    got = (
+        DBSCAN().setInputCol("features").setWeightCol("w")
+        .setEps(0.5).setMinSamples(5).fit().clusterLabels(df)
+    )
+    np.testing.assert_array_equal(got, _oracle(x, 0.5, 5, w))
+    assert got[20] == 0  # labeled, despite zero weight
+    assert got[21] == -1
+
+
+def test_block_rows_invariance():
+    x = _blobs(seed=3)
+    ones = jnp.asarray(np.ones(len(x)))
+    valid = jnp.asarray(np.ones(len(x), bool))
+    ref = np.asarray(
+        DB.dbscan_labels(jnp.asarray(x), ones, valid, jnp.asarray(1.0), jnp.asarray(5.0))
+    )
+    small = np.asarray(
+        DB.dbscan_labels(
+            jnp.asarray(x), ones, valid,
+            jnp.asarray(1.0), jnp.asarray(5.0), block_rows=17,
+        )
+    )
+    np.testing.assert_array_equal(ref, small)
+
+
+def test_sqeuclidean_metric():
+    """eps=0.7 euclidean ≡ eps=0.49 sqeuclidean — values chosen so a broken
+    eps² branch cannot pass by coincidence (0.7² ≠ 0.7)."""
+    x = _blobs(seed=5)
+    e = DBSCAN().setEps(0.7).setMinSamples(5).fit().clusterLabels(x)
+    sq = (
+        DBSCAN().setEps(0.49).setMetric("sqeuclidean").setMinSamples(5)
+        .fit().clusterLabels(x)
+    )
+    np.testing.assert_array_equal(e, sq)
+    np.testing.assert_array_equal(e, _oracle(x, 0.7, 5))
+
+
+def test_transform_appends_prediction():
+    pd = pytest.importorskip("pandas")
+    x = _blobs(seed=7)
+    df = pd.DataFrame({"features": list(x)})
+    out = (
+        DBSCAN().setInputCol("features").setEps(1.0).setMinSamples(5)
+        .setPredictionCol("cluster").fit(df).transform(df)
+    )
+    np.testing.assert_array_equal(
+        out["cluster"].to_numpy(), _oracle(x, 1.0, 5)
+    )
+
+
+def test_persistence_roundtrip(tmp_path):
+    from spark_rapids_ml_tpu.models.dbscan import DBSCANModel
+
+    x = _blobs(seed=9)
+    model = DBSCAN().setEps(1.0).setMinSamples(4).fit()
+    path = str(tmp_path / "db")
+    model.save(path)
+    loaded = DBSCANModel.load(path)
+    assert loaded.getEps() == 1.0 and loaded.getMinSamples() == 4.0
+    np.testing.assert_array_equal(loaded.clusterLabels(x), model.clusterLabels(x))
+
+
+def test_sharded_matches_local():
+    import jax
+    from spark_rapids_ml_tpu.parallel.mesh import create_mesh
+    from spark_rapids_ml_tpu.parallel.dbscan import make_sharded_dbscan
+
+    x = _blobs(seed=11)
+    ndev = len(jax.devices())
+    per = -(-len(x) // ndev)
+    padded = np.zeros((per * ndev, x.shape[1]))
+    padded[: len(x)] = x
+    w = np.zeros(per * ndev)
+    w[: len(x)] = 1.0
+
+    valid = w > 0
+
+    mesh = create_mesh(data=ndev)
+    run = make_sharded_dbscan(mesh)
+    got = np.asarray(
+        run(
+            jnp.asarray(padded), jnp.asarray(w), jnp.asarray(valid),
+            jnp.asarray(1.0), jnp.asarray(5.0),
+        )
+    )[: len(x)]
+    ref = np.asarray(
+        DB.dbscan_labels(
+            jnp.asarray(padded), jnp.asarray(w), jnp.asarray(valid),
+            jnp.asarray(1.0), jnp.asarray(5.0),
+        )
+    )[: len(x)]
+    np.testing.assert_array_equal(got, ref)
+    np.testing.assert_array_equal(
+        np.asarray(DBSCAN().setEps(1.0).setMinSamples(5).fit().clusterLabels(x)),
+        _oracle(x, 1.0, 5),
+    )
