@@ -74,6 +74,15 @@ def __getattr__(name):
 
         return getattr(dbscan, name)
     if name in (
+        "RandomForestClassifier",
+        "RandomForestClassificationModel",
+        "RandomForestRegressor",
+        "RandomForestRegressionModel",
+    ):
+        from spark_rapids_ml_tpu.models import forest
+
+        return getattr(forest, name)
+    if name in (
         "StandardScaler",
         "StandardScalerModel",
         "Normalizer",

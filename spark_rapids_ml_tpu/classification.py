@@ -2,9 +2,18 @@
 naming the way the reference's 10-line public class mirrors Spark's package
 path (PCA.scala:27-37, SURVEY.md §1 L6)."""
 
+from spark_rapids_ml_tpu.models.forest import (  # noqa: F401
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+)
 from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
     LogisticRegression,
     LogisticRegressionModel,
 )
 
-__all__ = ["LogisticRegression", "LogisticRegressionModel"]
+__all__ = [
+    "LogisticRegression",
+    "LogisticRegressionModel",
+    "RandomForestClassifier",
+    "RandomForestClassificationModel",
+]

@@ -1,0 +1,488 @@
+"""RandomForestClassifier / RandomForestRegressor — the tree family.
+
+Spark-ML-shaped API (params, fit/transform, persistence) over the
+histogram-tree kernels in ops/forest.py. The modern spark-rapids-ml family
+ships both estimators on cuML's GPU forest; the 22.12 reference this
+framework re-designs stops at PCA (SURVEY.md §2), so this is a
+capability-add with the same API surface Spark MLlib exposes
+(pyspark.ml.classification.RandomForestClassifier /
+pyspark.ml.regression.RandomForestRegressor).
+
+Spark-semantics choices mirrored here:
+
+- features are quantile-binned to ``maxBins`` histogram bins (Spark MLlib
+  itself is a binned-tree implementation with the same param);
+- bootstrap draws Poisson(subsamplingRate) per-row counts (Spark's
+  BaggedPoint), multiplied into any ``weightCol`` instance weights;
+- ``featureSubsetStrategy`` per-NODE feature subsets ('auto' = sqrt(F)
+  for classification, F/3 for regression — Spark's defaults);
+- classifier probability = average of per-tree leaf class distributions,
+  rawPrediction = their sum (Spark RandomForestClassificationModel);
+- regressor prediction = mean of per-tree leaf means;
+- ``minInstancesPerNode`` gates on WEIGHTED counts (with unweighted data
+  and bootstrap counts these are the sampled instance counts).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    Param,
+)
+from spark_rapids_ml_tpu.ops import forest as FO
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+#: rows sampled (not streamed) for quantile bin-edge estimation — the same
+#: bounded-sample role Spark's findSplits sampling plays
+_MAX_BIN_SAMPLE = 200_000
+
+
+def subset_size(strategy: str, n_features: int, *, classification: bool) -> int:
+    """Spark featureSubsetStrategy → per-node feature count."""
+    s = str(strategy).lower()
+    if s == "auto":
+        s = "sqrt" if classification else "onethird"
+    if s == "all":
+        return n_features
+    if s == "sqrt":
+        return max(1, int(math.sqrt(n_features)))
+    if s == "log2":
+        return max(1, int(math.log2(n_features)))
+    if s == "onethird":
+        return max(1, int(n_features / 3.0))
+    try:
+        v = float(s)
+    except ValueError:
+        raise ValueError(
+            f"featureSubsetStrategy must be auto/all/sqrt/log2/onethird or "
+            f"a number, got {strategy!r}"
+        ) from None
+    if v >= 1.0:
+        return min(n_features, int(v))
+    if v > 0.0:
+        # Spark ceils fractional strategies (RandomForest.getFeatureSubsetNumber)
+        return min(n_features, max(1, math.ceil(v * n_features)))
+    raise ValueError(f"featureSubsetStrategy must be > 0, got {strategy!r}")
+
+
+def quantile_bin_edges(x: np.ndarray, n_bins: int, seed: int) -> np.ndarray:
+    """[F, n_bins−1] interior quantile edges from a bounded row sample."""
+    if x.shape[0] > _MAX_BIN_SAMPLE:
+        rng = np.random.default_rng(seed)
+        x = x[rng.choice(x.shape[0], _MAX_BIN_SAMPLE, replace=False)]
+    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    return np.quantile(x, qs, axis=0).T.astype(np.float64)
+
+
+def bin_features(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """[rows, F] int32 bin ids: bin b ⇔ edges[b−1] < x ≤ edges[b]."""
+    out = np.empty(x.shape, dtype=np.int32)
+    for j in range(x.shape[1]):
+        out[:, j] = np.searchsorted(edges[j], x[:, j], side="left")
+    return out
+
+
+class _ForestParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
+    numTrees = Param("numTrees", "number of trees", int)
+    maxDepth = Param("maxDepth", "maximum tree depth (root = depth 0)", int)
+    maxBins = Param("maxBins", "histogram bins per feature", int)
+    minInstancesPerNode = Param(
+        "minInstancesPerNode",
+        "minimum weighted instance count per child for a split",
+        float,
+    )
+    minInfoGain = Param("minInfoGain", "minimum impurity decrease", float)
+    featureSubsetStrategy = Param(
+        "featureSubsetStrategy",
+        "features considered per node: auto/all/sqrt/log2/onethird or a "
+        "count/fraction",
+        str,
+    )
+    subsamplingRate = Param(
+        "subsamplingRate", "bootstrap sample rate per tree", float
+    )
+    bootstrap = Param(
+        "bootstrap",
+        "Poisson bootstrap per tree (False = every tree sees all rows)",
+        bool,
+    )
+    seed = Param("seed", "random seed", int)
+    weightCol = Param(
+        "weightCol", "optional instance-weight column", str
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            featuresCol="features", labelCol="label",
+            predictionCol="prediction",
+            numTrees=20, maxDepth=5, maxBins=32, minInstancesPerNode=1.0,
+            minInfoGain=0.0, featureSubsetStrategy="auto",
+            subsamplingRate=1.0, bootstrap=True, seed=0,
+        )
+
+    def getNumTrees(self) -> int:
+        return self.getOrDefault("numTrees")
+
+    def getMaxDepth(self) -> int:
+        return self.getOrDefault("maxDepth")
+
+    def getMaxBins(self) -> int:
+        return self.getOrDefault("maxBins")
+
+    def getSeed(self) -> int:
+        return self.getOrDefault("seed")
+
+
+class _ForestEstimator(_ForestParams, Estimator):
+    _classification: bool  # set by subclasses
+    _impurity_choices: tuple
+
+    def setNumTrees(self, value: int):
+        if value < 1:
+            raise ValueError(f"numTrees must be >= 1, got {value}")
+        return self._set(numTrees=value)
+
+    def setMaxDepth(self, value: int):
+        if not 0 <= value <= 14:
+            raise ValueError(f"maxDepth must be in [0, 14], got {value}")
+        return self._set(maxDepth=value)
+
+    def setMaxBins(self, value: int):
+        if value < 2:
+            raise ValueError(f"maxBins must be >= 2, got {value}")
+        return self._set(maxBins=value)
+
+    def setMinInstancesPerNode(self, value: float):
+        if value < 1:
+            raise ValueError(f"minInstancesPerNode must be >= 1, got {value}")
+        return self._set(minInstancesPerNode=float(value))
+
+    def setMinInfoGain(self, value: float):
+        return self._set(minInfoGain=float(value))
+
+    def setFeatureSubsetStrategy(self, value):
+        return self._set(featureSubsetStrategy=str(value))
+
+    def setSubsamplingRate(self, value: float):
+        if not 0.0 < value <= 1.0:
+            raise ValueError(f"subsamplingRate must be in (0, 1], got {value}")
+        return self._set(subsamplingRate=float(value))
+
+    def setBootstrap(self, value: bool):
+        return self._set(bootstrap=bool(value))
+
+    def setSeed(self, value: int):
+        return self._set(seed=value)
+
+    def setWeightCol(self, value: str):
+        return self._set(weightCol=value)
+
+    def setImpurity(self, value: str):
+        if value not in self._impurity_choices:
+            raise ValueError(
+                f"impurity must be one of {self._impurity_choices}, got {value!r}"
+            )
+        return self._set(impurity=value)
+
+    def getImpurity(self) -> str:
+        return self.getOrDefault("impurity")
+
+    def _fit_arrays(
+        self, x: np.ndarray, y: np.ndarray, w: np.ndarray | None
+    ):
+        """(trees, thresholds, edges) — the shared fit body."""
+        n_bins = self.getMaxBins()
+        seed = self.getSeed()
+        n_trees = self.getNumTrees()
+        max_depth = self.getMaxDepth()
+        fdt = columnar.float_dtype_for(x.dtype)
+
+        edges = quantile_bin_edges(x, n_bins, seed)
+        binned = bin_features(x, edges)
+        row_stats = self._row_stats(y, fdt)
+
+        rng = np.random.default_rng(seed)
+        base_w = np.ones(len(x), fdt) if w is None else w.astype(fdt)
+        rate = self.getOrDefault("subsamplingRate")
+        if self.getOrDefault("bootstrap"):
+            weights = rng.poisson(rate, size=(n_trees, len(x))).astype(fdt)
+        elif rate < 1.0:
+            # Spark bootstrap=False subsampling is WITHOUT replacement:
+            # Bernoulli(rate) per row per tree (BaggedPoint semantics)
+            weights = (
+                rng.random(size=(n_trees, len(x))) < rate
+            ).astype(fdt)
+        else:
+            weights = np.ones((n_trees, len(x)), fdt)
+        weights *= base_w[None, :]
+
+        k_feat = subset_size(
+            self.getOrDefault("featureSubsetStrategy"),
+            x.shape[1],
+            classification=self._classification,
+        )
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
+        with trace_range("forest build"):
+            trees = FO.build_forest(
+                keys,
+                jnp.asarray(binned),
+                jnp.asarray(row_stats),
+                jnp.asarray(weights),
+                jnp.asarray(np.asarray(self.getOrDefault("minInstancesPerNode"), fdt)),
+                jnp.asarray(np.asarray(self.getOrDefault("minInfoGain"), fdt)),
+                max_depth=max_depth,
+                n_bins=n_bins,
+                k_features=k_feat,
+                impurity=self.getImpurity(),
+            )
+        self._n_features_in = x.shape[1]
+        trees = FO.TreeArrays(*(np.asarray(a) for a in trees))
+        # split-bin → raw-value thresholds so inference needs no binning;
+        # bin b splits at edges[f, b] (go right when x > edge)
+        feat = np.clip(trees.feature, 0, None)
+        thresholds = np.take_along_axis(
+            edges[feat.reshape(-1)],
+            np.clip(trees.split_bin, 0, edges.shape[1] - 1).reshape(-1, 1),
+            axis=1,
+        ).reshape(trees.feature.shape)
+        thresholds = np.where(trees.feature >= 0, thresholds, 0.0)
+        return trees, thresholds
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        parts = columnar.labeled_partitions(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("labelCol"),
+            num_partitions,
+            weight_col=self._paramMap.get("weightCol"),
+        )
+        x = np.concatenate([p[0] for p in parts])
+        y = np.concatenate([p[1] for p in parts])
+        w = (
+            np.concatenate([p[2] for p in parts])
+            if parts[0][2] is not None
+            else None
+        )
+        return self._make_model(x, y, w)
+
+
+class _ForestModel(_ForestParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        trees: FO.TreeArrays | None = None,
+        thresholds: np.ndarray | None = None,
+        numFeatures: int = -1,
+    ):
+        super().__init__(uid)
+        self.trees = trees
+        self.thresholds = (
+            None if thresholds is None else np.asarray(thresholds)
+        )
+        self._num_features = int(numFeatures)
+
+    @property
+    def numFeatures(self) -> int:
+        """Training feature count (Spark model API)."""
+        return self._num_features
+
+    def predict(self, row) -> float:
+        return float(
+            self._predict_matrix(np.asarray(row, dtype=np.float64)[None, :])[0]
+        )
+
+    def getNumTrees(self) -> int:  # fitted count, not the param
+        return self.trees.feature.shape[0]
+
+    @property
+    def totalNumNodes(self) -> int:
+        """Materialized (reachable) nodes across the forest — Spark's
+        totalNumNodes analog for the heap layout."""
+        reachable = np.sum(self.trees.leaf_stats.sum(-1) > 0, axis=1)
+        return int(np.sum(np.maximum(reachable, 1)))
+
+    def _leaf_stats_for(self, mat: np.ndarray) -> np.ndarray:
+        """[T, rows, S] leaf stats via the device descent kernel."""
+        max_depth = int(
+            np.log2(self.trees.feature.shape[1] + 1) - 1
+        )
+        return np.asarray(
+            FO.forest_apply(
+                FO.TreeArrays(*(jnp.asarray(a) for a in self.trees)),
+                jnp.asarray(mat),
+                jnp.asarray(self.thresholds),
+                max_depth=max_depth,
+            )
+        )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {
+            "feature": self.trees.feature,
+            "split_bin": self.trees.split_bin,
+            "is_leaf": self.trees.is_leaf,
+            "leaf_stats": self.trees.leaf_stats,
+            "thresholds": self.thresholds,
+            "numFeatures": np.asarray([self._num_features]),
+        }
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        trees = FO.TreeArrays(
+            data["feature"].astype(np.int32),
+            data["split_bin"].astype(np.int32),
+            data["is_leaf"].astype(bool),
+            data["leaf_stats"],
+        )
+        return cls(
+            uid=uid,
+            trees=trees,
+            thresholds=data["thresholds"],
+            numFeatures=int(data["numFeatures"][0]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Classifier
+# ---------------------------------------------------------------------------
+
+
+class _ClassifierCols:
+    probabilityCol = Param("probabilityCol", "class-probability column", str)
+    rawPredictionCol = Param(
+        "rawPredictionCol", "summed per-tree distribution column", str
+    )
+
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            probabilityCol="probability", rawPredictionCol="rawPrediction",
+            impurity="gini",
+        )
+
+    def setProbabilityCol(self, value: str):
+        return self._set(probabilityCol=value)
+
+    def setRawPredictionCol(self, value: str):
+        return self._set(rawPredictionCol=value)
+
+
+class RandomForestClassifier(_ClassifierCols, _ForestEstimator):
+    impurity = Param("impurity", "'gini' or 'entropy'", str)
+    _classification = True
+    _impurity_choices = ("gini", "entropy")
+
+    def _row_stats(self, y: np.ndarray, fdt) -> np.ndarray:
+        classes = np.round(y).astype(np.int64)
+        if (classes < 0).any() or not np.allclose(y, classes):
+            raise ValueError(
+                "classification labels must be non-negative integers "
+                "(Spark ML label contract)"
+            )
+        return np.eye(int(classes.max()) + 1, dtype=fdt)[classes]
+
+    def _make_model(self, x, y, w):
+        trees, thresholds = self._fit_arrays(x, y, w)
+        model = RandomForestClassificationModel(
+            uid=self.uid, trees=trees, thresholds=thresholds,
+            numFeatures=self._n_features_in,
+        )
+        return self._copyValues(model)
+
+
+class RandomForestClassificationModel(_ClassifierCols, _ForestModel):
+    impurity = Param("impurity", "'gini' or 'entropy'", str)
+
+    @property
+    def numClasses(self) -> int:
+        return self.trees.leaf_stats.shape[-1]
+
+    def proba_and_predictions(self, mat):
+        """([rows, C] averaged per-tree distributions, [rows] argmax) —
+        Spark's RandomForestClassificationModel decision rule."""
+        leaf = self._leaf_stats_for(mat)  # [T, rows, C]
+        tot = leaf.sum(-1, keepdims=True)
+        per_tree = np.divide(
+            leaf, np.where(tot > 0, tot, 1.0), dtype=leaf.dtype
+        )
+        raw = per_tree.sum(0)
+        proba = raw / leaf.shape[0]
+        return proba, np.argmax(proba, axis=1).astype(np.float64)
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        return self.proba_and_predictions(mat)[1]
+
+    def transform(self, dataset: Any) -> Any:
+        if columnar.has_named_columns(dataset):
+            mat = columnar.extract_matrix(
+                dataset, self.getOrDefault("featuresCol")
+            )
+            proba, preds = self.proba_and_predictions(mat)
+            cols = [
+                (self.getOrDefault("rawPredictionCol"), proba * len(self.trees.feature)),
+                (self.getOrDefault("probabilityCol"), proba),
+                (self.getOrDefault("predictionCol"), preds),
+            ]
+            return columnar.append_columns(dataset, cols)
+        return columnar.apply_column_transform(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("predictionCol"),
+            self._predict_matrix,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Regressor
+# ---------------------------------------------------------------------------
+
+
+class RandomForestRegressor(_ForestEstimator):
+    impurity = Param("impurity", "'variance'", str)
+    _classification = False
+    _impurity_choices = ("variance",)
+
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(impurity="variance")
+
+    def _row_stats(self, y: np.ndarray, fdt) -> np.ndarray:
+        y = y.astype(fdt)
+        return np.stack([np.ones_like(y), y, y * y], axis=1)
+
+    def _make_model(self, x, y, w):
+        trees, thresholds = self._fit_arrays(x, y, w)
+        model = RandomForestRegressionModel(
+            uid=self.uid, trees=trees, thresholds=thresholds,
+            numFeatures=self._n_features_in,
+        )
+        return self._copyValues(model)
+
+
+class RandomForestRegressionModel(_ForestModel):
+    impurity = Param("impurity", "'variance'", str)
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        leaf = self._leaf_stats_for(mat)  # [T, rows, 3]
+        w = leaf[..., 0]
+        mean = leaf[..., 1] / np.where(w > 0, w, 1.0)
+        return mean.mean(0)
+
+    def transform(self, dataset: Any) -> Any:
+        return columnar.apply_column_transform(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("predictionCol"),
+            self._predict_matrix,
+        )

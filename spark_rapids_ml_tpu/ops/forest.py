@@ -1,0 +1,246 @@
+"""Random-forest device kernels — histogram trees grown level-order on TPU.
+
+The modern spark-rapids-ml family ships RandomForestClassifier/Regressor on
+cuML's GPU forest builder; the 22.12 reference this framework re-designs
+stops at PCA (SURVEY.md §2), so this is a capability-add in the
+KMeans/NearestNeighbors/DBSCAN spirit — same Spark ML API surface,
+TPU-native internals.
+
+Why histogram trees, and why breadth-first:
+
+- exact-split tree building (sort every feature at every node) is
+  pointer-chasing — hostile to both the MXU and XLA's static shapes.
+  Quantile-binned HISTOGRAM building (the XGBoost/LightGBM formulation,
+  also what Spark MLlib itself does with maxBins) turns split finding into
+  dense fixed-shape reductions;
+- LEVEL-ORDER growth makes every depth a fixed-shape program: all 2^d
+  nodes of a level build their [features, bins, stats] histograms in ONE
+  segment-sum pass over the rows (segment id = node·B + bin), then split
+  selection is a cumsum + argmax over a dense [F, nodes, B] gain tensor.
+  No per-node recursion ever reaches XLA;
+- the per-level histogram is a commutative monoid over rows — the mesh
+  version (parallel/forest.py) psums it across row shards and every device
+  takes identical split decisions, the same distribution shape as every
+  other fit here (and as Spark MLlib's own RF aggregation).
+
+Trees live in fixed heap-layout arrays (root 0, children 2i+1/2i+2, size
+2^(maxDepth+1)−1): ``feature``/``split_bin`` per node, ``is_leaf``, and
+``leaf_stats`` (class counts, or [w, wy, wy²] for regression) written for
+every materialized node so prediction can stop at any depth. Rows carry
+their current heap node; leaf rows go inactive (weight 0 in histograms).
+
+Stats convention: classification S=C per-class weighted counts;
+regression S=3 ([w, w·y, w·y²]). Impurities (gini/entropy/variance) are
+computed in n-scaled form (n·impurity), where gain·n_total =
+imp_n(parent) − imp_n(left) − imp_n(right) — no divisions until the gate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IMPURITIES = ("gini", "entropy", "variance")
+
+
+class TreeArrays(NamedTuple):
+    """One tree (or a [T, ...] stack) in heap layout."""
+
+    feature: jax.Array  # [nodes] int32, −1 at leaves
+    split_bin: jax.Array  # [nodes] int32 — go left when bin ≤ split_bin
+    is_leaf: jax.Array  # [nodes] bool
+    leaf_stats: jax.Array  # [nodes, S]
+
+
+def _impurity_n(stats: jax.Array, impurity: str) -> jax.Array:
+    """n·impurity over the trailing stats axis; 0 for empty cells."""
+    if impurity == "variance":
+        w = stats[..., 0]
+        safe = jnp.where(w > 0, w, 1.0)
+        v = stats[..., 2] - stats[..., 1] * stats[..., 1] / safe
+        return jnp.where(w > 0, jnp.maximum(v, 0.0), 0.0)
+    n = jnp.sum(stats, axis=-1)
+    safe = jnp.where(n > 0, n, 1.0)
+    if impurity == "gini":
+        return jnp.where(
+            n > 0, n - jnp.sum(stats * stats, axis=-1) / safe, 0.0
+        )
+    # entropy: Σ c·log(n/c) — 0·log(·) := 0
+    c = stats
+    ratio = jnp.where(c > 0, c / safe[..., None], 1.0)
+    return jnp.where(n > 0, -safe * jnp.sum(ratio * jnp.log(ratio), axis=-1), 0.0)
+
+
+def _node_count(stats: jax.Array, impurity: str) -> jax.Array:
+    """Weighted instance count per cell from the stats vector."""
+    return stats[..., 0] if impurity == "variance" else jnp.sum(stats, axis=-1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "max_depth", "n_bins", "k_features", "impurity", "axis_name",
+    ),
+)
+def build_tree(
+    key: jax.Array,
+    binned: jax.Array,  # [rows, F] int32 bin ids in [0, n_bins)
+    row_stats: jax.Array,  # [rows, S] per-row stats (UNweighted)
+    w: jax.Array,  # [rows] bootstrap × instance weights (0 = excluded)
+    min_instances: jax.Array,  # weighted count floor per child
+    min_info_gain: jax.Array,
+    *,
+    max_depth: int,
+    n_bins: int,
+    k_features: int,
+    impurity: str,
+    axis_name: str | None = None,
+) -> TreeArrays:
+    """Grow one histogram tree level-order; fully jittable, fixed shapes.
+
+    With ``axis_name`` set (mesh build), the per-level histogram and root
+    total are psum'd over that axis — rows are sharded, decisions
+    replicated. ``vmap`` over (key, w) grows a forest.
+    """
+    if impurity not in IMPURITIES:
+        raise ValueError(f"impurity must be one of {IMPURITIES}")
+    rows, n_feat = binned.shape
+    S = row_stats.shape[1]
+    max_nodes = 2 ** (max_depth + 1) - 1
+    fdt = row_stats.dtype
+
+    feature = jnp.full((max_nodes,), -1, jnp.int32)
+    split_bin = jnp.zeros((max_nodes,), jnp.int32)
+    is_leaf = jnp.ones((max_nodes,), bool)
+    leaf_stats = jnp.zeros((max_nodes, S), fdt)
+
+    node = jnp.zeros((rows,), jnp.int32)  # current heap node per row
+    active = jnp.ones((rows,), bool)
+
+    for d in range(max_depth + 1):
+        nodes_d = 2 ** d
+        offset = nodes_d - 1
+        # inactive rows keep the stale heap id of the level they went leaf
+        # at, so their local id is clipped into range — they contribute 0
+        # to histograms (wa=0) and never route (active gates row_split)
+        local = jnp.clip(node - offset, 0, nodes_d - 1)
+        wa = jnp.where(active, w, 0.0)
+        contrib = row_stats * wa[:, None]
+
+        # [F, nodes_d·B, S] histograms in one vmapped segment-sum pass
+        def hist_feature(bins_f):
+            seg = local * n_bins + bins_f
+            return jax.ops.segment_sum(
+                contrib, seg, num_segments=nodes_d * n_bins
+            )
+
+        hist = jax.vmap(hist_feature)(binned.T)
+        if axis_name is not None:
+            hist = lax.psum(hist, axis_name)
+        hist = hist.reshape(n_feat, nodes_d, n_bins, S)
+
+        total = jnp.sum(hist[0], axis=1)  # [nodes_d, S]
+        leaf_stats = lax.dynamic_update_slice(leaf_stats, total, (offset, 0))
+
+        if d == max_depth:
+            break  # depth-capped: this level is all leaves
+
+        left = jnp.cumsum(hist, axis=2)  # [F, nodes_d, B, S]
+        right = total[None, :, None, :] - left
+        gain_n = (
+            _impurity_n(total, impurity)[None, :, None]
+            - _impurity_n(left, impurity)
+            - _impurity_n(right, impurity)
+        )
+        n_tot = _node_count(total, impurity)  # [nodes_d]
+        n_l = _node_count(left, impurity)
+        n_r = _node_count(right, impurity)
+        safe_tot = jnp.where(n_tot > 0, n_tot, 1.0)
+        ok = (
+            (n_l >= min_instances)
+            & (n_r >= min_instances)
+            & (gain_n / safe_tot[None, :, None] >= min_info_gain)
+            & (gain_n > 1e-12)
+        )
+        # the last bin's "split" puts everything left — structurally invalid
+        ok = ok & (jnp.arange(n_bins)[None, None, :] < n_bins - 1)
+
+        if k_features < n_feat:
+            # Spark's per-node feature subsampling: k distinct features per
+            # node via Gumbel top-k (sampling without replacement)
+            kd = jax.random.fold_in(key, d)
+            g = jax.random.gumbel(kd, (nodes_d, n_feat), fdt)
+            kth = lax.top_k(g, k_features)[0][:, -1]
+            ok = ok & (g.T[:, :, None] >= kth[None, :, None])
+
+        masked = jnp.where(ok, gain_n, -jnp.inf)
+        flat = masked.transpose(1, 0, 2).reshape(nodes_d, n_feat * n_bins)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        best_f = (best // n_bins).astype(jnp.int32)
+        best_b = (best % n_bins).astype(jnp.int32)
+        do_split = best_gain > -jnp.inf  # [nodes_d]
+
+        feature = lax.dynamic_update_slice(
+            feature, jnp.where(do_split, best_f, -1), (offset,)
+        )
+        split_bin = lax.dynamic_update_slice(
+            split_bin, jnp.where(do_split, best_b, 0), (offset,)
+        )
+        is_leaf = lax.dynamic_update_slice(is_leaf, ~do_split, (offset,))
+
+        # route rows: split nodes send rows to 2·node+1 (+1 if bin > b)
+        row_split = active & do_split[local]
+        rf = best_f[local]
+        rb = best_b[local]
+        row_bin = jnp.take_along_axis(binned, rf[:, None], axis=1)[:, 0]
+        goes_right = (row_bin > rb).astype(jnp.int32)
+        node = jnp.where(row_split, 2 * node + 1 + goes_right, node)
+        active = active & row_split
+
+    return TreeArrays(feature, split_bin, is_leaf, leaf_stats)
+
+
+def build_forest(
+    keys: jax.Array,  # [T] PRNG keys (feature subsets)
+    binned: jax.Array,
+    row_stats: jax.Array,
+    weights: jax.Array,  # [T, rows] per-tree bootstrap × instance weights
+    min_instances,
+    min_info_gain,
+    **static,
+) -> TreeArrays:
+    """vmap :func:`build_tree` over trees → [T, ...] TreeArrays."""
+    return jax.vmap(
+        lambda k, w: build_tree(
+            k, binned, row_stats, w, min_instances, min_info_gain, **static
+        )
+    )(keys, weights)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def forest_apply(
+    trees: TreeArrays,  # [T, ...] stack
+    x: jax.Array,  # [rows, F] RAW feature values
+    thresholds: jax.Array,  # [T, nodes] split values (edges[f, b])
+    *,
+    max_depth: int,
+) -> jax.Array:
+    """[T, rows, S] leaf stats: descend every tree with gathers —
+    ``max_depth`` dependent steps, each one vectorized gather+compare."""
+
+    def one_tree(tree, thr):
+        node = jnp.zeros((x.shape[0],), jnp.int32)
+        for _ in range(max_depth):
+            leaf = tree.is_leaf[node]
+            f = jnp.maximum(tree.feature[node], 0)
+            xv = jnp.take_along_axis(x, f[:, None], axis=1)[:, 0]
+            goes_right = (xv > thr[node]).astype(jnp.int32)
+            node = jnp.where(leaf, node, 2 * node + 1 + goes_right)
+        return tree.leaf_stats[node]
+
+    return jax.vmap(one_tree)(trees, thresholds)

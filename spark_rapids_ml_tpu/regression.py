@@ -2,9 +2,18 @@
 the way the reference's 10-line public class mirrors Spark's package path
 (PCA.scala:27-37, SURVEY.md §1 L6)."""
 
+from spark_rapids_ml_tpu.models.forest import (  # noqa: F401
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
 from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
     LinearRegression,
     LinearRegressionModel,
 )
 
-__all__ = ["LinearRegression", "LinearRegressionModel"]
+__all__ = [
+    "LinearRegression",
+    "LinearRegressionModel",
+    "RandomForestRegressor",
+    "RandomForestRegressionModel",
+]

@@ -1,0 +1,347 @@
+"""Random-forest differential tests.
+
+Three oracle layers (SURVEY.md §4 strategy):
+1. an exact-spec NumPy mirror of the histogram tree builder — node-for-node
+   equality (stats are integer-valued, so f64 arithmetic is exact and even
+   argmax tie-breaks match);
+2. sklearn as a QUALITY oracle — our binned forest must land within a few
+   points of sklearn's exact-split forest on held-out synthetic data;
+3. invariances: seed determinism, weight≡duplication, mesh≡local.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.models.forest import (
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    bin_features,
+    quantile_bin_edges,
+    subset_size,
+)
+from spark_rapids_ml_tpu.ops import forest as FO
+
+
+# ---------------------------------------------------------------------------
+# exact-spec NumPy mirror (all features per node — no subset randomness)
+# ---------------------------------------------------------------------------
+
+
+def _imp_n(stats, impurity):
+    if impurity == "variance":
+        w = stats[..., 0]
+        safe = np.where(w > 0, w, 1.0)
+        return np.where(w > 0, np.maximum(stats[..., 2] - stats[..., 1] ** 2 / safe, 0.0), 0.0)
+    n = stats.sum(-1)
+    safe = np.where(n > 0, n, 1.0)
+    if impurity == "gini":
+        return np.where(n > 0, n - (stats * stats).sum(-1) / safe, 0.0)
+    ratio = np.where(stats > 0, stats / safe[..., None], 1.0)
+    return np.where(n > 0, -safe * (ratio * np.log(ratio)).sum(-1), 0.0)
+
+
+def _count(stats, impurity):
+    return stats[..., 0] if impurity == "variance" else stats.sum(-1)
+
+
+def numpy_tree(binned, row_stats, w, *, max_depth, n_bins, min_inst, min_gain, impurity):
+    rows, F = binned.shape
+    S = row_stats.shape[1]
+    max_nodes = 2 ** (max_depth + 1) - 1
+    feature = np.full(max_nodes, -1, np.int32)
+    split_bin = np.zeros(max_nodes, np.int32)
+    is_leaf = np.ones(max_nodes, bool)
+    leaf_stats = np.zeros((max_nodes, S))
+    node = np.zeros(rows, np.int32)
+    active = np.ones(rows, bool)
+
+    for d in range(max_depth + 1):
+        nodes_d = 2 ** d
+        offset = nodes_d - 1
+        local = np.clip(node - offset, 0, nodes_d - 1)
+        wa = np.where(active, w, 0.0)
+        hist = np.zeros((F, nodes_d, n_bins, S))
+        for f in range(F):
+            np.add.at(hist[f], (local, binned[:, f]), row_stats * wa[:, None])
+        total = hist[0].sum(1)
+        leaf_stats[offset : offset + nodes_d] = total
+        if d == max_depth:
+            break
+        left = np.cumsum(hist, axis=2)
+        right = total[None, :, None, :] - left
+        gain = _imp_n(total, impurity)[None, :, None] - _imp_n(left, impurity) - _imp_n(right, impurity)
+        n_tot = _count(total, impurity)
+        ok = (
+            (_count(left, impurity) >= min_inst)
+            & (_count(right, impurity) >= min_inst)
+            & (gain / np.where(n_tot > 0, n_tot, 1.0)[None, :, None] >= min_gain)
+            & (gain > 1e-12)
+            & (np.arange(n_bins)[None, None, :] < n_bins - 1)
+        )
+        masked = np.where(ok, gain, -np.inf)
+        flat = masked.transpose(1, 0, 2).reshape(nodes_d, F * n_bins)
+        best = flat.argmax(1)
+        best_gain = flat[np.arange(nodes_d), best]
+        bf, bb = best // n_bins, best % n_bins
+        do = best_gain > -np.inf
+        feature[offset : offset + nodes_d] = np.where(do, bf, -1)
+        split_bin[offset : offset + nodes_d] = np.where(do, bb, 0)
+        is_leaf[offset : offset + nodes_d] = ~do
+        row_split = active & do[local]
+        rb = binned[np.arange(rows), np.clip(bf[local], 0, F - 1)]
+        node = np.where(row_split, 2 * node + 1 + (rb > bb[local]), node)
+        active = active & row_split
+    return feature, split_bin, is_leaf, leaf_stats
+
+
+@pytest.fixture(scope="module")
+def clf_data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3000, 8))
+    logits = 1.5 * x[:, 0] - 2.0 * x[:, 3] + x[:, 5] * x[:, 0]
+    y = (logits + rng.normal(scale=0.5, size=3000) > 0).astype(float)
+    return x[:2000], y[:2000], x[2000:], y[2000:]
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(3000, 6))
+    y = np.sin(x[:, 0]) * 3 + x[:, 2] ** 2 + rng.normal(scale=0.2, size=3000)
+    return x[:2000], y[:2000], x[2000:], y[2000:]
+
+
+@pytest.mark.parametrize("impurity,S", [("gini", 3), ("entropy", 3), ("variance", 3)])
+def test_tree_matches_numpy_oracle(impurity, S):
+    rng = np.random.default_rng(7)
+    rows, F, B = 600, 5, 8
+    binned = rng.integers(0, B, size=(rows, F)).astype(np.int32)
+    if impurity == "variance":
+        yv = rng.normal(size=rows)
+        row_stats = np.stack([np.ones(rows), yv, yv * yv], axis=1)
+    else:
+        y = rng.integers(0, 3, size=rows)
+        row_stats = np.eye(3)[y]
+    w = rng.poisson(1.0, size=rows).astype(float)
+
+    got = FO.build_tree(
+        jax.random.PRNGKey(0),
+        jnp.asarray(binned), jnp.asarray(row_stats), jnp.asarray(w),
+        jnp.asarray(2.0), jnp.asarray(0.0),
+        max_depth=4, n_bins=B, k_features=F, impurity=impurity,
+    )
+    ref_f, ref_b, ref_l, ref_s = numpy_tree(
+        binned, row_stats, w,
+        max_depth=4, n_bins=B, min_inst=2.0, min_gain=0.0, impurity=impurity,
+    )
+    np.testing.assert_array_equal(np.asarray(got.feature), ref_f)
+    np.testing.assert_array_equal(np.asarray(got.split_bin), ref_b)
+    np.testing.assert_array_equal(np.asarray(got.is_leaf), ref_l)
+    np.testing.assert_allclose(np.asarray(got.leaf_stats), ref_s, rtol=1e-12)
+
+
+def test_classifier_quality_vs_sklearn(clf_data):
+    sklearn = pytest.importorskip("sklearn.ensemble")
+    xtr, ytr, xte, yte = clf_data
+    model = (
+        RandomForestClassifier().setNumTrees(30).setMaxDepth(7).setSeed(3)
+        .fit((xtr, ytr))
+    )
+    ours = (model._predict_matrix(xte) == yte).mean()
+    sk = sklearn.RandomForestClassifier(
+        n_estimators=30, max_depth=7, random_state=3
+    ).fit(xtr, ytr)
+    theirs = sk.score(xte, yte)
+    assert ours >= theirs - 0.04, (ours, theirs)
+
+
+def test_regressor_quality_vs_sklearn(reg_data):
+    sklearn = pytest.importorskip("sklearn.ensemble")
+    xtr, ytr, xte, yte = reg_data
+    # sklearn's regressor default is max_features=1.0 (ALL features per
+    # split) where Spark's 'auto' means F/3 — compare like-for-like, and
+    # give the histogram trade (global bins vs exact splits) 128 bins
+    model = (
+        RandomForestRegressor().setNumTrees(30).setMaxDepth(8).setSeed(3)
+        .setFeatureSubsetStrategy("all").setMaxBins(128)
+        .fit((xtr, ytr))
+    )
+    pred = model._predict_matrix(xte)
+    ours = 1 - ((pred - yte) ** 2).mean() / yte.var()
+    sk = sklearn.RandomForestRegressor(
+        n_estimators=30, max_depth=8, random_state=3
+    ).fit(xtr, ytr)
+    theirs = sk.score(xte, yte)
+    assert ours >= theirs - 0.03, (ours, theirs)
+
+
+def test_probability_columns_and_determinism(clf_data):
+    pd = pytest.importorskip("pandas")
+    xtr, ytr, xte, _ = clf_data
+    df = pd.DataFrame({"features": list(xtr), "label": ytr})
+    m1 = RandomForestClassifier().setNumTrees(9).setSeed(5).fit(df)
+    m2 = RandomForestClassifier().setNumTrees(9).setSeed(5).fit(df)
+    out = m1.transform(pd.DataFrame({"features": list(xte)}))
+    assert {"probability", "rawPrediction", "prediction"} <= set(out.columns)
+    p = np.stack(out["probability"])
+    assert np.allclose(p.sum(1), 1.0)
+    raw = np.stack(out["rawPrediction"])
+    np.testing.assert_allclose(raw, p * 9, rtol=1e-12)
+    np.testing.assert_array_equal(
+        m1._predict_matrix(xte), m2._predict_matrix(xte)
+    )
+    m3 = RandomForestClassifier().setNumTrees(9).setSeed(6).fit(df)
+    assert not np.array_equal(
+        np.asarray(m1.trees.feature), np.asarray(m3.trees.feature)
+    )
+
+
+def test_weight_equals_duplication():
+    """Kernel invariant: doubling a row's weight builds the identical tree
+    as physically duplicating the row (same binning by construction)."""
+    rng = np.random.default_rng(11)
+    rows, F, B = 300, 4, 8
+    binned = rng.integers(0, B, size=(rows, F)).astype(np.int32)
+    y = rng.integers(0, 2, size=rows)
+    row_stats = np.eye(2)[y]
+    dup_idx = np.arange(0, rows, 3)
+    w = np.ones(rows)
+    w[dup_idx] = 2.0
+
+    static = dict(max_depth=4, n_bins=B, k_features=F, impurity="gini")
+    key = jax.random.PRNGKey(0)
+    t_w = FO.build_tree(
+        key, jnp.asarray(binned), jnp.asarray(row_stats), jnp.asarray(w),
+        jnp.asarray(1.0), jnp.asarray(0.0), **static,
+    )
+    b_dup = np.concatenate([binned, binned[dup_idx]])
+    s_dup = np.concatenate([row_stats, row_stats[dup_idx]])
+    t_d = FO.build_tree(
+        key, jnp.asarray(b_dup), jnp.asarray(s_dup),
+        jnp.asarray(np.ones(len(b_dup))),
+        jnp.asarray(1.0), jnp.asarray(0.0), **static,
+    )
+    np.testing.assert_array_equal(np.asarray(t_w.feature), np.asarray(t_d.feature))
+    np.testing.assert_array_equal(np.asarray(t_w.split_bin), np.asarray(t_d.split_bin))
+    np.testing.assert_allclose(
+        np.asarray(t_w.leaf_stats), np.asarray(t_d.leaf_stats), rtol=1e-12
+    )
+
+
+def test_min_info_gain_and_depth_zero(clf_data):
+    xtr, ytr, _, _ = clf_data
+    stump = (
+        RandomForestClassifier().setNumTrees(3).setMaxDepth(0)
+        .setBootstrap(False)  # exact prior needs every tree on all rows
+        .fit((xtr, ytr))
+    )
+    assert np.all(np.asarray(stump.trees.is_leaf[:, 0]))
+    prior = ytr.mean()
+    p, _ = stump.proba_and_predictions(xtr[:5])
+    np.testing.assert_allclose(p[:, 1], prior, rtol=1e-6)
+
+    huge_gain = (
+        RandomForestClassifier().setNumTrees(3).setMinInfoGain(10.0)
+        .fit((xtr, ytr))
+    )
+    assert np.all(np.asarray(huge_gain.trees.is_leaf[:, 0]))
+
+
+def test_pure_labels_single_leaf():
+    x = np.random.default_rng(2).normal(size=(100, 3))
+    y = np.ones(100)
+    m = RandomForestClassifier().setNumTrees(2).fit((x, y))
+    assert np.all(np.asarray(m.trees.is_leaf[:, 0]))
+
+
+def test_persistence_roundtrip(tmp_path, clf_data, reg_data):
+    xtr, ytr, xte, _ = clf_data
+    m = RandomForestClassifier().setNumTrees(5).setMaxDepth(4).fit((xtr, ytr))
+    path = str(tmp_path / "rfc")
+    m.save(path)
+    loaded = RandomForestClassificationModel.load(path)
+    assert loaded.numClasses == 2
+    np.testing.assert_array_equal(
+        loaded._predict_matrix(xte), m._predict_matrix(xte)
+    )
+    p0, _ = m.proba_and_predictions(xte)
+    p1, _ = loaded.proba_and_predictions(xte)
+    np.testing.assert_allclose(p0, p1)
+
+    xr, yr, xq, _ = reg_data
+    mr = RandomForestRegressor().setNumTrees(4).fit((xr, yr))
+    rpath = str(tmp_path / "rfr")
+    mr.save(rpath)
+    from spark_rapids_ml_tpu.models.forest import RandomForestRegressionModel
+
+    lr = RandomForestRegressionModel.load(rpath)
+    np.testing.assert_allclose(lr._predict_matrix(xq), mr._predict_matrix(xq))
+
+
+def test_subset_size_strategies():
+    assert subset_size("auto", 100, classification=True) == 10
+    assert subset_size("auto", 99, classification=False) == 33
+    assert subset_size("all", 7, classification=True) == 7
+    assert subset_size("log2", 64, classification=True) == 6
+    assert subset_size("0.5", 10, classification=True) == 5
+    assert subset_size("0.15", 10, classification=True) == 2  # Spark ceils
+    assert subset_size("4", 10, classification=True) == 4
+    with pytest.raises(ValueError):
+        subset_size("bogus", 10, classification=True)
+
+
+def test_num_features_and_no_bootstrap_subsampling(clf_data):
+    xtr, ytr, _, _ = clf_data
+    m = RandomForestClassifier().setNumTrees(2).setMaxDepth(2).fit((xtr, ytr))
+    assert m.numFeatures == xtr.shape[1]
+    # numFeatures survives persistence even for all-stump forests
+    stump = RandomForestClassifier().setNumTrees(1).fit((xtr[:50], np.ones(50)))
+    assert stump.numFeatures == xtr.shape[1]
+
+    # bootstrap=False + subsamplingRate<1 = Bernoulli without-replacement
+    # sampling (Spark BaggedPoint): trees must differ
+    m2 = (
+        RandomForestClassifier().setNumTrees(2).setBootstrap(False)
+        .setSubsamplingRate(0.5).setFeatureSubsetStrategy("all").setSeed(1)
+        .fit((xtr, ytr))
+    )
+    t = np.asarray(m2.trees.feature)
+    assert not np.array_equal(t[0], t[1])
+
+
+def test_sharded_forest_matches_local():
+    from spark_rapids_ml_tpu.parallel.mesh import create_mesh
+    from spark_rapids_ml_tpu.parallel.forest import make_sharded_forest
+
+    rng = np.random.default_rng(13)
+    ndev = len(jax.devices())
+    rows = 1000
+    per = -(-rows // ndev)
+    F, B, T = 6, 16, 4
+    x = rng.normal(size=(rows, F))
+    y = rng.integers(0, 2, size=rows)
+    edges = quantile_bin_edges(x, B, 0)
+    binned = np.zeros((per * ndev, F), np.int32)
+    binned[:rows] = bin_features(x, edges)
+    row_stats = np.zeros((per * ndev, 2))
+    row_stats[:rows] = np.eye(2)[y]
+    w = np.zeros((T, per * ndev))
+    w[:, :rows] = rng.poisson(1.0, size=(T, rows))
+    keys = jax.random.split(jax.random.PRNGKey(0), T)
+
+    static = dict(max_depth=4, n_bins=B, k_features=F, impurity="gini")
+    local = FO.build_forest(
+        keys, jnp.asarray(binned), jnp.asarray(row_stats), jnp.asarray(w),
+        jnp.asarray(1.0), jnp.asarray(0.0), **static,
+    )
+    run = make_sharded_forest(create_mesh(data=ndev), **static)
+    sharded = run(
+        keys, jnp.asarray(binned), jnp.asarray(row_stats), jnp.asarray(w),
+        jnp.asarray(1.0), jnp.asarray(0.0),
+    )
+    for a, b in zip(local, sharded):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
