@@ -102,25 +102,49 @@ class DBSCANModel(_DBSCANParams, Model):
     def _cluster_matrix(
         self, mat: np.ndarray, weights: np.ndarray | None
     ) -> np.ndarray:
+        """THE clustering body: dtype/eps resolution, padded kernel run,
+        consecutive relabel. ``_compute_labels`` is the kernel+padding hook
+        the Spark wrapper overrides with the mesh-sharded program — the eps
+        semantics live only here."""
         fdt = columnar.float_dtype_for(mat.dtype)
         x = mat.astype(fdt, copy=False)
         eps = self.getEps()
         eps_sq = eps * eps if self.getMetric() == "euclidean" else eps
+        labels = self._compute_labels(
+            x,
+            weights,
+            np.asarray(eps_sq, fdt),
+            np.asarray(self.getMinSamples(), fdt),
+        )
+        return _relabel_consecutive(labels)
+
+    @staticmethod
+    def _pad_inputs(x, weights, pad_to: int):
+        """(padded x, weight vector, valid mask) with pad rows at weight 0 /
+        valid False — shared by the single-device and mesh paddings."""
+        fdt = x.dtype
+        rows = x.shape[0]
+        xp = np.zeros((pad_to, x.shape[1]), fdt)
+        xp[:rows] = x
+        w = np.zeros(pad_to, fdt)
+        w[:rows] = 1.0 if weights is None else weights
+        valid = np.zeros(pad_to, bool)
+        valid[:rows] = True
+        return xp, w, valid
+
+    def _compute_labels(self, x, weights, eps_sq, min_samples) -> np.ndarray:
+        """Single-device kernel run on shape-bucketed padding."""
         padded, true_rows = columnar.pad_rows(x)
-        w = np.zeros(padded.shape[0], fdt)
-        w[:true_rows] = 1.0 if weights is None else weights
-        valid = np.zeros(padded.shape[0], bool)
-        valid[:true_rows] = True
-        labels = np.asarray(
+        xp, w, valid = self._pad_inputs(x, weights, padded.shape[0])
+        return np.asarray(
             DB.dbscan_labels(
-                jnp.asarray(padded),
+                jnp.asarray(xp),
                 jnp.asarray(w),
                 jnp.asarray(valid),
-                jnp.asarray(np.asarray(eps_sq, fdt)),
-                jnp.asarray(np.asarray(self.getMinSamples(), fdt)),
+                jnp.asarray(eps_sq),
+                jnp.asarray(min_samples),
             )
         )[:true_rows]
-        return _relabel_consecutive(labels)
 
     def clusterLabels(self, dataset: Any) -> np.ndarray:
         """[rows] int32 cluster ids (−1 = noise) for ``dataset`` — the

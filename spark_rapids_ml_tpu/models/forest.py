@@ -200,9 +200,16 @@ class _ForestEstimator(_ForestParams, Estimator):
         return self.getOrDefault("impurity")
 
     def _fit_arrays(
-        self, x: np.ndarray, y: np.ndarray, w: np.ndarray | None
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        w: np.ndarray | None,
+        builder=None,
     ):
-        """(trees, thresholds, edges) — the shared fit body."""
+        """(trees, thresholds) — the shared fit body. ``builder`` overrides
+        the single-device :func:`ops.forest.build_forest` (same signature +
+        the static kwargs) so the Spark wrapper can route the build through
+        the mesh-sharded program (parallel/forest.py)."""
         n_bins = self.getMaxBins()
         seed = self.getSeed()
         n_trees = self.getNumTrees()
@@ -234,8 +241,9 @@ class _ForestEstimator(_ForestParams, Estimator):
             classification=self._classification,
         )
         keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
+        build = FO.build_forest if builder is None else builder
         with trace_range("forest build"):
-            trees = FO.build_forest(
+            trees = build(
                 keys,
                 jnp.asarray(binned),
                 jnp.asarray(row_stats),
@@ -392,8 +400,8 @@ class RandomForestClassifier(_ClassifierCols, _ForestEstimator):
             )
         return np.eye(int(classes.max()) + 1, dtype=fdt)[classes]
 
-    def _make_model(self, x, y, w):
-        trees, thresholds = self._fit_arrays(x, y, w)
+    def _make_model(self, x, y, w, builder=None):
+        trees, thresholds = self._fit_arrays(x, y, w, builder=builder)
         model = RandomForestClassificationModel(
             uid=self.uid, trees=trees, thresholds=thresholds,
             numFeatures=self._n_features_in,
@@ -461,8 +469,8 @@ class RandomForestRegressor(_ForestEstimator):
         y = y.astype(fdt)
         return np.stack([np.ones_like(y), y, y * y], axis=1)
 
-    def _make_model(self, x, y, w):
-        trees, thresholds = self._fit_arrays(x, y, w)
+    def _make_model(self, x, y, w, builder=None):
+        trees, thresholds = self._fit_arrays(x, y, w, builder=builder)
         model = RandomForestRegressionModel(
             uid=self.uid, trees=trees, thresholds=thresholds,
             numFeatures=self._n_features_in,

@@ -80,7 +80,9 @@ class _NearestNeighborsParams(HasInputCol):
     idCol = Param(
         "idCol",
         "optional item-id column; when unset, neighbors are identified by "
-        "their 0-based row position in the fitted dataset",
+        "their 0-based row position in the fitted dataset. Ids travel "
+        "through a float64 extractor, so integral ids are exact only up "
+        "to 2^53",
         str,
     )
 
@@ -174,6 +176,16 @@ class NearestNeighborsModel(_NearestNeighborsParams, Model):
         Distances are ordered best-first per the metric (ascending for the
         distance metrics, descending dot products for ``inner_product``).
         """
+        queries = columnar.extract_matrix(
+            dataset, self._paramMap.get("inputCol")
+        )
+        return self._kneighbors_matrix(queries, k)
+
+    def _kneighbors_matrix(
+        self, queries: np.ndarray, k: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """The matrix→(distances, ids) body — shared by the local path and
+        the Spark wrapper's per-batch executor transform."""
         k = self.getK() if k is None else k
         if not 1 <= k <= self.items.shape[0]:
             raise ValueError(
@@ -181,9 +193,6 @@ class NearestNeighborsModel(_NearestNeighborsParams, Model):
                 "(the fitted item count)"
             )
         metric = self.getMetric()
-        queries = columnar.extract_matrix(
-            dataset, self._paramMap.get("inputCol")
-        )
         if queries.shape[1] != self.items.shape[1]:
             raise ValueError(
                 f"queries have {queries.shape[1]} features but the fitted "

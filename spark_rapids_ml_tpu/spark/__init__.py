@@ -17,8 +17,16 @@ instead of the CUDA-only spark-rapids columnar engine.
 
 from spark_rapids_ml_tpu.spark import arrow_fns
 from spark_rapids_ml_tpu.spark.estimators import (
+    SparkDBSCAN,
+    SparkDBSCANModel,
     SparkKMeans,
     SparkKMeansModel,
+    SparkNearestNeighbors,
+    SparkNearestNeighborsModel,
+    SparkRandomForestClassificationModel,
+    SparkRandomForestClassifier,
+    SparkRandomForestRegressionModel,
+    SparkRandomForestRegressor,
     SparkLinearRegression,
     SparkLinearRegressionModel,
     SparkLogisticRegression,
@@ -54,6 +62,14 @@ __all__ = [
     "arrow_fns",
     "SparkPCA",
     "SparkPCAModel",
+    "SparkDBSCAN",
+    "SparkDBSCANModel",
+    "SparkNearestNeighbors",
+    "SparkNearestNeighborsModel",
+    "SparkRandomForestClassifier",
+    "SparkRandomForestClassificationModel",
+    "SparkRandomForestRegressor",
+    "SparkRandomForestRegressionModel",
     "SparkKMeans",
     "SparkKMeansModel",
     "SparkLinearRegression",

@@ -917,6 +917,41 @@ class MatrixMapPartitionFn:
             )
 
 
+class MultiOutputPartitionFn:
+    """Transform body emitting ANY number of output columns from one device
+    pass: ``matrix_fn(mat)`` returns one array per ``output_cols`` entry of
+    ``(name, numpy dtype)`` — 2-D arrays become list columns, 1-D arrays
+    scalar columns, each CAST to its declared dtype, because mapInArrow
+    batches must match the declared Spark schema exactly (workers may
+    compute in f32 while the schema says DoubleType — see _list_column).
+    Serialization contract as MatrixMapPartitionFn (the fn object ships to
+    workers by pickle with the model bound inside)."""
+
+    def __init__(self, input_col: str, output_cols: list, matrix_fn):
+        self.input_col = input_col
+        self.output_cols = [(n, np.dtype(d)) for n, d in output_cols]
+        self.matrix_fn = matrix_fn
+
+    def __call__(self, batches):
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            outs = self.matrix_fn(
+                columnar.extract_matrix(batch, self.input_col)
+            )
+            cols, schema = list(batch.columns), batch.schema
+            for (name, dtype), out in zip(self.output_cols, outs):
+                out = np.asarray(out).astype(dtype, copy=False)
+                col = (
+                    _list_column(out.reshape(-1), out.shape[1])
+                    if out.ndim == 2
+                    else pa.array(out)
+                )
+                cols.append(col)
+                schema = schema.append(pa.field(name, col.type))
+            yield pa.RecordBatch.from_arrays(cols, schema=schema)
+
+
 class ProbaPredictionPartitionFn:
     """Classifier transform body emitting BOTH Spark ML output columns in
     one device pass: ``probabilityCol`` (the per-class probability vector —

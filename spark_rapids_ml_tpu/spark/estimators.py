@@ -32,6 +32,17 @@ from spark_rapids_ml_tpu.models.linear import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.dbscan import DBSCAN, DBSCANModel
+from spark_rapids_ml_tpu.models.forest import (
+    RandomForestClassificationModel,
+    RandomForestClassifier,
+    RandomForestRegressionModel,
+    RandomForestRegressor,
+)
+from spark_rapids_ml_tpu.models.neighbors import (
+    NearestNeighbors,
+    NearestNeighborsModel,
+)
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
 from spark_rapids_ml_tpu.models import scaler as _scaler_mod
 from spark_rapids_ml_tpu.models.selector import (
@@ -2100,3 +2111,346 @@ class SparkNormalizer(Normalizer):
             self, dataset, self._normalize_matrix, self.getOutputCol(),
             scalar=False,
         )
+
+
+# ---------------------------------------------------------------------------
+# r5 model families: NearestNeighbors, DBSCAN, RandomForest
+# ---------------------------------------------------------------------------
+
+
+def _collect_xyw(dataset, feats, label_col=None, weight_col=None):
+    """Concatenate a Spark DataFrame's (features[, label][, weight]) columns
+    on the driver through the memory-bounded ingest chunker — the
+    driver-merge collection step the r5 families share. ``est_bytes`` is
+    computed (one count job on pyspark) so datasets above the Arrow cutover
+    actually take the streaming toLocalIterator path."""
+    from spark_rapids_ml_tpu.spark import ingest
+
+    cols = [feats] + ([label_col] if label_col else []) + (
+        [weight_col] if weight_col else []
+    )
+    selected = dataset.select(*cols)
+    if hasattr(selected, "_parts"):  # localspark streams natively
+        est_bytes = 0
+    else:
+        n = _infer_n(dataset, feats)
+        est_bytes = dataset.count() * (n + len(cols) - 1) * 8
+    xs, ys, ws = [], [], []
+    for x, y, w in ingest._iter_chunks(
+        selected, feats, label_col, weight_col, est_bytes=est_bytes
+    ):
+        xs.append(x)
+        if y is not None:
+            ys.append(y)
+        if w is not None:
+            ws.append(w)
+    if not xs:
+        raise ValueError("dataset has no rows")
+    return (
+        np.concatenate(xs),
+        np.concatenate(ys) if ys else None,
+        np.concatenate(ws) if ws else None,
+    )
+
+
+class SparkNearestNeighbors(NearestNeighbors):
+    """Exact brute-force k-NN over pyspark DataFrames: ``fit`` collects the
+    item set into the model (k-NN's training IS ingestion, as in
+    spark-rapids-ml's NearestNeighbors), and the model's query side runs as
+    an embarrassingly parallel mapInArrow pass — the item matrix ships to
+    executors inside the plan function, each batch computes its own
+    blocked-tournament top-k on the local accelerator."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            model = SparkNearestNeighborsModel(
+                uid=core.uid, items=core.items, itemIds=core.itemIds
+            )
+            return self._copyValues(model)
+        feats = _resolve_col(self, "inputCol") or "features"
+        id_col = self._paramMap.get("idCol")
+        items, ids, _ = _collect_xyw(dataset, feats, label_col=id_col)
+        if items.shape[0] < self.getK():
+            raise ValueError(
+                f"k={self.getK()} exceeds the fitted item count "
+                f"{items.shape[0]}"
+            )
+        if ids is None:
+            ids = np.arange(items.shape[0], dtype=np.int64)
+        elif np.all(ids == np.round(ids)):
+            ids = ids.astype(np.int64)
+        model = SparkNearestNeighborsModel(
+            uid=self.uid, items=items, itemIds=ids
+        )
+        return self._copyValues(model)
+
+
+class SparkNearestNeighborsModel(NearestNeighborsModel):
+    def kneighbors(self, dataset: Any, k: int | None = None):
+        """Spark DataFrame in → DataFrame out with ``indices`` (item-id
+        arrays) and ``distances`` appended; array inputs keep the core
+        (distances, ids) ndarray contract."""
+        if not _is_spark_df(dataset):
+            return super().kneighbors(dataset, k)
+        T, _ = _sql_mods(dataset)
+        kk = self.getK() if k is None else k
+        model = self
+        # the indices column type follows the fitted id dtype: positional /
+        # integral ids are LongType, non-integral idCol values DoubleType —
+        # the declared schema and the worker's cast must agree exactly
+        int_ids = np.issubdtype(self.itemIds.dtype, np.integer)
+        id_np = np.int64 if int_ids else np.float64
+        id_sql = T.LongType() if int_ids else T.DoubleType()
+
+        def matrix_fn(mat, _m=model, _k=kk):
+            d, i = _m._kneighbors_matrix(mat, _k)
+            return i, d
+
+        fn = arrow_fns.MultiOutputPartitionFn(
+            _resolve_col(self, "inputCol") or "features",
+            [("indices", id_np), ("distances", np.float64)],
+            matrix_fn,
+        )
+        with trace_range("knn spark transform"):
+            return _spark_append(
+                dataset,
+                fn,
+                [
+                    ("indices", T.ArrayType(id_sql)),
+                    ("distances", T.ArrayType(T.DoubleType())),
+                ],
+            )
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return self.kneighbors(dataset)
+
+
+class SparkDBSCAN(DBSCAN):
+    """DBSCAN over pyspark DataFrames — see SparkDBSCANModel.transform."""
+
+    def fit(self, dataset: Any = None) -> "SparkDBSCANModel":
+        return self._copyValues(SparkDBSCANModel(uid=self.uid))
+
+
+class SparkDBSCANModel(DBSCANModel):
+    """Density clustering needs EVERY pairwise relation, so the Spark path
+    is collect-and-cluster: the DataFrame is gathered to the driver
+    (memory-bounded chunker), labels are computed on the driver's device
+    mesh when it has more than one chip (the sharded label-propagation
+    program, parallel/dbscan.py) or on one device otherwise, and the result
+    comes back as a DataFrame with the prediction column appended — row
+    order preserved. O(rows·features) driver memory; the O(n²) compute that
+    dominates DBSCAN runs on the accelerator either way (spark-rapids-ml's
+    cuML DBSCAN is equally single-worker-global)."""
+
+    def _compute_labels(self, x, weights, eps_sq, min_samples) -> np.ndarray:
+        """Kernel hook override: mesh-sharded label propagation when the
+        driver owns >1 device (rows padded to an equal-shard multiple),
+        the single-device kernel otherwise — identical outputs (tests
+        assert so). All eps/dtype/relabel semantics stay in the base
+        ``_cluster_matrix``."""
+        import jax
+
+        ndev = len(jax.devices())
+        if ndev <= 1:
+            return super()._compute_labels(x, weights, eps_sq, min_samples)
+        import jax.numpy as jnp
+
+        from spark_rapids_ml_tpu.parallel.dbscan import make_sharded_dbscan
+        from spark_rapids_ml_tpu.parallel.mesh import create_mesh
+
+        rows = x.shape[0]
+        per = -(-rows // ndev)
+        xp, w, valid = self._pad_inputs(x, weights, per * ndev)
+        run = make_sharded_dbscan(create_mesh(data=ndev))
+        return np.asarray(
+            run(
+                jnp.asarray(xp), jnp.asarray(w), jnp.asarray(valid),
+                jnp.asarray(eps_sq), jnp.asarray(min_samples),
+            )
+        )[:rows]
+
+    def clusterLabels(self, dataset: Any) -> np.ndarray:
+        if not _is_spark_df(dataset):
+            return super().clusterLabels(dataset)
+        _, labels = self._collect_and_cluster(dataset)
+        return labels
+
+    def _collect_and_cluster(self, dataset):
+        """ONE collection feeding both the clustering and the output table:
+        a second collect could legally return rows in a different order
+        (nondeterministic plans), silently misaligning labels."""
+        feats = _resolve_col(self, "inputCol") or "features"
+        weight_col = self._paramMap.get("weightCol")
+        if hasattr(dataset, "_parts"):  # localspark: exact Arrow round-trip
+            table = dataset.toArrow()
+        else:
+            table = dataset.toPandas()
+        x = columnar.extract_matrix(table, feats)
+        w = None
+        if weight_col is not None:
+            w = columnar.validate_weights(
+                columnar.extract_vector(table, weight_col), x.shape[0]
+            )
+        with trace_range("dbscan spark cluster"):
+            return table, self._cluster_matrix(x, w)
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        table, labels = self._collect_and_cluster(dataset)
+        session = getattr(dataset, "sparkSession", None) or dataset._session
+        if hasattr(dataset, "_parts"):
+            import pyarrow as pa
+
+            table = table.append_column(
+                self.getPredictionCol(), pa.array(labels, type=pa.int32())
+            )
+        else:
+            table[self.getPredictionCol()] = labels
+        return session.createDataFrame(table)
+
+
+class SparkRandomForestClassifier(_HasDistribution, RandomForestClassifier):
+    """RandomForestClassifier over pyspark DataFrames.
+
+    ``driver-merge`` collects (features, label, weight) through the
+    memory-bounded chunker and builds on the driver's default device;
+    ``mesh-local`` routes the SAME build through the mesh-sharded program
+    (rows sharded, one histogram psum per level, parallel/forest.py) on the
+    driver's device mesh — bit-identical trees."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-local")
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            return self._wrap(core)
+        x, y, w = _collect_xyw(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            label_col=self.getOrDefault("labelCol"),
+            weight_col=self._paramMap.get("weightCol"),
+        )
+        builder = (
+            _mesh_forest_builder()
+            if self.getOrDefault("distribution") == "mesh-local"
+            else None
+        )
+        return self._wrap(self._make_model(x, y, w, builder=builder))
+
+    def _wrap(self, core):
+        model = SparkRandomForestClassificationModel(
+            uid=core.uid, trees=core.trees, thresholds=core.thresholds,
+            numFeatures=core.numFeatures,
+        )
+        return self._copyValues(model)
+
+
+class SparkRandomForestClassificationModel(RandomForestClassificationModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        T, _ = _sql_mods(dataset)
+        model = self
+        n_trees = self.trees.feature.shape[0]
+
+        def matrix_fn(mat, _m=model, _t=n_trees):
+            proba, pred = _m.proba_and_predictions(mat)
+            return proba * _t, proba, pred
+
+        fn = arrow_fns.MultiOutputPartitionFn(
+            self.getOrDefault("featuresCol"),
+            [
+                (self.getOrDefault("rawPredictionCol"), np.float64),
+                (self.getOrDefault("probabilityCol"), np.float64),
+                (self.getOrDefault("predictionCol"), np.float64),
+            ],
+            matrix_fn,
+        )
+        with trace_range("rf transform"):
+            return _spark_append(
+                dataset,
+                fn,
+                [
+                    (self.getOrDefault("rawPredictionCol"), T.ArrayType(T.DoubleType())),
+                    (self.getOrDefault("probabilityCol"), T.ArrayType(T.DoubleType())),
+                    (self.getOrDefault("predictionCol"), T.DoubleType()),
+                ],
+            )
+
+
+class SparkRandomForestRegressor(_HasDistribution, RandomForestRegressor):
+    """RandomForestRegressor over pyspark DataFrames — distribution modes
+    as SparkRandomForestClassifier."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-local")
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            return self._wrap(core)
+        x, y, w = _collect_xyw(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            label_col=self.getOrDefault("labelCol"),
+            weight_col=self._paramMap.get("weightCol"),
+        )
+        builder = (
+            _mesh_forest_builder()
+            if self.getOrDefault("distribution") == "mesh-local"
+            else None
+        )
+        return self._wrap(self._make_model(x, y, w, builder=builder))
+
+    def _wrap(self, core):
+        model = SparkRandomForestRegressionModel(
+            uid=core.uid, trees=core.trees, thresholds=core.thresholds,
+            numFeatures=core.numFeatures,
+        )
+        return self._copyValues(model)
+
+
+class SparkRandomForestRegressionModel(RandomForestRegressionModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._predict_matrix,
+            self.getOrDefault("predictionCol"), scalar=True,
+        )
+
+
+def _mesh_forest_builder():
+    """A drop-in for ops.forest.build_forest that routes the build through
+    the mesh-sharded program on THIS process's device mesh: rows padded to
+    an equal-shard multiple (pad weight 0 — histogram-invisible), one
+    psum per level. Bit-identical trees to the local build."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.parallel.forest import make_sharded_forest
+    from spark_rapids_ml_tpu.parallel.mesh import create_mesh
+
+    def build(keys, binned, row_stats, weights, min_inst, min_gain, **static):
+        ndev = len(jax.devices())
+        if ndev <= 1:
+            from spark_rapids_ml_tpu.ops.forest import build_forest
+
+            return build_forest(
+                keys, binned, row_stats, weights, min_inst, min_gain, **static
+            )
+        rows = binned.shape[0]
+        per = -(-rows // ndev)
+        pad = per * ndev - rows
+        if pad:
+            binned = jnp.pad(binned, ((0, pad), (0, 0)))
+            row_stats = jnp.pad(row_stats, ((0, pad), (0, 0)))
+            weights = jnp.pad(weights, ((0, 0), (0, pad)))
+        run = make_sharded_forest(create_mesh(data=ndev), **static)
+        return run(keys, binned, row_stats, weights, min_inst, min_gain)
+
+    return build

@@ -1,0 +1,201 @@
+"""r5 family Spark wrappers over the bundled localspark engine.
+
+The wrappers run the SAME plan code on localspark and real pyspark
+(``_sql_mods`` dispatch), so these localspark-driven tests exercise the
+actual mapInArrow bodies, schema handling, and collect paths; the pyspark
+legs live in the CI integration matrix like every other wrapper family.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.localspark import LocalSparkSession
+from spark_rapids_ml_tpu.localspark import types as LT
+from spark_rapids_ml_tpu.spark import (
+    SparkDBSCAN,
+    SparkNearestNeighbors,
+    SparkNearestNeighborsModel,
+    SparkRandomForestClassifier,
+    SparkRandomForestRegressor,
+)
+
+
+@pytest.fixture(scope="module")
+def spark():
+    with LocalSparkSession(parallelism=3) as s:
+        yield s
+
+
+def _features_df(s, x, extra=None, num_partitions=3):
+    fields = [LT.StructField("features", LT.ArrayType(LT.DoubleType()))]
+    rows = [(row.tolist(),) for row in x]
+    if extra:
+        for name, typ, vals in extra:
+            fields.append(LT.StructField(name, typ))
+        rows = [
+            base + tuple(float(vals[i]) for _, _, vals in extra)
+            for i, base in enumerate(rows)
+        ]
+    return s.createDataFrame(
+        rows, LT.StructType(fields), numPartitions=num_partitions
+    )
+
+
+def test_spark_knn_matches_core(spark, rng):
+    items = rng.normal(size=(200, 8))
+    queries = rng.normal(size=(40, 8))
+    item_df = _features_df(spark, items)
+    query_df = _features_df(spark, queries)
+
+    model = SparkNearestNeighbors().setInputCol("features").setK(6).fit(item_df)
+    assert isinstance(model, SparkNearestNeighborsModel)
+    out = model.kneighbors(query_df)
+    rows = sorted(out.collect(), key=lambda r: tuple(r["features"]))
+
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+    core = NearestNeighbors().setK(6).fit(items)
+    d_ref, i_ref = core.kneighbors(queries)
+    by_query = {
+        tuple(q): (d_ref[i], i_ref[i]) for i, q in enumerate(queries)
+    }
+    for r in rows:
+        d, i = by_query[tuple(r["features"])]
+        np.testing.assert_array_equal(np.asarray(r["indices"]), i)
+        # worker subprocesses compute in f32 (production default);
+        # the f64 core reference differs at float32 epsilon
+        np.testing.assert_allclose(np.asarray(r["distances"]), d, rtol=1e-5)
+
+
+def test_spark_knn_id_col(spark, rng):
+    items = rng.normal(size=(60, 4))
+    ids = (np.arange(60) * 7).astype(float)
+    df = _features_df(
+        spark, items, extra=[("item_id", LT.DoubleType(), ids)]
+    )
+    model = (
+        SparkNearestNeighbors().setInputCol("features").setIdCol("item_id")
+        .setK(1).fit(df)
+    )
+    out = model.transform(_features_df(spark, items + 1e-12))
+    got = {tuple(r["features"]): r["indices"][0] for r in out.collect()}
+    for i, row in enumerate(items + 1e-12):
+        assert got[tuple(row)] == i * 7
+
+
+def test_spark_knn_float_ids_schema(spark, rng):
+    """Non-integral idCol values keep a DoubleType indices column — the
+    declared schema and the worker's cast must agree (real pyspark rejects
+    dtype-mismatched mapInArrow batches)."""
+    items = rng.normal(size=(30, 3))
+    ids = np.arange(30) + 0.5
+    df = _features_df(spark, items, extra=[("item_id", LT.DoubleType(), ids)])
+    model = (
+        SparkNearestNeighbors().setInputCol("features").setIdCol("item_id")
+        .setK(1).fit(df)
+    )
+    out = model.kneighbors(_features_df(spark, items))
+    field = {f.name: f for f in out.schema.fields}["indices"]
+    assert isinstance(field.dataType.elementType, LT.DoubleType)
+    got = {tuple(r["features"]): r["indices"][0] for r in out.collect()}
+    for i, row in enumerate(items):
+        assert got[tuple(row)] == i + 0.5
+
+
+def test_spark_dbscan_matches_core(spark, rng):
+    blobs = np.concatenate(
+        [rng.normal(c, 0.25, size=(40, 3)) for c in (0.0, 6.0, -6.0)]
+    )
+    noise = rng.uniform(-12, 12, size=(8, 3))
+    x = np.concatenate([blobs, noise])
+    df = _features_df(spark, x)
+
+    model = (
+        SparkDBSCAN().setInputCol("features").setEps(1.2).setMinSamples(5)
+        .fit(df)
+    )
+    out = model.transform(df)
+    assert "prediction" in out.schema.names
+    got = np.array([r["prediction"] for r in out.collect()])
+
+    from spark_rapids_ml_tpu.clustering import DBSCAN
+
+    ref = DBSCAN().setEps(1.2).setMinSamples(5).fit().clusterLabels(x)
+    np.testing.assert_array_equal(got, ref)
+    # row order is preserved through the collect-and-rebuild path
+    feats = np.stack([np.asarray(r["features"]) for r in out.collect()])
+    np.testing.assert_allclose(feats, x)
+
+
+def test_spark_rf_classifier_both_distributions(spark, rng):
+    x = rng.normal(size=(400, 6))
+    y = (1.2 * x[:, 0] - x[:, 4] > 0).astype(float)
+    df = spark.createDataFrame(
+        [(row.tolist(), float(lab)) for row, lab in zip(x, y)],
+        LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        ),
+        numPartitions=3,
+    )
+    est = SparkRandomForestClassifier().setNumTrees(6).setMaxDepth(4).setSeed(2)
+    m_driver = est.copy().setDistribution("driver-merge").fit(df)
+    m_mesh = est.copy().setDistribution("mesh-local").fit(df)
+    # bit-identical trees regardless of distribution mode
+    np.testing.assert_array_equal(
+        np.asarray(m_driver.trees.feature), np.asarray(m_mesh.trees.feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_driver.trees.leaf_stats),
+        np.asarray(m_mesh.trees.leaf_stats),
+        rtol=1e-12,
+    )
+
+    out = m_driver.transform(df)
+    assert {"rawPrediction", "probability", "prediction"} <= set(out.schema.names)
+    rows = out.collect()
+    acc = np.mean(
+        [r["prediction"] == lab for r, lab in zip(rows, y)]
+    )
+    assert acc > 0.85, acc
+    p = np.stack([np.asarray(r["probability"]) for r in rows])
+    np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-5)  # f32 workers
+    raw = np.stack([np.asarray(r["rawPrediction"]) for r in rows])
+    np.testing.assert_allclose(raw, p * 6, rtol=1e-5)
+
+
+def test_spark_rf_regressor(spark, rng):
+    x = rng.normal(size=(400, 5))
+    y = 2.0 * x[:, 1] + np.sin(x[:, 3])
+    df = spark.createDataFrame(
+        [(row.tolist(), float(val)) for row, val in zip(x, y)],
+        LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        ),
+        numPartitions=2,
+    )
+    m = (
+        SparkRandomForestRegressor().setNumTrees(8).setMaxDepth(6)
+        .setFeatureSubsetStrategy("all").setSeed(4).fit(df)
+    )
+    preds = np.array([r["prediction"] for r in m.transform(df).collect()])
+    r2 = 1 - ((preds - y) ** 2).mean() / y.var()
+    assert r2 > 0.8, r2
+
+
+def test_spark_wrappers_fall_through_to_core(rng):
+    """Non-Spark inputs keep the core contract on every r5 wrapper."""
+    x = rng.normal(size=(50, 4))
+    m = SparkNearestNeighbors().setK(3).fit(x)
+    d, i = m.kneighbors(x[:5])
+    assert d.shape == (5, 3)
+    db = SparkDBSCAN().setEps(0.8).setMinSamples(3).fit()
+    assert db.clusterLabels(x).shape == (50,)
+    y = (x[:, 0] > 0).astype(float)
+    rf = SparkRandomForestClassifier().setNumTrees(2).fit((x, y))
+    assert rf._predict_matrix(x).shape == (50,)

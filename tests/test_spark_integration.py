@@ -1425,3 +1425,80 @@ class TestRangeScalersIntegration:
             want = [row0[0], row0[0] ** 2, row0[0] ** 3]
             key = tuple(np.round(row0, 9))
             np.testing.assert_allclose(ours[key][:3], want, atol=1e-9)
+
+
+class TestR5FamiliesIntegration:
+    """The r5 model families (k-NN, DBSCAN, random forest) through the live
+    DataFrame surface on both backends — differential vs the core paths."""
+
+    def test_knn_kneighbors_live(self, backend, rng_m):
+        from spark_rapids_ml_tpu.knn import NearestNeighbors
+        from spark_rapids_ml_tpu.spark import SparkNearestNeighbors
+
+        items = rng_m.normal(size=(150, 6))
+        queries = rng_m.normal(size=(30, 6))
+        schema = backend.features_schema()
+        item_df = backend.df([(r.tolist(),) for r in items], schema)
+        query_df = backend.df([(r.tolist(),) for r in queries], schema)
+        model = (
+            SparkNearestNeighbors().setInputCol("features").setK(5)
+            .fit(item_df)
+        )
+        got = {
+            tuple(np.round(r["features"], 9)): np.asarray(r["indices"])
+            for r in model.kneighbors(query_df).collect()
+        }
+        d_ref, i_ref = NearestNeighbors().setK(5).fit(items).kneighbors(queries)
+        for q, idx in zip(queries, i_ref):
+            np.testing.assert_array_equal(got[tuple(np.round(q, 9))], idx)
+
+    def test_dbscan_live(self, backend, rng_m):
+        from spark_rapids_ml_tpu.clustering import DBSCAN
+        from spark_rapids_ml_tpu.spark import SparkDBSCAN
+
+        x = np.concatenate(
+            [rng_m.normal(c, 0.2, size=(35, 3)) for c in (0.0, 5.0)]
+            + [rng_m.uniform(-10, 10, size=(6, 3))]
+        )
+        df = backend.df([(r.tolist(),) for r in x], backend.features_schema())
+        out = (
+            SparkDBSCAN().setInputCol("features").setEps(1.0)
+            .setMinSamples(4).fit(df).transform(df)
+        )
+        got = {
+            tuple(np.round(r["features"], 9)): r["prediction"]
+            for r in out.collect()
+        }
+        ref = DBSCAN().setEps(1.0).setMinSamples(4).fit().clusterLabels(x)
+        for row, lab in zip(x, ref):
+            assert got[tuple(np.round(row, 9))] == lab
+
+    def test_random_forest_live(self, backend, rng_m):
+        from spark_rapids_ml_tpu.spark import SparkRandomForestClassifier
+
+        x = rng_m.normal(size=(300, 5))
+        y = (x[:, 0] - 0.8 * x[:, 2] > 0).astype(float)
+        T = backend.T
+        schema = T.StructType(
+            [
+                T.StructField("features", T.ArrayType(T.DoubleType())),
+                T.StructField("label", T.DoubleType()),
+            ]
+        )
+        df = backend.df(
+            [(r.tolist(), float(l)) for r, l in zip(x, y)], schema
+        )
+        est = (
+            SparkRandomForestClassifier().setNumTrees(5).setMaxDepth(4)
+            .setSeed(7)
+        )
+        model = est.fit(df)
+        # the Spark fit equals the core fit on the same rows (collection
+        # preserves content; forest build is deterministic by seed)
+        core = est.copy().fit((x, y))
+        np.testing.assert_array_equal(
+            np.asarray(model.trees.feature), np.asarray(core.trees.feature)
+        )
+        rows = model.transform(df).collect()
+        acc = np.mean([r["prediction"] == l for r, l in zip(rows, y)])
+        assert acc > 0.85, acc
