@@ -65,7 +65,12 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import kmeans
 
         return getattr(kmeans, name)
-    if name in ("NearestNeighbors", "NearestNeighborsModel"):
+    if name in (
+        "NearestNeighbors",
+        "NearestNeighborsModel",
+        "ApproximateNearestNeighbors",
+        "ApproximateNearestNeighborsModel",
+    ):
         from spark_rapids_ml_tpu.models import neighbors
 
         return getattr(neighbors, name)

@@ -6,8 +6,15 @@ that API the same import path here.
 """
 
 from spark_rapids_ml_tpu.models.neighbors import (  # noqa: F401
+    ApproximateNearestNeighbors,
+    ApproximateNearestNeighborsModel,
     NearestNeighbors,
     NearestNeighborsModel,
 )
 
-__all__ = ["NearestNeighbors", "NearestNeighborsModel"]
+__all__ = [
+    "ApproximateNearestNeighbors",
+    "ApproximateNearestNeighborsModel",
+    "NearestNeighbors",
+    "NearestNeighborsModel",
+]

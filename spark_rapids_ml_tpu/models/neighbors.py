@@ -97,6 +97,39 @@ class _NearestNeighborsParams(HasInputCol):
         return self.getOrDefault("metric")
 
 
+def _extract_items_and_ids(dataset, ds, id_col, k):
+    """THE fit-side ingestion both k-NN estimators share: concatenated item
+    matrix + aligned ids (positional when ``id_col`` is None; integral ids
+    cast back to int64 after the float64 extractor — exact up to 2^53),
+    with the k-vs-items and ids-vs-items validations in one place."""
+    items = np.concatenate(list(ds.matrices()), axis=0)
+    if items.shape[0] < k:
+        raise ValueError(
+            f"k={k} exceeds the fitted item count {items.shape[0]}"
+        )
+    if id_col is not None:
+        # a list of columnar partitions (the from_any list branch) has
+        # its id column extracted per partition, in partition order
+        if isinstance(dataset, (list, tuple)) and not isinstance(
+            dataset, np.ndarray
+        ):
+            ids = np.concatenate(
+                [columnar.extract_vector(p, id_col) for p in dataset]
+            )
+        else:
+            ids = columnar.extract_vector(dataset, id_col)
+        if ids.shape[0] != items.shape[0]:
+            raise ValueError(
+                f"idCol {id_col!r} has {ids.shape[0]} values for "
+                f"{items.shape[0]} items"
+            )
+        if np.all(ids == np.round(ids)):  # integral ids stay integral
+            ids = ids.astype(np.int64)
+    else:
+        ids = np.arange(items.shape[0], dtype=np.int64)
+    return items, ids
+
+
 class NearestNeighbors(_NearestNeighborsParams, Estimator):
     """Brute-force exact k-NN over a fitted item set."""
 
@@ -123,33 +156,9 @@ class NearestNeighbors(_NearestNeighborsParams, Estimator):
         ds = columnar.PartitionedDataset.from_any(
             dataset, input_col, num_partitions
         )
-        items = np.concatenate(list(ds.matrices()), axis=0)
-        if items.shape[0] < self.getK():
-            raise ValueError(
-                f"k={self.getK()} exceeds the fitted item count "
-                f"{items.shape[0]}"
-            )
-        id_col = self._paramMap.get("idCol")
-        if id_col is not None:
-            # a list of columnar partitions (the from_any list branch) has
-            # its id column extracted per partition, in partition order
-            if isinstance(dataset, (list, tuple)) and not isinstance(
-                dataset, np.ndarray
-            ):
-                ids = np.concatenate(
-                    [columnar.extract_vector(p, id_col) for p in dataset]
-                )
-            else:
-                ids = columnar.extract_vector(dataset, id_col)
-            if np.all(ids == np.round(ids)):  # integral ids stay integral
-                ids = ids.astype(np.int64)
-        else:
-            ids = np.arange(items.shape[0], dtype=np.int64)
-        if ids.shape[0] != items.shape[0]:
-            raise ValueError(
-                f"idCol {id_col!r} has {ids.shape[0]} values for "
-                f"{items.shape[0]} items"
-            )
+        items, ids = _extract_items_and_ids(
+            dataset, ds, self._paramMap.get("idCol"), self.getK()
+        )
         model = NearestNeighborsModel(uid=self.uid, items=items, itemIds=ids)
         return self._copyValues(model)
 
@@ -244,3 +253,221 @@ class NearestNeighborsModel(_NearestNeighborsParams, Model):
     @classmethod
     def _fromSaved(cls, uid, data):
         return cls(uid=uid, items=data["items"], itemIds=data["itemIds"])
+
+
+# ---------------------------------------------------------------------------
+# Approximate nearest neighbors (IVF-Flat)
+# ---------------------------------------------------------------------------
+
+_ANN_METRICS = ("euclidean", "sqeuclidean", "cosine")
+
+
+class _ANNParams(_NearestNeighborsParams):
+    nlist = Param(
+        "nlist",
+        "IVF cluster count (0 = auto: ~sqrt(items), the cuML heuristic)",
+        int,
+    )
+    nprobe = Param("nprobe", "clusters scanned per query", int)
+    maxIter = Param("maxIter", "Lloyd iterations for the coarse quantizer", int)
+    seed = Param("seed", "random seed for the coarse quantizer", int)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(nlist=0, nprobe=20, maxIter=10, seed=0)
+
+    def getNlist(self) -> int:
+        return self.getOrDefault("nlist")
+
+    def getNprobe(self) -> int:
+        return self.getOrDefault("nprobe")
+
+
+class ApproximateNearestNeighbors(_ANNParams, Estimator):
+    """IVF-Flat approximate k-NN — spark-rapids-ml's
+    ``ApproximateNearestNeighbors(algorithm='ivfflat')``: the corpus is
+    clustered by this package's KMeans and queries scan only the
+    ``nprobe`` nearest clusters (ops/ivf.py; the module docstring has the
+    honest TPU brute-force-vs-IVF trade). ``nprobe == nlist`` degenerates
+    to exact search (tested bit-for-bit against NearestNeighbors)."""
+
+    def setK(self, value: int) -> "ApproximateNearestNeighbors":
+        if value < 1:
+            raise ValueError(f"k must be >= 1, got {value}")
+        return self._set(k=value)
+
+    def setMetric(self, value: str) -> "ApproximateNearestNeighbors":
+        if value not in _ANN_METRICS:
+            raise ValueError(
+                f"metric must be one of {_ANN_METRICS}, got {value!r}"
+            )
+        return self._set(metric=value)
+
+    def setIdCol(self, value: str) -> "ApproximateNearestNeighbors":
+        return self._set(idCol=value)
+
+    def setNlist(self, value: int) -> "ApproximateNearestNeighbors":
+        if value < 0:
+            raise ValueError(f"nlist must be >= 0, got {value}")
+        return self._set(nlist=value)
+
+    def setNprobe(self, value: int) -> "ApproximateNearestNeighbors":
+        if value < 1:
+            raise ValueError(f"nprobe must be >= 1, got {value}")
+        return self._set(nprobe=value)
+
+    def setMaxIter(self, value: int) -> "ApproximateNearestNeighbors":
+        return self._set(maxIter=value)
+
+    def setSeed(self, value: int) -> "ApproximateNearestNeighbors":
+        return self._set(seed=value)
+
+    def fit(
+        self, dataset: Any, num_partitions: int | None = None
+    ) -> "ApproximateNearestNeighborsModel":
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+        from spark_rapids_ml_tpu.ops import ivf as IVF
+        from spark_rapids_ml_tpu.ops import kmeans as KM
+
+        input_col = self._paramMap.get("inputCol")
+        ds = columnar.PartitionedDataset.from_any(
+            dataset, input_col, num_partitions
+        )
+        items, ids = _extract_items_and_ids(
+            dataset, ds, self._paramMap.get("idCol"), self.getK()
+        )
+
+        metric = self.getMetric()
+        fdt = columnar.float_dtype_for(items.dtype)
+        prepared = _prepare_rows(items.astype(fdt, copy=False), metric)
+        nlist = self.getNlist() or max(
+            1, min(items.shape[0], int(np.sqrt(items.shape[0])))
+        )
+        nlist = min(nlist, items.shape[0])
+        with trace_range("ivf build"):
+            km = (
+                KMeans(uid=f"{self.uid}-quantizer")
+                .setK(nlist)
+                .setMaxIter(self.getOrDefault("maxIter"))
+                .setSeed(self.getOrDefault("seed"))
+            )
+            kmodel = km.fit(prepared)
+            centroids = kmodel.clusterCenters.astype(fdt)
+            labels, _ = KM.assign_clusters(
+                jnp.asarray(prepared), jnp.asarray(centroids)
+            )
+            bucket_items, bucket_ids, _ = IVF.build_ivf_buckets(
+                prepared, np.asarray(labels), nlist
+            )
+        model = ApproximateNearestNeighborsModel(
+            uid=self.uid,
+            centroids=centroids,
+            bucketItems=bucket_items,
+            bucketIds=bucket_ids,
+            itemIds=ids,
+        )
+        return self._copyValues(model)
+
+
+class ApproximateNearestNeighborsModel(_ANNParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        centroids: np.ndarray | None = None,
+        bucketItems: np.ndarray | None = None,
+        bucketIds: np.ndarray | None = None,
+        itemIds: np.ndarray | None = None,
+    ):
+        super().__init__(uid)
+        self.centroids = None if centroids is None else np.asarray(centroids)
+        self.bucketItems = (
+            None if bucketItems is None else np.asarray(bucketItems)
+        )
+        self.bucketIds = None if bucketIds is None else np.asarray(bucketIds)
+        self.itemIds = None if itemIds is None else np.asarray(itemIds)
+
+    @property
+    def numItems(self) -> int:
+        return self.itemIds.shape[0]
+
+    def kneighbors(
+        self, dataset: Any, k: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        queries = columnar.extract_matrix(
+            dataset, self._paramMap.get("inputCol")
+        )
+        return self._kneighbors_matrix(queries, k)
+
+    def _kneighbors_matrix(self, queries, k=None):
+        from spark_rapids_ml_tpu.ops import ivf as IVF
+
+        k = self.getK() if k is None else k
+        if not 1 <= k <= self.numItems:
+            raise ValueError(
+                f"k={k} must be in [1, {self.numItems}] (the fitted item count)"
+            )
+        metric = self.getMetric()
+        if queries.shape[1] != self.centroids.shape[1]:
+            raise ValueError(
+                f"queries have {queries.shape[1]} features but the fitted "
+                f"items have {self.centroids.shape[1]}"
+            )
+        fdt = self.bucketItems.dtype
+        queries = _prepare_rows(queries.astype(fdt, copy=False), metric)
+        cd = jnp.asarray(self.centroids)
+        bi = jnp.asarray(self.bucketItems)
+        bd = jnp.asarray(self.bucketIds)
+        nprobe = self.getNprobe()
+
+        out_scores = np.empty((queries.shape[0], k), dtype=fdt)
+        out_idx = np.empty((queries.shape[0], k), dtype=np.int32)
+        with trace_range("ivf kneighbors"):
+            for lo in range(0, queries.shape[0], _QUERY_CHUNK):
+                chunk = queries[lo : lo + _QUERY_CHUNK]
+                qpad, q_rows = columnar.pad_rows(chunk)
+                scores, idx = IVF.ivf_search(
+                    jnp.asarray(qpad), cd, bi, bd, k, nprobe
+                )
+                out_scores[lo : lo + q_rows] = np.asarray(scores)[:q_rows]
+                out_idx[lo : lo + q_rows] = np.asarray(idx)[:q_rows]
+
+        # cosine rides normalized sqeuclidean here: 1 − cos = ‖x̂−ŷ‖²/2
+        # over [0, 2] (anti-parallel → 2). Caveat vs the exact model's
+        # dot-kernel cosine: an all-zero row lands at 0.5, not 1 — the IVF
+        # coarse quantizer needs one metric for centroids and members, and
+        # zero vectors have no direction to quantize. Unfilled slots
+        # (id −1, score −inf) must stay inf, never clip to a legal 2.0.
+        if metric == "cosine":
+            sq = np.clip(-out_scores, 0.0, None)
+            dists = np.where(
+                np.isfinite(sq), np.clip(sq / 2.0, 0.0, 2.0), np.inf
+            )
+        else:
+            dists = _finalize_distances(out_scores, metric)
+        safe_idx = np.clip(out_idx, 0, None)
+        ids = np.where(out_idx >= 0, self.itemIds[safe_idx], -1)
+        return dists, ids
+
+    def transform(self, dataset: Any) -> Any:
+        dists, ids = self.kneighbors(dataset)
+        return columnar.append_columns(
+            dataset, [("indices", ids), ("distances", dists)]
+        )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {
+            "centroids": self.centroids,
+            "bucketItems": self.bucketItems,
+            "bucketIds": self.bucketIds,
+            "itemIds": self.itemIds,
+        }
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(
+            uid=uid,
+            centroids=data["centroids"],
+            bucketItems=data["bucketItems"],
+            bucketIds=data["bucketIds"].astype(np.int32),
+            itemIds=data["itemIds"],
+        )
