@@ -78,6 +78,10 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import dbscan
 
         return getattr(dbscan, name)
+    if name in ("UMAP", "UMAPModel"):
+        from spark_rapids_ml_tpu.models import umap
+
+        return getattr(umap, name)
     if name in (
         "RandomForestClassifier",
         "RandomForestClassificationModel",

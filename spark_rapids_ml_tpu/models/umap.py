@@ -1,0 +1,283 @@
+"""UMAP estimator/model — the spark-rapids-ml manifold-learning family.
+
+API mirrors spark-rapids-ml's cuML-backed UMAP: ``fit`` learns an
+embedding of the training set (held on the model as ``embedding_``),
+``transform`` embeds NEW rows against the fitted reference set, params
+follow the cuML/umap-learn names (nNeighbors, nComponents, minDist,
+spread, nEpochs, learningRate, negativeSampleRate, init, seed).
+
+Pipeline (ops/umap.py has the kernel story):
+1. exact k-NN graph (ops/neighbors.knn_topk — MXU tournament);
+2. vectorized-bisection (rho, sigma) calibration + fuzzy set union;
+3. spectral (scipy eigsh on the k-sparse Laplacian) or random init;
+4. the SGD force layout as ONE lax.fori_loop XLA program.
+
+``transform`` is the reference's out-of-sample recipe: k-NN of the new
+rows against the TRAINING set, init at the membership-weighted mean of
+neighbor embeddings, then a short reference-frozen optimization
+(``move_tails=False``): only the new points move, attracted along their
+neighbor edges and repelled by negative samples — umap-learn's transform
+semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
+from spark_rapids_ml_tpu.models.neighbors import _finalize_distances
+from spark_rapids_ml_tpu.ops import neighbors as NN
+from spark_rapids_ml_tpu.ops import umap as UM
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+
+class _UMAPParams(HasInputCol, HasOutputCol):
+    nNeighbors = Param("nNeighbors", "k of the fuzzy k-NN graph", int)
+    nComponents = Param("nComponents", "embedding dimensionality", int)
+    nEpochs = Param(
+        "nEpochs",
+        "SGD epochs (0 = auto: 500 small / 200 large, the umap-learn rule)",
+        int,
+    )
+    learningRate = Param("learningRate", "initial SGD learning rate", float)
+    minDist = Param("minDist", "minimum embedded pair distance", float)
+    spread = Param("spread", "embedding scale of the membership curve", float)
+    negativeSampleRate = Param(
+        "negativeSampleRate", "negative samples per positive edge", int
+    )
+    init = Param("init", "'spectral' (default) or 'random'", str)
+    seed = Param("seed", "random seed", int)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            nNeighbors=15, nComponents=2, nEpochs=0, learningRate=1.0,
+            minDist=0.1, spread=1.0, negativeSampleRate=5, init="spectral",
+            seed=0, outputCol="embedding",
+        )
+
+    def getNNeighbors(self) -> int:
+        return self.getOrDefault("nNeighbors")
+
+    def getNComponents(self) -> int:
+        return self.getOrDefault("nComponents")
+
+
+class UMAP(_UMAPParams, Estimator):
+    def setNNeighbors(self, value: int) -> "UMAP":
+        if value < 2:
+            raise ValueError(f"nNeighbors must be >= 2, got {value}")
+        return self._set(nNeighbors=value)
+
+    def setNComponents(self, value: int) -> "UMAP":
+        if value < 1:
+            raise ValueError(f"nComponents must be >= 1, got {value}")
+        return self._set(nComponents=value)
+
+    def setNEpochs(self, value: int) -> "UMAP":
+        return self._set(nEpochs=value)
+
+    def setLearningRate(self, value: float) -> "UMAP":
+        return self._set(learningRate=float(value))
+
+    def setMinDist(self, value: float) -> "UMAP":
+        return self._set(minDist=float(value))
+
+    def setSpread(self, value: float) -> "UMAP":
+        return self._set(spread=float(value))
+
+    def setNegativeSampleRate(self, value: int) -> "UMAP":
+        return self._set(negativeSampleRate=value)
+
+    def setInit(self, value: str) -> "UMAP":
+        if value not in ("spectral", "random"):
+            raise ValueError(f"init must be 'spectral' or 'random', got {value!r}")
+        return self._set(init=value)
+
+    def setSeed(self, value: int) -> "UMAP":
+        return self._set(seed=value)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "UMAPModel":
+        input_col = self._paramMap.get("inputCol")
+        ds = columnar.PartitionedDataset.from_any(
+            dataset, input_col, num_partitions
+        )
+        x = np.concatenate(list(ds.matrices()), axis=0)
+        n = x.shape[0]
+        k = self.getNNeighbors()
+        if n <= k:
+            raise ValueError(
+                f"nNeighbors={k} needs more than {k} rows, got {n}"
+            )
+        fdt = columnar.float_dtype_for(x.dtype)
+        xf = x.astype(fdt, copy=False)
+        seed = self.getOrDefault("seed")
+        dim = self.getNComponents()
+
+        with trace_range("umap knn graph"):
+            scores, idx = NN.knn_topk(
+                jnp.asarray(xf),
+                jnp.asarray(xf),
+                jnp.asarray(np.ones(n, bool)),
+                k + 1,  # self lands in the list; calibration treats d=0 as self
+            )
+            knn_d = _finalize_distances(np.asarray(scores), "euclidean")[:, 1:]
+            knn_i = np.asarray(idx)[:, 1:]
+
+        with trace_range("umap fuzzy graph"):
+            rho, sigma = UM.smooth_knn_calibration(jnp.asarray(knn_d))
+            w = np.asarray(
+                UM.membership_strengths(jnp.asarray(knn_d), rho, sigma)
+            )
+            heads, tails, weights = UM.fuzzy_union_edges(knn_i, w)
+
+        n_epochs = self.getOrDefault("nEpochs") or (500 if n < 10_000 else 200)
+        # drop edges too weak to ever fire (umap-learn's threshold)
+        keep = weights >= weights.max() / float(n_epochs)
+        heads, tails, weights = heads[keep], tails[keep], weights[keep]
+        # the reference's symmetric COO carries BOTH (i,j) and (j,i): every
+        # point appears as head, so every point receives negative-sample
+        # repulsion and each pair fires at the reference rate. The
+        # undirected list (kept for spectral init) is doubled here.
+        heads_d = np.concatenate([heads, tails])
+        tails_d = np.concatenate([tails, heads])
+        weights_d = np.concatenate([weights, weights])
+        eps_per_sample = weights_d.max() / weights_d
+
+        a, b = UM.find_ab_params(
+            self.getOrDefault("spread"), self.getOrDefault("minDist")
+        )
+        with trace_range("umap init"):
+            if self.getOrDefault("init") == "spectral":
+                emb0 = UM.spectral_init(heads, tails, weights, n, dim, seed)
+            else:
+                emb0 = np.random.default_rng(seed).uniform(
+                    -10, 10, size=(n, dim)
+                )
+
+        with trace_range("umap layout"):
+            emb = np.asarray(
+                UM.optimize_layout(
+                    jax.random.PRNGKey(seed),
+                    jnp.asarray(emb0.astype(fdt)),
+                    jnp.asarray(heads_d),
+                    jnp.asarray(tails_d),
+                    jnp.asarray(eps_per_sample.astype(fdt)),
+                    jnp.asarray(np.asarray(a, fdt)),
+                    jnp.asarray(np.asarray(b, fdt)),
+                    n_epochs=int(n_epochs),
+                    n_neg=int(self.getOrDefault("negativeSampleRate")),
+                    initial_lr=float(self.getOrDefault("learningRate")),
+                )
+            )
+        model = UMAPModel(
+            uid=self.uid, rawData=xf, embedding=emb,
+            a=float(a), b=float(b),
+        )
+        return self._copyValues(model)
+
+
+class UMAPModel(_UMAPParams, Model):
+    """Holds the training data + its embedding (cuML UMAPModel shape:
+    ``embedding_`` is the fitted layout; transform embeds new rows)."""
+
+    def __init__(
+        self,
+        uid: str | None = None,
+        rawData: np.ndarray | None = None,
+        embedding: np.ndarray | None = None,
+        a: float = 1.577,
+        b: float = 0.895,
+    ):
+        super().__init__(uid)
+        self.rawData = None if rawData is None else np.asarray(rawData)
+        self.embedding_ = None if embedding is None else np.asarray(embedding)
+        self.a = float(a)
+        self.b = float(b)
+
+    def _embed_matrix(self, mat: np.ndarray) -> np.ndarray:
+        """Out-of-sample embedding: neighbor-weighted init + short
+        reference-frozen refinement (new points move under both attraction
+        and negative-sample repulsion; reference points stay fixed)."""
+        fdt = self.rawData.dtype
+        q = mat.astype(fdt, copy=False)
+        if q.shape[1] != self.rawData.shape[1]:
+            raise ValueError(
+                f"rows have {q.shape[1]} features but the model was fitted "
+                f"on {self.rawData.shape[1]}"
+            )
+        k = min(self.getNNeighbors(), self.rawData.shape[0])
+        nq = q.shape[0]
+        scores, idx = NN.knn_topk(
+            jnp.asarray(q),
+            jnp.asarray(self.rawData),
+            jnp.asarray(np.ones(self.rawData.shape[0], bool)),
+            k,
+        )
+        knn_d = _finalize_distances(np.asarray(scores), "euclidean")
+        knn_i = np.asarray(idx)
+        rho, sigma = UM.smooth_knn_calibration(jnp.asarray(knn_d))
+        w = np.asarray(
+            UM.membership_strengths(jnp.asarray(knn_d), rho, sigma)
+        )
+        w = w / np.maximum(w.sum(axis=1, keepdims=True), 1e-12)
+        init = np.einsum("qk,qkd->qd", w, self.embedding_[knn_i])
+
+        # short refinement: new points (heads, offset by the reference
+        # count) attract to their neighbors; reference points stay frozen
+        n_ref = self.embedding_.shape[0]
+        heads = np.repeat(np.arange(nq, dtype=np.int32), k) + n_ref
+        tails = knn_i.reshape(-1).astype(np.int32)
+        weights = w.reshape(-1)
+        keep = weights > 1e-12
+        heads, tails, weights = heads[keep], tails[keep], weights[keep]
+        eps_per_sample = weights.max() / weights
+        combined = np.concatenate([self.embedding_, init]).astype(fdt)
+        out = np.asarray(
+            UM.optimize_layout(
+                jax.random.PRNGKey(self.getOrDefault("seed") + 1),
+                jnp.asarray(combined),
+                jnp.asarray(heads),
+                jnp.asarray(tails),
+                jnp.asarray(eps_per_sample.astype(fdt)),
+                jnp.asarray(np.asarray(self.a, fdt)),
+                jnp.asarray(np.asarray(self.b, fdt)),
+                n_epochs=30,
+                n_neg=self.getOrDefault("negativeSampleRate"),
+                initial_lr=float(self.getOrDefault("learningRate")) / 4.0,
+                move_tails=False,
+            )
+        )
+        return out[n_ref:]
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("umap transform"):
+            return columnar.apply_column_transform(
+                dataset,
+                self._paramMap.get("inputCol"),
+                self.getOrDefault("outputCol"),
+                self._embed_matrix,
+            )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {
+            "rawData": self.rawData,
+            "embedding": self.embedding_,
+            "ab": np.asarray([self.a, self.b]),
+        }
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(
+            uid=uid,
+            rawData=data["rawData"],
+            embedding=data["embedding"],
+            a=float(data["ab"][0]),
+            b=float(data["ab"][1]),
+        )

@@ -1,0 +1,254 @@
+"""UMAP device kernels — fuzzy k-NN graph + SGD layout as XLA programs.
+
+The spark-rapids-ml family ships UMAP on cuML's GPU implementation
+(McInnes et al., arXiv:1802.03426); the 22.12 reference this framework
+re-designs stops at PCA (SURVEY.md §2), so this is a capability-add
+completing the family surface. TPU-first formulation:
+
+- the k-NN graph comes from this package's exact brute-force kernel
+  (ops/neighbors.py) — one MXU-bound tournament, no ANN trees;
+- per-point (rho, sigma) calibration is VECTORIZED BISECTION: all rows
+  solve Σ_j exp(−max(0, d_ij − rho_i)/σ_i) = log2(k) simultaneously for a
+  fixed 64 halvings (umap-learn's SMOOTH_K_TOLERANCE loop, but with no
+  data-dependent trip count — XLA wants static control flow);
+- the layout optimizer runs the reference force model (attractive
+  −2ab·d^{2(b−1)}/(1+a·d^{2b}) along graph edges on their
+  epochs_per_sample schedule, repulsive 2b/((ε+d²)(1+a·d^{2b})) against
+  uniform negative samples, both clipped to ±4, lr annealed linearly) as
+  ONE ``lax.fori_loop`` program over epochs: every epoch processes the
+  full fixed-shape [E] edge list with masks for edges not yet due —
+  dense vector math + two segment-sum scatters instead of umap-learn's
+  per-edge Python/numba loop.
+
+Determinism: negative samples derive from ``fold_in(key, epoch)``; the
+whole embedding is a pure function of (graph, init, key).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+SMOOTH_K_TARGET_ITERS = 64
+MIN_K_DIST_SCALE = 1e-3
+_GRAD_CLIP = 4.0
+
+
+@partial(jax.jit, static_argnames=())
+def smooth_knn_calibration(
+    knn_dists: jax.Array,  # [n, k] ascending, self possibly at col 0
+) -> tuple[jax.Array, jax.Array]:
+    """(rho [n], sigma [n]) — umap-learn's smooth_knn_dist, vectorized.
+
+    rho_i = smallest POSITIVE neighbor distance; sigma_i solves
+    Σ_j exp(−max(0, d_ij − rho_i)/σ_i) = log2(k) by bisection (64 fixed
+    halvings ≈ 1e−19 interval — far past float precision).
+    """
+    n, k = knn_dists.shape
+    target = jnp.log2(jnp.asarray(float(k), knn_dists.dtype))
+    pos = jnp.where(knn_dists > 0, knn_dists, jnp.inf)
+    rho = jnp.min(pos, axis=1)
+    rho = jnp.where(jnp.isfinite(rho), rho, 0.0)
+
+    def mass(sigma):
+        d = jnp.maximum(knn_dists - rho[:, None], 0.0)
+        return jnp.sum(jnp.exp(-d / sigma[:, None]), axis=1)
+
+    lo = jnp.full((n,), 1e-12, knn_dists.dtype)
+    hi = jnp.full((n,), 1e4, knn_dists.dtype)
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        too_small = mass(mid) < target  # need larger sigma
+        return jnp.where(too_small, mid, lo), jnp.where(too_small, hi, mid)
+
+    lo, hi = lax.fori_loop(0, SMOOTH_K_TARGET_ITERS, body, (lo, hi))
+    sigma = 0.5 * (lo + hi)
+    # umap-learn floors sigma at MIN_K_DIST_SCALE × mean distance
+    mean_d = jnp.mean(knn_dists)
+    return rho, jnp.maximum(sigma, MIN_K_DIST_SCALE * mean_d)
+
+
+def membership_strengths(
+    knn_dists: jax.Array, rho: jax.Array, sigma: jax.Array
+) -> jax.Array:
+    """[n, k] directed fuzzy membership exp(−max(0, d−rho)/sigma)."""
+    d = jnp.maximum(knn_dists - rho[:, None], 0.0)
+    w = jnp.exp(-d / sigma[:, None])
+    return jnp.where(knn_dists > 0, w, 1.0)  # self/duplicate → full strength
+
+
+def fuzzy_union_edges(
+    knn_idx: np.ndarray,  # [n, k]
+    weights: np.ndarray,  # [n, k]
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Symmetrize the directed graph (w ∪ wᵀ: a+b−ab) into a padded edge
+    list (heads [E], tails [E], weights [E]) — host-side NumPy, O(nk),
+    done once at fit.
+
+    Self-edges are dropped (they exert no layout force)."""
+    n, k = knn_idx.shape
+    heads = np.repeat(np.arange(n, dtype=np.int64), k)
+    tails = knn_idx.reshape(-1).astype(np.int64)
+    vals = weights.reshape(-1).astype(np.float64)
+    keep = heads != tails
+    heads, tails, vals = heads[keep], tails[keep], vals[keep]
+    # directed weight lookup table via lexsort on (head, tail)
+    import scipy.sparse as sp
+
+    A = sp.coo_matrix((vals, (heads, tails)), shape=(n, n)).tocsr()
+    A.sum_duplicates()
+    At = A.T.tocsr()
+    U = A + At - A.multiply(At)  # fuzzy set union
+    Uc = U.tocoo()
+    keep = Uc.row < Uc.col  # undirected: keep each pair once
+    return (
+        Uc.row[keep].astype(np.int32),
+        Uc.col[keep].astype(np.int32),
+        Uc.data[keep].astype(np.float64),
+    )
+
+
+def find_ab_params(spread: float, min_dist: float) -> tuple[float, float]:
+    """Fit the (a, b) of 1/(1+a·x^{2b}) to the target membership curve —
+    umap-learn's find_ab_params, via scipy curve_fit."""
+    from scipy.optimize import curve_fit
+
+    xv = np.linspace(0, spread * 3, 300)
+    yv = np.where(
+        xv < min_dist, 1.0, np.exp(-(xv - min_dist) / spread)
+    )
+    params, _ = curve_fit(
+        lambda x, a, b: 1.0 / (1.0 + a * x ** (2 * b)), xv, yv,
+        maxfev=5000,
+    )
+    return float(params[0]), float(params[1])
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_epochs", "n_neg", "move_tails"),
+)
+def optimize_layout(
+    key: jax.Array,
+    embedding: jax.Array,  # [n, dim] init
+    heads: jax.Array,  # [E] int32
+    tails: jax.Array,  # [E] int32
+    epochs_per_sample: jax.Array,  # [E] float
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    n_epochs: int,
+    n_neg: int = 5,
+    initial_lr: float = 1.0,
+    move_tails: bool = True,
+) -> jax.Array:
+    """The UMAP SGD layout loop as one XLA program.
+
+    Per epoch every edge computes its force, masked by the
+    epochs_per_sample schedule (edge e fires when its accumulated
+    next-due counter ≤ epoch — the reference schedule, carried as [E]
+    state); tail points receive the opposite attractive force
+    (``move_tails``; False for transform(), where reference points stay
+    fixed). Updates land via segment-sum scatter-adds.
+    """
+    n, dim = embedding.shape
+    E = heads.shape[0]
+    fdt = embedding.dtype
+    eps = jnp.asarray(1e-3, fdt)
+
+    def epoch_step(epoch, carry):
+        y, next_due = carry
+        alpha = initial_lr * (1.0 - epoch / n_epochs)
+        due = next_due <= epoch  # [E]
+
+        yh = y[heads]
+        yt = y[tails]
+        diff = yh - yt
+        d2 = jnp.sum(diff * diff, axis=1)
+        # attractive: −2ab·d^{2(b−1)} / (1 + a·d^{2b})
+        grad_coeff = jnp.where(
+            d2 > 0,
+            (-2.0 * a * b * d2 ** (b - 1.0)) / (a * d2 ** b + 1.0),
+            0.0,
+        )
+        g = jnp.clip(grad_coeff[:, None] * diff, -_GRAD_CLIP, _GRAD_CLIP)
+        g = jnp.where(due[:, None], g, 0.0) * alpha
+        y = y.at[heads].add(g)
+        if move_tails:
+            y = y.at[tails].add(-g)
+
+        # negative samples: n_neg uniform points per due edge
+        kk = jax.random.fold_in(key, epoch)
+        neg = jax.random.randint(kk, (E, n_neg), 0, n)
+        yh2 = y[heads]  # re-read after attractive update
+        yneg = y[neg]  # [E, n_neg, dim]
+        diffn = yh2[:, None, :] - yneg
+        d2n = jnp.sum(diffn * diffn, axis=2)
+        rep = (2.0 * b) / ((eps + d2n) * (a * d2n ** b + 1.0))
+        gn = jnp.clip(rep[:, :, None] * diffn, -_GRAD_CLIP, _GRAD_CLIP)
+        # zero-distance negatives get the reference's unit kick
+        gn = jnp.where(d2n[:, :, None] > 0, gn, _GRAD_CLIP)
+        gn = jnp.where(
+            (due[:, None] & (neg != heads[:, None]))[:, :, None], gn, 0.0
+        )
+        y = y.at[heads].add(alpha * jnp.sum(gn, axis=1))
+
+        next_due = jnp.where(due, next_due + epochs_per_sample, next_due)
+        return y, next_due
+
+    # first fire at ≈epochs_per_sample, matching the reference's
+    # epoch_of_next_sample initialization
+    y, _ = lax.fori_loop(
+        0, n_epochs, epoch_step, (embedding, epochs_per_sample)
+    )
+    return y
+
+
+def spectral_init(
+    heads: np.ndarray,
+    tails: np.ndarray,
+    weights: np.ndarray,
+    n: int,
+    dim: int,
+    seed: int,
+) -> np.ndarray:
+    """Symmetric-normalized-Laplacian eigenvector init (umap-learn's
+    'spectral'), via scipy sparse eigsh on the host — the graph is k-sparse
+    and the decomposition is a one-off fit cost. Falls back to scaled
+    random on convergence failure."""
+    import scipy.sparse as sp
+    import scipy.sparse.linalg as spl
+
+    rng = np.random.default_rng(seed)
+    try:
+        W = sp.coo_matrix(
+            (
+                np.concatenate([weights, weights]),
+                (
+                    np.concatenate([heads, tails]),
+                    np.concatenate([tails, heads]),
+                ),
+            ),
+            shape=(n, n),
+        ).tocsr()
+        deg = np.asarray(W.sum(axis=1)).reshape(-1)
+        dinv = 1.0 / np.sqrt(np.maximum(deg, 1e-12))
+        L = sp.identity(n) - sp.diags(dinv) @ W @ sp.diags(dinv)
+        k_eig = dim + 1
+        vals, vecs = spl.eigsh(
+            L, k=k_eig, which="SM", tol=1e-4, maxiter=n * 5,
+            v0=rng.normal(size=n),
+        )
+        order = np.argsort(vals)[1 : dim + 1]  # drop the trivial 0-vector
+        emb = vecs[:, order]
+        # umap-learn scales spectral init to ~[-10, 10] and jitters
+        expansion = 10.0 / np.abs(emb).max()
+        return emb * expansion + rng.normal(scale=1e-4, size=emb.shape)
+    except Exception:
+        return rng.uniform(-10, 10, size=(n, dim))

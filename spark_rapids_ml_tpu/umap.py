@@ -1,0 +1,5 @@
+"""Drop-in UMAP namespace mirroring ``spark_rapids_ml.umap``."""
+
+from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel  # noqa: F401
+
+__all__ = ["UMAP", "UMAPModel"]

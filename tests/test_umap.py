@@ -1,0 +1,137 @@
+"""UMAP tests — structure-preservation oracles.
+
+UMAP has no unique correct output, so the oracles are the metrics the
+field uses: sklearn's trustworthiness (local neighborhoods preserved) and
+cluster separability in the embedding. Kernel-level pieces (calibration,
+fuzzy union, ab fit) get exact differential checks against their specs.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
+from spark_rapids_ml_tpu.ops import umap as UM
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(0)
+    centers = rng.normal(scale=12, size=(4, 12))
+    x = np.concatenate(
+        [c + rng.normal(scale=0.6, size=(120, 12)) for c in centers]
+    )
+    labels = np.repeat(np.arange(4), 120)
+    perm = rng.permutation(len(x))
+    return x[perm], labels[perm]
+
+
+def test_smooth_knn_calibration_solves_target():
+    rng = np.random.default_rng(1)
+    d = np.sort(np.abs(rng.normal(size=(50, 15))), axis=1)
+    rho, sigma = UM.smooth_knn_calibration(jnp.asarray(d))
+    rho, sigma = np.asarray(rho), np.asarray(sigma)
+    np.testing.assert_allclose(rho, d.min(axis=1), atol=1e-12)
+    mass = np.exp(
+        -np.maximum(d - rho[:, None], 0.0) / sigma[:, None]
+    ).sum(axis=1)
+    np.testing.assert_allclose(mass, np.log2(15), rtol=1e-6)
+
+
+def test_fuzzy_union_is_symmetric_probabilistic_or():
+    knn_i = np.array([[1, 2], [0, 2], [0, 3], [2, 0]])
+    w = np.array([[0.9, 0.5], [0.8, 0.2], [0.6, 0.7], [0.4, 0.1]])
+    heads, tails, vals = UM.fuzzy_union_edges(knn_i, w)
+    edges = {(h, t): v for h, t, v in zip(heads, tails, vals)}
+    # (0,1): directed 0.9 and 0.8 → 0.9+0.8−0.72
+    assert edges[(0, 1)] == pytest.approx(0.9 + 0.8 - 0.72)
+    # (2,3): directed 0.7 and (3,2) 0.4 → 0.7+0.4−0.28
+    assert edges[(2, 3)] == pytest.approx(0.82)
+    # (1,2): 0.2 one-way ∪ 0 → 0.2
+    assert edges[(1, 2)] == pytest.approx(0.2)
+    assert all(h < t for h, t in edges)  # undirected, no self edges
+
+
+def test_find_ab_params_matches_curve():
+    a, b = UM.find_ab_params(1.0, 0.1)
+    # umap-learn's canonical values for spread=1, min_dist=0.1
+    assert a == pytest.approx(1.577, abs=0.05)
+    assert b == pytest.approx(0.895, abs=0.05)
+
+
+def test_fit_preserves_cluster_structure(blobs):
+    from sklearn.manifold import trustworthiness
+
+    x, labels = blobs
+    model = UMAP().setNNeighbors(12).setNEpochs(200).setSeed(3).fit(x)
+    emb = model.embedding_
+    assert emb.shape == (len(x), 2)
+    tw = trustworthiness(x, emb, n_neighbors=10)
+    assert tw > 0.9, tw
+    # embedded clusters stay separable: intra-cluster mean distance well
+    # below inter-cluster mean distance
+    intra = np.mean(
+        [
+            np.linalg.norm(
+                emb[labels == c] - emb[labels == c].mean(0), axis=1
+            ).mean()
+            for c in range(4)
+        ]
+    )
+    cmeans = np.stack([emb[labels == c].mean(0) for c in range(4)])
+    inter = np.mean(
+        [
+            np.linalg.norm(cmeans[i] - cmeans[j])
+            for i in range(4)
+            for j in range(i + 1, 4)
+        ]
+    )
+    assert inter > 3 * intra, (intra, inter)
+
+
+def test_fit_deterministic_by_seed(blobs):
+    x, _ = blobs
+    m1 = UMAP().setNEpochs(50).setSeed(7).fit(x[:150])
+    m2 = UMAP().setNEpochs(50).setSeed(7).fit(x[:150])
+    np.testing.assert_allclose(m1.embedding_, m2.embedding_)
+
+
+def test_transform_places_new_points_near_their_cluster(blobs):
+    x, labels = blobs
+    model = UMAP().setNNeighbors(12).setNEpochs(150).setSeed(5).fit(x[:400])
+    emb_train = model.embedding_
+    new = x[400:420]
+    new_labels = labels[400:420]
+    out = model._embed_matrix(new)
+    # each transformed point lands nearer its own cluster's centroid than
+    # any other cluster's
+    train_labels = labels[:400]
+    cmeans = np.stack(
+        [emb_train[train_labels == c].mean(0) for c in range(4)]
+    )
+    d = np.linalg.norm(out[:, None, :] - cmeans[None, :, :], axis=2)
+    assigned = d.argmin(1)
+    assert (assigned == new_labels).mean() >= 0.9
+
+
+def test_random_init_and_persistence(tmp_path, blobs):
+    x, _ = blobs
+    model = (
+        UMAP().setInit("random").setNEpochs(50).setSeed(2).fit(x[:150])
+    )
+    path = str(tmp_path / "umap")
+    model.save(path)
+    loaded = UMAPModel.load(path)
+    np.testing.assert_allclose(loaded.embedding_, model.embedding_)
+    np.testing.assert_allclose(
+        loaded._embed_matrix(x[150:160]), model._embed_matrix(x[150:160])
+    )
+
+
+def test_validation():
+    x = np.random.default_rng(0).normal(size=(10, 4))
+    with pytest.raises(ValueError, match="nNeighbors"):
+        UMAP().setNNeighbors(15).fit(x)
+    with pytest.raises(ValueError, match="init"):
+        UMAP().setInit("pca")
