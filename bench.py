@@ -67,6 +67,10 @@ DF_N = 256         # so the end-to-end shape is no longer driver-RAM-bound
 KM_ROWS = 4_000_000
 KM_N = 128
 KM_K = 1000
+KNN_CORPUS = 262_144  # exact brute-force k-NN throughput (r5 family)
+KNN_QUERIES = 2_048
+KNN_N = 256
+KNN_K = 10
 
 # --smoke: run the WHOLE bench pipeline at tiny shapes on the CPU backend.
 # Rationale (r3 post-mortem): the bench script itself was only ever executed
@@ -82,6 +86,7 @@ if SMOKE:
     ACCURACY_ROWS = 5_000
     DF_ROWS, DF_N = 4_000, 32
     KM_ROWS, KM_N, KM_K = 20_000, 16, 20
+    KNN_CORPUS, KNN_QUERIES, KNN_N, KNN_K = 4_096, 256, 32, 5
     PAIRS = 2
 
 
@@ -125,6 +130,31 @@ def _emit_opportunistic_fallback() -> bool:
     )
     print(json.dumps(result))
     return True
+
+
+def _paired_slope(short_call, long_call, iter_delta: int, reps: int):
+    """(median per-iteration slope, raw slopes) — THE timing methodology
+    every metric here shares: time a short and a long dependent-op chain
+    back to back, difference out the dispatch/transport constant, repeat
+    ``reps`` times, take the median (r2 weak #4: min-of-N drifted 27%).
+    Raises on a non-positive median — a noisy inversion must fail the
+    metric loudly, never publish a negative throughput."""
+    slopes = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        short_call()
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        long_call()
+        t_long = time.perf_counter() - t0
+        slopes.append((t_long - t_short) / iter_delta)
+    med = statistics.median(slopes)
+    if med <= 0:
+        raise RuntimeError(
+            f"non-positive paired slope {med!r}: timing noise swamped the "
+            "chain difference"
+        )
+    return med, slopes
 
 
 def main() -> None:
@@ -216,17 +246,9 @@ def main() -> None:
     short_chain, long_chain = make_chain(2), make_chain(12)
     float(short_chain(x)), float(long_chain(x))  # compile + warm up
 
-    # paired slopes, median-of-PAIRS (r2 weak #4: 27% drift with min-of-3)
-    slopes = []
-    for _ in range(PAIRS):
-        t0 = time.perf_counter()
-        float(short_chain(x))
-        t_short = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(long_chain(x))
-        t_long = time.perf_counter() - t0
-        slopes.append((t_long - t_short) / 10)
-    per_fit = statistics.median(slopes)
+    per_fit, slopes = _paired_slope(
+        lambda: float(short_chain(x)), lambda: float(long_chain(x)), 10, PAIRS
+    )
 
     # --- config-3 proxy: transform (projection) throughput ----------------
     # same paired-slope methodology as the fit metric — single-dispatch
@@ -246,16 +268,10 @@ def main() -> None:
 
     tr_short, tr_long = make_transform_chain(2), make_transform_chain(12)
     float(tr_short(x, pc)), float(tr_long(x, pc))  # warm up
-    tr_slopes = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(tr_short(x, pc))
-        t_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(tr_long(x, pc))
-        t_l = time.perf_counter() - t0
-        tr_slopes.append((t_l - t_s) / 10)
-    transform_rows_per_s = ROWS / statistics.median(tr_slopes)
+    tr_med, _ = _paired_slope(
+        lambda: float(tr_short(x, pc)), lambda: float(tr_long(x, pc)), 10, 3
+    )
+    transform_rows_per_s = ROWS / tr_med
 
     # --- config-5 proxy: KMeans Lloyd iteration throughput ----------------
     # chained REAL Lloyd iterations (update_centers feeds the next step's
@@ -289,17 +305,22 @@ def main() -> None:
 
     km_short, km_long = make_lloyd_chain(1), make_lloyd_chain(4)
     float(km_short(xk, centers0)), float(km_long(xk, centers0))  # warm up
-    km_slopes = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(km_short(xk, centers0))
-        t_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(km_long(xk, centers0))
-        t_l = time.perf_counter() - t0
-        km_slopes.append((t_l - t_s) / 3)
-    kmeans_rows_per_s = KM_ROWS / statistics.median(km_slopes)
+    km_med, _ = _paired_slope(
+        lambda: float(km_short(xk, centers0)),
+        lambda: float(km_long(xk, centers0)),
+        3,
+        3,
+    )
+    kmeans_rows_per_s = KM_ROWS / km_med
     del xk  # free ~2 GB of HBM before the accuracy pass
+
+    # --- exact k-NN query throughput (r5 family; MXU tournament) ----------
+    # guarded: a failure here must never cost the primary metric
+    try:
+        knn_qps = _bench_knn()
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"# knn bench skipped: {e!r}", file=sys.stderr)
+        knn_qps = None
 
     # --- accuracy: bench program vs f64 host oracle, on THIS chip ---------
     min_cosine = L.min_cosine_vs_f64_oracle(
@@ -391,7 +412,24 @@ def main() -> None:
                         "unit": "cosine",
                         "accuracy_ok": accuracy_ok,
                     },
-                ],
+                ]
+                + (
+                    [
+                        {
+                            "metric": (
+                                f"knn_exact_queries_per_s_"
+                                f"{KNN_CORPUS // 1000}kcorpus_{KNN_N}f_k{KNN_K}"
+                            ),
+                            "value": round(knn_qps),
+                            "unit": "queries/s",
+                            "note": "r5 family: blocked MXU distance "
+                            "tournament (ops/neighbors.knn_topk), paired-"
+                            "slope chain timing",
+                        }
+                    ]
+                    if knn_qps is not None
+                    else []
+                ),
             }
         )
     )
@@ -404,6 +442,48 @@ def main() -> None:
         raise SystemExit(
             f"eigvec_min_cosine {min_cosine:.10f} below the 0.9999 bar"
         )
+
+
+def _bench_knn() -> float:
+    """Exact-kNN queries/s via the same paired-slope chain methodology as
+    the primary metric (the ~70 ms transport RTT would otherwise dominate
+    a single ~ms kernel call): a lax.scan of dependent knn_topk calls, the
+    N=6 vs N=2 slope taken as the per-iteration time."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from spark_rapids_ml_tpu.ops import neighbors as NNops
+
+    rng = np.random.default_rng(3)
+    corpus = jnp.asarray(
+        rng.normal(size=(KNN_CORPUS, KNN_N)).astype(np.float32)
+    )
+    queries = jnp.asarray(
+        rng.normal(size=(KNN_QUERIES, KNN_N)).astype(np.float32)
+    )
+    valid = jnp.ones((KNN_CORPUS,), bool)
+
+    def make_chain(n_iter):
+        @jax.jit
+        def f(q):
+            def step(qc, _):
+                s, i = NNops.knn_topk(qc, corpus, valid, KNN_K)
+                # data dependency so XLA cannot collapse the chain
+                qc2 = qc + 1e-12 * s[:, :1]
+                return qc2, jnp.sum(s) + jnp.sum(i)
+
+            qq, ss = lax.scan(step, q, None, length=n_iter)
+            return jnp.sum(qq) + jnp.sum(ss)
+
+        return f
+
+    short, long_ = make_chain(2), make_chain(6)
+    float(short(queries)), float(long_(queries))  # warm / compile
+    med, _ = _paired_slope(
+        lambda: float(short(queries)), lambda: float(long_(queries)), 4, 3
+    )
+    return KNN_QUERIES / med
 
 
 def _bench_df_fit() -> float:
