@@ -1,0 +1,63 @@
+"""The r5 model families in one tour: exact/approximate k-NN, DBSCAN,
+random forests, and UMAP — the remainder of the spark-rapids-ml estimator
+surface, each TPU-first (MXU tournaments, label propagation, level-order
+histogram trees, a fori_loop force layout).
+
+Run: python examples/07_model_families.py   (any JAX backend; CPU works)
+"""
+
+import numpy as np
+
+from spark_rapids_ml_tpu.clustering import DBSCAN
+from spark_rapids_ml_tpu.classification import RandomForestClassifier
+from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors, NearestNeighbors
+from spark_rapids_ml_tpu.umap import UMAP
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    centers = rng.normal(scale=10, size=(4, 16))
+    x = np.concatenate(
+        [c + rng.normal(scale=0.5, size=(250, 16)) for c in centers]
+    )
+    labels = np.repeat(np.arange(4), 250)
+
+    # exact k-NN: streaming MXU tournament, never the full distance matrix
+    nn = NearestNeighbors().setK(5).fit(x)
+    d, i = nn.kneighbors(x[:3])
+    print(f"exact kNN: ids[0]={i[0]}, d[0]={np.round(d[0], 3)}")
+
+    # IVF-Flat: KMeans coarse quantizer; nprobe trades recall for work
+    ann = ApproximateNearestNeighbors().setK(5).setNlist(20).setNprobe(4).fit(x)
+    _, ai = ann.kneighbors(x[:200])
+    _, ei = nn.kneighbors(x[:200])
+    recall = np.mean([len(set(a) & set(b)) / 5 for a, b in zip(ai, ei)])
+    print(f"ivfflat recall@5 at nprobe=4/20: {recall:.3f}")
+
+    # DBSCAN: blocked eps-neighborhoods + min-label propagation
+    db_labels = DBSCAN().setEps(3.0).setMinSamples(5).fit().clusterLabels(x)
+    print(
+        f"dbscan: {len(np.unique(db_labels[db_labels >= 0]))} clusters, "
+        f"{int((db_labels == -1).sum())} noise points"
+    )
+
+    # random forest: level-order histogram trees, per-level stats monoid
+    y = (labels % 2).astype(float)
+    rf = RandomForestClassifier().setNumTrees(15).setMaxDepth(5).fit((x, y))
+    acc = (rf._predict_matrix(x) == y).mean()
+    print(f"random forest train accuracy: {acc:.3f}")
+
+    # UMAP: fuzzy kNN graph + the SGD layout as one XLA program
+    um = UMAP().setNNeighbors(10).setNEpochs(150).fit(x)
+    emb = um.embedding_
+    intra = np.mean(
+        [
+            np.linalg.norm(emb[labels == c] - emb[labels == c].mean(0), axis=1).mean()
+            for c in range(4)
+        ]
+    )
+    print(f"umap: embedded to {emb.shape}, mean intra-cluster radius {intra:.2f}")
+
+
+if __name__ == "__main__":
+    main()
