@@ -117,6 +117,8 @@ def __getattr__(name):
         "LinearRegressionModel",
         "LogisticRegression",
         "LogisticRegressionModel",
+        "LinearSVC",
+        "LinearSVCModel",
     ):
         from spark_rapids_ml_tpu.models import linear
 

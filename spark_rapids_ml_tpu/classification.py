@@ -7,11 +7,15 @@ from spark_rapids_ml_tpu.models.forest import (  # noqa: F401
     RandomForestClassifier,
 )
 from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
+    LinearSVC,
+    LinearSVCModel,
     LogisticRegression,
     LogisticRegressionModel,
 )
 
 __all__ = [
+    "LinearSVC",
+    "LinearSVCModel",
     "LogisticRegression",
     "LogisticRegressionModel",
     "RandomForestClassifier",

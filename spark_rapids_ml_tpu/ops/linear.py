@@ -336,6 +336,45 @@ def logistic_newton_stats(
     )
 
 
+def svc_newton_stats(
+    x_aug: jax.Array,
+    y: jax.Array,
+    w_full: jax.Array,
+    weights: jax.Array | None = None,
+    *,
+    precision=DEFAULT_PRECISION,
+) -> NewtonStats:
+    """Squared-hinge (L2-SVM) Newton statistics over an augmented shard —
+    the LinearSVC loss (cuML/sklearn's default; pyspark.ml's LinearSVC
+    minimizes the non-smooth plain hinge with OWLQN, but the squared hinge
+    is smooth, so the SAME IRLS/Newton machinery as logistic applies and
+    converges in a handful of data passes).
+
+    Labels arrive 0/1 (the Spark label contract) and map to ±1. With
+    margin mᵢ = 1 − ŷᵢ·zᵢ and the active set mᵢ > 0:
+
+        loss  = Σ cᵢ·mᵢ²                       (active)
+        grad  = Σ 2cᵢ·ŷᵢ·mᵢ·xᵢ                 (ascent of −loss, active)
+        hess  = Σ 2cᵢ·xᵢxᵢᵀ                    (active)
+
+    — the same NewtonStats monoid as logistic, so every reducer
+    (tree-aggregate, mesh psum, chunked checkpoints) applies unchanged.
+    """
+    z = jnp.matmul(x_aug, w_full, precision=precision)
+    yy = 2.0 * y - 1.0
+    c = (
+        weights
+        if weights is not None
+        else jnp.ones(x_aug.shape[0], x_aug.dtype)
+    )
+    margin = jnp.maximum(1.0 - yy * z, 0.0)
+    wa = 2.0 * c * (margin > 0)
+    hess = jnp.matmul(x_aug.T * wa[None, :], x_aug, precision=precision)
+    grad = jnp.matmul(x_aug.T, 2.0 * c * yy * margin, precision=precision)
+    loss = jnp.sum(c * margin * margin)
+    return NewtonStats(hess=hess, grad=grad, loss=loss, count=jnp.sum(c))
+
+
 def _check_alpha(elastic_net_param: float) -> None:
     if not 0.0 <= elastic_net_param <= 1.0:
         raise ValueError(

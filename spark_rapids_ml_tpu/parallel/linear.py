@@ -187,6 +187,7 @@ def make_distributed_logreg_fit(
     fit_intercept: bool = True,
     max_iter: int = 25,
     tol: float = 1e-6,
+    loss: str = "logistic",
 ):
     """The ENTIRE binary IRLS training loop as ONE XLA program over the mesh.
 
@@ -213,6 +214,7 @@ def make_distributed_logreg_fit(
         fit_intercept=fit_intercept,
         chunk_iters=max_iter,
         tol=tol,
+        loss=loss,
     )
 
     def fit(x_aug, y, w_vec):
@@ -269,6 +271,7 @@ def make_distributed_logreg_chunk(
     fit_intercept: bool = True,
     chunk_iters: int = 5,
     tol: float = 1e-6,
+    loss: str = "logistic",
 ):
     """Up to ``chunk_iters`` binary-Newton iterations from a CARRIED
     parameter vector — the resumable building block of the chunked-
@@ -283,11 +286,23 @@ def make_distributed_logreg_chunk(
     chunk reuses the same compiled program. ``done`` < chunk_iters means
     converged (or budget exhausted); ``step`` carries the NaN divergence
     sentinel exactly like the whole-loop program.
+
+    ``loss`` selects the per-iteration statistics: ``"logistic"`` (IRLS)
+    or ``"squared_hinge"`` (LinearSVC) — both produce the same NewtonStats
+    monoid, so the loop/psum/solve body is literally shared.
     """
     import jax.numpy as jnp
     from jax import lax
 
     from spark_rapids_ml_tpu.parallel.mesh import shard_map
+
+    if loss not in ("logistic", "squared_hinge"):
+        raise ValueError(f"loss must be 'logistic' or 'squared_hinge', got {loss!r}")
+    stats_fn = (
+        LIN.logistic_newton_stats
+        if loss == "logistic"
+        else LIN.svc_newton_stats
+    )
 
     @partial(
         shard_map,
@@ -305,7 +320,7 @@ def make_distributed_logreg_chunk(
 
         def body(carry):
             w_full, it, _ = carry
-            stats = LIN.logistic_newton_stats(x_aug, y, w_full, w_vec)
+            stats = stats_fn(x_aug, y, w_full, w_vec)
             stats = jax.tree.map(lambda v: lax.psum(v, DATA_AXIS), stats)
             new_w, step = LIN.newton_update(
                 w_full, stats,

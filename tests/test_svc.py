@@ -1,0 +1,129 @@
+"""LinearSVC tests — sklearn LinearSVC differential + mesh equality.
+
+sklearn's LinearSVC(loss='squared_hinge', penalty='l2') minimizes
+C·Σ max(0, 1−y·m)² + ½‖w‖² — the same objective up to the λ↔C
+reparameterization (λ·m = 1/C), so coefficient-level agreement (not just
+accuracy) is checkable on non-separable data.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.classification import LinearSVC, LinearSVCModel
+from spark_rapids_ml_tpu.ops import linear as LIN
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1500, 8))
+    w_true = rng.normal(size=8)
+    margin = x @ w_true + 0.3
+    y = (margin + rng.normal(scale=2.0, size=1500) > 0).astype(float)
+    return x, y
+
+
+def test_coefficients_match_sklearn(xy):
+    svm = pytest.importorskip("sklearn.svm")
+    x, y = xy
+    reg = 0.1
+    model = LinearSVC().setRegParam(reg).setMaxIter(50).fit((x, y))
+    # λ·m·Σmax² ↔ sklearn C·Σmax² + ½‖w‖²: C = 1/(λ·m)
+    sk = svm.LinearSVC(
+        loss="squared_hinge", C=1.0 / (reg * len(x)), max_iter=20000,
+        tol=1e-10,
+    ).fit(x, y)
+    np.testing.assert_allclose(
+        model.coefficients, sk.coef_[0], rtol=0.02, atol=5e-3
+    )
+    np.testing.assert_allclose(
+        model.intercept, sk.intercept_[0], rtol=0.05, atol=5e-3
+    )
+
+
+def test_accuracy_and_threshold(xy):
+    x, y = xy
+    model = LinearSVC().setRegParam(0.01).fit((x, y))
+    acc = (model._predict_matrix(x) == y).mean()
+    # the noise level caps the Bayes rate at ~0.81; the fit reaches it
+    assert acc > 0.79, acc
+    # a huge threshold predicts all 0
+    model.setThreshold(1e6)
+    assert not model._predict_matrix(x).any()
+
+
+def test_transform_raw_prediction_columns(xy):
+    pd = pytest.importorskip("pandas")
+    x, y = xy
+    df = pd.DataFrame({"features": list(x), "label": y})
+    model = LinearSVC().setRegParam(0.01).fit(df)
+    out = model.transform(pd.DataFrame({"features": list(x[:50])}))
+    assert {"rawPrediction", "prediction"} <= set(out.columns)
+    raw = np.stack(out["rawPrediction"])
+    np.testing.assert_allclose(raw[:, 1], -raw[:, 0])
+    np.testing.assert_array_equal(
+        out["prediction"].to_numpy(), (raw[:, 1] > 0).astype(float)
+    )
+
+
+def test_weighted_fit_equals_duplication(xy):
+    x, y = xy
+    x, y = x[:200], y[:200]
+    dup = np.arange(0, 200, 4)
+    w = np.ones(200)
+    w[dup] = 2.0
+    # both fits see identical Σc (m = 250) and identical loss sums, so the
+    # SAME regParam yields the same objective — weight ≡ duplication exactly
+    m_w = LinearSVC().setRegParam(0.05).fit((x, y, w))
+    m_d = LinearSVC().setRegParam(0.05).fit(
+        (np.concatenate([x, x[dup]]), np.concatenate([y, y[dup]]))
+    )
+    np.testing.assert_allclose(
+        m_w.coefficients, m_d.coefficients, rtol=1e-6, atol=1e-9
+    )
+
+
+def test_label_validation():
+    x = np.random.default_rng(1).normal(size=(20, 3))
+    with pytest.raises(ValueError, match="binary 0/1"):
+        LinearSVC().fit((x, np.arange(20, dtype=float)))
+
+
+def test_persistence_roundtrip(tmp_path, xy):
+    x, y = xy
+    model = LinearSVC().setRegParam(0.02).fit((x[:300], y[:300]))
+    path = str(tmp_path / "svc")
+    model.save(path)
+    loaded = LinearSVCModel.load(path)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients)
+    np.testing.assert_array_equal(
+        loaded._predict_matrix(x[:50]), model._predict_matrix(x[:50])
+    )
+
+
+def test_mesh_svc_matches_driver_merge(xy):
+    """The squared-hinge whole-loop mesh program lands where the
+    driver-merge loop lands."""
+    from spark_rapids_ml_tpu.parallel.linear import make_distributed_logreg_fit
+    from spark_rapids_ml_tpu.parallel.mesh import create_mesh
+
+    x, y = xy
+    ndev = len(jax.devices())
+    rows = (len(x) // ndev) * ndev
+    x, y = x[:rows], y[:rows]
+    mesh = create_mesh(data=ndev)
+    xa = LIN.augment(jnp.asarray(x))
+    fit = make_distributed_logreg_fit(
+        mesh, reg_param=0.05, max_iter=50, tol=1e-9, loss="squared_hinge"
+    )
+    w_mesh, iters, _ = fit(
+        xa, jnp.asarray(y), jnp.asarray(np.ones(rows))
+    )
+    core = LinearSVC().setRegParam(0.05).setMaxIter(50).setTol(1e-9).fit((x, y))
+    np.testing.assert_allclose(
+        np.asarray(w_mesh)[:-1], core.coefficients, rtol=1e-8, atol=1e-10
+    )
+    assert int(iters) >= 2
