@@ -21,6 +21,8 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkDBSCANModel,
     SparkKMeans,
     SparkKMeansModel,
+    SparkLinearSVC,
+    SparkLinearSVCModel,
     SparkNearestNeighbors,
     SparkNearestNeighborsModel,
     SparkRandomForestClassificationModel,
@@ -70,6 +72,8 @@ __all__ = [
     "SparkRandomForestClassificationModel",
     "SparkRandomForestRegressor",
     "SparkRandomForestRegressionModel",
+    "SparkLinearSVC",
+    "SparkLinearSVCModel",
     "SparkKMeans",
     "SparkKMeansModel",
     "SparkLinearRegression",

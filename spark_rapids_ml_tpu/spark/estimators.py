@@ -29,6 +29,8 @@ from spark_rapids_ml_tpu.models.kmeans import KMeans, KMeansModel
 from spark_rapids_ml_tpu.models.linear import (
     LinearRegression,
     LinearRegressionModel,
+    LinearSVC,
+    LinearSVCModel,
     LogisticRegression,
     LogisticRegressionModel,
 )
@@ -2454,3 +2456,140 @@ def _mesh_forest_builder():
         return run(keys, binned, row_stats, weights, min_inst, min_gain)
 
     return build
+
+
+class SparkLinearSVC(_HasDistribution, LinearSVC):
+    """LinearSVC over pyspark DataFrames.
+
+    ``driver-merge`` collects (features, label, weight) through the
+    memory-bounded chunker and runs the core Newton loop; ``mesh-local``
+    streams rows to the driver mesh and runs the ENTIRE squared-hinge
+    Newton loop as one XLA program (the logistic whole-loop builder with
+    ``loss='squared_hinge'`` — parallel/linear.py)."""
+
+    _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-local")
+
+    def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
+        checkpoint_dir, checkpoint_every = _parse_checkpoint_kwargs(kwargs, 5)
+        if not _is_spark_df(dataset):
+            core = super().fit(
+                dataset, num_partitions,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+            )
+            return self._wrap(core)
+        feats = self.getOrDefault("featuresCol")
+        label = self.getOrDefault("labelCol")
+        weight_col = self._paramMap.get("weightCol")
+        if self.getOrDefault("distribution") == "mesh-local":
+            from spark_rapids_ml_tpu.parallel import linear as PL
+            from spark_rapids_ml_tpu.spark import ingest
+
+            fit_intercept = self.getFitIntercept()
+            cols = [feats, label] + ([weight_col] if weight_col else [])
+            n = _infer_n(dataset, feats)
+            ing = ingest.stream_to_mesh(
+                dataset.select(*cols), features_col=feats, n=n,
+                label_col=label, weight_col=weight_col, with_weights=True,
+                augment_intercept=fit_intercept,
+            )
+            if weight_col and float(ing.ws.sum()) == 0.0:
+                raise ValueError("all instance weights are zero")
+            true_labels = np.unique(
+                np.asarray(ing.ys)[np.asarray(ing.ws) > 0]
+            )
+            if not np.all(np.isin(true_labels, (0.0, 1.0))):
+                raise ValueError(
+                    f"LinearSVC requires binary 0/1 labels, got "
+                    f"{true_labels[:8]}"
+                )
+            max_iter, tol = self.getMaxIter(), self.getTol()
+            d = n + 1 if fit_intercept else n
+            from spark_rapids_ml_tpu.models.linear import (
+                _resume_newton_checkpoint,
+            )
+
+            w0, start_iter, ckpt = _resume_newton_checkpoint(
+                checkpoint_dir, d
+            )
+            chunk_fn = PL.make_distributed_logreg_chunk(
+                ing.mesh,
+                reg_param=self.getRegParam(),
+                fit_intercept=fit_intercept,
+                chunk_iters=(
+                    checkpoint_every if checkpoint_dir is not None else max_iter
+                ),
+                tol=tol,
+                loss="squared_hinge",
+            )
+            with trace_range("svc mesh-local fit"):
+                # run_chunked_newton applies the NaN-outcome check itself
+                w_dev, _ = PL.run_chunked_newton(
+                    chunk_fn, ing.xs, ing.ys, ing.ws, w0,
+                    start_iter=start_iter, max_iter=max_iter, tol=tol,
+                    ckpt=ckpt,
+                )
+            w_np = np.asarray(w_dev)
+            if fit_intercept:
+                coef, intercept = w_np[:-1], float(w_np[-1])
+            else:
+                coef, intercept = w_np, 0.0
+            core = LinearSVCModel(
+                uid=self.uid, coefficients=coef, intercept=intercept
+            )
+        else:
+            x, y, w = _collect_xyw(
+                dataset, feats, label_col=label, weight_col=weight_col
+            )
+            core = LinearSVC._copyValues(
+                self, LinearSVC(uid=self.uid)
+            ).fit(
+                (x, y) if w is None else (x, y, w),
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+            )
+        return self._wrap(core)
+
+    def _wrap(self, core):
+        model = SparkLinearSVCModel(
+            uid=core.uid,
+            coefficients=core.coefficients,
+            intercept=core.intercept,
+        )
+        return self._copyValues(model)
+
+
+class SparkLinearSVCModel(LinearSVCModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        T, _ = _sql_mods(dataset)
+        model = self
+
+        def matrix_fn(mat, _m=model):
+            m = _m.margins(mat)
+            return (
+                np.stack([-m, m], axis=1),
+                (m > _m.getThreshold()).astype(np.float64),
+            )
+
+        fn = arrow_fns.MultiOutputPartitionFn(
+            self.getOrDefault("featuresCol"),
+            [
+                (self.getOrDefault("rawPredictionCol"), np.float64),
+                (self.getOrDefault("predictionCol"), np.float64),
+            ],
+            matrix_fn,
+        )
+        with trace_range("svc transform"):
+            return _spark_append(
+                dataset,
+                fn,
+                [
+                    (
+                        self.getOrDefault("rawPredictionCol"),
+                        T.ArrayType(T.DoubleType()),
+                    ),
+                    (self.getOrDefault("predictionCol"), T.DoubleType()),
+                ],
+            )

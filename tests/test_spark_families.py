@@ -188,6 +188,53 @@ def test_spark_rf_regressor(spark, rng):
     assert r2 > 0.8, r2
 
 
+def test_spark_linear_svc_both_distributions(spark, rng):
+    from spark_rapids_ml_tpu.spark import SparkLinearSVC
+
+    x = rng.normal(size=(400, 5))
+    y = (x[:, 0] - 0.7 * x[:, 3] > 0).astype(float)
+    df = spark.createDataFrame(
+        [(r.tolist(), float(l)) for r, l in zip(x, y)],
+        LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        ),
+        numPartitions=3,
+    )
+    est = SparkLinearSVC().setRegParam(0.02).setMaxIter(50)
+    m_driver = est.copy().setDistribution("driver-merge").fit(df)
+    m_mesh = est.copy().setDistribution("mesh-local").fit(df)
+    np.testing.assert_allclose(
+        m_driver.coefficients, m_mesh.coefficients, rtol=1e-6, atol=1e-8
+    )
+    out = m_driver.transform(df)
+    assert {"rawPrediction", "prediction"} <= set(out.schema.names)
+    rows = out.collect()
+    acc = np.mean([r["prediction"] == l for r, l in zip(rows, y)])
+    assert acc > 0.9, acc
+    raw = np.stack([np.asarray(r["rawPrediction"]) for r in rows])
+    np.testing.assert_allclose(raw[:, 0], -raw[:, 1], atol=1e-6)
+
+    # checkpoint kwargs flow through; typos raise (the sibling contract)
+    with pytest.raises(TypeError, match="unexpected fit"):
+        est.copy().fit(df, checkpont_dir="/tmp/x")
+    # mesh-local rejects non-binary labels loudly
+    bad = spark.createDataFrame(
+        [(r.tolist(), float(i % 3)) for i, r in enumerate(x)],
+        LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        ),
+        numPartitions=2,
+    )
+    with pytest.raises(ValueError, match="binary 0/1"):
+        est.copy().setDistribution("mesh-local").fit(bad)
+
+
 def test_spark_wrappers_fall_through_to_core(rng):
     """Non-Spark inputs keep the core contract on every r5 wrapper."""
     x = rng.normal(size=(50, 4))
