@@ -1,0 +1,82 @@
+"""Model-selection interop for the r5 families: CrossValidator composes
+with RandomForest and LinearSVC exactly as with the GLMs — same Estimator
+contract, same evaluators. (UMAP/DBSCAN/k-NN are unsupervised; CV's
+labeled-data surface doesn't apply.)"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import LinearSVC, RandomForestClassifier
+from spark_rapids_ml_tpu.models.tuning import (
+    BinaryClassificationEvaluator,
+    CrossValidator,
+    MulticlassClassificationEvaluator,
+    ParamGridBuilder,
+)
+
+
+@pytest.fixture(scope="module")
+def labeled():
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(600, 6))
+    y = (1.5 * x[:, 0] - x[:, 2] + 0.5 * rng.normal(size=600) > 0).astype(float)
+    return x, y
+
+
+def test_cv_over_random_forest_depth(labeled):
+    x, y = labeled
+    est = RandomForestClassifier().setNumTrees(8).setSeed(1)
+    grid = (
+        ParamGridBuilder()
+        .addGrid(est.maxDepth, [1, 6])
+        .build()
+    )
+    cv = CrossValidator(
+        estimator=est,
+        estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator().setMetricName("accuracy"),
+        numFolds=3,
+        seed=0,
+    )
+    model = cv.fit((x, y))
+    # depth 6 must beat a depth-1 stump on this interaction-free but
+    # 2-feature problem
+    assert model.bestModel.getMaxDepth() == 6
+    assert len(model.avgMetrics) == 2
+    assert model.avgMetrics[1] > model.avgMetrics[0]
+    preds = model.transform(x)
+    assert (np.asarray(preds) == y).mean() > 0.85
+
+
+def test_cv_over_svc_reg_param(labeled):
+    x, y = labeled
+    est = LinearSVC().setMaxIter(30)
+    grid = ParamGridBuilder().addGrid(est.regParam, [100.0, 0.01]).build()
+    cv = CrossValidator(
+        estimator=est,
+        estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator().setMetricName("accuracy"),
+        numFolds=3,
+        seed=0,
+    )
+    model = cv.fit((x, y))
+    # a crushing L2 penalty must lose to a sane one
+    assert model.bestModel.getRegParam() == 0.01
+    assert (np.asarray(model.transform(x)) == y).mean() > 0.85
+
+
+def test_binary_evaluator_on_svc_margins(labeled):
+    """BinaryClassificationEvaluator ranks on the rawPrediction margin
+    surface the SVC model emits — AUC near 1 on this separable-ish task."""
+    pd = pytest.importorskip("pandas")
+    x, y = labeled
+    model = LinearSVC().setRegParam(0.01).fit((x, y))
+    out = model.transform(pd.DataFrame({"features": list(x)}))
+    scored = pd.DataFrame(
+        {
+            "label": y,
+            "rawPrediction": list(np.stack(out["rawPrediction"])),
+        }
+    )
+    auc = BinaryClassificationEvaluator().evaluate(scored)
+    assert auc > 0.95, auc
