@@ -246,3 +246,44 @@ def test_spark_wrappers_fall_through_to_core(rng):
     y = (x[:, 0] > 0).astype(float)
     rf = SparkRandomForestClassifier().setNumTrees(2).fit((x, y))
     assert rf._predict_matrix(x).shape == (50,)
+
+
+def test_wrapper_upgrade_loads(tmp_path, rng):
+    """A core-model save opens through its Spark wrapper class (the
+    richer-subclass upgrade rule, models/base._resolve_load_class) for
+    every r5 family — the train-local / serve-on-Spark handoff."""
+    from spark_rapids_ml_tpu.classification import (
+        LinearSVC,
+        RandomForestClassifier,
+    )
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+    from spark_rapids_ml_tpu.spark import (
+        SparkLinearSVCModel,
+        SparkNearestNeighborsModel,
+        SparkRandomForestClassificationModel,
+    )
+
+    x = rng.normal(size=(80, 4))
+    y = (x[:, 0] > 0).astype(float)
+
+    rf = RandomForestClassifier().setNumTrees(2).setMaxDepth(2).fit((x, y))
+    rf.save(str(tmp_path / "rf"))
+    rf_up = SparkRandomForestClassificationModel.load(str(tmp_path / "rf"))
+    assert isinstance(rf_up, SparkRandomForestClassificationModel)
+    np.testing.assert_array_equal(
+        rf_up._predict_matrix(x), rf._predict_matrix(x)
+    )
+
+    svc = LinearSVC().setRegParam(0.1).fit((x, y))
+    svc.save(str(tmp_path / "svc"))
+    svc_up = SparkLinearSVCModel.load(str(tmp_path / "svc"))
+    assert isinstance(svc_up, SparkLinearSVCModel)
+    np.testing.assert_allclose(svc_up.coefficients, svc.coefficients)
+
+    nn = NearestNeighbors().setK(3).fit(x)
+    nn.save(str(tmp_path / "nn"))
+    nn_up = SparkNearestNeighborsModel.load(str(tmp_path / "nn"))
+    assert isinstance(nn_up, SparkNearestNeighborsModel)
+    d0, i0 = nn.kneighbors(x[:5])
+    d1, i1 = nn_up.kneighbors(x[:5])
+    np.testing.assert_array_equal(i0, i1)
