@@ -335,12 +335,32 @@ class _ForestModel(_ForestParams, Model):
             )
         )
 
+    @property
+    def featureImportances(self) -> np.ndarray:
+        """Impurity-based importances, Spark's recipe
+        (RandomForest.featureImportances): per tree, sum each split node's
+        n-scaled impurity gain by feature and normalize to 1; average the
+        per-tree vectors; normalize again."""
+        T = self.trees.feature.shape[0]
+        out = np.zeros((T, self._num_features))
+        for t in range(T):
+            feat = self.trees.feature[t]
+            split = feat >= 0
+            np.add.at(out[t], feat[split], self.trees.gain[t][split])
+            tot = out[t].sum()
+            if tot > 0:
+                out[t] /= tot
+        avg = out.mean(0)
+        s = avg.sum()
+        return avg / s if s > 0 else avg
+
     def _saveData(self) -> dict[str, np.ndarray]:
         return {
             "feature": self.trees.feature,
             "split_bin": self.trees.split_bin,
             "is_leaf": self.trees.is_leaf,
             "leaf_stats": self.trees.leaf_stats,
+            "gain": self.trees.gain,
             "thresholds": self.thresholds,
             "numFeatures": np.asarray([self._num_features]),
         }
@@ -352,6 +372,8 @@ class _ForestModel(_ForestParams, Model):
             data["split_bin"].astype(np.int32),
             data["is_leaf"].astype(bool),
             data["leaf_stats"],
+            # pre-gain saves load with zero importances rather than failing
+            data.get("gain", np.zeros(data["feature"].shape)),
         )
         return cls(
             uid=uid,

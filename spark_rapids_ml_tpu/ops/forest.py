@@ -54,6 +54,7 @@ class TreeArrays(NamedTuple):
     split_bin: jax.Array  # [nodes] int32 — go left when bin ≤ split_bin
     is_leaf: jax.Array  # [nodes] bool
     leaf_stats: jax.Array  # [nodes, S]
+    gain: jax.Array  # [nodes] n-scaled impurity decrease at split nodes (0 at leaves) — feeds featureImportances
 
 
 def _impurity_n(stats: jax.Array, impurity: str) -> jax.Array:
@@ -117,6 +118,7 @@ def build_tree(
     split_bin = jnp.zeros((max_nodes,), jnp.int32)
     is_leaf = jnp.ones((max_nodes,), bool)
     leaf_stats = jnp.zeros((max_nodes, S), fdt)
+    gain = jnp.zeros((max_nodes,), fdt)
 
     node = jnp.zeros((rows,), jnp.int32)  # current heap node per row
     active = jnp.ones((rows,), bool)
@@ -192,6 +194,9 @@ def build_tree(
             split_bin, jnp.where(do_split, best_b, 0), (offset,)
         )
         is_leaf = lax.dynamic_update_slice(is_leaf, ~do_split, (offset,))
+        gain = lax.dynamic_update_slice(
+            gain, jnp.where(do_split, best_gain, 0.0), (offset,)
+        )
 
         # route rows: split nodes send rows to 2·node+1 (+1 if bin > b)
         row_split = active & do_split[local]
@@ -202,7 +207,7 @@ def build_tree(
         node = jnp.where(row_split, 2 * node + 1 + goes_right, node)
         active = active & row_split
 
-    return TreeArrays(feature, split_bin, is_leaf, leaf_stats)
+    return TreeArrays(feature, split_bin, is_leaf, leaf_stats, gain)
 
 
 def build_forest(

@@ -282,6 +282,39 @@ def test_persistence_roundtrip(tmp_path, clf_data, reg_data):
     np.testing.assert_allclose(lr._predict_matrix(xq), mr._predict_matrix(xq))
 
 
+def test_feature_importances_identify_signal(clf_data):
+    """Impurity importances concentrate on the informative features and
+    correlate with sklearn's (same weighted-impurity-decrease family)."""
+    sklearn = pytest.importorskip("sklearn.ensemble")
+    xtr, ytr, _, _ = clf_data
+    m = (
+        RandomForestClassifier().setNumTrees(20).setMaxDepth(6).setSeed(1)
+        .fit((xtr, ytr))
+    )
+    imp = m.featureImportances
+    assert imp.shape == (xtr.shape[1],)
+    np.testing.assert_allclose(imp.sum(), 1.0, rtol=1e-9)
+    # the generative model uses features 0, 3, 5 — they must dominate
+    top3 = set(np.argsort(imp)[-3:])
+    assert top3 == {0, 3, 5}, (top3, imp)
+    sk = sklearn.RandomForestClassifier(
+        n_estimators=20, max_depth=6, random_state=1
+    ).fit(xtr, ytr)
+    corr = np.corrcoef(imp, sk.feature_importances_)[0, 1]
+    assert corr > 0.9, (corr, imp, sk.feature_importances_)
+
+
+def test_feature_importances_survive_persistence(tmp_path, clf_data):
+    xtr, ytr, _, _ = clf_data
+    m = RandomForestClassifier().setNumTrees(4).setMaxDepth(3).fit((xtr, ytr))
+    path = str(tmp_path / "rf_imp")
+    m.save(path)
+    loaded = RandomForestClassificationModel.load(path)
+    np.testing.assert_allclose(
+        loaded.featureImportances, m.featureImportances, rtol=1e-12
+    )
+
+
 def test_subset_size_strategies():
     assert subset_size("auto", 100, classification=True) == 10
     assert subset_size("auto", 99, classification=False) == 33
