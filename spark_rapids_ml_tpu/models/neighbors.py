@@ -325,10 +325,6 @@ class ApproximateNearestNeighbors(_ANNParams, Estimator):
     def fit(
         self, dataset: Any, num_partitions: int | None = None
     ) -> "ApproximateNearestNeighborsModel":
-        from spark_rapids_ml_tpu.models.kmeans import KMeans
-        from spark_rapids_ml_tpu.ops import ivf as IVF
-        from spark_rapids_ml_tpu.ops import kmeans as KM
-
         input_col = self._paramMap.get("inputCol")
         ds = columnar.PartitionedDataset.from_any(
             dataset, input_col, num_partitions
@@ -336,6 +332,17 @@ class ApproximateNearestNeighbors(_ANNParams, Estimator):
         items, ids = _extract_items_and_ids(
             dataset, ds, self._paramMap.get("idCol"), self.getK()
         )
+        return self._fit_items(items, ids)
+
+    def _fit_items(
+        self, items: np.ndarray, ids: np.ndarray
+    ) -> "ApproximateNearestNeighborsModel":
+        """The index build from pre-extracted arrays — shared with the
+        Spark wrapper, whose collection path produces (items, ids)
+        directly."""
+        from spark_rapids_ml_tpu.models.kmeans import KMeans
+        from spark_rapids_ml_tpu.ops import ivf as IVF
+        from spark_rapids_ml_tpu.ops import kmeans as KM
 
         metric = self.getMetric()
         fdt = columnar.float_dtype_for(items.dtype)

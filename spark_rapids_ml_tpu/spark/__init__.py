@@ -21,9 +21,13 @@ from spark_rapids_ml_tpu.spark.estimators import (
     SparkDBSCANModel,
     SparkKMeans,
     SparkKMeansModel,
+    SparkApproximateNearestNeighbors,
+    SparkApproximateNearestNeighborsModel,
     SparkLinearSVC,
     SparkLinearSVCModel,
     SparkNearestNeighbors,
+    SparkUMAP,
+    SparkUMAPModel,
     SparkNearestNeighborsModel,
     SparkRandomForestClassificationModel,
     SparkRandomForestClassifier,
@@ -74,6 +78,10 @@ __all__ = [
     "SparkRandomForestRegressionModel",
     "SparkLinearSVC",
     "SparkLinearSVCModel",
+    "SparkApproximateNearestNeighbors",
+    "SparkApproximateNearestNeighborsModel",
+    "SparkUMAP",
+    "SparkUMAPModel",
     "SparkKMeans",
     "SparkKMeansModel",
     "SparkLinearRegression",

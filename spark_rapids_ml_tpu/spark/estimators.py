@@ -42,10 +42,13 @@ from spark_rapids_ml_tpu.models.forest import (
     RandomForestRegressor,
 )
 from spark_rapids_ml_tpu.models.neighbors import (
+    ApproximateNearestNeighbors,
+    ApproximateNearestNeighborsModel,
     NearestNeighbors,
     NearestNeighborsModel,
 )
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel
+from spark_rapids_ml_tpu.models.umap import UMAP, UMAPModel
 from spark_rapids_ml_tpu.models import scaler as _scaler_mod
 from spark_rapids_ml_tpu.models.selector import (
     VarianceThresholdSelector,
@@ -2155,6 +2158,55 @@ def _collect_xyw(dataset, feats, label_col=None, weight_col=None):
     )
 
 
+
+def _knn_collect_items(est, dataset):
+    """(items, int64-coerced ids) from a Spark DataFrame — the fit-side
+    collection both k-NN wrappers share (mirrors the core
+    _extract_items_and_ids semantics: k bound, positional default ids,
+    integral coercion)."""
+    feats = _resolve_col(est, "inputCol") or "features"
+    id_col = est._paramMap.get("idCol")
+    items, ids, _ = _collect_xyw(dataset, feats, label_col=id_col)
+    if items.shape[0] < est.getK():
+        raise ValueError(
+            f"k={est.getK()} exceeds the fitted item count {items.shape[0]}"
+        )
+    if ids is None:
+        ids = np.arange(items.shape[0], dtype=np.int64)
+    elif np.all(ids == np.round(ids)):
+        ids = ids.astype(np.int64)
+    return items, ids
+
+
+def _knn_spark_kneighbors(model, dataset, kk, trace_label):
+    """The query-side mapInArrow plan both k-NN wrappers share: indices
+    column type follows the fitted id dtype (the declared schema and the
+    worker's cast must agree exactly — real pyspark enforces it)."""
+    T, _ = _sql_mods(dataset)
+    int_ids = np.issubdtype(model.itemIds.dtype, np.integer)
+    id_np = np.int64 if int_ids else np.float64
+    id_sql = T.LongType() if int_ids else T.DoubleType()
+
+    def matrix_fn(mat, _m=model, _k=kk):
+        d, i = _m._kneighbors_matrix(mat, _k)
+        return i, d
+
+    fn = arrow_fns.MultiOutputPartitionFn(
+        _resolve_col(model, "inputCol") or "features",
+        [("indices", id_np), ("distances", np.float64)],
+        matrix_fn,
+    )
+    with trace_range(trace_label):
+        return _spark_append(
+            dataset,
+            fn,
+            [
+                ("indices", T.ArrayType(id_sql)),
+                ("distances", T.ArrayType(T.DoubleType())),
+            ],
+        )
+
+
 class SparkNearestNeighbors(NearestNeighbors):
     """Exact brute-force k-NN over pyspark DataFrames: ``fit`` collects the
     item set into the model (k-NN's training IS ingestion, as in
@@ -2170,18 +2222,7 @@ class SparkNearestNeighbors(NearestNeighbors):
                 uid=core.uid, items=core.items, itemIds=core.itemIds
             )
             return self._copyValues(model)
-        feats = _resolve_col(self, "inputCol") or "features"
-        id_col = self._paramMap.get("idCol")
-        items, ids, _ = _collect_xyw(dataset, feats, label_col=id_col)
-        if items.shape[0] < self.getK():
-            raise ValueError(
-                f"k={self.getK()} exceeds the fitted item count "
-                f"{items.shape[0]}"
-            )
-        if ids is None:
-            ids = np.arange(items.shape[0], dtype=np.int64)
-        elif np.all(ids == np.round(ids)):
-            ids = ids.astype(np.int64)
+        items, ids = _knn_collect_items(self, dataset)
         model = SparkNearestNeighborsModel(
             uid=self.uid, items=items, itemIds=ids
         )
@@ -2195,34 +2236,10 @@ class SparkNearestNeighborsModel(NearestNeighborsModel):
         (distances, ids) ndarray contract."""
         if not _is_spark_df(dataset):
             return super().kneighbors(dataset, k)
-        T, _ = _sql_mods(dataset)
-        kk = self.getK() if k is None else k
-        model = self
-        # the indices column type follows the fitted id dtype: positional /
-        # integral ids are LongType, non-integral idCol values DoubleType —
-        # the declared schema and the worker's cast must agree exactly
-        int_ids = np.issubdtype(self.itemIds.dtype, np.integer)
-        id_np = np.int64 if int_ids else np.float64
-        id_sql = T.LongType() if int_ids else T.DoubleType()
-
-        def matrix_fn(mat, _m=model, _k=kk):
-            d, i = _m._kneighbors_matrix(mat, _k)
-            return i, d
-
-        fn = arrow_fns.MultiOutputPartitionFn(
-            _resolve_col(self, "inputCol") or "features",
-            [("indices", id_np), ("distances", np.float64)],
-            matrix_fn,
+        return _knn_spark_kneighbors(
+            self, dataset, self.getK() if k is None else k,
+            "knn spark transform",
         )
-        with trace_range("knn spark transform"):
-            return _spark_append(
-                dataset,
-                fn,
-                [
-                    ("indices", T.ArrayType(id_sql)),
-                    ("distances", T.ArrayType(T.DoubleType())),
-                ],
-            )
 
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
@@ -2593,3 +2610,79 @@ class SparkLinearSVCModel(LinearSVCModel):
                     (self.getOrDefault("predictionCol"), T.DoubleType()),
                 ],
             )
+
+
+class SparkApproximateNearestNeighbors(ApproximateNearestNeighbors):
+    """IVF-Flat ANN over pyspark DataFrames: ``fit`` collects the item set
+    and builds the index on the driver (clustering + bucket packing need
+    the whole corpus); the query side runs as an embarrassingly parallel
+    mapInArrow pass with the index shipped inside the plan function —
+    the same split as SparkNearestNeighbors."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            return self._wrap(core)
+        items, ids = _knn_collect_items(self, dataset)
+        return self._wrap(self._fit_items(items, ids))
+
+    def _wrap(self, core):
+        model = SparkApproximateNearestNeighborsModel(
+            uid=core.uid,
+            centroids=core.centroids,
+            bucketItems=core.bucketItems,
+            bucketIds=core.bucketIds,
+            itemIds=core.itemIds,
+        )
+        return self._copyValues(model)
+
+
+class SparkApproximateNearestNeighborsModel(ApproximateNearestNeighborsModel):
+    def kneighbors(self, dataset: Any, k: int | None = None):
+        if not _is_spark_df(dataset):
+            return super().kneighbors(dataset, k)
+        return _knn_spark_kneighbors(
+            self, dataset, self.getK() if k is None else k,
+            "ann spark transform",
+        )
+
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return self.kneighbors(dataset)
+
+
+class SparkUMAP(UMAP):
+    """UMAP over pyspark DataFrames: ``fit`` collects the dataset (the
+    fuzzy graph and layout are global — the same collect-and-compute shape
+    as SparkDBSCAN, with the O(n²) k-NN graph and the SGD layout on the
+    driver's accelerator); the fitted model's out-of-sample ``transform``
+    runs as an embarrassingly parallel mapInArrow pass (each batch embeds
+    against the shipped reference set)."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            return self._wrap(core)
+        feats = _resolve_col(self, "inputCol") or "features"
+        x, _, _ = _collect_xyw(dataset, feats)
+        # a plain core fit on the collected ndarray (inputCol is ignored
+        # for matrix input), rewrapped like the non-Spark branch
+        return self._wrap(UMAP.fit(self, x))
+
+    def _wrap(self, core):
+        model = SparkUMAPModel(
+            uid=core.uid, rawData=core.rawData, embedding=core.embedding_,
+            a=core.a, b=core.b,
+        )
+        return self._copyValues(model)
+
+
+class SparkUMAPModel(UMAPModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._embed_matrix,
+            self.getOrDefault("outputCol"), scalar=False,
+        )

@@ -248,6 +248,58 @@ def test_spark_wrappers_fall_through_to_core(rng):
     assert rf._predict_matrix(x).shape == (50,)
 
 
+def test_spark_ann_matches_core(spark, rng):
+    from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
+    from spark_rapids_ml_tpu.spark import SparkApproximateNearestNeighbors
+
+    centers = rng.normal(scale=8, size=(10, 6))
+    items = np.concatenate(
+        [c + rng.normal(scale=0.5, size=(40, 6)) for c in centers]
+    )
+    queries = items[::25] + 1e-9
+    item_df = _features_df(spark, items)
+    est_kw = dict(k=4, nlist=10, nprobe=10, seed=3)
+    model = (
+        SparkApproximateNearestNeighbors(**est_kw)
+        .setInputCol("features").fit(item_df)
+    )
+    out = model.kneighbors(_features_df(spark, queries))
+    got = {
+        tuple(np.round(r["features"], 9)): np.asarray(r["indices"])
+        for r in out.collect()
+    }
+    core = ApproximateNearestNeighbors(**est_kw).fit(items)
+    _, i_ref = core.kneighbors(queries)
+    for q, idx in zip(queries, i_ref):
+        np.testing.assert_array_equal(got[tuple(np.round(q, 9))], idx)
+
+
+def test_spark_umap_fit_and_distributed_transform(spark, rng):
+    from spark_rapids_ml_tpu.spark import SparkUMAP, SparkUMAPModel
+
+    centers = rng.normal(scale=10, size=(3, 8))
+    x = np.concatenate(
+        [c + rng.normal(scale=0.4, size=(60, 8)) for c in centers]
+    )
+    labels = np.repeat(np.arange(3), 60)
+    df = _features_df(spark, x)
+    model = (
+        SparkUMAP().setInputCol("features").setNNeighbors(10)
+        .setNEpochs(80).setSeed(2).fit(df)
+    )
+    assert isinstance(model, SparkUMAPModel)
+    assert model.embedding_.shape == (len(x), 2)
+    out = model.transform(_features_df(spark, x[:30]))
+    emb = np.stack([np.asarray(r["embedding"]) for r in out.collect()])
+    assert emb.shape == (30, 2)
+    # transformed points land nearest their own cluster's embedded centroid
+    cmeans = np.stack(
+        [model.embedding_[labels == c].mean(0) for c in range(3)]
+    )
+    d = np.linalg.norm(emb[:, None, :] - cmeans[None, :, :], axis=2)
+    assert (d.argmin(1) == labels[:30]).mean() >= 0.9
+
+
 def test_wrapper_upgrade_loads(tmp_path, rng):
     """A core-model save opens through its Spark wrapper class (the
     richer-subclass upgrade rule, models/base._resolve_load_class) for
