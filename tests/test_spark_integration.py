@@ -1502,3 +1502,55 @@ class TestR5FamiliesIntegration:
         rows = model.transform(df).collect()
         acc = np.mean([r["prediction"] == l for r, l in zip(rows, y)])
         assert acc > 0.85, acc
+
+    def test_linear_svc_live(self, backend, rng_m):
+        from spark_rapids_ml_tpu.classification import LinearSVC
+        from spark_rapids_ml_tpu.spark import SparkLinearSVC
+
+        x = rng_m.normal(size=(250, 4))
+        y = (x[:, 0] - x[:, 2] > 0).astype(float)
+        T = backend.T
+        schema = T.StructType(
+            [
+                T.StructField("features", T.ArrayType(T.DoubleType())),
+                T.StructField("label", T.DoubleType()),
+            ]
+        )
+        df = backend.df(
+            [(r.tolist(), float(l)) for r, l in zip(x, y)], schema
+        )
+        model = SparkLinearSVC().setRegParam(0.02).setMaxIter(40).fit(df)
+        core = LinearSVC().setRegParam(0.02).setMaxIter(40).fit((x, y))
+        np.testing.assert_allclose(
+            model.coefficients, core.coefficients, rtol=1e-6, atol=1e-8
+        )
+        rows = model.transform(df).collect()
+        acc = np.mean([r["prediction"] == l for r, l in zip(rows, y)])
+        assert acc > 0.9, acc
+
+    def test_ann_and_umap_live(self, backend, rng_m):
+        from spark_rapids_ml_tpu.spark import (
+            SparkApproximateNearestNeighbors,
+            SparkUMAP,
+        )
+
+        centers = rng_m.normal(scale=8, size=(3, 5))
+        x = np.concatenate(
+            [c + rng_m.normal(scale=0.4, size=(50, 5)) for c in centers]
+        )
+        df = backend.df(
+            [(r.tolist(),) for r in x], backend.features_schema()
+        )
+        ann = (
+            SparkApproximateNearestNeighbors(k=3, nlist=9, nprobe=9)
+            .setInputCol("features").fit(df)
+        )
+        row0 = ann.kneighbors(df).collect()[0]
+        assert len(row0["indices"]) == 3 and row0["distances"][0] >= 0
+
+        um = (
+            SparkUMAP().setInputCol("features").setNNeighbors(8)
+            .setNEpochs(60).setSeed(1).fit(df)
+        )
+        emb_rows = um.transform(df).collect()
+        assert len(np.asarray(emb_rows[0]["embedding"])) == 2

@@ -80,3 +80,42 @@ def test_binary_evaluator_on_svc_margins(labeled):
     )
     auc = BinaryClassificationEvaluator().evaluate(scored)
     assert auc > 0.95, auc
+
+
+def test_pipeline_composes_new_stages():
+    """Pipeline chains the r5 stages like any Spark ML stage: scale →
+    UMAP-embed → KMeans-cluster on the embedding, one fit/transform unit."""
+    pd = pytest.importorskip("pandas")
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.models.pipeline import Pipeline
+    from spark_rapids_ml_tpu.models.scaler import StandardScaler
+    from spark_rapids_ml_tpu.umap import UMAP
+
+    rng = np.random.default_rng(4)
+    centers = rng.normal(scale=10, size=(3, 8))
+    x = np.concatenate(
+        [c + rng.normal(scale=0.4, size=(70, 8)) for c in centers]
+    )
+    labels = np.repeat(np.arange(3), 70)
+    df = pd.DataFrame({"features": list(x)})
+
+    pipe = Pipeline(
+        stages=[
+            StandardScaler().setInputCol("features").setOutputCol("scaled"),
+            UMAP().setInputCol("scaled").setOutputCol("emb")
+            .setNNeighbors(10).setNEpochs(80).setSeed(1),
+            KMeans().setInputCol("emb").setOutputCol("cluster").setK(3)
+            .setSeed(0),
+        ]
+    )
+    model = pipe.fit(df)
+    out = model.transform(df)
+    assert {"scaled", "emb", "cluster"} <= set(out.columns)
+    clusters = out["cluster"].to_numpy()
+    # the pipeline's clusters recover the generative blobs (up to relabel)
+    from itertools import permutations
+
+    best = max(
+        (np.mean(clusters == np.array(p)[labels]) for p in permutations(range(3)))
+    )
+    assert best > 0.95, best
