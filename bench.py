@@ -71,6 +71,11 @@ KNN_CORPUS = 262_144  # exact brute-force k-NN throughput (r5 family)
 KNN_QUERIES = 2_048
 KNN_N = 256
 KNN_K = 10
+RF_ROWS = 1_048_576  # random-forest build throughput (r5 family)
+RF_FEATURES = 32
+RF_TREES = 8
+RF_DEPTH = 6
+RF_BINS = 32
 
 # --smoke: run the WHOLE bench pipeline at tiny shapes on the CPU backend.
 # Rationale (r3 post-mortem): the bench script itself was only ever executed
@@ -87,6 +92,7 @@ if SMOKE:
     DF_ROWS, DF_N = 4_000, 32
     KM_ROWS, KM_N, KM_K = 20_000, 16, 20
     KNN_CORPUS, KNN_QUERIES, KNN_N, KNN_K = 4_096, 256, 32, 5
+    RF_ROWS, RF_FEATURES, RF_TREES, RF_DEPTH, RF_BINS = 8_192, 8, 2, 3, 8
     PAIRS = 2
 
 
@@ -322,6 +328,13 @@ def main() -> None:
         print(f"# knn bench skipped: {e!r}", file=sys.stderr)
         knn_qps = None
 
+    # --- random-forest build throughput (r5 family) -----------------------
+    try:
+        rf_rows_per_s = _bench_forest()
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"# forest bench skipped: {e!r}", file=sys.stderr)
+        rf_rows_per_s = None
+
     # --- accuracy: bench program vs f64 host oracle, on THIS chip ---------
     min_cosine = L.min_cosine_vs_f64_oracle(
         x[:ACCURACY_ROWS], jax.jit(fit_pca)(x[:ACCURACY_ROWS])[0], K
@@ -429,6 +442,23 @@ def main() -> None:
                     ]
                     if knn_qps is not None
                     else []
+                )
+                + (
+                    [
+                        {
+                            "metric": (
+                                f"forest_build_rows_per_s_"
+                                f"{RF_TREES}trees_d{RF_DEPTH}_{RF_FEATURES}f"
+                            ),
+                            "value": round(rf_rows_per_s),
+                            "unit": "rows/s",
+                            "note": "r5 family: level-order histogram "
+                            "forest build (ops/forest.build_forest), "
+                            "rows x trees / wall-clock",
+                        }
+                    ]
+                    if rf_rows_per_s is not None
+                    else []
                 ),
             }
         )
@@ -484,6 +514,52 @@ def _bench_knn() -> float:
         lambda: float(short(queries)), lambda: float(long_(queries)), 4, 3
     )
     return KNN_QUERIES / med
+
+
+def _bench_forest() -> float:
+    """Random-forest build throughput: rows×trees processed per second of
+    one full level-order build. The build is a multi-second program at
+    this shape, so plain median-of-3 timing suffices (the ~70 ms dispatch
+    constant is noise at this duration, unlike the per-ms kernels that
+    need the chain-slope methodology). Completion is forced by a host
+    float() transfer, NOT block_until_ready — the transport's fence is
+    unreliable here (see the module doc), which is why every metric in
+    this file reads a scalar back."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import forest as FOops
+
+    rng = np.random.default_rng(5)
+    binned = jnp.asarray(
+        rng.integers(0, RF_BINS, size=(RF_ROWS, RF_FEATURES)).astype(np.int32)
+    )
+    y = rng.integers(0, 2, size=RF_ROWS)
+    row_stats = jnp.asarray(np.eye(2, dtype=np.float32)[y])
+    weights = jnp.asarray(
+        rng.poisson(1.0, size=(RF_TREES, RF_ROWS)).astype(np.float32)
+    )
+    keys = jax.random.split(jax.random.PRNGKey(0), RF_TREES)
+    static = dict(
+        max_depth=RF_DEPTH, n_bins=RF_BINS,
+        k_features=max(1, int(np.sqrt(RF_FEATURES))), impurity="gini",
+    )
+
+    def run():
+        trees = FOops.build_forest(
+            keys, binned, row_stats, weights,
+            jnp.asarray(np.float32(1.0)), jnp.asarray(np.float32(0.0)),
+            **static,
+        )
+        return float(jnp.sum(trees.leaf_stats) + jnp.sum(trees.gain))
+
+    run()  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    return RF_ROWS * RF_TREES / statistics.median(times)
 
 
 def _bench_df_fit() -> float:
