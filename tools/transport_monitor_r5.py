@@ -39,8 +39,17 @@ sys.path.insert(0, REPO)
 from spark_rapids_ml_tpu.utils import devicepolicy  # noqa: E402
 
 LOG_PATH = os.path.join(REPO, "TRANSPORT_LOG_r05.jsonl")
-BENCH_OUT = os.path.join(REPO, "BENCH_OPPORTUNISTIC_r05.json")
-DRIFT_OUT = os.path.join(REPO, "BENCH_DRIFT_r05.jsonl")
+# Output names are env-overridable so a SUPPLEMENTAL harvest instance can
+# run after the primary landed (e.g. when new bench extras are added
+# mid-round and deserve their own on-chip values: point BENCH_OUT at a
+# _r05b file and the main-loop "already harvested?" check follows it).
+BENCH_OUT = os.path.join(
+    REPO,
+    os.environ.get("TPU_ML_MONITOR_BENCH_OUT", "BENCH_OPPORTUNISTIC_r05.json"),
+)
+DRIFT_OUT = os.path.join(
+    REPO, os.environ.get("TPU_ML_MONITOR_DRIFT_OUT", "BENCH_DRIFT_r05.jsonl")
+)
 
 PROBE_INTERVAL_S = float(os.environ.get("TPU_ML_MONITOR_INTERVAL_S", "600"))
 PROBE_TIMEOUT_S = float(os.environ.get("TPU_ML_MONITOR_PROBE_TIMEOUT_S", "120"))
