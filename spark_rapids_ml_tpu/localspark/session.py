@@ -170,8 +170,23 @@ class LocalSparkSession:
         self.max_records_per_batch = max_records_per_batch
         self._worker_env = devicepolicy.worker_env(worker_platform)
         self._worker_env.update(worker_env or {})
-        # rendezvous bound for barrier stages (spark.barrier.sync.timeout)
-        self.barrier_timeout = 120.0
+        # rendezvous bound for barrier stages (spark.barrier.sync.timeout).
+        # Env-tunable because the bound races the workers' FIRST JAX
+        # compile: on a saturated host (e.g. a bench run sharing the box)
+        # 120 s can flake — the test harness raises it rather than letting
+        # load turn into spurious WorkerExceptions.
+        raw_bt = os.environ.get("TPU_ML_BARRIER_TIMEOUT_S", "120")
+        try:
+            self.barrier_timeout = float(raw_bt)
+        except ValueError:
+            raise ValueError(
+                f"TPU_ML_BARRIER_TIMEOUT_S must be a number of seconds, "
+                f"got {raw_bt!r}"
+            ) from None
+        if self.barrier_timeout <= 0:
+            raise ValueError(
+                f"TPU_ML_BARRIER_TIMEOUT_S must be > 0, got {raw_bt!r}"
+            )
         self._workers: list[_Worker] = []
         self._closed = False
         atexit.register(self.stop)

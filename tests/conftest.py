@@ -13,6 +13,9 @@ This must run before the first ``import jax`` anywhere in the test session.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# barrier rendezvous races first-compile latency; on a loaded box (bench
+# or a sibling suite sharing the host) the 120 s default can flake
+os.environ.setdefault("TPU_ML_BARRIER_TIMEOUT_S", "300")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
