@@ -92,6 +92,15 @@ def __getattr__(name):
 
         return getattr(forest, name)
     if name in (
+        "GBTClassifier",
+        "GBTClassificationModel",
+        "GBTRegressor",
+        "GBTRegressionModel",
+    ):
+        from spark_rapids_ml_tpu.models import gbt
+
+        return getattr(gbt, name)
+    if name in (
         "StandardScaler",
         "StandardScalerModel",
         "Normalizer",

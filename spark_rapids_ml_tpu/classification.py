@@ -6,6 +6,10 @@ from spark_rapids_ml_tpu.models.forest import (  # noqa: F401
     RandomForestClassificationModel,
     RandomForestClassifier,
 )
+from spark_rapids_ml_tpu.models.gbt import (  # noqa: F401
+    GBTClassificationModel,
+    GBTClassifier,
+)
 from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
     LinearSVC,
     LinearSVCModel,
@@ -14,6 +18,8 @@ from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
 )
 
 __all__ = [
+    "GBTClassifier",
+    "GBTClassificationModel",
     "LinearSVC",
     "LinearSVCModel",
     "LogisticRegression",

@@ -76,13 +76,35 @@ def subset_size(strategy: str, n_features: int, *, classification: bool) -> int:
     raise ValueError(f"featureSubsetStrategy must be > 0, got {strategy!r}")
 
 
-def quantile_bin_edges(x: np.ndarray, n_bins: int, seed: int) -> np.ndarray:
-    """[F, n_bins−1] interior quantile edges from a bounded row sample."""
+def quantile_bin_edges(
+    x: np.ndarray, n_bins: int, seed: int, w: np.ndarray | None = None
+) -> np.ndarray:
+    """[F, n_bins−1] interior quantile edges from a bounded row sample.
+
+    Zero-weight rows are EXCLUDED before the quantile pass — an excluded
+    instance must not stretch the bin grid any more than it may vote in a
+    histogram (positive fractional weights still count one row each, the
+    same approximation Spark's unweighted findSplits sampling makes)."""
+    if w is not None:
+        x = x[np.asarray(w) > 0]
     if x.shape[0] > _MAX_BIN_SAMPLE:
         rng = np.random.default_rng(seed)
         x = x[rng.choice(x.shape[0], _MAX_BIN_SAMPLE, replace=False)]
     qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
     return np.quantile(x, qs, axis=0).T.astype(np.float64)
+
+
+def split_thresholds(trees: FO.TreeArrays, edges: np.ndarray) -> np.ndarray:
+    """[T, nodes] raw-value split thresholds from (feature, split_bin) —
+    bin b splits at edges[f, b] (go right when x > edge); leaves get 0.
+    Shared by the forest and GBT fits so inference needs no binning."""
+    feat = np.clip(trees.feature, 0, None)
+    thresholds = np.take_along_axis(
+        edges[feat.reshape(-1)],
+        np.clip(trees.split_bin, 0, edges.shape[1] - 1).reshape(-1, 1),
+        axis=1,
+    ).reshape(trees.feature.shape)
+    return np.where(trees.feature >= 0, thresholds, 0.0)
 
 
 def bin_features(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
@@ -216,7 +238,7 @@ class _ForestEstimator(_ForestParams, Estimator):
         max_depth = self.getMaxDepth()
         fdt = columnar.float_dtype_for(x.dtype)
 
-        edges = quantile_bin_edges(x, n_bins, seed)
+        edges = quantile_bin_edges(x, n_bins, seed, w)
         binned = bin_features(x, edges)
         row_stats = self._row_stats(y, fdt)
 
@@ -257,16 +279,7 @@ class _ForestEstimator(_ForestParams, Estimator):
             )
         self._n_features_in = x.shape[1]
         trees = FO.TreeArrays(*(np.asarray(a) for a in trees))
-        # split-bin → raw-value thresholds so inference needs no binning;
-        # bin b splits at edges[f, b] (go right when x > edge)
-        feat = np.clip(trees.feature, 0, None)
-        thresholds = np.take_along_axis(
-            edges[feat.reshape(-1)],
-            np.clip(trees.split_bin, 0, edges.shape[1] - 1).reshape(-1, 1),
-            axis=1,
-        ).reshape(trees.feature.shape)
-        thresholds = np.where(trees.feature >= 0, thresholds, 0.0)
-        return trees, thresholds
+        return trees, split_thresholds(trees, edges)
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         parts = columnar.labeled_partitions(

@@ -1,0 +1,401 @@
+"""GBTRegressor / GBTClassifier — gradient-boosted trees on the forest kernels.
+
+pyspark.ml ships GBTs (the spark-rapids-ml ecosystem points GBT users at
+xgboost); this module completes the pyspark.ml tree surface natively,
+REUSING the random-forest machinery end to end: the estimator inherits
+`_ForestEstimator`'s param surface, setters, and labeled fit body; every
+boosting stage is the same level-order histogram ``build_tree`` (variance
+impurity — stages are regression trees on pseudo-residuals) with the same
+heap-layout arrays, raw-threshold conversion, and persistence shape.
+
+Spark MLlib semantics mirrored (GradientBoostedTrees.boost):
+
+- the FIRST tree enters with weight 1.0 and no prior; every later stage
+  contributes ``stepSize``·(leaf mean of pseudo-residuals) — the model
+  exposes the resulting ``treeWeights`` like Spark's;
+- regressor: squared loss, residuals y − F;
+- classifier: Friedman's deviance with labels y∈{−1,1} and margin 2F —
+  pseudo-residuals r = 2y/(1+exp(2yF)); rawPrediction = [−2F, 2F],
+  probability = σ(2F), prediction = 1[F > 0] (the MLlib decision rule);
+- ``featureSubsetStrategy`` 'auto' resolves to 'all' (Spark's GBT rule —
+  each stage is a single tree; RF's sqrt/onethird heuristics don't apply);
+- ``subsamplingRate`` draws a fresh Bernoulli row sample per STAGE
+  (stochastic gradient boosting);
+- boosting is inherently sequential, so the distributed story is
+  per-stage: each tree build is the same histogram pass the forest uses
+  (psum-able via the builder hook, parallel/forest.py); the driver loop
+  carries only F [rows] between stages.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Model
+from spark_rapids_ml_tpu.models.forest import (
+    _ForestEstimator,
+    _ForestParams,
+    bin_features,
+    quantile_bin_edges,
+    split_thresholds,
+    subset_size,
+)
+from spark_rapids_ml_tpu.models.params import Param
+from spark_rapids_ml_tpu.ops import forest as FO
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+
+class _GBTParams(_ForestParams):
+    stepSize = Param("stepSize", "learning rate per boosting stage", float)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        # Spark GBT defaults: maxIter stages of depth 5, lr 0.1, ALL
+        # features per node (numTrees is RF vocabulary — GBT's stage count
+        # param maxIter maps onto the shared numTrees storage)
+        self._setDefault(
+            stepSize=0.1, numTrees=20, featureSubsetStrategy="all",
+            impurity="variance",
+        )
+
+    def setStepSize(self, value: float):
+        if not 0.0 < value <= 1.0:
+            raise ValueError(f"stepSize must be in (0, 1], got {value}")
+        return self._set(stepSize=float(value))
+
+    def getStepSize(self) -> float:
+        return self.getOrDefault("stepSize")
+
+    def setMaxIter(self, value: int):
+        if value < 1:
+            raise ValueError(f"maxIter must be >= 1, got {value}")
+        return self._set(numTrees=value)
+
+    def getMaxIter(self) -> int:
+        return self.getOrDefault("numTrees")
+
+
+class _GBTClassifierCols:
+    """probability/rawPrediction columns — shared by GBTClassifier and its
+    model (the forest's _ClassifierCols bundles an impurity default GBT
+    must not inherit, hence the GBT-local twin)."""
+
+    probabilityCol = Param("probabilityCol", "class-probability column", str)
+    rawPredictionCol = Param(
+        "rawPredictionCol", "margin column [−2F, 2F] (Spark GBT shape)", str
+    )
+
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            probabilityCol="probability", rawPredictionCol="rawPrediction"
+        )
+
+    def setProbabilityCol(self, value: str):
+        return self._set(probabilityCol=value)
+
+    def setRawPredictionCol(self, value: str):
+        return self._set(rawPredictionCol=value)
+
+
+class _GBTEstimator(_GBTParams, _ForestEstimator):
+    """Shares _ForestEstimator's setters and labeled ``fit`` body; the
+    model build is the boosting loop instead of the vmapped forest."""
+
+    impurity = Param("impurity", "'variance' (every stage is regression)", str)
+    _impurity_choices = ("variance",)
+
+    def _make_model(self, x, y, w):  # _ForestEstimator.fit's hook
+        return self._boost(x, y, w)
+
+    def _boost(self, x: np.ndarray, y: np.ndarray, w: np.ndarray | None):
+        if self.getImpurity() != "variance":
+            raise ValueError(
+                "GBT stages are regression trees; impurity must be "
+                f"'variance', got {self.getImpurity()!r}"
+            )
+        n_bins = self.getMaxBins()
+        seed = self.getSeed()
+        n_stages = self.getMaxIter()
+        max_depth = self.getMaxDepth()
+        lr = self.getStepSize()
+        fdt = columnar.float_dtype_for(x.dtype)
+        rng = np.random.default_rng(seed)
+
+        edges = quantile_bin_edges(x, n_bins, seed, w)
+        binned = jnp.asarray(bin_features(x, edges))
+        rows = x.shape[0]
+        base_w = np.ones(rows, fdt) if w is None else w.astype(fdt)
+        yj = jnp.asarray(self._targets(y).astype(fdt))
+        rate = self.getOrDefault("subsamplingRate")
+        strategy = self.getOrDefault("featureSubsetStrategy")
+        if str(strategy).lower() == "auto":
+            strategy = "all"  # Spark's GBT rule (single tree per stage)
+        k_feat = subset_size(strategy, x.shape[1], classification=False)
+        static = dict(
+            max_depth=max_depth, n_bins=n_bins, k_features=k_feat,
+            impurity="variance",
+        )
+        min_inst = jnp.asarray(
+            np.asarray(self.getOrDefault("minInstancesPerNode"), fdt)
+        )
+        min_gain = jnp.asarray(
+            np.asarray(self.getOrDefault("minInfoGain"), fdt)
+        )
+
+        # MLlib boost schedule: first tree weight 1.0, later stages lr
+        tree_weights = np.asarray(
+            [1.0] + [lr] * (n_stages - 1), dtype=np.float64
+        )
+        F = jnp.zeros((rows,), fdt)
+        trees, losses = [], []
+        with trace_range("gbt boost"):
+            for m in range(n_stages):
+                r = self._pseudo_residuals(yj, F)
+                stats = jnp.stack([jnp.ones_like(r), r, r * r], axis=1)
+                stage_w = jnp.asarray(
+                    base_w
+                    * (
+                        (rng.random(rows) < rate).astype(fdt)
+                        if rate < 1.0
+                        else 1.0
+                    )
+                )
+                tree = FO.build_tree(
+                    jax.random.fold_in(jax.random.PRNGKey(seed), m),
+                    binned, stats, stage_w, min_inst, min_gain, **static,
+                )
+                leaf = FO.tree_apply_binned(tree, binned, max_depth=max_depth)
+                # leaf mean over the SAMPLED rows that built the tree;
+                # applied to every row routed there (Friedman)
+                pred = leaf[:, 1] / jnp.where(leaf[:, 0] > 0, leaf[:, 0], 1.0)
+                F = F + float(tree_weights[m]) * pred
+                losses.append(float(self._loss(yj, F, jnp.asarray(base_w))))
+                trees.append(FO.TreeArrays(*(np.asarray(a) for a in tree)))
+
+        stacked = FO.TreeArrays(
+            *(
+                np.stack([getattr(t, f) for t in trees])
+                for f in FO.TreeArrays._fields
+            )
+        )
+        model = self._model_cls(
+            uid=self.uid,
+            trees=stacked,
+            thresholds=split_thresholds(stacked, edges),
+            treeWeights=tree_weights,
+            numFeatures=x.shape[1],
+            trainLosses=np.asarray(losses),
+        )
+        return self._copyValues(model)
+
+
+class _GBTModel(_GBTParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        trees: FO.TreeArrays | None = None,
+        thresholds: np.ndarray | None = None,
+        treeWeights: np.ndarray | None = None,
+        numFeatures: int = -1,
+        trainLosses: np.ndarray | None = None,
+    ):
+        super().__init__(uid)
+        self.trees = trees
+        self.thresholds = (
+            None if thresholds is None else np.asarray(thresholds)
+        )
+        #: per-stage weights ([1.0, lr, lr, ...] — Spark's treeWeights)
+        self.treeWeights = (
+            None if treeWeights is None else np.asarray(treeWeights)
+        )
+        self._num_features = int(numFeatures)
+        #: per-stage training loss — Spark GBT's summary hook
+        self.trainLosses = (
+            None if trainLosses is None else np.asarray(trainLosses)
+        )
+
+    @property
+    def numFeatures(self) -> int:
+        return self._num_features
+
+    def getNumTrees(self) -> int:
+        return self.trees.feature.shape[0]
+
+    def _margins(self, mat: np.ndarray) -> np.ndarray:
+        """[rows] additive prediction F(x) = Σ treeWeights·(leaf mean)."""
+        max_depth = int(np.log2(self.trees.feature.shape[1] + 1) - 1)
+        leaf = np.asarray(
+            FO.forest_apply(
+                FO.TreeArrays(*(jnp.asarray(a) for a in self.trees)),
+                jnp.asarray(mat),
+                jnp.asarray(self.thresholds),
+                max_depth=max_depth,
+            )
+        )  # [T, rows, 3]
+        pred = leaf[..., 1] / np.where(leaf[..., 0] > 0, leaf[..., 0], 1.0)
+        return self.treeWeights @ pred
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {
+            "feature": self.trees.feature,
+            "split_bin": self.trees.split_bin,
+            "is_leaf": self.trees.is_leaf,
+            "leaf_stats": self.trees.leaf_stats,
+            "gain": self.trees.gain,
+            "thresholds": self.thresholds,
+            "treeWeights": self.treeWeights,
+            "numFeatures": np.asarray([self._num_features]),
+            "trainLosses": (
+                self.trainLosses
+                if self.trainLosses is not None
+                else np.zeros(0)
+            ),
+        }
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        trees = FO.TreeArrays(
+            data["feature"].astype(np.int32),
+            data["split_bin"].astype(np.int32),
+            data["is_leaf"].astype(bool),
+            data["leaf_stats"],
+            data["gain"],
+        )
+        return cls(
+            uid=uid, trees=trees, thresholds=data["thresholds"],
+            treeWeights=data["treeWeights"],
+            numFeatures=int(data["numFeatures"][0]),
+            trainLosses=data["trainLosses"],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Regressor
+# ---------------------------------------------------------------------------
+
+
+class GBTRegressor(_GBTEstimator):
+    _classification = False
+
+    def _targets(self, y: np.ndarray) -> np.ndarray:
+        return np.asarray(y, dtype=np.float64)
+
+    def _row_stats(self, y, fdt):  # pragma: no cover - forest hook unused
+        raise NotImplementedError("GBT builds per-stage residual stats")
+
+    @staticmethod
+    def _pseudo_residuals(y, F):
+        return y - F  # squared loss
+
+    @staticmethod
+    def _loss(y, F, w):
+        return jnp.sum(w * (y - F) ** 2) / jnp.sum(w)
+
+    @property
+    def _model_cls(self):
+        return GBTRegressionModel
+
+
+class GBTRegressionModel(_GBTModel):
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        return self._margins(mat)
+
+    def transform(self, dataset: Any) -> Any:
+        return columnar.apply_column_transform(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("predictionCol"),
+            self._predict_matrix,
+        )
+
+    def predict(self, row) -> float:
+        return float(
+            self._predict_matrix(np.asarray(row, dtype=np.float64)[None, :])[0]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Classifier
+# ---------------------------------------------------------------------------
+
+
+class GBTClassifier(_GBTClassifierCols, _GBTEstimator):
+    _classification = True
+
+    def _targets(self, y: np.ndarray) -> np.ndarray:
+        classes = np.unique(y)
+        if not np.all(np.isin(classes, (0.0, 1.0))):
+            raise ValueError(
+                f"GBTClassifier requires binary 0/1 labels, got {classes[:8]}"
+            )
+        return 2.0 * np.asarray(y, dtype=np.float64) - 1.0  # ±1
+
+    def _row_stats(self, y, fdt):  # pragma: no cover - forest hook unused
+        raise NotImplementedError("GBT builds per-stage residual stats")
+
+    @staticmethod
+    def _pseudo_residuals(y, F):
+        # −∂/∂F log(1+exp(−2yF)) = 2y / (1+exp(2yF))
+        return 2.0 * y / (1.0 + jnp.exp(2.0 * y * F))
+
+    @staticmethod
+    def _loss(y, F, w):
+        # logistic (deviance) loss, logaddexp for stability
+        return jnp.sum(w * jnp.logaddexp(0.0, -2.0 * y * F)) / jnp.sum(w)
+
+    @property
+    def _model_cls(self):
+        return GBTClassificationModel
+
+
+class GBTClassificationModel(_GBTClassifierCols, _GBTModel):
+    @property
+    def numClasses(self) -> int:
+        return 2
+
+    def proba_and_predictions(self, mat: np.ndarray):
+        F = self._margins(mat)
+        p1 = 1.0 / (1.0 + np.exp(-2.0 * F))
+        proba = np.stack([1.0 - p1, p1], axis=1)
+        return proba, (F > 0).astype(np.float64)
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        return self.proba_and_predictions(mat)[1]
+
+    def transform(self, dataset: Any) -> Any:
+        if columnar.has_named_columns(dataset):
+            mat = columnar.extract_matrix(
+                dataset, self.getOrDefault("featuresCol")
+            )
+            F = self._margins(mat)
+            raw = np.stack([-2.0 * F, 2.0 * F], axis=1)
+            p1 = 1.0 / (1.0 + np.exp(-2.0 * F))
+            proba = np.stack([1.0 - p1, p1], axis=1)
+            return columnar.append_columns(
+                dataset,
+                [
+                    (self.getOrDefault("rawPredictionCol"), raw),
+                    (self.getOrDefault("probabilityCol"), proba),
+                    (
+                        self.getOrDefault("predictionCol"),
+                        (F > 0).astype(np.float64),
+                    ),
+                ],
+            )
+        return columnar.apply_column_transform(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("predictionCol"),
+            self._predict_matrix,
+        )
+
+    def predict(self, row) -> float:
+        return float(
+            self._predict_matrix(np.asarray(row, dtype=np.float64)[None, :])[0]
+        )

@@ -228,6 +228,27 @@ def build_forest(
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
+def tree_apply_binned(
+    tree: TreeArrays,  # ONE tree (unstacked)
+    binned: jax.Array,  # [rows, F] int32 bin ids
+    *,
+    max_depth: int,
+) -> jax.Array:
+    """[rows, S] leaf stats by descending on BIN ids (go left when
+    bin ≤ split_bin) — the training-time router gradient boosting uses to
+    update its running prediction without converting back to raw
+    thresholds."""
+    node = jnp.zeros((binned.shape[0],), jnp.int32)
+    for _ in range(max_depth):
+        leaf = tree.is_leaf[node]
+        f = jnp.maximum(tree.feature[node], 0)
+        b = jnp.take_along_axis(binned, f[:, None], axis=1)[:, 0]
+        goes_right = (b > tree.split_bin[node]).astype(jnp.int32)
+        node = jnp.where(leaf, node, 2 * node + 1 + goes_right)
+    return tree.leaf_stats[node]
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
 def forest_apply(
     trees: TreeArrays,  # [T, ...] stack
     x: jax.Array,  # [rows, F] RAW feature values

@@ -6,12 +6,18 @@ from spark_rapids_ml_tpu.models.forest import (  # noqa: F401
     RandomForestRegressionModel,
     RandomForestRegressor,
 )
+from spark_rapids_ml_tpu.models.gbt import (  # noqa: F401
+    GBTRegressionModel,
+    GBTRegressor,
+)
 from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
     LinearRegression,
     LinearRegressionModel,
 )
 
 __all__ = [
+    "GBTRegressor",
+    "GBTRegressionModel",
     "LinearRegression",
     "LinearRegressionModel",
     "RandomForestRegressor",

@@ -1,0 +1,170 @@
+"""GBT tests — sklearn GradientBoosting differentials + boosting invariants."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import GBTClassificationModel, GBTClassifier
+from spark_rapids_ml_tpu.regression import GBTRegressionModel, GBTRegressor
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2400, 6))
+    y = np.sin(x[:, 0]) * 3 + x[:, 2] ** 2 + 0.5 * x[:, 4] + rng.normal(
+        scale=0.2, size=2400
+    )
+    return x[:1800], y[:1800], x[1800:], y[1800:]
+
+
+@pytest.fixture(scope="module")
+def clf_data():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2400, 6))
+    logit = 1.5 * x[:, 0] - x[:, 3] + x[:, 0] * x[:, 5]
+    y = (logit + rng.normal(scale=0.7, size=2400) > 0).astype(float)
+    return x[:1800], y[:1800], x[1800:], y[1800:]
+
+
+def test_regressor_quality_vs_sklearn(reg_data):
+    sk_ens = pytest.importorskip("sklearn.ensemble")
+    xtr, ytr, xte, yte = reg_data
+    m = (
+        GBTRegressor().setMaxIter(60).setMaxDepth(4).setStepSize(0.2)
+        .setMaxBins(64).setSeed(2).fit((xtr, ytr))
+    )
+    pred = m._predict_matrix(xte)
+    ours = 1 - ((pred - yte) ** 2).mean() / yte.var()
+    sk = sk_ens.GradientBoostingRegressor(
+        n_estimators=60, max_depth=4, learning_rate=0.2, random_state=2
+    ).fit(xtr, ytr)
+    theirs = sk.score(xte, yte)
+    assert ours >= theirs - 0.04, (ours, theirs)
+
+
+def test_classifier_quality_vs_sklearn(clf_data):
+    sk_ens = pytest.importorskip("sklearn.ensemble")
+    xtr, ytr, xte, yte = clf_data
+    m = (
+        GBTClassifier().setMaxIter(60).setMaxDepth(3).setStepSize(0.2)
+        .setMaxBins(64).setSeed(2).fit((xtr, ytr))
+    )
+    ours = (m._predict_matrix(xte) == yte).mean()
+    sk = sk_ens.GradientBoostingClassifier(
+        n_estimators=60, max_depth=3, learning_rate=0.2, random_state=2
+    ).fit(xtr, ytr)
+    theirs = sk.score(xte, yte)
+    assert ours >= theirs - 0.04, (ours, theirs)
+
+
+def test_training_loss_decreases(reg_data, clf_data):
+    """Boosting's defining invariant: each stage reduces training loss."""
+    xtr, ytr, _, _ = reg_data
+    m = GBTRegressor().setMaxIter(25).setStepSize(0.3).fit((xtr, ytr))
+    losses = m.trainLosses
+    assert len(losses) == 25
+    assert losses[-1] < losses[0] * 0.5
+    assert np.all(np.diff(losses) <= 1e-9)  # squared loss: monotone
+
+    xc, yc, _, _ = clf_data
+    mc = GBTClassifier().setMaxIter(25).setStepSize(0.3).fit((xc, yc))
+    assert mc.trainLosses[-1] < mc.trainLosses[0]
+
+
+def test_classifier_output_columns_and_margin_consistency(clf_data):
+    pd = pytest.importorskip("pandas")
+    xtr, ytr, xte, _ = clf_data
+    m = GBTClassifier().setMaxIter(15).fit(
+        pd.DataFrame({"features": list(xtr), "label": ytr})
+    )
+    out = m.transform(pd.DataFrame({"features": list(xte[:40])}))
+    assert {"rawPrediction", "probability", "prediction"} <= set(out.columns)
+    raw = np.stack(out["rawPrediction"])
+    p = np.stack(out["probability"])
+    np.testing.assert_allclose(raw[:, 1], -raw[:, 0])
+    # probability is the sigmoid of the margin: σ(2F) with raw = [−2F, 2F]
+    np.testing.assert_allclose(p[:, 1], 1 / (1 + np.exp(-raw[:, 1])), rtol=1e-9)
+    np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-12)
+    np.testing.assert_array_equal(
+        out["prediction"].to_numpy(), (raw[:, 1] > 0).astype(float)
+    )
+
+
+def test_determinism_and_subsampling(clf_data):
+    xtr, ytr, _, _ = clf_data
+    kw = dict(numTrees=10, seed=5, subsamplingRate=0.7)
+    m1 = GBTClassifier(**kw).fit((xtr, ytr))
+    m2 = GBTClassifier(**kw).fit((xtr, ytr))
+    np.testing.assert_array_equal(
+        np.asarray(m1.trees.feature), np.asarray(m2.trees.feature)
+    )
+    m3 = GBTClassifier(numTrees=10, seed=6, subsamplingRate=0.7).fit((xtr, ytr))
+    assert not np.array_equal(
+        np.asarray(m1.trees.feature), np.asarray(m3.trees.feature)
+    )
+
+
+def test_weighted_fit(reg_data):
+    """Zero-weight rows must not influence the fit at all."""
+    xtr, ytr, _, _ = reg_data
+    x2 = np.concatenate([xtr, xtr[:200] + 100.0])  # junk rows far away
+    y2 = np.concatenate([ytr, np.full(200, 1e6)])
+    w2 = np.concatenate([np.ones(len(xtr)), np.zeros(200)])
+    m_w = GBTRegressor().setMaxIter(10).setSeed(0).fit((x2, y2, w2))
+    m_ref = GBTRegressor().setMaxIter(10).setSeed(0).fit((xtr, ytr))
+    # zero-weight rows are excluded from the quantile grid AND carry zero
+    # histogram mass, so the fits are numerically identical
+    np.testing.assert_array_equal(
+        np.asarray(m_w.trees.feature), np.asarray(m_ref.trees.feature)
+    )
+    np.testing.assert_allclose(
+        m_w._predict_matrix(xtr[:100]),
+        m_ref._predict_matrix(xtr[:100]),
+        rtol=1e-10,
+    )
+
+
+def test_persistence_roundtrip(tmp_path, reg_data, clf_data):
+    xtr, ytr, xte, _ = reg_data
+    m = GBTRegressor().setMaxIter(8).fit((xtr, ytr))
+    path = str(tmp_path / "gbtr")
+    m.save(path)
+    loaded = GBTRegressionModel.load(path)
+    np.testing.assert_allclose(
+        loaded._predict_matrix(xte), m._predict_matrix(xte)
+    )
+    np.testing.assert_allclose(loaded.trainLosses, m.trainLosses)
+
+    xc, yc, xq, _ = clf_data
+    mc = GBTClassifier().setMaxIter(8).fit((xc, yc))
+    cpath = str(tmp_path / "gbtc")
+    mc.save(cpath)
+    lc = GBTClassificationModel.load(cpath)
+    p0, _ = mc.proba_and_predictions(xq[:50])
+    p1, _ = lc.proba_and_predictions(xq[:50])
+    np.testing.assert_allclose(p0, p1)
+
+
+def test_label_validation():
+    x = np.random.default_rng(2).normal(size=(30, 3))
+    with pytest.raises(ValueError, match="binary 0/1"):
+        GBTClassifier().fit((x, np.arange(30, dtype=float)))
+    with pytest.raises(ValueError, match="variance"):
+        GBTRegressor().setImpurity("gini")
+
+
+def test_spark_api_surface(clf_data):
+    """Spark-parity knobs: configurable output columns, treeWeights with
+    the MLlib boost schedule (first tree 1.0, later stages stepSize),
+    'auto' strategy resolving to all features."""
+    pd = pytest.importorskip("pandas")
+    xtr, ytr, _, _ = clf_data
+    m = (
+        GBTClassifier().setMaxIter(5).setStepSize(0.25)
+        .setProbabilityCol("p").setRawPredictionCol("rawr")
+        .setFeatureSubsetStrategy("auto")
+        .fit((xtr, ytr))
+    )
+    np.testing.assert_allclose(m.treeWeights, [1.0, 0.25, 0.25, 0.25, 0.25])
+    out = m.transform(pd.DataFrame({"features": list(xtr[:10])}))
+    assert {"p", "rawr", "prediction"} <= set(out.columns)
