@@ -41,6 +41,12 @@ from spark_rapids_ml_tpu.models.forest import (
     RandomForestRegressionModel,
     RandomForestRegressor,
 )
+from spark_rapids_ml_tpu.models.gbt import (
+    GBTClassificationModel,
+    GBTClassifier,
+    GBTRegressionModel,
+    GBTRegressor,
+)
 from spark_rapids_ml_tpu.models.neighbors import (
     ApproximateNearestNeighbors,
     ApproximateNearestNeighborsModel,
@@ -2685,4 +2691,111 @@ class SparkUMAPModel(UMAPModel):
         return _spark_transform(
             self, dataset, self._embed_matrix,
             self.getOrDefault("outputCol"), scalar=False,
+        )
+
+
+class SparkGBTClassifier(GBTClassifier):
+    """GBTClassifier over pyspark DataFrames: boosting is sequential, so
+    fit collects (features, label, weight) through the memory-bounded
+    chunker and boosts on the driver's accelerator; transform runs as an
+    embarrassingly parallel mapInArrow pass."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            return self._wrap(super().fit(dataset, num_partitions))
+        x, y, w = _collect_xyw(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            label_col=self.getOrDefault("labelCol"),
+            weight_col=self._paramMap.get("weightCol"),
+        )
+        return self._wrap(self._boost(x, y, w))
+
+    def _wrap(self, core):
+        model = SparkGBTClassificationModel(
+            uid=core.uid, trees=core.trees, thresholds=core.thresholds,
+            treeWeights=core.treeWeights, numFeatures=core.numFeatures,
+            trainLosses=core.trainLosses,
+        )
+        return self._copyValues(model)
+
+
+class SparkGBTClassificationModel(GBTClassificationModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        T, _ = _sql_mods(dataset)
+        model = self
+
+        def matrix_fn(mat, _m=model):
+            # one margin pass, raw derived directly ([−2F, 2F]) — matching
+            # the core transform; a sigmoid round-trip would saturate to
+            # ±inf at |F| ≳ 18 where the margin itself stays finite
+            F = _m._margins(mat)
+            p1 = 1.0 / (1.0 + np.exp(-2.0 * F))
+            proba = np.stack([1.0 - p1, p1], axis=1)
+            return (
+                np.stack([-2.0 * F, 2.0 * F], axis=1),
+                proba,
+                (F > 0).astype(np.float64),
+            )
+
+        fn = arrow_fns.MultiOutputPartitionFn(
+            self.getOrDefault("featuresCol"),
+            [
+                (self.getOrDefault("rawPredictionCol"), np.float64),
+                (self.getOrDefault("probabilityCol"), np.float64),
+                (self.getOrDefault("predictionCol"), np.float64),
+            ],
+            matrix_fn,
+        )
+        with trace_range("gbt transform"):
+            return _spark_append(
+                dataset,
+                fn,
+                [
+                    (
+                        self.getOrDefault("rawPredictionCol"),
+                        T.ArrayType(T.DoubleType()),
+                    ),
+                    (
+                        self.getOrDefault("probabilityCol"),
+                        T.ArrayType(T.DoubleType()),
+                    ),
+                    (self.getOrDefault("predictionCol"), T.DoubleType()),
+                ],
+            )
+
+
+class SparkGBTRegressor(GBTRegressor):
+    """GBTRegressor over pyspark DataFrames — collection as
+    SparkGBTClassifier."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            return self._wrap(super().fit(dataset, num_partitions))
+        x, y, w = _collect_xyw(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            label_col=self.getOrDefault("labelCol"),
+            weight_col=self._paramMap.get("weightCol"),
+        )
+        return self._wrap(self._boost(x, y, w))
+
+    def _wrap(self, core):
+        model = SparkGBTRegressionModel(
+            uid=core.uid, trees=core.trees, thresholds=core.thresholds,
+            treeWeights=core.treeWeights, numFeatures=core.numFeatures,
+            trainLosses=core.trainLosses,
+        )
+        return self._copyValues(model)
+
+
+class SparkGBTRegressionModel(GBTRegressionModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._predict_matrix,
+            self.getOrDefault("predictionCol"), scalar=True,
         )

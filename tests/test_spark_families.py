@@ -300,6 +300,58 @@ def test_spark_umap_fit_and_distributed_transform(spark, rng):
     assert (d.argmin(1) == labels[:30]).mean() >= 0.9
 
 
+def test_spark_gbt_matches_core(spark, rng):
+    from spark_rapids_ml_tpu.classification import GBTClassifier
+    from spark_rapids_ml_tpu.spark import SparkGBTClassifier, SparkGBTRegressor
+
+    x = rng.normal(size=(400, 5))
+    y = (1.3 * x[:, 0] - x[:, 2] > 0).astype(float)
+    df = spark.createDataFrame(
+        [(r.tolist(), float(l)) for r, l in zip(x, y)],
+        LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        ),
+        numPartitions=3,
+    )
+    m = SparkGBTClassifier().setMaxIter(10).setSeed(2).fit(df)
+    core = GBTClassifier().setMaxIter(10).setSeed(2).fit((x, y))
+    np.testing.assert_array_equal(
+        np.asarray(m.trees.feature), np.asarray(core.trees.feature)
+    )
+    out = m.transform(df)
+    rows = out.collect()
+    assert {"rawPrediction", "probability", "prediction"} <= set(
+        out.schema.names
+    )
+    acc = np.mean([r["prediction"] == l for r, l in zip(rows, y)])
+    assert acc > 0.9, acc
+    p = np.stack([np.asarray(r["probability"]) for r in rows])
+    raw = np.stack([np.asarray(r["rawPrediction"]) for r in rows])
+    # raw recovers the margin: p1 = sigma(raw[:, 1])
+    np.testing.assert_allclose(
+        p[:, 1], 1 / (1 + np.exp(-raw[:, 1])), rtol=1e-4
+    )
+
+    yr = 2.0 * x[:, 1] + np.sin(x[:, 3])
+    rdf = spark.createDataFrame(
+        [(r.tolist(), float(v)) for r, v in zip(x, yr)],
+        LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        ),
+        numPartitions=2,
+    )
+    mr = SparkGBTRegressor().setMaxIter(15).setMaxBins(64).fit(rdf)
+    preds = np.array([r["prediction"] for r in mr.transform(rdf).collect()])
+    r2 = 1 - ((preds - yr) ** 2).mean() / yr.var()
+    assert r2 > 0.85, r2
+
+
 def test_wrapper_upgrade_loads(tmp_path, rng):
     """A core-model save opens through its Spark wrapper class (the
     richer-subclass upgrade rule, models/base._resolve_load_class) for
