@@ -16,6 +16,10 @@ from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.ovr import (  # noqa: F401
+    OneVsRest,
+    OneVsRestModel,
+)
 
 __all__ = [
     "GBTClassifier",
@@ -24,6 +28,8 @@ __all__ = [
     "LinearSVCModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "OneVsRest",
+    "OneVsRestModel",
     "RandomForestClassifier",
     "RandomForestClassificationModel",
 ]

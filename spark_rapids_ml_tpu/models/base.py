@@ -131,6 +131,17 @@ class Saveable(Params):
         module, _, qualname = meta["class"].rpartition(".")
         klass = getattr(importlib.import_module(module), qualname)
         klass = _resolve_load_class(cls, klass, path)
+        # composite models (PipelineModel, OneVsRestModel, ...) persist
+        # sub-models in subdirectories their own ``load`` knows how to
+        # read; the generic array path would return them EMPTY. Delegate
+        # whenever the resolved class overrides load — unless that class
+        # is the entry point itself (it already runs its own body).
+        if (
+            klass is not cls
+            and getattr(klass.load, "__func__", None)
+            is not Saveable.load.__func__
+        ):
+            return klass.load(path)
         data = {}
         if persistence._FS(path).exists("data.parquet"):
             data = persistence.load_arrays(path)

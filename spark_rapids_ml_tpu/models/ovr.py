@@ -1,0 +1,180 @@
+"""OneVsRest — pyspark.ml's multiclass meta-estimator, natively.
+
+Wraps any binary classifier whose model emits a margin/score (LinearSVC,
+GBTClassifier, binary LogisticRegression): fit trains C one-vs-rest
+copies (label == c → 1.0), predict takes the class whose model scores its
+positive side highest — pyspark.ml.classification.OneVsRest semantics.
+
+The per-class fits are independent, so the meta-layer adds no new
+distributed machinery: each sub-fit uses whatever distribution its
+estimator implements.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model, Saveable
+from spark_rapids_ml_tpu.models.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+)
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+
+def _positive_score(model, mat: np.ndarray) -> np.ndarray:
+    """[rows] 'how positive' score from a fitted binary model — the
+    decision surface OneVsRest ranks classes on. Preference order matches
+    what each model family exposes: probability of class 1, else the raw
+    margin."""
+    if hasattr(model, "proba_and_predictions"):
+        proba, _ = model.proba_and_predictions(mat)
+        proba = np.asarray(proba)
+        return proba[:, 1] if proba.ndim == 2 else proba
+    if hasattr(model, "predict_proba_matrix"):
+        p = np.asarray(model.predict_proba_matrix(mat))
+        return p[:, 1] if p.ndim == 2 else p
+    if hasattr(model, "margins"):
+        return np.asarray(model.margins(mat))
+    raise TypeError(
+        f"{type(model).__name__} exposes no probability or margin surface "
+        "for OneVsRest scoring"
+    )
+
+
+class OneVsRest(HasFeaturesCol, HasLabelCol, HasPredictionCol, Estimator):
+    def __init__(self, uid: str | None = None, classifier=None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self.classifier = classifier
+        self._setDefault(
+            featuresCol="features", labelCol="label",
+            predictionCol="prediction",
+        )
+
+    def setClassifier(self, value) -> "OneVsRest":
+        self.classifier = value
+        return self
+
+    def getClassifier(self):
+        return self.classifier
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if self.classifier is None:
+            raise ValueError("setClassifier(...) before fit")
+        parts = columnar.labeled_partitions(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("labelCol"),
+            None,  # sub-fits re-partition themselves below
+            weight_col=None,
+        )
+        x = np.concatenate([p[0] for p in parts])
+        y = np.concatenate([p[1] for p in parts])
+        classes = np.unique(y)
+        if not np.all(classes == np.round(classes)) or classes.min() < 0:
+            raise ValueError(
+                f"OneVsRest requires integer class labels 0..C-1, got "
+                f"{classes[:8]}"
+            )
+        n_classes = int(classes.max()) + 1
+        if n_classes < 2:
+            raise ValueError("OneVsRest needs at least 2 classes")
+        models = []
+        with trace_range("one-vs-rest fit"):
+            for c in range(n_classes):
+                est = self.classifier.copy()
+                models.append(
+                    est.fit(
+                        (x, (y == c).astype(np.float64)), num_partitions
+                    )
+                )
+        model = OneVsRestModel(uid=self.uid, models=models)
+        return self._copyValues(model)
+
+    # persistence: the classifier template lives in a subdirectory (the
+    # pyspark OneVsRest writer's shape); base save handles params/layout
+    def save(
+        self, path: str, overwrite: bool = False, layout: str = "native"
+    ) -> None:
+        if self.classifier is None:
+            raise ValueError(
+                "OneVsRest has no classifier set; nothing meaningful to save"
+            )
+        super().save(path, overwrite=overwrite, layout=layout)
+        from spark_rapids_ml_tpu.utils import persistence
+
+        self.classifier.save(persistence._FS(path).join("classifier"))
+
+    @classmethod
+    def load(cls, path: str) -> "OneVsRest":
+        from spark_rapids_ml_tpu.utils import persistence
+
+        meta = persistence.load_metadata(path)
+        classifier = Saveable.load(persistence._FS(path).join("classifier"))
+        instance = cls(uid=meta["uid"], classifier=classifier)
+        instance._restoreParamState(meta)
+        return instance
+
+
+class OneVsRestModel(
+    HasFeaturesCol, HasLabelCol, HasPredictionCol, Model
+):
+    def __init__(self, uid: str | None = None, models: list | None = None):
+        super().__init__(uid)
+        self.models = list(models or [])
+        self._setDefault(
+            featuresCol="features", labelCol="label",
+            predictionCol="prediction",
+        )
+
+    @property
+    def numClasses(self) -> int:
+        return len(self.models)
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        scores = np.stack(
+            [_positive_score(m, mat) for m in self.models], axis=1
+        )
+        return np.argmax(scores, axis=1).astype(np.float64)
+
+    def transform(self, dataset: Any) -> Any:
+        with trace_range("one-vs-rest transform"):
+            return columnar.apply_column_transform(
+                dataset,
+                self.getOrDefault("featuresCol"),
+                self.getOrDefault("predictionCol"),
+                self._predict_matrix,
+            )
+
+    # persistence: one subdirectory per class model; the base save handles
+    # params/overwrite/layout validation, ``_saveData`` records the count,
+    # and the custom ``load`` (reachable from generic Saveable.load via
+    # the composite-model delegation in models/base.py) reads the subdirs
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"numClasses": np.asarray([len(self.models)])}
+
+    def save(
+        self, path: str, overwrite: bool = False, layout: str = "native"
+    ) -> None:
+        super().save(path, overwrite=overwrite, layout=layout)
+        from spark_rapids_ml_tpu.utils import persistence
+
+        fs = persistence._FS(path)
+        for c, m in enumerate(self.models):
+            m.save(fs.join(f"class-{c}"))
+
+    @classmethod
+    def load(cls, path: str) -> "OneVsRestModel":
+        from spark_rapids_ml_tpu.utils import persistence
+
+        meta = persistence.load_metadata(path)
+        n = int(persistence.load_arrays(path)["numClasses"][0])
+        fs = persistence._FS(path)
+        models = [Saveable.load(fs.join(f"class-{c}")) for c in range(n)]
+        instance = cls(uid=meta["uid"], models=models)
+        instance._restoreParamState(meta)
+        return instance
