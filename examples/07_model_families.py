@@ -1,7 +1,8 @@
 """The r5 model families in one tour: exact/approximate k-NN, DBSCAN,
-random forests, and UMAP — the remainder of the spark-rapids-ml estimator
-surface, each TPU-first (MXU tournaments, label propagation, level-order
-histogram trees, a fori_loop force layout).
+random forests, gradient boosting, OneVsRest over LinearSVC, and UMAP —
+the remainder of the spark-rapids-ml estimator surface and beyond, each
+TPU-first (MXU tournaments, label propagation, level-order histogram
+trees, a fori_loop force layout).
 
 Run: python examples/07_model_families.py   (any JAX backend; CPU works)
 """
@@ -9,7 +10,12 @@ Run: python examples/07_model_families.py   (any JAX backend; CPU works)
 import numpy as np
 
 from spark_rapids_ml_tpu.clustering import DBSCAN
-from spark_rapids_ml_tpu.classification import RandomForestClassifier
+from spark_rapids_ml_tpu.classification import (
+    GBTClassifier,
+    LinearSVC,
+    OneVsRest,
+    RandomForestClassifier,
+)
 from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors, NearestNeighbors
 from spark_rapids_ml_tpu.umap import UMAP
 
@@ -46,6 +52,18 @@ def main() -> None:
     rf = RandomForestClassifier().setNumTrees(15).setMaxDepth(5).fit((x, y))
     acc = (rf._predict_matrix(x) == y).mean()
     print(f"random forest train accuracy: {acc:.3f}")
+
+    # gradient boosting: sequential histogram trees on pseudo-residuals
+    gbt = GBTClassifier().setMaxIter(15).setStepSize(0.2).fit((x, y))
+    print(f"gbt train accuracy: {(gbt._predict_matrix(x) == y).mean():.3f}, "
+          f"loss {gbt.trainLosses[0]:.3f} -> {gbt.trainLosses[-1]:.3f}")
+
+    # OneVsRest: 4-class via per-class squared-hinge SVMs
+    ovr = OneVsRest(classifier=LinearSVC().setRegParam(0.01)).fit(
+        (x, labels.astype(float))
+    )
+    print(f"one-vs-rest 4-class accuracy: "
+          f"{(ovr._predict_matrix(x) == labels).mean():.3f}")
 
     # UMAP: fuzzy kNN graph + the SGD layout as one XLA program
     um = UMAP().setNNeighbors(10).setNEpochs(150).fit(x)

@@ -74,6 +74,16 @@ class OneVsRest(HasFeaturesCol, HasLabelCol, HasPredictionCol, Estimator):
         )
         x = np.concatenate([p[0] for p in parts])
         y = np.concatenate([p[1] for p in parts])
+        return self._fit_xy(x, y, num_partitions)
+
+    def _fit_xy(
+        self, x: np.ndarray, y: np.ndarray, num_partitions: int | None = None
+    ):
+        """The per-class training loop from pre-extracted arrays — shared
+        with the Spark wrapper, whose collection path already produced
+        (x, y) (re-running fit's ingestion would copy the matrix twice)."""
+        if self.classifier is None:
+            raise ValueError("setClassifier(...) before fit")
         classes = np.unique(y)
         if not np.all(classes == np.round(classes)) or classes.min() < 0:
             raise ValueError(

@@ -47,6 +47,7 @@ from spark_rapids_ml_tpu.models.gbt import (
     GBTRegressionModel,
     GBTRegressor,
 )
+from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel
 from spark_rapids_ml_tpu.models.neighbors import (
     ApproximateNearestNeighbors,
     ApproximateNearestNeighborsModel,
@@ -2792,6 +2793,38 @@ class SparkGBTRegressor(GBTRegressor):
 
 
 class SparkGBTRegressionModel(GBTRegressionModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._predict_matrix,
+            self.getOrDefault("predictionCol"), scalar=True,
+        )
+
+
+class SparkOneVsRest(OneVsRest):
+    """OneVsRest over pyspark DataFrames: fit collects (features, label)
+    through the memory-bounded chunker and trains the per-class fleet on
+    the driver (each sub-fit is whatever the wrapped classifier's core fit
+    is); transform runs as an embarrassingly parallel mapInArrow pass."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            core = super().fit(dataset, num_partitions)
+            return self._wrap(core)
+        x, y, _ = _collect_xyw(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            label_col=self.getOrDefault("labelCol"),
+        )
+        return self._wrap(self._fit_xy(x, y, num_partitions))
+
+    def _wrap(self, core):
+        model = SparkOneVsRestModel(uid=core.uid, models=core.models)
+        return self._copyValues(model)
+
+
+class SparkOneVsRestModel(OneVsRestModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)

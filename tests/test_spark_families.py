@@ -352,6 +352,34 @@ def test_spark_gbt_matches_core(spark, rng):
     assert r2 > 0.85, r2
 
 
+def test_spark_one_vs_rest(spark, rng):
+    from spark_rapids_ml_tpu.classification import LinearSVC
+    from spark_rapids_ml_tpu.spark import SparkOneVsRest
+
+    centers = rng.normal(scale=8, size=(3, 4))
+    x = np.concatenate([c + rng.normal(size=(80, 4)) for c in centers])
+    y = np.repeat(np.arange(3.0), 80)
+    df = spark.createDataFrame(
+        [(r.tolist(), float(l)) for r, l in zip(x, y)],
+        LT.StructType(
+            [
+                LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+                LT.StructField("label", LT.DoubleType()),
+            ]
+        ),
+        numPartitions=3,
+    )
+    m = (
+        SparkOneVsRest()
+        .setClassifier(LinearSVC().setRegParam(0.01))
+        .fit(df)
+    )
+    assert m.numClasses == 3
+    rows = m.transform(df).collect()
+    acc = np.mean([r["prediction"] == l for r, l in zip(rows, y)])
+    assert acc > 0.95, acc
+
+
 def test_wrapper_upgrade_loads(tmp_path, rng):
     """A core-model save opens through its Spark wrapper class (the
     richer-subclass upgrade rule, models/base._resolve_load_class) for
@@ -391,3 +419,17 @@ def test_wrapper_upgrade_loads(tmp_path, rng):
     d0, i0 = nn.kneighbors(x[:5])
     d1, i1 = nn_up.kneighbors(x[:5])
     np.testing.assert_array_equal(i0, i1)
+
+    # the composite family: a core OneVsRest save upgrades through the
+    # wrapper class's inherited custom load (subdirectory sub-models)
+    from spark_rapids_ml_tpu.classification import OneVsRest
+    from spark_rapids_ml_tpu.spark import SparkOneVsRestModel
+
+    ovr = OneVsRest(classifier=LinearSVC().setRegParam(0.05)).fit((x, y))
+    ovr.save(str(tmp_path / "ovr"))
+    ovr_up = SparkOneVsRestModel.load(str(tmp_path / "ovr"))
+    assert isinstance(ovr_up, SparkOneVsRestModel)
+    assert ovr_up.numClasses == ovr.numClasses
+    np.testing.assert_array_equal(
+        ovr_up._predict_matrix(x[:20]), ovr._predict_matrix(x[:20])
+    )
