@@ -94,6 +94,27 @@ def quantile_bin_edges(
     return np.quantile(x, qs, axis=0).T.astype(np.float64)
 
 
+def tree_feature_importances(
+    trees: FO.TreeArrays, n_features: int
+) -> np.ndarray:
+    """Spark's TreeEnsembleModel.featureImportances: per tree, sum each
+    split node's n-scaled impurity gain by feature and normalize to 1;
+    average the per-tree vectors; normalize again. Shared by the forest
+    and GBT models (both carry gains in the same heap arrays)."""
+    T = trees.feature.shape[0]
+    out = np.zeros((T, n_features))
+    for t in range(T):
+        feat = trees.feature[t]
+        split = feat >= 0
+        np.add.at(out[t], feat[split], trees.gain[t][split])
+        tot = out[t].sum()
+        if tot > 0:
+            out[t] /= tot
+    avg = out.mean(0)
+    s = avg.sum()
+    return avg / s if s > 0 else avg
+
+
 def split_thresholds(trees: FO.TreeArrays, edges: np.ndarray) -> np.ndarray:
     """[T, nodes] raw-value split thresholds from (feature, split_bin) —
     bin b splits at edges[f, b] (go right when x > edge); leaves get 0.
@@ -351,21 +372,8 @@ class _ForestModel(_ForestParams, Model):
     @property
     def featureImportances(self) -> np.ndarray:
         """Impurity-based importances, Spark's recipe
-        (RandomForest.featureImportances): per tree, sum each split node's
-        n-scaled impurity gain by feature and normalize to 1; average the
-        per-tree vectors; normalize again."""
-        T = self.trees.feature.shape[0]
-        out = np.zeros((T, self._num_features))
-        for t in range(T):
-            feat = self.trees.feature[t]
-            split = feat >= 0
-            np.add.at(out[t], feat[split], self.trees.gain[t][split])
-            tot = out[t].sum()
-            if tot > 0:
-                out[t] /= tot
-        avg = out.mean(0)
-        s = avg.sum()
-        return avg / s if s > 0 else avg
+        (RandomForest.featureImportances)."""
+        return tree_feature_importances(self.trees, self._num_features)
 
     def _saveData(self) -> dict[str, np.ndarray]:
         return {

@@ -43,6 +43,7 @@ from spark_rapids_ml_tpu.models.forest import (
     quantile_bin_edges,
     split_thresholds,
     subset_size,
+    tree_feature_importances,
 )
 from spark_rapids_ml_tpu.models.params import Param
 from spark_rapids_ml_tpu.ops import forest as FO
@@ -223,6 +224,12 @@ class _GBTModel(_GBTParams, Model):
     @property
     def numFeatures(self) -> int:
         return self._num_features
+
+    @property
+    def featureImportances(self) -> np.ndarray:
+        """Impurity-based importances (Spark's GBT exposes the same
+        TreeEnsembleModel recipe as the forest)."""
+        return tree_feature_importances(self.trees, self._num_features)
 
     def getNumTrees(self) -> int:
         return self.trees.feature.shape[0]

@@ -2809,6 +2809,8 @@ class SparkOneVsRest(OneVsRest):
     is); transform runs as an embarrassingly parallel mapInArrow pass."""
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
+        if self.classifier is None:  # before any cluster work
+            raise ValueError("setClassifier(...) before fit")
         if not _is_spark_df(dataset):
             core = super().fit(dataset, num_partitions)
             return self._wrap(core)

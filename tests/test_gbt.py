@@ -168,3 +168,12 @@ def test_spark_api_surface(clf_data):
     np.testing.assert_allclose(m.treeWeights, [1.0, 0.25, 0.25, 0.25, 0.25])
     out = m.transform(pd.DataFrame({"features": list(xtr[:10])}))
     assert {"p", "rawr", "prediction"} <= set(out.columns)
+
+
+def test_feature_importances(clf_data):
+    xtr, ytr, _, _ = clf_data
+    m = GBTClassifier().setMaxIter(20).setMaxDepth(3).fit((xtr, ytr))
+    imp = m.featureImportances
+    np.testing.assert_allclose(imp.sum(), 1.0, rtol=1e-9)
+    # the generative logit uses features 0, 3, 5
+    assert set(np.argsort(imp)[-3:]) == {0, 3, 5}, imp
