@@ -3,6 +3,8 @@ naming the way the reference's 10-line public class mirrors Spark's package
 path (PCA.scala:27-37, SURVEY.md §1 L6)."""
 
 from spark_rapids_ml_tpu.models.forest import (  # noqa: F401
+    DecisionTreeClassificationModel,
+    DecisionTreeClassifier,
     RandomForestClassificationModel,
     RandomForestClassifier,
 )
@@ -22,6 +24,8 @@ from spark_rapids_ml_tpu.models.ovr import (  # noqa: F401
 )
 
 __all__ = [
+    "DecisionTreeClassifier",
+    "DecisionTreeClassificationModel",
     "GBTClassifier",
     "GBTClassificationModel",
     "LinearSVC",

@@ -242,6 +242,16 @@ class _ForestEstimator(_ForestParams, Estimator):
     def getImpurity(self) -> str:
         return self.getOrDefault("impurity")
 
+    def _make_model(self, x, y, w, builder=None):
+        """THE fit-then-wrap handoff — one copy for every tree estimator;
+        subclasses choose the model class via ``_model_cls``."""
+        trees, thresholds = self._fit_arrays(x, y, w, builder=builder)
+        model = self._model_cls(
+            uid=self.uid, trees=trees, thresholds=thresholds,
+            numFeatures=self._n_features_in,
+        )
+        return self._copyValues(model)
+
     def _fit_arrays(
         self,
         x: np.ndarray,
@@ -443,13 +453,9 @@ class RandomForestClassifier(_ClassifierCols, _ForestEstimator):
             )
         return np.eye(int(classes.max()) + 1, dtype=fdt)[classes]
 
-    def _make_model(self, x, y, w, builder=None):
-        trees, thresholds = self._fit_arrays(x, y, w, builder=builder)
-        model = RandomForestClassificationModel(
-            uid=self.uid, trees=trees, thresholds=thresholds,
-            numFeatures=self._n_features_in,
-        )
-        return self._copyValues(model)
+    @property
+    def _model_cls(self):
+        return RandomForestClassificationModel
 
 
 class RandomForestClassificationModel(_ClassifierCols, _ForestModel):
@@ -512,13 +518,9 @@ class RandomForestRegressor(_ForestEstimator):
         y = y.astype(fdt)
         return np.stack([np.ones_like(y), y, y * y], axis=1)
 
-    def _make_model(self, x, y, w, builder=None):
-        trees, thresholds = self._fit_arrays(x, y, w, builder=builder)
-        model = RandomForestRegressionModel(
-            uid=self.uid, trees=trees, thresholds=thresholds,
-            numFeatures=self._n_features_in,
-        )
-        return self._copyValues(model)
+    @property
+    def _model_cls(self):
+        return RandomForestRegressionModel
 
 
 class RandomForestRegressionModel(_ForestModel):
@@ -537,3 +539,74 @@ class RandomForestRegressionModel(_ForestModel):
             self.getOrDefault("predictionCol"),
             self._predict_matrix,
         )
+
+
+# ---------------------------------------------------------------------------
+# Single decision trees (pyspark.ml parity: a forest of one)
+# ---------------------------------------------------------------------------
+
+
+class _SingleTreeDefaults:
+    """pyspark.ml's DecisionTree* estimators are exactly the forest
+    machinery at numTrees=1, no bootstrap, all features per node — the
+    deterministic CART the forest randomizes. Depth of the model's single
+    tree and its importances come from the shared ensemble arrays."""
+
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            numTrees=1, bootstrap=False, featureSubsetStrategy="all"
+        )
+
+    def setNumTrees(self, value):  # a decision tree IS one tree
+        raise AttributeError(
+            "DecisionTree estimators fit exactly one tree; use the "
+            "RandomForest estimators for ensembles"
+        )
+
+
+class DecisionTreeClassifier(_SingleTreeDefaults, RandomForestClassifier):
+    @property
+    def _model_cls(self):
+        return DecisionTreeClassificationModel
+
+
+def _require_single_tree(data):
+    """DecisionTree*Model.load must reject multi-tree (forest) saves — the
+    richer-subclass upgrade rule assumes added behavior, not structure."""
+    n_trees = data["feature"].shape[0]
+    if n_trees != 1:
+        raise TypeError(
+            f"save holds {n_trees} trees; a DecisionTree model is exactly "
+            "one — load it through the RandomForest model class"
+        )
+
+
+class DecisionTreeClassificationModel(RandomForestClassificationModel):
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        _require_single_tree(data)
+        return super()._fromSaved(uid, data)
+
+    @property
+    def depth(self) -> int:
+        """Depth of the fitted tree (deepest materialized split + 1)."""
+        split_nodes = np.flatnonzero(self.trees.feature[0] >= 0)
+        if len(split_nodes) == 0:
+            return 0
+        return int(np.floor(np.log2(split_nodes.max() + 1)) + 1)
+
+
+class DecisionTreeRegressor(_SingleTreeDefaults, RandomForestRegressor):
+    @property
+    def _model_cls(self):
+        return DecisionTreeRegressionModel
+
+
+class DecisionTreeRegressionModel(RandomForestRegressionModel):
+    depth = DecisionTreeClassificationModel.depth
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        _require_single_tree(data)
+        return super()._fromSaved(uid, data)

@@ -3,6 +3,8 @@ the way the reference's 10-line public class mirrors Spark's package path
 (PCA.scala:27-37, SURVEY.md §1 L6)."""
 
 from spark_rapids_ml_tpu.models.forest import (  # noqa: F401
+    DecisionTreeRegressionModel,
+    DecisionTreeRegressor,
     RandomForestRegressionModel,
     RandomForestRegressor,
 )
@@ -16,6 +18,8 @@ from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
 )
 
 __all__ = [
+    "DecisionTreeRegressor",
+    "DecisionTreeRegressionModel",
     "GBTRegressor",
     "GBTRegressionModel",
     "LinearRegression",

@@ -378,3 +378,64 @@ def test_sharded_forest_matches_local():
     )
     for a, b in zip(local, sharded):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_decision_tree_matches_sklearn_quality(clf_data):
+    """A single deterministic CART: close to sklearn's DecisionTree and
+    exactly reproducible (no bootstrap, all features)."""
+    sk_tree = pytest.importorskip("sklearn.tree")
+    from spark_rapids_ml_tpu.classification import DecisionTreeClassifier
+    from spark_rapids_ml_tpu.regression import DecisionTreeRegressor
+
+    xtr, ytr, xte, yte = clf_data
+    m = DecisionTreeClassifier().setMaxDepth(6).setMaxBins(64).fit((xtr, ytr))
+    assert m.trees.feature.shape[0] == 1  # a forest of one
+    ours = (m._predict_matrix(xte) == yte).mean()
+    sk = sk_tree.DecisionTreeClassifier(max_depth=6, random_state=0).fit(xtr, ytr)
+    assert ours >= sk.score(xte, yte) - 0.05, (ours, sk.score(xte, yte))
+    assert 1 <= m.depth <= 6
+    # deterministic: two fits agree exactly
+    m2 = DecisionTreeClassifier().setMaxDepth(6).setMaxBins(64).fit((xtr, ytr))
+    np.testing.assert_array_equal(
+        np.asarray(m.trees.feature), np.asarray(m2.trees.feature)
+    )
+    with pytest.raises(AttributeError, match="exactly one tree"):
+        DecisionTreeClassifier().setNumTrees(5)
+
+    reg = DecisionTreeRegressor().setMaxDepth(5).fit((xtr, xtr[:, 0] * 2))
+    pred = reg._predict_matrix(xte)
+    r2 = 1 - ((pred - xte[:, 0] * 2) ** 2).mean() / (xte[:, 0] * 2).var()
+    assert r2 > 0.85, r2
+
+
+def test_decision_tree_persistence(tmp_path, clf_data):
+    from spark_rapids_ml_tpu.classification import (
+        DecisionTreeClassificationModel,
+        DecisionTreeClassifier,
+    )
+
+    xtr, ytr, xte, _ = clf_data
+    m = DecisionTreeClassifier().setMaxDepth(4).fit((xtr, ytr))
+    path = str(tmp_path / "dt")
+    m.save(path)
+    loaded = DecisionTreeClassificationModel.load(path)
+    assert isinstance(loaded, DecisionTreeClassificationModel)
+    np.testing.assert_array_equal(
+        loaded._predict_matrix(xte), m._predict_matrix(xte)
+    )
+    assert loaded.depth == m.depth
+
+
+def test_decision_tree_load_rejects_forest_saves(tmp_path, clf_data):
+    """The richer-subclass upgrade rule must not let a 5-tree forest pose
+    as a decision tree."""
+    from spark_rapids_ml_tpu.classification import (
+        DecisionTreeClassificationModel,
+    )
+
+    xtr, ytr, _, _ = clf_data
+    rf = RandomForestClassifier().setNumTrees(5).setMaxDepth(2).fit((xtr, ytr))
+    path = str(tmp_path / "rf5")
+    rf.save(path)
+    with pytest.raises(TypeError, match="5 trees"):
+        DecisionTreeClassificationModel.load(path)
