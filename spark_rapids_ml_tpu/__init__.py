@@ -91,6 +91,10 @@ def __getattr__(name):
         from spark_rapids_ml_tpu.models import forest
 
         return getattr(forest, name)
+    if name in ("NaiveBayes", "NaiveBayesModel"):
+        from spark_rapids_ml_tpu.models import naive_bayes
+
+        return getattr(naive_bayes, name)
     if name in (
         "GBTClassifier",
         "GBTClassificationModel",

@@ -18,6 +18,10 @@ from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: F401
+    NaiveBayes,
+    NaiveBayesModel,
+)
 from spark_rapids_ml_tpu.models.ovr import (  # noqa: F401
     OneVsRest,
     OneVsRestModel,
@@ -32,6 +36,8 @@ __all__ = [
     "LinearSVCModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "NaiveBayes",
+    "NaiveBayesModel",
     "OneVsRest",
     "OneVsRestModel",
     "RandomForestClassifier",

@@ -1,0 +1,279 @@
+"""NaiveBayes — pyspark.ml's three-flavor NB from one statistics pass.
+
+Spark's surface mirrored: ``modelType`` 'multinomial' (default) /
+'bernoulli' / 'gaussian', ``smoothing`` λ (Laplace/Lidstone), Spark ML's
+``weightCol`` contract, and the model's ``pi`` (log class priors),
+``theta`` (log feature parameters, [C, F]) and ``sigma`` (gaussian
+variances). Training is ONE distributed NBStats monoid pass
+(ops/naive_bayes.py) + a closed-form host solve; prediction is one
+matmul against theta (+ the flavor's additive corrections).
+
+Closed forms (all sklearn-identical — the tests assert parameter-level
+equality against MultinomialNB / BernoulliNB / GaussianNB):
+
+- multinomial: θ = log((S_cf + λ) / (Σ_f S_cf + λF));
+- bernoulli:   p = (S_cf + λ) / (N_c + 2λ); raw adds both log p and
+  log(1−p) legs (features must be 0/1, validated like Spark);
+- gaussian:    μ = S/N from the first pass; σ² from a SECOND centered
+  pass Σw(x−μ_c)²/N (numerically stable on offset-heavy features);
+  raw = π − ½Σ(log 2πσ² + (x−μ)²/σ²).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    Param,
+)
+from spark_rapids_ml_tpu.ops import naive_bayes as NB
+from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+_MODEL_TYPES = ("multinomial", "bernoulli", "gaussian")
+
+
+class _NBParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
+    modelType = Param(
+        "modelType", "'multinomial' (default), 'bernoulli', or 'gaussian'", str
+    )
+    smoothing = Param("smoothing", "Laplace smoothing λ", float)
+    probabilityCol = Param("probabilityCol", "class-probability column", str)
+    rawPredictionCol = Param(
+        "rawPredictionCol", "per-class log-likelihood column", str
+    )
+    weightCol = Param("weightCol", "optional instance-weight column", str)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            featuresCol="features", labelCol="label",
+            predictionCol="prediction", probabilityCol="probability",
+            rawPredictionCol="rawPrediction",
+            modelType="multinomial", smoothing=1.0,
+        )
+
+    def getModelType(self) -> str:
+        return self.getOrDefault("modelType")
+
+    def getSmoothing(self) -> float:
+        return self.getOrDefault("smoothing")
+
+
+class NaiveBayes(_NBParams, Estimator):
+    def setModelType(self, value: str) -> "NaiveBayes":
+        if value not in _MODEL_TYPES:
+            raise ValueError(
+                f"modelType must be one of {_MODEL_TYPES}, got {value!r}"
+            )
+        return self._set(modelType=value)
+
+    def setSmoothing(self, value: float) -> "NaiveBayes":
+        if value < 0:
+            raise ValueError(f"smoothing must be >= 0, got {value}")
+        return self._set(smoothing=float(value))
+
+    def setWeightCol(self, value: str) -> "NaiveBayes":
+        return self._set(weightCol=value)
+
+    def setProbabilityCol(self, value: str) -> "NaiveBayes":
+        return self._set(probabilityCol=value)
+
+    def setRawPredictionCol(self, value: str) -> "NaiveBayes":
+        return self._set(rawPredictionCol=value)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        parts = columnar.labeled_partitions(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("labelCol"),
+            num_partitions,
+            weight_col=self._paramMap.get("weightCol"),
+        )
+        model_type = self.getModelType()
+        all_labels = np.unique(
+            np.concatenate([np.unique(y) for _, y, _ in parts])
+        )
+        if not np.all(all_labels == np.round(all_labels)) or all_labels.min() < 0:
+            raise ValueError(
+                f"NaiveBayes requires integer class labels 0..C-1, got "
+                f"{all_labels[:8]}"
+            )
+        n_classes = int(all_labels.max()) + 1
+        if model_type in ("multinomial", "bernoulli"):
+            for x, _, _ in parts:
+                if (x < 0).any():
+                    raise ValueError(
+                        f"modelType='{model_type}' requires non-negative "
+                        "features (Spark's requireNonnegativeValues)"
+                    )
+                if model_type == "bernoulli" and not np.isin(
+                    x, (0.0, 1.0)
+                ).all():
+                    raise ValueError(
+                        "modelType='bernoulli' requires 0/1 features "
+                        "(Spark's requireZeroOneBernoulliValues)"
+                    )
+
+        def padded_parts():
+            for x, y, w in parts:
+                padded, true_rows = columnar.pad_rows(x)
+                fdt = columnar.float_dtype_for(padded.dtype)
+                wv = np.zeros(padded.shape[0], fdt)
+                wv[:true_rows] = 1.0 if w is None else w
+                yv = np.zeros(padded.shape[0], fdt)
+                yv[:true_rows] = y
+                yield jnp.asarray(padded), jnp.asarray(yv), jnp.asarray(wv)
+
+        with trace_range("naive bayes stats"):
+            stats = tree_reduce(
+                [
+                    NB.nb_stats(xd, yd, wd, n_classes)
+                    for xd, yd, wd in padded_parts()
+                ],
+                NB.combine_nb_stats,
+            )
+
+        counts = np.asarray(stats.counts, dtype=np.float64)
+        feat_sum = np.asarray(stats.feat_sum, dtype=np.float64)
+        lam = self.getSmoothing()
+        total = counts.sum()
+        safe_counts = np.where(counts > 0, counts, 1.0)
+        with np.errstate(divide="ignore"):
+            pi = np.log(counts / total)
+        F = feat_sum.shape[1]
+
+        sigma = np.zeros((0, 0))
+        if model_type == "multinomial":
+            theta = np.log(feat_sum + lam) - np.log(
+                feat_sum.sum(axis=1, keepdims=True) + lam * F
+            )
+        elif model_type == "bernoulli":
+            p = (feat_sum + lam) / (counts[:, None] + 2.0 * lam)
+            theta = np.log(p)  # log(1-p) is derived at predict time
+        else:  # gaussian
+            mu = feat_sum / safe_counts[:, None]
+            # SECOND centered pass (ops.nb_centered_sq): variance from
+            # squared deviations against the reduced class means — the
+            # one-pass Sq/N − μ² form cancels catastrophically on
+            # offset-heavy features (sklearn computes it this way too)
+            with trace_range("naive bayes variance pass"):
+                mu_d = jnp.asarray(mu)
+                sq = tree_reduce(
+                    [
+                        NB.nb_centered_sq(xd, yd, wd, mu_d, n_classes)
+                        for xd, yd, wd in padded_parts()
+                    ],
+                    lambda a, b: a + b,
+                )
+            var = np.asarray(sq, dtype=np.float64) / safe_counts[:, None]
+            theta = mu
+            sigma = np.maximum(var, 1e-12)
+
+        model = NaiveBayesModel(
+            uid=self.uid, pi=pi, theta=theta, sigma=sigma,
+        )
+        return self._copyValues(model)
+
+
+class NaiveBayesModel(_NBParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        pi: np.ndarray | None = None,
+        theta: np.ndarray | None = None,
+        sigma: np.ndarray | None = None,
+    ):
+        super().__init__(uid)
+        self.pi = None if pi is None else np.asarray(pi)
+        self.theta = None if theta is None else np.asarray(theta)
+        self.sigma = None if sigma is None else np.asarray(sigma)
+
+    @property
+    def numClasses(self) -> int:
+        return self.pi.shape[0]
+
+    def _raw_scores(self, mat: np.ndarray) -> np.ndarray:
+        """[rows, C] joint log-likelihoods (Spark's rawPrediction)."""
+        model_type = self.getModelType()
+        x = mat.astype(np.float64, copy=False)
+        if model_type == "multinomial":
+            return self.pi[None, :] + x @ self.theta.T
+        if model_type == "bernoulli":
+            if not np.isin(x, (0.0, 1.0)).all():
+                raise ValueError(
+                    "Bernoulli naive Bayes requires 0 or 1 feature values "
+                    "at predict time (the Spark contract)"
+                )
+            log_p = self.theta
+            log_1mp = np.log1p(-np.exp(self.theta))
+            return (
+                self.pi[None, :]
+                + x @ (log_p - log_1mp).T
+                + log_1mp.sum(axis=1)[None, :]
+            )
+        # gaussian
+        mu, var = self.theta, self.sigma
+        const = -0.5 * np.log(2.0 * np.pi * var).sum(axis=1)
+        quad = -0.5 * (
+            (x[:, None, :] - mu[None, :, :]) ** 2 / var[None, :, :]
+        ).sum(axis=2)
+        return self.pi[None, :] + const[None, :] + quad
+
+    @staticmethod
+    def _from_raw(raw: np.ndarray):
+        shifted = raw - raw.max(axis=1, keepdims=True)
+        e = np.exp(shifted)
+        proba = e / e.sum(axis=1, keepdims=True)
+        return proba, np.argmax(raw, axis=1).astype(np.float64)
+
+    def proba_and_predictions(self, mat: np.ndarray):
+        return self._from_raw(self._raw_scores(mat))
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        return self.proba_and_predictions(mat)[1]
+
+    def transform(self, dataset: Any) -> Any:
+        if columnar.has_named_columns(dataset):
+            mat = columnar.extract_matrix(
+                dataset, self.getOrDefault("featuresCol")
+            )
+            raw = self._raw_scores(mat)  # ONE scoring pass feeds all three
+            proba, preds = self._from_raw(raw)
+            return columnar.append_columns(
+                dataset,
+                [
+                    (self.getOrDefault("rawPredictionCol"), raw),
+                    (self.getOrDefault("probabilityCol"), proba),
+                    (self.getOrDefault("predictionCol"), preds),
+                ],
+            )
+        return columnar.apply_column_transform(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("predictionCol"),
+            self._predict_matrix,
+        )
+
+    def predict(self, row) -> float:
+        return float(
+            self._predict_matrix(np.asarray(row, dtype=np.float64)[None, :])[0]
+        )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {"pi": self.pi, "theta": self.theta, "sigma": self.sigma}
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(
+            uid=uid, pi=data["pi"], theta=data["theta"], sigma=data["sigma"],
+        )

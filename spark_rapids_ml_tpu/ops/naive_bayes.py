@@ -1,0 +1,71 @@
+"""Naive Bayes sufficient statistics — one MXU pass, one monoid.
+
+pyspark.ml's NaiveBayes (multinomial / bernoulli / gaussian) trains from
+per-class reductions: weighted class counts + per-class feature sums
+(one pass), and for gaussian a SECOND centered pass of squared
+deviations against the reduced class means (``nb_centered_sq`` — the
+numerically stable variance route). Each pass is one-hot matmuls — the
+same onehotᵀ·X recast of scatter-by-label KMeans uses (ops/kmeans.py) —
+and the stats tuple is a commutative monoid, so every reducer in this
+framework (tree-aggregate, mesh psum) applies unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.ops.linalg import DEFAULT_PRECISION
+
+
+class NBStats(NamedTuple):
+    counts: jax.Array  # [C]    — weighted class counts
+    feat_sum: jax.Array  # [C, F] — weighted per-class feature sums
+
+
+def combine_nb_stats(a: NBStats, b: NBStats) -> NBStats:
+    return NBStats(*(av + bv for av, bv in zip(a, b)))
+
+
+@partial(jax.jit, static_argnames=("n_classes",))
+def nb_centered_sq(
+    x: jax.Array,  # [rows, F]
+    y: jax.Array,  # [rows] class indices
+    w: jax.Array,  # [rows] weights (0 = pad)
+    mu: jax.Array,  # [C, F] per-class means (replicated)
+    n_classes: int,
+    *,
+    precision=DEFAULT_PRECISION,
+) -> jax.Array:
+    """[C, F] Σ w·(x − μ_class)² — the SECOND gaussian pass. Variance via
+    squared deviations from the already-reduced class means is numerically
+    stable where the one-pass Sq/N − μ² form cancels catastrophically on
+    offset-heavy features (values ~1e8, spread ~1)."""
+    yi = y.astype(jnp.int32)
+    d = x - mu[jnp.clip(yi, 0, n_classes - 1)]
+    onehot_w = (
+        yi[:, None] == jnp.arange(n_classes, dtype=jnp.int32)[None, :]
+    ).astype(x.dtype) * w[:, None]
+    return jnp.matmul(onehot_w.T, d * d, precision=precision)
+
+
+@partial(jax.jit, static_argnames=("n_classes",))
+def nb_stats(
+    x: jax.Array,  # [rows, F]
+    y: jax.Array,  # [rows] class indices (float or int)
+    w: jax.Array,  # [rows] instance weights (0 = pad/excluded)
+    n_classes: int,
+    *,
+    precision=DEFAULT_PRECISION,
+) -> NBStats:
+    onehot_w = (
+        y.astype(jnp.int32)[:, None]
+        == jnp.arange(n_classes, dtype=jnp.int32)[None, :]
+    ).astype(x.dtype) * w[:, None]
+    return NBStats(
+        counts=jnp.sum(onehot_w, axis=0),
+        feat_sum=jnp.matmul(onehot_w.T, x, precision=precision),
+    )

@@ -1,0 +1,116 @@
+"""NaiveBayes tests — sklearn PARAMETER-level differentials (the closed
+forms are identical, so theta/pi must agree to float precision)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import NaiveBayes, NaiveBayesModel
+
+
+@pytest.fixture(scope="module")
+def count_data():
+    rng = np.random.default_rng(0)
+    x = rng.poisson(3.0, size=(900, 12)).astype(float)
+    y = rng.integers(0, 3, size=900).astype(float)
+    # make classes separable-ish: class c inflates features [4c, 4c+4)
+    for c in range(3):
+        x[y == c, 4 * c : 4 * c + 4] += rng.poisson(6.0, size=(int((y == c).sum()), 4))
+    return x, y
+
+
+def test_multinomial_matches_sklearn_parameters(count_data):
+    sk_nb = pytest.importorskip("sklearn.naive_bayes")
+    x, y = count_data
+    m = NaiveBayes().setSmoothing(1.0).fit((x, y))
+    sk = sk_nb.MultinomialNB(alpha=1.0).fit(x, y)
+    np.testing.assert_allclose(m.pi, sk.class_log_prior_, rtol=1e-12)
+    np.testing.assert_allclose(m.theta, sk.feature_log_prob_, rtol=1e-12)
+    np.testing.assert_array_equal(m._predict_matrix(x), sk.predict(x))
+    proba, _ = m.proba_and_predictions(x[:50])
+    np.testing.assert_allclose(proba, sk.predict_proba(x[:50]), atol=1e-10)
+
+
+def test_bernoulli_matches_sklearn(count_data):
+    sk_nb = pytest.importorskip("sklearn.naive_bayes")
+    x, y = count_data
+    xb = (x > 3).astype(float)
+    m = NaiveBayes().setModelType("bernoulli").setSmoothing(1.0).fit((xb, y))
+    sk = sk_nb.BernoulliNB(alpha=1.0).fit(xb, y)
+    np.testing.assert_allclose(m.theta, sk.feature_log_prob_, rtol=1e-12)
+    np.testing.assert_array_equal(m._predict_matrix(xb), sk.predict(xb))
+
+
+def test_gaussian_matches_sklearn(count_data):
+    sk_nb = pytest.importorskip("sklearn.naive_bayes")
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(600, 5)) + rng.integers(0, 2, size=600)[:, None] * 3
+    y = (x[:, 0] > 1.5).astype(float)
+    m = NaiveBayes().setModelType("gaussian").fit((x, y))
+    sk = sk_nb.GaussianNB(var_smoothing=0.0).fit(x, y)
+    np.testing.assert_allclose(m.theta, sk.theta_, rtol=1e-10)
+    np.testing.assert_allclose(m.sigma, sk.var_, rtol=1e-8)
+    agree = (m._predict_matrix(x) == sk.predict(x)).mean()
+    assert agree > 0.999, agree
+
+
+def test_weighted_equals_duplication(count_data):
+    x, y = count_data
+    dup = np.arange(0, len(x), 5)
+    w = np.ones(len(x)); w[dup] = 2.0
+    m_w = NaiveBayes().fit((x, y, w))
+    m_d = NaiveBayes().fit(
+        (np.concatenate([x, x[dup]]), np.concatenate([y, y[dup]]))
+    )
+    np.testing.assert_allclose(m_w.theta, m_d.theta, rtol=1e-10)
+    np.testing.assert_allclose(m_w.pi, m_d.pi, rtol=1e-10)
+
+
+def test_validation_and_columns(count_data):
+    pd = pytest.importorskip("pandas")
+    x, y = count_data
+    with pytest.raises(ValueError, match="non-negative"):
+        NaiveBayes().fit((x - 100.0, y))
+    with pytest.raises(ValueError, match="0/1 features"):
+        NaiveBayes().setModelType("bernoulli").fit((x, y))
+    with pytest.raises(ValueError, match="modelType"):
+        NaiveBayes().setModelType("poisson")
+    m = NaiveBayes().fit(pd.DataFrame({"features": list(x), "label": y}))
+    out = m.transform(pd.DataFrame({"features": list(x[:20])}))
+    assert {"rawPrediction", "probability", "prediction"} <= set(out.columns)
+    p = np.stack(out["probability"])
+    np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-12)
+
+
+def test_persistence_and_partitions(tmp_path, count_data):
+    x, y = count_data
+    m1 = NaiveBayes().fit((x, y), num_partitions=1)
+    m4 = NaiveBayes().fit((x, y), num_partitions=4)
+    np.testing.assert_allclose(m1.theta, m4.theta, rtol=1e-10)  # monoid
+    path = str(tmp_path / "nb")
+    m1.save(path)
+    loaded = NaiveBayesModel.load(path)
+    assert loaded.getModelType() == "multinomial"
+    np.testing.assert_array_equal(
+        loaded._predict_matrix(x[:50]), m1._predict_matrix(x[:50])
+    )
+
+
+def test_gaussian_stable_on_offset_features():
+    """Epoch-timestamp-style features (offset 1e8, spread 1): the centered
+    second pass keeps variances exact where Sq/N − mu^2 cancels to junk."""
+    sk_nb = pytest.importorskip("sklearn.naive_bayes")
+    rng = np.random.default_rng(3)
+    y = rng.integers(0, 2, size=500).astype(float)
+    x = 1e8 + rng.normal(size=(500, 4)) + y[:, None] * 2.0
+    m = NaiveBayes().setModelType("gaussian").fit((x, y))
+    sk = sk_nb.GaussianNB(var_smoothing=0.0).fit(x, y)
+    np.testing.assert_allclose(m.sigma, sk.var_, rtol=1e-6)
+    assert (m._predict_matrix(x) == sk.predict(x)).mean() > 0.999
+
+
+def test_bernoulli_rejects_nonbinary_at_predict(count_data):
+    x, y = count_data
+    xb = (x > 3).astype(float)
+    m = NaiveBayes().setModelType("bernoulli").fit((xb, y))
+    with pytest.raises(ValueError, match="0 or 1 feature values"):
+        m._predict_matrix(x)  # raw counts, not binarized
