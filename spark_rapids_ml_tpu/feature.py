@@ -32,6 +32,13 @@ from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
     StandardScaler,
     StandardScalerModel,
 )
+from spark_rapids_ml_tpu.models.feature_eng import (  # noqa: F401
+    OneHotEncoder,
+    OneHotEncoderModel,
+    StringIndexer,
+    StringIndexerModel,
+    VectorAssembler,
+)
 from spark_rapids_ml_tpu.models.discretizer import (  # noqa: F401
     Bucketizer,
     QuantileDiscretizer,
@@ -49,6 +56,11 @@ from spark_rapids_ml_tpu.models.truncated_svd import (  # noqa: F401
 __all__ = [
     "PCA",
     "PCAModel",
+    "VectorAssembler",
+    "StringIndexer",
+    "StringIndexerModel",
+    "OneHotEncoder",
+    "OneHotEncoderModel",
     "StandardScaler",
     "StandardScalerModel",
     "Normalizer",
