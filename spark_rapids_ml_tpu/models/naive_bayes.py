@@ -52,6 +52,14 @@ class _NBParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         "rawPredictionCol", "per-class log-likelihood column", str
     )
     weightCol = Param("weightCol", "optional instance-weight column", str)
+    distribution = Param(
+        "distribution",
+        "'driver-merge' (host tree-reduce of per-partition NBStats) or "
+        "'mesh-local' (rows concatenated onto THIS process's device mesh; "
+        "both statistics passes reduce via psum collectives) — identical "
+        "results, the framework-wide distribution contract",
+        str,
+    )
 
     def __init__(self, uid: str | None = None, **kwargs):
         super().__init__(uid, **kwargs)
@@ -60,6 +68,7 @@ class _NBParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
             predictionCol="prediction", probabilityCol="probability",
             rawPredictionCol="rawPrediction",
             modelType="multinomial", smoothing=1.0,
+            distribution="driver-merge",
         )
 
     def getModelType(self) -> str:
@@ -90,6 +99,14 @@ class NaiveBayes(_NBParams, Estimator):
 
     def setRawPredictionCol(self, value: str) -> "NaiveBayes":
         return self._set(rawPredictionCol=value)
+
+    def setDistribution(self, value: str) -> "NaiveBayes":
+        if value not in ("driver-merge", "mesh-local"):
+            raise ValueError(
+                "distribution must be 'driver-merge' or 'mesh-local', "
+                f"got {value!r}"
+            )
+        return self._set(distribution=value)
 
     def fit(self, dataset: Any, num_partitions: int | None = None):
         parts = columnar.labeled_partitions(
@@ -124,24 +141,58 @@ class NaiveBayes(_NBParams, Estimator):
                         "(Spark's requireZeroOneBernoulliValues)"
                     )
 
-        def padded_parts():
-            for x, y, w in parts:
-                padded, true_rows = columnar.pad_rows(x)
-                fdt = columnar.float_dtype_for(padded.dtype)
-                wv = np.zeros(padded.shape[0], fdt)
-                wv[:true_rows] = 1.0 if w is None else w
-                yv = np.zeros(padded.shape[0], fdt)
-                yv[:true_rows] = y
-                yield jnp.asarray(padded), jnp.asarray(yv), jnp.asarray(wv)
-
-        with trace_range("naive bayes stats"):
-            stats = tree_reduce(
-                [
-                    NB.nb_stats(xd, yd, wd, n_classes)
-                    for xd, yd, wd in padded_parts()
-                ],
-                NB.combine_nb_stats,
+        mesh_local = self.getOrDefault("distribution") == "mesh-local"
+        if mesh_local:
+            # rows concatenated once onto THIS process's mesh, padded to an
+            # equal-shard multiple with weight 0 — both passes psum
+            from spark_rapids_ml_tpu.parallel.mesh import create_mesh
+            from spark_rapids_ml_tpu.parallel.naive_bayes import (
+                sharded_nb_centered_sq,
+                sharded_nb_stats,
             )
+
+            x_all = np.concatenate([p[0] for p in parts])
+            y_all = np.concatenate([p[1] for p in parts])
+            w_all = (
+                np.concatenate([p[2] for p in parts])
+                if parts[0][2] is not None
+                else np.ones(len(x_all))
+            )
+            ndev = len(jax.devices())
+            per = -(-len(x_all) // ndev)
+            fdt = columnar.float_dtype_for(x_all.dtype)
+            xp = np.zeros((per * ndev, x_all.shape[1]), fdt)
+            xp[: len(x_all)] = x_all
+            yp = np.zeros(per * ndev, fdt)
+            yp[: len(x_all)] = y_all
+            wp = np.zeros(per * ndev, fdt)
+            wp[: len(x_all)] = w_all
+            mesh = create_mesh(data=ndev)
+            xd_m, yd_m, wd_m = (
+                jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(wp),
+            )
+            with trace_range("naive bayes stats (mesh)"):
+                stats = sharded_nb_stats(xd_m, yd_m, wd_m, n_classes, mesh)
+        else:
+
+            def padded_parts():
+                for x, y, w in parts:
+                    padded, true_rows = columnar.pad_rows(x)
+                    fdt = columnar.float_dtype_for(padded.dtype)
+                    wv = np.zeros(padded.shape[0], fdt)
+                    wv[:true_rows] = 1.0 if w is None else w
+                    yv = np.zeros(padded.shape[0], fdt)
+                    yv[:true_rows] = y
+                    yield jnp.asarray(padded), jnp.asarray(yv), jnp.asarray(wv)
+
+            with trace_range("naive bayes stats"):
+                stats = tree_reduce(
+                    [
+                        NB.nb_stats(xd, yd, wd, n_classes)
+                        for xd, yd, wd in padded_parts()
+                    ],
+                    NB.combine_nb_stats,
+                )
 
         counts = np.asarray(stats.counts, dtype=np.float64)
         feat_sum = np.asarray(stats.feat_sum, dtype=np.float64)
@@ -168,13 +219,18 @@ class NaiveBayes(_NBParams, Estimator):
             # offset-heavy features (sklearn computes it this way too)
             with trace_range("naive bayes variance pass"):
                 mu_d = jnp.asarray(mu)
-                sq = tree_reduce(
-                    [
-                        NB.nb_centered_sq(xd, yd, wd, mu_d, n_classes)
-                        for xd, yd, wd in padded_parts()
-                    ],
-                    lambda a, b: a + b,
-                )
+                if mesh_local:
+                    sq = sharded_nb_centered_sq(
+                        xd_m, yd_m, wd_m, mu_d, n_classes, mesh
+                    )
+                else:
+                    sq = tree_reduce(
+                        [
+                            NB.nb_centered_sq(xd, yd, wd, mu_d, n_classes)
+                            for xd, yd, wd in padded_parts()
+                        ],
+                        lambda a, b: a + b,
+                    )
             var = np.asarray(sq, dtype=np.float64) / safe_counts[:, None]
             theta = mu
             sigma = np.maximum(var, 1e-12)

@@ -1,0 +1,70 @@
+"""Mesh-sharded NaiveBayes statistics — the NBStats monoid over ICI.
+
+One ``mapreduce_data_axis`` program: each device computes its row shard's
+one-hot-matmul statistics (ops/naive_bayes.py) and a psum combines them —
+the same shape as every other stats pass here. The closed-form solve
+stays on the host (it is O(C·F)).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_rapids_ml_tpu.ops import naive_bayes as NB
+from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS
+
+
+@lru_cache(maxsize=None)
+def _nb_stats_prog(mesh: Mesh, n_classes: int):
+    from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
+
+    return jax.jit(
+        mapreduce_data_axis(
+            lambda xl, yl, wl: NB.nb_stats(xl, yl, wl, n_classes),
+            mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        )
+    )
+
+
+def sharded_nb_stats(
+    x: jax.Array, y: jax.Array, w: jax.Array, n_classes: int, mesh: Mesh
+) -> NB.NBStats:
+    """NBStats over data-sharded (x, y, w); replicated stats out. ``w``
+    carries instance weights on true rows and 0.0 on pad rows."""
+    return _nb_stats_prog(mesh, n_classes)(x, y, w)
+
+
+@lru_cache(maxsize=None)
+def _nb_centered_sq_prog(mesh: Mesh, n_classes: int):
+    from jax.sharding import PartitionSpec as P
+
+    from spark_rapids_ml_tpu.parallel.backend import mapreduce_data_axis
+
+    return jax.jit(
+        mapreduce_data_axis(
+            lambda xl, yl, wl, mu: NB.nb_centered_sq(
+                xl, yl, wl, mu, n_classes
+            ),
+            mesh,
+            in_specs=(
+                P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(),
+            ),
+        )
+    )
+
+
+def sharded_nb_centered_sq(
+    x: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    mu: jax.Array,
+    n_classes: int,
+    mesh: Mesh,
+) -> jax.Array:
+    """The gaussian second pass (Σw·(x−μ_class)²) over the mesh — μ
+    replicated, rows sharded."""
+    return _nb_centered_sq_prog(mesh, n_classes)(x, y, w, mu)

@@ -114,3 +114,51 @@ def test_bernoulli_rejects_nonbinary_at_predict(count_data):
     m = NaiveBayes().setModelType("bernoulli").fit((xb, y))
     with pytest.raises(ValueError, match="0 or 1 feature values"):
         m._predict_matrix(x)  # raw counts, not binarized
+
+
+def test_mesh_local_fit_equals_driver_merge(count_data):
+    """distribution='mesh-local' produces the identical model (psum of an
+    integer-valued monoid), for the one-pass multinomial AND the
+    two-pass gaussian."""
+    x, y = count_data
+    m_d = NaiveBayes().fit((x, y))
+    m_m = NaiveBayes().setDistribution("mesh-local").fit((x, y))
+    np.testing.assert_allclose(m_m.theta, m_d.theta, rtol=1e-12)
+    np.testing.assert_allclose(m_m.pi, m_d.pi, rtol=1e-12)
+
+    rng = np.random.default_rng(7)
+    xg = rng.normal(size=(500, 4)) + 1e6  # offset: exercises the stable pass
+    yg = rng.integers(0, 2, size=500).astype(float)
+    g_d = NaiveBayes().setModelType("gaussian").fit((xg, yg))
+    g_m = (
+        NaiveBayes().setModelType("gaussian").setDistribution("mesh-local")
+        .fit((xg, yg))
+    )
+    np.testing.assert_allclose(g_m.sigma, g_d.sigma, rtol=1e-9)
+    np.testing.assert_allclose(g_m.theta, g_d.theta, rtol=1e-12)
+
+
+def test_sharded_stats_match_tree_reduce(count_data):
+    """The NBStats monoid over the mesh psum equals the host tree-reduce
+    exactly (integer-valued sums in f64)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import naive_bayes as NBops
+    from spark_rapids_ml_tpu.parallel.mesh import create_mesh
+    from spark_rapids_ml_tpu.parallel.naive_bayes import sharded_nb_stats
+
+    x, y = count_data
+    ndev = len(jax.devices())
+    rows = (len(x) // ndev) * ndev
+    xs, ys = x[:rows], y[:rows]
+    w = np.ones(rows)
+    mesh = create_mesh(data=ndev)
+    got = sharded_nb_stats(
+        jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(w), 3, mesh
+    )
+    ref = NBops.nb_stats(jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(w), 3)
+    np.testing.assert_array_equal(np.asarray(got.counts), np.asarray(ref.counts))
+    np.testing.assert_allclose(
+        np.asarray(got.feat_sum), np.asarray(ref.feat_sum), rtol=1e-12
+    )
