@@ -12,6 +12,10 @@ from spark_rapids_ml_tpu.models.gbt import (  # noqa: F401
     GBTRegressionModel,
     GBTRegressor,
 )
+from spark_rapids_ml_tpu.models.isotonic import (  # noqa: F401
+    IsotonicRegression,
+    IsotonicRegressionModel,
+)
 from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
     LinearRegression,
     LinearRegressionModel,
@@ -22,6 +26,8 @@ __all__ = [
     "DecisionTreeRegressionModel",
     "GBTRegressor",
     "GBTRegressionModel",
+    "IsotonicRegression",
+    "IsotonicRegressionModel",
     "LinearRegression",
     "LinearRegressionModel",
     "RandomForestRegressor",

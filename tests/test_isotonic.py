@@ -1,0 +1,112 @@
+"""IsotonicRegression — exact sklearn differential (same L2 PAV)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.regression import (
+    IsotonicRegression,
+    IsotonicRegressionModel,
+)
+
+
+@pytest.fixture()
+def noisy_monotone():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 10, size=400)
+    y = np.log1p(x) * 3 + rng.normal(scale=0.4, size=400)
+    return x[:, None], y
+
+
+def test_matches_sklearn_exactly(noisy_monotone):
+    sk_iso = pytest.importorskip("sklearn.isotonic")
+    x, y = noisy_monotone
+    m = IsotonicRegression().fit((x, y))
+    sk = sk_iso.IsotonicRegression(out_of_bounds="clip").fit(x[:, 0], y)
+    grid = np.linspace(-1, 11, 300)[:, None]
+    np.testing.assert_allclose(
+        m._predict_matrix(grid), sk.predict(grid[:, 0]), atol=1e-9
+    )
+
+
+def test_antitonic_and_feature_index(noisy_monotone):
+    sk_iso = pytest.importorskip("sklearn.isotonic")
+    x, y = noisy_monotone
+    x2 = np.concatenate([np.zeros_like(x), x], axis=1)
+    m = (
+        IsotonicRegression().setIsotonic(False).setFeatureIndex(1)
+        .fit((x2, -y))
+    )
+    sk = sk_iso.IsotonicRegression(
+        increasing=False, out_of_bounds="clip"
+    ).fit(x[:, 0], -y)
+    grid = np.linspace(0, 10, 200)
+    grid2 = np.stack([np.zeros_like(grid), grid], axis=1)
+    np.testing.assert_allclose(
+        m._predict_matrix(grid2), sk.predict(grid), atol=1e-9
+    )
+
+
+def test_weighted_equals_duplication(noisy_monotone):
+    x, y = noisy_monotone
+    dup = np.arange(0, len(x), 3)
+    w = np.ones(len(x)); w[dup] = 2.0
+    m_w = IsotonicRegression().fit((x, y, w))
+    m_d = IsotonicRegression().fit(
+        (np.concatenate([x, x[dup]]), np.concatenate([y, y[dup]]))
+    )
+    grid = np.linspace(0, 10, 100)[:, None]
+    np.testing.assert_allclose(
+        m_w._predict_matrix(grid), m_d._predict_matrix(grid), atol=1e-9
+    )
+
+
+def test_clamping_and_persistence(tmp_path, noisy_monotone):
+    x, y = noisy_monotone
+    m = IsotonicRegression().fit((x, y))
+    lo = m.predict(-100.0)
+    hi = m.predict(100.0)
+    assert lo == m.predictions[0] and hi == m.predictions[-1]
+    assert np.all(np.diff(m.predictions) >= -1e-12)  # monotone
+    path = str(tmp_path / "iso")
+    m.save(path)
+    loaded = IsotonicRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.boundaries, m.boundaries)
+    np.testing.assert_allclose(
+        loaded._predict_matrix(x[:50]), m._predict_matrix(x[:50])
+    )
+
+
+def test_tied_feature_values_pool_before_pav():
+    """Duplicate x pool into one weighted point BEFORE PAV — the isotonic
+    optimum (sklearn agrees); post-hoc averaging of separately-fitted tie
+    points would not be the L2 minimizer."""
+    sk_iso = pytest.importorskip("sklearn.isotonic")
+    x = np.array([[0.0], [0.0], [1.0]])
+    y = np.array([0.0, 10.0, 2.0])
+    m = IsotonicRegression().fit((x, y))
+    sk = sk_iso.IsotonicRegression(out_of_bounds="clip").fit(x[:, 0], y)
+    np.testing.assert_allclose(
+        m._predict_matrix(np.array([[0.0], [0.5], [1.0]])),
+        sk.predict([0.0, 0.5, 1.0]),
+        atol=1e-12,
+    )
+    # heavily-tied calibration-style data, exact sklearn agreement
+    rng = np.random.default_rng(5)
+    xt = rng.integers(0, 12, size=600).astype(float)
+    yt = xt * 0.5 + rng.normal(scale=1.0, size=600)
+    wt = rng.uniform(0.5, 2.0, size=600)
+    m2 = IsotonicRegression().fit((xt[:, None], yt, wt))
+    sk2 = sk_iso.IsotonicRegression(out_of_bounds="clip").fit(
+        xt, yt, sample_weight=wt
+    )
+    grid = np.linspace(-1, 13, 200)
+    np.testing.assert_allclose(
+        m2._predict_matrix(grid[:, None]), sk2.predict(grid), atol=1e-9
+    )
+
+
+def test_negative_feature_index_rejected():
+    x = np.random.default_rng(0).normal(size=(20, 3))
+    y = x[:, 0]
+    with pytest.raises(ValueError, match="featureIndex"):
+        IsotonicRegression(featureIndex=-1).fit((x, y))
