@@ -18,6 +18,10 @@ from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.mlp import (  # noqa: F401
+    MultilayerPerceptronClassificationModel,
+    MultilayerPerceptronClassifier,
+)
 from spark_rapids_ml_tpu.models.naive_bayes import (  # noqa: F401
     NaiveBayes,
     NaiveBayesModel,
@@ -36,6 +40,8 @@ __all__ = [
     "LinearSVCModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "MultilayerPerceptronClassifier",
+    "MultilayerPerceptronClassificationModel",
     "NaiveBayes",
     "NaiveBayesModel",
     "OneVsRest",

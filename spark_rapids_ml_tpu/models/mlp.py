@@ -1,0 +1,368 @@
+"""MultilayerPerceptronClassifier — pyspark.ml's feed-forward network,
+TPU-native.
+
+This is the one pyspark.ml estimator that IS a neural network, and the
+most natural fit in the package for the MXU: every layer is a matmul.
+Spark's architecture is mirrored exactly — sigmoid hidden layers, softmax
+output, cross-entropy loss, the ``layers`` param specifying
+[inputs, hidden..., classes] — and training follows Spark's solver menu:
+``l-bfgs`` (default; optax's jaxopt-derived L-BFGS) or ``gd`` with
+``stepSize``. The entire optimization runs as ONE XLA program: a
+``lax.while_loop`` whose body is value_and_grad of the full-batch loss
+plus the optimizer update — no host round-trips in training.
+
+The fitted model exposes Spark's ``weights`` (one flat vector, layer
+matrices then biases in layer order) so a coefficients-level comparison
+with a pyspark model is possible.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    Param,
+)
+from spark_rapids_ml_tpu.ops.linalg import DEFAULT_PRECISION
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+_SOLVERS = ("l-bfgs", "gd")
+
+#: module-level jit so transform/predict hit the compilation cache (the
+#: repo convention — a fresh jax.jit per call would retrace every time)
+_forward_jit = None  # created lazily below to keep import cheap
+
+
+def _forward_cached(flat, x, layers):
+    global _forward_jit
+    if _forward_jit is None:
+        _forward_jit = jax.jit(_forward, static_argnames=("layers",))
+    return _forward_jit(flat, x, layers=layers)
+
+
+def _unflatten(flat: jnp.ndarray, layers: tuple):
+    """Spark's weight layout: per layer, the [in, out] matrix then the
+    [out] bias, concatenated flat."""
+    params = []
+    at = 0
+    for fan_in, fan_out in zip(layers[:-1], layers[1:]):
+        w = flat[at : at + fan_in * fan_out].reshape(fan_in, fan_out)
+        at += fan_in * fan_out
+        b = flat[at : at + fan_out]
+        at += fan_out
+        params.append((w, b))
+    return params
+
+
+def _forward(flat, x, layers: tuple, *, precision=DEFAULT_PRECISION):
+    """Logits of Spark's topology: sigmoid hidden layers, affine output
+    (softmax applied by the loss / probability consumers)."""
+    h = x
+    params = _unflatten(flat, layers)
+    for i, (w, b) in enumerate(params):
+        h = jnp.matmul(h, w, precision=precision) + b
+        if i < len(params) - 1:
+            h = jax.nn.sigmoid(h)
+    return h
+
+
+@partial(
+    jax.jit,
+    static_argnames=("layers", "solver", "max_iter"),
+)
+def train_mlp(
+    flat0: jnp.ndarray,
+    x: jnp.ndarray,
+    y: jnp.ndarray,  # [rows] class indices (float ok)
+    w: jnp.ndarray,  # [rows] weights; 0 = pad
+    *,
+    layers: tuple,
+    solver: str,
+    max_iter: int,
+    step_size: float = 0.03,
+    tol: float = 1e-6,
+):
+    """Full-batch training as one XLA program; returns (weights, loss,
+    iterations)."""
+    import optax
+
+    y_idx = y.astype(jnp.int32)
+    w_sum = jnp.maximum(jnp.sum(w), 1.0)
+
+    def loss_fn(flat):
+        logits = _forward(flat, x, layers)
+        ll = optax.softmax_cross_entropy_with_integer_labels(logits, y_idx)
+        return jnp.sum(ll * w) / w_sum
+
+    def cond(carry):
+        _, _, it, prev, cur = carry
+        # first test runs unconditionally (prev=inf, cur finite → inf>tol)
+        return (it < max_iter) & (jnp.abs(prev - cur) > tol)
+
+    if solver == "l-bfgs":
+        opt = optax.lbfgs()
+        value_and_grad = optax.value_and_grad_from_state(loss_fn)
+
+        def body(carry):
+            flat, state, it, _, cur = carry
+            value, grad = value_and_grad(flat, state=state)
+            updates, state = opt.update(
+                grad, state, flat, value=value, grad=grad, value_fn=loss_fn
+            )
+            flat = optax.apply_updates(flat, updates)
+            # convergence compares loss(new) vs loss(old): an extra
+            # forward per iteration, the price of a correct stop test
+            return flat, state, it + 1, value, loss_fn(flat)
+
+    else:
+        opt = optax.sgd(step_size)
+
+        def body(carry):
+            flat, state, it, _, cur = carry
+            value, grad = jax.value_and_grad(loss_fn)(flat)
+            updates, state = opt.update(grad, state, flat)
+            flat = optax.apply_updates(flat, updates)
+            return flat, state, it + 1, value, loss_fn(flat)
+
+    state0 = opt.init(flat0)
+    inf = jnp.asarray(jnp.inf, flat0.dtype)
+    flat, _, it, _, loss = jax.lax.while_loop(
+        cond, body, (flat0, state0, jnp.int32(0), inf, loss_fn(flat0))
+    )
+    return flat, loss, it
+
+
+class _MLPParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
+    layers = Param(
+        "layers",
+        "layer sizes [inputs, hidden..., classes] (the Spark spec)",
+        list,
+    )
+    maxIter = Param("maxIter", "maximum optimizer iterations", int)
+    tol = Param("tol", "convergence tolerance on the loss decrease", float)
+    stepSize = Param("stepSize", "learning rate for solver='gd'", float)
+    solver = Param("solver", "'l-bfgs' (default) or 'gd'", str)
+    seed = Param("seed", "weight-initialization seed", int)
+    probabilityCol = Param("probabilityCol", "class-probability column", str)
+    rawPredictionCol = Param("rawPredictionCol", "logits column", str)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            featuresCol="features", labelCol="label",
+            predictionCol="prediction", probabilityCol="probability",
+            rawPredictionCol="rawPrediction",
+            maxIter=100, tol=1e-6, stepSize=0.03, solver="l-bfgs", seed=0,
+        )
+
+    def getLayers(self) -> list:
+        return self.getOrDefault("layers")
+
+    def getMaxIter(self) -> int:
+        return self.getOrDefault("maxIter")
+
+
+class MultilayerPerceptronClassifier(_MLPParams, Estimator):
+    def setLayers(self, value) -> "MultilayerPerceptronClassifier":
+        value = [int(v) for v in value]
+        if len(value) < 2 or any(v < 1 for v in value):
+            raise ValueError(
+                f"layers needs >= 2 positive sizes [in, ..., out], got {value}"
+            )
+        return self._set(layers=value)
+
+    def setMaxIter(self, value: int):
+        return self._set(maxIter=value)
+
+    def setTol(self, value: float):
+        return self._set(tol=float(value))
+
+    def setStepSize(self, value: float):
+        if value <= 0:
+            raise ValueError(f"stepSize must be > 0, got {value}")
+        return self._set(stepSize=float(value))
+
+    def setSolver(self, value: str):
+        if value not in _SOLVERS:
+            raise ValueError(f"solver must be one of {_SOLVERS}, got {value!r}")
+        return self._set(solver=value)
+
+    def setSeed(self, value: int):
+        return self._set(seed=value)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        """``num_partitions`` is accepted for Estimator-signature
+        uniformity; training is one full-batch XLA program either way.
+        Instance weights ((X, y, w) tuples) weight the loss — an extension
+        over pyspark's MLP, which has no weightCol."""
+        if "layers" not in self._paramMap:
+            raise ValueError("setLayers([...]) before fit (the Spark spec)")
+        layers = tuple(self.getLayers())
+        parts = columnar.labeled_partitions(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("labelCol"),
+            None,
+            weight_col=None,
+        )
+        x = np.concatenate([p[0] for p in parts])
+        y = np.concatenate([p[1] for p in parts])
+        w = (
+            np.concatenate([p[2] for p in parts])
+            if parts[0][2] is not None
+            else None
+        )
+        if x.shape[1] != layers[0]:
+            raise ValueError(
+                f"layers[0]={layers[0]} but the data has {x.shape[1]} features"
+            )
+        classes = np.unique(y)
+        if not np.all(classes == np.round(classes)) or classes.min() < 0:
+            raise ValueError(
+                f"labels must be integers 0..C-1, got {classes[:8]}"
+            )
+        if int(classes.max()) + 1 > layers[-1]:
+            raise ValueError(
+                f"labels imply {int(classes.max()) + 1} classes but "
+                f"layers[-1]={layers[-1]}"
+            )
+        fdt = columnar.float_dtype_for(x.dtype)
+        padded, true_rows = columnar.pad_rows(x.astype(fdt, copy=False))
+        wv = np.zeros(padded.shape[0], fdt)
+        wv[:true_rows] = 1.0 if w is None else w
+        yv = np.zeros(padded.shape[0], fdt)
+        yv[:true_rows] = y
+
+        # Glorot-uniform init, deterministic by seed
+        key = jax.random.PRNGKey(self.getOrDefault("seed"))
+        pieces = []
+        for fan_in, fan_out in zip(layers[:-1], layers[1:]):
+            key, k1 = jax.random.split(key)
+            limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+            pieces.append(
+                jax.random.uniform(
+                    k1, (fan_in * fan_out,), fdt, -limit, limit
+                )
+            )
+            pieces.append(jnp.zeros((fan_out,), fdt))
+        flat0 = jnp.concatenate(pieces)
+
+        with trace_range("mlp train"):
+            flat, loss, it = train_mlp(
+                flat0,
+                jnp.asarray(padded),
+                jnp.asarray(yv),
+                jnp.asarray(wv),
+                layers=layers,
+                solver=self.getOrDefault("solver"),
+                max_iter=self.getMaxIter(),
+                step_size=self.getOrDefault("stepSize"),
+                tol=self.getOrDefault("tol"),
+            )
+        weights = np.asarray(flat)
+        if not np.isfinite(weights).all():
+            raise ValueError(
+                "MLP training diverged to non-finite weights; lower "
+                "stepSize or check the data for NaN/Inf"
+            )
+        model = MultilayerPerceptronClassificationModel(
+            uid=self.uid, weights=weights,
+            trainLoss=float(loss), iterations=int(it),
+        )
+        return self._copyValues(model)
+
+
+class MultilayerPerceptronClassificationModel(_MLPParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        weights: np.ndarray | None = None,
+        trainLoss: float = float("nan"),
+        iterations: int = 0,
+    ):
+        super().__init__(uid)
+        self.weights = None if weights is None else np.asarray(weights)
+        self.trainLoss = float(trainLoss)
+        self.iterations = int(iterations)
+
+    @property
+    def numClasses(self) -> int:
+        return int(self.getLayers()[-1])
+
+    def _logits(self, mat: np.ndarray) -> np.ndarray:
+        layers = tuple(self.getLayers())
+        fdt = columnar.float_dtype_for(mat.dtype)
+        padded, true_rows = columnar.pad_rows(mat.astype(fdt, copy=False))
+        out = _forward_cached(
+            jnp.asarray(self.weights.astype(fdt)),
+            jnp.asarray(padded),
+            layers,
+        )
+        return np.asarray(out)[:true_rows]
+
+    @staticmethod
+    def _from_logits(logits: np.ndarray):
+        """THE softmax/argmax decision rule, in one place."""
+        shifted = logits - logits.max(axis=1, keepdims=True)
+        e = np.exp(shifted)
+        proba = e / e.sum(axis=1, keepdims=True)
+        return proba, np.argmax(logits, axis=1).astype(np.float64)
+
+    def proba_and_predictions(self, mat: np.ndarray):
+        return self._from_logits(self._logits(mat))
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        # prediction needs only the argmax — no softmax work
+        return np.argmax(self._logits(mat), axis=1).astype(np.float64)
+
+    def transform(self, dataset: Any) -> Any:
+        if columnar.has_named_columns(dataset):
+            mat = columnar.extract_matrix(
+                dataset, self.getOrDefault("featuresCol")
+            )
+            logits = self._logits(mat)
+            proba, preds = self._from_logits(logits)
+            return columnar.append_columns(
+                dataset,
+                [
+                    (self.getOrDefault("rawPredictionCol"), logits),
+                    (self.getOrDefault("probabilityCol"), proba),
+                    (self.getOrDefault("predictionCol"), preds),
+                ],
+            )
+        return columnar.apply_column_transform(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("predictionCol"),
+            self._predict_matrix,
+        )
+
+    def predict(self, row) -> float:
+        return float(
+            self._predict_matrix(np.asarray(row, dtype=np.float64)[None, :])[0]
+        )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {
+            "weights": self.weights,
+            "meta": np.asarray([self.trainLoss, float(self.iterations)]),
+        }
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(
+            uid=uid,
+            weights=data["weights"],
+            trainLoss=float(data["meta"][0]),
+            iterations=int(data["meta"][1]),
+        )

@@ -1,0 +1,132 @@
+"""MultilayerPerceptronClassifier — nonlinear-capacity and quality tests.
+
+The XOR-style oracle is the point: no linear model in this package can
+exceed ~50% there, so passing proves the hidden layers actually train.
+sklearn's MLPClassifier (lbfgs solver) is the quality reference.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import (
+    MultilayerPerceptronClassificationModel,
+    MultilayerPerceptronClassifier,
+)
+
+
+@pytest.fixture(scope="module")
+def xor_data():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1, 1, size=(1500, 2))
+    y = ((x[:, 0] * x[:, 1]) > 0).astype(float)
+    return x[:1000], y[:1000], x[1000:], y[1000:]
+
+
+@pytest.fixture(scope="module")
+def blobs3():
+    rng = np.random.default_rng(1)
+    centers = rng.normal(scale=4, size=(3, 6))
+    x = np.concatenate([c + rng.normal(size=(200, 6)) for c in centers])
+    y = np.repeat(np.arange(3.0), 200)
+    return x, y
+
+
+def test_solves_xor(xor_data):
+    xtr, ytr, xte, yte = xor_data
+    m = (
+        MultilayerPerceptronClassifier().setLayers([2, 16, 8, 2])
+        .setMaxIter(300).setSeed(1).fit((xtr, ytr))
+    )
+    acc = (m._predict_matrix(xte) == yte).mean()
+    assert acc > 0.95, acc  # a linear model caps at ~0.5 here
+    assert m.iterations > 5 and np.isfinite(m.trainLoss)
+
+
+def test_quality_vs_sklearn(blobs3):
+    sk_nn = pytest.importorskip("sklearn.neural_network")
+    x, y = blobs3
+    m = (
+        MultilayerPerceptronClassifier().setLayers([6, 16, 3])
+        .setMaxIter(200).setSeed(2).fit((x, y))
+    )
+    ours = (m._predict_matrix(x) == y).mean()
+    sk = sk_nn.MLPClassifier(
+        hidden_layer_sizes=(16,), solver="lbfgs", max_iter=200, random_state=2
+    ).fit(x, y)
+    assert ours >= sk.score(x, y) - 0.03, (ours, sk.score(x, y))
+
+
+def test_gd_solver_reduces_loss(xor_data):
+    xtr, ytr, _, _ = xor_data
+    m = (
+        MultilayerPerceptronClassifier().setLayers([2, 8, 2])
+        .setSolver("gd").setStepSize(0.5).setMaxIter(50).setSeed(0)
+        .fit((xtr, ytr))
+    )
+    assert np.isfinite(m.trainLoss) and m.trainLoss < np.log(2.0)
+
+
+def test_determinism_and_columns(blobs3):
+    pd = pytest.importorskip("pandas")
+    x, y = blobs3
+    kw = dict(maxIter=60, seed=7)
+    m1 = MultilayerPerceptronClassifier(**kw).setLayers([6, 8, 3]).fit((x, y))
+    m2 = MultilayerPerceptronClassifier(**kw).setLayers([6, 8, 3]).fit((x, y))
+    np.testing.assert_array_equal(m1.weights, m2.weights)
+    out = m1.transform(pd.DataFrame({"features": list(x[:30])}))
+    assert {"rawPrediction", "probability", "prediction"} <= set(out.columns)
+    p = np.stack(out["probability"])
+    np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-9)
+    raw = np.stack(out["rawPrediction"])
+    np.testing.assert_array_equal(
+        out["prediction"].to_numpy(), raw.argmax(1).astype(float)
+    )
+
+
+def test_validation(blobs3):
+    x, y = blobs3
+    with pytest.raises(ValueError, match="setLayers"):
+        MultilayerPerceptronClassifier().fit((x, y))
+    with pytest.raises(ValueError, match="layers\\[0\\]"):
+        MultilayerPerceptronClassifier().setLayers([4, 8, 3]).fit((x, y))
+    with pytest.raises(ValueError, match="layers\\[-1\\]"):
+        MultilayerPerceptronClassifier().setLayers([6, 8, 2]).fit((x, y))
+    with pytest.raises(ValueError, match="solver"):
+        MultilayerPerceptronClassifier().setSolver("adam")
+
+
+def test_persistence_roundtrip(tmp_path, blobs3):
+    x, y = blobs3
+    m = (
+        MultilayerPerceptronClassifier().setLayers([6, 10, 3])
+        .setMaxIter(80).setSeed(3).fit((x, y))
+    )
+    path = str(tmp_path / "mlp")
+    m.save(path)
+    loaded = MultilayerPerceptronClassificationModel.load(path)
+    assert loaded.getLayers() == [6, 10, 3]
+    np.testing.assert_array_equal(loaded.weights, m.weights)
+    np.testing.assert_array_equal(
+        loaded._predict_matrix(x[:50]), m._predict_matrix(x[:50])
+    )
+
+
+def test_weighted_fit_is_honored(blobs3):
+    """(X, y, w) weights the loss (an extension over pyspark's MLP):
+    zero-weight junk rows must not move the fit."""
+    x, y = blobs3
+    junk_x = np.concatenate([x, x[:50] + 100.0])
+    junk_y = np.concatenate([y, (y[:50] + 1) % 3])
+    w = np.concatenate([np.ones(len(x)), np.zeros(50)])
+    kw = dict(maxIter=60, seed=4)
+    m_w = (
+        MultilayerPerceptronClassifier(**kw).setLayers([6, 8, 3])
+        .fit((junk_x, junk_y, w))
+    )
+    m_ref = (
+        MultilayerPerceptronClassifier(**kw).setLayers([6, 8, 3])
+        .fit((x, y))
+    )
+    # identical loss surfaces -> identical L-BFGS trajectories from the
+    # same init (padding differs, but pad rows carry zero weight)
+    np.testing.assert_allclose(m_w.weights, m_ref.weights, rtol=1e-6, atol=1e-8)
