@@ -39,6 +39,12 @@ from spark_rapids_ml_tpu.models.feature_eng import (  # noqa: F401
     StringIndexerModel,
     VectorAssembler,
 )
+from spark_rapids_ml_tpu.models.text import (  # noqa: F401
+    HashingTF,
+    IDF,
+    IDFModel,
+    Tokenizer,
+)
 from spark_rapids_ml_tpu.models.discretizer import (  # noqa: F401
     Bucketizer,
     QuantileDiscretizer,
@@ -61,6 +67,10 @@ __all__ = [
     "StringIndexerModel",
     "OneHotEncoder",
     "OneHotEncoderModel",
+    "Tokenizer",
+    "HashingTF",
+    "IDF",
+    "IDFModel",
     "StandardScaler",
     "StandardScalerModel",
     "Normalizer",

@@ -29,34 +29,9 @@ from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
 from spark_rapids_ml_tpu.utils import columnar
 
 
-def _column_values(dataset: Any, col: str) -> np.ndarray:
-    """A column as a 1-D string/float array, or a 2-D float matrix for
-    array-valued columns — dispatching to utils/columnar's zero-copy
-    extractors for the numeric shapes; only genuinely-string columns take
-    the Python-object path."""
-    try:
-        import pyarrow as pa
-    except ImportError:  # pragma: no cover
-        pa = None
-    if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
-        typ = dataset.schema.field(col).type
-        if pa.types.is_list(typ) or pa.types.is_fixed_size_list(typ):
-            return columnar.extract_matrix(dataset, col)
-        if pa.types.is_string(typ) or pa.types.is_large_string(typ):
-            return np.asarray(dataset.column(col).to_pylist())
-        return columnar.extract_vector(dataset, col)
-    if hasattr(dataset, "columns") and hasattr(dataset, "__getitem__"):
-        series = dataset[col]
-        first = series.iloc[0] if len(series) else None
-        if isinstance(first, (list, tuple, np.ndarray)):
-            return columnar.extract_matrix(dataset, col)
-        arr = series.to_numpy() if hasattr(series, "to_numpy") else np.asarray(series)
-        if np.issubdtype(arr.dtype, np.number):
-            return columnar.extract_vector(dataset, col)
-        return arr
-    raise TypeError(
-        f"cannot extract column {col!r} from {type(dataset).__name__}"
-    )
+#: shared column extraction (moved to utils/columnar so the text stages
+#: use the same dispatch)
+_column_values = columnar.extract_column_values
 
 
 class VectorAssembler(HasOutputCol, Transformer):

@@ -1,0 +1,203 @@
+"""Text features — Tokenizer, HashingTF, IDF (pyspark.ml's classic trio).
+
+The stages that turn raw strings into the numeric arrays every estimator
+here consumes, mirroring Spark's surface (divergences documented per
+stage):
+
+- Tokenizer: lowercase + whitespace split. Divergence from Spark's
+  ``split("\\s")``: runs of whitespace collapse here (Spark emits empty
+  tokens for consecutive separators — an artifact most users regex away;
+  documented rather than reproduced);
+- HashingTF: the hashing trick onto ``numFeatures`` buckets (term
+  frequency counts, or ``binary`` presence flags — Spark's params).
+  Bucket assignment is an md5-derived stable hash, NOT Spark's Murmur3,
+  so vectors are internally consistent and deterministic across
+  processes but not bucket-identical to a JVM run (documented trade; the
+  downstream math is invariant to the permutation). Output columns here
+  are DENSE arrays (this package's columnar layer), so sizing differs
+  from Spark's sparse vectors: a guard rejects transforms whose dense
+  output would exceed ~2 GB and points at ``setNumFeatures``;
+- IDF: log((N+1)/(df+1)) (Spark's exact formula) from a DOCUMENT-
+  FREQUENCY monoid pass (per-partition presence-count sums — the same
+  tree/psum reduction shape as every statistics pass in this package),
+  with ``minDocFreq`` zeroing rare terms like Spark.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model, Transformer
+from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
+from spark_rapids_ml_tpu.utils import columnar
+
+
+def _string_column(dataset: Any, col: str) -> list:
+    """Raw values of a string/token column (the shared columnar dispatch;
+    token arrays come back as lists/ndarrays of strings)."""
+    try:
+        import pyarrow as pa
+    except ImportError:  # pragma: no cover
+        pa = None
+    if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
+        return dataset.column(col).to_pylist()
+    return list(columnar.extract_column_values(dataset, col))
+
+
+def _bucket(term: str, num_features: int) -> int:
+    """Stable non-negative term bucket (md5-derived — deterministic across
+    processes and Python runs, unlike built-in str hashing)."""
+    digest = hashlib.md5(term.encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "little") % num_features
+
+
+class Tokenizer(HasInputCol, HasOutputCol, Transformer):
+    """Lowercase + whitespace split (pyspark.ml.feature.Tokenizer)."""
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(outputCol="tokens")
+
+    def transform(self, dataset: Any) -> Any:
+        texts = _string_column(dataset, self.getOrDefault("inputCol"))
+        tokens = [str(t).lower().split() for t in texts]
+        return columnar.append_columns(
+            dataset, [(self.getOutputCol(), np.asarray(tokens, dtype=object))]
+        )
+
+
+class HashingTF(HasInputCol, HasOutputCol, Transformer):
+    numFeatures = Param("numFeatures", "hash bucket count", int)
+    binary = Param(
+        "binary", "presence flags instead of term counts", bool
+    )
+
+    #: dense-output guard: reject transforms whose [docs, numFeatures]
+    #: float64 matrix would exceed this (the columnar layer is dense —
+    #: Spark's sparse vectors don't pay this; lower numFeatures instead)
+    _MAX_DENSE_BYTES = 2 << 30
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            numFeatures=1 << 18, binary=False, outputCol="tf_features"
+        )
+
+    def setNumFeatures(self, value: int) -> "HashingTF":
+        if value < 1:
+            raise ValueError(f"numFeatures must be >= 1, got {value}")
+        return self._set(numFeatures=value)
+
+    def getNumFeatures(self) -> int:
+        return self.getOrDefault("numFeatures")
+
+    def setBinary(self, value: bool) -> "HashingTF":
+        return self._set(binary=bool(value))
+
+    def transform(self, dataset: Any) -> Any:
+        docs = _string_column(dataset, self.getOrDefault("inputCol"))
+        nf = self.getNumFeatures()
+        binary = self.getOrDefault("binary")
+        need = len(docs) * nf * 8
+        if need > self._MAX_DENSE_BYTES:
+            raise ValueError(
+                f"HashingTF dense output would be {need / 2**30:.1f} GiB "
+                f"({len(docs)} docs x numFeatures={nf}); this package's "
+                "columnar layer is dense — lower setNumFeatures (e.g. "
+                "1<<14) for large corpora"
+            )
+        out = np.zeros((len(docs), nf), dtype=np.float64)
+        for i, doc in enumerate(docs):
+            if isinstance(doc, str):
+                raise TypeError(
+                    f"HashingTF input column holds raw strings, not token "
+                    f"arrays — run Tokenizer first (got {doc[:30]!r})"
+                )
+            for term in doc:
+                j = _bucket(str(term), nf)
+                if binary:
+                    out[i, j] = 1.0
+                else:
+                    out[i, j] += 1.0
+        return columnar.append_columns(dataset, [(self.getOutputCol(), out)])
+
+
+class IDF(HasInputCol, HasOutputCol, Estimator):
+    minDocFreq = Param(
+        "minDocFreq", "terms in fewer documents get IDF 0 (Spark)", int
+    )
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(minDocFreq=0, outputCol="tfidf_features")
+
+    def setMinDocFreq(self, value: int) -> "IDF":
+        if value < 0:
+            raise ValueError(f"minDocFreq must be >= 0, got {value}")
+        return self._set(minDocFreq=value)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None) -> "IDFModel":
+        ds = columnar.PartitionedDataset.from_any(
+            dataset, self._paramMap.get("inputCol"), num_partitions
+        )
+        # document-frequency monoid: per-partition presence-count sums
+        df = None
+        n_docs = 0
+        for mat in ds.matrices():
+            part = (mat > 0).sum(axis=0).astype(np.float64)
+            df = part if df is None else df + part
+            n_docs += mat.shape[0]
+        idf = np.log((n_docs + 1.0) / (df + 1.0))  # Spark's exact formula
+        idf = np.where(df >= self.getOrDefault("minDocFreq"), idf, 0.0)
+        model = IDFModel(uid=self.uid, idf=idf, docFreq=df, numDocs=n_docs)
+        return self._copyValues(model)
+
+
+class IDFModel(HasInputCol, HasOutputCol, Model):
+    minDocFreq = IDF.minDocFreq
+
+    def __init__(
+        self,
+        uid: str | None = None,
+        idf: np.ndarray | None = None,
+        docFreq: np.ndarray | None = None,
+        numDocs: int = 0,
+    ):
+        super().__init__(uid)
+        self.idf = None if idf is None else np.asarray(idf)
+        self.docFreq = None if docFreq is None else np.asarray(docFreq)
+        self.numDocs = int(numDocs)
+        self._setDefault(minDocFreq=0, outputCol="tfidf_features")
+
+    def _scale(self, mat: np.ndarray) -> np.ndarray:
+        if mat.shape[1] != self.idf.shape[0]:
+            raise ValueError(
+                f"input has {mat.shape[1]} features but the model was "
+                f"fitted on {self.idf.shape[0]}"
+            )
+        return mat * self.idf[None, :]
+
+    def transform(self, dataset: Any) -> Any:
+        return columnar.apply_column_transform(
+            dataset,
+            self._paramMap.get("inputCol"),
+            self.getOutputCol(),
+            self._scale,
+        )
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {
+            "idf": self.idf,
+            "docFreq": self.docFreq,
+            "numDocs": np.asarray([self.numDocs]),
+        }
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(
+            uid=uid, idf=data["idf"], docFreq=data["docFreq"],
+            numDocs=int(data["numDocs"][0]),
+        )

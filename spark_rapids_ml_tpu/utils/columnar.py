@@ -277,6 +277,36 @@ def has_named_columns(dataset: Any) -> bool:
     return hasattr(dataset, "columns") and hasattr(dataset, "assign")
 
 
+def extract_column_values(dataset: Any, col: str) -> np.ndarray:
+    """A column as a 1-D string/float array, or a 2-D float matrix for
+    array-valued columns — numeric shapes ride the zero-copy extractors;
+    only genuinely-string columns take the Python-object path. Shared by
+    the feature-engineering and text stages."""
+    if pa is not None and isinstance(dataset, (pa.Table, pa.RecordBatch)):
+        typ = dataset.schema.field(col).type
+        if pa.types.is_list(typ) or pa.types.is_fixed_size_list(typ):
+            return extract_matrix(dataset, col)
+        if pa.types.is_string(typ) or pa.types.is_large_string(typ):
+            return np.asarray(dataset.column(col).to_pylist())
+        return extract_vector(dataset, col)
+    if hasattr(dataset, "columns") and hasattr(dataset, "__getitem__"):
+        series = dataset[col]
+        first = series.iloc[0] if len(series) else None
+        if isinstance(first, (list, tuple, np.ndarray)):
+            return extract_matrix(dataset, col)
+        arr = (
+            series.to_numpy()
+            if hasattr(series, "to_numpy")
+            else np.asarray(series)
+        )
+        if np.issubdtype(arr.dtype, np.number):
+            return extract_vector(dataset, col)
+        return arr
+    raise TypeError(
+        f"cannot extract column {col!r} from {type(dataset).__name__}"
+    )
+
+
 def extract_vector(data: Any, col: str) -> np.ndarray:
     """Extract a scalar column (labels) as a [rows] float vector."""
     if pa is not None and isinstance(data, (pa.Table, pa.RecordBatch)):

@@ -1,0 +1,111 @@
+"""Tokenizer / HashingTF / IDF — Spark's text trio on host containers."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.feature import IDF, HashingTF, IDFModel, Tokenizer
+
+pd = pytest.importorskip("pandas")
+
+
+@pytest.fixture()
+def docs():
+    return pd.DataFrame(
+        {
+            "text": [
+                "TPU kernels are Fast",
+                "fast kernels fast pipelines",
+                "spark pipelines on tpu",
+            ]
+        }
+    )
+
+
+def test_tokenizer_lowercases_and_splits(docs):
+    out = Tokenizer().setInputCol("text").setOutputCol("words").transform(docs)
+    assert list(out["words"][0]) == ["tpu", "kernels", "are", "fast"]
+    assert list(out["words"][1]) == ["fast", "kernels", "fast", "pipelines"]
+
+
+def test_hashing_tf_counts_and_binary(docs):
+    words = Tokenizer().setInputCol("text").setOutputCol("words").transform(docs)
+    tf = (
+        HashingTF().setInputCol("words").setOutputCol("tf")
+        .setNumFeatures(64).transform(words)
+    )
+    mat = np.stack(tf["tf"])
+    assert mat.shape == (3, 64)
+    # doc 1 has 'fast' twice → some bucket holds 2; counts sum to token counts
+    np.testing.assert_array_equal(mat.sum(1), [4, 4, 4])
+    assert mat[1].max() == 2.0
+    binary = (
+        HashingTF().setInputCol("words").setOutputCol("tf")
+        .setNumFeatures(64).setBinary(True).transform(words)
+    )
+    assert np.stack(binary["tf"])[1].max() == 1.0
+
+
+def test_idf_matches_spark_formula(docs):
+    words = Tokenizer().setInputCol("text").setOutputCol("words").transform(docs)
+    tf = (
+        HashingTF().setInputCol("words").setOutputCol("tf")
+        .setNumFeatures(32).transform(words)
+    )
+    model = IDF().setInputCol("tf").setOutputCol("tfidf").fit(tf)
+    mat = np.stack(tf["tf"])
+    df = (mat > 0).sum(0)
+    np.testing.assert_allclose(model.idf, np.log((3 + 1) / (df + 1)))
+    out = model.transform(tf)
+    np.testing.assert_allclose(
+        np.stack(out["tfidf"]), mat * model.idf[None, :]
+    )
+    assert model.numDocs == 3
+
+
+def test_idf_min_doc_freq_and_partition_invariance(docs):
+    words = Tokenizer().setInputCol("text").setOutputCol("words").transform(docs)
+    tf = (
+        HashingTF().setInputCol("words").setOutputCol("tf")
+        .setNumFeatures(32).transform(words)
+    )
+    mat = np.stack(tf["tf"])
+    m = IDF().setMinDocFreq(2).setInputCol("tf").fit(tf)
+    df = (mat > 0).sum(0)
+    assert (m.idf[df < 2] == 0).all()
+    assert (m.idf[df >= 2] != 0).all()
+    # monoid: partition count cannot change the model
+    m4 = IDF().setMinDocFreq(2).fit(mat)
+    m1 = IDF().setMinDocFreq(2).fit(mat, num_partitions=3)
+    np.testing.assert_allclose(m4.idf, m1.idf)
+
+
+def test_text_pipeline_and_persistence(tmp_path, docs):
+    from spark_rapids_ml_tpu.models.pipeline import Pipeline
+
+    pipe = Pipeline(
+        stages=[
+            Tokenizer().setInputCol("text").setOutputCol("words"),
+            HashingTF().setInputCol("words").setOutputCol("tf").setNumFeatures(64),
+            IDF().setInputCol("tf").setOutputCol("tfidf"),
+        ]
+    )
+    model = pipe.fit(docs)
+    out = model.transform(docs)
+    assert np.stack(out["tfidf"]).shape == (3, 64)
+    idf_model = model.stages[-1]
+    idf_model.save(str(tmp_path / "idf"))
+    loaded = IDFModel.load(str(tmp_path / "idf"))
+    np.testing.assert_allclose(loaded.idf, idf_model.idf)
+
+
+def test_guards_and_defaults(docs):
+    # default output columns exist (the package-wide contract)
+    out = Tokenizer().setInputCol("text").transform(docs)
+    assert "tokens" in out.columns
+    # raw-string input (forgot the Tokenizer) raises instead of hashing chars
+    with pytest.raises(TypeError, match="run Tokenizer first"):
+        HashingTF().setInputCol("text").setNumFeatures(8).transform(docs)
+    # dense-output guard names the knob
+    big = pd.DataFrame({"w": [["a"]] * 20000})
+    with pytest.raises(ValueError, match="setNumFeatures"):
+        HashingTF().setInputCol("w").setNumFeatures(1 << 18).transform(big)
