@@ -18,6 +18,10 @@ from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_rapids_ml_tpu.models.fm import (  # noqa: F401
+    FMClassificationModel,
+    FMClassifier,
+)
 from spark_rapids_ml_tpu.models.mlp import (  # noqa: F401
     MultilayerPerceptronClassificationModel,
     MultilayerPerceptronClassifier,
@@ -34,6 +38,8 @@ from spark_rapids_ml_tpu.models.ovr import (  # noqa: F401
 __all__ = [
     "DecisionTreeClassifier",
     "DecisionTreeClassificationModel",
+    "FMClassifier",
+    "FMClassificationModel",
     "GBTClassifier",
     "GBTClassificationModel",
     "LinearSVC",

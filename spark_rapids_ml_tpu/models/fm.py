@@ -1,0 +1,451 @@
+"""FMRegressor / FMClassifier — pyspark.ml's factorization machines.
+
+The degree-2 FM score (Rendle 2010, the formulation Spark implements):
+
+    ŷ(x) = b + wᵀx + ½ Σ_f [ (Σ_i v_{if} x_i)² − Σ_i v_{if}² x_i² ]
+
+— the pairwise-interaction term is two matmuls via the (Σvx)² − Σ(vx)²
+identity, which is exactly the MXU-friendly recast that makes FMs a
+natural fit here. Training mirrors the MLP module's shape: the WHOLE
+optimization (Spark's adamW default or gd) runs as one
+``lax.while_loop`` XLA program over the full-batch loss — squared for
+the regressor, logistic for the classifier — with ``regParam`` applied
+as DECOUPLED weight decay under adamW (Spark's semantics) or loss-side
+L2 under gd, plus ``factorSize``, ``fitIntercept``/``fitLinear``, and
+``initStd`` matching Spark's param surface. (Spark additionally offers
+``miniBatchFraction``; full batch — its default 1.0 — is the one mode
+here, documented.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_ml_tpu.models.base import Estimator, Model
+from spark_rapids_ml_tpu.models.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    Param,
+)
+from spark_rapids_ml_tpu.ops.linalg import DEFAULT_PRECISION
+from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+_SOLVERS = ("adamW", "gd")
+
+
+def _split(flat, n_feat: int, k: int):
+    """flat = [b, w (n), V (n·k)] — Spark's layout order reversed for
+    convenience; the model re-exposes the pieces by name."""
+    b = flat[0]
+    w = flat[1 : 1 + n_feat]
+    v = flat[1 + n_feat :].reshape(n_feat, k)
+    return b, w, v
+
+
+def fm_score(flat, x, *, n_feat: int, k: int, precision=DEFAULT_PRECISION):
+    """[rows] FM scores via the two-matmul interaction identity."""
+    b, w, v = _split(flat, n_feat, k)
+    linear = jnp.matmul(x, w, precision=precision)
+    xv = jnp.matmul(x, v, precision=precision)  # [rows, k]
+    x2v2 = jnp.matmul(x * x, v * v, precision=precision)
+    inter = 0.5 * jnp.sum(xv * xv - x2v2, axis=1)
+    return b + linear + inter
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_feat", "k", "solver", "max_iter", "classification",
+        "fit_intercept", "fit_linear",
+    ),
+)
+def train_fm(
+    flat0,
+    x,
+    y,
+    w,
+    *,
+    n_feat: int,
+    k: int,
+    solver: str,
+    max_iter: int,
+    classification: bool,
+    fit_intercept: bool,
+    fit_linear: bool,
+    step_size: float = 1.0,
+    reg_param: float = 0.0,
+    tol: float = 1e-6,
+):
+    """Full-batch FM training as one XLA program → (flat, loss, iters)."""
+    import optax
+
+    w_sum = jnp.maximum(jnp.sum(w), 1.0)
+    # mask freezes disabled parameter groups at zero (Spark's
+    # fitIntercept/fitLinear switches)
+    mask = jnp.concatenate(
+        [
+            jnp.asarray([1.0 if fit_intercept else 0.0], flat0.dtype),
+            jnp.full((n_feat,), 1.0 if fit_linear else 0.0, flat0.dtype),
+            jnp.ones((n_feat * k,), flat0.dtype),
+        ]
+    )
+
+    # Spark's adamW semantics: regParam is DECOUPLED weight decay (the
+    # thing AdamW exists for), never an L2 term routed through Adam's
+    # moment normalization; 'gd' keeps the equivalent loss-side L2.
+    # Frozen parameter groups sit at exactly 0, so decay is a no-op there.
+    l2_in_loss = reg_param if solver == "gd" else 0.0
+
+    def loss_fn(flat):
+        s = fm_score(flat * mask, x, n_feat=n_feat, k=k)
+        if classification:
+            yy = 2.0 * y - 1.0  # logistic loss on ±1
+            data = jnp.sum(w * jnp.logaddexp(0.0, -yy * s)) / w_sum
+        else:
+            data = jnp.sum(w * (y - s) ** 2) / w_sum
+        return data + l2_in_loss * jnp.sum((flat * mask) ** 2)
+
+    opt = (
+        optax.adamw(step_size, weight_decay=reg_param)
+        if solver == "adamW"
+        else optax.sgd(step_size)
+    )
+
+    def cond(carry):
+        _, _, it, prev, cur = carry
+        return (it < max_iter) & (jnp.abs(prev - cur) > tol)
+
+    def body(carry):
+        flat, state, it, _, cur = carry
+        value, grad = jax.value_and_grad(loss_fn)(flat)
+        updates, state = opt.update(grad * mask, state, flat)
+        flat = optax.apply_updates(flat, updates) * mask
+        return flat, state, it + 1, value, loss_fn(flat)
+
+    state0 = opt.init(flat0)
+    inf = jnp.asarray(jnp.inf, flat0.dtype)
+    flat, _, it, _, loss = jax.lax.while_loop(
+        cond, body, (flat0 * mask, state0, jnp.int32(0), inf, loss_fn(flat0))
+    )
+    return flat, loss, it
+
+
+class _FMParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
+    factorSize = Param("factorSize", "latent factor dimension k", int)
+    fitIntercept = Param("fitIntercept", "fit the global bias", bool)
+    fitLinear = Param("fitLinear", "fit the 1-way (linear) term", bool)
+    regParam = Param("regParam", "L2 regularization", float)
+    maxIter = Param("maxIter", "maximum optimizer iterations", int)
+    stepSize = Param("stepSize", "optimizer learning rate", float)
+    tol = Param("tol", "convergence tolerance on the loss decrease", float)
+    solver = Param("solver", "'adamW' (default, Spark's) or 'gd'", str)
+    initStd = Param("initStd", "factor-init standard deviation", float)
+    seed = Param("seed", "factor-initialization seed", int)
+
+    def __init__(self, uid: str | None = None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            featuresCol="features", labelCol="label",
+            predictionCol="prediction",
+            factorSize=8, fitIntercept=True, fitLinear=True, regParam=0.0,
+            maxIter=100, stepSize=1.0, tol=1e-6, solver="adamW",
+            initStd=0.01, seed=0,
+        )
+
+    def getFactorSize(self) -> int:
+        return self.getOrDefault("factorSize")
+
+
+class _FMEstimator(_FMParams, Estimator):
+    _classification: bool
+
+    def setFactorSize(self, value: int):
+        if value < 1:
+            raise ValueError(f"factorSize must be >= 1, got {value}")
+        return self._set(factorSize=value)
+
+    def setFitIntercept(self, value: bool):
+        return self._set(fitIntercept=bool(value))
+
+    def setFitLinear(self, value: bool):
+        return self._set(fitLinear=bool(value))
+
+    def setRegParam(self, value: float):
+        if value < 0:
+            raise ValueError(f"regParam must be >= 0, got {value}")
+        return self._set(regParam=float(value))
+
+    def setMaxIter(self, value: int):
+        return self._set(maxIter=value)
+
+    def setStepSize(self, value: float):
+        if value <= 0:
+            raise ValueError(f"stepSize must be > 0, got {value}")
+        return self._set(stepSize=float(value))
+
+    def setTol(self, value: float):
+        return self._set(tol=float(value))
+
+    def setSolver(self, value: str):
+        if value not in _SOLVERS:
+            raise ValueError(f"solver must be one of {_SOLVERS}, got {value!r}")
+        return self._set(solver=value)
+
+    def setInitStd(self, value: float):
+        if value <= 0:
+            raise ValueError(f"initStd must be > 0, got {value}")
+        return self._set(initStd=float(value))
+
+    def setSeed(self, value: int):
+        return self._set(seed=value)
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        """``num_partitions`` accepted for signature uniformity; training
+        is one full-batch XLA program."""
+        parts = columnar.labeled_partitions(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("labelCol"),
+            None,
+            weight_col=None,
+        )
+        x = np.concatenate([p[0] for p in parts])
+        y = np.concatenate([p[1] for p in parts])
+        w = (
+            np.concatenate([p[2] for p in parts])
+            if parts[0][2] is not None
+            else None
+        )
+        if self._classification:
+            classes = np.unique(y)
+            if not np.all(np.isin(classes, (0.0, 1.0))):
+                raise ValueError(
+                    f"FMClassifier requires binary 0/1 labels, got {classes[:8]}"
+                )
+        n_feat = x.shape[1]
+        k = self.getFactorSize()
+        padded, yv, wv, _ = columnar.pad_labeled_batch(x, y, w)
+        fdt = padded.dtype
+
+        key = jax.random.PRNGKey(self.getOrDefault("seed"))
+        flat0 = jnp.concatenate(
+            [
+                jnp.zeros((1 + n_feat,), fdt),
+                self.getOrDefault("initStd")
+                * jax.random.normal(key, (n_feat * k,), fdt),
+            ]
+        )
+        with trace_range("fm train"):
+            flat, loss, it = train_fm(
+                flat0,
+                jnp.asarray(padded),
+                jnp.asarray(yv),
+                jnp.asarray(wv),
+                n_feat=n_feat,
+                k=k,
+                solver=self.getOrDefault("solver"),
+                max_iter=self.getOrDefault("maxIter"),
+                classification=self._classification,
+                fit_intercept=self.getOrDefault("fitIntercept"),
+                fit_linear=self.getOrDefault("fitLinear"),
+                step_size=self.getOrDefault("stepSize"),
+                reg_param=self.getOrDefault("regParam"),
+                tol=self.getOrDefault("tol"),
+            )
+        weights = np.asarray(flat)
+        if not np.isfinite(weights).all():
+            raise ValueError(
+                "FM training diverged to non-finite weights; lower stepSize"
+            )
+        model = self._model_cls(
+            uid=self.uid, flatWeights=weights, numFeatures=n_feat,
+            trainLoss=float(loss), iterations=int(it),
+        )
+        return self._copyValues(model)
+
+
+class _FMModel(_FMParams, Model):
+    def __init__(
+        self,
+        uid: str | None = None,
+        flatWeights: np.ndarray | None = None,
+        numFeatures: int = 0,
+        trainLoss: float = float("nan"),
+        iterations: int = 0,
+    ):
+        super().__init__(uid)
+        self.flatWeights = (
+            None if flatWeights is None else np.asarray(flatWeights)
+        )
+        self._num_features = int(numFeatures)
+        self.trainLoss = float(trainLoss)
+        self.iterations = int(iterations)
+
+    @property
+    def numFeatures(self) -> int:
+        return self._num_features
+
+    @property
+    def intercept(self) -> float:
+        return float(self.flatWeights[0])
+
+    @property
+    def linear(self) -> np.ndarray:
+        return self.flatWeights[1 : 1 + self._num_features]
+
+    @property
+    def factors(self) -> np.ndarray:
+        k = self.getFactorSize()
+        return self.flatWeights[1 + self._num_features :].reshape(
+            self._num_features, k
+        )
+
+    def _scores(self, mat: np.ndarray) -> np.ndarray:
+        if mat.shape[1] != self._num_features:
+            raise ValueError(
+                f"input has {mat.shape[1]} features but the model was "
+                f"fitted on {self._num_features}"
+            )
+        fdt = columnar.float_dtype_for(mat.dtype)
+        padded, true_rows = columnar.pad_rows(mat.astype(fdt, copy=False))
+        out = _fm_score_jitted(
+            jnp.asarray(self.flatWeights.astype(fdt)),
+            jnp.asarray(padded),
+            n_feat=self._num_features,
+            k=self.getFactorSize(),
+        )
+        return np.asarray(out)[:true_rows]
+
+    def _saveData(self) -> dict[str, np.ndarray]:
+        return {
+            "flatWeights": self.flatWeights,
+            "meta": np.asarray(
+                [
+                    float(self._num_features),
+                    self.trainLoss,
+                    float(self.iterations),
+                ]
+            ),
+        }
+
+    @classmethod
+    def _fromSaved(cls, uid, data):
+        return cls(
+            uid=uid,
+            flatWeights=data["flatWeights"],
+            numFeatures=int(data["meta"][0]),
+            trainLoss=float(data["meta"][1]),
+            iterations=int(data["meta"][2]),
+        )
+
+
+#: module-level jit: jax caches compilations per (shape, static args)
+_fm_score_jitted = jax.jit(fm_score, static_argnames=("n_feat", "k"))
+
+
+class FMRegressor(_FMEstimator):
+    _classification = False
+
+    @property
+    def _model_cls(self):
+        return FMRegressionModel
+
+
+class FMRegressionModel(_FMModel):
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        return self._scores(mat)
+
+    def transform(self, dataset: Any) -> Any:
+        return columnar.apply_column_transform(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("predictionCol"),
+            self._predict_matrix,
+        )
+
+    def predict(self, row) -> float:
+        return float(
+            self._predict_matrix(np.asarray(row, dtype=np.float64)[None, :])[0]
+        )
+
+
+class _FMClassifierCols:
+    probabilityCol = Param("probabilityCol", "class-probability column", str)
+    rawPredictionCol = Param(
+        "rawPredictionCol", "margin column [−s, s]", str
+    )
+
+    def __init__(self, uid=None, **kwargs):
+        super().__init__(uid, **kwargs)
+        self._setDefault(
+            probabilityCol="probability", rawPredictionCol="rawPrediction"
+        )
+
+    def setProbabilityCol(self, value: str):
+        return self._set(probabilityCol=value)
+
+    def setRawPredictionCol(self, value: str):
+        return self._set(rawPredictionCol=value)
+
+
+class FMClassifier(_FMClassifierCols, _FMEstimator):
+    _classification = True
+
+    @property
+    def _model_cls(self):
+        return FMClassificationModel
+
+
+class FMClassificationModel(_FMClassifierCols, _FMModel):
+    @property
+    def numClasses(self) -> int:
+        return 2
+
+    @staticmethod
+    def _outputs_from_scores(s: np.ndarray):
+        """THE decision rule in one place: (proba [rows, 2], preds)."""
+        p1 = 1.0 / (1.0 + np.exp(-s))
+        return np.stack([1.0 - p1, p1], axis=1), (s > 0).astype(np.float64)
+
+    def proba_and_predictions(self, mat: np.ndarray):
+        return self._outputs_from_scores(self._scores(mat))
+
+    def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
+        return self._outputs_from_scores(self._scores(mat))[1]
+
+    def transform(self, dataset: Any) -> Any:
+        if columnar.has_named_columns(dataset):
+            mat = columnar.extract_matrix(
+                dataset, self.getOrDefault("featuresCol")
+            )
+            s = self._scores(mat)
+            proba, preds = self._outputs_from_scores(s)
+            return columnar.append_columns(
+                dataset,
+                [
+                    (
+                        self.getOrDefault("rawPredictionCol"),
+                        np.stack([-s, s], axis=1),
+                    ),
+                    (self.getOrDefault("probabilityCol"), proba),
+                    (self.getOrDefault("predictionCol"), preds),
+                ],
+            )
+        return columnar.apply_column_transform(
+            dataset,
+            self.getOrDefault("featuresCol"),
+            self.getOrDefault("predictionCol"),
+            self._predict_matrix,
+        )
+
+    def predict(self, row) -> float:
+        return float(
+            self._predict_matrix(np.asarray(row, dtype=np.float64)[None, :])[0]
+        )

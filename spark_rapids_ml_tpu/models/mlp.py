@@ -236,12 +236,8 @@ class MultilayerPerceptronClassifier(_MLPParams, Estimator):
                 f"labels imply {int(classes.max()) + 1} classes but "
                 f"layers[-1]={layers[-1]}"
             )
-        fdt = columnar.float_dtype_for(x.dtype)
-        padded, true_rows = columnar.pad_rows(x.astype(fdt, copy=False))
-        wv = np.zeros(padded.shape[0], fdt)
-        wv[:true_rows] = 1.0 if w is None else w
-        yv = np.zeros(padded.shape[0], fdt)
-        yv[:true_rows] = y
+        padded, yv, wv, _ = columnar.pad_labeled_batch(x, y, w)
+        fdt = padded.dtype
 
         # Glorot-uniform init, deterministic by seed
         key = jax.random.PRNGKey(self.getOrDefault("seed"))

@@ -8,6 +8,10 @@ from spark_rapids_ml_tpu.models.forest import (  # noqa: F401
     RandomForestRegressionModel,
     RandomForestRegressor,
 )
+from spark_rapids_ml_tpu.models.fm import (  # noqa: F401
+    FMRegressionModel,
+    FMRegressor,
+)
 from spark_rapids_ml_tpu.models.gbt import (  # noqa: F401
     GBTRegressionModel,
     GBTRegressor,
@@ -24,6 +28,8 @@ from spark_rapids_ml_tpu.models.linear import (  # noqa: F401
 __all__ = [
     "DecisionTreeRegressor",
     "DecisionTreeRegressionModel",
+    "FMRegressor",
+    "FMRegressionModel",
     "GBTRegressor",
     "GBTRegressionModel",
     "IsotonicRegression",

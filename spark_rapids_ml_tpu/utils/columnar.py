@@ -460,6 +460,20 @@ def pad_labeled(
 # ---------------------------------------------------------------------------
 
 
+def pad_labeled_batch(x, y, w=None):
+    """(padded_x, yv, wv, true_rows): the full-batch trainer marshalling —
+    row-bucketed X with a label vector and a pad-masking weight vector
+    (instance weights on true rows, 0.0 on padding). Shared by every
+    optimizer that trains on one concatenated batch (MLP, FM, ...)."""
+    fdt = float_dtype_for(x.dtype)
+    padded, true_rows = pad_rows(np.asarray(x).astype(fdt, copy=False))
+    wv = np.zeros(padded.shape[0], fdt)
+    wv[:true_rows] = 1.0 if w is None else w
+    yv = np.zeros(padded.shape[0], fdt)
+    yv[:true_rows] = y
+    return padded, yv, wv, true_rows
+
+
 def bucket_rows(rows: int, *, min_bucket: int | None = None) -> int:
     """Round a row count up to the next power-of-two bucket.
 

@@ -1,0 +1,102 @@
+"""Factorization machines — the pairwise-interaction oracle: data whose
+signal is PURE x_i·x_j products, where any linear model is at chance.
+FM's own generative form is the differential (sklearn has no FM)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.classification import FMClassificationModel, FMClassifier
+from spark_rapids_ml_tpu.regression import FMRegressionModel, FMRegressor
+
+
+@pytest.fixture(scope="module")
+def interaction_reg():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2000, 6))
+    y = 2.0 * x[:, 0] * x[:, 1] - 1.5 * x[:, 2] * x[:, 4] + 0.1 * rng.normal(
+        size=2000
+    )
+    return x[:1500], y[:1500], x[1500:], y[1500:]
+
+
+@pytest.fixture(scope="module")
+def interaction_clf():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2000, 4))
+    y = ((x[:, 0] * x[:, 1] + 0.5 * x[:, 2] * x[:, 3]) > 0).astype(float)
+    return x[:1500], y[:1500], x[1500:], y[1500:]
+
+
+def test_regressor_captures_interactions(interaction_reg):
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    xtr, ytr, xte, yte = interaction_reg
+    fm = (
+        FMRegressor().setFactorSize(4).setMaxIter(800).setStepSize(0.05)
+        .setSeed(2).fit((xtr, ytr))
+    )
+    pred = fm._predict_matrix(xte)
+    r2 = 1 - ((pred - yte) ** 2).mean() / yte.var()
+    assert r2 > 0.9, r2
+    # the linear baseline is at chance on pure interactions
+    lin = LinearRegression().fit((xtr, ytr))
+    lin_r2 = 1 - ((lin._predict_matrix(xte) - yte) ** 2).mean() / yte.var()
+    assert lin_r2 < 0.1, lin_r2
+
+
+def test_classifier_captures_interactions(interaction_clf):
+    xtr, ytr, xte, yte = interaction_clf
+    fm = (
+        FMClassifier().setFactorSize(4).setMaxIter(600).setStepSize(0.05)
+        .setSeed(3).fit((xtr, ytr))
+    )
+    acc = (fm._predict_matrix(xte) == yte).mean()
+    assert acc > 0.9, acc  # logistic regression caps near 0.5 here
+
+
+def test_fit_linear_and_intercept_switches(interaction_reg):
+    xtr, ytr, _, _ = interaction_reg
+    m = (
+        FMRegressor().setFitLinear(False).setFitIntercept(False)
+        .setMaxIter(50).fit((xtr, ytr))
+    )
+    assert m.intercept == 0.0
+    assert (m.linear == 0.0).all()
+    assert m.factors.shape == (6, 8)
+
+
+def test_columns_determinism_validation(interaction_clf):
+    pd = pytest.importorskip("pandas")
+    xtr, ytr, _, _ = interaction_clf
+    kw = dict(maxIter=80, seed=5, stepSize=0.05)
+    m1 = FMClassifier(**kw).fit((xtr, ytr))
+    m2 = FMClassifier(**kw).fit((xtr, ytr))
+    np.testing.assert_array_equal(m1.flatWeights, m2.flatWeights)
+    out = m1.transform(pd.DataFrame({"features": list(xtr[:20])}))
+    assert {"rawPrediction", "probability", "prediction"} <= set(out.columns)
+    raw = np.stack(out["rawPrediction"])
+    p = np.stack(out["probability"])
+    np.testing.assert_allclose(p[:, 1], 1 / (1 + np.exp(-raw[:, 1])), rtol=1e-9)
+    with pytest.raises(ValueError, match="binary 0/1"):
+        FMClassifier().fit((xtr, np.arange(len(xtr), dtype=float)))
+    with pytest.raises(ValueError, match="solver"):
+        FMRegressor().setSolver("lbfgs")
+
+
+def test_persistence_roundtrip(tmp_path, interaction_reg, interaction_clf):
+    xtr, ytr, xte, _ = interaction_reg
+    m = FMRegressor().setFactorSize(3).setMaxIter(60).fit((xtr, ytr))
+    m.save(str(tmp_path / "fmr"))
+    loaded = FMRegressionModel.load(str(tmp_path / "fmr"))
+    assert loaded.getFactorSize() == 3 and loaded.numFeatures == 6
+    np.testing.assert_allclose(
+        loaded._predict_matrix(xte), m._predict_matrix(xte)
+    )
+
+    xc, yc, xq, _ = interaction_clf
+    mc = FMClassifier().setMaxIter(60).fit((xc, yc))
+    mc.save(str(tmp_path / "fmc"))
+    lc = FMClassificationModel.load(str(tmp_path / "fmc"))
+    p0, _ = mc.proba_and_predictions(xq[:40])
+    p1, _ = lc.proba_and_predictions(xq[:40])
+    np.testing.assert_allclose(p0, p1)
