@@ -47,6 +47,21 @@ from spark_rapids_ml_tpu.models.gbt import (
     GBTRegressionModel,
     GBTRegressor,
 )
+from spark_rapids_ml_tpu.models.fm import (
+    FMClassificationModel,
+    FMClassifier,
+    FMRegressionModel,
+    FMRegressor,
+)
+from spark_rapids_ml_tpu.models.isotonic import (
+    IsotonicRegression,
+    IsotonicRegressionModel,
+)
+from spark_rapids_ml_tpu.models.mlp import (
+    MultilayerPerceptronClassificationModel,
+    MultilayerPerceptronClassifier,
+)
+from spark_rapids_ml_tpu.models.naive_bayes import NaiveBayes, NaiveBayesModel
 from spark_rapids_ml_tpu.models.ovr import OneVsRest, OneVsRestModel
 from spark_rapids_ml_tpu.models.neighbors import (
     ApproximateNearestNeighbors,
@@ -2380,7 +2395,6 @@ class SparkRandomForestClassificationModel(RandomForestClassificationModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)
-        T, _ = _sql_mods(dataset)
         model = self
         n_trees = self.trees.feature.shape[0]
 
@@ -2388,25 +2402,9 @@ class SparkRandomForestClassificationModel(RandomForestClassificationModel):
             proba, pred = _m.proba_and_predictions(mat)
             return proba * _t, proba, pred
 
-        fn = arrow_fns.MultiOutputPartitionFn(
-            self.getOrDefault("featuresCol"),
-            [
-                (self.getOrDefault("rawPredictionCol"), np.float64),
-                (self.getOrDefault("probabilityCol"), np.float64),
-                (self.getOrDefault("predictionCol"), np.float64),
-            ],
-            matrix_fn,
+        return _classifier_columns_transform(
+            self, dataset, matrix_fn, "rf transform"
         )
-        with trace_range("rf transform"):
-            return _spark_append(
-                dataset,
-                fn,
-                [
-                    (self.getOrDefault("rawPredictionCol"), T.ArrayType(T.DoubleType())),
-                    (self.getOrDefault("probabilityCol"), T.ArrayType(T.DoubleType())),
-                    (self.getOrDefault("predictionCol"), T.DoubleType()),
-                ],
-            )
 
 
 class SparkRandomForestRegressor(_HasDistribution, RandomForestRegressor):
@@ -2725,7 +2723,6 @@ class SparkGBTClassificationModel(GBTClassificationModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)
-        T, _ = _sql_mods(dataset)
         model = self
 
         def matrix_fn(mat, _m=model):
@@ -2741,31 +2738,9 @@ class SparkGBTClassificationModel(GBTClassificationModel):
                 (F > 0).astype(np.float64),
             )
 
-        fn = arrow_fns.MultiOutputPartitionFn(
-            self.getOrDefault("featuresCol"),
-            [
-                (self.getOrDefault("rawPredictionCol"), np.float64),
-                (self.getOrDefault("probabilityCol"), np.float64),
-                (self.getOrDefault("predictionCol"), np.float64),
-            ],
-            matrix_fn,
+        return _classifier_columns_transform(
+            self, dataset, matrix_fn, "gbt transform"
         )
-        with trace_range("gbt transform"):
-            return _spark_append(
-                dataset,
-                fn,
-                [
-                    (
-                        self.getOrDefault("rawPredictionCol"),
-                        T.ArrayType(T.DoubleType()),
-                    ),
-                    (
-                        self.getOrDefault("probabilityCol"),
-                        T.ArrayType(T.DoubleType()),
-                    ),
-                    (self.getOrDefault("predictionCol"), T.DoubleType()),
-                ],
-            )
 
 
 class SparkGBTRegressor(GBTRegressor):
@@ -2827,6 +2802,199 @@ class SparkOneVsRest(OneVsRest):
 
 
 class SparkOneVsRestModel(OneVsRestModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._predict_matrix,
+            self.getOrDefault("predictionCol"), scalar=True,
+        )
+
+
+def _collect_fit_wrap(est, dataset, wrap, core_fit, *, weighted=True):
+    """The thin supervised-wrapper fit shared by the r5-close families:
+    collect (features, label[, weight]) through the memory-bounded chunker,
+    run the bound core fit on the arrays, re-wrap as the Spark model
+    class."""
+    x, y, w = _collect_xyw(
+        dataset,
+        est.getOrDefault("featuresCol"),
+        label_col=est.getOrDefault("labelCol"),
+        weight_col=(est._paramMap.get("weightCol") if weighted else None),
+    )
+    data = (x, y) if w is None else (x, y, w)
+    return wrap(core_fit(data))
+
+
+def _classifier_columns_transform(model, dataset, matrix_fn, trace_label):
+    """raw/probability/prediction in one mapInArrow pass (the classifier
+    wrapper transform every family shares); ``matrix_fn(mat)`` returns the
+    three arrays in that order."""
+    T, _ = _sql_mods(dataset)
+    fn = arrow_fns.MultiOutputPartitionFn(
+        model.getOrDefault("featuresCol"),
+        [
+            (model.getOrDefault("rawPredictionCol"), np.float64),
+            (model.getOrDefault("probabilityCol"), np.float64),
+            (model.getOrDefault("predictionCol"), np.float64),
+        ],
+        matrix_fn,
+    )
+    with trace_range(trace_label):
+        return _spark_append(
+            dataset,
+            fn,
+            [
+                (
+                    model.getOrDefault("rawPredictionCol"),
+                    T.ArrayType(T.DoubleType()),
+                ),
+                (
+                    model.getOrDefault("probabilityCol"),
+                    T.ArrayType(T.DoubleType()),
+                ),
+                (model.getOrDefault("predictionCol"), T.DoubleType()),
+            ],
+        )
+
+
+class SparkNaiveBayes(NaiveBayes):
+    """NaiveBayes over pyspark DataFrames (collect + core monoid fit; the
+    core estimator's own 'mesh-local' distribution applies unchanged)."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            return self._wrap(super().fit(dataset, num_partitions))
+        return _collect_fit_wrap(self, dataset, self._wrap, super().fit)
+
+    def _wrap(self, core):
+        model = SparkNaiveBayesModel(
+            uid=core.uid, pi=core.pi, theta=core.theta, sigma=core.sigma
+        )
+        return self._copyValues(model)
+
+
+class SparkNaiveBayesModel(NaiveBayesModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        model = self
+
+        def matrix_fn(mat, _m=model):
+            raw = _m._raw_scores(mat)
+            proba, preds = _m._from_raw(raw)
+            return raw, proba, preds
+
+        return _classifier_columns_transform(
+            self, dataset, matrix_fn, "naive bayes transform"
+        )
+
+
+class SparkMultilayerPerceptronClassifier(MultilayerPerceptronClassifier):
+    """MLP over pyspark DataFrames (collect + the one-XLA-program fit)."""
+
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            return self._wrap(super().fit(dataset, num_partitions))
+        return _collect_fit_wrap(self, dataset, self._wrap, super().fit, weighted=False)
+
+    def _wrap(self, core):
+        model = SparkMultilayerPerceptronClassificationModel(
+            uid=core.uid, weights=core.weights,
+            trainLoss=core.trainLoss, iterations=core.iterations,
+        )
+        return self._copyValues(model)
+
+
+class SparkMultilayerPerceptronClassificationModel(
+    MultilayerPerceptronClassificationModel
+):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        model = self
+
+        def matrix_fn(mat, _m=model):
+            logits = _m._logits(mat)
+            proba, preds = _m._from_logits(logits)
+            return logits, proba, preds
+
+        return _classifier_columns_transform(
+            self, dataset, matrix_fn, "mlp transform"
+        )
+
+
+class SparkFMClassifier(FMClassifier):
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            return self._wrap(super().fit(dataset, num_partitions))
+        return _collect_fit_wrap(self, dataset, self._wrap, super().fit, weighted=False)
+
+    def _wrap(self, core):
+        model = SparkFMClassificationModel(
+            uid=core.uid, flatWeights=core.flatWeights,
+            numFeatures=core.numFeatures, trainLoss=core.trainLoss,
+            iterations=core.iterations,
+        )
+        return self._copyValues(model)
+
+
+class SparkFMClassificationModel(FMClassificationModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        model = self
+
+        def matrix_fn(mat, _m=model):
+            s = _m._scores(mat)
+            proba, preds = _m._outputs_from_scores(s)
+            return np.stack([-s, s], axis=1), proba, preds
+
+        return _classifier_columns_transform(
+            self, dataset, matrix_fn, "fm transform"
+        )
+
+
+class SparkFMRegressor(FMRegressor):
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            return self._wrap(super().fit(dataset, num_partitions))
+        return _collect_fit_wrap(self, dataset, self._wrap, super().fit, weighted=False)
+
+    def _wrap(self, core):
+        model = SparkFMRegressionModel(
+            uid=core.uid, flatWeights=core.flatWeights,
+            numFeatures=core.numFeatures, trainLoss=core.trainLoss,
+            iterations=core.iterations,
+        )
+        return self._copyValues(model)
+
+
+class SparkFMRegressionModel(FMRegressionModel):
+    def transform(self, dataset: Any) -> Any:
+        if not _is_spark_df(dataset):
+            return super().transform(dataset)
+        return _spark_transform(
+            self, dataset, self._predict_matrix,
+            self.getOrDefault("predictionCol"), scalar=True,
+        )
+
+
+class SparkIsotonicRegression(IsotonicRegression):
+    def fit(self, dataset: Any, num_partitions: int | None = None):
+        if not _is_spark_df(dataset):
+            return self._wrap(super().fit(dataset, num_partitions))
+        return _collect_fit_wrap(self, dataset, self._wrap, super().fit)
+
+    def _wrap(self, core):
+        model = SparkIsotonicRegressionModel(
+            uid=core.uid, boundaries=core.boundaries,
+            predictions=core.predictions,
+        )
+        return self._copyValues(model)
+
+
+class SparkIsotonicRegressionModel(IsotonicRegressionModel):
     def transform(self, dataset: Any) -> Any:
         if not _is_spark_df(dataset):
             return super().transform(dataset)

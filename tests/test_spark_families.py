@@ -433,3 +433,66 @@ def test_wrapper_upgrade_loads(tmp_path, rng):
     np.testing.assert_array_equal(
         ovr_up._predict_matrix(x[:20]), ovr._predict_matrix(x[:20])
     )
+
+
+def test_spark_close_family_wrappers(spark, rng):
+    """The five r5-close supervised wrappers: DataFrame fit equals the
+    core array fit; classifier transforms emit the three Spark columns."""
+    from spark_rapids_ml_tpu.classification import NaiveBayes
+    from spark_rapids_ml_tpu.spark import (
+        SparkFMRegressor,
+        SparkIsotonicRegression,
+        SparkMultilayerPerceptronClassifier,
+        SparkNaiveBayes,
+    )
+
+    x = np.abs(rng.normal(size=(240, 4))) * 3
+    y = (x[:, 0] > x[:, 1]).astype(float)
+    schema = LT.StructType(
+        [
+            LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+            LT.StructField("label", LT.DoubleType()),
+        ]
+    )
+    df = spark.createDataFrame(
+        [(r.tolist(), float(l)) for r, l in zip(x, y)], schema,
+        numPartitions=3,
+    )
+
+    nb = SparkNaiveBayes().fit(df)
+    core = NaiveBayes().fit((x, y))
+    np.testing.assert_allclose(nb.theta, core.theta, rtol=1e-6)
+    nb_out = nb.transform(df)
+    assert {"rawPrediction", "probability", "prediction"} <= set(
+        nb_out.schema.names
+    )
+    nacc = np.mean(
+        [r["prediction"] == l for r, l in zip(nb_out.collect(), y)]
+    )
+    assert nacc > 0.7, nacc
+
+    mlp = (
+        SparkMultilayerPerceptronClassifier().setLayers([4, 8, 2])
+        .setMaxIter(80).setSeed(1).fit(df)
+    )
+    macc = np.mean(
+        [r["prediction"] == l for r, l in zip(mlp.transform(df).collect(), y)]
+    )
+    assert macc > 0.9, macc
+
+    yr = x[:, 0] * x[:, 1]  # interaction target
+    rdf = spark.createDataFrame(
+        [(r.tolist(), float(v)) for r, v in zip(x, yr)], schema,
+        numPartitions=2,
+    )
+    fm = (
+        SparkFMRegressor().setFactorSize(3).setMaxIter(400)
+        .setStepSize(0.05).fit(rdf)
+    )
+    preds = np.array([r["prediction"] for r in fm.transform(rdf).collect()])
+    r2 = 1 - ((preds - yr) ** 2).mean() / yr.var()
+    assert r2 > 0.85, r2
+
+    iso = SparkIsotonicRegression().fit(rdf)  # monotone-ish in feature 0
+    out = iso.transform(rdf).collect()
+    assert all(np.isfinite(r["prediction"]) for r in out)
