@@ -232,7 +232,7 @@ class _FMEstimator(_FMParams, Estimator):
         n_feat = x.shape[1]
         k = self.getFactorSize()
         padded, yv, wv, _ = columnar.pad_labeled_batch(x, y, w)
-        fdt = padded.dtype
+        fdt = jax.dtypes.canonicalize_dtype(padded.dtype)
 
         key = jax.random.PRNGKey(self.getOrDefault("seed"))
         flat0 = jnp.concatenate(
@@ -411,7 +411,9 @@ class FMClassificationModel(_FMClassifierCols, _FMModel):
     @staticmethod
     def _outputs_from_scores(s: np.ndarray):
         """THE decision rule in one place: (proba [rows, 2], preds)."""
-        p1 = 1.0 / (1.0 + np.exp(-s))
+        from scipy.special import expit  # overflow-free sigmoid
+
+        p1 = expit(s)
         return np.stack([1.0 - p1, p1], axis=1), (s > 0).astype(np.float64)
 
     def proba_and_predictions(self, mat: np.ndarray):

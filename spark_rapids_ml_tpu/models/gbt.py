@@ -129,6 +129,7 @@ class _GBTEstimator(_GBTParams, _ForestEstimator):
         rng = np.random.default_rng(seed)
 
         edges = quantile_bin_edges(x, n_bins, seed, w)
+        fdt = jax.dtypes.canonicalize_dtype(fdt)  # no x64-off warnings
         binned = jnp.asarray(bin_features(x, edges))
         rows = x.shape[0]
         base_w = np.ones(rows, fdt) if w is None else w.astype(fdt)
@@ -367,8 +368,10 @@ class GBTClassificationModel(_GBTClassifierCols, _GBTModel):
         return 2
 
     def proba_and_predictions(self, mat: np.ndarray):
+        from scipy.special import expit  # overflow-free sigmoid
+
         F = self._margins(mat)
-        p1 = 1.0 / (1.0 + np.exp(-2.0 * F))
+        p1 = expit(2.0 * F)
         proba = np.stack([1.0 - p1, p1], axis=1)
         return proba, (F > 0).astype(np.float64)
 
@@ -380,9 +383,11 @@ class GBTClassificationModel(_GBTClassifierCols, _GBTModel):
             mat = columnar.extract_matrix(
                 dataset, self.getOrDefault("featuresCol")
             )
+            from scipy.special import expit
+
             F = self._margins(mat)
             raw = np.stack([-2.0 * F, 2.0 * F], axis=1)
-            p1 = 1.0 / (1.0 + np.exp(-2.0 * F))
+            p1 = expit(2.0 * F)
             proba = np.stack([1.0 - p1, p1], axis=1)
             return columnar.append_columns(
                 dataset,

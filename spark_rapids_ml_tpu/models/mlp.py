@@ -237,7 +237,7 @@ class MultilayerPerceptronClassifier(_MLPParams, Estimator):
                 f"layers[-1]={layers[-1]}"
             )
         padded, yv, wv, _ = columnar.pad_labeled_batch(x, y, w)
-        fdt = padded.dtype
+        fdt = jax.dtypes.canonicalize_dtype(padded.dtype)
 
         # Glorot-uniform init, deterministic by seed
         key = jax.random.PRNGKey(self.getOrDefault("seed"))

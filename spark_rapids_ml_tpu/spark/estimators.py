@@ -2729,8 +2729,10 @@ class SparkGBTClassificationModel(GBTClassificationModel):
             # one margin pass, raw derived directly ([−2F, 2F]) — matching
             # the core transform; a sigmoid round-trip would saturate to
             # ±inf at |F| ≳ 18 where the margin itself stays finite
+            from scipy.special import expit
+
             F = _m._margins(mat)
-            p1 = 1.0 / (1.0 + np.exp(-2.0 * F))
+            p1 = expit(2.0 * F)
             proba = np.stack([1.0 - p1, p1], axis=1)
             return (
                 np.stack([-2.0 * F, 2.0 * F], axis=1),
