@@ -33,6 +33,7 @@ from spark_rapids_ml_tpu.models.scaler import (  # noqa: F401
     StandardScalerModel,
 )
 from spark_rapids_ml_tpu.models.feature_eng import (  # noqa: F401
+    IndexToString,
     OneHotEncoder,
     OneHotEncoderModel,
     StringIndexer,
@@ -67,6 +68,7 @@ __all__ = [
     "StringIndexerModel",
     "OneHotEncoder",
     "OneHotEncoderModel",
+    "IndexToString",
     "Tokenizer",
     "HashingTF",
     "IDF",

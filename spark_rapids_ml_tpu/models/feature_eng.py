@@ -276,3 +276,37 @@ class OneHotEncoderModel(HasInputCol, HasOutputCol, Model):
     @classmethod
     def _fromSaved(cls, uid, data):
         return cls(uid=uid, categorySize=int(data["categorySize"][0]))
+
+
+class IndexToString(HasInputCol, HasOutputCol, Transformer):
+    """The StringIndexer inverse (pyspark.ml.feature.IndexToString): map a
+    numeric index column back to labels — typically a model's prediction
+    column back to the original categories."""
+
+    labels = Param("labels", "index → label table (required)", list)
+
+    def setLabels(self, value) -> "IndexToString":
+        value = [str(v) for v in value]
+        if not value:
+            raise ValueError("labels must be non-empty")
+        return self._set(labels=value)
+
+    def getLabels(self) -> list:
+        return self.getOrDefault("labels")
+
+    def transform(self, dataset: Any) -> Any:
+        if "labels" not in self._paramMap:
+            raise ValueError("setLabels([...]) before transform")
+        labels = np.asarray(self.getLabels())
+        idx = np.asarray(
+            _column_values(dataset, self.getOrDefault("inputCol")),
+            dtype=np.float64,
+        ).astype(np.int64)
+        if ((idx < 0) | (idx >= len(labels))).any():
+            bad = int(idx[(idx < 0) | (idx >= len(labels))][0])
+            raise ValueError(
+                f"index {bad} outside the label table of size {len(labels)}"
+            )
+        return columnar.append_columns(
+            dataset, [(self.getOutputCol(), labels[idx])]
+        )

@@ -156,3 +156,21 @@ def test_vector_assembler_allows_inf(df):
     df2.loc[0, "age"] = np.inf
     out = VectorAssembler().setInputCols(["age", "income"]).transform(df2)
     assert np.isinf(np.stack(out["features"])[0, 0])
+
+
+def test_index_to_string_round_trips(df):
+    from spark_rapids_ml_tpu.feature import IndexToString
+
+    si = StringIndexer().setInputCol("city").setOutputCol("ci").fit(df)
+    indexed = si.transform(df)
+    back = (
+        IndexToString().setInputCol("ci").setOutputCol("city2")
+        .setLabels(si.labels).transform(indexed)
+    )
+    assert list(back["city2"]) == list(df["city"])
+    with pytest.raises(ValueError, match="outside the label table"):
+        IndexToString().setInputCol("ci").setLabels(["only-one"]).transform(
+            indexed
+        )
+    with pytest.raises(ValueError, match="setLabels"):
+        IndexToString().setInputCol("ci").transform(indexed)
