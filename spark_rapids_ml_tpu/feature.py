@@ -11,6 +11,12 @@ preprocessing family end to end: PCA/TruncatedSVD, the scaler quartet
 (Standard/MinMax/MaxAbs/Robust), Imputer, QuantileDiscretizer/Bucketizer,
 VarianceThresholdSelector, and the stateless Normalizer/Binarizer/DCT/
 ElementwiseProduct/VectorSlicer.
+
+Fits routed through this namespace inherit the out-of-core streamed path:
+``PCA``/``StandardScaler`` fits whose estimated resident footprint exceeds
+``TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES`` fold chunks through a donated
+device accumulator (``spark.ingest.stream_fold``) at O(chunk + n²) device
+memory instead of materializing the full dataset.
 """
 
 from spark_rapids_ml_tpu.models.pca import PCA, PCAModel  # noqa: F401

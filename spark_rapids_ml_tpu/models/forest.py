@@ -55,12 +55,15 @@ def subset_size(strategy: str, n_features: int, *, classification: bool) -> int:
         s = "sqrt" if classification else "onethird"
     if s == "all":
         return n_features
+    # Spark CEILS the named strategies (RandomForestParams: sqrt → ceil(√F),
+    # log2 → ceil(log₂F), onethird → ceil(F/3)) — floor under-samples, e.g.
+    # F=10 must give 4 features for 'sqrt', not 3
     if s == "sqrt":
-        return max(1, int(math.sqrt(n_features)))
+        return max(1, math.ceil(math.sqrt(n_features)))
     if s == "log2":
-        return max(1, int(math.log2(n_features)))
+        return max(1, math.ceil(math.log2(n_features)))
     if s == "onethird":
-        return max(1, int(n_features / 3.0))
+        return max(1, math.ceil(n_features / 3.0))
     try:
         v = float(s)
     except ValueError:

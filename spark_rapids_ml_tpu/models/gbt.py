@@ -16,7 +16,14 @@ Spark MLlib semantics mirrored (GradientBoostedTrees.boost):
 - regressor: squared loss, residuals y − F;
 - classifier: Friedman's deviance with labels y∈{−1,1} and margin 2F —
   pseudo-residuals r = 2y/(1+exp(2yF)); rawPrediction = [−2F, 2F],
-  probability = σ(2F), prediction = 1[F > 0] (the MLlib decision rule);
+  probability = σ(2F), prediction = 1[F > 0] (the MLlib decision rule).
+  DISCLOSED DIVERGENCE: Spark's LogLoss.gradient is −4y/(1+exp(2yF)), so
+  its pseudo-residuals are exactly 2× the Friedman-scaled r used here.
+  Each stage's leaf values absorb part of that scale (leaf mean of r), so
+  ensemble *decisions* (sign of F) track Spark's, but margins — and hence
+  probabilities — are NOT comparable to Spark's model-for-model; parity
+  with Spark GBTClassifier holds at the decision level only (see the
+  README "Parity divergences" table);
 - ``featureSubsetStrategy`` 'auto' resolves to 'all' (Spark's GBT rule —
   each stage is a single tree; RF's sqrt/onethird heuristics don't apply);
 - ``subsamplingRate`` draws a fresh Bernoulli row sample per STAGE
@@ -349,7 +356,11 @@ class GBTClassifier(_GBTClassifierCols, _GBTEstimator):
 
     @staticmethod
     def _pseudo_residuals(y, F):
-        # −∂/∂F log(1+exp(−2yF)) = 2y / (1+exp(2yF))
+        # −∂/∂F log(1+exp(−2yF)) = 2y / (1+exp(2yF)) — Friedman's scaling.
+        # Spark's LogLoss.gradient uses margin 2F in the chain rule and
+        # lands on 4y/(1+exp(2yF)): 2× these residuals. Decision parity
+        # survives (sign of F is scale-free); margin/probability parity
+        # does not — disclosed in the module docstring and README table.
         return 2.0 * y / (1.0 + jnp.exp(2.0 * y * F))
 
     @staticmethod

@@ -30,7 +30,6 @@ from spark_rapids_ml_tpu.models.pca import (
     PCAModel,
     _combine_r,
     _fit_from_stats_jit,
-    _gram_stats,
     _qr_r,
     _svd_from_r_jit,
 )
@@ -39,19 +38,16 @@ from spark_rapids_ml_tpu.models.params import Param
 from spark_rapids_ml_tpu.models.linear import (
     LinearRegression,
     LinearRegressionModel,
-    _linear_stats,
     _solve_from_stats,
 )
 from spark_rapids_ml_tpu.models.scaler import (
     StandardScaler,
     StandardScalerModel,
-    _moment_stats,
 )
 from spark_rapids_ml_tpu.models.truncated_svd import (
     TruncatedSVD,
     TruncatedSVDModel,
     _decompose_gram_jit,
-    _gram,
     _svd_values_from_r_jit,
 )
 from spark_rapids_ml_tpu.ops import linalg as L
@@ -60,9 +56,11 @@ from spark_rapids_ml_tpu.utils import columnar
 
 from spark_rapids_ml_tpu.ops import linear as LIN
 
-_combine_gram = jax.jit(L.combine_gram_stats)
-_combine_moments = jax.jit(S.combine_moment_stats)
-_combine_linear = jax.jit(LIN.combine_linear_stats)
+# partial_fit accumulation rides the streamed-fit donated fold steps
+# (ops.linalg.gram_fold_step rationale): the carry updates in place on
+# device — no per-batch [n, n] realloc — and the dispatch returns before
+# the fold completes, so the caller's next batch extraction overlaps the
+# device work for free.
 
 
 def _as_matrix(est, batch: Any) -> np.ndarray:
@@ -137,11 +135,12 @@ class IncrementalPCA(PCA):
             self._rows_seen = getattr(self, "_rows_seen", 0) + len(mat)
             return self
         prec = L.PRECISIONS[self.getOrDefault("precision")]
-        stats = _gram_stats(jnp.asarray(padded), precision=prec)
-        stats = L.GramStats(
-            stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype)
-        )
-        self._acc = stats if self._acc is None else _combine_gram(self._acc, stats)
+        xj = jnp.asarray(padded)
+        wp = np.zeros(padded.shape[0], padded.dtype)
+        wp[:true_rows] = 1.0  # pad mask doubles as the exact count
+        if self._acc is None:
+            self._acc = L.init_gram_carry(xj.shape[1], xj.dtype)
+        self._acc = L.gram_fold_step(prec)(self._acc, xj, jnp.asarray(wp))
         return self
 
     def finalize(self) -> PCAModel:
@@ -189,8 +188,10 @@ class IncrementalTruncatedSVD(TruncatedSVD):
             self._r_acc = r if self._r_acc is None else _combine_r(self._r_acc, r)
         else:
             prec = L.PRECISIONS[self.getOrDefault("precision")]
-            g = _gram(jnp.asarray(padded), precision=prec)
-            self._gram = g if self._gram is None else self._gram + g
+            xj = jnp.asarray(padded)
+            if self._gram is None:
+                self._gram = jnp.zeros((xj.shape[1], xj.shape[1]), xj.dtype)
+            self._gram = L.gram_fold_xtx_step(prec)(self._gram, xj)
         return self
 
     def finalize(self) -> TruncatedSVDModel:
@@ -228,13 +229,12 @@ class IncrementalStandardScaler(StandardScaler):
     def partial_fit(self, batch: Any) -> "IncrementalStandardScaler":
         mat = _as_matrix(self, batch)
         padded, true_rows = columnar.pad_rows(mat)
-        stats = _moment_stats(jnp.asarray(padded))
-        stats = S.MomentStats(
-            count=jnp.asarray(true_rows, stats.count.dtype),
-            total=stats.total,
-            total_sq=stats.total_sq,
-        )
-        self._acc = stats if self._acc is None else _combine_moments(self._acc, stats)
+        xj = jnp.asarray(padded)
+        wp = np.zeros(padded.shape[0], padded.dtype)
+        wp[:true_rows] = 1.0
+        if self._acc is None:
+            self._acc = S.init_moment_carry(xj.shape[1], xj.dtype)
+        self._acc = S.moment_fold_step()(self._acc, xj, jnp.asarray(wp))
         return self
 
     def finalize(self) -> StandardScalerModel:
@@ -285,13 +285,11 @@ class IncrementalLinearRegression(LinearRegression):
                     f"inconsistent feature dim: {x.shape[1]} != {self._n_cols}"
                 )
             xp, yp, w = columnar.pad_labeled(x, y, sw)
-            stats = _linear_stats(
-                jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w)
-            )
-            self._acc = (
-                stats
-                if self._acc is None
-                else _combine_linear(self._acc, stats)
+            xj = jnp.asarray(xp)
+            if self._acc is None:
+                self._acc = LIN.init_linear_carry(xj.shape[1], xj.dtype)
+            self._acc = LIN.linear_fold_step()(
+                self._acc, xj, jnp.asarray(yp), jnp.asarray(w)
             )
             self._rows_seen += x.shape[0]
         return self

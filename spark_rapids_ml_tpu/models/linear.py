@@ -232,8 +232,29 @@ class LinearRegression(_ElasticNetParams, _SupervisedParams, Estimator):
             return _linear_stats(jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(w))
 
         with trace_range("linreg stats"):
-            partials = run_partition_tasks(task, parts)
-            stats = tree_reduce(partials, LIN.combine_linear_stats)
+            rows = sum(len(p[0]) for p in parts)
+            n = parts[0][0].shape[1] if parts else 0
+            from spark_rapids_ml_tpu.spark.ingest import (
+                stream_fold,
+                use_streamed_fit,
+                wire_dtype,
+            )
+
+            if parts and use_streamed_fit(rows, n):
+                # out-of-core: labeled partitions drain through the donated
+                # LinearStats fold at O(chunk + n²) device memory; instance
+                # weights and the pad mask share the same w vector
+                res = stream_fold(
+                    iter(parts),
+                    LIN.linear_fold_step(),
+                    n=n,
+                    label_col="y",
+                    init=LIN.init_linear_carry(n, wire_dtype()),
+                )
+                stats = res.carry
+            else:
+                partials = run_partition_tasks(task, parts)
+                stats = tree_reduce(partials, LIN.combine_linear_stats)
         with trace_range("linreg solve"):
             coef, intercept = _solve_from_stats(stats, **self._solve_args())
         model = LinearRegressionModel(

@@ -199,8 +199,11 @@ class NaiveBayes(_NBParams, Estimator):
         lam = self.getSmoothing()
         total = counts.sum()
         safe_counts = np.where(counts > 0, counts, 1.0)
+        # Spark smooths the class priors with the same λ as the likelihoods
+        # (NaiveBayes.scala piLogDenom): π_i = log((n_i + λ)/(N + λ·C)).
+        # Unsmoothed log(n_i/N) diverges for classes absent from the sample.
         with np.errstate(divide="ignore"):
-            pi = np.log(counts / total)
+            pi = np.log(counts + lam) - np.log(total + lam * len(counts))
         F = feat_sum.shape[1]
 
         sigma = np.zeros((0, 0))

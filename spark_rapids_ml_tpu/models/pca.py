@@ -184,28 +184,49 @@ class PCA(PCAParams, Estimator):
         partials = run_partition_tasks(partition_task, mats)
         return tree_reduce(partials, _combine_r)
 
+    def _stream_gram_stats(self, ds, k: int) -> tuple[L.GramStats, int]:
+        """Out-of-core Gram accumulation: partitions drain lazily through
+        ``spark.ingest.stream_fold`` into ONE donated device carry
+        (ops.linalg.gram_fold_step) — the full [rows, n] set of matrices is
+        never resident at once, host or device. The {1,0} pad mask makes
+        ragged chunk tails exact (x·1 ≡ x bit-for-bit), so the streamed
+        GramStats equal the resident reduction's."""
+        from spark_rapids_ml_tpu.spark import ingest
+
+        prec = _PRECISIONS[self.getOrDefault("precision")]
+        it = ds.matrices()
+        first = next(it)
+        n_cols = first.shape[1]
+        if k > n_cols:
+            raise ValueError(f"k={k} must be <= number of features {n_cols}")
+
+        def chunks():
+            yield first
+            yield from it
+
+        res = ingest.stream_fold(
+            chunks(),
+            L.gram_fold_step(prec),
+            n=n_cols,
+            init=L.init_gram_carry(n_cols, ingest.wire_dtype()),
+        )
+        return res.carry, n_cols
+
     def fit(self, dataset: Any, num_partitions: int | None = None) -> "PCAModel":
         """Two-phase fit, mirroring the reference call stack (SURVEY.md §3.1):
         per-partition device Gram accumulation + cross-partition reduce, then
-        a single device decomposition."""
+        a single device decomposition. Covariance solvers go out-of-core
+        above the ``TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES`` cutover: chunks
+        fold through a donated device accumulator (``_stream_gram_stats``)
+        at O(chunk + n²) memory instead of materializing every partition."""
         input_col = self._paramMap.get("inputCol") or self._defaultParamMap.get("inputCol")
         ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
         k = self.getK()
         mean_centering = self.getMeanCentering()
 
         with trace_range("compute cov"):  # NvtxRange analog, RapidsRowMatrix.scala:62
-            mats = list(ds.matrices())
-            n_cols = mats[0].shape[1]  # infer nCols like RapidsPCA.scala:74
-            for m in mats[1:]:
-                if m.shape[1] != n_cols:
-                    raise ValueError(
-                        f"inconsistent feature dim: {m.shape[1]} != {n_cols}"
-                    )
-
             solver = self.getOrDefault("solver")
             standardize = self.getOrDefault("standardize")
-            if k > n_cols:
-                raise ValueError(f"k={k} must be <= number of features {n_cols}")
             if standardize and solver == "svd":
                 raise ValueError(
                     "standardize=True derives the scaled covariance from "
@@ -213,26 +234,41 @@ class PCA(PCAParams, Estimator):
                     "('full'/'randomized'/'auto'); solver='svd' decomposes "
                     "R factors of the raw rows"
                 )
-            if solver == "svd":
-                r = self._reduce_r(mats, mean_centering)
+            if solver != "svd" and columnar.use_streamed_fit(ds):
+                stats, n_cols = self._stream_gram_stats(ds, k)
             else:
-                prec = _PRECISIONS[self.getOrDefault("precision")]
+                mats = list(ds.matrices())
+                n_cols = mats[0].shape[1]  # infer nCols like RapidsPCA.scala:74
+                for m in mats[1:]:
+                    if m.shape[1] != n_cols:
+                        raise ValueError(
+                            f"inconsistent feature dim: {m.shape[1]} != {n_cols}"
+                        )
 
-                def partition_task(mat):
-                    padded, true_rows = columnar.pad_rows(mat)
-                    stats = _gram_stats(jnp.asarray(padded), precision=prec)
-                    # padding adds zero rows: fix only the count
-                    return L.GramStats(
-                        stats.xtx,
-                        stats.col_sum,
-                        jnp.asarray(true_rows, stats.count.dtype),
+                if k > n_cols:
+                    raise ValueError(
+                        f"k={k} must be <= number of features {n_cols}"
                     )
+                if solver == "svd":
+                    r = self._reduce_r(mats, mean_centering)
+                else:
+                    prec = _PRECISIONS[self.getOrDefault("precision")]
 
-                from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
-                from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+                    def partition_task(mat):
+                        padded, true_rows = columnar.pad_rows(mat)
+                        stats = _gram_stats(jnp.asarray(padded), precision=prec)
+                        # padding adds zero rows: fix only the count
+                        return L.GramStats(
+                            stats.xtx,
+                            stats.col_sum,
+                            jnp.asarray(true_rows, stats.count.dtype),
+                        )
 
-                partials = run_partition_tasks(partition_task, mats)
-                stats = tree_reduce(partials, L.combine_gram_stats)
+                    from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+                    from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
+
+                    partials = run_partition_tasks(partition_task, mats)
+                    stats = tree_reduce(partials, L.combine_gram_stats)
 
         mean = std = None
         with trace_range("eigh"):  # "cuSolver SVD" range analog, RapidsRowMatrix.scala:70

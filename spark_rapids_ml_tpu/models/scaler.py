@@ -84,18 +84,40 @@ class StandardScaler(_ScalerParams, Estimator):
         input_col = self._paramMap.get("inputCol")
         ds = columnar.PartitionedDataset.from_any(dataset, input_col, num_partitions)
         with trace_range("scaler moments"):
+            if columnar.use_streamed_fit(ds):
+                # out-of-core: partitions drain through the donated moments
+                # fold (ops.scaler.moment_fold_step) at O(chunk + n) device
+                # memory; count = Σw (1.0 true rows / 0.0 pads) is exact
+                from spark_rapids_ml_tpu.spark import ingest
 
-            def partition_task(mat):
-                padded, true_rows = columnar.pad_rows(mat)
-                st = _moment_stats(jnp.asarray(padded))
-                return S.MomentStats(
-                    jnp.asarray(true_rows, st.count.dtype), st.total, st.total_sq
+                it = ds.matrices()
+                first = next(it)
+                n = first.shape[1]
+
+                def chunks():
+                    yield first
+                    yield from it
+
+                res = ingest.stream_fold(
+                    chunks(),
+                    S.moment_fold_step(),
+                    n=n,
+                    init=S.init_moment_carry(n, ingest.wire_dtype()),
                 )
+                stats = res.carry
+            else:
 
-            from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+                def partition_task(mat):
+                    padded, true_rows = columnar.pad_rows(mat)
+                    st = _moment_stats(jnp.asarray(padded))
+                    return S.MomentStats(
+                        jnp.asarray(true_rows, st.count.dtype), st.total, st.total_sq
+                    )
 
-            partials = run_partition_tasks(partition_task, list(ds.matrices()))
-            stats = tree_reduce(partials, S.combine_moment_stats)
+                from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
+
+                partials = run_partition_tasks(partition_task, list(ds.matrices()))
+                stats = tree_reduce(partials, S.combine_moment_stats)
             mean, std = _finalize(stats)
         model = StandardScalerModel(
             uid=self.uid, mean=np.asarray(mean), std=np.asarray(std)

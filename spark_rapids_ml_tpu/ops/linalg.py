@@ -34,6 +34,7 @@ and the signFlip orientation rule (rapidsml_jni.cu:35-61).
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple
 
 import jax
@@ -90,6 +91,65 @@ def gram_stats(x: jax.Array, *, precision=DEFAULT_PRECISION) -> GramStats:
 def combine_gram_stats(a: GramStats, b: GramStats) -> GramStats:
     """Monoid combine — elementwise sum of the triples."""
     return GramStats(a.xtx + b.xtx, a.col_sum + b.col_sum, a.count + b.count)
+
+
+def gram_stats_weighted(
+    x: jax.Array, w: jax.Array, *, precision=DEFAULT_PRECISION
+) -> GramStats:
+    """GramStats under the framework-wide masking convention: ``w`` carries
+    instance weights on true rows and 0.0 on pad rows, so XᵀWX, the weighted
+    column sums, and the weight-sum count are exact over padded chunks with
+    no count fix-up. With unit weights this reduces bit-for-bit to
+    :func:`gram_stats` of the zero-padded block (x·1.0 == x)."""
+    xw = x * w[:, None]
+    return GramStats(
+        xtx=jnp.matmul(x.T, xw, precision=precision),
+        col_sum=jnp.sum(xw, axis=0),
+        count=jnp.sum(w),
+    )
+
+
+def fold_gram_stats(
+    carry: GramStats, x: jax.Array, w: jax.Array, *, precision=DEFAULT_PRECISION
+) -> GramStats:
+    """One streamed-fit fold step: carry + weighted stats of one chunk."""
+    return combine_gram_stats(carry, gram_stats_weighted(x, w, precision=precision))
+
+
+@lru_cache(maxsize=None)
+def gram_fold_step(precision=DEFAULT_PRECISION):
+    """The cached jitted fold step for streamed fits, with the carry
+    **donated**: the [n, n] accumulator is updated in place on device, so a
+    stream of C chunks allocates ONE set of carry buffers, not C — and the
+    jitted call returns as soon as it is dispatched (JAX async dispatch),
+    which is what lets the next chunk's host ingest overlap this chunk's
+    MXU fold. Use ``carry = step(carry, x, w)`` and never touch the old
+    carry again — donation invalidates it."""
+
+    def _step(carry: GramStats, x: jax.Array, w: jax.Array) -> GramStats:
+        return fold_gram_stats(carry, x, w, precision=precision)
+
+    return jax.jit(_step, donate_argnums=0)
+
+
+def init_gram_carry(n: int, dtype) -> GramStats:
+    """Zero device-resident GramStats carry for :func:`gram_fold_step`."""
+    return GramStats(
+        xtx=jnp.zeros((n, n), dtype),
+        col_sum=jnp.zeros((n,), dtype),
+        count=jnp.zeros((), dtype),
+    )
+
+
+@lru_cache(maxsize=None)
+def gram_fold_xtx_step(precision=DEFAULT_PRECISION):
+    """Donated fold of the bare [n, n] Gram (the TruncatedSVD accumulator —
+    no col_sum/count companions). Pad rows are zero so no mask is needed."""
+
+    def _step(carry: jax.Array, x: jax.Array) -> jax.Array:
+        return carry + gram(x, precision=precision)
+
+    return jax.jit(_step, donate_argnums=0)
 
 
 def covariance_from_stats(stats: GramStats, *, mean_centering: bool) -> jax.Array:

@@ -22,6 +22,7 @@ intercept coordinate out of the penalty, matching Spark ML/sklearn.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple
 
 import jax
@@ -79,6 +80,46 @@ def linear_stats(
 
 def combine_linear_stats(a: LinearStats, b: LinearStats) -> LinearStats:
     return LinearStats(*(av + bv for av, bv in zip(a, b)))
+
+
+def fold_linear_stats(
+    carry: LinearStats,
+    x: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    *,
+    precision=DEFAULT_PRECISION,
+) -> LinearStats:
+    """One streamed-fit fold step: carry + weighted stats of one chunk
+    (``w`` is the instance-weight/pad-mask vector, 0.0 on pads)."""
+    return combine_linear_stats(
+        carry, linear_stats(x, y, w, precision=precision)
+    )
+
+
+@lru_cache(maxsize=None)
+def linear_fold_step(precision=DEFAULT_PRECISION):
+    """Cached jitted fold with the carry donated — the [n, n] normal-equation
+    accumulator updates in place and the dispatch returns before the device
+    fold completes (ops.linalg.gram_fold_step rationale)."""
+
+    def _step(carry, x, y, w):
+        return fold_linear_stats(carry, x, y, w, precision=precision)
+
+    return jax.jit(_step, donate_argnums=0)
+
+
+def init_linear_carry(n: int, dtype) -> LinearStats:
+    """Zero device-resident LinearStats carry for :func:`linear_fold_step`."""
+    z = jnp.zeros
+    return LinearStats(
+        xtx=z((n, n), dtype),
+        xty=z((n,), dtype),
+        x_sum=z((n,), dtype),
+        y_sum=z((), dtype),
+        y_sq=z((), dtype),
+        count=z((), dtype),
+    )
 
 
 def solve_normal(

@@ -11,6 +11,7 @@ into one program with no extra HBM round-trip.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple
 
 import jax
@@ -35,6 +36,43 @@ def moment_stats(x: jax.Array) -> MomentStats:
 
 def combine_moment_stats(a: MomentStats, b: MomentStats) -> MomentStats:
     return MomentStats(a.count + b.count, a.total + b.total, a.total_sq + b.total_sq)
+
+
+def moment_stats_weighted(x: jax.Array, w: jax.Array) -> MomentStats:
+    """MomentStats under the masking convention (``w``: instance weights on
+    true rows, 0.0 on pads) — the count is the weight sum, so padded chunks
+    reduce exactly. Unit weights reproduce :func:`moment_stats` of the
+    zero-padded block bit-for-bit apart from the count fix-up."""
+    xw = x * w[:, None]
+    return MomentStats(
+        count=jnp.sum(w),
+        total=jnp.sum(xw, axis=0),
+        total_sq=jnp.sum(xw * x, axis=0),
+    )
+
+
+def fold_moment_stats(
+    carry: MomentStats, x: jax.Array, w: jax.Array
+) -> MomentStats:
+    """One streamed-fit fold step: carry + weighted moments of one chunk."""
+    return combine_moment_stats(carry, moment_stats_weighted(x, w))
+
+
+@lru_cache(maxsize=None)
+def moment_fold_step():
+    """Cached jitted fold with the carry donated (no per-chunk [n] realloc);
+    dispatch returns immediately, so chunk ingest overlaps the device fold
+    (ops.linalg.gram_fold_step rationale)."""
+    return jax.jit(fold_moment_stats, donate_argnums=0)
+
+
+def init_moment_carry(n: int, dtype) -> MomentStats:
+    """Zero device-resident MomentStats carry for :func:`moment_fold_step`."""
+    return MomentStats(
+        count=jnp.zeros((), dtype),
+        total=jnp.zeros((n,), dtype),
+        total_sq=jnp.zeros((n,), dtype),
+    )
 
 
 def finalize_moments(stats: MomentStats) -> tuple[jax.Array, jax.Array]:

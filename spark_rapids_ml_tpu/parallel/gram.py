@@ -249,3 +249,143 @@ def sharded_histogram(
     mesh: one scatter-add per column per shard + a psum — pad rows carry
     zero weight and never count."""
     return _histogram_prog(mesh, bins)(x, w, mins, maxs)
+
+
+# ---------------------------------------------------------------------------
+# Streamed-fit chunk folds: per-chunk sharded accumulation, psum at finalize
+# ---------------------------------------------------------------------------
+#
+# The resident programs above reduce ONCE over a fully-materialized sharded
+# array. The streamed fit (spark.ingest.stream_fold) instead folds a stream
+# of fixed-shape chunks; running a psum per chunk would serialize every fold
+# on the slowest link, so the carry here is the STACKED per-device partials —
+# each leaf [ndev, ...] sharded over the data axis — and each fold is a
+# collective-free shard_map: device d adds its chunk shard's local statistics
+# into its own carry slice, with the carry donated (no per-chunk [n, n]
+# realloc). One allreduce at finalize produces the replicated total.
+
+
+def chunk_put(mesh: Mesh):
+    """Chunk placement for mesh-sharded stream folds: [c, n] matrices shard
+    as P(data, None), [c] vectors as P(data). Pass as ``put_fn`` to
+    ``stream_fold`` (chunk_rows must divide by the data-axis size —
+    :func:`stream_chunk_rows_for_mesh`)."""
+    mat = NamedSharding(mesh, P(DATA_AXIS, None))
+    vec = NamedSharding(mesh, P(DATA_AXIS))
+
+    def put(a):
+        return jax.device_put(a, mat if a.ndim == 2 else vec)
+
+    return put
+
+
+def stream_chunk_rows_for_mesh(mesh: Mesh) -> int:
+    """The streamed chunk size rounded up to a data-axis multiple so every
+    chunk shards evenly (power-of-two buckets already divide power-of-two
+    meshes; this covers odd device counts too)."""
+    from spark_rapids_ml_tpu.spark.ingest import stream_chunk_rows
+
+    ndev = mesh.shape[DATA_AXIS]
+    base = stream_chunk_rows()
+    return -(-base // ndev) * ndev
+
+
+def init_chunk_carry(example, mesh: Mesh):
+    """Zero stacked-partials carry from an example pytree of the UNSTACKED
+    statistics (arrays or ShapeDtypeStructs): each leaf becomes
+    [ndev, *shape] sharded over the data axis, ready for donation."""
+    import numpy as np
+
+    ndev = mesh.shape[DATA_AXIS]
+
+    def mk(leaf):
+        shard = NamedSharding(mesh, P(DATA_AXIS))
+        return jax.device_put(
+            np.zeros((ndev,) + tuple(leaf.shape), leaf.dtype), shard
+        )
+
+    return jax.tree.map(mk, example)
+
+
+def finalize_chunk_fold(carry, mesh: Mesh):
+    """Collapse the stacked per-device partials into the replicated total —
+    the ONE cross-device reduction of a streamed fit (vs one per chunk)."""
+    from spark_rapids_ml_tpu.parallel.backend import allreduce
+
+    return jax.tree.map(lambda v: allreduce(v, mesh, DATA_AXIS), carry)
+
+
+def _chunk_fold_prog(mesh: Mesh, kernel, vec_args: int):
+    """shard_map a local-stats kernel into a donated per-chunk fold: no
+    collectives inside — each device folds its shard into its carry slice."""
+    in_specs = (P(DATA_AXIS), P(DATA_AXIS, None)) + tuple(
+        P(DATA_AXIS) for _ in range(vec_args)
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(DATA_AXIS),
+        check_rep=False,
+    )
+    def _fold(carry, xl, *vecs):
+        local = kernel(xl, *vecs)
+        return jax.tree.map(lambda c, s: c + s[None], carry, local)
+
+    return jax.jit(_fold, donate_argnums=0)
+
+
+@lru_cache(maxsize=None)
+def _gram_chunk_fold_prog(mesh: Mesh, precision):
+    return _chunk_fold_prog(
+        mesh,
+        lambda xl, wl: L.gram_stats_weighted(xl, wl, precision=precision),
+        1,
+    )
+
+
+def sharded_gram_fold(
+    carry, x: jax.Array, w: jax.Array, mesh: Mesh, *, precision=L.DEFAULT_PRECISION
+):
+    """One streamed GramStats fold: carry leaves are [ndev, ...] stacked
+    partials (init_chunk_carry), ``x``/``w`` one sharded chunk. Donated —
+    reassign the carry and never touch the old one."""
+    return _gram_chunk_fold_prog(mesh, precision)(carry, x, w)
+
+
+@lru_cache(maxsize=None)
+def _moment_chunk_fold_prog(mesh: Mesh):
+    from spark_rapids_ml_tpu.ops import scaler as S
+
+    return _chunk_fold_prog(mesh, S.moment_stats_weighted, 1)
+
+
+def sharded_moment_fold(carry, x: jax.Array, w: jax.Array, mesh: Mesh):
+    """One streamed MomentStats fold over a sharded chunk (donated carry)."""
+    return _moment_chunk_fold_prog(mesh)(carry, x, w)
+
+
+@lru_cache(maxsize=None)
+def _linear_chunk_fold_prog(mesh: Mesh, precision):
+    from spark_rapids_ml_tpu.ops import linear as LIN
+
+    return _chunk_fold_prog(
+        mesh,
+        lambda xl, yl, wl: LIN.linear_stats(xl, yl, wl, precision=precision),
+        2,
+    )
+
+
+def sharded_linear_fold(
+    carry,
+    x: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    mesh: Mesh,
+    *,
+    precision=L.DEFAULT_PRECISION,
+):
+    """One streamed LinearStats fold over a sharded labeled chunk (donated
+    carry; ``w`` is the instance-weight/pad mask)."""
+    return _linear_chunk_fold_prog(mesh, precision)(carry, x, y, w)

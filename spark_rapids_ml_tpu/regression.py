@@ -1,6 +1,11 @@
 """Drop-in regression namespace — mirrors ``pyspark.ml.regression`` naming
 the way the reference's 10-line public class mirrors Spark's package path
-(PCA.scala:27-37, SURVEY.md §1 L6)."""
+(PCA.scala:27-37, SURVEY.md §1 L6).
+
+``LinearRegression`` fits above the ``TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES``
+cutover stream chunk-wise through the donated-carry fold pipeline
+(``spark.ingest.stream_fold``) — O(chunk + n²) device memory, unbounded
+rows — instead of padding every partition onto the device at once."""
 
 from spark_rapids_ml_tpu.models.forest import (  # noqa: F401
     DecisionTreeRegressionModel,

@@ -359,16 +359,48 @@ class SparkPCA(_HasDistribution, PCA):
         Gram program (parallel/gram.py) — the deployment where one process
         owns every local chip and DataFrame workers only do ingestion. Same
         XLA program as the in-core mesh path; zero pad rows are exact, the
-        true count overrides."""
+        true count overrides.
+
+        Above the ``TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES`` cutover the fit
+        goes out-of-core: stream_fold drives the donated per-chunk Gram fold
+        (parallel.gram.sharded_gram_fold) so device memory stays
+        O(chunk + n²) — the resident [rows, n] array is never assembled."""
+        import jax
         import jax.numpy as jnp
 
         from spark_rapids_ml_tpu.parallel import gram as G
+        from spark_rapids_ml_tpu.parallel import mesh as M
         from spark_rapids_ml_tpu.spark import ingest
 
-        ing = ingest.stream_to_mesh(selected, features_col=input_col, n=n)
+        precision = L.PRECISIONS[self.getOrDefault("precision")]
+        rows = selected.count()
+        if ingest.use_streamed_fit(rows, n):
+            mesh = M.create_mesh()
+            dt = ingest.wire_dtype()
+            example = L.GramStats(
+                xtx=jax.ShapeDtypeStruct((n, n), dt),
+                col_sum=jax.ShapeDtypeStruct((n,), dt),
+                count=jax.ShapeDtypeStruct((), dt),
+            )
+            res = ingest.stream_fold(
+                selected,
+                lambda c, x, w: G.sharded_gram_fold(
+                    c, x, w, mesh, precision=precision
+                ),
+                features_col=input_col,
+                n=n,
+                init=G.init_chunk_carry(example, mesh),
+                rows=rows,
+                chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
+                put_fn=G.chunk_put(mesh),
+            )
+            # weighted count == Σ true-row weights == rows; no override needed
+            return G.finalize_chunk_fold(res.carry, mesh)
+        ing = ingest.stream_to_mesh(
+            selected, features_col=input_col, n=n, rows=rows
+        )
         stats = G.sharded_gram_stats(
-            ing.xs, ing.mesh,
-            precision=L.PRECISIONS[self.getOrDefault("precision")],
+            ing.xs, ing.mesh, precision=precision
         )
         return L.GramStats(
             stats.xtx, stats.col_sum,
@@ -577,16 +609,51 @@ class SparkLinearRegression(_HasDistribution, LinearRegression):
                 from spark_rapids_ml_tpu.parallel import linear as PL
                 from spark_rapids_ml_tpu.spark import ingest
 
-                ing = ingest.stream_to_mesh(
-                    dataset.select(*cols), features_col=feats, n=n,
-                    label_col=label, weight_col=weight_col,
-                    with_weights=True,
-                )
-                if weight_col and float(ing.ws.sum()) == 0.0:
-                    raise ValueError("all instance weights are zero")
-                stats = PL.sharded_linear_stats_weighted(
-                    ing.xs, ing.ys, ing.ws, ing.mesh
-                )
+                selected = dataset.select(*cols)
+                rows = selected.count()
+                if ingest.use_streamed_fit(rows, n):
+                    # out-of-core: donated per-chunk LinearStats fold at
+                    # O(chunk + n²) device memory (see _mesh_local_stats)
+                    import jax
+
+                    from spark_rapids_ml_tpu.ops import linear as LIN
+                    from spark_rapids_ml_tpu.parallel import gram as G
+                    from spark_rapids_ml_tpu.parallel import mesh as M
+
+                    mesh = M.create_mesh()
+                    dt = ingest.wire_dtype()
+                    example = LIN.LinearStats(
+                        xtx=jax.ShapeDtypeStruct((n, n), dt),
+                        xty=jax.ShapeDtypeStruct((n,), dt),
+                        x_sum=jax.ShapeDtypeStruct((n,), dt),
+                        y_sum=jax.ShapeDtypeStruct((), dt),
+                        y_sq=jax.ShapeDtypeStruct((), dt),
+                        count=jax.ShapeDtypeStruct((), dt),
+                    )
+                    res = ingest.stream_fold(
+                        selected,
+                        lambda c, x, y, w: G.sharded_linear_fold(
+                            c, x, y, w, mesh
+                        ),
+                        features_col=feats,
+                        n=n,
+                        label_col=label,
+                        weight_col=weight_col,
+                        init=G.init_chunk_carry(example, mesh),
+                        rows=rows,
+                        chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
+                        put_fn=G.chunk_put(mesh),
+                    )
+                    stats = G.finalize_chunk_fold(res.carry, mesh)
+                else:
+                    ing = ingest.stream_to_mesh(
+                        selected, features_col=feats, n=n,
+                        label_col=label, weight_col=weight_col,
+                        with_weights=True, rows=rows,
+                    )
+                    stats = PL.sharded_linear_stats_weighted(
+                        ing.xs, ing.ys, ing.ws, ing.mesh
+                    )
                 arrays = {
                     k: np.asarray(v) for k, v in zip(stats._fields, stats)
                 }
@@ -1524,15 +1591,50 @@ class SparkStandardScaler(_HasDistribution, StandardScaler):
 
                 from spark_rapids_ml_tpu.spark import ingest
 
-                ing = ingest.stream_to_mesh(
-                    dataset.select(input_col), features_col=input_col, n=n
-                )
-                mstats = G.sharded_moment_stats(ing.xs, ing.mesh)
-                arrays = {
-                    "count": np.float64(ing.rows),  # pads are zero rows
-                    "total": np.asarray(mstats.total),
-                    "total_sq": np.asarray(mstats.total_sq),
-                }
+                selected = dataset.select(input_col)
+                rows = selected.count()
+                if ingest.use_streamed_fit(rows, n):
+                    # out-of-core: donated per-chunk moments fold at
+                    # O(chunk + n) device memory (see _mesh_local_stats)
+                    import jax
+
+                    from spark_rapids_ml_tpu.parallel import mesh as M
+
+                    mesh = M.create_mesh()
+                    dt = ingest.wire_dtype()
+                    example = S.MomentStats(
+                        count=jax.ShapeDtypeStruct((), dt),
+                        total=jax.ShapeDtypeStruct((n,), dt),
+                        total_sq=jax.ShapeDtypeStruct((n,), dt),
+                    )
+                    res = ingest.stream_fold(
+                        selected,
+                        lambda c, x, w: G.sharded_moment_fold(c, x, w, mesh),
+                        features_col=input_col,
+                        n=n,
+                        init=G.init_chunk_carry(example, mesh),
+                        rows=rows,
+                        chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
+                        put_fn=G.chunk_put(mesh),
+                    )
+                    mstats = G.finalize_chunk_fold(res.carry, mesh)
+                    arrays = {
+                        # count = Σw: 1.0 true rows / 0.0 pads, so it IS
+                        # the true row count — no override needed
+                        "count": np.asarray(mstats.count),
+                        "total": np.asarray(mstats.total),
+                        "total_sq": np.asarray(mstats.total_sq),
+                    }
+                else:
+                    ing = ingest.stream_to_mesh(
+                        selected, features_col=input_col, n=n, rows=rows
+                    )
+                    mstats = G.sharded_moment_stats(ing.xs, ing.mesh)
+                    arrays = {
+                        "count": np.float64(ing.rows),  # pads are zero rows
+                        "total": np.asarray(mstats.total),
+                        "total_sq": np.asarray(mstats.total_sq),
+                    }
             elif self.getOrDefault("distribution") == "mesh-barrier":
                 from spark_rapids_ml_tpu.spark import spmd
 

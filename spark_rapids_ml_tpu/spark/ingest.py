@@ -54,6 +54,12 @@ DEFAULT_ARROW_CUTOVER = 1 << 30
 # rows per conversion chunk on the row-iterator (pyspark) path; Arrow-path
 # chunks keep whatever batch size the engine produced
 ROW_CHUNK = 65_536
+# streamed-fit knobs: fits whose estimated resident footprint exceeds the
+# cutover never assemble the global array — they fold fixed-shape chunks of
+# STREAM_CHUNK rows through a donated device accumulator instead
+STREAM_CUTOVER_VAR = "TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES"
+STREAM_CHUNK_VAR = "TPU_ML_STREAM_CHUNK_ROWS"
+DEFAULT_STREAM_CHUNK = 65_536
 
 
 def wire_dtype() -> np.dtype:
@@ -378,4 +384,224 @@ def stream_to_mesh(
     )
     return MeshIngest(
         xs=xs, ys=ys, ws=ws, mesh=mesh, rows=rows, padded_rows=padded_rows
+    )
+
+
+# ---------------------------------------------------------------------------
+# Streamed fit: chunk-wise fold with a donated device accumulator
+# ---------------------------------------------------------------------------
+
+
+def use_streamed_fit(rows: int, n: int) -> bool:
+    """Cutover rule for DataFrame fits: stream when the resident global
+    array (rows × n at the wire dtype) would exceed
+    ``TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES``. The resident path stays the
+    default — it is still fastest when the data fits."""
+    from spark_rapids_ml_tpu.utils.config import get_config
+
+    return (
+        rows * n * wire_dtype().itemsize
+        > get_config().stream_fit_max_resident_bytes
+    )
+
+
+def stream_chunk_rows() -> int:
+    """Rows per fold chunk (``TPU_ML_STREAM_CHUNK_ROWS``), bucketed to a
+    power of two so every fold call shares ONE static XLA shape."""
+    rows = int(os.environ.get(STREAM_CHUNK_VAR, DEFAULT_STREAM_CHUNK))
+    if rows < 1:
+        raise ValueError(f"{STREAM_CHUNK_VAR}={rows} must be >= 1")
+    return columnar.bucket_rows(rows)
+
+
+@dataclass
+class StreamFold:
+    """Result of a streamed fold: the final carry plus pipeline evidence.
+
+    ``overlapped`` counts fold dispatches issued while the PREVIOUS chunk's
+    fold was still executing on device — the double-buffering observable
+    (> 0 means ingest genuinely overlapped compute). ``max_put_bytes`` is
+    the largest single host→device transfer: O(chunk), never O(rows),
+    because the global array is never assembled.
+    """
+
+    carry: Any
+    rows: int
+    chunks: int
+    overlapped: int
+    max_put_bytes: int
+
+
+def stream_fold(
+    source,
+    fold_fn,
+    *,
+    n: int,
+    init,
+    features_col: str | None = None,
+    label_col: str | None = None,
+    weight_col: str | None = None,
+    augment_intercept: bool = False,
+    rows: int | None = None,
+    chunk_rows: int | None = None,
+    put_fn=None,
+) -> StreamFold:
+    """Fold ``source`` chunk-wise through a donated device accumulator —
+    the out-of-core fit pipeline. The full [rows, n] array is NEVER
+    assembled: device memory stays O(chunk + carry), so fit() scales to
+    row counts that cannot fit in HBM.
+
+    The pipeline double-buffers via JAX async dispatch: ``fold_fn`` must be
+    a jitted step with ``donate_argnums=0`` (ops.linalg.gram_fold_step and
+    friends), whose call returns the moment it is dispatched — so while
+    chunk i's fold executes on the MXU, the host is already extracting and
+    ``device_put``-ing chunk i+1. Each phase is traced
+    (``ingest.chunk`` / ``fold.dispatch`` / ``fold.wait``,
+    utils.tracing.metrics()) so the overlap is observable.
+
+    ``source`` is either a DataFrame-shaped object (localspark / pyspark —
+    drained via the same strategy-gated ``_iter_chunks`` the resident
+    ingest uses; requires ``features_col``) or any iterable of host chunks:
+    bare ``[c, n]`` arrays or ``(x,)``/``(x, y)``/``(x, y, w)`` tuples.
+
+    ``fold_fn(carry, x, w)`` — or ``fold_fn(carry, x, y, w)`` when labels
+    flow — receives fixed-shape [chunk_rows, n(+1)] device chunks; ``w``
+    follows the framework-wide masking convention (instance weights on true
+    rows, 0.0 on pads), so ragged tails and chunk sizes that don't divide
+    the row count are exact with no count fix-up. ``init`` is the zero
+    carry (or a callable returning it); ``put_fn`` overrides chunk
+    placement (e.g. parallel.gram.chunk_put shards chunks over a mesh).
+    """
+    import jax
+
+    from spark_rapids_ml_tpu.utils.tracing import trace_range
+
+    dt = wire_dtype()
+    n_eff = n + 1 if augment_intercept else n
+    if chunk_rows is None:
+        chunk_rows = stream_chunk_rows()
+    want_y = label_col is not None
+    put = put_fn if put_fn is not None else jax.device_put
+
+    df_like = features_col is not None and any(
+        callable(getattr(source, attr, None))
+        for attr in ("_parts", "toArrow", "toPandas", "toLocalIterator", "collect")
+    )
+
+    def chunks():
+        if df_like:
+            nonlocal rows
+            if rows is None and callable(getattr(source, "count", None)):
+                rows = source.count()
+            yield from _iter_chunks(
+                source, features_col, label_col, weight_col,
+                est_bytes=(rows or 0) * n * 8,
+            )
+            return
+        for item in source:
+            if isinstance(item, tuple):
+                x = np.asarray(item[0])
+                y = np.asarray(item[1]) if len(item) > 1 and item[1] is not None else None
+                w = np.asarray(item[2]) if len(item) > 2 and item[2] is not None else None
+            else:
+                x, y, w = np.asarray(item), None, None
+            yield x, y, w
+
+    def timed_chunks():
+        it = chunks()
+        while True:
+            # host-side extraction span; the staging memcpy below is noise
+            # next to the DataFrame pull this times
+            with trace_range("ingest.chunk"):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+            yield item
+
+    def fresh():
+        return (
+            np.zeros((chunk_rows, n_eff), dt),
+            np.zeros(chunk_rows, dt) if want_y else None,
+            np.zeros(chunk_rows, dt),
+        )
+
+    carry = init() if callable(init) else init
+    x_buf, y_buf, w_buf = fresh()
+    fill = 0
+    seen = 0
+    n_chunks = 0
+    overlapped = 0
+    max_put = 0
+
+    def dispatch():
+        nonlocal carry, x_buf, y_buf, w_buf, fill, n_chunks, overlapped, max_put
+        busy = any(
+            not leaf.is_ready()
+            for leaf in jax.tree_util.tree_leaves(carry)
+            if hasattr(leaf, "is_ready")
+        )
+        with trace_range("fold.dispatch"):
+            xd = put(x_buf)
+            wd = put(w_buf)
+            nbytes = x_buf.nbytes + w_buf.nbytes
+            if want_y:
+                yd = put(y_buf)
+                nbytes += y_buf.nbytes
+                carry = fold_fn(carry, xd, yd, wd)
+            else:
+                carry = fold_fn(carry, xd, wd)
+        if busy:
+            overlapped += 1
+        max_put = max(max_put, nbytes)
+        n_chunks += 1
+        # never reuse a put buffer: device_put of a host ndarray may alias
+        # rather than copy on some backends (stream_to_mesh rationale)
+        x_buf, y_buf, w_buf = fresh()
+        fill = 0
+
+    for xc, yc, wc in timed_chunks():
+        if xc.ndim != 2 or xc.shape[1] != n:
+            raise ValueError(
+                f"feature dimension changed mid-stream: expected {n}, got "
+                f"{xc.shape[1:]} in column {features_col!r}"
+            )
+        if wc is not None:
+            wc = columnar.validate_weights(wc, len(xc), allow_all_zero=True)
+        if want_y and yc is None:
+            raise ValueError("label column missing from a streamed chunk")
+        at = 0
+        while at < len(xc):
+            take = min(chunk_rows - fill, len(xc) - at)
+            x_buf[fill : fill + take, :n] = xc[at : at + take]
+            if augment_intercept:
+                x_buf[fill : fill + take, n] = 1.0
+            if want_y:
+                y_buf[fill : fill + take] = yc[at : at + take]
+            w_buf[fill : fill + take] = (
+                1.0 if wc is None else wc[at : at + take]
+            )
+            fill += take
+            at += take
+            seen += take
+            if fill == chunk_rows:
+                dispatch()
+    if fill:
+        dispatch()  # ragged tail: pads ride the w=0 mask, exactly
+    if seen == 0:
+        raise ValueError("empty dataset")
+    if rows is not None and seen != rows:
+        raise ValueError(
+            f"dataset produced {seen} rows while streaming but count() "
+            f"reported {rows}; cache() the DataFrame if its source is "
+            "nondeterministic"
+        )
+    with trace_range("fold.wait"):
+        carry = jax.block_until_ready(carry)
+    return StreamFold(
+        carry=carry,
+        rows=seen,
+        chunks=n_chunks,
+        overlapped=overlapped,
+        max_put_bytes=max_put,
     )

@@ -543,6 +543,34 @@ class PartitionedDataset:
     def num_partitions(self) -> int:
         return len(self.partitions)
 
+    def est_rows(self) -> int | None:
+        """Total row count from partition metadata alone — no matrix
+        extraction, so the streamed-fit cutover can be decided without
+        materializing anything. None when a partition's size isn't knowable
+        cheaply (callers fall back to the resident path)."""
+        total = 0
+        for p in self.partitions:
+            nr = getattr(p, "num_rows", None)
+            if nr is None and isinstance(p, np.ndarray):
+                nr = p.shape[0]
+            if nr is None and isinstance(p, (list, tuple)):
+                nr = len(p)
+            if nr is None:
+                return None
+            total += int(nr)
+        return total
+
+    def est_feature_dim(self) -> int | None:
+        """Feature dimension from the first partition's metadata (2-D
+        ndarray partitions only — anything else returns None and the caller
+        keeps the resident path)."""
+        if not self.partitions:
+            return None
+        p = self.partitions[0]
+        if isinstance(p, np.ndarray) and p.ndim == 2:
+            return int(p.shape[1])
+        return None
+
     def matrices(self) -> Iterator[np.ndarray]:
         for p in self.partitions:
             yield extract_matrix(p, self.input_col)
@@ -553,3 +581,17 @@ class PartitionedDataset:
     def collect_matrix(self) -> np.ndarray:
         mats = list(self.matrices())
         return mats[0] if len(mats) == 1 else np.concatenate(mats)
+
+
+def use_streamed_fit(ds: PartitionedDataset) -> bool:
+    """Streamed-fit cutover for core-model (non-Spark) fits: True when the
+    partition metadata alone proves the resident array would exceed
+    ``TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES``. Unknown sizes keep the
+    resident path — streaming is an optimization, never a behavior gamble."""
+    rows = ds.est_rows()
+    n = ds.est_feature_dim()
+    if rows is None or n is None:
+        return False
+    from spark_rapids_ml_tpu.spark.ingest import use_streamed_fit as _cutover
+
+    return _cutover(rows, n)

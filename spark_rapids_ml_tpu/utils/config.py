@@ -13,6 +13,13 @@ module is tier 2 for the TPU build — process-level knobs read from
   (the ``spark.task.maxFailures`` analog).
 - ``TPU_ML_DEFAULT_PRECISION`` ('highest'|'high'|'default') — estimator-level
   default for the Gram/projection matmul precision.
+- ``TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES`` (int, default 2**31) — cutover
+  for the out-of-core streamed fit: DataFrame fits whose estimated device
+  footprint (rows × n × wire-dtype bytes) exceeds this stream chunk-wise
+  through the donated-carry fold pipeline (spark.ingest.stream_fold) at
+  O(chunk + n²) device memory instead of materializing the full resident
+  array. Small data keeps the resident path — it is still fastest when it
+  fits.
 - ``TPU_ML_COMPILE_CACHE``   (path, default ``~/.cache/spark_rapids_ml_tpu/
   xla``; empty string disables) — persistent XLA compilation cache shared by
   every process of a deployment. In-process executable reuse is handled by
@@ -55,6 +62,11 @@ class RuntimeConfig:
     max_workers: int = field(default_factory=lambda: _int_env("TPU_ML_MAX_WORKERS", 4))
     task_retries: int = field(default_factory=lambda: _int_env("TPU_ML_TASK_RETRIES", 3))
     default_precision: str = field(default_factory=_precision_env)
+    stream_fit_max_resident_bytes: int = field(
+        default_factory=lambda: _int_env(
+            "TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES", 1 << 31
+        )
+    )
 
 
 _config: RuntimeConfig | None = None

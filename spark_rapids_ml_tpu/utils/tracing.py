@@ -7,6 +7,13 @@ TensorBoard: ``jax.profiler.TraceAnnotation`` marks host spans and
 ``jax.named_scope`` tags the traced HLO so the phases are findable in a
 device profile. ``trace_range`` layers both, plus wall-clock accounting into
 a process-local metrics registry (the observability the reference lacked).
+
+The streamed-fit pipeline (``spark.ingest.stream_fold``) emits three spans
+per fit: ``ingest.chunk`` (host-side pull + staging of one inbound chunk),
+``fold.dispatch`` (device_put + async fold launch), and ``fold.wait`` (the
+single terminal block on the carry). In a profile, ``fold.dispatch`` spans
+landing inside device execution of the previous fold are the visible
+signature of H2D/compute double buffering.
 """
 
 from __future__ import annotations

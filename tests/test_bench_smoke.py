@@ -1,0 +1,50 @@
+"""Tier-1 guard for the bench harness: ``bench.py --smoke`` must keep
+producing its JSON contract — including the ``streamed_fit_rows_per_s``
+out-of-core metric — on the CPU backend.
+
+Runs the bench as a subprocess (it owns platform/x64 setup) with the shared
+compilation cache so repeat runs stay cheap.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_json_contract():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR="/tmp/jax_test_cache",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+
+    json_lines = [
+        ln for ln in proc.stdout.splitlines() if ln.startswith("{")
+    ]
+    assert json_lines, f"no JSON line in bench output:\n{proc.stdout[-2000:]}"
+    data = json.loads(json_lines[-1])
+
+    assert data["value"] > 0
+    assert data["unit"]
+    assert "SMOKE" in data["metric"]
+
+    extras = {m["metric"]: m for m in data["extra_metrics"]}
+    assert "streamed_fit_rows_per_s" in extras, sorted(extras)
+    sf = extras["streamed_fit_rows_per_s"]
+    assert sf["unit"] == "rows/s"
+    assert sf["value"] > 0
+    # pipeline introspection must ride along so perf regressions in the
+    # overlap machinery are visible in the bench record
+    assert "overlapped_dispatches" in sf

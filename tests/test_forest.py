@@ -323,6 +323,12 @@ def test_subset_size_strategies():
     assert subset_size("0.5", 10, classification=True) == 5
     assert subset_size("0.15", 10, classification=True) == 2  # Spark ceils
     assert subset_size("4", 10, classification=True) == 4
+    # Spark ceils the named strategies too (RandomForestParams):
+    # ceil(√10)=4 not 3, ceil(log₂10)=4 not 3, ceil(10/3)=4 not 3
+    assert subset_size("sqrt", 10, classification=True) == 4
+    assert subset_size("log2", 10, classification=True) == 4
+    assert subset_size("onethird", 10, classification=False) == 4
+    assert subset_size("auto", 10, classification=True) == 4
     with pytest.raises(ValueError):
         subset_size("bogus", 10, classification=True)
 

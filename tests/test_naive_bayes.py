@@ -18,11 +18,23 @@ def count_data():
     return x, y
 
 
+def _spark_priors(y, n_classes, lam):
+    """Spark's smoothed class priors (NaiveBayes.scala piLogDenom):
+    (n_i + λ)/(N + λ·C) — probability space, for sklearn's class_prior."""
+    counts = np.array([(y == c).sum() for c in range(n_classes)], float)
+    return (counts + lam) / (counts.sum() + lam * n_classes)
+
+
 def test_multinomial_matches_sklearn_parameters(count_data):
     sk_nb = pytest.importorskip("sklearn.naive_bayes")
     x, y = count_data
     m = NaiveBayes().setSmoothing(1.0).fit((x, y))
-    sk = sk_nb.MultinomialNB(alpha=1.0).fit(x, y)
+    # sklearn's default prior is the unsmoothed log(n_i/N); Spark smooths
+    # the prior with the same λ as the likelihoods, so hand sklearn the
+    # smoothed prior explicitly and the two models must agree exactly
+    sk = sk_nb.MultinomialNB(
+        alpha=1.0, class_prior=_spark_priors(y, 3, 1.0)
+    ).fit(x, y)
     np.testing.assert_allclose(m.pi, sk.class_log_prior_, rtol=1e-12)
     np.testing.assert_allclose(m.theta, sk.feature_log_prob_, rtol=1e-12)
     np.testing.assert_array_equal(m._predict_matrix(x), sk.predict(x))
@@ -30,12 +42,33 @@ def test_multinomial_matches_sklearn_parameters(count_data):
     np.testing.assert_allclose(proba, sk.predict_proba(x[:50]), atol=1e-10)
 
 
+def test_class_priors_match_spark_smoothing_formula(count_data):
+    """Documented Spark parity: π_i = log((n_i + λ)/(N + λ·C)) — including
+    a class with zero observed rows, whose prior stays finite."""
+    x, y = count_data
+    lam = 0.7
+    m = NaiveBayes().setSmoothing(lam).fit((x, y))
+    counts = np.array([(y == c).sum() for c in range(3)], float)
+    expected = np.log((counts + lam) / (counts.sum() + lam * 3))
+    np.testing.assert_allclose(m.pi, expected, rtol=1e-12)
+
+    # empty class: relabel class 1 into 0; label 2 keeps the 3-class space
+    y2 = np.where(y == 1, 0.0, y)
+    m2 = NaiveBayes().setSmoothing(lam).fit((x, y2))
+    assert np.isfinite(m2.pi).all()
+    counts2 = np.array([(y2 == c).sum() for c in range(3)], float)
+    expected2 = np.log((counts2 + lam) / (counts2.sum() + lam * 3))
+    np.testing.assert_allclose(m2.pi, expected2, rtol=1e-12)
+
+
 def test_bernoulli_matches_sklearn(count_data):
     sk_nb = pytest.importorskip("sklearn.naive_bayes")
     x, y = count_data
     xb = (x > 3).astype(float)
     m = NaiveBayes().setModelType("bernoulli").setSmoothing(1.0).fit((xb, y))
-    sk = sk_nb.BernoulliNB(alpha=1.0).fit(xb, y)
+    sk = sk_nb.BernoulliNB(
+        alpha=1.0, class_prior=_spark_priors(y, 3, 1.0)
+    ).fit(xb, y)
     np.testing.assert_allclose(m.theta, sk.feature_log_prob_, rtol=1e-12)
     np.testing.assert_array_equal(m._predict_matrix(xb), sk.predict(xb))
 
@@ -46,7 +79,9 @@ def test_gaussian_matches_sklearn(count_data):
     x = rng.normal(size=(600, 5)) + rng.integers(0, 2, size=600)[:, None] * 3
     y = (x[:, 0] > 1.5).astype(float)
     m = NaiveBayes().setModelType("gaussian").fit((x, y))
-    sk = sk_nb.GaussianNB(var_smoothing=0.0).fit(x, y)
+    sk = sk_nb.GaussianNB(
+        var_smoothing=0.0, priors=_spark_priors(y, 2, 1.0)
+    ).fit(x, y)
     np.testing.assert_allclose(m.theta, sk.theta_, rtol=1e-10)
     np.testing.assert_allclose(m.sigma, sk.var_, rtol=1e-8)
     agree = (m._predict_matrix(x) == sk.predict(x)).mean()
