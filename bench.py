@@ -149,8 +149,14 @@ def _paired_slope(short_call, long_call, iter_delta: int, reps: int):
     ``reps`` times, take the median (r2 weak #4: min-of-N drifted 27%).
     Raises on a non-positive median — a noisy inversion must fail the
     metric loudly, never publish a negative throughput."""
+    from spark_rapids_ml_tpu.telemetry import reset_metrics
+
     slopes = []
     for _ in range(reps):
+        # per-pair registry window: phase numbers in the embedded telemetry
+        # snapshot attribute to the LAST (short, long) pair of the last
+        # metric, never to the whole accumulated session
+        reset_metrics()
         t0 = time.perf_counter()
         short_call()
         t_short = time.perf_counter() - t0
@@ -356,6 +362,13 @@ def main() -> None:
 
     accuracy_ok = bool(min_cosine >= 0.9999)
     tag = "_SMOKE" if SMOKE else ""
+
+    # full-registry telemetry snapshot riding the JSON line: per-phase span
+    # percentiles + ingest/collective/compile counters make each BENCH_r*
+    # round phase-attributable without a separate profiling session
+    from spark_rapids_ml_tpu.telemetry import snapshot_dict
+
+    telemetry_snapshot = snapshot_dict()
     # Raw throughput alongside the modeled vs_baseline (r3 verdict weak #4:
     # "publishing the raw TF/s and MXU-utilization makes it harder to fool
     # ourselves" — the A100 roofline model stays, but these numbers are
@@ -406,6 +419,7 @@ def main() -> None:
                     "pairs": PAIRS,
                 },
                 "derived": derived,
+                "telemetry": telemetry_snapshot,
                 "extra_metrics": [
                     {
                         "metric": f"pca_transform_throughput_{N}f_k{K}",

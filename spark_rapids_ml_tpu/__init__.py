@@ -22,7 +22,27 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
                  host-side fallback linalg backend behind a C ABI.
 """
 
+import logging as _logging
+import os as _os
+
 __version__ = "0.1.0"
+
+# Library logging etiquette: a NullHandler so applications without logging
+# config never see "No handler could be found" (and never get surprise
+# stderr), plus the TPU_ML_LOG_LEVEL escape hatch — level name or number —
+# for turning on the library's debug stream without touching code. Routing
+# records to an output stays the application's job.
+_logger = _logging.getLogger(__name__)
+if not any(isinstance(h, _logging.NullHandler) for h in _logger.handlers):
+    _logger.addHandler(_logging.NullHandler())
+_level = _os.environ.get("TPU_ML_LOG_LEVEL", "")
+if _level:
+    try:
+        _logger.setLevel(
+            int(_level) if _level.isdigit() else _level.upper()
+        )
+    except ValueError:
+        _logger.warning("ignoring invalid TPU_ML_LOG_LEVEL=%r", _level)
 
 
 def __getattr__(name):

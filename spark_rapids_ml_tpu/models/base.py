@@ -14,7 +14,9 @@ same ``PCAModel.load(path)`` call.
 
 from __future__ import annotations
 
+import functools
 import importlib
+import threading
 from typing import Any
 
 import numpy as np
@@ -200,10 +202,63 @@ class Transformer(Saveable):
         raise NotImplementedError
 
 
+# Fit-nesting depth per thread: SparkPCA.fit → core PCA.fit, CrossValidator
+# → sub-estimator fits. Every level gets its own FitReport (the inner one is
+# a sub-window of the outer), but only the OUTERMOST fit exports to the
+# JSONL sink — one user-visible fit() is one sink line.
+_fit_depth = threading.local()
+
+
+def _instrumented_fit(fit):
+    """Wrap one class's ``fit`` with telemetry capture.
+
+    Applied by ``Estimator.__init_subclass__`` to every subclass that
+    defines its own ``fit`` — the 20+ estimators get FitReport/JSONL
+    behavior with zero per-estimator code. The telemetry import is deferred
+    to call time so importing ``models.base`` never pulls in jax.
+    """
+
+    @functools.wraps(fit)
+    def fit_with_telemetry(self, *args, **kwargs):
+        from spark_rapids_ml_tpu import telemetry
+
+        depth = getattr(_fit_depth, "value", 0)
+        _fit_depth.value = depth + 1
+        cap = telemetry.begin_fit(
+            type(self).__name__, getattr(self, "uid", "") or ""
+        )
+        try:
+            model = fit(self, *args, **kwargs)
+        finally:
+            _fit_depth.value = depth
+            report = telemetry.end_fit(cap)
+        telemetry.attach_report(model, report)
+        if depth == 0:
+            telemetry.export_fit_report(report)
+        return model
+
+    fit_with_telemetry._telemetry_wrapped = True
+    return fit_with_telemetry
+
+
 class Estimator(Saveable):
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        fit = cls.__dict__.get("fit")
+        if fit is not None and not getattr(fit, "_telemetry_wrapped", False):
+            cls.fit = _instrumented_fit(fit)
+
     def fit(self, dataset: Any) -> "Model":
         raise NotImplementedError
 
 
 class Model(Transformer):
-    """A fitted Transformer produced by an Estimator."""
+    """A fitted Transformer produced by an Estimator.
+
+    ``fit_report`` is the :class:`~spark_rapids_ml_tpu.telemetry.FitReport`
+    of the fit that produced this model (phase latency percentiles,
+    rows/bytes ingested, compile cost, peak device memory); ``None`` on
+    loaded models — telemetry describes a fit, not a file.
+    """
+
+    fit_report = None

@@ -28,6 +28,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, FEAT_AXIS, shard_map
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+
+def _count_collectives(kind: str, n_ops: float, payload_bytes: float) -> None:
+    """Book cross-device traffic into the registry. Collectives live inside
+    jitted programs, so the accounting happens here at the host call sites:
+    ``n_ops`` launches moving ``payload_bytes`` per launch (logical payload,
+    not the ICI wire schedule XLA actually picks)."""
+    REGISTRY.counter_inc("collective.count", n_ops, kind=kind)
+    REGISTRY.counter_inc("collective.bytes", n_ops * payload_bytes, kind=kind)
 
 
 @lru_cache(maxsize=None)
@@ -54,6 +64,9 @@ def sharded_gram_stats(
     (the DataFrame path calls this once per ``fit()``) reuse the executable
     instead of re-tracing a fresh closure each time.
     """
+    n = x.shape[1]
+    # one psum of GramStats: [n, n] gram + [n] col_sum + scalar count
+    _count_collectives("psum", 1, (n * n + n + 1) * x.dtype.itemsize)
     return _gram_stats_prog(mesh, precision)(x)
 
 
@@ -67,6 +80,8 @@ def _moment_stats_prog(mesh: Mesh):
 
 def sharded_moment_stats(x: jax.Array, mesh: Mesh):
     """Data-parallel StandardScaler moments: local sums + psum over ICI."""
+    n = x.shape[1]
+    _count_collectives("psum", 1, (2 * n + 1) * x.dtype.itemsize)
     return _moment_stats_prog(mesh)(x)
 
 
@@ -85,6 +100,14 @@ def ring_gram(
     t computes XⱼᵀX₍ⱼ₊ₜ₎ — F·(C×C) MXU matmuls per device, F−1 neighbor
     transfers, zero host involvement.
     """
+    n_feat = mesh.shape[FEAT_AXIS]
+    rows_local = x.shape[0] // max(mesh.shape[DATA_AXIS], 1)
+    c = x.shape[1] // max(n_feat, 1)
+    item = x.dtype.itemsize
+    # F ring steps each moving a [rows_local, c] visiting block ...
+    _count_collectives("ppermute", n_feat, rows_local * c * item)
+    # ... then the block-row psum, col_sum psum+all_gather, count psum
+    _count_collectives("psum", 3, (c * (c * n_feat) + c + 1) * item)
     return _ring_gram_prog(mesh, precision)(x)
 
 
@@ -214,6 +237,8 @@ def sharded_range_stats(x: jax.Array, w: jax.Array, mesh: Mesh):
     MinMax/MaxAbs/Robust/QuantileDiscretizer statistic: local masked
     reductions, then pmin/pmax (the family's one non-additive fold) over
     ICI. ``w`` is the ingest pad mask (0 on pad rows)."""
+    # psum(count) + pmin + 2×pmax, each over an [n]-ish vector
+    _count_collectives("preduce", 4, x.shape[1] * x.dtype.itemsize)
     return _range_stats_prog(mesh)(x, w)
 
 
@@ -248,6 +273,7 @@ def sharded_histogram(
     """Data-parallel fixed-bin histograms (the quantile sketch) over the
     mesh: one scatter-add per column per shard + a psum — pad rows carry
     zero weight and never count."""
+    _count_collectives("psum", 1, x.shape[1] * bins * x.dtype.itemsize)
     return _histogram_prog(mesh, bins)(x, w, mins, maxs)
 
 
@@ -312,6 +338,12 @@ def finalize_chunk_fold(carry, mesh: Mesh):
     the ONE cross-device reduction of a streamed fit (vs one per chunk)."""
     from spark_rapids_ml_tpu.parallel.backend import allreduce
 
+    leaves = jax.tree_util.tree_leaves(carry)
+    _count_collectives(
+        "allreduce",
+        len(leaves),
+        sum(getattr(leaf, "nbytes", 0) for leaf in leaves) / max(len(leaves), 1),
+    )
     return jax.tree.map(lambda v: allreduce(v, mesh, DATA_AXIS), carry)
 
 

@@ -16,7 +16,26 @@ from __future__ import annotations
 
 from typing import Callable, Sequence, TypeVar
 
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
 T = TypeVar("T")
+
+
+def _payload_bytes(item) -> int:
+    """Best-effort size of one reduction operand: ndarrays/jax arrays report
+    ``nbytes``; dataclass-ish stat bundles sum their array fields; anything
+    opaque counts 0 (the combine count is still booked)."""
+    nb = getattr(item, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    fields = getattr(item, "__dataclass_fields__", None)
+    if fields:
+        return sum(
+            int(getattr(getattr(item, f), "nbytes", 0) or 0) for f in fields
+        )
+    if isinstance(item, (tuple, list)):
+        return sum(_payload_bytes(v) for v in item)
+    return 0
 
 
 def tree_reduce(items: Sequence[T], combine: Callable[[T, T], T]) -> T:
@@ -24,6 +43,14 @@ def tree_reduce(items: Sequence[T], combine: Callable[[T, T], T]) -> T:
     items = list(items)
     if not items:
         raise ValueError("cannot reduce an empty sequence")
+    if len(items) > 1:
+        # n-1 pairwise combines, each merging two partials of this payload
+        REGISTRY.counter_inc("collective.tree_combines", len(items) - 1)
+        REGISTRY.counter_inc(
+            "collective.bytes",
+            (len(items) - 1) * 2 * _payload_bytes(items[0]),
+            kind="tree",
+        )
     while len(items) > 1:
         nxt = []
         for i in range(0, len(items) - 1, 2):

@@ -486,13 +486,28 @@ def _collect_stats(
     driver (toArrow on PySpark >= 4, collect() fallback below). The fold is
     per-field np.add unless ``combine`` overrides it (the range scalers'
     min/max monoid)."""
+    from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
     T, _ = _sql_mods(df)
     stats_df = df.mapInArrow(partition_fn, schema=_spark_arrays_type(T, fields))
     if hasattr(stats_df, "toArrow"):
-        return arrow_fns.arrays_from_batches(
+        out = arrow_fns.arrays_from_batches(
             stats_df.toArrow().to_batches(), shapes, combine
         )
-    return arrow_fns.arrays_from_rows(stats_df.collect(), shapes, combine)
+    else:
+        out = arrow_fns.arrays_from_rows(stats_df.collect(), shapes, combine)
+    # what the driver-merge deployment actually ships executor→driver: one
+    # stats bundle of these shapes per partition (post-fold we only see the
+    # merged arrays; per-bundle size × partition count is booked elsewhere —
+    # this counter records the merged payload as the lower bound)
+    REGISTRY.counter_inc(
+        "drivermerge.bytes",
+        sum(getattr(v, "nbytes", 0) for v in out.values())
+        if isinstance(out, dict)
+        else 0,
+    )
+    REGISTRY.counter_inc("drivermerge.passes")
+    return out
 
 
 def _resolve_col(obj, *names) -> str | None:

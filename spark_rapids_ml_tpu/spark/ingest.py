@@ -40,6 +40,7 @@ from typing import Any, Iterator
 
 import numpy as np
 
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 from spark_rapids_ml_tpu.utils import columnar
 
 WIRE_DTYPE_VAR = "TPU_ML_MESH_LOCAL_WIRE_DTYPE"
@@ -312,11 +313,15 @@ def stream_to_mesh(
     def flush():
         nonlocal x_buf, y_buf, w_buf, fill
         d = devices[len(x_parts)]
+        nbytes = x_buf.nbytes
         x_parts.append(jax.device_put(x_buf, d))
         if want_y:
+            nbytes += y_buf.nbytes
             y_parts.append(jax.device_put(y_buf, d))
         if want_w:
+            nbytes += w_buf.nbytes
             w_parts.append(jax.device_put(w_buf, d))
+        REGISTRY.counter_inc("h2d.bytes", nbytes, path="mesh")
         x_buf, y_buf, w_buf = fresh()
         fill = 0
 
@@ -324,6 +329,9 @@ def stream_to_mesh(
         selected, features_col, label_col, weight_col,
         est_bytes=rows * n * 8,
     ):
+        REGISTRY.counter_inc("ingest.rows", len(xc))
+        REGISTRY.counter_inc("ingest.bytes", xc.nbytes)
+        REGISTRY.histogram_record("ingest.chunk_rows", len(xc))
         if xc.shape[1] != n:
             raise ValueError(
                 f"feature dimension changed mid-stream: expected {n}, got "
@@ -457,7 +465,7 @@ def stream_fold(
     chunk i's fold executes on the MXU, the host is already extracting and
     ``device_put``-ing chunk i+1. Each phase is traced
     (``ingest.chunk`` / ``fold.dispatch`` / ``fold.wait``,
-    utils.tracing.metrics()) so the overlap is observable.
+    telemetry.metrics()) so the overlap is observable.
 
     ``source`` is either a DataFrame-shaped object (localspark / pyspark —
     drained via the same strategy-gated ``_iter_chunks`` the resident
@@ -474,7 +482,7 @@ def stream_fold(
     """
     import jax
 
-    from spark_rapids_ml_tpu.utils.tracing import trace_range
+    from spark_rapids_ml_tpu.telemetry import trace_range
 
     dt = wire_dtype()
     n_eff = n + 1 if augment_intercept else n
@@ -554,6 +562,7 @@ def stream_fold(
         if busy:
             overlapped += 1
         max_put = max(max_put, nbytes)
+        REGISTRY.counter_inc("h2d.bytes", nbytes, path="stream")
         n_chunks += 1
         # never reuse a put buffer: device_put of a host ndarray may alias
         # rather than copy on some backends (stream_to_mesh rationale)
@@ -561,6 +570,9 @@ def stream_fold(
         fill = 0
 
     for xc, yc, wc in timed_chunks():
+        REGISTRY.counter_inc("ingest.rows", len(xc))
+        REGISTRY.counter_inc("ingest.bytes", xc.nbytes)
+        REGISTRY.histogram_record("ingest.chunk_rows", len(xc))
         if xc.ndim != 2 or xc.shape[1] != n:
             raise ValueError(
                 f"feature dimension changed mid-stream: expected {n}, got "

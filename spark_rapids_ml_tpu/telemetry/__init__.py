@@ -1,0 +1,79 @@
+"""Structured telemetry: metrics registry, spans, fit reports, JSONL export.
+
+Public surface (everything the rest of the framework and user code needs):
+
+- ``REGISTRY`` / ``counter_inc`` / ``gauge_set`` / ``histogram_record`` —
+  the process-local metric store (:mod:`.registry`).
+- ``trace_range`` — host+device trace span with latency accounting
+  (:mod:`.spans`); ``metrics()`` / ``reset_metrics()`` keep the legacy
+  ``utils.tracing`` read shape.
+- ``FitReport`` / ``begin_fit`` / ``end_fit`` — per-fit capture windows
+  (:mod:`.report`), wired automatically through ``models.base``.
+- ``export_fit_report`` / ``read_jsonl`` — the ``TPU_ML_TELEMETRY_PATH``
+  JSONL sink (:mod:`.export`).
+- ``install_monitoring`` / ``sample_device_memory`` — jax.monitoring
+  compile listeners and device-memory gauges (:mod:`.compilemon`).
+- ``snapshot_dict`` — full-registry JSON snapshot (bench embedding).
+"""
+
+from spark_rapids_ml_tpu.telemetry.registry import (
+    REGISTRY,
+    Histogram,
+    MetricsRegistry,
+    RegistrySnapshot,
+    counter_inc,
+    gauge_set,
+    histogram_record,
+    metrics,
+    render_key,
+    reset_metrics,
+)
+from spark_rapids_ml_tpu.telemetry.spans import (
+    current_estimator,
+    reset_current_estimator,
+    set_current_estimator,
+    trace_range,
+)
+from spark_rapids_ml_tpu.telemetry.compilemon import (
+    install_monitoring,
+    sample_device_memory,
+)
+from spark_rapids_ml_tpu.telemetry.report import (
+    FitReport,
+    attach_report,
+    begin_fit,
+    end_fit,
+    snapshot_dict,
+)
+from spark_rapids_ml_tpu.telemetry.export import (
+    export_fit_report,
+    read_jsonl,
+    telemetry_path,
+)
+
+__all__ = [
+    "REGISTRY",
+    "Histogram",
+    "MetricsRegistry",
+    "RegistrySnapshot",
+    "counter_inc",
+    "gauge_set",
+    "histogram_record",
+    "metrics",
+    "render_key",
+    "reset_metrics",
+    "current_estimator",
+    "reset_current_estimator",
+    "set_current_estimator",
+    "trace_range",
+    "install_monitoring",
+    "sample_device_memory",
+    "FitReport",
+    "attach_report",
+    "begin_fit",
+    "end_fit",
+    "snapshot_dict",
+    "export_fit_report",
+    "read_jsonl",
+    "telemetry_path",
+]

@@ -1,0 +1,68 @@
+"""JSONL sink for fit reports.
+
+One fitted model → one line in the file named by ``TPU_ML_TELEMETRY_PATH``
+(read through :mod:`utils.config`, so it is also settable per-session via
+``set_config(telemetry_path=...)``). The write is a single ``os.write`` on
+an ``O_APPEND`` descriptor: POSIX appends of one small buffer land intact
+even when several localspark worker processes share the file, so no lock
+file or fsync dance is needed. Export failures are logged and swallowed —
+telemetry must never be the reason a fit fails.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+logger = logging.getLogger("spark_rapids_ml_tpu")
+
+
+def telemetry_path() -> str:
+    """The configured sink path ('' = disabled)."""
+    from spark_rapids_ml_tpu.utils.config import get_config
+
+    return get_config().telemetry_path
+
+
+def export_fit_report(report, path: str | None = None) -> bool:
+    """Append one ``fit_report`` JSONL record; returns True if written.
+
+    ``path=None`` uses the configured sink and is a silent no-op when that
+    is unset. The record is ``report.to_dict()`` serialized compactly on a
+    single line.
+    """
+    if path is None:
+        path = telemetry_path()
+    if not path:
+        return False
+    try:
+        data = (
+            json.dumps(report.to_dict(), separators=(",", ":"), sort_keys=True)
+            + "\n"
+        ).encode()
+        fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+        return True
+    except Exception:
+        logger.warning("telemetry export to %s failed", path, exc_info=True)
+        return False
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Parse a telemetry JSONL file, skipping blank/corrupt lines (a torn
+    line from a crashed process shouldn't hide every other record)."""
+    records: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                logger.debug("skipping corrupt telemetry line in %s", path)
+    return records

@@ -1,0 +1,201 @@
+"""Per-fit telemetry capture: the ``FitReport`` attached to every model.
+
+The registry accumulates per-process; a user asking "where did THIS fit's
+time go" needs the interval. ``begin_fit``/``end_fit`` bracket one
+``Estimator.fit`` call (wired once in ``models.base`` so all estimators —
+core and Spark-facing — get it without per-estimator code): snapshot the
+registry, stamp the estimator name into the span context, and on exit build
+a :class:`FitReport` from the snapshot delta — per-phase latency
+percentiles, rows/bytes ingested, H2D bytes, collective count/payload,
+compile count/seconds/cache traffic, and the per-device peak memory sampled
+at fit end.
+
+Nested fits (CrossValidator → estimator, SparkPCA → core PCA, OneVsRest →
+per-class fits) each get their own report — the inner report is a subset
+window of the outer — but only the OUTERMOST fit is exported to the JSONL
+sink, so one user-visible ``fit()`` is one sink line.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from spark_rapids_ml_tpu.telemetry import compilemon, spans
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY, render_key
+
+SCHEMA_VERSION = 1
+
+
+@dataclass
+class FitReport:
+    """Everything observed during one ``fit()`` call.
+
+    ``phases`` maps span name → ``{count, sum, min, max, p50, p90, p99}``
+    seconds. ``rows_ingested``/``bytes_ingested`` count the data-path layer
+    that actually ran: the streamed/mesh ingest counters when the fit went
+    through ``spark.ingest``, else the columnar extraction counters.
+    ``device_memory`` is the fit-end ``memory_stats()`` sample per device
+    (``peak_bytes_in_use`` is process-lifetime peak — an upper bound for
+    the fit, exact when the fit is the process's big allocation).
+    """
+
+    estimator: str
+    uid: str
+    wall_seconds: float
+    phases: dict[str, dict[str, float]] = field(default_factory=dict)
+    rows_ingested: int = 0
+    bytes_ingested: int = 0
+    h2d_bytes: int = 0
+    collectives: dict[str, float] = field(default_factory=dict)
+    compile: dict[str, float] = field(default_factory=dict)
+    device_memory: dict[str, dict[str, int]] = field(default_factory=dict)
+    counters: dict[str, float] = field(default_factory=dict)
+    timestamp_unix: float = 0.0
+    schema: int = SCHEMA_VERSION
+
+    @property
+    def peak_device_bytes(self) -> int:
+        """Max ``peak_bytes_in_use`` across devices (0 when unavailable)."""
+        return max(
+            (m.get("peak_bytes_in_use", 0) for m in self.device_memory.values()),
+            default=0,
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "type": "fit_report",
+            "schema": self.schema,
+            "estimator": self.estimator,
+            "uid": self.uid,
+            "timestamp_unix": self.timestamp_unix,
+            "wall_seconds": self.wall_seconds,
+            "phases": self.phases,
+            "rows_ingested": self.rows_ingested,
+            "bytes_ingested": self.bytes_ingested,
+            "h2d_bytes": self.h2d_bytes,
+            "collectives": self.collectives,
+            "compile": self.compile,
+            "device_memory": self.device_memory,
+            "peak_device_bytes": self.peak_device_bytes,
+            "counters": self.counters,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FitReport":
+        return cls(
+            estimator=d.get("estimator", ""),
+            uid=d.get("uid", ""),
+            wall_seconds=float(d.get("wall_seconds", 0.0)),
+            phases=d.get("phases", {}),
+            rows_ingested=int(d.get("rows_ingested", 0)),
+            bytes_ingested=int(d.get("bytes_ingested", 0)),
+            h2d_bytes=int(d.get("h2d_bytes", 0)),
+            collectives=d.get("collectives", {}),
+            compile=d.get("compile", {}),
+            device_memory=d.get("device_memory", {}),
+            counters=d.get("counters", {}),
+            timestamp_unix=float(d.get("timestamp_unix", 0.0)),
+            schema=int(d.get("schema", SCHEMA_VERSION)),
+        )
+
+
+class _FitCapture:
+    __slots__ = ("estimator", "uid", "token", "snap", "t0", "t_unix")
+
+    def __init__(self, estimator: str, uid: str, token, snap, t0: float):
+        self.estimator = estimator
+        self.uid = uid
+        self.token = token
+        self.snap = snap
+        self.t0 = t0
+        self.t_unix = time.time()
+
+
+def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
+    """Open a capture window: install the compile listeners (first call
+    only), snapshot the registry, and label subsequent spans with the
+    estimator name."""
+    compilemon.install_monitoring()
+    return _FitCapture(
+        estimator=estimator,
+        uid=uid,
+        token=spans.set_current_estimator(estimator),
+        snap=REGISTRY.snapshot(),
+        t0=time.perf_counter(),
+    )
+
+
+# counters folded into dedicated report fields; everything else lands in
+# FitReport.counters verbatim
+_INGEST_ROWS = "ingest.rows"
+_INGEST_BYTES = "ingest.bytes"
+_COLUMNAR_ROWS = "columnar.rows"
+_COLUMNAR_BYTES = "columnar.bytes"
+
+
+def end_fit(cap: _FitCapture) -> FitReport:
+    """Close a capture window and build the report from the delta. Always
+    call (a ``finally`` in the fit wrapper) so the estimator span label is
+    restored even when the fit raised."""
+    wall = time.perf_counter() - cap.t0
+    spans.reset_current_estimator(cap.token)
+    device_memory = compilemon.sample_device_memory()
+    delta = REGISTRY.snapshot().delta(cap.snap)
+
+    ingest_rows = int(delta.counter(_INGEST_ROWS))
+    ingest_bytes = int(delta.counter(_INGEST_BYTES))
+    # the streamed/mesh ingest layer re-extracts through columnar, so when
+    # it ran, its counters are THE data-path numbers; pure in-core fits only
+    # ever touch the columnar extractors
+    rows = ingest_rows or int(delta.counter(_COLUMNAR_ROWS))
+    nbytes = ingest_bytes or int(delta.counter(_COLUMNAR_BYTES))
+
+    compile_hist = delta.hist("compile.seconds")
+    counters = {
+        render_key(k): v
+        for k, v in sorted(delta.counters.items())
+        if k[0]
+        not in (_INGEST_ROWS, _INGEST_BYTES, _COLUMNAR_ROWS, _COLUMNAR_BYTES)
+        and not k[0].startswith(("compile.", "collective.", "h2d."))
+    }
+    return FitReport(
+        estimator=cap.estimator,
+        uid=cap.uid,
+        wall_seconds=wall,
+        phases=delta.phase_table(),
+        rows_ingested=rows,
+        bytes_ingested=nbytes,
+        h2d_bytes=int(delta.counter("h2d.bytes")),
+        collectives={
+            "count": delta.counter("collective.count"),
+            "bytes": delta.counter("collective.bytes"),
+            "tree_combines": delta.counter("collective.tree_combines"),
+        },
+        compile={
+            "count": compile_hist.count,
+            "seconds": compile_hist.total,
+            "trace_seconds": delta.hist("compile.trace_seconds").total,
+            "cache_hits": delta.counter("compile.cache_hits"),
+            "cache_misses": delta.counter("compile.cache_misses"),
+        },
+        device_memory=device_memory,
+        counters=counters,
+        timestamp_unix=cap.t_unix,
+    )
+
+
+def snapshot_dict(percentiles=(50, 90, 99)) -> dict:
+    """The full registry state as a JSON-shaped dict — what ``bench.py``
+    embeds in its emitted line so rounds are phase-attributable."""
+    return REGISTRY.snapshot().to_dict(percentiles)
+
+
+def attach_report(model: Any, report: FitReport) -> None:
+    """Best-effort ``model.fit_report = report`` (never breaks a fit over a
+    slots/frozen model class)."""
+    try:
+        model.fit_report = report
+    except (AttributeError, TypeError):  # pragma: no cover - exotic models
+        pass

@@ -1,0 +1,71 @@
+"""Tracing / profiling annotations — the NVTX-range analog, registry-backed.
+
+The reference wraps its two training phases in NVTX ranges visible in Nsight
+(``NvtxRange("compute cov", RED)`` / ``NvtxRange("cuSolver SVD", BLUE)``,
+RapidsRowMatrix.scala:62,70). On TPU the equivalent surface is xprof /
+TensorBoard: ``jax.profiler.TraceAnnotation`` marks host spans and
+``jax.named_scope`` tags the traced HLO so the phases are findable in a
+device profile. ``trace_range`` layers both, plus wall-clock accounting into
+the telemetry registry as a ``span.seconds`` histogram labeled with the
+phase name and the estimator currently fitting (set by the ``models.base``
+fit instrumentation) — so one fit later reads back as per-phase latency
+percentiles, not just sums.
+
+Accounting is in a ``finally`` block: a body that raises still books its
+elapsed time (a fit that dies 40 s into ``compute cov`` must show those
+40 s, or the post-mortem blames the wrong phase).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import time
+
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+logger = logging.getLogger("spark_rapids_ml_tpu")
+
+# Which estimator's fit() this thread/context is inside — stamps every span
+# recorded during the fit so phase latencies group by estimator without each
+# trace_range call site threading a label through.
+_current_estimator: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "tpu_ml_current_estimator", default=None
+)
+
+
+def current_estimator() -> str | None:
+    return _current_estimator.get()
+
+
+def set_current_estimator(name: str | None):
+    """Returns the reset token (contextvars protocol)."""
+    return _current_estimator.set(name)
+
+
+def reset_current_estimator(token) -> None:
+    _current_estimator.reset(token)
+
+
+@contextlib.contextmanager
+def trace_range(name: str):
+    """Host+device trace span with registry-backed latency accounting."""
+    # deferred so importing telemetry (and through it columnar/ingest, which
+    # run in jax-free worker ingestion processes) never pulls in jax; after
+    # the first call this is one sys.modules lookup
+    import jax
+
+    start = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+            yield
+    finally:
+        elapsed = time.perf_counter() - start
+        REGISTRY.histogram_record(
+            "span.seconds",
+            elapsed,
+            phase=name,
+            estimator=_current_estimator.get() or "",
+        )
+        logger.debug("trace %s: %.3fs", name, elapsed)

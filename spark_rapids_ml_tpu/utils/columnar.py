@@ -29,6 +29,8 @@ from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
 
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
 try:  # pyarrow is present in the image, but keep the core importable without it
     import pyarrow as pa
 except Exception:  # pragma: no cover
@@ -181,7 +183,21 @@ def extract_matrix(data: Any, input_col: str | None = None) -> np.ndarray:
     fixed-size-list column named ``input_col``); pandas DataFrame whose
     ``input_col`` holds per-row arrays/lists (the ArrayType shape); and
     sequences of per-row arrays.
+
+    This is the Arrow-collect measuring point: every extraction books its
+    rows/bytes into the telemetry registry (``columnar.rows`` /
+    ``columnar.bytes``), so in-core fits report throughput the same way
+    streamed ones do.
     """
+    out = _extract_matrix(data, input_col)
+    REGISTRY.counter_inc("columnar.rows", out.shape[0])
+    REGISTRY.counter_inc(
+        "columnar.bytes", getattr(out, "nbytes", out.size * 8)
+    )
+    return out
+
+
+def _extract_matrix(data: Any, input_col: str | None) -> np.ndarray:
     if pa is not None and isinstance(data, (pa.Table, pa.RecordBatch)):
         if input_col is None:
             raise ValueError("input_col is required for Arrow tables")
@@ -532,7 +548,10 @@ class PartitionedDataset:
             pa is not None and isinstance(data[0], (pa.Table, pa.RecordBatch))
         ):
             return PartitionedDataset(list(data), input_col)
-        x = extract_matrix(data, input_col)
+        # unbooked extraction: the telemetry rows/bytes counters fire when
+        # partitions are consumed (matrices()), so wrapping must not count
+        # the same rows a second time
+        x = _extract_matrix(data, input_col)
         if num_partitions and num_partitions > 1:
             splits = np.array_split(x, num_partitions)
         else:

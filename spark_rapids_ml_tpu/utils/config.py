@@ -27,6 +27,14 @@ module is tier 2 for the TPU build — process-level knobs read from
   saves the barrier-stage/executor WORKER processes (fresh interpreter per
   job) and repeated driver runs from paying the multi-second XLA compile on
   every fit.
+- ``TPU_ML_TELEMETRY_PATH``  (path, default ``''`` = disabled) — JSONL sink
+  for per-fit telemetry reports (``telemetry.export``). Each completed
+  ``fit()`` appends one ``fit_report`` record; render with
+  ``python tools/trace_report.py <path>``.
+- ``TPU_ML_LOG_LEVEL``       (logging level name or number, default unset) —
+  sets the ``spark_rapids_ml_tpu`` logger level at package import. The
+  package attaches only a ``logging.NullHandler``; output routing stays the
+  application's choice.
 """
 
 from __future__ import annotations
@@ -36,6 +44,9 @@ from dataclasses import dataclass, field
 
 
 VALID_PRECISIONS = ("highest", "high", "default")
+
+# config fields whose values are strings (everything else is int-typed)
+_STR_KEYS = ("default_precision", "telemetry_path")
 
 
 def _int_env(name: str, default: int) -> int:
@@ -66,6 +77,9 @@ class RuntimeConfig:
         default_factory=lambda: _int_env(
             "TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES", 1 << 31
         )
+    )
+    telemetry_path: str = field(
+        default_factory=lambda: os.environ.get("TPU_ML_TELEMETRY_PATH", "")
     )
 
 
@@ -139,7 +153,10 @@ def set_config(**overrides) -> RuntimeConfig:
             raise ValueError(
                 f"default_precision={v!r} must be one of {VALID_PRECISIONS}"
             )
-        if k != "default_precision" and not isinstance(v, int):
+        if k in _STR_KEYS:
+            if not isinstance(v, str):
+                raise TypeError(f"{k} must be a str, got {type(v).__name__}")
+        elif not isinstance(v, int):
             raise TypeError(f"{k} must be an int, got {type(v).__name__}")
         setattr(cfg, k, v)
     return cfg

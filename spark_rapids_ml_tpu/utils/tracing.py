@@ -1,53 +1,19 @@
-"""Tracing / profiling annotations — the NVTX-range analog.
+"""Back-compat shim — tracing moved to :mod:`spark_rapids_ml_tpu.telemetry`.
 
-The reference wraps its two training phases in NVTX ranges visible in Nsight
-(``NvtxRange("compute cov", RED)`` / ``NvtxRange("cuSolver SVD", BLUE)``,
-RapidsRowMatrix.scala:62,70). On TPU the equivalent surface is xprof /
-TensorBoard: ``jax.profiler.TraceAnnotation`` marks host spans and
-``jax.named_scope`` tags the traced HLO so the phases are findable in a
-device profile. ``trace_range`` layers both, plus wall-clock accounting into
-a process-local metrics registry (the observability the reference lacked).
-
-The streamed-fit pipeline (``spark.ingest.stream_fold``) emits three spans
-per fit: ``ingest.chunk`` (host-side pull + staging of one inbound chunk),
-``fold.dispatch`` (device_put + async fold launch), and ``fold.wait`` (the
-single terminal block on the carry). In a profile, ``fold.dispatch`` spans
-landing inside device execution of the previous fold are the visible
-signature of H2D/compute double buffering.
+``trace_range`` began here as the NVTX-range analog with a 53-line
+wall-clock dict; it is now backed by the telemetry registry (thread-safe,
+log-scale latency histograms, estimator labels, exception-safe
+accounting). Import sites throughout the models/spark layers keep working
+through this module; new code should import from
+``spark_rapids_ml_tpu.telemetry`` directly.
 """
 
 from __future__ import annotations
 
-import contextlib
 import logging
-import time
-from collections import defaultdict
 
-import jax
+from spark_rapids_ml_tpu.telemetry import metrics, reset_metrics, trace_range
 
 logger = logging.getLogger("spark_rapids_ml_tpu")
 
-# name -> [total_seconds, call_count]
-_METRICS: dict[str, list[float]] = defaultdict(lambda: [0.0, 0])
-
-
-@contextlib.contextmanager
-def trace_range(name: str):
-    """Host+device trace span with wall-clock metrics accumulation."""
-    start = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
-        yield
-    elapsed = time.perf_counter() - start
-    m = _METRICS[name]
-    m[0] += elapsed
-    m[1] += 1
-    logger.debug("trace %s: %.3fs", name, elapsed)
-
-
-def metrics() -> dict[str, dict[str, float]]:
-    """Snapshot of accumulated phase timings."""
-    return {k: {"seconds": v[0], "count": v[1]} for k, v in _METRICS.items()}
-
-
-def reset_metrics() -> None:
-    _METRICS.clear()
+__all__ = ["trace_range", "metrics", "reset_metrics", "logger"]

@@ -48,3 +48,15 @@ def test_bench_smoke_json_contract():
     # pipeline introspection must ride along so perf regressions in the
     # overlap machinery are visible in the bench record
     assert "overlapped_dispatches" in sf
+
+    # the telemetry snapshot makes every BENCH_r* round phase-attributable
+    # (ISSUE 2): full registry state keyed counters/gauges/spans/histograms
+    tel = data["telemetry"]
+    assert set(tel) == {"counters", "gauges", "spans", "histograms"}
+    # the bench's streamed-fit stage ran through the instrumented pipeline,
+    # so its spans must appear in the snapshot (reset_metrics in
+    # _paired_slope clears earlier stages; the streamed-fit stage and the
+    # DataFrame fit run after the last reset)
+    assert any(
+        phase.startswith(("fold.", "ingest.")) for phase in tel["spans"]
+    ), sorted(tel["spans"])

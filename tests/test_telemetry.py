@@ -1,0 +1,297 @@
+"""Telemetry subsystem: registry math, span accounting, FitReport, JSONL.
+
+Covers the ISSUE-2 satellite list: histogram percentile math against known
+distributions, exception-path span accounting (the trace_range try/finally
+fix), registry thread-safety under concurrent recording (the localspark
+partition-executor load shape), FitReport presence on PCA / StandardScaler /
+LinearRegression after both in-core and streamed fits, and the JSONL sink
+round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import telemetry as T
+from spark_rapids_ml_tpu.models.linear import LinearRegression
+from spark_rapids_ml_tpu.models.pca import PCA
+from spark_rapids_ml_tpu.models.scaler import StandardScaler
+from spark_rapids_ml_tpu.utils.config import get_config, set_config
+from spark_rapids_ml_tpu.utils.tracing import metrics, reset_metrics, trace_range
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    T.reset_metrics()
+    yield
+    T.reset_metrics()
+
+
+@pytest.fixture
+def force_streamed(monkeypatch):
+    old = get_config().stream_fit_max_resident_bytes
+    monkeypatch.setenv("TPU_ML_STREAM_CHUNK_ROWS", "128")
+    set_config(stream_fit_max_resident_bytes=1)
+    yield
+    set_config(stream_fit_max_resident_bytes=old)
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(23)
+    x = np.asarray(rng.normal(size=(600, 8)), np.float64)
+    y = x @ rng.normal(size=8) + 0.1 * rng.normal(size=600)
+    return x, y
+
+
+class TestHistogram:
+    def test_exact_count_sum_min_max(self):
+        h = T.Histogram()
+        vals = [0.5, 1.5, 2.5, 10.0, 0.001]
+        for v in vals:
+            h.record(v)
+        assert h.count == len(vals)
+        assert h.total == pytest.approx(sum(vals))
+        assert h.vmin == min(vals)
+        assert h.vmax == max(vals)
+
+    def test_percentiles_within_bucket_tolerance(self):
+        # uniform 1..1000: log-bucket quantiles are within half a bucket
+        # (GROWTH=2^0.25 ⇒ ~9.5%) of the exact order statistic
+        h = T.Histogram()
+        vals = np.linspace(1.0, 1000.0, 1000)
+        for v in vals:
+            h.record(float(v))
+        for q in (50, 90, 99):
+            exact = float(np.percentile(vals, q))
+            got = h.percentile(q)
+            assert got == pytest.approx(exact, rel=0.15), (q, got, exact)
+
+    def test_percentile_extremes_are_clamped_exact(self):
+        h = T.Histogram()
+        for v in (3.0, 7.0, 42.0):
+            h.record(v)
+        assert h.percentile(0) >= h.vmin
+        assert h.percentile(100) <= h.vmax
+
+    def test_zero_and_negative_values_bucket_safely(self):
+        h = T.Histogram()
+        h.record(0.0)
+        h.record(-1.0)
+        h.record(5.0)
+        assert h.count == 3
+        assert h.percentile(1) == 0.0  # the zero bucket ranks first
+
+    def test_empty_percentile_is_zero(self):
+        assert T.Histogram().percentile(50) == 0.0
+
+    def test_delta_subtracts_earlier_window(self):
+        h = T.Histogram()
+        for v in range(1, 11):
+            h.record(float(v))
+        snap = h.copy()
+        for v in range(1, 11):
+            h.record(float(v) * 100)
+        d = h.delta(snap)
+        assert d.count == 10
+        assert d.total == pytest.approx(sum(range(1, 11)) * 100)
+
+    def test_to_dict_shape(self):
+        h = T.Histogram()
+        h.record(1.0)
+        d = h.to_dict()
+        assert set(d) == {"count", "sum", "min", "max", "p50", "p90", "p99"}
+        assert T.Histogram().to_dict() == {"count": 0, "sum": 0.0}
+
+
+class TestSpans:
+    def test_trace_range_books_elapsed_on_raise(self):
+        # satellite (a): a body that raises must still account its time
+        with pytest.raises(RuntimeError):
+            with trace_range("boom.phase"):
+                raise RuntimeError("body died")
+        m = metrics()
+        assert m["boom.phase"]["count"] == 1
+        assert m["boom.phase"]["seconds"] >= 0.0
+
+    def test_legacy_metrics_shape(self):
+        with trace_range("p1"):
+            pass
+        with trace_range("p1"):
+            pass
+        m = metrics()
+        assert m["p1"]["count"] == 2
+        assert "seconds" in m["p1"]
+
+    def test_estimator_label_groups_spans(self):
+        token = T.set_current_estimator("DemoEst")
+        try:
+            with trace_range("labelled"):
+                pass
+        finally:
+            T.reset_current_estimator(token)
+        snap = T.REGISTRY.snapshot()
+        h = snap.hist("span.seconds", phase="labelled", estimator="DemoEst")
+        assert h.count == 1
+
+
+class TestRegistryThreadSafety:
+    def test_concurrent_counters_and_spans_exact(self):
+        # the localspark partition-executor load shape: many threads, one
+        # registry. Totals must be exact — the lock satellite.
+        n_threads, per_thread = 8, 500
+        start = threading.Barrier(n_threads)
+
+        def work():
+            start.wait()
+            for _ in range(per_thread):
+                T.counter_inc("t.count")
+                T.counter_inc("t.bytes", 3, path="x")
+                T.REGISTRY.histogram_record("t.h", 0.5)
+                with trace_range("t.span"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = T.REGISTRY.snapshot()
+        total = n_threads * per_thread
+        assert snap.counter("t.count") == total
+        assert snap.counter("t.bytes") == 3 * total
+        assert snap.hist("t.h").count == total
+        assert metrics()["t.span"]["count"] == total
+
+
+class TestFitReport:
+    def test_in_core_pca(self, data):
+        x, _ = data
+        m = PCA().setInputCol("f").setK(3).fit(x)
+        r = m.fit_report
+        assert r is not None
+        assert r.estimator == "PCA"
+        assert r.wall_seconds > 0
+        assert r.phases  # compute cov / eigh spans
+        for p in r.phases.values():
+            assert {"count", "sum"}.issubset(p)
+
+    def test_in_core_scaler_and_linreg(self, data):
+        x, y = data
+        ms = StandardScaler().fit(x)
+        assert ms.fit_report is not None
+        assert ms.fit_report.estimator == "StandardScaler"
+        ml = LinearRegression().fit((x, y))
+        assert ml.fit_report is not None
+        assert ml.fit_report.estimator == "LinearRegression"
+
+    def test_streamed_fits_report_rows(self, data, force_streamed):
+        x, y = data
+        for est, arg in (
+            (PCA().setInputCol("f").setK(3), x),
+            (StandardScaler(), x),
+            (LinearRegression(), (x, y)),
+        ):
+            T.reset_metrics()
+            m = est.fit(arg, num_partitions=3)
+            r = m.fit_report
+            assert r is not None, type(est).__name__
+            assert r.rows_ingested == len(x), type(est).__name__
+            assert r.bytes_ingested > 0
+            # the streamed pipeline's spans are attributed to this fit
+            assert "fold.dispatch" in r.phases, r.phases.keys()
+            assert "fold.wait" in r.phases
+
+    def test_report_isolated_per_fit(self, data):
+        x, _ = data
+        m1 = StandardScaler().fit(x)
+        m2 = StandardScaler().fit(x[:100])
+        # each report is a snapshot delta, not the accumulated registry
+        assert m2.fit_report.phases != {} or m1.fit_report.phases != {}
+        c1 = sum(p["count"] for p in m1.fit_report.phases.values())
+        c2 = sum(p["count"] for p in m2.fit_report.phases.values())
+        assert c2 <= c1 * 2  # second fit didn't inherit the first's spans
+
+    def test_report_roundtrips_via_dict(self, data):
+        x, _ = data
+        r = StandardScaler().fit(x).fit_report
+        back = T.FitReport.from_dict(json.loads(json.dumps(r.to_dict())))
+        assert back.estimator == r.estimator
+        assert back.wall_seconds == pytest.approx(r.wall_seconds)
+        assert back.phases.keys() == r.phases.keys()
+
+    def test_loaded_model_has_no_report(self, data, tmp_path):
+        x, _ = data
+        from spark_rapids_ml_tpu.models.scaler import StandardScalerModel
+
+        m = StandardScaler().fit(x)
+        m.save(str(tmp_path / "m"))
+        loaded = StandardScalerModel.load(str(tmp_path / "m"))
+        assert loaded.fit_report is None
+
+
+class TestJsonlSink:
+    def test_round_trip(self, data, tmp_path):
+        x, _ = data
+        path = str(tmp_path / "telemetry.jsonl")
+        old = get_config().telemetry_path
+        set_config(telemetry_path=path)
+        try:
+            PCA().setInputCol("f").setK(3).fit(x)
+            StandardScaler().fit(x)
+        finally:
+            set_config(telemetry_path=old)
+        records = T.read_jsonl(path)
+        assert [r["estimator"] for r in records] == ["PCA", "StandardScaler"]
+        for r in records:
+            assert r["type"] == "fit_report"
+            assert r["schema"] == 1
+            assert r["wall_seconds"] > 0
+            assert isinstance(r["phases"], dict)
+            assert "compile" in r and "device_memory" in r
+
+    def test_disabled_by_default(self, data, tmp_path):
+        x, _ = data
+        assert get_config().telemetry_path == ""
+        m = StandardScaler().fit(x)
+        assert m.fit_report is not None  # report still attaches, no sink
+
+    def test_export_failure_never_raises(self, data):
+        x, _ = data
+        old = get_config().telemetry_path
+        set_config(telemetry_path="/nonexistent-dir/nope/t.jsonl")
+        try:
+            m = StandardScaler().fit(x)  # export fails, fit must not
+            assert m.fit_report is not None
+        finally:
+            set_config(telemetry_path=old)
+
+    def test_read_jsonl_skips_corrupt_lines(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        p.write_text('{"type":"fit_report","estimator":"A"}\n{oops\n\n')
+        recs = T.read_jsonl(str(p))
+        assert len(recs) == 1 and recs[0]["estimator"] == "A"
+
+
+class TestConfigValidation:
+    def test_telemetry_path_must_be_str(self):
+        with pytest.raises(TypeError):
+            set_config(telemetry_path=7)
+
+    def test_int_keys_still_reject_str(self):
+        with pytest.raises(TypeError):
+            set_config(min_bucket="128")
+
+
+class TestDeviceMemorySampling:
+    def test_sample_never_raises(self):
+        # CPU backend: memory_stats() is None — must return empty, not throw
+        out = T.sample_device_memory()
+        assert isinstance(out, dict)
+
+    def test_install_monitoring_idempotent(self):
+        assert T.install_monitoring() == T.install_monitoring()

@@ -1,0 +1,115 @@
+"""tools/trace_report.py smoke: tiny fit with the JSONL sink enabled, then
+the CLI renders it and the anomaly checks run (ISSUE-2 CI satellite)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models.pca import PCA
+from spark_rapids_ml_tpu.utils.config import get_config, set_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "trace_report.py")
+
+
+def _load_cli_module():
+    spec = importlib.util.spec_from_file_location("trace_report", CLI)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def sink(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    old = get_config().telemetry_path
+    set_config(telemetry_path=path)
+    yield path
+    set_config(telemetry_path=old)
+
+
+def test_cli_renders_a_real_fit(sink):
+    x = np.random.default_rng(0).normal(size=(256, 6))
+    PCA().setInputCol("f").setK(2).fit(x)
+    assert os.path.exists(sink)
+    proc = subprocess.run(
+        [sys.executable, CLI, sink],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "PCA" in out
+    assert "phase" in out  # the per-phase table header rendered
+    # the anomaly checker ran (either clean or flagged)
+    assert "anomaly checks: ok" in out or "!!" in out
+
+
+def test_cli_in_process_main(sink):
+    x = np.random.default_rng(1).normal(size=(128, 4))
+    PCA().setInputCol("f").setK(2).fit(x)
+    mod = _load_cli_module()
+    assert mod.main([sink]) == 0
+    assert mod.main([sink, "--last", "1"]) == 0
+
+
+def test_cli_missing_file_fails_cleanly():
+    mod = _load_cli_module()
+    assert mod.main(["/nonexistent/t.jsonl"]) == 1
+
+
+def test_overlap_anomaly_fires():
+    mod = _load_cli_module()
+    rec = {
+        "type": "fit_report",
+        "estimator": "X",
+        "wall_seconds": 10.0,
+        "rows_ingested": 100,
+        "phases": {
+            "fold.dispatch": {"count": 4, "sum": 1.0},
+            "fold.wait": {"count": 1, "sum": 5.0},
+        },
+        "compile": {},
+    }
+    anomalies = mod.check_anomalies(rec)
+    assert any("not overlapping" in a for a in anomalies)
+
+
+def test_compile_dominated_anomaly_fires():
+    mod = _load_cli_module()
+    rec = {
+        "type": "fit_report",
+        "estimator": "X",
+        "wall_seconds": 2.0,
+        "rows_ingested": 100,
+        "phases": {},
+        "compile": {"count": 3, "seconds": 1.5},
+    }
+    anomalies = mod.check_anomalies(rec)
+    assert any("compile-dominated" in a for a in anomalies)
+
+
+def test_strict_exit_code(tmp_path):
+    mod = _load_cli_module()
+    import json
+
+    rec = {
+        "type": "fit_report",
+        "estimator": "X",
+        "wall_seconds": 10.0,
+        "rows_ingested": 100,
+        "phases": {
+            "fold.dispatch": {"count": 4, "sum": 1.0},
+            "fold.wait": {"count": 1, "sum": 5.0},
+        },
+        "compile": {},
+    }
+    p = tmp_path / "t.jsonl"
+    p.write_text(json.dumps(rec) + "\n")
+    assert mod.main([str(p)]) == 0
+    assert mod.main([str(p), "--strict"]) == 2
