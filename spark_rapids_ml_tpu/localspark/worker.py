@@ -182,6 +182,12 @@ def main() -> None:
             json.loads(read_block(proto_in)) if magic == MAGIC_BARRIER else None
         )
         try:
+            # fault site for chaos tests: a worker-scoped TPU_ML_FAULT_PLAN
+            # (e.g. worker.task:kill:1) crashes THIS process mid-job,
+            # exercising the session's crashed-worker replacement
+            from spark_rapids_ml_tpu.resilience import faults
+
+            faults.inject("worker.task")
             payload, status = run_task(fn_bytes, data, schema_bytes, context), b"O"
         except BaseException:
             payload, status = cloudpickle.dumps(traceback.format_exc()), b"E"

@@ -8,14 +8,21 @@ on a bounded thread pool, retry transient failures per-task up to
 attempts there), fail fast on exhaustion, and keep results in partition
 order. Device dispatch is async under the hood, so threads overlap host-side
 extraction/padding with device compute.
+
+The backoff loop itself is ``resilience.retry.call_with_retry`` — the
+shared policy, configured here for Spark-task semantics (ANY exception
+consumes an attempt, no deadline, no jitter) — which also counts retries
+in telemetry and never sleeps after the final failed attempt.
 """
 
 from __future__ import annotations
 
 import logging
-import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence, TypeVar
+
+from spark_rapids_ml_tpu.resilience import faults
+from spark_rapids_ml_tpu.resilience import retry as _retry
 
 logger = logging.getLogger("spark_rapids_ml_tpu")
 
@@ -53,22 +60,40 @@ def run_partition_tasks(
     if not items:
         return []
 
+    policy = _retry.RetryPolicy(
+        max_attempts=1 + max_retries,
+        backoff_s=retry_backoff_s,
+        multiplier=2.0,
+        max_backoff_s=60.0,
+        jitter=0.0,
+        deadline_s=None,
+    )
+
     def attempt(idx_item):
         idx, item = idx_item
-        last = None
-        for att in range(1 + max_retries):
-            try:
-                return fn(item)
-            except Exception as e:  # noqa: BLE001 — retry any task failure
-                last = e
-                logger.warning(
-                    "partition task %d attempt %d/%d failed: %s",
-                    idx, att + 1, 1 + max_retries, e,
-                )
-                time.sleep(retry_backoff_s * (2**att))
-        raise TaskFailedError(
-            f"partition task {idx} failed after {1 + max_retries} attempts"
-        ) from last
+
+        def run():
+            faults.inject("worker.task")
+            return fn(item)
+
+        def log_failure(att, e, will_retry):
+            logger.warning(
+                "partition task %d attempt %d/%d failed: %s",
+                idx, att, 1 + max_retries, e,
+            )
+
+        try:
+            return _retry.call_with_retry(
+                run,
+                site="worker.task",
+                policy=policy,
+                retry_on=_retry.RETRY_ANY,
+                on_failure=log_failure,
+            )
+        except Exception as e:  # noqa: BLE001 — budget exhausted
+            raise TaskFailedError(
+                f"partition task {idx} failed after {1 + max_retries} attempts"
+            ) from e
 
     if len(items) == 1 or max_workers <= 1:
         return [attempt((i, it)) for i, it in enumerate(items)]

@@ -335,8 +335,14 @@ def init_chunk_carry(example, mesh: Mesh):
 
 def finalize_chunk_fold(carry, mesh: Mesh):
     """Collapse the stacked per-device partials into the replicated total —
-    the ONE cross-device reduction of a streamed fit (vs one per chunk)."""
+    the ONE cross-device reduction of a streamed fit (vs one per chunk).
+
+    The carry is deliberately NOT donated here, so a transient collective
+    failure (site ``collective``) is safe to retry in place — the partials
+    are still valid."""
     from spark_rapids_ml_tpu.parallel.backend import allreduce
+    from spark_rapids_ml_tpu.resilience import faults
+    from spark_rapids_ml_tpu.resilience import retry as _retry
 
     leaves = jax.tree_util.tree_leaves(carry)
     _count_collectives(
@@ -344,7 +350,16 @@ def finalize_chunk_fold(carry, mesh: Mesh):
         len(leaves),
         sum(getattr(leaf, "nbytes", 0) for leaf in leaves) / max(len(leaves), 1),
     )
-    return jax.tree.map(lambda v: allreduce(v, mesh, DATA_AXIS), carry)
+
+    def run():
+        faults.inject("collective")
+        return jax.tree.map(lambda v: allreduce(v, mesh, DATA_AXIS), carry)
+
+    return _retry.call_with_retry(
+        run,
+        site="collective",
+        retry_on=frozenset({_retry.ErrorClass.TRANSIENT}),
+    )
 
 
 def _chunk_fold_prog(mesh: Mesh, kernel, vec_args: int):

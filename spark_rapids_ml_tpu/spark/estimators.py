@@ -21,6 +21,7 @@ actionable error if Spark isn't installed. Everything executor-side lives in
 
 from __future__ import annotations
 
+import logging
 from typing import Any
 
 import numpy as np
@@ -106,6 +107,35 @@ from spark_rapids_ml_tpu.spark import arrow_fns
 from spark_rapids_ml_tpu.utils import columnar
 from spark_rapids_ml_tpu.utils.tracing import trace_range
 
+logger = logging.getLogger("spark_rapids_ml_tpu")
+
+
+def _mesh_or_fallback():
+    """Create the driver's device mesh for a mesh-local streamed fit, or
+    degrade gracefully: a non-fatal device-init failure (wedged transport,
+    exhausted device, poisoned client — or an injected fault at site
+    ``device.init``) downgrades to the single-device fallback path (returns
+    None) with a loud warning and a ``degraded.cpu_fallback`` telemetry
+    flag, instead of failing a fit that the host can still finish."""
+    from spark_rapids_ml_tpu.parallel import mesh as M
+    from spark_rapids_ml_tpu.resilience import faults
+    from spark_rapids_ml_tpu.resilience import retry as _retry
+    from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+    try:
+        faults.inject("device.init")
+        return M.create_mesh()
+    except Exception as e:  # noqa: BLE001 — classified below
+        if _retry.classify(e) is _retry.ErrorClass.FATAL:
+            raise
+        logger.warning(
+            "DEGRADED: device mesh initialization failed (%s: %s); "
+            "streaming this fit through the single-device fallback path — "
+            "expect reduced throughput", type(e).__name__, e,
+        )
+        REGISTRY.counter_inc("degraded.cpu_fallback")
+        return None
+
 
 def _require_pyspark():
     try:
@@ -186,8 +216,20 @@ class SparkPCA(_HasDistribution, PCA):
 
     _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-barrier", "mesh-local")
 
-    def fit(self, dataset: Any, num_partitions: int | None = None) -> "SparkPCAModel":
+    def fit(
+        self, dataset: Any, num_partitions: int | None = None, **kwargs
+    ) -> "SparkPCAModel":
+        from spark_rapids_ml_tpu.utils.config import get_config
+
+        checkpoint_dir, checkpoint_every = _parse_checkpoint_kwargs(
+            kwargs, get_config().stream_checkpoint_every_chunks
+        )
         if not _is_spark_df(dataset):
+            if checkpoint_dir is not None:
+                raise NotImplementedError(
+                    "checkpoint_dir applies to the mesh-local streamed "
+                    "DataFrame fit; local containers fit in one resident pass"
+                )
             core = super().fit(dataset, num_partitions)
             return self._copyValues(
                 SparkPCAModel(uid=core.uid, pc=core.pc,
@@ -213,6 +255,15 @@ class SparkPCA(_HasDistribution, PCA):
             if k > n:
                 raise ValueError(f"k={k} must be <= number of features {n}")
             distribution = self.getOrDefault("distribution")
+            if checkpoint_dir is not None and (
+                distribution != "mesh-local"
+                or self.getOrDefault("solver") == "svd"
+            ):
+                raise NotImplementedError(
+                    "checkpoint_dir requires distribution='mesh-local' with "
+                    "a covariance solver: only the streamed chunk fold has "
+                    "a resumable cursor"
+                )
             if self.getOrDefault("solver") == "svd":
                 if self.getOrDefault("standardize"):
                     raise ValueError(
@@ -232,7 +283,11 @@ class SparkPCA(_HasDistribution, PCA):
                     arrays["xtx"], arrays["col_sum"], np.float64(arrays["count"])
                 )
             elif distribution == "mesh-local":
-                stats = self._mesh_local_stats(selected, input_col, n)
+                stats = self._mesh_local_stats(
+                    selected, input_col, n,
+                    checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every,
+                )
             else:
                 fit_fn = arrow_fns.make_fit_partition_fn(
                     input_col, precision=self.getOrDefault("precision")
@@ -353,7 +408,10 @@ class SparkPCA(_HasDistribution, PCA):
         )
         return self._copyValues(model)
 
-    def _mesh_local_stats(self, selected, input_col: str, n: int) -> L.GramStats:
+    def _mesh_local_stats(
+        self, selected, input_col: str, n: int, *,
+        checkpoint_dir=None, checkpoint_every=None,
+    ) -> L.GramStats:
         """'mesh-local': stream rows shard-by-shard onto the driver's own
         device mesh (spark/ingest.py — O(shard) host RSS) and run the psum
         Gram program (parallel/gram.py) — the deployment where one process
@@ -364,7 +422,10 @@ class SparkPCA(_HasDistribution, PCA):
         Above the ``TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES`` cutover the fit
         goes out-of-core: stream_fold drives the donated per-chunk Gram fold
         (parallel.gram.sharded_gram_fold) so device memory stays
-        O(chunk + n²) — the resident [rows, n] array is never assembled."""
+        O(chunk + n²) — the resident [rows, n] array is never assembled.
+        A ``checkpoint_dir`` makes that streamed pass resumable (carry +
+        chunk cursor every ``checkpoint_every`` chunks), and a non-fatal
+        device-init failure degrades it to the single-device fold."""
         import jax
         import jax.numpy as jnp
 
@@ -375,8 +436,23 @@ class SparkPCA(_HasDistribution, PCA):
         precision = L.PRECISIONS[self.getOrDefault("precision")]
         rows = selected.count()
         if ingest.use_streamed_fit(rows, n):
-            mesh = M.create_mesh()
+            from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+            ckpt = TrainingCheckpointer(checkpoint_dir) if checkpoint_dir else None
             dt = ingest.wire_dtype()
+            mesh = _mesh_or_fallback()
+            if mesh is None:  # degraded: single-device donated fold
+                res = ingest.stream_fold(
+                    selected,
+                    L.gram_fold_step(precision),
+                    features_col=input_col,
+                    n=n,
+                    init=L.init_gram_carry(n, dt),
+                    rows=rows,
+                    checkpointer=ckpt,
+                    checkpoint_every=checkpoint_every,
+                )
+                return res.carry
             example = L.GramStats(
                 xtx=jax.ShapeDtypeStruct((n, n), dt),
                 col_sum=jax.ShapeDtypeStruct((n,), dt),
@@ -393,9 +469,18 @@ class SparkPCA(_HasDistribution, PCA):
                 rows=rows,
                 chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
                 put_fn=G.chunk_put(mesh),
+                checkpointer=ckpt,
+                checkpoint_every=checkpoint_every,
+                min_chunk_rows=mesh.shape[M.DATA_AXIS],
             )
             # weighted count == Σ true-row weights == rows; no override needed
             return G.finalize_chunk_fold(res.carry, mesh)
+        if checkpoint_dir is not None:
+            raise NotImplementedError(
+                "checkpoint_dir applies to the out-of-core streamed fit; "
+                "this dataset fits resident in device memory (lower "
+                "TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES to force streaming)"
+            )
         ing = ingest.stream_to_mesh(
             selected, features_col=input_col, n=n, rows=rows
         )
@@ -593,15 +678,22 @@ class SparkLinearRegression(_HasDistribution, LinearRegression):
     _ALLOWED_DISTRIBUTIONS = ("driver-merge", "mesh-barrier", "mesh-local")
 
     def fit(self, dataset: Any, num_partitions: int | None = None, **kwargs):
-        if kwargs:
-            # the normal-equations solve is a single pass — there is no
-            # training loop to checkpoint on EITHER data path
-            extra = set(kwargs) - {"checkpoint_dir", "checkpoint_every"}
-            if extra:
-                raise TypeError(f"unexpected fit() kwargs: {sorted(extra)}")
+        from spark_rapids_ml_tpu.utils.config import get_config
+
+        checkpoint_dir, checkpoint_every = _parse_checkpoint_kwargs(
+            kwargs, get_config().stream_checkpoint_every_chunks
+        )
+        if checkpoint_dir is not None and (
+            not _is_spark_df(dataset)
+            or self.getOrDefault("distribution") != "mesh-local"
+        ):
+            # the normal-equations solve is one closed-form pass — there is
+            # no training loop to checkpoint; only the mesh-local STREAMED
+            # stats fold has a resumable chunk cursor
             raise NotImplementedError(
                 "LinearRegression trains in one closed-form pass; "
-                "mid-training checkpointing does not apply"
+                "checkpoint/resume applies only to the mesh-local streamed "
+                "DataFrame fit's chunk cursor"
             )
         if not _is_spark_df(dataset):
             core = super().fit(dataset, num_partitions)
@@ -634,32 +726,64 @@ class SparkLinearRegression(_HasDistribution, LinearRegression):
                     from spark_rapids_ml_tpu.ops import linear as LIN
                     from spark_rapids_ml_tpu.parallel import gram as G
                     from spark_rapids_ml_tpu.parallel import mesh as M
+                    from spark_rapids_ml_tpu.utils.checkpoint import (
+                        TrainingCheckpointer,
+                    )
 
-                    mesh = M.create_mesh()
+                    ckpt = (
+                        TrainingCheckpointer(checkpoint_dir)
+                        if checkpoint_dir else None
+                    )
                     dt = ingest.wire_dtype()
-                    example = LIN.LinearStats(
-                        xtx=jax.ShapeDtypeStruct((n, n), dt),
-                        xty=jax.ShapeDtypeStruct((n,), dt),
-                        x_sum=jax.ShapeDtypeStruct((n,), dt),
-                        y_sum=jax.ShapeDtypeStruct((), dt),
-                        y_sq=jax.ShapeDtypeStruct((), dt),
-                        count=jax.ShapeDtypeStruct((), dt),
+                    mesh = _mesh_or_fallback()
+                    if mesh is None:  # degraded: single-device donated fold
+                        res = ingest.stream_fold(
+                            selected,
+                            LIN.linear_fold_step(),
+                            features_col=feats,
+                            n=n,
+                            label_col=label,
+                            weight_col=weight_col,
+                            init=LIN.init_linear_carry(n, dt),
+                            rows=rows,
+                            checkpointer=ckpt,
+                            checkpoint_every=checkpoint_every,
+                        )
+                        stats = res.carry
+                    else:
+                        example = LIN.LinearStats(
+                            xtx=jax.ShapeDtypeStruct((n, n), dt),
+                            xty=jax.ShapeDtypeStruct((n,), dt),
+                            x_sum=jax.ShapeDtypeStruct((n,), dt),
+                            y_sum=jax.ShapeDtypeStruct((), dt),
+                            y_sq=jax.ShapeDtypeStruct((), dt),
+                            count=jax.ShapeDtypeStruct((), dt),
+                        )
+                        res = ingest.stream_fold(
+                            selected,
+                            lambda c, x, y, w: G.sharded_linear_fold(
+                                c, x, y, w, mesh
+                            ),
+                            features_col=feats,
+                            n=n,
+                            label_col=label,
+                            weight_col=weight_col,
+                            init=G.init_chunk_carry(example, mesh),
+                            rows=rows,
+                            chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
+                            put_fn=G.chunk_put(mesh),
+                            checkpointer=ckpt,
+                            checkpoint_every=checkpoint_every,
+                            min_chunk_rows=mesh.shape[M.DATA_AXIS],
+                        )
+                        stats = G.finalize_chunk_fold(res.carry, mesh)
+                elif checkpoint_dir is not None:
+                    raise NotImplementedError(
+                        "checkpoint_dir applies to the out-of-core streamed "
+                        "fit; this dataset fits resident in device memory "
+                        "(lower TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES to "
+                        "force streaming)"
                     )
-                    res = ingest.stream_fold(
-                        selected,
-                        lambda c, x, y, w: G.sharded_linear_fold(
-                            c, x, y, w, mesh
-                        ),
-                        features_col=feats,
-                        n=n,
-                        label_col=label,
-                        weight_col=weight_col,
-                        init=G.init_chunk_carry(example, mesh),
-                        rows=rows,
-                        chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
-                        put_fn=G.chunk_put(mesh),
-                    )
-                    stats = G.finalize_chunk_fold(res.carry, mesh)
                 else:
                     ing = ingest.stream_to_mesh(
                         selected, features_col=feats, n=n,

@@ -34,7 +34,9 @@ into a descriptive error naming the alternatives.
 
 from __future__ import annotations
 
+import logging
 import os
+import threading
 from dataclasses import dataclass
 from typing import Any, Iterator
 
@@ -42,6 +44,8 @@ import numpy as np
 
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 from spark_rapids_ml_tpu.utils import columnar
+
+logger = logging.getLogger("spark_rapids_ml_tpu")
 
 WIRE_DTYPE_VAR = "TPU_ML_MESH_LOCAL_WIRE_DTYPE"
 MAX_BYTES_VAR = "TPU_ML_MESH_LOCAL_MAX_BYTES"
@@ -61,6 +65,11 @@ ROW_CHUNK = 65_536
 STREAM_CUTOVER_VAR = "TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES"
 STREAM_CHUNK_VAR = "TPU_ML_STREAM_CHUNK_ROWS"
 DEFAULT_STREAM_CHUNK = 65_536
+# floor (and alignment multiple) for the OOM chunk bisection; mesh callers
+# pass min_chunk_rows >= the data-axis size so bisected chunks still shard
+STREAM_CHUNK_FLOOR_VAR = "TPU_ML_STREAM_CHUNK_FLOOR"
+DEFAULT_STREAM_CHUNK_FLOOR = 8
+FOLD_WAIT_TIMEOUT_VAR = "TPU_ML_FOLD_WAIT_TIMEOUT_S"
 
 
 def wire_dtype() -> np.dtype:
@@ -430,7 +439,10 @@ class StreamFold:
     fold was still executing on device — the double-buffering observable
     (> 0 means ingest genuinely overlapped compute). ``max_put_bytes`` is
     the largest single host→device transfer: O(chunk), never O(rows),
-    because the global array is never assembled.
+    because the global array is never assembled. ``skipped_rows`` counts
+    non-finite rows dropped under the ``skip`` policy, ``bisections`` the
+    OOM-driven chunk splits, and ``resumed`` whether the fold continued
+    from a durable checkpoint instead of starting cold.
     """
 
     carry: Any
@@ -438,6 +450,122 @@ class StreamFold:
     chunks: int
     overlapped: int
     max_put_bytes: int
+    skipped_rows: int = 0
+    bisections: int = 0
+    resumed: bool = False
+
+
+def _bounded_wait(carry, timeout_s: float):
+    """``jax.block_until_ready`` with a bound: a wedged device (hung
+    collective, dead transport) surfaces as a diagnosable
+    :class:`~spark_rapids_ml_tpu.resilience.retry.FoldHangTimeout` instead
+    of blocking the driver forever. The waiter runs on a daemon thread; on
+    timeout the stuck wait is abandoned with the thread (the process is
+    poisoned for further device work — see retry.ErrorClass.POISONED)."""
+    import jax
+
+    from spark_rapids_ml_tpu.resilience import faults
+    from spark_rapids_ml_tpu.resilience.retry import FoldHangTimeout
+
+    if not timeout_s or timeout_s <= 0:
+        faults.inject("fold.wait")
+        return jax.block_until_ready(carry)
+    box: dict[str, Any] = {}
+
+    def _wait():
+        try:
+            faults.inject("fold.wait")
+            box["carry"] = jax.block_until_ready(carry)
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            box["error"] = e
+
+    t = threading.Thread(target=_wait, name="tpu-ml-fold-wait", daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise FoldHangTimeout(
+            f"fold.wait did not complete within {timeout_s:g}s: the device "
+            "fold is hung, not slow — most likely a wedged collective or "
+            "device transport (check device health; on a mesh, every "
+            "participant must reach the same collective). Raise "
+            f"{FOLD_WAIT_TIMEOUT_VAR} to wait longer, or set it to 0 to "
+            "disable the bound."
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["carry"]
+
+
+_CKPT_LEAF = "leaf_{:03d}"
+
+
+def _save_stream_checkpoint(ckpt, carry, *, chunks, seen, skipped, chunk_rows):
+    """Durably checkpoint the carry + chunk cursor. The carry is synced
+    first (``block_until_ready``) so the bytes written are the fold of
+    every dispatched chunk — the checkpoint IS the stream position."""
+    import jax
+
+    done = jax.block_until_ready(carry)
+    leaves = jax.tree_util.tree_leaves(done)
+    arrays = {
+        _CKPT_LEAF.format(i): np.asarray(leaf) for i, leaf in enumerate(leaves)
+    }
+    ckpt.save(
+        chunks,
+        arrays,
+        {
+            "kind": "stream_fold",
+            "rows_seen": int(seen),
+            "skipped_rows": int(skipped),
+            "chunks": int(chunks),
+            "chunk_rows": int(chunk_rows),
+        },
+    )
+    REGISTRY.counter_inc("stream.checkpoints")
+
+
+def _restore_stream_checkpoint(ckpt, init_carry):
+    """Latest stream_fold checkpoint restored onto ``init_carry``'s
+    shardings (device placement follows the zero carry the caller built),
+    or None. Foreign checkpoints (a different ``kind``) are ignored rather
+    than misread."""
+    import jax
+
+    latest = ckpt.latest()
+    if latest is None:
+        return None
+    step, arrays, state = latest
+    if state.get("kind") != "stream_fold":
+        return None
+    leaves, treedef = jax.tree_util.tree_flatten(init_carry)
+    restored = []
+    for i, leaf in enumerate(leaves):
+        loaded = arrays[_CKPT_LEAF.format(i)]
+        sharding = getattr(leaf, "sharding", None)
+        restored.append(
+            jax.device_put(loaded, sharding) if sharding is not None else loaded
+        )
+    carry = jax.tree_util.tree_unflatten(treedef, restored)
+    return carry, state
+
+
+def _split_chunk_buffers(bx, by, bw, size: int):
+    """Re-stage one failed fixed-shape chunk as ``size``-row chunks (the
+    OOM bisection): slices are zero-padded to the new static shape, and the
+    pads ride the w=0 mask — exact, same as any ragged tail."""
+    out = []
+    for at in range(0, len(bx), size):
+        take = min(size, len(bx) - at)
+        sx = np.zeros((size,) + bx.shape[1:], bx.dtype)
+        sx[:take] = bx[at : at + take]
+        sw = np.zeros(size, bw.dtype)
+        sw[:take] = bw[at : at + take]
+        sy = None
+        if by is not None:
+            sy = np.zeros(size, by.dtype)
+            sy[:take] = by[at : at + take]
+        out.append((sx, sy, sw))
+    return out
 
 
 def stream_fold(
@@ -453,6 +581,11 @@ def stream_fold(
     rows: int | None = None,
     chunk_rows: int | None = None,
     put_fn=None,
+    checkpointer=None,
+    checkpoint_every: int | None = None,
+    min_chunk_rows: int | None = None,
+    fold_wait_timeout_s: float | None = None,
+    nonfinite: str | None = None,
 ) -> StreamFold:
     """Fold ``source`` chunk-wise through a donated device accumulator —
     the out-of-core fit pipeline. The full [rows, n] array is NEVER
@@ -479,15 +612,63 @@ def stream_fold(
     the row count are exact with no count fix-up. ``init`` is the zero
     carry (or a callable returning it); ``put_fn`` overrides chunk
     placement (e.g. parallel.gram.chunk_put shards chunks over a mesh).
+
+    The fold self-heals (resilience/ package):
+
+    - fault sites ``ingest.chunk`` / ``fold.dispatch`` / ``fold.wait`` are
+      injectable, and classified-transient dispatch failures retry under
+      the shared :class:`~spark_rapids_ml_tpu.resilience.retry.RetryPolicy`
+      (injection happens BEFORE the donated fold consumes its buffers, so
+      the carry stays valid for the retry);
+    - a ``RESOURCE_EXHAUSTED``-classified dispatch failure bisects: the
+      failed chunk is re-staged at half the rows (w=0 pads keep it exact)
+      and ``chunk_rows`` drops for the rest of the stream — one re-trace
+      of the jitted fold at the new static shape, floor-bounded by
+      ``min_chunk_rows`` (``TPU_ML_STREAM_CHUNK_FLOOR``; mesh callers pass
+      the data-axis size so bisected chunks still shard evenly);
+    - with a ``checkpointer`` (``utils.checkpoint.TrainingCheckpointer``),
+      the carry + chunk cursor are durably saved every
+      ``checkpoint_every`` chunks and a later call with the same
+      checkpointer RESUMES: already-consumed source rows are skipped and
+      the fold continues from the restored carry — bitwise-identical to
+      the uninterrupted fit (same chunks, same fold order);
+    - non-finite input rows follow ``nonfinite``
+      (``TPU_ML_NONFINITE_POLICY``): ``raise`` (default), ``skip`` (drop +
+      count ``rows.nonfinite_skipped``), or ``allow`` (no scan);
+    - the terminal wait is bounded (``TPU_ML_FOLD_WAIT_TIMEOUT_S``): a
+      hung device surfaces a ``FoldHangTimeout`` diagnosis, not a block.
     """
     import jax
 
+    from spark_rapids_ml_tpu.resilience import faults
+    from spark_rapids_ml_tpu.resilience import retry as R
     from spark_rapids_ml_tpu.telemetry import trace_range
+    from spark_rapids_ml_tpu.utils.config import (
+        VALID_NONFINITE_POLICIES,
+        get_config,
+    )
 
+    cfg = get_config()
     dt = wire_dtype()
     n_eff = n + 1 if augment_intercept else n
     if chunk_rows is None:
         chunk_rows = stream_chunk_rows()
+    if min_chunk_rows is None:
+        min_chunk_rows = max(
+            1,
+            int(os.environ.get(STREAM_CHUNK_FLOOR_VAR, DEFAULT_STREAM_CHUNK_FLOOR)),
+        )
+    if checkpoint_every is None:
+        checkpoint_every = cfg.stream_checkpoint_every_chunks
+    if fold_wait_timeout_s is None:
+        fold_wait_timeout_s = float(cfg.fold_wait_timeout_s)
+    nonfinite = nonfinite or cfg.nonfinite_policy
+    if nonfinite not in VALID_NONFINITE_POLICIES:
+        raise ValueError(
+            f"nonfinite={nonfinite!r} must be one of {VALID_NONFINITE_POLICIES}"
+        )
+    policy = R.RetryPolicy.from_config()
+    transient_only = frozenset({R.ErrorClass.TRANSIENT})
     want_y = label_col is not None
     put = put_fn if put_fn is not None else jax.device_put
 
@@ -535,27 +716,55 @@ def stream_fold(
         )
 
     carry = init() if callable(init) else init
-    x_buf, y_buf, w_buf = fresh()
-    fill = 0
     seen = 0
+    skipped = 0
     n_chunks = 0
     overlapped = 0
     max_put = 0
+    bisections = 0
+    resumed = False
+    resume_skip = 0  # raw source rows already consumed by a prior run
+    last_ckpt = 0
 
-    def dispatch():
-        nonlocal carry, x_buf, y_buf, w_buf, fill, n_chunks, overlapped, max_put
+    if checkpointer is not None:
+        found = _restore_stream_checkpoint(checkpointer, carry)
+        if found is not None:
+            carry, state = found
+            seen = int(state["rows_seen"])
+            skipped = int(state["skipped_rows"])
+            n_chunks = int(state["chunks"])
+            # resume at the (possibly bisected) size the prior run settled
+            # on — re-OOMing at the original size would be self-inflicted
+            chunk_rows = min(chunk_rows, int(state["chunk_rows"]))
+            last_ckpt = n_chunks
+            resume_skip = seen + skipped
+            resumed = True
+            REGISTRY.counter_inc("stream.resumes")
+            logger.warning(
+                "resuming streamed fit from checkpoint (chunk %d, %d rows "
+                "already folded)", n_chunks, seen,
+            )
+
+    x_buf, y_buf, w_buf = fresh()
+    fill = 0
+
+    def attempt_fold(xb, yb, wb):
+        nonlocal carry, n_chunks, overlapped, max_put
         busy = any(
             not leaf.is_ready()
             for leaf in jax.tree_util.tree_leaves(carry)
             if hasattr(leaf, "is_ready")
         )
         with trace_range("fold.dispatch"):
-            xd = put(x_buf)
-            wd = put(w_buf)
-            nbytes = x_buf.nbytes + w_buf.nbytes
-            if want_y:
-                yd = put(y_buf)
-                nbytes += y_buf.nbytes
+            # inject BEFORE the donated fold consumes its buffers, so the
+            # carry is still valid when the retry re-enters
+            faults.inject("fold.dispatch")
+            xd = put(xb)
+            wd = put(wb)
+            nbytes = xb.nbytes + wb.nbytes
+            if yb is not None:
+                yd = put(yb)
+                nbytes += yb.nbytes
                 carry = fold_fn(carry, xd, yd, wd)
             else:
                 carry = fold_fn(carry, xd, wd)
@@ -564,6 +773,43 @@ def stream_fold(
         max_put = max(max_put, nbytes)
         REGISTRY.counter_inc("h2d.bytes", nbytes, path="stream")
         n_chunks += 1
+
+    def dispatch_buffers(xb, yb, wb):
+        """Fold one staged chunk, retrying transients and bisecting OOMs:
+        a RESOURCE_EXHAUSTED-classified failure re-stages the chunk as
+        smaller fixed-shape chunks (w=0 pads keep it exact) and drops
+        ``chunk_rows`` for the rest of the stream."""
+        nonlocal chunk_rows, bisections
+        queue = [(xb, yb, wb)]
+        while queue:
+            bx, by, bw = queue.pop(0)
+            try:
+                R.call_with_retry(
+                    lambda: attempt_fold(bx, by, bw),
+                    site="fold.dispatch",
+                    policy=policy,
+                    retry_on=transient_only,
+                )
+            except Exception as e:  # noqa: BLE001 — classified below
+                if R.classify(e) is not R.ErrorClass.RESOURCE_EXHAUSTED:
+                    raise
+                cur = len(bx)
+                half = cur // 2
+                new = half - half % min_chunk_rows
+                if new < min_chunk_rows or new >= cur:
+                    raise  # floor reached: the OOM is not chunk-sized
+                logger.warning(
+                    "device OOM folding a %d-row chunk; bisecting to %d "
+                    "rows and re-dispatching", cur, new,
+                )
+                REGISTRY.counter_inc("chunk.bisections")
+                bisections += 1
+                queue[:0] = _split_chunk_buffers(bx, by, bw, new)
+                chunk_rows = min(chunk_rows, new)
+
+    def dispatch():
+        nonlocal x_buf, y_buf, w_buf, fill
+        dispatch_buffers(x_buf, y_buf if want_y else None, w_buf)
         # never reuse a put buffer: device_put of a host ndarray may alias
         # rather than copy on some backends (stream_to_mesh rationale)
         x_buf, y_buf, w_buf = fresh()
@@ -578,10 +824,55 @@ def stream_fold(
                 f"feature dimension changed mid-stream: expected {n}, got "
                 f"{xc.shape[1:]} in column {features_col!r}"
             )
-        if wc is not None:
-            wc = columnar.validate_weights(wc, len(xc), allow_all_zero=True)
         if want_y and yc is None:
             raise ValueError("label column missing from a streamed chunk")
+        if resume_skip:
+            # replaying an already-checkpointed prefix: drop the raw rows a
+            # prior run consumed (counted BEFORE any filtering, so the
+            # cursor is exact regardless of the non-finite policy)
+            drop = min(resume_skip, len(xc))
+            resume_skip -= drop
+            xc = xc[drop:]
+            yc = yc[drop:] if yc is not None else None
+            wc = wc[drop:] if wc is not None else None
+            if not len(xc):
+                continue
+        xc = R.call_with_retry(
+            lambda: faults.inject("ingest.chunk", xc),
+            site="ingest.chunk",
+            policy=policy,
+            retry_on=transient_only,
+        )
+        if nonfinite != "allow" and not (
+            # scalar pre-check keeps the all-finite fast path off the
+            # per-row mask allocation
+            np.isfinite(xc).all()
+            and (yc is None or np.isfinite(yc).all())
+            and (wc is None or np.isfinite(wc).all())
+        ):
+            bad = ~np.isfinite(xc).all(axis=1)
+            if yc is not None:
+                bad |= ~np.isfinite(yc)
+            if wc is not None:
+                bad |= ~np.isfinite(wc)
+            n_bad = int(bad.sum())
+            if n_bad:
+                if nonfinite == "raise":
+                    raise ValueError(
+                        f"{n_bad} non-finite input row(s) in a streamed "
+                        "chunk; set TPU_ML_NONFINITE_POLICY=skip to drop "
+                        "and count them instead"
+                    )
+                keep = ~bad
+                xc = xc[keep]
+                yc = yc[keep] if yc is not None else None
+                wc = wc[keep] if wc is not None else None
+                skipped += n_bad
+                REGISTRY.counter_inc("rows.nonfinite_skipped", n_bad)
+                if not len(xc):
+                    continue
+        if wc is not None:
+            wc = columnar.validate_weights(wc, len(xc), allow_all_zero=True)
         at = 0
         while at < len(xc):
             take = min(chunk_rows - fill, len(xc) - at)
@@ -598,22 +889,34 @@ def stream_fold(
             seen += take
             if fill == chunk_rows:
                 dispatch()
+                if (
+                    checkpointer is not None
+                    and n_chunks - last_ckpt >= checkpoint_every
+                ):
+                    _save_stream_checkpoint(
+                        checkpointer, carry, chunks=n_chunks, seen=seen,
+                        skipped=skipped, chunk_rows=chunk_rows,
+                    )
+                    last_ckpt = n_chunks
     if fill:
         dispatch()  # ragged tail: pads ride the w=0 mask, exactly
     if seen == 0:
         raise ValueError("empty dataset")
-    if rows is not None and seen != rows:
+    if rows is not None and seen + skipped != rows:
         raise ValueError(
-            f"dataset produced {seen} rows while streaming but count() "
-            f"reported {rows}; cache() the DataFrame if its source is "
-            "nondeterministic"
+            f"dataset produced {seen + skipped} rows while streaming but "
+            f"count() reported {rows}; cache() the DataFrame if its source "
+            "is nondeterministic"
         )
     with trace_range("fold.wait"):
-        carry = jax.block_until_ready(carry)
+        carry = _bounded_wait(carry, fold_wait_timeout_s)
     return StreamFold(
         carry=carry,
         rows=seen,
         chunks=n_chunks,
         overlapped=overlapped,
         max_put_bytes=max_put,
+        skipped_rows=skipped,
+        bisections=bisections,
+        resumed=resumed,
     )

@@ -55,9 +55,14 @@ class TrainingCheckpointer:
         return sorted(out)
 
     def save(self, step: int, arrays: dict[str, np.ndarray], state: dict | None = None) -> None:
+        # sweep ALL stale staging dirs, not just this step's: a writer killed
+        # mid-save (preemption, fault injection) leaves a .tmp-<other-step>
+        # orphan that would otherwise accumulate forever
+        if self.dir.is_dir():
+            for stale in self.dir.iterdir():
+                if stale.name.startswith(".tmp-"):
+                    shutil.rmtree(stale, ignore_errors=True)
         tmp = self.dir / f".tmp-{step}"
-        if tmp.exists():
-            shutil.rmtree(tmp)
         tmp.mkdir(parents=True)
         np.savez(tmp / "arrays.npz", **{k: np.asarray(v) for k, v in arrays.items()})
         (tmp / "state.json").write_text(json.dumps({"step": step, **(state or {})}))

@@ -31,6 +31,26 @@ module is tier 2 for the TPU build — process-level knobs read from
   for per-fit telemetry reports (``telemetry.export``). Each completed
   ``fit()`` appends one ``fit_report`` record; render with
   ``python tools/trace_report.py <path>``.
+- ``TPU_ML_RETRY_MAX_ATTEMPTS`` (int, default 4) — attempt budget for the
+  shared retry policy (``resilience.retry.RetryPolicy.from_config``):
+  classified-transient failures at the data-movement/compute choke points
+  retry up to this many total attempts.
+- ``TPU_ML_RETRY_DEADLINE_S`` (int, default 300; 0 = unbounded) — wall
+  deadline across one call's retries; once exceeded, no further attempt
+  is made.
+- ``TPU_ML_STREAM_CHECKPOINT_EVERY_CHUNKS`` (int, default 64) — with a
+  ``checkpoint_dir``, the streamed fit durably checkpoints its carry +
+  chunk cursor every this many chunks so a preempted fit resumes instead
+  of restarting.
+- ``TPU_ML_FOLD_WAIT_TIMEOUT_S`` (int, default 600; 0 = unbounded) — bound
+  on the streamed fit's terminal device wait; a wedged device surfaces as
+  a diagnosable ``FoldHangTimeout`` instead of blocking forever.
+- ``TPU_ML_NONFINITE_POLICY`` ('raise'|'skip'|'allow', default 'raise') —
+  streamed-fit handling of non-finite input rows: fail the fit, drop and
+  count them (``rows.nonfinite_skipped``), or skip the scan entirely.
+- ``TPU_ML_FAULT_PLAN`` (read by ``resilience.faults``, not cached here) —
+  deterministic fault-injection plan for chaos testing; see the Resilience
+  README section. Never set in production.
 - ``TPU_ML_LOG_LEVEL``       (logging level name or number, default unset) —
   sets the ``spark_rapids_ml_tpu`` logger level at package import. The
   package attaches only a ``logging.NullHandler``; output routing stays the
@@ -44,9 +64,10 @@ from dataclasses import dataclass, field
 
 
 VALID_PRECISIONS = ("highest", "high", "default")
+VALID_NONFINITE_POLICIES = ("raise", "skip", "allow")
 
 # config fields whose values are strings (everything else is int-typed)
-_STR_KEYS = ("default_precision", "telemetry_path")
+_STR_KEYS = ("default_precision", "telemetry_path", "nonfinite_policy")
 
 
 def _int_env(name: str, default: int) -> int:
@@ -67,6 +88,16 @@ def _precision_env() -> str:
     return v
 
 
+def _nonfinite_env() -> str:
+    v = os.environ.get("TPU_ML_NONFINITE_POLICY", "raise")
+    if v not in VALID_NONFINITE_POLICIES:
+        raise ValueError(
+            f"TPU_ML_NONFINITE_POLICY={v!r} must be one of "
+            f"{VALID_NONFINITE_POLICIES}"
+        )
+    return v
+
+
 @dataclass
 class RuntimeConfig:
     min_bucket: int = field(default_factory=lambda: _int_env("TPU_ML_MIN_BUCKET", 128))
@@ -81,6 +112,21 @@ class RuntimeConfig:
     telemetry_path: str = field(
         default_factory=lambda: os.environ.get("TPU_ML_TELEMETRY_PATH", "")
     )
+    retry_max_attempts: int = field(
+        default_factory=lambda: _int_env("TPU_ML_RETRY_MAX_ATTEMPTS", 4)
+    )
+    retry_deadline_s: int = field(
+        default_factory=lambda: _int_env("TPU_ML_RETRY_DEADLINE_S", 300)
+    )
+    stream_checkpoint_every_chunks: int = field(
+        default_factory=lambda: _int_env(
+            "TPU_ML_STREAM_CHECKPOINT_EVERY_CHUNKS", 64
+        )
+    )
+    fold_wait_timeout_s: int = field(
+        default_factory=lambda: _int_env("TPU_ML_FOLD_WAIT_TIMEOUT_S", 600)
+    )
+    nonfinite_policy: str = field(default_factory=_nonfinite_env)
 
 
 _config: RuntimeConfig | None = None
@@ -152,6 +198,11 @@ def set_config(**overrides) -> RuntimeConfig:
         if k == "default_precision" and v not in VALID_PRECISIONS:
             raise ValueError(
                 f"default_precision={v!r} must be one of {VALID_PRECISIONS}"
+            )
+        if k == "nonfinite_policy" and v not in VALID_NONFINITE_POLICIES:
+            raise ValueError(
+                f"nonfinite_policy={v!r} must be one of "
+                f"{VALID_NONFINITE_POLICIES}"
             )
         if k in _STR_KEYS:
             if not isinstance(v, str):

@@ -332,12 +332,27 @@ def wait_for_transport(
     round-3 failure mode. Returns the platform name; raises
     :class:`DevicePolicyError` with the per-attempt log if the window
     expires.
+
+    The backoff schedule comes from the shared
+    ``resilience.retry.RetryPolicy`` (jitter disabled so the emitted plan
+    stays human-predictable) and every sleep is counted as
+    ``retry.attempts{site=transport}`` in telemetry.
     """
+    from spark_rapids_ml_tpu.resilience.retry import RetryPolicy
+    from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
     emit = log or (lambda m: print(m, file=sys.stderr, flush=True))
     do_probe = probe or probe_transport_subprocess
+    policy = RetryPolicy(
+        max_attempts=1 << 30,  # bounded by the window, not a count
+        backoff_s=backoff_start,
+        multiplier=2.0,
+        max_backoff_s=backoff_max,
+        jitter=0.0,
+        deadline_s=window,
+    )
     deadline = time.monotonic() + window
     attempts: list[str] = []
-    backoff = backoff_start
     attempt = 0
     while True:
         attempt += 1
@@ -351,6 +366,7 @@ def wait_for_transport(
             )
             return detail
         attempts.append(f"attempt {attempt} ({took:.1f}s): {detail.splitlines()[0][:160]}")
+        backoff = policy.sleep_s(attempt)
         remaining = deadline - time.monotonic()
         if remaining <= backoff:
             raise DevicePolicyError(
@@ -363,5 +379,5 @@ def wait_for_transport(
             f"in {backoff:.0f}s ({remaining:.0f}s left in window): "
             f"{detail.splitlines()[0][:160]}"
         )
+        REGISTRY.counter_inc("retry.attempts", site="transport")
         time.sleep(backoff)
-        backoff = min(backoff * 2, backoff_max)

@@ -20,6 +20,7 @@ def test_bench_smoke_json_contract():
         JAX_PLATFORMS="cpu",
         JAX_COMPILATION_CACHE_DIR="/tmp/jax_test_cache",
     )
+    env.pop("TPU_ML_FAULT_PLAN", None)  # the zero-fault assertion below
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
         capture_output=True,
@@ -60,3 +61,7 @@ def test_bench_smoke_json_contract():
     assert any(
         phase.startswith(("fold.", "ingest.")) for phase in tel["spans"]
     ), sorted(tel["spans"])
+    # no TPU_ML_FAULT_PLAN is set, so the resilience layer must be inert:
+    # zero synthetic faults fired during the bench
+    injected = [k for k in tel["counters"] if k.startswith("fault.injected")]
+    assert injected == [], injected

@@ -1,0 +1,317 @@
+"""Chaos fault-matrix: every injected fault class against the streamed fit,
+asserting BOTH recovery (parity with the clean result) and the telemetry
+trail (injection + recovery counters). Deterministic — the TPU_ML_FAULT_PLAN
+nth-occurrence grammar always fails the same call — so these run in tier-1,
+not behind the slow marker.
+
+Matrix: device OOM (chunk bisection), transient I/O (retry-in-place), hang
+(bounded fold.wait + FoldHangTimeout diagnosis), preemption (durable
+checkpoint + bitwise resume), non-finite rows (raise/skip policy),
+collective blips (finalize retry), device-init failure (CPU degradation).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models.linear import LinearRegression
+from spark_rapids_ml_tpu.models.pca import PCA
+from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.resilience import faults
+from spark_rapids_ml_tpu.resilience import retry as R
+from spark_rapids_ml_tpu.spark import ingest
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+from spark_rapids_ml_tpu.utils.config import get_config, set_config
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_faults(monkeypatch):
+    """No plan leaks in (from the env) or out (to later tests)."""
+    monkeypatch.delenv(faults.FAULT_PLAN_VAR, raising=False)
+    faults.reset_faults()
+    yield
+    faults.reset_faults()
+
+
+@pytest.fixture
+def snap():
+    """Telemetry delta for the test body: ``snap.delta()`` -> counters."""
+    s0 = REGISTRY.snapshot()
+
+    class _Snap:
+        @staticmethod
+        def delta():
+            return REGISTRY.snapshot().delta(s0)
+
+    return _Snap
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(23)
+    x = np.asarray(rng.normal(size=(1100, 12)), np.float64)
+    coef = rng.normal(size=12)
+    y = x @ coef + 0.05 * rng.normal(size=1100)
+    return x, y
+
+
+def _gram_stream(x, plan=None, monkeypatch=None, **kw):
+    if plan is not None:
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, plan)
+    return ingest.stream_fold(
+        iter(np.array_split(x, 4)),
+        L.gram_fold_step(),
+        n=x.shape[1],
+        init=L.init_gram_carry(x.shape[1], x.dtype),
+        rows=len(x),
+        chunk_rows=128,
+        **kw,
+    )
+
+
+def _assert_gram_equal(carry, x):
+    import jax.numpy as jnp
+
+    want = L.gram_stats(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(carry.xtx), np.asarray(want.xtx), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(carry.col_sum), np.asarray(want.col_sum), rtol=1e-12
+    )
+    assert float(carry.count) == float(len(x))
+
+
+class TestOOMBisection:
+    def test_oom_bisects_chunk_and_stays_exact(self, data, monkeypatch, snap):
+        x, _ = data
+        res = _gram_stream(x, "fold.dispatch:oom:3", monkeypatch)
+        assert res.bisections >= 1
+        assert res.rows == 1100
+        _assert_gram_equal(res.carry, x)
+        d = snap.delta()
+        assert d.counter("fault.injected", site="fold.dispatch", kind="oom") == 1
+        assert d.counter("chunk.bisections") == res.bisections
+
+    def test_bisection_respects_floor(self, data, monkeypatch):
+        """Every dispatch OOMs: the bisection floor turns an un-shrinkable
+        OOM into the original error instead of an infinite loop."""
+        x, _ = data
+        plan = ",".join(f"fold.dispatch:oom:{i}" for i in range(1, 40))
+        with pytest.raises(faults.InjectedResourceExhausted):
+            _gram_stream(x, plan, monkeypatch, min_chunk_rows=64)
+
+
+class TestTransientRetry:
+    def test_ingest_io_retried(self, data, monkeypatch, snap):
+        x, _ = data
+        res = _gram_stream(x, "ingest.chunk:io:2", monkeypatch)
+        _assert_gram_equal(res.carry, x)
+        d = snap.delta()
+        assert d.counter("fault.injected", site="ingest.chunk", kind="io") == 1
+        assert d.counter("retry.attempts", site="ingest.chunk") == 1
+
+    def test_dispatch_io_retried(self, data, monkeypatch, snap):
+        x, _ = data
+        res = _gram_stream(x, "fold.dispatch:io:4", monkeypatch)
+        _assert_gram_equal(res.carry, x)
+        assert snap.delta().counter("retry.attempts", site="fold.dispatch") == 1
+
+    def test_transient_budget_exhaustion_raises(self, data, monkeypatch):
+        plan = ",".join(f"ingest.chunk:io:{i}" for i in range(1, 30))
+        monkeypatch.setattr(R.time, "sleep", lambda s: None)
+        with pytest.raises(faults.InjectedTransientIOError):
+            _gram_stream(x := data[0], plan, monkeypatch)
+
+
+class TestHangBound:
+    def test_hang_within_bound_completes(self, data, monkeypatch):
+        x, _ = data
+        res = _gram_stream(
+            x, "fold.wait:hang:1:0.1", monkeypatch, fold_wait_timeout_s=30.0
+        )
+        _assert_gram_equal(res.carry, x)
+
+    def test_hang_beyond_bound_diagnosed(self, data, monkeypatch):
+        x, _ = data
+        with pytest.raises(R.FoldHangTimeout, match="hung, not slow"):
+            _gram_stream(
+                x, "fold.wait:hang:1:3.0", monkeypatch, fold_wait_timeout_s=0.3
+            )
+
+    def test_hang_timeout_classified_poisoned(self):
+        assert R.classify(R.FoldHangTimeout("x")) is R.ErrorClass.POISONED
+
+
+class TestPreemptResume:
+    def test_preempted_stream_resumes_bitwise(self, data, monkeypatch, tmp_path, snap):
+        x, _ = data
+        clean = _gram_stream(x)
+        ckpt = TrainingCheckpointer(tmp_path / "ck")
+        # chunks 1-5 fold; checkpoints land after chunks 2 and 4; the 6th
+        # dispatch dies like a preempted process would
+        with pytest.raises(faults.InjectedPreemption):
+            _gram_stream(
+                x, "fold.dispatch:preempt:6", monkeypatch,
+                checkpointer=ckpt, checkpoint_every=2,
+            )
+        assert snap.delta().counter("stream.checkpoints") == 2
+        monkeypatch.delenv(faults.FAULT_PLAN_VAR)
+        res = _gram_stream(x, checkpointer=ckpt, checkpoint_every=2)
+        assert res.resumed
+        assert res.chunks == clean.chunks
+        assert snap.delta().counter("stream.resumes") == 1
+        # bitwise: the resumed accumulator path must reproduce the clean run
+        np.testing.assert_array_equal(
+            np.asarray(res.carry.xtx), np.asarray(clean.carry.xtx)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res.carry.col_sum), np.asarray(clean.carry.col_sum)
+        )
+        assert float(res.carry.count) == float(clean.carry.count)
+
+    def test_preemption_never_retried_in_process(self):
+        calls = {"n": 0}
+
+        def die():
+            calls["n"] += 1
+            raise faults.InjectedPreemption("gone")
+
+        with pytest.raises(faults.InjectedPreemption):
+            R.call_with_retry(die, policy=R.RetryPolicy(max_attempts=5))
+        assert calls["n"] == 1
+
+
+class TestNonFinitePolicy:
+    def test_raise_policy_fails_loudly(self, data, monkeypatch):
+        x = data[0].copy()
+        x[7, 3] = np.nan
+        with pytest.raises(ValueError, match="non-finite"):
+            _gram_stream(x, nonfinite="raise")
+
+    def test_skip_policy_drops_counts_and_matches(self, data, snap):
+        x = data[0].copy()
+        bad_rows = [7, 500, 1099]
+        for i in bad_rows:
+            x[i, i % 12] = np.inf if i % 2 else np.nan
+        res = _gram_stream(x, nonfinite="skip")
+        assert res.skipped_rows == len(bad_rows)
+        assert res.rows == len(x) - len(bad_rows)
+        _assert_gram_equal(res.carry, np.delete(x, bad_rows, axis=0))
+        assert snap.delta().counter("rows.nonfinite_skipped") == len(bad_rows)
+
+    def test_injected_corruption_skipped(self, data, monkeypatch, snap):
+        x, _ = data
+        res = _gram_stream(
+            x, "ingest.chunk:nonfinite:1", monkeypatch, nonfinite="skip"
+        )
+        assert res.skipped_rows == 1
+        _assert_gram_equal(res.carry, x[1:])  # first row of first pull corrupted
+        d = snap.delta()
+        assert d.counter("fault.injected", site="ingest.chunk", kind="nonfinite") == 1
+
+    def test_allow_policy_skips_the_scan(self, data):
+        x = data[0].copy()
+        x[3, 3] = np.nan
+        res = _gram_stream(x, nonfinite="allow")
+        assert res.skipped_rows == 0
+        assert not np.isfinite(np.asarray(res.carry.xtx)).all()
+
+
+class TestCollectiveRetry:
+    def test_finalize_retries_transient(self, data, monkeypatch, snap):
+        from spark_rapids_ml_tpu.parallel import gram as G
+        from spark_rapids_ml_tpu.parallel import mesh as M
+
+        x, _ = data
+        mesh = M.create_mesh()
+        example = L.GramStats(
+            xtx=jax.ShapeDtypeStruct((12, 12), np.float64),
+            col_sum=jax.ShapeDtypeStruct((12,), np.float64),
+            count=jax.ShapeDtypeStruct((), np.float64),
+        )
+        res = ingest.stream_fold(
+            iter(np.array_split(x, 4)),
+            lambda c, xd, wd: G.sharded_gram_fold(c, xd, wd, mesh),
+            n=12,
+            init=G.init_chunk_carry(example, mesh),
+            chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
+            put_fn=G.chunk_put(mesh),
+        )
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "collective:io:1")
+        monkeypatch.setattr(R.time, "sleep", lambda s: None)
+        stats = G.finalize_chunk_fold(res.carry, mesh)
+        _assert_gram_equal(stats, x)
+        d = snap.delta()
+        assert d.counter("fault.injected", site="collective", kind="io") == 1
+        assert d.counter("retry.attempts", site="collective") == 1
+
+
+class TestDeviceInitDegradation:
+    def test_nonfatal_init_failure_degrades(self, monkeypatch, snap):
+        from spark_rapids_ml_tpu.spark import estimators as E
+
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "device.init:io:1")
+        assert E._mesh_or_fallback() is None
+        assert snap.delta().counter("degraded.cpu_fallback") == 1
+
+    def test_fatal_init_failure_propagates(self, monkeypatch):
+        from spark_rapids_ml_tpu.spark import estimators as E
+
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "device.init:preempt:1")
+        with pytest.raises(faults.InjectedPreemption):
+            E._mesh_or_fallback()
+
+    def test_healthy_init_returns_mesh(self):
+        from spark_rapids_ml_tpu.spark import estimators as E
+
+        assert E._mesh_or_fallback() is not None
+
+
+@pytest.fixture
+def force_streamed(monkeypatch):
+    old = get_config().stream_fit_max_resident_bytes
+    monkeypatch.setenv("TPU_ML_STREAM_CHUNK_ROWS", "128")
+    set_config(stream_fit_max_resident_bytes=1)
+    yield
+    set_config(stream_fit_max_resident_bytes=old)
+
+
+class TestEstimatorChaosParity:
+    """Whole-fit chaos: streamed PCA / LinearRegression under injection
+    complete with parity against the clean model, and the per-fit telemetry
+    records the injection and the recovery."""
+
+    def test_pca_streamed_fit_under_faults(self, data, monkeypatch, force_streamed, snap):
+        x, _ = data
+        est = PCA().setInputCol("f").setK(4)
+        clean = est.fit(x, num_partitions=3)
+        monkeypatch.setenv(
+            faults.FAULT_PLAN_VAR, "ingest.chunk:io:1,fold.dispatch:oom:5"
+        )
+        monkeypatch.setattr(R.time, "sleep", lambda s: None)
+        m = est.fit(x, num_partitions=3)
+        cos = np.abs(np.sum(clean.pc * m.pc, axis=0))
+        assert cos.min() >= 0.9999, cos
+        d = snap.delta()
+        assert d.counter("fault.injected") == 2
+        assert d.counter("retry.attempts") >= 1
+        assert d.counter("chunk.bisections") >= 1
+
+    def test_linreg_streamed_fit_under_faults(self, data, monkeypatch, force_streamed, snap):
+        x, y = data
+        clean = LinearRegression().fit((x, y), num_partitions=3)
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "fold.dispatch:io:2")
+        monkeypatch.setattr(R.time, "sleep", lambda s: None)
+        m = LinearRegression().fit((x, y), num_partitions=3)
+        np.testing.assert_allclose(m.coefficients, clean.coefficients, atol=1e-9)
+        assert abs(m.intercept - clean.intercept) <= 1e-9
+        d = snap.delta()
+        assert d.counter("fault.injected", site="fold.dispatch", kind="io") == 1
+        assert d.counter("retry.attempts", site="fold.dispatch") == 1
+
+    def test_no_plan_means_zero_injections(self, data, force_streamed, snap):
+        x, _ = data
+        PCA().setInputCol("f").setK(3).fit(x, num_partitions=3)
+        assert snap.delta().counter("fault.injected") == 0

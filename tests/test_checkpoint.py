@@ -60,6 +60,35 @@ class TestCheckpointer:
         step, arrays, _ = ckpt.latest()
         assert step == 1 and arrays["v"][0] == 1.0
 
+    def test_stray_tmp_ignored_and_swept_on_next_save(self, tmp_path):
+        """A writer killed mid-save leaves .tmp-<other-step> orphans: they
+        must never count as state, and the NEXT save sweeps them all."""
+        ckpt = TrainingCheckpointer(tmp_path)
+        ckpt.save(3, {"v": np.asarray([3.0])})
+        for stray in (".tmp-1", ".tmp-7"):
+            d = tmp_path / stray
+            d.mkdir()
+            (d / "arrays.npz").write_bytes(b"torn")
+        step, arrays, _ = ckpt.latest()
+        assert step == 3 and arrays["v"][0] == 3.0
+        ckpt.save(4, {"v": np.asarray([4.0])})
+        leftovers = [p.name for p in tmp_path.iterdir() if p.name.startswith(".tmp-")]
+        assert leftovers == []
+        step, arrays, _ = ckpt.latest()
+        assert step == 4 and arrays["v"][0] == 4.0
+
+    def test_resume_after_replace_yields_newest_step(self, tmp_path):
+        """os.replace publication: once save() returns, a fresh reader (a
+        resumed process) sees exactly the newest step."""
+        ckpt = TrainingCheckpointer(tmp_path, keep=3)
+        for s in (2, 5, 9):
+            ckpt.save(s, {"v": np.asarray([float(s)])}, {"chunks": s})
+        fresh = TrainingCheckpointer(tmp_path, keep=3)
+        step, arrays, state = fresh.latest()
+        assert step == 9
+        assert arrays["v"][0] == 9.0
+        assert state["chunks"] == 9
+
 
 class TestKMeansResume:
     def test_resume_matches_uninterrupted(self, blobs, tmp_path):

@@ -318,6 +318,29 @@ class TestReviewRegressions:
 
             assert df.mapInArrow(ident, df.schema).count() == 10
 
+    @pytest.mark.chaos
+    def test_fault_plan_kill_replaces_worker(self, monkeypatch):
+        # workers snapshot os.environ at spawn, so a TPU_ML_FAULT_PLAN set
+        # before session creation rides into the worker process and kills it
+        # mid-task (exit code 113); clearing the env before the next job
+        # means the replacement worker spawns WITHOUT the plan and survives
+        monkeypatch.setenv("TPU_ML_FAULT_PLAN", "worker.task:kill:1")
+        with LocalSparkSession(parallelism=1) as s:
+            df, _ = _features_df(s, rows=10)
+
+            def ident(batches):
+                yield from batches
+
+            with pytest.raises(WorkerException, match="died mid-task"):
+                df.mapInArrow(ident, df.schema).collect()
+            doomed_pid = None
+            if s._workers:  # the dead worker is still listed until _ensure_workers
+                doomed_pid = s._workers[0].proc.pid
+
+            monkeypatch.delenv("TPU_ML_FAULT_PLAN")
+            assert df.mapInArrow(ident, df.schema).count() == 10
+            assert s._workers[0].proc.pid != doomed_pid
+
     def test_rand_offset_continuation(self):
         # rand(seed) must yield the same per-row stream regardless of how a
         # partition is chunked: evaluating at row offset k must continue the

@@ -113,3 +113,42 @@ def test_strict_exit_code(tmp_path):
     p.write_text(json.dumps(rec) + "\n")
     assert mod.main([str(p)]) == 0
     assert mod.main([str(p), "--strict"]) == 2
+
+
+def test_recovered_but_degraded_anomaly_fires():
+    mod = _load_cli_module()
+    rec = {
+        "type": "fit_report",
+        "estimator": "X",
+        "wall_seconds": 1.0,
+        "rows_ingested": 100,
+        "phases": {},
+        "compile": {},
+        "counters": {
+            "retry.attempts{site=ingest.chunk}": 2.0,
+            "chunk.bisections{}": 1.0,
+        },
+    }
+    anomalies = mod.check_anomalies(rec)
+    assert any("recovered-but-degraded" in a for a in anomalies)
+
+
+def test_fault_injection_anomaly_fires_and_strict_exits_2(tmp_path):
+    mod = _load_cli_module()
+    import json
+
+    rec = {
+        "type": "fit_report",
+        "estimator": "X",
+        "wall_seconds": 1.0,
+        "rows_ingested": 100,
+        "phases": {},
+        "compile": {},
+        "counters": {"fault.injected{site=fold.dispatch,kind=oom}": 3.0},
+    }
+    anomalies = mod.check_anomalies(rec)
+    assert any("fault injection active" in a for a in anomalies)
+    p = tmp_path / "t.jsonl"
+    p.write_text(json.dumps(rec) + "\n")
+    assert mod.main([str(p)]) == 0
+    assert mod.main([str(p), "--strict"]) == 2

@@ -18,6 +18,12 @@ of anomaly checks — heuristics that turn the numbers into a diagnosis:
 - zero rows ingested with nonzero wall ⇒ the fit never saw the data path
   this report instruments (fine for array fits fed device arrays; worth a
   look for DataFrame fits).
+- nonzero ``retry.attempts`` / ``chunk.bisections`` counters ⇒ the fit
+  completed but only by recovering (transient retries, OOM chunk
+  bisection) — healthy output, unhealthy ride; worth investigating
+  before it becomes a hard failure.
+- nonzero ``fault.injected`` ⇒ a TPU_ML_FAULT_PLAN was active; expected
+  only in chaos tests, never in a production report.
 
 Exit status: 0 normally; with ``--strict``, 2 when any anomaly fired
 (CI gate). Stdlib-only on the read path — the report must render on hosts
@@ -83,7 +89,33 @@ def check_anomalies(rec: dict) -> list[str]:
             "no rows counted: the fit bypassed the instrumented ingest/"
             "columnar path (expected for fits fed pre-built device arrays)"
         )
+    retries = _counter_total(rec, "retry.attempts")
+    bisections = _counter_total(rec, "chunk.bisections")
+    if retries or bisections:
+        out.append(
+            f"recovered-but-degraded fit: {retries:g} retried attempt(s), "
+            f"{bisections:g} chunk bisection(s) — the fit finished only by "
+            "recovering; investigate the flaking transport / device memory "
+            "headroom before it becomes a hard failure"
+        )
+    injected = _counter_total(rec, "fault.injected")
+    if injected:
+        out.append(
+            f"fault injection active: {injected:g} synthetic fault(s) fired "
+            "— TPU_ML_FAULT_PLAN is set; expected only in chaos tests, "
+            "never in production"
+        )
     return out
+
+
+def _counter_total(rec: dict, name: str) -> float:
+    """Sum a counter across its label sets: report counters are keyed
+    ``name`` or ``name{label=value,...}`` (telemetry.registry.render_key)."""
+    total = 0.0
+    for key, val in (rec.get("counters") or {}).items():
+        if key == name or key.startswith(name + "{"):
+            total += val
+    return total
 
 
 def render_record(rec: dict, out=sys.stdout) -> list[str]:
