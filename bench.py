@@ -347,10 +347,10 @@ def main() -> None:
 
     # --- out-of-core streamed fit throughput (this PR) --------------------
     try:
-        sf_rows_per_s, sf_overlapped = _bench_streamed_fit()
+        sf_rows_per_s, sf_overlapped, sf_overlap_fraction = _bench_streamed_fit()
     except Exception as e:  # pragma: no cover - defensive
         print(f"# streamed-fit bench skipped: {e!r}", file=sys.stderr)
-        sf_rows_per_s = sf_overlapped = None
+        sf_rows_per_s = sf_overlapped = sf_overlap_fraction = None
 
     # --- accuracy: bench program vs f64 host oracle, on THIS chip ---------
     min_cosine = L.min_cosine_vs_f64_oracle(
@@ -493,6 +493,11 @@ def main() -> None:
                             "unit": "rows/s",
                             "shape": f"{SF_ROWS}x{SF_N}_chunk{SF_CHUNK}",
                             "overlapped_dispatches": sf_overlapped,
+                            "overlap_fraction": (
+                                round(sf_overlap_fraction, 3)
+                                if sf_overlap_fraction is not None
+                                else None
+                            ),
                             "note": "out-of-core fit: donated-carry Gram "
                             "chunk fold (spark.ingest.stream_fold), H2D "
                             "of chunk i+1 overlapping chunk i's fold",
@@ -603,7 +608,7 @@ def _bench_forest() -> float:
     return RF_ROWS * RF_TREES / statistics.median(times)
 
 
-def _bench_streamed_fit() -> tuple[float, int]:
+def _bench_streamed_fit() -> tuple[float, int, float | None]:
     """Out-of-core streamed-fit throughput: rows/s through the donated-carry
     Gram chunk-fold pipeline (spark.ingest.stream_fold +
     ops.linalg.gram_fold_step). One host chunk is generated and re-yielded
@@ -611,10 +616,16 @@ def _bench_streamed_fit() -> tuple[float, int]:
     dispatch, so the measured path (H2D put overlapping the previous
     chunk's MXU fold, no per-chunk [n, n] realloc) is identical to distinct
     data while host RSS stays one chunk. Returns (rows/s, overlapped
-    dispatch count from the timed run) — overlapped > 0 is the
-    double-buffering evidence."""
+    dispatch count from the timed run, mean overlap fraction) —
+    overlapped > 0 is the double-buffering evidence.
+
+    Also the flight-recorder contract check: the timed reps' timeline
+    window must serialize as valid Chrome trace JSON (structure only — no
+    absolute-time assertions; wall-clock is load-dependent)."""
     from spark_rapids_ml_tpu.ops import linalg as L
     from spark_rapids_ml_tpu.spark import ingest
+    from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+    from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE, chrome_trace
 
     rng = np.random.default_rng(9)
     n_chunks = SF_ROWS // SF_CHUNK
@@ -630,13 +641,21 @@ def _bench_streamed_fit() -> tuple[float, int]:
         )
 
     run()  # compile + warm
+    tl_seq = TIMELINE.seq()
+    reg0 = REGISTRY.snapshot()
     times, overlapped = [], 0
     for _ in range(3):
         t0 = time.perf_counter()
         res = run()
         times.append(time.perf_counter() - t0)
         overlapped = res.overlapped
-    return SF_ROWS / statistics.median(times), overlapped
+
+    trace = chrome_trace(TIMELINE.events(since_seq=tl_seq))
+    if not isinstance(json.loads(json.dumps(trace)).get("traceEvents"), list):
+        raise RuntimeError("timeline did not round-trip as Chrome trace JSON")
+    ov = REGISTRY.snapshot().delta(reg0).hist("stream.overlap_fraction")
+    overlap_fraction = (ov.total / ov.count) if ov.count else None
+    return SF_ROWS / statistics.median(times), overlapped, overlap_fraction
 
 
 def _bench_df_fit() -> float:

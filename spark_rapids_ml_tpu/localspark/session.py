@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import atexit
+import logging
 import os
 import subprocess
 import sys
@@ -23,6 +24,8 @@ from spark_rapids_ml_tpu.localspark.dataframe import (
     _infer_type,
     dataframe_from_partitions,
 )
+
+logger = logging.getLogger("spark_rapids_ml_tpu")
 
 
 class WorkerException(RuntimeError):
@@ -57,7 +60,9 @@ class _Worker:
         data: bytes,
         schema_bytes: bytes,
         context: dict | None = None,
+        partition: int | None = None,
     ) -> bytes:
+        trailer = b""
         with self._lock:
             try:
                 out = self.proc.stdin
@@ -77,6 +82,10 @@ class _Worker:
                 if len(status) != 1:
                     raise EOFError
                 payload = W.read_block(self.proc.stdout)
+                if status == b"O":
+                    # telemetry trailer: the worker's registry delta +
+                    # timeline events for THIS task (worker.py framing doc)
+                    trailer = W.read_block(self.proc.stdout)
             except (EOFError, BrokenPipeError, OSError) as e:
                 self.dead = True  # session must not reuse this process
                 try:  # EOF can precede process teardown: wait briefly for rc
@@ -107,7 +116,35 @@ class _Worker:
                 "mapInArrow plan function failed in the worker process:\n"
                 + cloudpickle.loads(payload)
             )
+        self._merge_telemetry(trailer, partition)
         return payload
+
+    @staticmethod
+    def _merge_telemetry(trailer: bytes, partition: int | None) -> None:
+        """Fold a worker's telemetry trailer into the driver's registry and
+        flight-recorder timeline, labeling every series/event with the
+        partition it came from. Best-effort by design: a malformed trailer
+        is logged and dropped, never failing the task that produced it."""
+        if not trailer:
+            return
+        try:
+            import json
+
+            from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+            from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
+
+            t = json.loads(trailer)
+            label = "" if partition is None else str(partition)
+            if t.get("registry"):
+                REGISTRY.merge_wire(t["registry"], partition=label)
+            if t.get("events"):
+                TIMELINE.merge(t["events"], partition=label)
+        except Exception:
+            logger.warning(
+                "dropping unmergeable worker telemetry trailer (partition=%s)",
+                partition,
+                exc_info=True,
+            )
 
     def _stderr_tail(self, limit: int = 4000) -> str:
         try:
@@ -291,7 +328,9 @@ class LocalSparkSession:
 
         def run_on(worker: _Worker, indices: list[int]) -> None:
             for i in indices:
-                payload = worker.run_task(fn_bytes, task_parts[i], schema_bytes)
+                payload = worker.run_task(
+                    fn_bytes, task_parts[i], schema_bytes, partition=i
+                )
                 results[i], _ = W.batches_from_ipc(payload)
 
         assignments = [
@@ -363,7 +402,8 @@ class LocalSparkSession:
             }
             try:
                 payload = workers[rank].run_task(
-                    fn_bytes, task_parts[rank], schema_bytes, context
+                    fn_bytes, task_parts[rank], schema_bytes, context,
+                    partition=rank,
                 )
                 results[rank], _ = W.batches_from_ipc(payload)
             except BaseException as e:  # noqa: BLE001 - re-raised below

@@ -23,12 +23,21 @@ Framing protocol, little-endian u64 lengths, one task per request::
     driver -> worker:  b"LSPK" | fn | input-arrow-stream | target-schema
     driver -> worker:  b"LSPB" | fn | input-arrow-stream | target-schema
                        | json task-context               (barrier task)
-    worker -> driver:  b"O" | output-arrow-stream        (success)
+    worker -> driver:  b"O" | output-arrow-stream
+                       | json telemetry-trailer          (success)
                        b"E" | pickled traceback string   (failure)
 
 A barrier frame additionally installs a ``BarrierTaskContext`` (see
 ``taskcontext.py``) before invoking the plan function, the way Spark's
 worker exposes ``BarrierTaskContext.get()`` inside barrier stages.
+
+The telemetry trailer on the success frame is what keeps worker-side
+observability from dying with the process: everything the task recorded
+into THIS worker's registry (a snapshot delta — columnar counters, spans,
+fault injections) plus its flight-recorder timeline events, JSON-encoded.
+The driver merges it into its own registry/timeline labeled by partition
+(``session._Worker.run_task``). Serialization failures degrade to an empty
+trailer — telemetry must never fail a task.
 
 stdout is re-pointed at stderr after startup so user ``print``\\ s inside
 plan functions cannot corrupt the protocol stream (Spark's workers talk
@@ -41,6 +50,7 @@ import io
 import os
 import struct
 import sys
+import time
 import traceback
 
 import pyarrow as pa
@@ -169,6 +179,11 @@ def main() -> None:
 
     import json
 
+    # jax-free on purpose: importing the registry/timeline must not trigger
+    # a backend init in workers that never touch jax (pure-Arrow tasks)
+    from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+    from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
+
     while True:
         magic = proto_in.read(4)
         if not magic:
@@ -181,6 +196,10 @@ def main() -> None:
         context = (
             json.loads(read_block(proto_in)) if magic == MAGIC_BARRIER else None
         )
+        # bracket the task so the trailer carries exactly what IT recorded
+        reg0 = REGISTRY.snapshot()
+        tl_seq0 = TIMELINE.seq()
+        t0 = time.perf_counter()
         try:
             # fault site for chaos tests: a worker-scoped TPU_ML_FAULT_PLAN
             # (e.g. worker.task:kill:1) crashes THIS process mid-job,
@@ -193,6 +212,23 @@ def main() -> None:
             payload, status = cloudpickle.dumps(traceback.format_exc()), b"E"
         proto_out.write(status)
         write_block(proto_out, payload)
+        if status == b"O":
+            # the one span every task gets, recorded worker-side (plain
+            # registry/timeline calls, not trace_range — that would drag a
+            # jax import into pure-Arrow tasks)
+            t1 = time.perf_counter()
+            REGISTRY.histogram_record("span.seconds", t1 - t0, phase="worker.task")
+            TIMELINE.record_span("worker.task", t0, t1)
+            try:
+                trailer = json.dumps(
+                    {
+                        "registry": REGISTRY.snapshot().delta(reg0).to_wire(),
+                        "events": TIMELINE.events(since_seq=tl_seq0),
+                    }
+                ).encode()
+            except Exception:
+                trailer = b"{}"  # telemetry must never fail a task
+            write_block(proto_out, trailer)
         proto_out.flush()
 
 

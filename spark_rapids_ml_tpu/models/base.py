@@ -235,6 +235,16 @@ def _instrumented_fit(fit):
         telemetry.attach_report(model, report)
         if depth == 0:
             telemetry.export_fit_report(report)
+            # flight-recorder window for this outermost fit: everything
+            # recorded since begin_fit's watermark, including worker events
+            # merged in via the task-protocol telemetry trailer
+            telemetry.export_timeline(
+                telemetry.TIMELINE.events(since_seq=cap.tl_seq),
+                fit_id=report.fit_id,
+                estimator=report.estimator,
+                uid=report.uid,
+                overlap_fraction=report.overlap_fraction,
+            )
         return model
 
     fit_with_telemetry._telemetry_wrapped = True

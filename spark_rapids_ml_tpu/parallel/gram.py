@@ -29,15 +29,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.parallel.mesh import DATA_AXIS, FEAT_AXIS, shard_map
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
 
 def _count_collectives(kind: str, n_ops: float, payload_bytes: float) -> None:
-    """Book cross-device traffic into the registry. Collectives live inside
-    jitted programs, so the accounting happens here at the host call sites:
-    ``n_ops`` launches moving ``payload_bytes`` per launch (logical payload,
-    not the ICI wire schedule XLA actually picks)."""
+    """Book cross-device traffic into the registry (and the flight
+    recorder, so collective dispatches appear on the fit timeline).
+    Collectives live inside jitted programs, so the accounting happens here
+    at the host call sites: ``n_ops`` launches moving ``payload_bytes`` per
+    launch (logical payload, not the ICI wire schedule XLA actually
+    picks)."""
     REGISTRY.counter_inc("collective.count", n_ops, kind=kind)
     REGISTRY.counter_inc("collective.bytes", n_ops * payload_bytes, kind=kind)
+    TIMELINE.record_instant(
+        "collective.dispatch",
+        kind=kind,
+        n_ops=n_ops,
+        payload_bytes=int(n_ops * payload_bytes),
+    )
 
 
 @lru_cache(maxsize=None)

@@ -48,6 +48,7 @@ from dataclasses import dataclass
 from typing import Any
 
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
 FAULT_PLAN_VAR = "TPU_ML_FAULT_PLAN"
 
@@ -175,6 +176,7 @@ def inject(site: str, data: Any = None) -> Any:
         hits = [s for s in plan if s.site == site and s.nth == n]
     for spec in hits:
         REGISTRY.counter_inc("fault.injected", site=site, kind=spec.kind)
+        TIMELINE.record_instant("fault.injected", site=site, kind=spec.kind)
         if spec.kind == "oom":
             raise InjectedResourceExhausted(
                 f"RESOURCE_EXHAUSTED: injected device OOM at {site!r} "

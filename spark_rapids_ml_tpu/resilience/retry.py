@@ -41,6 +41,7 @@ from dataclasses import dataclass
 from typing import Callable, FrozenSet
 
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
 logger = logging.getLogger("spark_rapids_ml_tpu")
 
@@ -198,5 +199,9 @@ def call_with_retry(
             if not will_retry:
                 raise
             REGISTRY.counter_inc("retry.attempts", site=site or "unlabeled")
+            TIMELINE.record_instant(
+                "retry", site=site or "unlabeled", attempt=attempt,
+                error_class=cls.value,
+            )
             # late-bound so tests monkeypatching time.sleep observe it
             (sleep if sleep is not None else time.sleep)(pol.sleep_s(attempt))

@@ -36,13 +36,16 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Iterator
 
 import numpy as np
 
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 from spark_rapids_ml_tpu.utils import columnar
 
 logger = logging.getLogger("spark_rapids_ml_tpu")
@@ -70,6 +73,9 @@ DEFAULT_STREAM_CHUNK = 65_536
 STREAM_CHUNK_FLOOR_VAR = "TPU_ML_STREAM_CHUNK_FLOOR"
 DEFAULT_STREAM_CHUNK_FLOOR = 8
 FOLD_WAIT_TIMEOUT_VAR = "TPU_ML_FOLD_WAIT_TIMEOUT_S"
+# live progress heartbeat: float seconds between stderr lines during a
+# streamed fold (unset/0 = silent — multi-minute fits opt in)
+PROGRESS_VAR = "TPU_ML_PROGRESS"
 
 
 def wire_dtype() -> np.dtype:
@@ -431,6 +437,20 @@ def stream_chunk_rows() -> int:
     return columnar.bucket_rows(rows)
 
 
+def progress_interval() -> float:
+    """Heartbeat period from ``TPU_ML_PROGRESS`` (seconds; 0/unset = off)."""
+    raw = os.environ.get(PROGRESS_VAR, "")
+    if not raw:
+        return 0.0
+    try:
+        every = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{PROGRESS_VAR}={raw!r} must be a number of seconds"
+        ) from None
+    return max(0.0, every)
+
+
 @dataclass
 class StreamFold:
     """Result of a streamed fold: the final carry plus pipeline evidence.
@@ -522,6 +542,9 @@ def _save_stream_checkpoint(ckpt, carry, *, chunks, seen, skipped, chunk_rows):
         },
     )
     REGISTRY.counter_inc("stream.checkpoints")
+    TIMELINE.record_instant(
+        "stream.checkpoint", chunk=int(chunks), rows_seen=int(seen)
+    )
 
 
 def _restore_stream_checkpoint(ckpt, init_carry):
@@ -642,7 +665,7 @@ def stream_fold(
 
     from spark_rapids_ml_tpu.resilience import faults
     from spark_rapids_ml_tpu.resilience import retry as R
-    from spark_rapids_ml_tpu.telemetry import trace_range
+    from spark_rapids_ml_tpu.telemetry import current_fit_id, trace_range
     from spark_rapids_ml_tpu.utils.config import (
         VALID_NONFINITE_POLICIES,
         get_config,
@@ -740,6 +763,9 @@ def stream_fold(
             resume_skip = seen + skipped
             resumed = True
             REGISTRY.counter_inc("stream.resumes")
+            TIMELINE.record_instant(
+                "stream.resume", chunk=n_chunks, rows_seen=seen
+            )
             logger.warning(
                 "resuming streamed fit from checkpoint (chunk %d, %d rows "
                 "already folded)", n_chunks, seen,
@@ -747,6 +773,36 @@ def stream_fold(
 
     x_buf, y_buf, w_buf = fresh()
     fill = 0
+
+    # live progress heartbeat (TPU_ML_PROGRESS): opt-in stderr line so a
+    # multi-minute out-of-core fit is not silent. Retry counts come from
+    # the registry delta (the retries happen inside call_with_retry below).
+    progress_every = progress_interval()
+    progress_t0 = time.perf_counter()
+    last_beat = progress_t0
+    retries0 = (
+        REGISTRY.snapshot().counter("retry.attempts") if progress_every else 0
+    )
+
+    def maybe_heartbeat():
+        nonlocal last_beat
+        if not progress_every:
+            return
+        now = time.perf_counter()
+        if now - last_beat < progress_every:
+            return
+        last_beat = now
+        elapsed = max(now - progress_t0, 1e-9)
+        retries = REGISTRY.snapshot().counter("retry.attempts") - retries0
+        fid = current_fit_id() or ""
+        print(
+            f"[tpu-ml progress{' ' + fid if fid else ''}] "
+            f"rows={seen} ({seen / elapsed:,.0f} rows/s) "
+            f"chunks={n_chunks} chunk_rows={chunk_rows} "
+            f"retries={retries:g} bisections={bisections}",
+            file=sys.stderr,
+            flush=True,
+        )
 
     def attempt_fold(xb, yb, wb):
         nonlocal carry, n_chunks, overlapped, max_put
@@ -803,6 +859,9 @@ def stream_fold(
                     "rows and re-dispatching", cur, new,
                 )
                 REGISTRY.counter_inc("chunk.bisections")
+                TIMELINE.record_instant(
+                    "chunk.bisection", from_rows=cur, to_rows=new
+                )
                 bisections += 1
                 queue[:0] = _split_chunk_buffers(bx, by, bw, new)
                 chunk_rows = min(chunk_rows, new)
@@ -819,6 +878,9 @@ def stream_fold(
         REGISTRY.counter_inc("ingest.rows", len(xc))
         REGISTRY.counter_inc("ingest.bytes", xc.nbytes)
         REGISTRY.histogram_record("ingest.chunk_rows", len(xc))
+        TIMELINE.record_instant(
+            "stream.chunk", rows=len(xc), nbytes=int(xc.nbytes)
+        )
         if xc.ndim != 2 or xc.shape[1] != n:
             raise ValueError(
                 f"feature dimension changed mid-stream: expected {n}, got "
@@ -889,6 +951,7 @@ def stream_fold(
             seen += take
             if fill == chunk_rows:
                 dispatch()
+                maybe_heartbeat()
                 if (
                     checkpointer is not None
                     and n_chunks - last_ckpt >= checkpoint_every
@@ -910,6 +973,13 @@ def stream_fold(
         )
     with trace_range("fold.wait"):
         carry = _bounded_wait(carry, fold_wait_timeout_s)
+    # per-stream H2D↔compute overlap evidence: fraction of dispatches
+    # issued while the prior fold was still on device. Recorded as a
+    # histogram so end_fit's snapshot delta reads a per-fit mean into
+    # FitReport.overlap_fraction.
+    REGISTRY.histogram_record(
+        "stream.overlap_fraction", overlapped / n_chunks if n_chunks else 0.0
+    )
     return StreamFold(
         carry=carry,
         rows=seen,

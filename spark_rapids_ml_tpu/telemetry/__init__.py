@@ -30,9 +30,20 @@ from spark_rapids_ml_tpu.telemetry.registry import (
 )
 from spark_rapids_ml_tpu.telemetry.spans import (
     current_estimator,
+    current_fit_id,
+    install_fit_id_filter,
     reset_current_estimator,
+    reset_current_fit_id,
     set_current_estimator,
+    set_current_fit_id,
     trace_range,
+)
+from spark_rapids_ml_tpu.telemetry.timeline import (
+    TIMELINE,
+    Timeline,
+    chrome_trace,
+    record_instant,
+    timeline_capacity,
 )
 from spark_rapids_ml_tpu.telemetry.compilemon import (
     install_monitoring,
@@ -47,8 +58,10 @@ from spark_rapids_ml_tpu.telemetry.report import (
 )
 from spark_rapids_ml_tpu.telemetry.export import (
     export_fit_report,
+    export_timeline,
     read_jsonl,
     telemetry_path,
+    timeline_path,
 )
 
 __all__ = [
@@ -63,9 +76,18 @@ __all__ = [
     "render_key",
     "reset_metrics",
     "current_estimator",
+    "current_fit_id",
+    "install_fit_id_filter",
     "reset_current_estimator",
+    "reset_current_fit_id",
     "set_current_estimator",
+    "set_current_fit_id",
     "trace_range",
+    "TIMELINE",
+    "Timeline",
+    "chrome_trace",
+    "record_instant",
+    "timeline_capacity",
     "install_monitoring",
     "sample_device_memory",
     "FitReport",
@@ -74,6 +96,8 @@ __all__ = [
     "end_fit",
     "snapshot_dict",
     "export_fit_report",
+    "export_timeline",
     "read_jsonl",
     "telemetry_path",
+    "timeline_path",
 ]

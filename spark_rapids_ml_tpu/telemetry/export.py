@@ -25,6 +25,64 @@ def telemetry_path() -> str:
     return get_config().telemetry_path
 
 
+def timeline_path() -> str:
+    """The configured timeline sink path ('' = disabled). May equal
+    ``telemetry_path`` — readers filter on the record ``type``."""
+    from spark_rapids_ml_tpu.utils.config import get_config
+
+    return get_config().timeline_path
+
+
+def _append_line(path: str, record: dict) -> bool:
+    data = (
+        json.dumps(record, separators=(",", ":"), sort_keys=True) + "\n"
+    ).encode()
+    fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+    return True
+
+
+def export_timeline(
+    events: list[dict],
+    *,
+    fit_id: str = "",
+    estimator: str = "",
+    uid: str = "",
+    overlap_fraction: float | None = None,
+    path: str | None = None,
+) -> bool:
+    """Append one ``timeline`` JSONL record (raw flight-recorder events +
+    the fit identity they belong to); returns True if written.
+
+    ``path=None`` uses ``TPU_ML_TIMELINE_PATH`` and is a silent no-op when
+    that is unset or there are no events. Render/export with
+    ``python tools/trace_timeline.py <path>``.
+    """
+    if path is None:
+        path = timeline_path()
+    if not path or not events:
+        return False
+    try:
+        return _append_line(
+            path,
+            {
+                "type": "timeline",
+                "schema": 1,
+                "fit_id": fit_id,
+                "estimator": estimator,
+                "uid": uid,
+                "overlap_fraction": overlap_fraction,
+                "events": events,
+            },
+        )
+    except Exception:
+        logger.warning("timeline export to %s failed", path, exc_info=True)
+        return False
+
+
 def export_fit_report(report, path: str | None = None) -> bool:
     """Append one ``fit_report`` JSONL record; returns True if written.
 
@@ -37,16 +95,7 @@ def export_fit_report(report, path: str | None = None) -> bool:
     if not path:
         return False
     try:
-        data = (
-            json.dumps(report.to_dict(), separators=(",", ":"), sort_keys=True)
-            + "\n"
-        ).encode()
-        fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
-        try:
-            os.write(fd, data)
-        finally:
-            os.close(fd)
-        return True
+        return _append_line(path, report.to_dict())
     except Exception:
         logger.warning("telemetry export to %s failed", path, exc_info=True)
         return False

@@ -138,6 +138,33 @@ class Histogram:
             out[f"p{q}"] = self.percentile(q)
         return out
 
+    def to_wire(self) -> dict:
+        """JSON-safe full state (buckets included — unlike ``to_dict``,
+        this round-trips): the worker→driver telemetry trailer payload.
+        Bucket keys are stringified for JSON; infinities (empty histogram
+        extremes) are omitted rather than serialized."""
+        out: dict = {
+            "count": self.count,
+            "total": self.total,
+            "buckets": {str(k): v for k, v in self.buckets.items()},
+        }
+        if self.count:
+            out["vmin"] = self.vmin
+            out["vmax"] = self.vmax
+        return out
+
+    def merge_wire(self, wire: dict) -> None:
+        """Fold a ``to_wire`` payload into this histogram."""
+        self.count += int(wire.get("count", 0))
+        self.total += float(wire.get("total", 0.0))
+        if "vmin" in wire:
+            self.vmin = min(self.vmin, float(wire["vmin"]))
+        if "vmax" in wire:
+            self.vmax = max(self.vmax, float(wire["vmax"]))
+        for k, v in (wire.get("buckets") or {}).items():
+            idx = int(k)
+            self.buckets[idx] = self.buckets.get(idx, 0) + int(v)
+
 
 def _key(name: str, labels: dict) -> tuple:
     return (name, tuple(sorted((k, v) for k, v in labels.items() if v)))
@@ -149,6 +176,16 @@ def render_key(key: tuple) -> str:
     if not labels:
         return name
     return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+def _prom_escape(v) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 class MetricsRegistry:
@@ -178,6 +215,29 @@ class MetricsRegistry:
             if h is None:
                 h = self._hists[k] = Histogram()
             h.record(value)
+
+    def merge_wire(self, wire: dict, **extra_labels) -> None:
+        """Fold a :meth:`RegistrySnapshot.to_wire` payload — typically a
+        worker's registry delta shipped over the task protocol — into this
+        registry, stamping ``extra_labels`` (e.g. ``partition="3"``) onto
+        every merged series so driver-side reads attribute them."""
+        extra = {k: v for k, v in extra_labels.items() if v}
+        with self._lock:
+            for name, labels, value in wire.get("counters", ()):
+                k = _key(name, {**labels, **extra})
+                self._counters[k] = self._counters.get(k, 0) + value
+            for name, labels, value in wire.get("gauges", ()):
+                self._gauges[_key(name, {**labels, **extra})] = value
+            for name, labels, hwire in wire.get("hists", ()):
+                k = _key(name, {**labels, **extra})
+                h = self._hists.get(k)
+                if h is None:
+                    h = self._hists[k] = Histogram()
+                h.merge_wire(hwire)
+
+    def to_prometheus(self) -> str:
+        """Current state in the Prometheus text exposition format."""
+        return self.snapshot().to_prometheus()
 
     def reset(self) -> None:
         with self._lock:
@@ -277,6 +337,86 @@ class RegistrySnapshot:
             else:
                 phases[phase] = h.copy()
         return {p: h.to_dict(percentiles) for p, h in sorted(phases.items())}
+
+    def to_wire(self) -> dict:
+        """JSON-safe lossless form — labels kept structured, histogram
+        buckets included — for the worker→driver telemetry trailer. The
+        receiving side replays it with :meth:`MetricsRegistry.merge_wire`.
+        """
+        return {
+            "counters": [
+                [name, dict(labels), v]
+                for (name, labels), v in sorted(self.counters.items())
+            ],
+            "gauges": [
+                [name, dict(labels), v]
+                for (name, labels), v in sorted(self.gauges.items())
+            ],
+            "hists": [
+                [name, dict(labels), h.to_wire()]
+                for (name, labels), h in sorted(self.hists.items())
+            ],
+        }
+
+    def to_prometheus(self) -> str:
+        """The snapshot in the Prometheus text exposition format: counters
+        and gauges verbatim, histograms as cumulative ``_bucket{le=...}``
+        series (upper bound = the log-bucket's right edge) plus ``_sum`` /
+        ``_count``. Metric names are sanitized to the Prometheus charset
+        under a ``tpu_ml_`` prefix."""
+        lines: list[str] = []
+
+        def prom_name(name: str) -> str:
+            return "tpu_ml_" + "".join(
+                c if c.isalnum() or c == "_" else "_" for c in name
+            )
+
+        def prom_labels(labels, extra: str = "") -> str:
+            parts = [f'{k}="{_prom_escape(v)}"' for k, v in labels]
+            if extra:
+                parts.append(extra)
+            return "{" + ",".join(parts) + "}" if parts else ""
+
+        by_name: dict[str, list] = {}
+        for (name, labels), v in sorted(self.counters.items()):
+            by_name.setdefault(name, []).append((labels, v))
+        for name, series in by_name.items():
+            pn = prom_name(name)
+            lines.append(f"# TYPE {pn} counter")
+            for labels, v in series:
+                lines.append(f"{pn}{prom_labels(labels)} {v:g}")
+
+        by_name = {}
+        for (name, labels), v in sorted(self.gauges.items()):
+            by_name.setdefault(name, []).append((labels, v))
+        for name, series in by_name.items():
+            pn = prom_name(name)
+            lines.append(f"# TYPE {pn} gauge")
+            for labels, v in series:
+                lines.append(f"{pn}{prom_labels(labels)} {v:g}")
+
+        by_name = {}
+        for (name, labels), h in sorted(self.hists.items()):
+            by_name.setdefault(name, []).append((labels, h))
+        for name, series in by_name.items():
+            pn = prom_name(name)
+            lines.append(f"# TYPE {pn} histogram")
+            for labels, h in series:
+                cum = 0
+                for idx in sorted(h.buckets):
+                    cum += h.buckets[idx]
+                    le = 0.0 if idx == _ZERO_BUCKET else GROWTH ** (idx + 1)
+                    le_label = 'le="%g"' % le
+                    lines.append(
+                        f"{pn}_bucket{prom_labels(labels, le_label)} {cum}"
+                    )
+                inf_label = 'le="+Inf"'
+                lines.append(
+                    f"{pn}_bucket{prom_labels(labels, inf_label)} {h.count}"
+                )
+                lines.append(f"{pn}_sum{prom_labels(labels)} {h.total:g}")
+                lines.append(f"{pn}_count{prom_labels(labels)} {h.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
 
     def to_dict(self, percentiles=(50, 90, 99)) -> dict:
         """Flat JSON form: rendered-key counters/gauges plus span and

@@ -19,13 +19,18 @@ sink, so one user-visible ``fit()`` is one sink line.
 from __future__ import annotations
 
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
 from spark_rapids_ml_tpu.telemetry import compilemon, spans
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY, render_key
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
-SCHEMA_VERSION = 1
+# v2: + fit_id (log↔report correlation) and overlap_fraction (H2D↔compute
+# overlap evidence from the streamed fold). Readers must tolerate other
+# versions (tools/trace_report.py skips-with-note rather than KeyError).
+SCHEMA_VERSION = 2
 
 
 @dataclass
@@ -53,6 +58,12 @@ class FitReport:
     device_memory: dict[str, dict[str, int]] = field(default_factory=dict)
     counters: dict[str, float] = field(default_factory=dict)
     timestamp_unix: float = 0.0
+    # log↔report join key: stamped on package log records (%(fit_id)s) and
+    # timeline events recorded inside this fit's window
+    fit_id: str = ""
+    # mean streamed-fold overlap (overlapped dispatches / chunks) across
+    # the fit's stream_fold calls; None when nothing streamed
+    overlap_fraction: float | None = None
     schema: int = SCHEMA_VERSION
 
     @property
@@ -69,6 +80,8 @@ class FitReport:
             "schema": self.schema,
             "estimator": self.estimator,
             "uid": self.uid,
+            "fit_id": self.fit_id,
+            "overlap_fraction": self.overlap_fraction,
             "timestamp_unix": self.timestamp_unix,
             "wall_seconds": self.wall_seconds,
             "phases": self.phases,
@@ -97,33 +110,50 @@ class FitReport:
             device_memory=d.get("device_memory", {}),
             counters=d.get("counters", {}),
             timestamp_unix=float(d.get("timestamp_unix", 0.0)),
+            fit_id=d.get("fit_id", ""),
+            overlap_fraction=d.get("overlap_fraction"),
             schema=int(d.get("schema", SCHEMA_VERSION)),
         )
 
 
 class _FitCapture:
-    __slots__ = ("estimator", "uid", "token", "snap", "t0", "t_unix")
+    __slots__ = (
+        "estimator", "uid", "token", "snap", "t0", "t_unix",
+        "fit_id", "fit_id_token", "tl_seq",
+    )
 
-    def __init__(self, estimator: str, uid: str, token, snap, t0: float):
+    def __init__(
+        self, estimator: str, uid: str, token, snap, t0: float,
+        fit_id: str, fit_id_token, tl_seq: int,
+    ):
         self.estimator = estimator
         self.uid = uid
         self.token = token
         self.snap = snap
         self.t0 = t0
         self.t_unix = time.time()
+        self.fit_id = fit_id
+        self.fit_id_token = fit_id_token
+        self.tl_seq = tl_seq
 
 
 def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
-    """Open a capture window: install the compile listeners (first call
-    only), snapshot the registry, and label subsequent spans with the
-    estimator name."""
+    """Open a capture window: install the compile listeners and the
+    fit_id log filter (first call only), snapshot the registry and the
+    timeline watermark, mint a fit_id, and label subsequent spans with
+    the estimator name."""
     compilemon.install_monitoring()
+    spans.install_fit_id_filter()
+    fit_id = uuid.uuid4().hex[:12]
     return _FitCapture(
         estimator=estimator,
         uid=uid,
         token=spans.set_current_estimator(estimator),
         snap=REGISTRY.snapshot(),
         t0=time.perf_counter(),
+        fit_id=fit_id,
+        fit_id_token=spans.set_current_fit_id(fit_id),
+        tl_seq=TIMELINE.seq(),
     )
 
 
@@ -141,8 +171,14 @@ def end_fit(cap: _FitCapture) -> FitReport:
     restored even when the fit raised."""
     wall = time.perf_counter() - cap.t0
     spans.reset_current_estimator(cap.token)
+    spans.reset_current_fit_id(cap.fit_id_token)
     device_memory = compilemon.sample_device_memory()
     delta = REGISTRY.snapshot().delta(cap.snap)
+
+    # mean per-stream overlap fraction recorded by stream_fold; None when
+    # the fit never streamed (resident path, plain array fits)
+    ov = delta.hist("stream.overlap_fraction")
+    overlap_fraction = (ov.total / ov.count) if ov.count else None
 
     ingest_rows = int(delta.counter(_INGEST_ROWS))
     ingest_bytes = int(delta.counter(_INGEST_BYTES))
@@ -183,6 +219,8 @@ def end_fit(cap: _FitCapture) -> FitReport:
         device_memory=device_memory,
         counters=counters,
         timestamp_unix=cap.t_unix,
+        fit_id=cap.fit_id,
+        overlap_fraction=overlap_fraction,
     )
 
 

@@ -24,6 +24,7 @@ import logging
 import time
 
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
 logger = logging.getLogger("spark_rapids_ml_tpu")
 
@@ -32,6 +33,13 @@ logger = logging.getLogger("spark_rapids_ml_tpu")
 # trace_range call site threading a label through.
 _current_estimator: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "tpu_ml_current_estimator", default=None
+)
+
+# The fit_id of the same window — stamped into timeline events AND every
+# package log record (via _FitIdFilter), so `grep <fit_id>` joins the log
+# stream with the JSONL report of one specific fit.
+_current_fit_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "tpu_ml_current_fit_id", default=None
 )
 
 
@@ -48,6 +56,38 @@ def reset_current_estimator(token) -> None:
     _current_estimator.reset(token)
 
 
+def current_fit_id() -> str | None:
+    return _current_fit_id.get()
+
+
+def set_current_fit_id(fit_id: str | None):
+    """Returns the reset token (contextvars protocol)."""
+    return _current_fit_id.set(fit_id)
+
+
+def reset_current_fit_id(token) -> None:
+    _current_fit_id.reset(token)
+
+
+class _FitIdFilter(logging.Filter):
+    """Stamps ``record.fit_id`` (the current fit's id, or ``"-"``) onto
+    every record of the package logger, so a format string with
+    ``%(fit_id)s`` correlates log lines with exported FitReports. A Filter
+    rather than a LoggerAdapter: it covers every module-level ``logger``
+    in the package without changing any call site."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.fit_id = _current_fit_id.get() or "-"
+        return True
+
+
+def install_fit_id_filter() -> None:
+    """Attach the fit_id filter to the package logger (idempotent)."""
+    pkg = logging.getLogger("spark_rapids_ml_tpu")
+    if not any(isinstance(f, _FitIdFilter) for f in pkg.filters):
+        pkg.addFilter(_FitIdFilter())
+
+
 @contextlib.contextmanager
 def trace_range(name: str):
     """Host+device trace span with registry-backed latency accounting."""
@@ -61,11 +101,19 @@ def trace_range(name: str):
         with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
             yield
     finally:
-        elapsed = time.perf_counter() - start
+        end = time.perf_counter()
+        elapsed = end - start
         REGISTRY.histogram_record(
             "span.seconds",
             elapsed,
             phase=name,
             estimator=_current_estimator.get() or "",
+        )
+        TIMELINE.record_span(
+            name,
+            start,
+            end,
+            estimator=_current_estimator.get() or "",
+            fit_id=_current_fit_id.get() or "",
         )
         logger.debug("trace %s: %.3fs", name, elapsed)

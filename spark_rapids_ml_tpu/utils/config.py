@@ -31,6 +31,20 @@ module is tier 2 for the TPU build — process-level knobs read from
   for per-fit telemetry reports (``telemetry.export``). Each completed
   ``fit()`` appends one ``fit_report`` record; render with
   ``python tools/trace_report.py <path>``.
+- ``TPU_ML_TIMELINE_PATH``   (path, default ``''`` = disabled) — JSONL sink
+  for per-fit flight-recorder timelines (``telemetry.timeline``): one
+  ``timeline`` record of raw span/instant events per outermost ``fit()``.
+  May point at the same file as ``TPU_ML_TELEMETRY_PATH`` (readers filter
+  by record type). Export to Perfetto-loadable Chrome trace JSON with
+  ``python tools/trace_timeline.py <path> --out trace.json``.
+- ``TPU_ML_TIMELINE_EVENTS`` (int, default 4096; 0 disables; read directly
+  by ``telemetry.timeline``, not cached here) — ring-buffer capacity of
+  the flight recorder. Old events fall off; aggregate truth stays in the
+  metrics registry.
+- ``TPU_ML_PROGRESS`` (float seconds, default unset = off; read directly
+  by ``spark.ingest.stream_fold``) — emit a live progress heartbeat line
+  to stderr every N seconds during a streamed fit: rows done, rows/s,
+  current chunk size, retries/bisections so far.
 - ``TPU_ML_RETRY_MAX_ATTEMPTS`` (int, default 4) — attempt budget for the
   shared retry policy (``resilience.retry.RetryPolicy.from_config``):
   classified-transient failures at the data-movement/compute choke points
@@ -67,7 +81,12 @@ VALID_PRECISIONS = ("highest", "high", "default")
 VALID_NONFINITE_POLICIES = ("raise", "skip", "allow")
 
 # config fields whose values are strings (everything else is int-typed)
-_STR_KEYS = ("default_precision", "telemetry_path", "nonfinite_policy")
+_STR_KEYS = (
+    "default_precision",
+    "telemetry_path",
+    "timeline_path",
+    "nonfinite_policy",
+)
 
 
 def _int_env(name: str, default: int) -> int:
@@ -111,6 +130,9 @@ class RuntimeConfig:
     )
     telemetry_path: str = field(
         default_factory=lambda: os.environ.get("TPU_ML_TELEMETRY_PATH", "")
+    )
+    timeline_path: str = field(
+        default_factory=lambda: os.environ.get("TPU_ML_TIMELINE_PATH", "")
     )
     retry_max_attempts: int = field(
         default_factory=lambda: _int_env("TPU_ML_RETRY_MAX_ATTEMPTS", 4)

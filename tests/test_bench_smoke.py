@@ -49,6 +49,12 @@ def test_bench_smoke_json_contract():
     # pipeline introspection must ride along so perf regressions in the
     # overlap machinery are visible in the bench record
     assert "overlapped_dispatches" in sf
+    # flight-recorder evidence (ISSUE 4): the recorded H2D<->compute
+    # overlap fraction of the timed streamed reps — structural only, no
+    # absolute-time assertions (wall-clock is host-load-dependent)
+    assert "overlap_fraction" in sf
+    if sf["overlap_fraction"] is not None:
+        assert 0.0 <= sf["overlap_fraction"] <= 1.0
 
     # the telemetry snapshot makes every BENCH_r* round phase-attributable
     # (ISSUE 2): full registry state keyed counters/gauges/spans/histograms

@@ -133,6 +133,73 @@ def test_recovered_but_degraded_anomaly_fires():
     assert any("recovered-but-degraded" in a for a in anomalies)
 
 
+def test_newer_schema_skipped_with_note_not_keyerror(tmp_path, capsys):
+    """Schema-tolerance satellite: a record from a future schema renders as
+    a skip-note, and --strict turns skips into exit 2."""
+    mod = _load_cli_module()
+    import json
+
+    future = {"type": "fit_report", "schema": 99, "estimator": "X"}
+    ok = {
+        "type": "fit_report",
+        "estimator": "Y",
+        "wall_seconds": 1.0,
+        "rows_ingested": 10,
+        "phases": {},
+        "compile": {},
+    }
+    p = tmp_path / "t.jsonl"
+    p.write_text(json.dumps(future) + "\n" + json.dumps(ok) + "\n")
+    assert mod.main([str(p)]) == 0  # the good record still rendered
+    captured = capsys.readouterr()
+    assert "newer than this tool" in captured.err
+    assert "Y" in captured.out
+    assert mod.main([str(p), "--strict"]) == 2
+
+
+def test_malformed_record_skipped_not_traceback(tmp_path, capsys):
+    mod = _load_cli_module()
+    import json
+
+    # phases as a list breaks the renderer's .items(); must skip, not raise
+    bad = {"type": "fit_report", "estimator": "X", "phases": [1, 2]}
+    ok = {
+        "type": "fit_report",
+        "estimator": "Y",
+        "wall_seconds": 1.0,
+        "rows_ingested": 10,
+        "phases": {},
+        "compile": {},
+    }
+    p = tmp_path / "t.jsonl"
+    p.write_text(json.dumps(bad) + "\n" + json.dumps(ok) + "\n")
+    assert mod.main([str(p)]) == 0
+    captured = capsys.readouterr()
+    assert "skipping unrenderable record" in captured.err
+    assert "Y" in captured.out
+
+
+def test_overlap_fraction_and_fit_id_rendered():
+    mod = _load_cli_module()
+    import io
+
+    rec = {
+        "type": "fit_report",
+        "estimator": "X",
+        "fit_id": "abc123def456",
+        "overlap_fraction": 0.75,
+        "wall_seconds": 1.0,
+        "rows_ingested": 10,
+        "phases": {},
+        "compile": {},
+    }
+    buf = io.StringIO()
+    mod.render_record(rec, out=buf)
+    out = buf.getvalue()
+    assert "fit=abc123def456" in out
+    assert "overlap: 0.75" in out
+
+
 def test_fault_injection_anomaly_fires_and_strict_exits_2(tmp_path):
     mod = _load_cli_module()
     import json

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""One-shot Prometheus text exposition from per-fit telemetry JSONL.
+
+Usage::
+
+    python tools/metrics_dump.py /path/to/telemetry.jsonl [--last N]
+
+There is no long-lived server process to scrape — fits run inside batch
+jobs — so this re-aggregates the ``fit_report`` records of a JSONL sink
+(``TPU_ML_TELEMETRY_PATH``) into a fresh
+:class:`~spark_rapids_ml_tpu.telemetry.registry.MetricsRegistry` and
+prints :meth:`to_prometheus` text, suitable for a node-exporter textfile
+collector or a pushgateway::
+
+    python tools/metrics_dump.py telemetry.jsonl \\
+        > /var/lib/node_exporter/textfile/tpu_ml.prom
+
+Counter keys are parsed back from their rendered ``name{k=v,...}`` form;
+the report's dedicated fields re-emit as counters (``rows_ingested``,
+``h2d_bytes``, ``collective.count`` ...) and per-fit scalars
+(``fit.wall_seconds``, ``compile.seconds``) as one-sample-per-fit
+histograms, all labeled by estimator. Importing the registry does not pull
+in jax, so this runs on telemetry-collection hosts without it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# runnable straight from a checkout: the registry import needs the repo
+# root, which `python tools/metrics_dump.py` does not put on sys.path
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def parse_rendered_key(key: str) -> tuple[str, dict[str, str]]:
+    """Invert ``telemetry.registry.render_key``: ``name{k=v,...}`` →
+    ``(name, labels)``. Values never contain ``,`` or ``=`` (label values
+    are estimator/site/phase identifiers)."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    labels = {}
+    for part in rest.rstrip("}").split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return name, labels
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Dump telemetry JSONL as Prometheus exposition text"
+    )
+    ap.add_argument("path", help="telemetry JSONL file (TPU_ML_TELEMETRY_PATH)")
+    ap.add_argument(
+        "--last", type=int, default=0, metavar="N",
+        help="only aggregate the last N fit reports",
+    )
+    args = ap.parse_args(argv)
+
+    from spark_rapids_ml_tpu.telemetry.export import read_jsonl
+    from spark_rapids_ml_tpu.telemetry.registry import MetricsRegistry
+
+    try:
+        records = [
+            r for r in read_jsonl(args.path) if r.get("type") == "fit_report"
+        ]
+    except OSError as e:
+        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    if not records:
+        print(f"no fit_report records in {args.path}", file=sys.stderr)
+        return 1
+    if args.last > 0:
+        records = records[-args.last:]
+
+    reg = MetricsRegistry()
+    for rec in records:
+        est = rec.get("estimator", "")
+        for key, v in (rec.get("counters") or {}).items():
+            name, labels = parse_rendered_key(key)
+            reg.counter_inc(name, v, **labels)
+        for name, v in (
+            ("rows_ingested", rec.get("rows_ingested", 0)),
+            ("bytes_ingested", rec.get("bytes_ingested", 0)),
+            ("h2d_bytes", rec.get("h2d_bytes", 0)),
+        ):
+            if v:
+                reg.counter_inc(name, v, estimator=est)
+        coll = rec.get("collectives") or {}
+        for k in ("count", "bytes", "tree_combines"):
+            if coll.get(k):
+                reg.counter_inc(f"collective.{k}", coll[k], estimator=est)
+        comp = rec.get("compile") or {}
+        for k in ("count", "cache_hits", "cache_misses"):
+            if comp.get(k):
+                reg.counter_inc(f"compile.{k}", comp[k], estimator=est)
+        reg.counter_inc("fits", 1, estimator=est)
+        reg.histogram_record(
+            "fit.wall_seconds", rec.get("wall_seconds", 0.0), estimator=est
+        )
+        if comp.get("seconds"):
+            reg.histogram_record("compile.seconds", comp["seconds"], estimator=est)
+        ov = rec.get("overlap_fraction")
+        if ov is not None:
+            reg.histogram_record("stream.overlap_fraction", ov, estimator=est)
+
+    sys.stdout.write(reg.to_prometheus())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

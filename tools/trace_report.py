@@ -25,9 +25,14 @@ of anomaly checks — heuristics that turn the numbers into a diagnosis:
 - nonzero ``fault.injected`` ⇒ a TPU_ML_FAULT_PLAN was active; expected
   only in chaos tests, never in a production report.
 
-Exit status: 0 normally; with ``--strict``, 2 when any anomaly fired
-(CI gate). Stdlib-only on the read path — the report must render on hosts
-without jax installed.
+The reader is tolerant by design: a record from a newer schema than this
+tool understands, or one missing the fields a renderer needs, is skipped
+with a note — never a KeyError traceback — so one odd record cannot hide
+the rest of the file.
+
+Exit status: 0 normally; with ``--strict``, 2 when any anomaly fired OR
+any record had to be skipped (CI gate). Stdlib-only on the read path —
+the report must render on hosts without jax installed.
 """
 
 from __future__ import annotations
@@ -35,6 +40,11 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+# highest fit_report schema this renderer understands (telemetry.report
+# .SCHEMA_VERSION); newer records are skipped with a note, older ones
+# render with defaults for the fields they predate
+SUPPORTED_SCHEMA = 2
 
 
 def _fmt_s(v: float) -> str:
@@ -123,7 +133,18 @@ def render_record(rec: dict, out=sys.stdout) -> list[str]:
     est = rec.get("estimator", "?")
     uid = rec.get("uid", "")
     wall = rec.get("wall_seconds", 0.0)
-    print(f"\n=== {est}{f' [{uid}]' if uid else ''} — {_fmt_s(wall)} ===", file=out)
+    fit_id = rec.get("fit_id", "")
+    tag = f" [{uid}]" if uid else ""
+    tag += f" fit={fit_id}" if fit_id else ""
+    print(f"\n=== {est}{tag} — {_fmt_s(wall)} ===", file=out)
+    ov = rec.get("overlap_fraction")
+    if ov is not None:
+        print(
+            f"streamed H2D<->compute overlap: {ov:.2f} "
+            f"({'overlapped' if ov > 0 else 'NOT overlapped'}; "
+            "see tools/trace_timeline.py for the event view)",
+            file=out,
+        )
 
     phases = rec.get("phases", {})
     if phases:
@@ -227,10 +248,32 @@ def main(argv=None) -> int:
 
     print(f"{len(records)} fit report(s) from {args.path}")
     any_anomaly = False
-    for rec in records:
-        if render_record(rec):
-            any_anomaly = True
-    return 2 if (args.strict and any_anomaly) else 0
+    skipped = 0
+    for i, rec in enumerate(records):
+        schema = rec.get("schema", 1)
+        if isinstance(schema, (int, float)) and schema > SUPPORTED_SCHEMA:
+            print(
+                f"# skipping record {i}: schema {schema} is newer than this "
+                f"tool understands (<= {SUPPORTED_SCHEMA}) — upgrade "
+                "tools/trace_report.py",
+                file=sys.stderr,
+            )
+            skipped += 1
+            continue
+        try:
+            if render_record(rec):
+                any_anomaly = True
+        except Exception as e:  # noqa: BLE001 — a bad record must not
+            # hide the rest of the file
+            print(
+                f"# skipping unrenderable record {i} "
+                f"({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
+            skipped += 1
+    if skipped:
+        print(f"# {skipped} record(s) skipped", file=sys.stderr)
+    return 2 if (args.strict and (any_anomaly or skipped)) else 0
 
 
 if __name__ == "__main__":

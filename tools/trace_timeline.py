@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Flight-recorder timeline: export to Chrome trace JSON + overlap/gap report.
+
+Usage::
+
+    python tools/trace_timeline.py /path/to/timeline.jsonl
+    python tools/trace_timeline.py timeline.jsonl --out trace.json
+    python tools/trace_timeline.py timeline.jsonl --last 1 --strict \\
+        --gap-threshold 0.5
+
+Input is either the JSONL file written by ``TPU_ML_TIMELINE_PATH``
+(``timeline`` records, one per outermost fit — see
+``telemetry/export.py``) or an already-exported Chrome trace JSON object.
+
+The default output is a per-fit summary: event counts, per-track (one
+track = one ``(pid, partition)``) span busy time and the largest idle gap
+between consecutive spans, straggler tracks (busy time well above the
+median — the partition everyone else waited on), instant-event tallies
+(retries, bisections, checkpoints, faults) and the recorded H2D↔compute
+overlap fraction.
+
+``--out trace.json`` merges the selected records into one Chrome
+trace-event JSON file that loads directly in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``. Driver and worker
+events share a clock (CLOCK_MONOTONIC is system-wide on Linux) so they
+interleave correctly; each pid renders as its own named process track.
+
+Exit status: 0 normally; with ``--strict``, 2 when any track's largest
+gap exceeds ``--gap-threshold`` seconds (default 1.0) — the CI gate for
+"the pipeline stalled". Stdlib-only: renders on hosts without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def load_records(path: str) -> list[dict]:
+    """Timeline records from JSONL (``type == "timeline"``) or a raw Chrome
+    trace object (wrapped as one synthetic record). Corrupt JSONL lines are
+    skipped with a note — a torn line from a crashed process must not hide
+    the rest of the file."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{") and '"traceEvents"' in stripped[:200]:
+        trace = json.loads(text)
+        events = [
+            e for e in trace.get("traceEvents", []) if e.get("ph") != "M"
+        ]
+        return [{"type": "timeline", "fit_id": "", "events": events}]
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            print("# skipping corrupt line", file=sys.stderr)
+            continue
+        if rec.get("type") == "timeline":
+            records.append(rec)
+    return records
+
+
+def chrome_trace(events: list[dict]) -> dict:
+    """Events → Chrome trace-event JSON (mirrors
+    ``telemetry.timeline.chrome_trace``, re-implemented here so the tool
+    stays importable without the package installed)."""
+    pids: list = []
+    out = []
+    for e in events:
+        e = {k: v for k, v in e.items() if k != "seq"}
+        pid = e.get("pid", 0)
+        if pid not in pids:
+            pids.append(pid)
+        out.append(e)
+    meta = []
+    for pid in pids:
+        part = next(
+            (
+                e["args"]["partition"]
+                for e in out
+                if e.get("pid") == pid and (e.get("args") or {}).get("partition")
+            ),
+            None,
+        )
+        name = f"worker partition {part}" if part is not None else f"pid {pid}"
+        meta.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": name},
+            }
+        )
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def _track_key(e: dict) -> tuple:
+    return (e.get("pid", 0), (e.get("args") or {}).get("partition", ""))
+
+
+def summarize_record(rec: dict, gap_threshold_s: float, out=sys.stdout) -> bool:
+    """Print one timeline record's report; returns True when a track's
+    largest inter-span gap exceeds the threshold (the --strict trigger)."""
+    events = [e for e in rec.get("events", []) if isinstance(e, dict)]
+    fit_id = rec.get("fit_id", "")
+    est = rec.get("estimator", "")
+    head = " ".join(x for x in (est, f"[{fit_id}]" if fit_id else "") if x)
+    print(f"\n=== timeline {head or '(unlabeled)'}: {len(events)} events ===",
+          file=out)
+    ov = rec.get("overlap_fraction")
+    if ov is not None:
+        print(f"H2D<->compute overlap fraction: {ov:.2f}", file=out)
+
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+
+    tally: dict[str, int] = {}
+    for e in instants:
+        tally[e.get("name", "?")] = tally.get(e.get("name", "?"), 0) + 1
+    if tally:
+        print(
+            "instants: "
+            + ", ".join(f"{n} x{c}" for n, c in sorted(tally.items())),
+            file=out,
+        )
+
+    exceeded = False
+    if spans:
+        tracks: dict[tuple, list[dict]] = {}
+        for e in spans:
+            tracks.setdefault(_track_key(e), []).append(e)
+        rows = []
+        busies = {}
+        for key, evs in tracks.items():
+            evs.sort(key=lambda e: e.get("ts", 0))
+            busy = sum(e.get("dur", 0) for e in evs) / 1e6
+            extent = (
+                evs[-1].get("ts", 0) + evs[-1].get("dur", 0) - evs[0].get("ts", 0)
+            ) / 1e6
+            max_gap = 0.0
+            end = None
+            for e in evs:
+                ts = e.get("ts", 0)
+                if end is not None and ts > end:
+                    max_gap = max(max_gap, (ts - end) / 1e6)
+                end = max(end or 0, ts + e.get("dur", 0))
+            busies[key] = busy
+            if max_gap > gap_threshold_s:
+                exceeded = True
+            pid, part = key
+            rows.append([
+                f"partition {part}" if part else f"driver pid {pid}",
+                len(evs),
+                _fmt_s(busy),
+                _fmt_s(extent),
+                _fmt_s(max_gap) + (" !!" if max_gap > gap_threshold_s else ""),
+            ])
+        rows.sort(key=lambda r: r[0])
+        widths = [max(len(str(r[i])) for r in rows + [["track", "spans", "busy", "extent", "max gap"]]) for i in range(5)]
+        header = ["track", "spans", "busy", "extent", "max gap"]
+        print("  ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip(), file=out)
+        print("  ".join("-" * w for w in widths), file=out)
+        for r in rows:
+            print("  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip(), file=out)
+
+        # straggler: a track busy > 2x the median busy time means the rest
+        # of the stage sat waiting on it
+        if len(busies) >= 3:
+            vals = sorted(busies.values())
+            median = vals[len(vals) // 2]
+            for key, busy in sorted(busies.items()):
+                if median > 0 and busy > 2.0 * median:
+                    pid, part = key
+                    label = f"partition {part}" if part else f"driver pid {pid}"
+                    print(
+                        f"  !! straggler: {label} busy {_fmt_s(busy)} > 2x "
+                        f"median {_fmt_s(median)}",
+                        file=out,
+                    )
+    else:
+        print("(no spans)", file=out)
+    return exceeded
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize/export flight-recorder timeline JSONL"
+    )
+    ap.add_argument(
+        "path", help="timeline JSONL (TPU_ML_TIMELINE_PATH) or Chrome trace JSON"
+    )
+    ap.add_argument(
+        "--out", metavar="TRACE_JSON", default="",
+        help="write the selected records merged as Chrome trace JSON "
+             "(load in Perfetto / chrome://tracing)",
+    )
+    ap.add_argument(
+        "--last", type=int, default=0, metavar="N",
+        help="only use the last N timeline records",
+    )
+    ap.add_argument(
+        "--fit", default="", metavar="FIT_ID",
+        help="only use records with this fit_id",
+    )
+    ap.add_argument(
+        "--gap-threshold", type=float, default=1.0, metavar="SECONDS",
+        help="largest tolerated idle gap within a track (default 1.0)",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="exit 2 when any track's max gap exceeds --gap-threshold",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        records = load_records(args.path)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    if args.fit:
+        records = [r for r in records if r.get("fit_id") == args.fit]
+    if args.last > 0:
+        records = records[-args.last:]
+    if not records:
+        print(f"no timeline records in {args.path}", file=sys.stderr)
+        return 1
+
+    print(f"{len(records)} timeline record(s) from {args.path}")
+    any_exceeded = False
+    for rec in records:
+        if summarize_record(rec, args.gap_threshold):
+            any_exceeded = True
+
+    if args.out:
+        merged: list[dict] = []
+        for rec in records:
+            merged.extend(e for e in rec.get("events", []) if isinstance(e, dict))
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(chrome_trace(merged), f)
+        print(f"\nwrote Chrome trace: {args.out} ({len(merged)} events)")
+
+    return 2 if (args.strict and any_exceeded) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
