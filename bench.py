@@ -173,6 +173,83 @@ def _paired_slope(short_call, long_call, iter_delta: int, reps: int):
     return med, slopes
 
 
+def _ledger_path() -> str:
+    """PERF_LEDGER.jsonl location: ``TPU_ML_PERF_LEDGER_PATH`` override, or
+    next to this script ('' disables the ledger entirely)."""
+    env = os.environ.get("TPU_ML_PERF_LEDGER_PATH")
+    if env is not None:
+        return env
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "PERF_LEDGER.jsonl"
+    )
+
+
+def _ledger_entry(record: dict) -> dict:
+    """Flatten one bench JSON record into a perf-ledger line: every metric
+    as ``name -> {value, unit}`` (what tools/perf_sentinel.py compares
+    across runs) plus the run's analytical cost-model numbers."""
+    metrics = {
+        record["metric"]: {"value": record["value"], "unit": record["unit"]}
+    }
+    for extra in record.get("extra_metrics", []):
+        metrics[extra["metric"]] = {
+            "value": extra["value"],
+            "unit": extra.get("unit", ""),
+        }
+    from spark_rapids_ml_tpu.telemetry import REGISTRY, costmodel
+
+    snap = REGISTRY.snapshot()
+    cost = {
+        "kernels": costmodel.kernel_costs(),
+        "analytical_flops": snap.counter("costmodel.flops"),
+        "analytical_bytes": snap.counter("costmodel.bytes"),
+        "peak_flops": costmodel.peak_flops(),
+    }
+    return {
+        "type": "perf_ledger",
+        "schema": 1,
+        "timestamp_unix": time.time(),
+        "smoke": SMOKE,
+        "metrics": metrics,
+        "cost_model": cost,
+        "derived": record.get("derived"),
+    }
+
+
+def _emit_result(record: dict) -> None:
+    """Print the bench JSON line, append it to the perf ledger, and — under
+    ``TPU_ML_PERF_SENTINEL=1`` — gate the run on tools/perf_sentinel.py
+    ``--strict`` (regression vs the median of prior ledger entries fails
+    the process). The opt-in keeps tier-1 deterministic while CI can turn
+    ``bench --smoke`` into a perf regression gate."""
+    print(json.dumps(record))
+    path = _ledger_path()
+    appended = False
+    if path:
+        try:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(
+                    json.dumps(_ledger_entry(record), sort_keys=True) + "\n"
+                )
+            appended = True
+        except OSError as e:
+            print(f"perf ledger append to {path} failed: {e}",
+                  file=sys.stderr)
+    if appended and os.environ.get("TPU_ML_PERF_SENTINEL") == "1":
+        import subprocess
+
+        sentinel = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools", "perf_sentinel.py",
+        )
+        proc = subprocess.run(
+            [sys.executable, sentinel, path, "--strict"],
+            capture_output=False,
+        )
+        if proc.returncode != 0:
+            raise SystemExit(proc.returncode)
+
+
 def main() -> None:
     # Transport-recovery preamble (r3 verdict #1): the accelerator transport
     # on this host wedges *transiently* (observed: hours, clearing on its
@@ -392,8 +469,8 @@ def main() -> None:
             "mxu_utilization": round(hw_tflops_high / V5E_BF16_PEAK_TFLOPS, 3),
         }
     )
-    print(
-        json.dumps(
+    _emit_result(
+        (
             {
                 # the non-smoke name is the cross-round primary-metric key:
                 # it must stay byte-identical to BENCH_r01/r02's

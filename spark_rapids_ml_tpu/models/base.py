@@ -197,7 +197,129 @@ def spark_set_params(instance: Params) -> dict:
     return {k: persistence._jsonable(v) for k, v in instance._paramMap.items()}
 
 
+# Transform-nesting depth per thread: PipelineModel.transform → per-stage
+# transforms, OneVsRestModel → per-class model transforms. Mirror of
+# ``_fit_depth`` below; only the outermost transform exports.
+_transform_depth = threading.local()
+
+
+def _is_lazy_plan(out: Any) -> bool:
+    """A localspark DataFrame: a lazy plan whose partition generator
+    (``_parts``) executes at action time and can be re-pointed."""
+    return callable(getattr(out, "_parts", None)) and hasattr(out, "_derive")
+
+
+def _defer_transform_finalize(df: Any, cap, finalize) -> None:
+    """Arrange for ``finalize`` to run when ``df`` first materializes.
+
+    ``transform`` on a localspark DataFrame returns a *plan* — no partition
+    function has run yet, so finalizing at return would report zero rows.
+    Re-point the instance's ``_parts`` generator: the wrapper restores the
+    transform_id contextvar for the duration of execution (so worker-merge
+    telemetry and log records stamp correctly) and closes the capture once
+    the plan is first exhausted. Derived frames (select/filter over the
+    result) read ``self._parts`` at iteration time, so they hit the wrapper
+    too.
+    """
+    from spark_rapids_ml_tpu import telemetry
+
+    orig = df._parts
+
+    def parts_with_capture():
+        token = telemetry.set_current_transform_id(cap.transform_id)
+        try:
+            yield from orig()
+        finally:
+            try:
+                telemetry.reset_current_transform_id(token)
+            except ValueError:  # pragma: no cover - foreign-context reuse
+                pass
+            finalize()
+
+    df._parts = parts_with_capture
+
+
+def _instrumented_transform(transform):
+    """Wrap one class's ``transform`` with serve-side telemetry capture.
+
+    Applied by ``Transformer.__init_subclass__`` to every subclass that
+    defines its own ``transform`` — models and feature transformers get
+    TransformReport/JSONL behavior with zero per-class code, mirroring
+    ``_instrumented_fit``. Eager results (arrays, in-core paths) finalize at
+    return; lazy localspark plans finalize at first materialization (see
+    ``_defer_transform_finalize``); other lazy frames (real pyspark)
+    finalize at return with planning-only numbers.
+    """
+
+    @functools.wraps(transform)
+    def transform_with_telemetry(self, *args, **kwargs):
+        from spark_rapids_ml_tpu import telemetry
+
+        depth = getattr(_transform_depth, "value", 0)
+        _transform_depth.value = depth + 1
+        cap = telemetry.begin_transform(
+            type(self).__name__, getattr(self, "uid", "") or ""
+        )
+        done = False
+
+        def finalize():
+            nonlocal done
+            if done:
+                return
+            done = True
+            report = telemetry.end_transform(cap)
+            telemetry.attach_transform_report(self, report)
+            if depth == 0:
+                telemetry.export_transform_report(report)
+                telemetry.export_timeline(
+                    telemetry.TIMELINE.events(since_seq=cap.tl_seq),
+                    transform_id=report.transform_id,
+                    estimator=report.transformer,
+                    uid=report.uid,
+                )
+
+        try:
+            out = transform(self, *args, **kwargs)
+        except BaseException:
+            _transform_depth.value = depth
+            finalize()
+            raise
+        _transform_depth.value = depth
+        if depth == 0 and _is_lazy_plan(out):
+            # restore context now (the report window stays open until the
+            # plan runs); the _parts wrapper re-establishes transform_id
+            # around execution
+            telemetry.release_transform_context(cap)
+            _defer_transform_finalize(out, cap, finalize)
+        else:
+            finalize()
+        return out
+
+    transform_with_telemetry._telemetry_wrapped = True
+    return transform_with_telemetry
+
+
 class Transformer(Saveable):
+    """Pipeline stage with ``transform``.
+
+    ``transform_report`` is the
+    :class:`~spark_rapids_ml_tpu.telemetry.TransformReport` of the last
+    ``transform()`` call on this instance (per-partition rows/bytes,
+    partition latency percentiles, analytical kernel cost); ``None`` before
+    the first transform. For lazy localspark results it appears once the
+    returned DataFrame materializes.
+    """
+
+    transform_report = None
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        transform = cls.__dict__.get("transform")
+        if transform is not None and not getattr(
+            transform, "_telemetry_wrapped", False
+        ):
+            cls.transform = _instrumented_transform(transform)
+
     def transform(self, dataset: Any) -> Any:
         raise NotImplementedError
 

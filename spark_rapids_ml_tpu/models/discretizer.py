@@ -32,7 +32,7 @@ from spark_rapids_ml_tpu.models.params import (
 )
 from spark_rapids_ml_tpu.ops import scaler as S
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 _bucketize = jax.jit(S.bucketize)
 

@@ -41,7 +41,7 @@ from spark_rapids_ml_tpu.models.params import (
 )
 from spark_rapids_ml_tpu.ops import forest as FO
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 #: rows sampled (not streamed) for quantile bin-edge estimation — the same
 #: bounded-sample role Spark's findSplits sampling plays

@@ -55,7 +55,7 @@ from spark_rapids_ml_tpu.models.forest import (
 from spark_rapids_ml_tpu.models.params import Param
 from spark_rapids_ml_tpu.ops import forest as FO
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 
 class _GBTParams(_ForestParams):

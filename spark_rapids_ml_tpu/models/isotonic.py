@@ -30,7 +30,7 @@ from spark_rapids_ml_tpu.models.params import (
     Param,
 )
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 
 def _pav(y: np.ndarray, w: np.ndarray) -> np.ndarray:

@@ -21,7 +21,7 @@ from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
 from spark_rapids_ml_tpu.ops import kmeans as KM
 from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 _MAX_INIT_SAMPLE = 16384
 

@@ -30,7 +30,7 @@ from spark_rapids_ml_tpu.ops import linear as LIN
 from spark_rapids_ml_tpu.parallel.executor import run_partition_tasks
 from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 import jax.numpy as jnp
 

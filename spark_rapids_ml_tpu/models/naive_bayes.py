@@ -37,7 +37,7 @@ from spark_rapids_ml_tpu.models.params import (
 from spark_rapids_ml_tpu.ops import naive_bayes as NB
 from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 _MODEL_TYPES = ("multinomial", "bernoulli", "gaussian")
 

@@ -32,7 +32,7 @@ from spark_rapids_ml_tpu.models.base import Estimator, Model
 from spark_rapids_ml_tpu.models.params import HasInputCol, Param
 from spark_rapids_ml_tpu.ops import neighbors as NN
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 _METRICS = ("euclidean", "sqeuclidean", "cosine", "inner_product")
 

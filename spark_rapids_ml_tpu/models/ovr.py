@@ -23,7 +23,7 @@ from spark_rapids_ml_tpu.models.params import (
     HasPredictionCol,
 )
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 
 def _positive_score(model, mat: np.ndarray) -> np.ndarray:

@@ -40,7 +40,7 @@ from spark_rapids_ml_tpu.models.base import Estimator, Model
 from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import costmodel, trace_range
 
 try:
     import pyarrow as pa
@@ -256,7 +256,12 @@ class PCA(PCAParams, Estimator):
 
                     def partition_task(mat):
                         padded, true_rows = columnar.pad_rows(mat)
-                        stats = _gram_stats(jnp.asarray(padded), precision=prec)
+                        xd = jnp.asarray(padded)
+                        costmodel.capture(
+                            "linalg.gram_stats", _gram_stats, xd,
+                            precision=prec,
+                        )
+                        stats = _gram_stats(xd, precision=prec)
                         # padding adds zero rows: fix only the count
                         return L.GramStats(
                             stats.xtx,
@@ -324,7 +329,9 @@ class PCAModel(PCAParams, Model):
     def _project_matrix(self, mat: np.ndarray) -> np.ndarray:
         padded, true_rows = columnar.pad_rows(self._standardize_host(mat))
         xd = jnp.asarray(padded)  # device dtype (f32 unless x64 is enabled)
-        out = _project(xd, jnp.asarray(self.pc, dtype=xd.dtype))
+        pc_dev = jnp.asarray(self.pc, dtype=xd.dtype)
+        costmodel.capture("linalg.project", _project, xd, pc_dev)
+        out = _project(xd, pc_dev)
         return np.asarray(out)[:true_rows]
 
     def transform(self, dataset: Any) -> Any:

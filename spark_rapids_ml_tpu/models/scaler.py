@@ -22,7 +22,7 @@ from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
 from spark_rapids_ml_tpu.ops import scaler as S
 from spark_rapids_ml_tpu.parallel.tree_aggregate import tree_reduce
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 _moment_stats = jax.jit(S.moment_stats)
 _finalize = jax.jit(S.finalize_moments)

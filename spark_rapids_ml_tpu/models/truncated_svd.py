@@ -30,7 +30,7 @@ from spark_rapids_ml_tpu.models.base import Estimator, Model
 from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 
 class TruncatedSVDParams(HasInputCol, HasOutputCol):

@@ -34,7 +34,7 @@ from spark_rapids_ml_tpu.models.neighbors import _finalize_distances
 from spark_rapids_ml_tpu.ops import neighbors as NN
 from spark_rapids_ml_tpu.ops import umap as UM
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 
 class _UMAPParams(HasInputCol, HasOutputCol):

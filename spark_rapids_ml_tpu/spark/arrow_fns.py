@@ -34,12 +34,16 @@ instead of JVM serialization.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Iterable, Iterator, NamedTuple
 
 import numpy as np
 import pyarrow as pa
 
 from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.telemetry import costmodel
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 from spark_rapids_ml_tpu.utils import columnar
 
 
@@ -287,9 +291,13 @@ class FitPartitionFn(_StatsAccumulatorFn):
 
         mat = columnar.extract_matrix(batch, self.input_col)
         padded, true_rows = columnar.pad_rows(mat)
-        stats = _jitted_gram_stats()(
-            jnp.asarray(padded), precision=L.PRECISIONS[self.precision]
+        xd = jnp.asarray(padded)
+        gram = _jitted_gram_stats()
+        costmodel.capture(
+            "linalg.gram_stats", gram, xd,
+            precision=L.PRECISIONS[self.precision],
         )
+        stats = gram(xd, precision=L.PRECISIONS[self.precision])
         return L.GramStats(
             stats.xtx, stats.col_sum, jnp.asarray(true_rows, stats.count.dtype)
         )
@@ -876,7 +884,57 @@ class NanRangePartitionFn(_StatsAccumulatorFn):
 
 
 
-class MatrixMapPartitionFn:
+class _InstrumentedTransformFn:
+    """Serve-side instrumentation shared by every transform partition body.
+
+    ``__call__`` wraps the subclass's ``_run`` generator with per-partition
+    accounting: input rows/bytes/batch counters, a partition-latency
+    histogram sample, and a ``transform.partition`` timeline span — all
+    labeled ``fn=<ClassName>``. Booked in the executing process's registry,
+    so localspark worker values ride the task telemetry trailer back to the
+    driver labeled ``partition=N``, where ``end_transform`` folds them into
+    the TransformReport. The ``finally`` booking means a partition that
+    dies mid-batch still reports the rows it consumed.
+    """
+
+    def __call__(
+        self, batches: Iterator[pa.RecordBatch]
+    ) -> Iterator[pa.RecordBatch]:
+        fn = type(self).__name__
+        rows = 0
+        nbytes = 0
+        nbatches = 0
+
+        def counted(src):
+            nonlocal rows, nbytes, nbatches
+            for b in src:
+                rows += b.num_rows
+                nbytes += b.nbytes
+                nbatches += 1
+                yield b
+
+        t0 = time.perf_counter()
+        try:
+            yield from self._run(counted(batches))
+        finally:
+            t1 = time.perf_counter()
+            REGISTRY.counter_inc("transform.rows", rows, fn=fn)
+            REGISTRY.counter_inc("transform.bytes", nbytes, fn=fn)
+            REGISTRY.counter_inc("transform.batches", nbatches, fn=fn)
+            REGISTRY.histogram_record(
+                "transform.partition_seconds", t1 - t0, fn=fn
+            )
+            TIMELINE.record_span(
+                "transform.partition", t0, t1, fn=fn, rows=rows
+            )
+
+    def _run(
+        self, batches: Iterator[pa.RecordBatch]
+    ) -> Iterator[pa.RecordBatch]:
+        raise NotImplementedError
+
+
+class MatrixMapPartitionFn(_InstrumentedTransformFn):
     """Generic mapInArrow transform body: apply ``matrix_fn`` to the input
     column's [rows, n] matrix and append the result — a float64 list column
     when 2-D (ArrayType), a float64 scalar column when 1-D (predictions).
@@ -899,7 +957,7 @@ class MatrixMapPartitionFn:
         self.output_col = output_col
         self.matrix_fn = matrix_fn
 
-    def __call__(self, batches):
+    def _run(self, batches):
         for batch in batches:
             if batch.num_rows == 0:
                 continue
@@ -917,7 +975,7 @@ class MatrixMapPartitionFn:
             )
 
 
-class MultiOutputPartitionFn:
+class MultiOutputPartitionFn(_InstrumentedTransformFn):
     """Transform body emitting ANY number of output columns from one device
     pass: ``matrix_fn(mat)`` returns one array per ``output_cols`` entry of
     ``(name, numpy dtype)`` — 2-D arrays become list columns, 1-D arrays
@@ -932,7 +990,7 @@ class MultiOutputPartitionFn:
         self.output_cols = [(n, np.dtype(d)) for n, d in output_cols]
         self.matrix_fn = matrix_fn
 
-    def __call__(self, batches):
+    def _run(self, batches):
         for batch in batches:
             if batch.num_rows == 0:
                 continue
@@ -952,7 +1010,7 @@ class MultiOutputPartitionFn:
             yield pa.RecordBatch.from_arrays(cols, schema=schema)
 
 
-class ProbaPredictionPartitionFn:
+class ProbaPredictionPartitionFn(_InstrumentedTransformFn):
     """Classifier transform body emitting BOTH Spark ML output columns in
     one device pass: ``probabilityCol`` (the per-class probability vector —
     [1−p, p] for binary, the softmax row for multinomial, matching
@@ -976,7 +1034,7 @@ class ProbaPredictionPartitionFn:
         #: rule shared with the local transform path, one forward pass
         self.proba_pred_fn = proba_pred_fn
 
-    def __call__(self, batches):
+    def _run(self, batches):
         for batch in batches:
             if batch.num_rows == 0:
                 continue
@@ -995,7 +1053,7 @@ class ProbaPredictionPartitionFn:
             )
 
 
-class TransformPartitionFn:
+class TransformPartitionFn(_InstrumentedTransformFn):
     """The batched-projection transform body.
 
     Streaming analog of the reference's columnar UDF (``evaluateColumnar``,
@@ -1028,7 +1086,7 @@ class TransformPartitionFn:
         state["_pc_dev"] = None  # device buffers must not cross processes
         return state
 
-    def __call__(self, batches):
+    def _run(self, batches):
         import jax.numpy as jnp
 
         project = _jitted_project()
@@ -1044,6 +1102,7 @@ class TransformPartitionFn:
             xd = jnp.asarray(padded)
             if self._pc_dev is None or self._pc_dev.dtype != xd.dtype:
                 self._pc_dev = jnp.asarray(self.pc, dtype=xd.dtype)
+            costmodel.capture("linalg.project", project, xd, self._pc_dev)
             out = np.asarray(project(xd, self._pc_dev))[:true_rows]
             # FLOAT64 variable-list output column: Spark's ArrayType(Double)
             # Arrow mapping (reference output is FLOAT64, rapidsml_jni.cu:89)

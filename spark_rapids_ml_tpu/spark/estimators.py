@@ -105,7 +105,7 @@ from spark_rapids_ml_tpu.models.params import Param
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.spark import arrow_fns
 from spark_rapids_ml_tpu.utils import columnar
-from spark_rapids_ml_tpu.utils.tracing import trace_range
+from spark_rapids_ml_tpu.telemetry import trace_range
 
 logger = logging.getLogger("spark_rapids_ml_tpu")
 
@@ -611,22 +611,30 @@ def _resolve_input_col(model) -> str:
 
 def _spark_append(dataset, fn, fields):
     """mapInArrow with the input schema plus ``fields`` appended — the one
-    dispatch site every model transform (single- or multi-output) uses."""
+    dispatch site every model transform (single- or multi-output) uses.
+    The ``transform.dispatch`` span times plan construction only (mapInArrow
+    is lazy); execution time lands in the per-partition
+    ``transform.partition_seconds`` booked by the instrumented partition
+    functions themselves (arrow_fns._InstrumentedTransformFn)."""
     T, _ = _sql_mods(dataset)
-    schema = T.StructType(
-        dataset.schema.fields
-        + [T.StructField(name, typ) for name, typ in fields]
-    )
-    return dataset.mapInArrow(fn, schema=schema)
+    with trace_range("transform.dispatch"):
+        schema = T.StructType(
+            dataset.schema.fields
+            + [T.StructField(name, typ) for name, typ in fields]
+        )
+        return dataset.mapInArrow(fn, schema=schema)
 
 
 def _spark_transform(model, dataset, matrix_fn, output_col, scalar: bool):
     T, _ = _sql_mods(dataset)
     input_col = _resolve_input_col(model)
-    fn = arrow_fns.make_matrix_map_partition_fn(input_col, output_col, matrix_fn)
-    out_type = (
-        T.DoubleType() if scalar else T.ArrayType(T.DoubleType())
-    )
+    with trace_range("transform.plan"):
+        fn = arrow_fns.make_matrix_map_partition_fn(
+            input_col, output_col, matrix_fn
+        )
+        out_type = (
+            T.DoubleType() if scalar else T.ArrayType(T.DoubleType())
+        )
     return _spark_append(dataset, fn, [(output_col, out_type)])
 
 

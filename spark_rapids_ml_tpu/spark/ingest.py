@@ -44,6 +44,7 @@ from typing import Any, Iterator
 
 import numpy as np
 
+from spark_rapids_ml_tpu.telemetry import costmodel
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 from spark_rapids_ml_tpu.utils import columnar
@@ -821,8 +822,10 @@ def stream_fold(
             if yb is not None:
                 yd = put(yb)
                 nbytes += yb.nbytes
+                costmodel.capture("stream.fold_step", fold_fn, carry, xd, yd, wd)
                 carry = fold_fn(carry, xd, yd, wd)
             else:
+                costmodel.capture("stream.fold_step", fold_fn, carry, xd, wd)
                 carry = fold_fn(carry, xd, wd)
         if busy:
             overlapped += 1

@@ -9,8 +9,13 @@ Public surface (everything the rest of the framework and user code needs):
   ``utils.tracing`` read shape.
 - ``FitReport`` / ``begin_fit`` / ``end_fit`` — per-fit capture windows
   (:mod:`.report`), wired automatically through ``models.base``.
-- ``export_fit_report`` / ``read_jsonl`` — the ``TPU_ML_TELEMETRY_PATH``
-  JSONL sink (:mod:`.export`).
+- ``TransformReport`` / ``begin_transform`` / ``end_transform`` — the
+  serve-side capture windows (:mod:`.report`), wired automatically through
+  ``models.base`` transform instrumentation.
+- ``costmodel`` — analytical kernel FLOPs/bytes + roofline accounting
+  (:mod:`.costmodel`), captured at jitted dispatch sites.
+- ``export_fit_report`` / ``export_transform_report`` / ``read_jsonl`` —
+  the ``TPU_ML_TELEMETRY_PATH`` JSONL sink (:mod:`.export`).
 - ``install_monitoring`` / ``sample_device_memory`` — jax.monitoring
   compile listeners and device-memory gauges (:mod:`.compilemon`).
 - ``snapshot_dict`` — full-registry JSON snapshot (bench embedding).
@@ -31,11 +36,14 @@ from spark_rapids_ml_tpu.telemetry.registry import (
 from spark_rapids_ml_tpu.telemetry.spans import (
     current_estimator,
     current_fit_id,
+    current_transform_id,
     install_fit_id_filter,
     reset_current_estimator,
     reset_current_fit_id,
+    reset_current_transform_id,
     set_current_estimator,
     set_current_fit_id,
+    set_current_transform_id,
     trace_range,
 )
 from spark_rapids_ml_tpu.telemetry.timeline import (
@@ -49,16 +57,23 @@ from spark_rapids_ml_tpu.telemetry.compilemon import (
     install_monitoring,
     sample_device_memory,
 )
+from spark_rapids_ml_tpu.telemetry import costmodel
 from spark_rapids_ml_tpu.telemetry.report import (
     FitReport,
+    TransformReport,
     attach_report,
+    attach_transform_report,
     begin_fit,
+    begin_transform,
     end_fit,
+    end_transform,
+    release_transform_context,
     snapshot_dict,
 )
 from spark_rapids_ml_tpu.telemetry.export import (
     export_fit_report,
     export_timeline,
+    export_transform_report,
     read_jsonl,
     telemetry_path,
     timeline_path,
@@ -77,11 +92,14 @@ __all__ = [
     "reset_metrics",
     "current_estimator",
     "current_fit_id",
+    "current_transform_id",
     "install_fit_id_filter",
     "reset_current_estimator",
     "reset_current_fit_id",
+    "reset_current_transform_id",
     "set_current_estimator",
     "set_current_fit_id",
+    "set_current_transform_id",
     "trace_range",
     "TIMELINE",
     "Timeline",
@@ -91,12 +109,19 @@ __all__ = [
     "install_monitoring",
     "sample_device_memory",
     "FitReport",
+    "TransformReport",
     "attach_report",
+    "attach_transform_report",
     "begin_fit",
+    "begin_transform",
     "end_fit",
+    "end_transform",
+    "release_transform_context",
+    "costmodel",
     "snapshot_dict",
     "export_fit_report",
     "export_timeline",
+    "export_transform_report",
     "read_jsonl",
     "telemetry_path",
     "timeline_path",

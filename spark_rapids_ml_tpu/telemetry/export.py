@@ -49,13 +49,14 @@ def export_timeline(
     events: list[dict],
     *,
     fit_id: str = "",
+    transform_id: str = "",
     estimator: str = "",
     uid: str = "",
     overlap_fraction: float | None = None,
     path: str | None = None,
 ) -> bool:
     """Append one ``timeline`` JSONL record (raw flight-recorder events +
-    the fit identity they belong to); returns True if written.
+    the fit/transform identity they belong to); returns True if written.
 
     ``path=None`` uses ``TPU_ML_TIMELINE_PATH`` and is a silent no-op when
     that is unset or there are no events. Render/export with
@@ -66,18 +67,18 @@ def export_timeline(
     if not path or not events:
         return False
     try:
-        return _append_line(
-            path,
-            {
-                "type": "timeline",
-                "schema": 1,
-                "fit_id": fit_id,
-                "estimator": estimator,
-                "uid": uid,
-                "overlap_fraction": overlap_fraction,
-                "events": events,
-            },
-        )
+        record = {
+            "type": "timeline",
+            "schema": 1,
+            "fit_id": fit_id,
+            "estimator": estimator,
+            "uid": uid,
+            "overlap_fraction": overlap_fraction,
+            "events": events,
+        }
+        if transform_id:
+            record["transform_id"] = transform_id
+        return _append_line(path, record)
     except Exception:
         logger.warning("timeline export to %s failed", path, exc_info=True)
         return False
@@ -90,6 +91,20 @@ def export_fit_report(report, path: str | None = None) -> bool:
     is unset. The record is ``report.to_dict()`` serialized compactly on a
     single line.
     """
+    if path is None:
+        path = telemetry_path()
+    if not path:
+        return False
+    try:
+        return _append_line(path, report.to_dict())
+    except Exception:
+        logger.warning("telemetry export to %s failed", path, exc_info=True)
+        return False
+
+
+def export_transform_report(report, path: str | None = None) -> bool:
+    """Append one ``transform_report`` JSONL record; same contract as
+    :func:`export_fit_report` (shared sink, readers filter on ``type``)."""
     if path is None:
         path = telemetry_path()
     if not path:

@@ -23,14 +23,19 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
-from spark_rapids_ml_tpu.telemetry import compilemon, spans
+from spark_rapids_ml_tpu.telemetry import compilemon, costmodel, spans
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY, render_key
 from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
 # v2: + fit_id (log↔report correlation) and overlap_fraction (H2D↔compute
-# overlap evidence from the streamed fold). Readers must tolerate other
-# versions (tools/trace_report.py skips-with-note rather than KeyError).
-SCHEMA_VERSION = 2
+# overlap evidence from the streamed fold). v3: + cost_model (analytical
+# FLOPs/bytes + roofline utilization from telemetry.costmodel). Readers must
+# tolerate other versions (tools/trace_report.py skips-with-note rather than
+# KeyError).
+SCHEMA_VERSION = 3
+
+# TransformReport wire schema (independent of the fit schema above).
+TRANSFORM_SCHEMA_VERSION = 1
 
 
 @dataclass
@@ -64,6 +69,10 @@ class FitReport:
     # mean streamed-fold overlap (overlapped dispatches / chunks) across
     # the fit's stream_fold calls; None when nothing streamed
     overlap_fraction: float | None = None
+    # analytical kernel cost rollup (telemetry.costmodel.window_summary):
+    # per-kernel calls + per-call FLOPs/bytes, window totals, roofline
+    # utilization. Empty when no captured kernel dispatched in the window.
+    cost_model: dict = field(default_factory=dict)
     schema: int = SCHEMA_VERSION
 
     @property
@@ -93,6 +102,7 @@ class FitReport:
             "device_memory": self.device_memory,
             "peak_device_bytes": self.peak_device_bytes,
             "counters": self.counters,
+            "cost_model": self.cost_model,
         }
 
     @classmethod
@@ -112,6 +122,7 @@ class FitReport:
             timestamp_unix=float(d.get("timestamp_unix", 0.0)),
             fit_id=d.get("fit_id", ""),
             overlap_fraction=d.get("overlap_fraction"),
+            cost_model=d.get("cost_model", {}) or {},
             schema=int(d.get("schema", SCHEMA_VERSION)),
         )
 
@@ -194,7 +205,9 @@ def end_fit(cap: _FitCapture) -> FitReport:
         for k, v in sorted(delta.counters.items())
         if k[0]
         not in (_INGEST_ROWS, _INGEST_BYTES, _COLUMNAR_ROWS, _COLUMNAR_BYTES)
-        and not k[0].startswith(("compile.", "collective.", "h2d."))
+        and not k[0].startswith(
+            ("compile.", "collective.", "h2d.", "costmodel.")
+        )
     }
     return FitReport(
         estimator=cap.estimator,
@@ -213,15 +226,218 @@ def end_fit(cap: _FitCapture) -> FitReport:
             "count": compile_hist.count,
             "seconds": compile_hist.total,
             "trace_seconds": delta.hist("compile.trace_seconds").total,
+            "lower_seconds": delta.hist("compile.lower_seconds").total,
             "cache_hits": delta.counter("compile.cache_hits"),
             "cache_misses": delta.counter("compile.cache_misses"),
+            "cache_time_saved_s": delta.counter("compile.cache_time_saved_s"),
         },
         device_memory=device_memory,
         counters=counters,
         timestamp_unix=cap.t_unix,
         fit_id=cap.fit_id,
         overlap_fraction=overlap_fraction,
+        cost_model=costmodel.window_summary(delta, wall),
     )
+
+
+@dataclass
+class TransformReport:
+    """Everything observed during one ``transform()`` call — the serve-side
+    sibling of :class:`FitReport`.
+
+    ``partitions`` maps partition label (``"0"``, ``"1"``, ... for
+    localspark workers, ``"driver"`` for in-process execution) →
+    ``{rows, bytes, seconds, batches}`` accumulated by the instrumented
+    arrow partition functions. ``partition_latency`` is the merged
+    ``transform.partition_seconds`` histogram (count/sum/min/max/p50/p90/
+    p99) — per-partition-call latency across all partitions. For lazy
+    plans (localspark ``mapInArrow``), ``wall_seconds`` spans transform()
+    entry through first full materialization of the returned DataFrame.
+    """
+
+    transformer: str
+    uid: str
+    wall_seconds: float
+    phases: dict[str, dict[str, float]] = field(default_factory=dict)
+    rows: int = 0
+    bytes: int = 0
+    partitions: dict[str, dict[str, float]] = field(default_factory=dict)
+    partition_latency: dict[str, float] = field(default_factory=dict)
+    cost_model: dict = field(default_factory=dict)
+    counters: dict[str, float] = field(default_factory=dict)
+    timestamp_unix: float = 0.0
+    # log↔report join key, stamped as %(transform_id)s on package log
+    # records emitted inside the window (including lazy materialization)
+    transform_id: str = ""
+    schema: int = TRANSFORM_SCHEMA_VERSION
+
+    def to_dict(self) -> dict:
+        return {
+            "type": "transform_report",
+            "schema": self.schema,
+            "transformer": self.transformer,
+            "uid": self.uid,
+            "transform_id": self.transform_id,
+            "timestamp_unix": self.timestamp_unix,
+            "wall_seconds": self.wall_seconds,
+            "phases": self.phases,
+            "rows": self.rows,
+            "bytes": self.bytes,
+            "partitions": self.partitions,
+            "partition_latency": self.partition_latency,
+            "cost_model": self.cost_model,
+            "counters": self.counters,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TransformReport":
+        return cls(
+            transformer=d.get("transformer", ""),
+            uid=d.get("uid", ""),
+            wall_seconds=float(d.get("wall_seconds", 0.0)),
+            phases=d.get("phases", {}),
+            rows=int(d.get("rows", 0)),
+            bytes=int(d.get("bytes", 0)),
+            partitions=d.get("partitions", {}),
+            partition_latency=d.get("partition_latency", {}),
+            cost_model=d.get("cost_model", {}) or {},
+            counters=d.get("counters", {}),
+            timestamp_unix=float(d.get("timestamp_unix", 0.0)),
+            transform_id=d.get("transform_id", ""),
+            schema=int(d.get("schema", TRANSFORM_SCHEMA_VERSION)),
+        )
+
+
+class _TransformCapture:
+    __slots__ = (
+        "transformer", "uid", "token", "snap", "t0", "t_unix",
+        "transform_id", "transform_id_token", "tl_seq", "released",
+    )
+
+    def __init__(
+        self, transformer: str, uid: str, token, snap, t0: float,
+        transform_id: str, transform_id_token, tl_seq: int,
+    ):
+        self.transformer = transformer
+        self.uid = uid
+        self.token = token
+        self.snap = snap
+        self.t0 = t0
+        self.t_unix = time.time()
+        self.transform_id = transform_id
+        self.transform_id_token = transform_id_token
+        self.tl_seq = tl_seq
+        self.released = False
+
+
+def begin_transform(transformer: str, uid: str = "") -> _TransformCapture:
+    """Open a serve-side capture window: mirror of :func:`begin_fit` minting
+    a ``transform_id`` instead of a ``fit_id``."""
+    compilemon.install_monitoring()
+    spans.install_fit_id_filter()
+    transform_id = uuid.uuid4().hex[:12]
+    return _TransformCapture(
+        transformer=transformer,
+        uid=uid,
+        token=spans.set_current_estimator(transformer),
+        snap=REGISTRY.snapshot(),
+        t0=time.perf_counter(),
+        transform_id=transform_id,
+        transform_id_token=spans.set_current_transform_id(transform_id),
+        tl_seq=TIMELINE.seq(),
+    )
+
+
+def release_transform_context(cap: _TransformCapture) -> None:
+    """Restore the estimator/transform_id contextvars (idempotent).
+
+    Split out of :func:`end_transform` because lazy plans finalize their
+    report from a *different* execution context (the DataFrame's
+    materialization) where the original tokens are unusable — the wrapper
+    resets them at transform() exit, the report is built later.
+    """
+    if cap.released:
+        return
+    cap.released = True
+    try:
+        spans.reset_current_estimator(cap.token)
+        spans.reset_current_transform_id(cap.transform_id_token)
+    except ValueError:  # pragma: no cover - reset from a foreign Context
+        spans.set_current_estimator(None)
+        spans.set_current_transform_id(None)
+
+
+def end_transform(cap: _TransformCapture) -> TransformReport:
+    """Close a serve-side capture window and build the report from the
+    registry delta. Per-partition rows/bytes/seconds come from the
+    ``transform.*`` counters/histograms the instrumented arrow partition
+    functions recorded — worker-side values arrive with a ``partition=N``
+    label via the localspark telemetry trailer; unlabeled values (in-process
+    execution) are booked under ``"driver"``."""
+    wall = time.perf_counter() - cap.t0
+    release_transform_context(cap)
+    delta = REGISTRY.snapshot().delta(cap.snap)
+
+    partitions: dict[str, dict[str, float]] = {}
+
+    def _bucket(labels) -> dict[str, float]:
+        part = dict(labels).get("partition", "") or "driver"
+        return partitions.setdefault(
+            part, {"rows": 0, "bytes": 0, "seconds": 0.0, "batches": 0}
+        )
+
+    counter_fields = {
+        "transform.rows": "rows",
+        "transform.bytes": "bytes",
+        "transform.batches": "batches",
+    }
+    for (name, labels), v in delta.counters.items():
+        dest = counter_fields.get(name)
+        if dest is not None:
+            _bucket(labels)[dest] += int(v)
+    for (name, labels), h in delta.hists.items():
+        if name == "transform.partition_seconds":
+            b = _bucket(labels)
+            b["seconds"] += h.total
+
+    rows = int(delta.counter("transform.rows"))
+    nbytes = int(delta.counter("transform.bytes"))
+    if not rows:  # in-core array transforms never run a partition fn
+        rows = int(delta.counter(_COLUMNAR_ROWS))
+        nbytes = nbytes or int(delta.counter(_COLUMNAR_BYTES))
+
+    counters = {
+        render_key(k): v
+        for k, v in sorted(delta.counters.items())
+        if not k[0].startswith(
+            ("transform.", "compile.", "collective.", "h2d.", "costmodel.")
+        )
+        and k[0]
+        not in (_INGEST_ROWS, _INGEST_BYTES, _COLUMNAR_ROWS, _COLUMNAR_BYTES)
+    }
+    return TransformReport(
+        transformer=cap.transformer,
+        uid=cap.uid,
+        wall_seconds=wall,
+        phases=delta.phase_table(),
+        rows=rows,
+        bytes=nbytes,
+        partitions=partitions,
+        partition_latency=delta.hist("transform.partition_seconds").to_dict(),
+        cost_model=costmodel.window_summary(delta, wall),
+        counters=counters,
+        timestamp_unix=cap.t_unix,
+        transform_id=cap.transform_id,
+    )
+
+
+def attach_transform_report(model: Any, report: TransformReport) -> None:
+    """Best-effort ``model.transform_report = report`` (mirror of
+    :func:`attach_report`)."""
+    try:
+        model.transform_report = report
+    except (AttributeError, TypeError):  # pragma: no cover - exotic models
+        pass
 
 
 def snapshot_dict(percentiles=(50, 90, 99)) -> dict:

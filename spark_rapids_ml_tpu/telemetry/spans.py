@@ -42,6 +42,14 @@ _current_fit_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "tpu_ml_current_fit_id", default=None
 )
 
+# The transform_id of the serve-side window — the transform-path sibling of
+# fit_id, minted by models.base transform instrumentation and stamped into
+# timeline events and log records for the lifetime of one transform (through
+# lazy localspark materialization).
+_current_transform_id: contextvars.ContextVar[str | None] = (
+    contextvars.ContextVar("tpu_ml_current_transform_id", default=None)
+)
+
 
 def current_estimator() -> str | None:
     return _current_estimator.get()
@@ -69,15 +77,30 @@ def reset_current_fit_id(token) -> None:
     _current_fit_id.reset(token)
 
 
+def current_transform_id() -> str | None:
+    return _current_transform_id.get()
+
+
+def set_current_transform_id(transform_id: str | None):
+    """Returns the reset token (contextvars protocol)."""
+    return _current_transform_id.set(transform_id)
+
+
+def reset_current_transform_id(token) -> None:
+    _current_transform_id.reset(token)
+
+
 class _FitIdFilter(logging.Filter):
-    """Stamps ``record.fit_id`` (the current fit's id, or ``"-"``) onto
-    every record of the package logger, so a format string with
-    ``%(fit_id)s`` correlates log lines with exported FitReports. A Filter
-    rather than a LoggerAdapter: it covers every module-level ``logger``
-    in the package without changing any call site."""
+    """Stamps ``record.fit_id`` and ``record.transform_id`` (the current
+    window ids, or ``"-"``) onto every record of the package logger, so a
+    format string with ``%(fit_id)s`` / ``%(transform_id)s`` correlates log
+    lines with exported Fit/TransformReports. A Filter rather than a
+    LoggerAdapter: it covers every module-level ``logger`` in the package
+    without changing any call site."""
 
     def filter(self, record: logging.LogRecord) -> bool:
         record.fit_id = _current_fit_id.get() or "-"
+        record.transform_id = _current_transform_id.get() or "-"
         return True
 
 
@@ -115,5 +138,6 @@ def trace_range(name: str):
             end,
             estimator=_current_estimator.get() or "",
             fit_id=_current_fit_id.get() or "",
+            transform_id=_current_transform_id.get() or "",
         )
         logger.debug("trace %s: %.3fs", name, elapsed)

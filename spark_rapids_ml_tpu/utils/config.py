@@ -69,6 +69,17 @@ module is tier 2 for the TPU build — process-level knobs read from
   sets the ``spark_rapids_ml_tpu`` logger level at package import. The
   package attaches only a ``logging.NullHandler``; output routing stays the
   application's choice.
+- ``TPU_ML_PEAK_TFLOPS`` (float, default 197.0 = TPU v5e bf16 peak; read
+  directly by ``telemetry.costmodel``) — device peak for the cost model's
+  roofline-utilization denominator stamped into Fit/TransformReports.
+- ``TPU_ML_PERF_LEDGER_PATH`` (path, default ``PERF_LEDGER.jsonl`` next to
+  ``bench.py``; empty string disables; read directly by ``bench.py``) —
+  persistent perf ledger each bench run appends its metrics + cost-model
+  numbers to; compared across runs by ``tools/perf_sentinel.py``.
+- ``TPU_ML_PERF_SENTINEL`` (``1`` to enable; read directly by ``bench.py``)
+  — after appending the ledger entry, the bench runs
+  ``tools/perf_sentinel.py --strict`` on it and fails on regressions
+  beyond the threshold — the opt-in CI perf gate for ``bench --smoke``.
 """
 
 from __future__ import annotations

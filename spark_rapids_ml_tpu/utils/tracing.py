@@ -1,19 +1,3 @@
-"""Back-compat shim — tracing moved to :mod:`spark_rapids_ml_tpu.telemetry`.
+"""Deprecated: import from :mod:`spark_rapids_ml_tpu.telemetry` instead."""
 
-``trace_range`` began here as the NVTX-range analog with a 53-line
-wall-clock dict; it is now backed by the telemetry registry (thread-safe,
-log-scale latency histograms, estimator labels, exception-safe
-accounting). Import sites throughout the models/spark layers keep working
-through this module; new code should import from
-``spark_rapids_ml_tpu.telemetry`` directly.
-"""
-
-from __future__ import annotations
-
-import logging
-
-from spark_rapids_ml_tpu.telemetry import metrics, reset_metrics, trace_range
-
-logger = logging.getLogger("spark_rapids_ml_tpu")
-
-__all__ = ["trace_range", "metrics", "reset_metrics", "logger"]
+from spark_rapids_ml_tpu.telemetry import metrics, reset_metrics, trace_range  # noqa: F401

@@ -1,6 +1,7 @@
 """Tier-1 guard for the bench harness: ``bench.py --smoke`` must keep
 producing its JSON contract — including the ``streamed_fit_rows_per_s``
-out-of-core metric — on the CPU backend.
+out-of-core metric — on the CPU backend, and appending a ``perf_ledger``
+entry that the regression sentinel accepts (ISSUE 5).
 
 Runs the bench as a subprocess (it owns platform/x64 setup) with the shared
 compilation cache so repeat runs stay cheap.
@@ -14,11 +15,14 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_smoke_json_contract():
+def test_bench_smoke_json_contract(tmp_path):
+    ledger = str(tmp_path / "PERF_LEDGER.jsonl")
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         JAX_COMPILATION_CACHE_DIR="/tmp/jax_test_cache",
+        TPU_ML_PERF_LEDGER_PATH=ledger,
+        TPU_ML_PERF_SENTINEL="1",  # the bench gates itself on the sentinel
     )
     env.pop("TPU_ML_FAULT_PLAN", None)  # the zero-fault assertion below
     proc = subprocess.run(
@@ -71,3 +75,30 @@ def test_bench_smoke_json_contract():
     # zero synthetic faults fired during the bench
     injected = [k for k in tel["counters"] if k.startswith("fault.injected")]
     assert injected == [], injected
+
+    # the run appended one perf-ledger entry holding every emitted metric
+    # plus the analytical cost-model numbers (ISSUE 5)
+    with open(ledger, encoding="utf-8") as f:
+        entries = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(entries) == 1
+    entry = entries[0]
+    assert entry["type"] == "perf_ledger"
+    assert entry["smoke"] is True
+    assert data["metric"] in entry["metrics"]
+    assert "streamed_fit_rows_per_s" in entry["metrics"]
+    assert entry["metrics"]["streamed_fit_rows_per_s"]["unit"] == "rows/s"
+    assert "analytical_flops" in entry["cost_model"]
+    # TPU_ML_PERF_SENTINEL=1 already ran the gate in-process (exit 0 above
+    # proves a fresh ledger passes); the standalone CLI agrees
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "perf_sentinel.py"),
+            ledger,
+            "--strict",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,161 @@
+"""Perf ledger + regression sentinel (tools/perf_sentinel.py).
+
+Covers the ISSUE-5 sentinel list: a fresh ledger always passes, a
+synthetic 2x slowdown (and a 2x throughput drop) is flagged and exits 2
+under --strict, improvements and within-threshold noise pass, smoke and
+full-shape entries are never compared with each other, unit-derived
+direction (seconds up = bad, rows/s down = bad), and --bless truncates
+the ledger to the new baseline.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "perf_sentinel.py")
+
+spec = importlib.util.spec_from_file_location("perf_sentinel", CLI)
+sentinel = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(sentinel)
+
+
+def entry(wall=1.0, rows_s=1000.0, smoke=True, **extra_metrics):
+    metrics = {
+        "fit.wall": {"value": wall, "unit": "seconds"},
+        "fit.throughput": {"value": rows_s, "unit": "rows/s"},
+    }
+    for name, (value, unit) in extra_metrics.items():
+        metrics[name] = {"value": value, "unit": unit}
+    return {
+        "type": "perf_ledger",
+        "schema": 1,
+        "timestamp_unix": 0.0,
+        "smoke": smoke,
+        "metrics": metrics,
+        "cost_model": {},
+    }
+
+
+def write_ledger(path, entries):
+    with open(path, "w", encoding="utf-8") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+class TestCompare:
+    def test_clean_run_has_no_regressions(self):
+        history = [entry(wall=1.0, rows_s=1000.0) for _ in range(5)]
+        regs, notes = sentinel.compare(entry(1.05, 980.0), history, 0.35)
+        assert regs == [] and notes == []
+
+    def test_2x_slowdown_flagged(self):
+        history = [entry(wall=1.0) for _ in range(5)]
+        regs, _ = sentinel.compare(entry(wall=2.0), history, 0.35)
+        assert [r["metric"] for r in regs] == ["fit.wall"]
+        assert regs[0]["ratio"] == pytest.approx(2.0)
+        assert regs[0]["baseline_median"] == 1.0
+
+    def test_2x_throughput_drop_flagged(self):
+        history = [entry(rows_s=1000.0) for _ in range(5)]
+        regs, _ = sentinel.compare(entry(rows_s=500.0), history, 0.35)
+        assert [r["metric"] for r in regs] == ["fit.throughput"]
+
+    def test_improvement_is_not_a_regression(self):
+        history = [entry(wall=1.0, rows_s=1000.0) for _ in range(5)]
+        regs, _ = sentinel.compare(entry(wall=0.4, rows_s=2500.0), history, 0.35)
+        assert regs == []
+
+    def test_direction_comes_from_unit(self):
+        assert sentinel.lower_is_better("seconds")
+        assert sentinel.lower_is_better("bytes")
+        assert sentinel.lower_is_better("ms")
+        assert not sentinel.lower_is_better("rows/s")
+        assert not sentinel.lower_is_better("cosine")
+
+    def test_new_metric_and_zero_baseline_are_notes(self):
+        history = [entry(extra=(0.0, "seconds")) for _ in range(3)]
+        cur = entry(extra=(1.0, "seconds"), brand_new=(5.0, "widgets"))
+        regs, notes = sentinel.compare(cur, history, 0.35)
+        assert regs == []
+        assert any("brand_new" in n and "no history" in n for n in notes)
+        assert any("extra" in n and "zero baseline" in n for n in notes)
+
+    def test_median_absorbs_one_outlier_run(self):
+        history = [entry(wall=w) for w in (1.0, 1.0, 1.0, 1.0, 30.0)]
+        regs, _ = sentinel.compare(entry(wall=1.1), history, 0.35)
+        assert regs == []
+
+
+class TestCli:
+    def test_fresh_ledger_passes_strict(self, tmp_path):
+        p = write_ledger(tmp_path / "l.jsonl", [entry()])
+        assert sentinel.main([p, "--strict"]) == 0
+
+    def test_empty_ledger_passes(self, tmp_path):
+        p = tmp_path / "l.jsonl"
+        p.write_text("")
+        assert sentinel.main([str(p), "--strict"]) == 0
+
+    def test_missing_ledger_is_an_error(self, tmp_path):
+        assert sentinel.main([str(tmp_path / "nope.jsonl")]) == 1
+
+    def test_strict_exits_2_on_synthetic_regression(self, tmp_path, capsys):
+        entries = [entry(wall=1.0) for _ in range(5)] + [entry(wall=2.0)]
+        p = write_ledger(tmp_path / "l.jsonl", entries)
+        assert sentinel.main([p]) == 0  # report-only mode never gates
+        assert sentinel.main([p, "--strict"]) == 2
+        out = capsys.readouterr().out
+        assert "REGRESSION fit.wall" in out
+        assert "--bless" in out  # points at the intentional-change workflow
+
+    def test_threshold_is_respected(self, tmp_path):
+        entries = [entry(wall=1.0) for _ in range(5)] + [entry(wall=1.25)]
+        p = write_ledger(tmp_path / "l.jsonl", entries)
+        assert sentinel.main([p, "--strict", "--threshold", "0.35"]) == 0
+        assert sentinel.main([p, "--strict", "--threshold", "0.2"]) == 2
+
+    def test_smoke_and_full_runs_never_compared(self, tmp_path):
+        # slow full-shape history must not judge a fast smoke run (or the
+        # reverse) — the current smoke entry only sees smoke history
+        entries = [entry(wall=10.0, smoke=False) for _ in range(5)]
+        entries.append(entry(wall=1.0, smoke=True))
+        p = write_ledger(tmp_path / "l.jsonl", entries)
+        assert sentinel.main([p, "--strict"]) == 0  # fresh for smoke
+        entries.append(entry(wall=2.0, smoke=True))
+        p = write_ledger(tmp_path / "l.jsonl", entries)
+        assert sentinel.main([p, "--strict"]) == 2  # judged vs smoke only
+
+    def test_last_window_bounds_history(self, tmp_path):
+        # ancient fast history beyond --last must not flag today's steady
+        # state: 2 slow entries in the window, current matches them
+        entries = [entry(wall=1.0) for _ in range(5)]
+        entries += [entry(wall=3.0), entry(wall=3.0), entry(wall=3.1)]
+        p = write_ledger(tmp_path / "l.jsonl", entries)
+        assert sentinel.main([p, "--strict", "--last", "2"]) == 0
+        assert sentinel.main([p, "--strict", "--last", "0"]) == 2
+
+    def test_bless_truncates_to_new_baseline(self, tmp_path):
+        entries = [entry(wall=1.0) for _ in range(5)] + [entry(wall=2.0)]
+        p = write_ledger(tmp_path / "l.jsonl", entries)
+        assert sentinel.main([p, "--strict"]) == 2
+        assert sentinel.main([p, "--bless"]) == 0
+        remaining = sentinel.load_ledger(p)
+        assert len(remaining) == 1
+        assert remaining[0]["metrics"]["fit.wall"]["value"] == 2.0
+        # after blessing, the once-regressed numbers are the baseline
+        assert sentinel.main([p, "--strict"]) == 0
+
+    def test_corrupt_lines_are_skipped(self, tmp_path):
+        p = tmp_path / "l.jsonl"
+        lines = [json.dumps(entry(wall=1.0)) for _ in range(3)]
+        lines.insert(1, "{torn line")
+        lines.append(json.dumps({"type": "other"}))
+        p.write_text("\n".join(lines) + "\n")
+        assert len(sentinel.load_ledger(str(p))) == 3
+        assert sentinel.main([str(p), "--strict"]) == 0

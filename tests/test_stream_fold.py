@@ -28,7 +28,7 @@ from spark_rapids_ml_tpu.models.scaler import StandardScaler
 from spark_rapids_ml_tpu.ops import linalg as L
 from spark_rapids_ml_tpu.spark import ingest
 from spark_rapids_ml_tpu.utils.config import get_config, set_config
-from spark_rapids_ml_tpu.utils.tracing import metrics, reset_metrics
+from spark_rapids_ml_tpu.telemetry import metrics, reset_metrics
 
 
 @pytest.fixture

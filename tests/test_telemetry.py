@@ -21,7 +21,7 @@ from spark_rapids_ml_tpu.models.linear import LinearRegression
 from spark_rapids_ml_tpu.models.pca import PCA
 from spark_rapids_ml_tpu.models.scaler import StandardScaler
 from spark_rapids_ml_tpu.utils.config import get_config, set_config
-from spark_rapids_ml_tpu.utils.tracing import metrics, reset_metrics, trace_range
+from spark_rapids_ml_tpu.telemetry import metrics, reset_metrics, trace_range
 
 
 @pytest.fixture(autouse=True)
@@ -249,7 +249,7 @@ class TestJsonlSink:
         assert [r["estimator"] for r in records] == ["PCA", "StandardScaler"]
         for r in records:
             assert r["type"] == "fit_report"
-            assert r["schema"] == 2
+            assert r["schema"] == 3
             assert len(r["fit_id"]) == 12  # log<->report join key
             assert r["wall_seconds"] > 0
             assert isinstance(r["phases"], dict)

@@ -6,8 +6,8 @@ Usage::
     python tools/metrics_dump.py /path/to/telemetry.jsonl [--last N]
 
 There is no long-lived server process to scrape — fits run inside batch
-jobs — so this re-aggregates the ``fit_report`` records of a JSONL sink
-(``TPU_ML_TELEMETRY_PATH``) into a fresh
+jobs — so this re-aggregates the ``fit_report`` and ``transform_report``
+records of a JSONL sink (``TPU_ML_TELEMETRY_PATH``) into a fresh
 :class:`~spark_rapids_ml_tpu.telemetry.registry.MetricsRegistry` and
 prints :meth:`to_prometheus` text, suitable for a node-exporter textfile
 collector or a pushgateway::
@@ -17,10 +17,14 @@ collector or a pushgateway::
 
 Counter keys are parsed back from their rendered ``name{k=v,...}`` form;
 the report's dedicated fields re-emit as counters (``rows_ingested``,
-``h2d_bytes``, ``collective.count`` ...) and per-fit scalars
-(``fit.wall_seconds``, ``compile.seconds``) as one-sample-per-fit
-histograms, all labeled by estimator. Importing the registry does not pull
-in jax, so this runs on telemetry-collection hosts without it.
+``h2d_bytes``, ``collective.count``, the full ``compile.*`` family from
+``telemetry.compilemon`` — count / cache hits+misses / cache time saved —
+and the cost model's ``costmodel.flops`` / ``costmodel.bytes``) and
+per-record scalars (``fit.wall_seconds``, ``transform.wall_seconds``,
+``compile.seconds`` / ``trace_seconds`` / ``lower_seconds``) as
+one-sample-per-record histograms, all labeled by estimator/transformer.
+Importing the registry does not pull in jax, so this runs on
+telemetry-collection hosts without it.
 """
 
 from __future__ import annotations
@@ -67,19 +71,26 @@ def main(argv=None) -> int:
 
     try:
         records = [
-            r for r in read_jsonl(args.path) if r.get("type") == "fit_report"
+            r for r in read_jsonl(args.path)
+            if r.get("type") in ("fit_report", "transform_report")
         ]
     except OSError as e:
         print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
         return 1
     if not records:
-        print(f"no fit_report records in {args.path}", file=sys.stderr)
+        print(
+            f"no fit_report/transform_report records in {args.path}",
+            file=sys.stderr,
+        )
         return 1
     if args.last > 0:
         records = records[-args.last:]
 
     reg = MetricsRegistry()
     for rec in records:
+        if rec.get("type") == "transform_report":
+            _aggregate_transform(reg, rec)
+            continue
         est = rec.get("estimator", "")
         for key, v in (rec.get("counters") or {}).items():
             name, labels = parse_rendered_key(key)
@@ -96,21 +107,63 @@ def main(argv=None) -> int:
             if coll.get(k):
                 reg.counter_inc(f"collective.{k}", coll[k], estimator=est)
         comp = rec.get("compile") or {}
-        for k in ("count", "cache_hits", "cache_misses"):
+        for k in ("count", "cache_hits", "cache_misses", "cache_time_saved_s"):
             if comp.get(k):
                 reg.counter_inc(f"compile.{k}", comp[k], estimator=est)
         reg.counter_inc("fits", 1, estimator=est)
         reg.histogram_record(
             "fit.wall_seconds", rec.get("wall_seconds", 0.0), estimator=est
         )
-        if comp.get("seconds"):
-            reg.histogram_record("compile.seconds", comp["seconds"], estimator=est)
+        for k in ("seconds", "trace_seconds", "lower_seconds"):
+            if comp.get(k):
+                reg.histogram_record(f"compile.{k}", comp[k], estimator=est)
+        _aggregate_cost_model(reg, rec, estimator=est)
         ov = rec.get("overlap_fraction")
         if ov is not None:
             reg.histogram_record("stream.overlap_fraction", ov, estimator=est)
 
     sys.stdout.write(reg.to_prometheus())
     return 0
+
+
+def _aggregate_cost_model(reg, rec: dict, **labels) -> None:
+    """Re-emit a record's analytical cost-model totals as counters."""
+    cm = rec.get("cost_model") or {}
+    if cm.get("analytical_flops"):
+        reg.counter_inc("costmodel.flops", cm["analytical_flops"], **labels)
+    if cm.get("analytical_bytes"):
+        reg.counter_inc("costmodel.bytes", cm["analytical_bytes"], **labels)
+    util = cm.get("roofline_utilization")
+    if util is not None:
+        reg.histogram_record("costmodel.roofline_utilization", util, **labels)
+
+
+def _aggregate_transform(reg, rec: dict) -> None:
+    """Fold one transform_report into the registry (transformer-labeled)."""
+    tr = rec.get("transformer", "")
+    for key, v in (rec.get("counters") or {}).items():
+        name, labels = parse_rendered_key(key)
+        reg.counter_inc(name, v, **labels)
+    for name, v in (
+        ("transform.rows", rec.get("rows", 0)),
+        ("transform.bytes", rec.get("bytes", 0)),
+        ("transform.partitions", len(rec.get("partitions") or {})),
+    ):
+        if v:
+            reg.counter_inc(name, v, transformer=tr)
+    reg.counter_inc("transforms", 1, transformer=tr)
+    reg.histogram_record(
+        "transform.wall_seconds", rec.get("wall_seconds", 0.0), transformer=tr
+    )
+    # one sample per partition is gone by now; re-emit the report's own
+    # latency digest as representative samples so the hist survives export
+    lat = rec.get("partition_latency") or {}
+    for q in ("p50", "p99"):
+        if lat.get(q) is not None and lat.get("count"):
+            reg.histogram_record(
+                f"transform.partition_seconds_{q}", lat[q], transformer=tr
+            )
+    _aggregate_cost_model(reg, rec, transformer=tr)
 
 
 if __name__ == "__main__":

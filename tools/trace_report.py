@@ -1,14 +1,17 @@
 #!/usr/bin/env python
-"""Render per-fit telemetry JSONL (TPU_ML_TELEMETRY_PATH) as tables + checks.
+"""Render per-fit/transform telemetry JSONL (TPU_ML_TELEMETRY_PATH).
 
 Usage::
 
     python tools/trace_report.py /path/to/telemetry.jsonl [--last N] [--strict]
 
-For each ``fit_report`` record (newest last; ``--last N`` keeps only the
-final N): a per-phase latency table (count / total / p50 / p90 / p99 / max),
-throughput and collective/compile summaries, peak device memory, and a set
-of anomaly checks — heuristics that turn the numbers into a diagnosis:
+For each ``fit_report`` or ``transform_report`` record (newest last;
+``--last N`` keeps only the final N): a per-phase latency table (count /
+total / p50 / p90 / p99 / max), throughput and collective/compile
+summaries, the analytical cost-model line (FLOPs, bytes accessed, roofline
+utilization vs TPU_ML_PEAK_TFLOPS), per-partition breakdowns for
+transforms, peak device memory, and a set of anomaly checks — heuristics
+that turn the numbers into a diagnosis:
 
 - ``fold.wait`` total > 2× ``fold.dispatch`` total ⇒ the streamed-fit
   pipeline is NOT overlapping H2D with compute (the terminal block is
@@ -24,6 +27,12 @@ of anomaly checks — heuristics that turn the numbers into a diagnosis:
   before it becomes a hard failure.
 - nonzero ``fault.injected`` ⇒ a TPU_ML_FAULT_PLAN was active; expected
   only in chaos tests, never in a production report.
+- backend compiles far exceeding the distinct cost-model kernel count ⇒
+  recompile storm: static-shape bucketing is not holding, so the same
+  logical kernels keep recompiling per shape (check TPU_ML_MIN_BUCKET and
+  TPU_ML_COMPILE_CACHE).
+- transform reports: slowest partition > 3× the median partition ⇒
+  partition skew; one straggler sets the wall clock.
 
 The reader is tolerant by design: a record from a newer schema than this
 tool understands, or one missing the fields a renderer needs, is skipped
@@ -44,7 +53,11 @@ import sys
 # highest fit_report schema this renderer understands (telemetry.report
 # .SCHEMA_VERSION); newer records are skipped with a note, older ones
 # render with defaults for the fields they predate
-SUPPORTED_SCHEMA = 2
+SUPPORTED_SCHEMA = 3
+
+# highest transform_report schema understood
+# (telemetry.report.TRANSFORM_SCHEMA_VERSION)
+SUPPORTED_TRANSFORM_SCHEMA = 1
 
 
 def _fmt_s(v: float) -> str:
@@ -115,6 +128,70 @@ def check_anomalies(rec: dict) -> list[str]:
             "— TPU_ML_FAULT_PLAN is set; expected only in chaos tests, "
             "never in production"
         )
+    storm = _recompile_storm(rec)
+    if storm:
+        out.append(storm)
+    return out
+
+
+def _recompile_storm(rec: dict) -> str | None:
+    """Backend compiles >> distinct cost-model kernels ⇒ recompile storm.
+
+    Each captured kernel legitimately costs up to two compiles (the AOT
+    cost-analysis lowering plus the real dispatch), and a fit also runs a
+    few auxiliary jitted helpers the cost model does not capture — hence
+    the 2x + slack budget before the check fires.
+    """
+    kernels = (rec.get("cost_model") or {}).get("kernels") or {}
+    count = (rec.get("compile") or {}).get("count", 0)
+    if kernels and count > 2 * len(kernels) + 2:
+        return (
+            f"recompile storm: {count:g} backend compiles for "
+            f"{len(kernels)} distinct cost-model kernel(s) — the same "
+            "logical kernels are recompiling per input shape (check "
+            "TPU_ML_MIN_BUCKET row-bucketing and TPU_ML_COMPILE_CACHE)"
+        )
+    return None
+
+
+def check_transform_anomalies(rec: dict) -> list[str]:
+    """The heuristic diagnoses for one transform_report record."""
+    out: list[str] = []
+    wall = rec.get("wall_seconds", 0.0)
+    if wall > 0 and not rec.get("rows"):
+        out.append(
+            "no rows counted: the transform plan was built but never "
+            "materialized through the instrumented arrow path (lazy plans "
+            "only report after an action consumes them)"
+        )
+    parts = rec.get("partitions") or {}
+    secs = sorted(
+        p.get("seconds", 0.0) for p in parts.values() if p.get("seconds")
+    )
+    if len(secs) >= 2:
+        median = secs[len(secs) // 2]
+        if median > 0 and secs[-1] > 3.0 * median:
+            out.append(
+                f"partition skew: slowest partition took {_fmt_s(secs[-1])} "
+                f"vs median {_fmt_s(median)} — one straggler is setting the "
+                "wall clock (check the input partitioning)"
+            )
+    retries = _counter_total(rec, "retry.attempts")
+    if retries:
+        out.append(
+            f"recovered-but-degraded transform: {retries:g} retried "
+            "attempt(s) — the transform finished only by recovering"
+        )
+    injected = _counter_total(rec, "fault.injected")
+    if injected:
+        out.append(
+            f"fault injection active: {injected:g} synthetic fault(s) fired "
+            "— TPU_ML_FAULT_PLAN is set; expected only in chaos tests, "
+            "never in production"
+        )
+    storm = _recompile_storm(rec)
+    if storm:
+        out.append(storm)
     return out
 
 
@@ -126,6 +203,60 @@ def _counter_total(rec: dict, name: str) -> float:
         if key == name or key.startswith(name + "{"):
             total += val
     return total
+
+
+def _print_phase_table(rec: dict, out) -> None:
+    phases = rec.get("phases", {})
+    if not phases:
+        print("(no spans recorded)", file=out)
+        return
+    rows = []
+    for name, p in sorted(
+        phases.items(), key=lambda kv: -kv[1].get("sum", 0.0)
+    ):
+        rows.append([
+            name,
+            int(p.get("count", 0)),
+            _fmt_s(p.get("sum", 0.0)),
+            _fmt_s(p.get("p50", 0.0)),
+            _fmt_s(p.get("p90", 0.0)),
+            _fmt_s(p.get("p99", 0.0)),
+            _fmt_s(p.get("max", 0.0)),
+        ])
+    print(
+        _table(rows, ["phase", "count", "total", "p50", "p90", "p99", "max"]),
+        file=out,
+    )
+
+
+def _print_cost_model(rec: dict, out) -> None:
+    """The analytical FLOPs/bytes + roofline line (telemetry.costmodel)."""
+    cm = rec.get("cost_model") or {}
+    kernels = cm.get("kernels") or {}
+    if not kernels and not cm.get("analytical_flops"):
+        return
+    line = (
+        f"cost model: {cm.get('analytical_flops', 0):,.0f} analytical FLOPs, "
+        f"{_fmt_bytes(cm.get('analytical_bytes', 0))} accessed, "
+        f"{len(kernels)} kernel(s)"
+    )
+    util = cm.get("roofline_utilization")
+    if util is not None:
+        line += (
+            f"; roofline {util:.3%} of "
+            f"{cm.get('peak_flops', 0) / 1e12:.0f} TFLOP/s peak"
+        )
+    print(line, file=out)
+    for name, k in sorted(kernels.items()):
+        calls = k.get("calls", 0)
+        detail = (
+            f"  kernel {name}: {calls:g} call(s), "
+            f"{k.get('flops', 0):,.0f} FLOPs/call, "
+            f"{_fmt_bytes(k.get('bytes_accessed', 0))}/call"
+        )
+        if k.get("temp_bytes"):
+            detail += f", temp {_fmt_bytes(k['temp_bytes'])}"
+        print(detail, file=out)
 
 
 def render_record(rec: dict, out=sys.stdout) -> list[str]:
@@ -146,27 +277,7 @@ def render_record(rec: dict, out=sys.stdout) -> list[str]:
             file=out,
         )
 
-    phases = rec.get("phases", {})
-    if phases:
-        rows = []
-        for name, p in sorted(
-            phases.items(), key=lambda kv: -kv[1].get("sum", 0.0)
-        ):
-            rows.append([
-                name,
-                int(p.get("count", 0)),
-                _fmt_s(p.get("sum", 0.0)),
-                _fmt_s(p.get("p50", 0.0)),
-                _fmt_s(p.get("p90", 0.0)),
-                _fmt_s(p.get("p99", 0.0)),
-                _fmt_s(p.get("max", 0.0)),
-            ])
-        print(
-            _table(rows, ["phase", "count", "total", "p50", "p90", "p99", "max"]),
-            file=out,
-        )
-    else:
-        print("(no spans recorded)", file=out)
+    _print_phase_table(rec, out)
 
     rows_in = rec.get("rows_ingested", 0)
     if rows_in:
@@ -196,11 +307,70 @@ def render_record(rec: dict, out=sys.stdout) -> list[str]:
             f"{comp.get('cache_misses', 0):g} misses)",
             file=out,
         )
+    _print_cost_model(rec, out)
     peak = rec.get("peak_device_bytes", 0)
     if peak:
         print(f"peak device memory: {_fmt_bytes(peak)}", file=out)
 
     anomalies = check_anomalies(rec)
+    for a in anomalies:
+        print(f"  !! {a}", file=out)
+    if not anomalies:
+        print("  anomaly checks: ok", file=out)
+    return anomalies
+
+
+def render_transform_record(rec: dict, out=sys.stdout) -> list[str]:
+    """Print one transform_report; returns its anomaly list."""
+    name = rec.get("transformer", "?")
+    uid = rec.get("uid", "")
+    wall = rec.get("wall_seconds", 0.0)
+    transform_id = rec.get("transform_id", "")
+    tag = f" [{uid}]" if uid else ""
+    tag += f" transform={transform_id}" if transform_id else ""
+    print(f"\n=== {name}{tag} — {_fmt_s(wall)} (transform) ===", file=out)
+
+    _print_phase_table(rec, out)
+
+    rows_out = rec.get("rows", 0)
+    if rows_out:
+        line = f"output: {rows_out} rows, {_fmt_bytes(rec.get('bytes', 0))}"
+        if wall > 0:
+            line += f" ({rows_out / wall:,.0f} rows/s)"
+        print(line, file=out)
+
+    parts = rec.get("partitions") or {}
+    if parts:
+        def _pkey(kv):
+            pid = kv[0]
+            return (0, int(pid)) if pid.isdigit() else (1, 0)
+        rows = []
+        for pid, p in sorted(parts.items(), key=_pkey):
+            rows.append([
+                pid,
+                int(p.get("rows", 0)),
+                _fmt_bytes(p.get("bytes", 0)),
+                int(p.get("batches", 0)),
+                _fmt_s(p.get("seconds", 0.0)),
+            ])
+        print(
+            _table(rows, ["partition", "rows", "bytes", "batches", "seconds"]),
+            file=out,
+        )
+    lat = rec.get("partition_latency") or {}
+    if lat.get("count"):
+        print(
+            f"partition latency: {lat['count']:g} partition(s), "
+            f"p50 {_fmt_s(lat.get('p50', 0.0))} / "
+            f"p90 {_fmt_s(lat.get('p90', 0.0))} / "
+            f"p99 {_fmt_s(lat.get('p99', 0.0))}, "
+            f"max {_fmt_s(lat.get('max', 0.0))}",
+            file=out,
+        )
+
+    _print_cost_model(rec, out)
+
+    anomalies = check_transform_anomalies(rec)
     for a in anomalies:
         print(f"  !! {a}", file=out)
     if not anomalies:
@@ -235,33 +405,45 @@ def main(argv=None) -> int:
                 except json.JSONDecodeError:
                     print(f"# skipping corrupt line", file=sys.stderr)
                     continue
-                if rec.get("type") == "fit_report":
+                if rec.get("type") in ("fit_report", "transform_report"):
                     records.append(rec)
     except OSError as e:
         print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
         return 1
     if not records:
-        print(f"no fit_report records in {args.path}", file=sys.stderr)
+        print(
+            f"no fit_report/transform_report records in {args.path}",
+            file=sys.stderr,
+        )
         return 1
     if args.last > 0:
         records = records[-args.last:]
 
-    print(f"{len(records)} fit report(s) from {args.path}")
+    n_fit = sum(1 for r in records if r.get("type") == "fit_report")
+    print(
+        f"{n_fit} fit report(s), {len(records) - n_fit} transform "
+        f"report(s) from {args.path}"
+    )
     any_anomaly = False
     skipped = 0
     for i, rec in enumerate(records):
+        is_transform = rec.get("type") == "transform_report"
+        supported = (
+            SUPPORTED_TRANSFORM_SCHEMA if is_transform else SUPPORTED_SCHEMA
+        )
         schema = rec.get("schema", 1)
-        if isinstance(schema, (int, float)) and schema > SUPPORTED_SCHEMA:
+        if isinstance(schema, (int, float)) and schema > supported:
             print(
                 f"# skipping record {i}: schema {schema} is newer than this "
-                f"tool understands (<= {SUPPORTED_SCHEMA}) — upgrade "
+                f"tool understands (<= {supported}) — upgrade "
                 "tools/trace_report.py",
                 file=sys.stderr,
             )
             skipped += 1
             continue
         try:
-            if render_record(rec):
+            renderer = render_transform_record if is_transform else render_record
+            if renderer(rec):
                 any_anomaly = True
         except Exception as e:  # noqa: BLE001 — a bad record must not
             # hide the rest of the file

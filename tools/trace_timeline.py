@@ -9,8 +9,10 @@ Usage::
         --gap-threshold 0.5
 
 Input is either the JSONL file written by ``TPU_ML_TIMELINE_PATH``
-(``timeline`` records, one per outermost fit — see
+(``timeline`` records, one per outermost fit or transform — see
 ``telemetry/export.py``) or an already-exported Chrome trace JSON object.
+Transform timelines carry a ``transform_id`` instead of (or alongside) a
+``fit_id``; both show in the record header and both have a filter flag.
 
 The default output is a per-fit summary: event counts, per-track (one
 track = one ``(pid, partition)``) span busy time and the largest idle gap
@@ -118,8 +120,17 @@ def summarize_record(rec: dict, gap_threshold_s: float, out=sys.stdout) -> bool:
     largest inter-span gap exceeds the threshold (the --strict trigger)."""
     events = [e for e in rec.get("events", []) if isinstance(e, dict)]
     fit_id = rec.get("fit_id", "")
+    transform_id = rec.get("transform_id", "")
     est = rec.get("estimator", "")
-    head = " ".join(x for x in (est, f"[{fit_id}]" if fit_id else "") if x)
+    head = " ".join(
+        x
+        for x in (
+            est,
+            f"[fit={fit_id}]" if fit_id else "",
+            f"[transform={transform_id}]" if transform_id else "",
+        )
+        if x
+    )
     print(f"\n=== timeline {head or '(unlabeled)'}: {len(events)} events ===",
           file=out)
     ov = rec.get("overlap_fraction")
@@ -218,6 +229,10 @@ def main(argv=None) -> int:
         help="only use records with this fit_id",
     )
     ap.add_argument(
+        "--transform", default="", metavar="TRANSFORM_ID",
+        help="only use records with this transform_id",
+    )
+    ap.add_argument(
         "--gap-threshold", type=float, default=1.0, metavar="SECONDS",
         help="largest tolerated idle gap within a track (default 1.0)",
     )
@@ -234,6 +249,10 @@ def main(argv=None) -> int:
         return 1
     if args.fit:
         records = [r for r in records if r.get("fit_id") == args.fit]
+    if args.transform:
+        records = [
+            r for r in records if r.get("transform_id") == args.transform
+        ]
     if args.last > 0:
         records = records[-args.last:]
     if not records:
