@@ -35,7 +35,9 @@ __version__ = "0.1.0"
 _logger = _logging.getLogger(__name__)
 if not any(isinstance(h, _logging.NullHandler) for h in _logger.handlers):
     _logger.addHandler(_logging.NullHandler())
-_level = _os.environ.get("TPU_ML_LOG_LEVEL", "")
+from spark_rapids_ml_tpu.utils import knobs as _knobs
+
+_level = _os.environ.get(_knobs.LOG_LEVEL.name, "")
 if _level:
     try:
         _logger.setLevel(

@@ -1,0 +1,27 @@
+"""tpulint — project-native static analysis for the framework's JAX/TPU
+invariants.
+
+The reference design keeps its invariants honest with Scala's compiler over
+a 1.8k-LoC surface; a ~27k-LoC Python/JAX reproduction keeps them honest
+with this package instead. The engine (:mod:`.engine`) is a small AST
+visitor framework — per-rule IDs, ``# tpulint: disable=RULE`` suppressions,
+a checked-in baseline for grandfathered findings, JSON and human output —
+and the rules (:mod:`.rules`) encode the conventions the first five PRs
+established: donated fold carries, no host syncs inside traced code, no
+recompile hazards, one retry policy, registered telemetry names, a central
+knob inventory, locked telemetry globals, no silently swallowed broad
+exceptions.
+
+Run it as ``python -m tools.tpulint`` (CI runs ``--strict``); this package
+stays import-pure (no jax) so linting works anywhere the repo checks out.
+"""
+
+from spark_rapids_ml_tpu.analysis.engine import (  # noqa: F401
+    Baseline,
+    Finding,
+    LintedModule,
+    Rule,
+    lint_paths,
+    lint_source,
+)
+from spark_rapids_ml_tpu.analysis.rules import ALL_RULES  # noqa: F401

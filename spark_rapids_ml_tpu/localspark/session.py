@@ -17,7 +17,7 @@ import pyarrow as pa
 
 from spark_rapids_ml_tpu.localspark import types as T
 from spark_rapids_ml_tpu.localspark import worker as W
-from spark_rapids_ml_tpu.utils import devicepolicy
+from spark_rapids_ml_tpu.utils import devicepolicy, knobs
 from spark_rapids_ml_tpu.localspark.dataframe import (
     DataFrame,
     Row,
@@ -212,17 +212,17 @@ class LocalSparkSession:
         # compile: on a saturated host (e.g. a bench run sharing the box)
         # 120 s can flake — the test harness raises it rather than letting
         # load turn into spurious WorkerExceptions.
-        raw_bt = os.environ.get("TPU_ML_BARRIER_TIMEOUT_S", "120")
+        raw_bt = os.environ.get(knobs.BARRIER_TIMEOUT_S.name, "120")
         try:
             self.barrier_timeout = float(raw_bt)
         except ValueError:
             raise ValueError(
-                f"TPU_ML_BARRIER_TIMEOUT_S must be a number of seconds, "
-                f"got {raw_bt!r}"
+                f"{knobs.BARRIER_TIMEOUT_S.name} must be a number of "
+                f"seconds, got {raw_bt!r}"
             ) from None
         if self.barrier_timeout <= 0:
             raise ValueError(
-                f"TPU_ML_BARRIER_TIMEOUT_S must be > 0, got {raw_bt!r}"
+                f"{knobs.BARRIER_TIMEOUT_S.name} must be > 0, got {raw_bt!r}"
             )
         self._workers: list[_Worker] = []
         self._closed = False

@@ -25,6 +25,10 @@ from spark_rapids_ml_tpu.telemetry import trace_range
 
 _MAX_INIT_SAMPLE = 16384
 
+#: module-level jit so transform/computeCost reuse one compiled program per
+#: shape bucket instead of retracing per call (tpulint TPL003 convention)
+_assign_clusters_jit = jax.jit(KM.assign_clusters)
+
 
 def _resume_kmeans_checkpoint(checkpoint_dir: str | None, k: int):
     """(centers-or-None, start_iter, cost, checkpointer-or-None) for a Lloyd
@@ -315,7 +319,7 @@ class KMeansModel(_KMeansParams, Model):
     def _predict_matrix(self, mat: np.ndarray) -> np.ndarray:
         padded, true_rows = columnar.pad_rows(mat)
         xd = jnp.asarray(padded)
-        labels, _ = jax.jit(KM.assign_clusters)(
+        labels, _ = _assign_clusters_jit(
             xd, jnp.asarray(self.clusterCenters, dtype=xd.dtype)
         )
         return np.asarray(labels)[:true_rows]
@@ -343,7 +347,7 @@ class KMeansModel(_KMeansParams, Model):
         for mat in ds.matrices():
             padded, true_rows = columnar.pad_rows(mat)
             xd = jnp.asarray(padded)
-            _, dists = jax.jit(KM.assign_clusters)(
+            _, dists = _assign_clusters_jit(
                 xd, jnp.asarray(self.clusterCenters, dtype=xd.dtype)
             )
             total += float(jnp.sum(dists[:true_rows]))

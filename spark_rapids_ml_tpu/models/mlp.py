@@ -46,6 +46,8 @@ _forward_jit = None  # created lazily below to keep import cheap
 def _forward_cached(flat, x, layers):
     global _forward_jit
     if _forward_jit is None:
+        # built once behind the None guard — a hand-rolled module cache
+        # tpulint: disable=TPL003
         _forward_jit = jax.jit(_forward, static_argnames=("layers",))
     return _forward_jit(flat, x, layers=layers)
 

@@ -49,6 +49,8 @@ def smooth_knn_calibration(
     halvings ≈ 1e−19 interval — far past float precision).
     """
     n, k = knn_dists.shape
+    # k is a Python int from .shape — static under tracing, no sync
+    # tpulint: disable=TPL002
     target = jnp.log2(jnp.asarray(float(k), knn_dists.dtype))
     pos = jnp.where(knn_dists > 0, knn_dists, jnp.inf)
     rho = jnp.min(pos, axis=1)

@@ -389,6 +389,8 @@ def _chunk_fold_prog(mesh: Mesh, kernel, vec_args: int):
         local = kernel(xl, *vecs)
         return jax.tree.map(lambda c, s: c + s[None], carry, local)
 
+    # every caller is an @lru_cache'd factory, so the program is built
+    # once per (mesh, kernel) key  # tpulint: disable=TPL003
     return jax.jit(_fold, donate_argnums=0)
 
 

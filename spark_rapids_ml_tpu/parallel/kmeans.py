@@ -157,6 +157,9 @@ def make_distributed_kmeans_chunk(
             NamedSharding(mesh, P()),
         ),
         out_shardings=NamedSharding(mesh, P()),
+        # the host loop rebinds its centers to this chunk's output, so the
+        # incoming carry is dead after dispatch — donate its buffer
+        donate_argnums=2,
     )
 
 

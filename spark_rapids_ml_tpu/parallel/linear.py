@@ -343,6 +343,9 @@ def make_distributed_logreg_chunk(
             NamedSharding(mesh, P()),
         ),
         out_shardings=NamedSharding(mesh, P()),
+        # run_chunked_newton rebinds w to this chunk's output; the carried
+        # weights are dead after dispatch — donate their buffer
+        donate_argnums=3,
     )
 
 
@@ -405,6 +408,9 @@ def make_distributed_softmax_chunk(
             NamedSharding(mesh, P()),
         ),
         out_shardings=NamedSharding(mesh, P()),
+        # same contract as the logreg chunk: the carried flat weights are
+        # rebound by run_chunked_newton, so donate their buffer
+        donate_argnums=3,
     )
 
 

@@ -49,8 +49,9 @@ from typing import Any
 
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
+from spark_rapids_ml_tpu.utils import knobs
 
-FAULT_PLAN_VAR = "TPU_ML_FAULT_PLAN"
+FAULT_PLAN_VAR = knobs.FAULT_PLAN.name
 
 KINDS = ("oom", "io", "hang", "nonfinite", "preempt", "kill")
 

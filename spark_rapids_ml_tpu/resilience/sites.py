@@ -1,0 +1,30 @@
+"""Canonical registry of fault-injection site names.
+
+``resilience.faults.inject(site)`` gates are addressed by name from
+``TPU_ML_FAULT_PLAN`` plans; a typo'd site in either place silently never
+fires. Declaring the sites here gives the chaos tests, the docs, and the
+linter (``tools/tpulint.py`` rule TPL005) one source of truth: a call-site
+literal that does not resolve against this set is a lint error.
+
+Import-pure (no package siblings) so the linter can load it standalone.
+"""
+
+from __future__ import annotations
+
+# Site constants — call sites use these (or the equal literal; the linter
+# accepts both, the constant is preferred for grep-ability).
+WORKER_TASK = "worker.task"       # localspark worker / executor task entry
+COLLECTIVE = "collective"         # cross-device collective dispatch
+DEVICE_INIT = "device.init"       # backend/device initialization
+FOLD_DISPATCH = "fold.dispatch"   # streamed-fit chunk dispatch
+FOLD_WAIT = "fold.wait"           # streamed-fit terminal device wait
+INGEST_CHUNK = "ingest.chunk"     # streamed-fit chunk staging
+
+FAULT_SITES: frozenset[str] = frozenset({
+    WORKER_TASK,
+    COLLECTIVE,
+    DEVICE_INIT,
+    FOLD_DISPATCH,
+    FOLD_WAIT,
+    INGEST_CHUNK,
+})

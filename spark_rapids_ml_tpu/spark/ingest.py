@@ -47,18 +47,18 @@ import numpy as np
 from spark_rapids_ml_tpu.telemetry import costmodel
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
-from spark_rapids_ml_tpu.utils import columnar
+from spark_rapids_ml_tpu.utils import columnar, knobs
 
 logger = logging.getLogger("spark_rapids_ml_tpu")
 
-WIRE_DTYPE_VAR = "TPU_ML_MESH_LOCAL_WIRE_DTYPE"
-MAX_BYTES_VAR = "TPU_ML_MESH_LOCAL_MAX_BYTES"
+WIRE_DTYPE_VAR = knobs.MESH_LOCAL_WIRE_DTYPE.name
+MAX_BYTES_VAR = knobs.MESH_LOCAL_MAX_BYTES.name
 # real-pyspark ingest strategy cutover: datasets at or under this many
 # estimated bytes use the columnar toArrow() fast path (O(dataset) driver
 # Arrow memory, no per-row Python); larger ones stream via toLocalIterator
 # (O(partition) memory, row-conversion cost). localspark always streams
 # columnar (its partitions are lazy Arrow batches — both properties at once).
-ARROW_CUTOVER_VAR = "TPU_ML_MESH_LOCAL_ARROW_MAX_BYTES"
+ARROW_CUTOVER_VAR = knobs.MESH_LOCAL_ARROW_MAX_BYTES.name
 DEFAULT_ARROW_CUTOVER = 1 << 30
 # rows per conversion chunk on the row-iterator (pyspark) path; Arrow-path
 # chunks keep whatever batch size the engine produced
@@ -66,17 +66,17 @@ ROW_CHUNK = 65_536
 # streamed-fit knobs: fits whose estimated resident footprint exceeds the
 # cutover never assemble the global array — they fold fixed-shape chunks of
 # STREAM_CHUNK rows through a donated device accumulator instead
-STREAM_CUTOVER_VAR = "TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES"
-STREAM_CHUNK_VAR = "TPU_ML_STREAM_CHUNK_ROWS"
+STREAM_CUTOVER_VAR = knobs.STREAM_FIT_MAX_RESIDENT_BYTES.name
+STREAM_CHUNK_VAR = knobs.STREAM_CHUNK_ROWS.name
 DEFAULT_STREAM_CHUNK = 65_536
 # floor (and alignment multiple) for the OOM chunk bisection; mesh callers
 # pass min_chunk_rows >= the data-axis size so bisected chunks still shard
-STREAM_CHUNK_FLOOR_VAR = "TPU_ML_STREAM_CHUNK_FLOOR"
+STREAM_CHUNK_FLOOR_VAR = knobs.STREAM_CHUNK_FLOOR.name
 DEFAULT_STREAM_CHUNK_FLOOR = 8
-FOLD_WAIT_TIMEOUT_VAR = "TPU_ML_FOLD_WAIT_TIMEOUT_S"
+FOLD_WAIT_TIMEOUT_VAR = knobs.FOLD_WAIT_TIMEOUT_S.name
 # live progress heartbeat: float seconds between stderr lines during a
 # streamed fold (unset/0 = silent — multi-minute fits opt in)
-PROGRESS_VAR = "TPU_ML_PROGRESS"
+PROGRESS_VAR = knobs.PROGRESS.name
 
 
 def wire_dtype() -> np.dtype:

@@ -36,6 +36,7 @@ import os
 import threading
 
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils import knobs
 
 logger = logging.getLogger("spark_rapids_ml_tpu")
 
@@ -58,7 +59,7 @@ def peak_flops() -> float:
     """Device peak FLOP/s for the roofline denominator."""
     try:
         return float(
-            os.environ.get("TPU_ML_PEAK_TFLOPS", DEFAULT_PEAK_TFLOPS)
+            os.environ.get(knobs.PEAK_TFLOPS.name, DEFAULT_PEAK_TFLOPS)
         ) * 1e12
     except (TypeError, ValueError):
         return DEFAULT_PEAK_TFLOPS * 1e12

@@ -1,0 +1,183 @@
+"""Canonical registry of telemetry names: metrics, span phases, instants.
+
+A typo'd name at a call site does not crash — it silently mints a fresh
+metric family that no dashboard, FitReport consumer, or trace-report
+anomaly check ever reads. This module is the single declaration point the
+linter (``tools/tpulint.py`` rule TPL005) cross-checks every string literal
+passed to ``counter_inc``/``gauge_set``/``histogram_record``,
+``trace_range``, ``record_span``/``record_instant`` and
+``resilience.faults.inject`` against — adding a new series means adding it
+here first, which is exactly the point.
+
+Import-pure: no jax, no package siblings, usable from the linter and from
+jax-free worker processes.
+"""
+
+from __future__ import annotations
+
+# -- metric families (telemetry.registry counter/gauge/histogram names) ----
+
+METRICS: frozenset[str] = frozenset({
+    # ingestion / data movement
+    "ingest.rows",
+    "ingest.bytes",
+    "ingest.chunk_rows",
+    "h2d.bytes",
+    "columnar.rows",
+    "columnar.bytes",
+    # collectives / distributed aggregation
+    "collective.bytes",
+    "collective.count",
+    "collective.tree_combines",
+    "collective.dispatch",
+    "drivermerge.passes",
+    "drivermerge.bytes",
+    # streamed-fit lifecycle
+    "stream.checkpoints",
+    "stream.resumes",
+    "stream.overlap_fraction",
+    "chunk.bisections",
+    "rows.nonfinite_skipped",
+    # spans
+    "span.seconds",
+    # compile monitoring (telemetry.compilemon event mappings)
+    "compile.count",
+    "compile.seconds",
+    "compile.trace_seconds",
+    "compile.lower_seconds",
+    "compile.other_seconds",
+    "compile.cache_hits",
+    "compile.cache_misses",
+    "compile.cache_time_saved_s",
+    # resilience
+    "retry.attempts",
+    "fault.injected",
+    "degraded.cpu_fallback",
+    # serve path
+    "transform.rows",
+    "transform.bytes",
+    "transform.batches",
+    "transform.partitions",
+    "transform.partition_seconds",
+    # cost model
+    "costmodel.calls",
+    "costmodel.flops",
+    "costmodel.bytes",
+    "costmodel.roofline_utilization",
+    # report re-aggregation (tools/metrics_dump.py Prometheus export)
+    "fits",
+    "fit.wall_seconds",
+    "transforms",
+    "transform.wall_seconds",
+})
+
+# Metric families minted with a dynamic suffix (one registered prefix per
+# family; the dynamic tail is data, not a name).
+METRIC_PREFIXES: tuple[str, ...] = (
+    "device.",  # telemetry.compilemon device memory gauges: device.<stat>
+    # metrics_dump re-emits a transform report's latency digest as
+    # representative histogram samples, one family per quantile
+    "transform.partition_seconds_",
+)
+
+# -- span phases (trace_range names -> span.seconds{phase=...}) ------------
+
+SPAN_PHASES: frozenset[str] = frozenset({
+    # streamed-fit / dispatch machinery
+    "fold.dispatch",
+    "fold.wait",
+    "ingest.chunk",
+    "transform.plan",
+    "transform.dispatch",
+    # cross-process timeline span events
+    "worker.task",
+    "transform.partition",
+    # linalg / decomposition
+    "compute cov",
+    "eigh",
+    "svd from r",
+    "svd mesh fit",
+    "tsvd decompose",
+    "tsvd reduce",
+    "tsvd transform",
+    "tsvd mesh fit",
+    "tsvd mesh-local fit",
+    "pca transform",
+    # scalers / preprocessing
+    "scaler moments",
+    "scaler range stats",
+    "scaler transform",
+    "robust scaler histogram",
+    "robust transform",
+    "maxabs transform",
+    "minmax transform",
+    "normalize",
+    "binarize",
+    "bucketize",
+    "quantile bucketize",
+    "quantile discretizer histogram",
+    "quantile sketch histogram",
+    "impute",
+    "imputer fit",
+    "polynomial expansion",
+    "elementwise product",
+    "vector slicer",
+    "dct",
+    "variance selector fit",
+    "variance selector transform",
+    "label scan",
+    # linear family
+    "linreg solve",
+    "linreg stats",
+    "logreg newton",
+    "logreg transform",
+    "logreg mesh fit",
+    "logreg mesh-local fit",
+    "logreg mesh-local chunked fit",
+    "softmax newton",
+    "softmax mesh fit",
+    "svc mesh-local fit",
+    "svc transform",
+    "isotonic pav",
+    # clustering
+    "kmeans init",
+    "kmeans lloyd",
+    "kmeans transform",
+    "kmeans mesh fit",
+    "kmeans mesh init",
+    "kmeans mesh-local fit",
+    "kmeans mesh-local chunked fit",
+    "dbscan cluster",
+    "dbscan spark cluster",
+    # trees / ensembles / misc models
+    "forest build",
+    "gbt boost",
+    "fm train",
+    "mlp train",
+    "naive bayes stats",
+    "naive bayes stats (mesh)",
+    "naive bayes variance pass",
+    "one-vs-rest fit",
+    "one-vs-rest transform",
+    # neighbors / umap
+    "knn kneighbors",
+    "ivf build",
+    "ivf kneighbors",
+    "umap init",
+    "umap knn graph",
+    "umap fuzzy graph",
+    "umap layout",
+    "umap transform",
+})
+
+# -- timeline instant events (flight-recorder record_instant names) --------
+
+INSTANTS: frozenset[str] = frozenset({
+    "stream.chunk",
+    "stream.checkpoint",
+    "stream.resume",
+    "chunk.bisection",
+    "collective.dispatch",
+    "retry",
+    "fault.injected",
+})

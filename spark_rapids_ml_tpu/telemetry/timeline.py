@@ -46,7 +46,9 @@ import os
 import threading
 import time
 
-TIMELINE_CAPACITY_VAR = "TPU_ML_TIMELINE_EVENTS"
+from spark_rapids_ml_tpu.utils import knobs
+
+TIMELINE_CAPACITY_VAR = knobs.TIMELINE_EVENTS.name
 DEFAULT_TIMELINE_CAPACITY = 4096
 
 

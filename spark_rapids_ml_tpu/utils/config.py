@@ -87,6 +87,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
+from spark_rapids_ml_tpu.utils import knobs
 
 VALID_PRECISIONS = ("highest", "high", "default")
 VALID_NONFINITE_POLICIES = ("raise", "skip", "allow")
@@ -110,19 +111,20 @@ def _int_env(name: str, default: int) -> int:
 
 
 def _precision_env() -> str:
-    v = os.environ.get("TPU_ML_DEFAULT_PRECISION", "highest")
+    v = os.environ.get(knobs.DEFAULT_PRECISION.name, "highest")
     if v not in VALID_PRECISIONS:
         raise ValueError(
-            f"TPU_ML_DEFAULT_PRECISION={v!r} must be one of {VALID_PRECISIONS}"
+            f"{knobs.DEFAULT_PRECISION.name}={v!r} must be one of "
+            f"{VALID_PRECISIONS}"
         )
     return v
 
 
 def _nonfinite_env() -> str:
-    v = os.environ.get("TPU_ML_NONFINITE_POLICY", "raise")
+    v = os.environ.get(knobs.NONFINITE_POLICY.name, "raise")
     if v not in VALID_NONFINITE_POLICIES:
         raise ValueError(
-            f"TPU_ML_NONFINITE_POLICY={v!r} must be one of "
+            f"{knobs.NONFINITE_POLICY.name}={v!r} must be one of "
             f"{VALID_NONFINITE_POLICIES}"
         )
     return v
@@ -130,34 +132,40 @@ def _nonfinite_env() -> str:
 
 @dataclass
 class RuntimeConfig:
-    min_bucket: int = field(default_factory=lambda: _int_env("TPU_ML_MIN_BUCKET", 128))
-    max_workers: int = field(default_factory=lambda: _int_env("TPU_ML_MAX_WORKERS", 4))
-    task_retries: int = field(default_factory=lambda: _int_env("TPU_ML_TASK_RETRIES", 3))
+    min_bucket: int = field(
+        default_factory=lambda: _int_env(knobs.MIN_BUCKET.name, 128)
+    )
+    max_workers: int = field(
+        default_factory=lambda: _int_env(knobs.MAX_WORKERS.name, 4)
+    )
+    task_retries: int = field(
+        default_factory=lambda: _int_env(knobs.TASK_RETRIES.name, 3)
+    )
     default_precision: str = field(default_factory=_precision_env)
     stream_fit_max_resident_bytes: int = field(
         default_factory=lambda: _int_env(
-            "TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES", 1 << 31
+            knobs.STREAM_FIT_MAX_RESIDENT_BYTES.name, 1 << 31
         )
     )
     telemetry_path: str = field(
-        default_factory=lambda: os.environ.get("TPU_ML_TELEMETRY_PATH", "")
+        default_factory=lambda: os.environ.get(knobs.TELEMETRY_PATH.name, "")
     )
     timeline_path: str = field(
-        default_factory=lambda: os.environ.get("TPU_ML_TIMELINE_PATH", "")
+        default_factory=lambda: os.environ.get(knobs.TIMELINE_PATH.name, "")
     )
     retry_max_attempts: int = field(
-        default_factory=lambda: _int_env("TPU_ML_RETRY_MAX_ATTEMPTS", 4)
+        default_factory=lambda: _int_env(knobs.RETRY_MAX_ATTEMPTS.name, 4)
     )
     retry_deadline_s: int = field(
-        default_factory=lambda: _int_env("TPU_ML_RETRY_DEADLINE_S", 300)
+        default_factory=lambda: _int_env(knobs.RETRY_DEADLINE_S.name, 300)
     )
     stream_checkpoint_every_chunks: int = field(
         default_factory=lambda: _int_env(
-            "TPU_ML_STREAM_CHECKPOINT_EVERY_CHUNKS", 64
+            knobs.STREAM_CHECKPOINT_EVERY_CHUNKS.name, 64
         )
     )
     fold_wait_timeout_s: int = field(
-        default_factory=lambda: _int_env("TPU_ML_FOLD_WAIT_TIMEOUT_S", 600)
+        default_factory=lambda: _int_env(knobs.FOLD_WAIT_TIMEOUT_S.name, 600)
     )
     nonfinite_policy: str = field(default_factory=_nonfinite_env)
 
@@ -177,7 +185,7 @@ def enable_compilation_cache() -> str | None:
     """
     global _compile_cache_enabled
     cache_dir = os.environ.get(
-        "TPU_ML_COMPILE_CACHE",
+        knobs.COMPILE_CACHE.name,
         os.path.join(
             os.path.expanduser("~"), ".cache", "spark_rapids_ml_tpu", "xla"
         ),

@@ -37,6 +37,8 @@ import sys
 import time
 from typing import Callable, Mapping
 
+from spark_rapids_ml_tpu.utils import knobs
+
 # Environment variables whose mere presence makes an interpreter-start hook
 # register an accelerator PJRT plugin (and potentially dial/claim the
 # device). Scrubbed from worker environments under the "cpu" policy.
@@ -51,9 +53,9 @@ ACCELERATOR_BOOTSTRAP_VARS: tuple[str, ...] = (
 )
 
 # Env contract between the session (parent) and worker (child):
-PLATFORM_VAR = "TPU_ML_WORKER_PLATFORM"          # expected jax platform name
-PROBE_VAR = "TPU_ML_WORKER_PROBE"                # "1": probe at worker startup
-PROBE_TIMEOUT_VAR = "TPU_ML_WORKER_PROBE_TIMEOUT"  # seconds, float
+PLATFORM_VAR = knobs.WORKER_PLATFORM.name        # expected jax platform name
+PROBE_VAR = knobs.WORKER_PROBE.name              # "1": probe at worker startup
+PROBE_TIMEOUT_VAR = knobs.WORKER_PROBE_TIMEOUT.name  # seconds, float
 DEFAULT_PROBE_TIMEOUT = 60.0
 
 # Exit code a worker uses for a failed device probe; distinguishable in the
@@ -64,7 +66,7 @@ PROBE_EXIT_CODE = 17
 def scrub_vars() -> tuple[str, ...]:
     extra = tuple(
         v.strip()
-        for v in os.environ.get("TPU_ML_WORKER_SCRUB_VARS", "").split(",")
+        for v in os.environ.get(knobs.WORKER_SCRUB_VARS.name, "").split(",")
         if v.strip()
     )
     return ACCELERATOR_BOOTSTRAP_VARS + extra

@@ -1,0 +1,219 @@
+"""THE canonical inventory of ``TPU_ML_*`` environment knobs.
+
+Every environment variable the framework (package, bench, tools) reads is
+declared here once — name, type, default, one-line doc, and the module that
+consumes it. Consumers re-export the env-var *name* from their declaration
+here (``FAULT_PLAN_VAR = knobs.FAULT_PLAN.name`` style) instead of minting
+their own string literal; ``tools/tpulint.py`` rule TPL006 rejects any
+``TPU_ML_*`` literal outside this module, so an undeclared knob cannot
+ship, and ``python -m tools.tpulint --list-knobs`` renders this inventory
+(the README knob table is generated from it and drift-checked in CI).
+
+This module is import-pure on purpose: no jax, no package siblings — the
+linter, the README generator, and every consumer (including jax-free worker
+ingestion processes) can import it with zero side effects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared environment knob."""
+
+    name: str          # the TPU_ML_* environment variable
+    type: str          # "int" | "float" | "str" | "path" | "flag" | "enum"
+    default: str       # rendered default ("" = unset/disabled)
+    doc: str           # one-line meaning, README-table ready
+    module: str        # the consuming module (dotted path or tool file)
+
+
+_DECLARATIONS = (
+    # -- core runtime (utils.config caches these in RuntimeConfig) ----------
+    Knob("TPU_ML_MIN_BUCKET", "int", "128",
+         "row-bucket floor for static-shape padding (bounds distinct "
+         "compiled shapes)", "utils.config"),
+    Knob("TPU_ML_MAX_WORKERS", "int", "4",
+         "partition executor thread pool size", "utils.config"),
+    Knob("TPU_ML_TASK_RETRIES", "int", "3",
+         "per-task retry budget (the `spark.task.maxFailures` analog)",
+         "utils.config"),
+    Knob("TPU_ML_DEFAULT_PRECISION", "enum", "highest",
+         "`highest`/`high`/`default` matmul precision for Gram/projection "
+         "kernels", "utils.config"),
+    Knob("TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES", "int", str(1 << 31),
+         "device-footprint cutover above which DataFrame fits stream "
+         "chunk-wise instead of materializing", "utils.config"),
+    Knob("TPU_ML_COMPILE_CACHE", "path",
+         "~/.cache/spark_rapids_ml_tpu/xla",
+         "persistent XLA compilation cache dir (empty string disables)",
+         "utils.config"),
+    Knob("TPU_ML_LOG_LEVEL", "str", "",
+         "package logger level (name or number) set at import",
+         "spark_rapids_ml_tpu"),
+    # -- telemetry ----------------------------------------------------------
+    Knob("TPU_ML_TELEMETRY_PATH", "path", "",
+         "JSONL sink for per-fit/transform telemetry reports (empty "
+         "disables)", "utils.config"),
+    Knob("TPU_ML_TIMELINE_PATH", "path", "",
+         "JSONL sink for flight-recorder timelines (empty disables)",
+         "utils.config"),
+    Knob("TPU_ML_TIMELINE_EVENTS", "int", "4096",
+         "flight-recorder ring-buffer capacity (0 disables)",
+         "telemetry.timeline"),
+    Knob("TPU_ML_PROGRESS", "float", "",
+         "emit a live streamed-fit heartbeat to stderr every N seconds "
+         "(unset = off)", "spark.ingest"),
+    Knob("TPU_ML_PEAK_TFLOPS", "float", "197.0",
+         "device peak for the cost model's roofline denominator (default "
+         "= TPU v5e bf16)", "telemetry.costmodel"),
+    # -- resilience ---------------------------------------------------------
+    Knob("TPU_ML_RETRY_MAX_ATTEMPTS", "int", "4",
+         "shared retry-policy attempt budget per call site", "utils.config"),
+    Knob("TPU_ML_RETRY_DEADLINE_S", "int", "300",
+         "wall-clock ceiling across one call's retries (0 = unbounded)",
+         "utils.config"),
+    Knob("TPU_ML_STREAM_CHECKPOINT_EVERY_CHUNKS", "int", "64",
+         "checkpoint the streamed-fit carry every K full chunks (with a "
+         "checkpoint_dir)", "utils.config"),
+    Knob("TPU_ML_FOLD_WAIT_TIMEOUT_S", "int", "600",
+         "bound on the streamed fit's terminal device wait (0 = unbounded)",
+         "utils.config"),
+    Knob("TPU_ML_NONFINITE_POLICY", "enum", "raise",
+         "`raise`/`skip`/`allow` for non-finite input rows in streamed "
+         "fits", "utils.config"),
+    Knob("TPU_ML_FAULT_PLAN", "str", "",
+         "`site:kind:nth[:arg]` comma list of deterministic synthetic "
+         "faults (chaos tests only — never production)",
+         "resilience.faults"),
+    # -- ingestion / streaming (spark.ingest) -------------------------------
+    Knob("TPU_ML_MESH_LOCAL_WIRE_DTYPE", "enum", "float64",
+         "wire dtype for mesh-local ingestion staging (`float32` halves "
+         "the footprint)", "spark.ingest"),
+    Knob("TPU_ML_MESH_LOCAL_MAX_BYTES", "int", "",
+         "hard cap on mesh-local resident ingestion bytes (unset = "
+         "uncapped)", "spark.ingest"),
+    Knob("TPU_ML_MESH_LOCAL_ARROW_MAX_BYTES", "int", str(1 << 30),
+         "Arrow-batch staging cutover for mesh-local ingestion",
+         "spark.ingest"),
+    Knob("TPU_ML_STREAM_CHUNK_ROWS", "int", "65536",
+         "streamed-fit chunk size in rows", "spark.ingest"),
+    Knob("TPU_ML_STREAM_CHUNK_FLOOR", "int", "8",
+         "smallest chunk the OOM bisection may produce", "spark.ingest"),
+    # -- worker device policy (localspark session <-> worker contract) ------
+    Knob("TPU_ML_BARRIER_TIMEOUT_S", "float", "120",
+         "barrier-stage rendezvous timeout", "localspark.session"),
+    Knob("TPU_ML_WORKER_PLATFORM", "str", "",
+         "jax platform a worker must initialize (env contract with the "
+         "session)", "utils.devicepolicy"),
+    Knob("TPU_ML_WORKER_PROBE", "flag", "",
+         "`1`: workers run a bounded-time device probe at startup",
+         "utils.devicepolicy"),
+    Knob("TPU_ML_WORKER_PROBE_TIMEOUT", "float", "60.0",
+         "seconds the worker device probe may take before failing",
+         "utils.devicepolicy"),
+    Knob("TPU_ML_WORKER_SCRUB_VARS", "str", "",
+         "extra comma-separated env vars scrubbed from cpu-policy worker "
+         "environments", "utils.devicepolicy"),
+    # -- bench / perf ledger ------------------------------------------------
+    Knob("TPU_ML_PERF_LEDGER_PATH", "path", "PERF_LEDGER.jsonl",
+         "persistent perf ledger bench runs append to (empty disables)",
+         "bench.py"),
+    Knob("TPU_ML_PERF_SENTINEL", "flag", "",
+         "`1`: bench runs tools/perf_sentinel.py --strict after appending "
+         "the ledger entry", "bench.py"),
+    Knob("TPU_ML_BENCH_PROBE_WINDOW_S", "float", "3600",
+         "window the bench preamble waits for a healthy device transport",
+         "bench.py"),
+    Knob("TPU_ML_BENCH_PROBE_TIMEOUT", "float", "120",
+         "per-attempt timeout of the bench device probe", "bench.py"),
+    Knob("TPU_ML_OPPORTUNISTIC_MAX_AGE_S", "float", str(14 * 3600),
+         "max age of an opportunistic bench harvest before it is ignored",
+         "bench.py"),
+    # -- transport monitor (tools/transport_monitor_r5.py) ------------------
+    Knob("TPU_ML_MONITOR_BENCH_OUT", "path", "BENCH_OPPORTUNISTIC_r05.json",
+         "opportunistic bench output file (relative to the repo)",
+         "tools/transport_monitor_r5.py"),
+    Knob("TPU_ML_MONITOR_DRIFT_OUT", "path", "BENCH_DRIFT_r05.jsonl",
+         "transport-monitor drift log (relative to the repo)",
+         "tools/transport_monitor_r5.py"),
+    Knob("TPU_ML_MONITOR_INTERVAL_S", "float", "600",
+         "seconds between transport probes", "tools/transport_monitor_r5.py"),
+    Knob("TPU_ML_MONITOR_PROBE_TIMEOUT_S", "float", "120",
+         "per-probe timeout of the transport monitor",
+         "tools/transport_monitor_r5.py"),
+    Knob("TPU_ML_MONITOR_WINDOW_S", "float", str(11.5 * 3600),
+         "total monitoring window before the monitor gives up",
+         "tools/transport_monitor_r5.py"),
+    Knob("TPU_ML_MONITOR_BENCH_RUNS", "int", "5",
+         "bench repetitions per opportunistic harvest",
+         "tools/transport_monitor_r5.py"),
+    Knob("TPU_ML_MONITOR_BENCH_TIMEOUT_S", "float", "3600",
+         "timeout of one opportunistic bench run",
+         "tools/transport_monitor_r5.py"),
+)
+
+KNOBS: dict[str, Knob] = {k.name: k for k in _DECLARATIONS}
+
+if len(KNOBS) != len(_DECLARATIONS):  # pragma: no cover - declaration bug
+    raise RuntimeError("duplicate TPU_ML_* knob declaration")
+
+# Named handles for consumers that re-export the env-var name locally
+# (keeps call sites grep-able while the literal lives only here).
+MIN_BUCKET = KNOBS["TPU_ML_MIN_BUCKET"]
+MAX_WORKERS = KNOBS["TPU_ML_MAX_WORKERS"]
+TASK_RETRIES = KNOBS["TPU_ML_TASK_RETRIES"]
+DEFAULT_PRECISION = KNOBS["TPU_ML_DEFAULT_PRECISION"]
+STREAM_FIT_MAX_RESIDENT_BYTES = KNOBS["TPU_ML_STREAM_FIT_MAX_RESIDENT_BYTES"]
+COMPILE_CACHE = KNOBS["TPU_ML_COMPILE_CACHE"]
+LOG_LEVEL = KNOBS["TPU_ML_LOG_LEVEL"]
+TELEMETRY_PATH = KNOBS["TPU_ML_TELEMETRY_PATH"]
+TIMELINE_PATH = KNOBS["TPU_ML_TIMELINE_PATH"]
+TIMELINE_EVENTS = KNOBS["TPU_ML_TIMELINE_EVENTS"]
+PROGRESS = KNOBS["TPU_ML_PROGRESS"]
+PEAK_TFLOPS = KNOBS["TPU_ML_PEAK_TFLOPS"]
+RETRY_MAX_ATTEMPTS = KNOBS["TPU_ML_RETRY_MAX_ATTEMPTS"]
+RETRY_DEADLINE_S = KNOBS["TPU_ML_RETRY_DEADLINE_S"]
+STREAM_CHECKPOINT_EVERY_CHUNKS = KNOBS["TPU_ML_STREAM_CHECKPOINT_EVERY_CHUNKS"]
+FOLD_WAIT_TIMEOUT_S = KNOBS["TPU_ML_FOLD_WAIT_TIMEOUT_S"]
+NONFINITE_POLICY = KNOBS["TPU_ML_NONFINITE_POLICY"]
+FAULT_PLAN = KNOBS["TPU_ML_FAULT_PLAN"]
+MESH_LOCAL_WIRE_DTYPE = KNOBS["TPU_ML_MESH_LOCAL_WIRE_DTYPE"]
+MESH_LOCAL_MAX_BYTES = KNOBS["TPU_ML_MESH_LOCAL_MAX_BYTES"]
+MESH_LOCAL_ARROW_MAX_BYTES = KNOBS["TPU_ML_MESH_LOCAL_ARROW_MAX_BYTES"]
+STREAM_CHUNK_ROWS = KNOBS["TPU_ML_STREAM_CHUNK_ROWS"]
+STREAM_CHUNK_FLOOR = KNOBS["TPU_ML_STREAM_CHUNK_FLOOR"]
+BARRIER_TIMEOUT_S = KNOBS["TPU_ML_BARRIER_TIMEOUT_S"]
+WORKER_PLATFORM = KNOBS["TPU_ML_WORKER_PLATFORM"]
+WORKER_PROBE = KNOBS["TPU_ML_WORKER_PROBE"]
+WORKER_PROBE_TIMEOUT = KNOBS["TPU_ML_WORKER_PROBE_TIMEOUT"]
+WORKER_SCRUB_VARS = KNOBS["TPU_ML_WORKER_SCRUB_VARS"]
+PERF_LEDGER_PATH = KNOBS["TPU_ML_PERF_LEDGER_PATH"]
+PERF_SENTINEL = KNOBS["TPU_ML_PERF_SENTINEL"]
+BENCH_PROBE_WINDOW_S = KNOBS["TPU_ML_BENCH_PROBE_WINDOW_S"]
+BENCH_PROBE_TIMEOUT = KNOBS["TPU_ML_BENCH_PROBE_TIMEOUT"]
+OPPORTUNISTIC_MAX_AGE_S = KNOBS["TPU_ML_OPPORTUNISTIC_MAX_AGE_S"]
+MONITOR_BENCH_OUT = KNOBS["TPU_ML_MONITOR_BENCH_OUT"]
+MONITOR_DRIFT_OUT = KNOBS["TPU_ML_MONITOR_DRIFT_OUT"]
+MONITOR_INTERVAL_S = KNOBS["TPU_ML_MONITOR_INTERVAL_S"]
+MONITOR_PROBE_TIMEOUT_S = KNOBS["TPU_ML_MONITOR_PROBE_TIMEOUT_S"]
+MONITOR_WINDOW_S = KNOBS["TPU_ML_MONITOR_WINDOW_S"]
+MONITOR_BENCH_RUNS = KNOBS["TPU_ML_MONITOR_BENCH_RUNS"]
+MONITOR_BENCH_TIMEOUT_S = KNOBS["TPU_ML_MONITOR_BENCH_TIMEOUT_S"]
+
+
+def markdown_table() -> str:
+    """The README knob table, generated (see tools/tpulint.py
+    --list-knobs --markdown and the --check-readme drift gate)."""
+    lines = [
+        "| knob | type | default | meaning | read by |",
+        "|------|------|---------|---------|---------|",
+    ]
+    for k in _DECLARATIONS:
+        default = f"`{k.default}`" if k.default else "unset"
+        lines.append(
+            f"| `{k.name}` | {k.type} | {default} | {k.doc} | `{k.module}` |"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,788 @@
+"""tpulint: per-rule fixtures, suppressions, baseline, and the meta-test.
+
+Every rule gets (a) a minimal true-positive snippet that MUST fire and
+(b) a nearby false-positive pattern — the idiom the codebase actually
+uses — that MUST stay clean. The meta-test then lints the live package
+with the checked-in baseline, which is exactly what CI's strict run does:
+these tests failing and CI failing are the same event.
+
+Pure stdlib on purpose (no jax import): the lint layer must work in
+jax-free checkouts, so its tests prove that property by existing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from spark_rapids_ml_tpu.analysis.engine import (
+    Baseline,
+    Finding,
+    LintedModule,
+    lint_paths,
+    lint_source,
+)
+from spark_rapids_ml_tpu.analysis import rules as R
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fire(source: str, rule, relpath: str = "pkg/mod.py") -> list:
+    """Unsuppressed findings of one rule on a dedented snippet."""
+    found = lint_source(textwrap.dedent(source), relpath, [rule])
+    return [f for f in found if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# TPL001 donated-carry
+
+
+class TestDonatedCarry:
+    def test_undonated_carry_fires(self):
+        src = """
+            import jax
+            def step(carry, x):
+                return carry + x
+            prog = jax.jit(step)
+        """
+        found = fire(src, R.DonatedCarryRule())
+        assert len(found) == 1
+        assert "carry" in found[0].message
+        assert found[0].rule == "TPL001"
+
+    def test_positional_index_named(self):
+        src = """
+            import jax
+            def run(x, w, centers0, budget):
+                return centers0
+            prog = jax.jit(run, donate_argnums=1)
+        """
+        (f,) = fire(src, R.DonatedCarryRule())
+        assert "arg 2" in f.message
+
+    def test_donated_carry_clean(self):
+        src = """
+            import jax
+            def step(carry, x):
+                return carry + x
+            prog = jax.jit(step, donate_argnums=0)
+        """
+        assert fire(src, R.DonatedCarryRule()) == []
+
+    def test_donate_argnames_clean(self):
+        src = """
+            import jax
+            def step(carry, x):
+                return carry + x
+            prog = jax.jit(step, donate_argnames=("carry",))
+        """
+        assert fire(src, R.DonatedCarryRule()) == []
+
+    def test_decorated_def_fires(self):
+        src = """
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnums=(1,))
+            def fold(acc, n):
+                return acc + n
+        """
+        (f,) = fire(src, R.DonatedCarryRule())
+        assert "acc" in f.message
+
+    def test_no_carry_param_clean(self):
+        src = """
+            import jax
+            def kernel(x, y):
+                return x @ y
+            prog = jax.jit(kernel)
+        """
+        assert fire(src, R.DonatedCarryRule()) == []
+
+    def test_same_name_other_scope_not_confused(self):
+        # two defs named `run`; the jit call must resolve to ITS `run`
+        src = """
+            import jax
+            def make_a():
+                def run(x, w, centers0, budget):
+                    return centers0
+                return jax.jit(run, donate_argnums=2)
+            def make_b():
+                def run(x, key):
+                    return x
+                return jax.jit(run)
+        """
+        assert fire(src, R.DonatedCarryRule()) == []
+
+
+# ---------------------------------------------------------------------------
+# TPL002 host-sync
+
+
+class TestHostSync:
+    def test_float_in_jitted_fires(self):
+        src = """
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x)
+        """
+        (f,) = fire(src, R.HostSyncRule())
+        assert f.rule == "TPL002"
+
+    def test_item_in_jit_target_fires(self):
+        src = """
+            import jax
+            def g(x):
+                return x.item()
+            prog = jax.jit(g)
+        """
+        (f,) = fire(src, R.HostSyncRule())
+        assert ".item()" in f.message
+
+    def test_shape_read_clean(self):
+        src = """
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x.shape[0]) + float(len(x))
+        """
+        assert fire(src, R.HostSyncRule()) == []
+
+    def test_np_asarray_in_traced_fires(self):
+        src = """
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                return np.asarray(x)
+        """
+        (f,) = fire(src, R.HostSyncRule())
+        assert "jnp" in f.message
+
+    def test_ops_module_methods_flagged_everywhere(self):
+        src = """
+            def helper(x):
+                x.block_until_ready()
+        """
+        found = fire(src, R.HostSyncRule(), "spark_rapids_ml_tpu/ops/foo.py")
+        assert len(found) == 1
+
+    def test_telemetry_exempt(self):
+        src = """
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x)
+        """
+        found = fire(
+            src, R.HostSyncRule(), "spark_rapids_ml_tpu/telemetry/foo.py"
+        )
+        assert found == []
+
+    def test_untraced_host_code_clean(self):
+        src = """
+            import numpy as np
+            def host_path(x):
+                return float(np.asarray(x).sum())
+        """
+        assert fire(src, R.HostSyncRule()) == []
+
+
+# ---------------------------------------------------------------------------
+# TPL003 recompile-hazard
+
+
+class TestRecompileHazard:
+    def test_jit_in_loop_fires(self):
+        src = """
+            import jax
+            def f(fn, xs):
+                out = []
+                for x in xs:
+                    out.append(jax.jit(fn)(x))
+                return out
+        """
+        (f,) = fire(src, R.RecompileHazardRule())
+        assert "loop" in f.message
+
+    def test_jit_per_call_fires(self):
+        src = """
+            import jax
+            def transform(fn, x):
+                return jax.jit(fn)(x)
+        """
+        (f,) = fire(src, R.RecompileHazardRule())
+        assert "per call" in f.message
+
+    def test_module_scope_clean(self):
+        src = """
+            import jax
+            def kernel(x):
+                return x * 2
+            _prog = jax.jit(kernel)
+        """
+        assert fire(src, R.RecompileHazardRule()) == []
+
+    def test_lru_cached_factory_clean(self):
+        src = """
+            import jax
+            from functools import lru_cache
+            @lru_cache(maxsize=32)
+            def make_prog(mesh):
+                def fold(c, x):
+                    return c + x
+                return jax.jit(fold, donate_argnums=0)
+        """
+        assert fire(src, R.RecompileHazardRule()) == []
+
+    def test_suppression_comment(self):
+        src = """
+            import jax
+            def build(fn):
+                # hand-rolled once-guard  # tpulint: disable=TPL003
+                return jax.jit(fn)
+        """
+        assert fire(src, R.RecompileHazardRule()) == []
+
+
+# ---------------------------------------------------------------------------
+# TPL004 retry-discipline
+
+
+class TestRetryDiscipline:
+    def test_sleep_in_except_fires(self):
+        src = """
+            import time
+            def fetch(fn):
+                for attempt in range(3):
+                    try:
+                        return fn()
+                    except OSError:
+                        time.sleep(2 ** attempt)
+        """
+        (f,) = fire(src, R.RetryDisciplineRule())
+        assert "call_with_retry" in f.message
+
+    def test_backoff_variable_fires(self):
+        src = """
+            import time
+            def poll(backoff):
+                time.sleep(backoff * 2)
+        """
+        (f,) = fire(src, R.RetryDisciplineRule())
+        assert f.rule == "TPL004"
+
+    def test_plain_sleep_clean(self):
+        src = """
+            import time
+            def heartbeat(interval):
+                time.sleep(interval)
+        """
+        assert fire(src, R.RetryDisciplineRule()) == []
+
+    def test_retry_module_exempt(self):
+        src = """
+            import time
+            def call_with_retry(fn):
+                try:
+                    return fn()
+                except OSError:
+                    time.sleep(1.0)
+        """
+        found = fire(
+            src, R.RetryDisciplineRule(),
+            "spark_rapids_ml_tpu/resilience/retry.py",
+        )
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# TPL005 name-registry
+
+
+def _names_rule():
+    return R.NameRegistryRule(
+        metrics=frozenset({"ingest.rows"}),
+        prefixes=("device.",),
+        spans=frozenset({"fold.dispatch"}),
+        instants=frozenset({"stream.chunk"}),
+        sites=frozenset({"worker.task"}),
+    )
+
+
+class TestNameRegistry:
+    def test_unregistered_metric_fires(self):
+        src = """
+            REGISTRY.counter_inc("ingest.rowz", 5)
+        """
+        (f,) = fire(src, _names_rule())
+        assert "ingest.rowz" in f.message and f.rule == "TPL005"
+
+    def test_registered_names_clean(self):
+        src = """
+            REGISTRY.counter_inc("ingest.rows", 5)
+            REGISTRY.gauge_set("device.hbm_bytes", 1)
+            with trace_range("fold.dispatch"):
+                pass
+            TIMELINE.record_instant("stream.chunk", rows=5)
+        """
+        assert fire(src, _names_rule()) == []
+
+    def test_fault_site_checked(self):
+        src = """
+            from spark_rapids_ml_tpu.resilience import faults
+            faults.inject("worker.taskz")
+        """
+        (f,) = fire(src, _names_rule())
+        assert "fault site" in f.message
+
+    def test_dynamic_name_with_unregistered_prefix_fires(self):
+        src = """
+            def emit(reg, k, v):
+                reg.gauge_set(f"devize.{k}", v)
+        """
+        (f,) = fire(src, _names_rule())
+        assert "prefix" in f.message
+
+    def test_nonliteral_skipped(self):
+        src = """
+            def emit(reg, name, v):
+                reg.counter_inc(name, v)
+        """
+        assert fire(src, _names_rule()) == []
+
+    def test_live_registries_load(self):
+        # the default constructor reads the real declaration modules
+        rule = R.NameRegistryRule()
+        assert "span.seconds" in rule.metrics
+        assert "worker.task" in rule.sites
+
+
+# ---------------------------------------------------------------------------
+# TPL006 knob-inventory
+
+
+class TestKnobInventory:
+    def test_undeclared_knob_fires(self):
+        rule = R.KnobInventoryRule(declared=frozenset({"TPU_ML_KNOWN"}))
+        src = """
+            import os
+            v = os.environ.get("TPU_ML_MYSTERY_KNOB", "1")
+        """
+        (f,) = fire(src, rule)
+        assert "TPU_ML_MYSTERY_KNOB" in f.message and f.rule == "TPL006"
+
+    def test_declared_knob_clean(self):
+        rule = R.KnobInventoryRule(declared=frozenset({"TPU_ML_KNOWN"}))
+        src = """
+            import os
+            v = os.environ.get("TPU_ML_KNOWN", "1")
+        """
+        assert fire(src, rule) == []
+
+    def test_docstring_mention_clean(self):
+        rule = R.KnobInventoryRule(declared=frozenset())
+        src = '''
+            def f():
+                """Reads TPU_ML_SOMETHING from the environment."""
+                return 1
+        '''
+        assert fire(src, rule) == []
+
+    def test_knobs_module_exempt(self):
+        rule = R.KnobInventoryRule(declared=frozenset())
+        src = """
+            NAME = "TPU_ML_NEW_KNOB"
+        """
+        found = fire(
+            src, rule, "spark_rapids_ml_tpu/utils/knobs.py"
+        )
+        assert found == []
+
+    def test_live_inventory_covers_repo_reads(self):
+        from spark_rapids_ml_tpu.utils import knobs
+
+        assert "TPU_ML_MIN_BUCKET" in knobs.KNOBS
+        assert knobs.FAULT_PLAN.name == "TPU_ML_FAULT_PLAN"
+        # every declaration renders into the table
+        table = knobs.markdown_table()
+        for name in knobs.KNOBS:
+            assert name in table
+
+
+# ---------------------------------------------------------------------------
+# TPL007 telemetry-race
+
+
+class TestTelemetryRace:
+    PATH = "spark_rapids_ml_tpu/telemetry/mod.py"
+
+    def test_unlocked_mutation_fires(self):
+        src = """
+            _events = []
+            def record(e):
+                _events.append(e)
+        """
+        (f,) = fire(src, R.TelemetryRaceRule(), self.PATH)
+        assert "_events" in f.message and f.rule == "TPL007"
+
+    def test_locked_mutation_clean(self):
+        src = """
+            import threading
+            _events = []
+            _lock = threading.Lock()
+            def record(e):
+                with _lock:
+                    _events.append(e)
+        """
+        assert fire(src, R.TelemetryRaceRule(), self.PATH) == []
+
+    def test_global_rebind_fires(self):
+        src = """
+            _cache = {}
+            def reset():
+                global _cache
+                _cache = {}
+        """
+        (f,) = fire(src, R.TelemetryRaceRule(), self.PATH)
+        assert "_cache" in f.message
+
+    def test_subscript_store_fires(self):
+        src = """
+            _by_name = {}
+            def put(k, v):
+                _by_name[k] = v
+        """
+        (f,) = fire(src, R.TelemetryRaceRule(), self.PATH)
+        assert "_by_name" in f.message
+
+    def test_outside_scoped_dirs_clean(self):
+        src = """
+            _events = []
+            def record(e):
+                _events.append(e)
+        """
+        assert fire(src, R.TelemetryRaceRule(), "pkg/models/foo.py") == []
+
+    def test_local_mutable_clean(self):
+        src = """
+            def collect(xs):
+                out = []
+                for x in xs:
+                    out.append(x)
+                return out
+        """
+        assert fire(src, R.TelemetryRaceRule(), self.PATH) == []
+
+
+# ---------------------------------------------------------------------------
+# TPL008 swallowed-exception
+
+
+class TestSwallowedException:
+    def test_except_pass_fires(self):
+        src = """
+            def f(fn):
+                try:
+                    fn()
+                except Exception:
+                    pass
+        """
+        (f,) = fire(src, R.SwallowedExceptionRule())
+        assert f.rule == "TPL008"
+
+    def test_bare_except_fires(self):
+        src = """
+            def f(fn):
+                try:
+                    fn()
+                except:
+                    pass
+        """
+        (f,) = fire(src, R.SwallowedExceptionRule())
+        assert "bare except" in f.message
+
+    def test_commented_pass_clean(self):
+        src = """
+            def f(fn):
+                try:
+                    fn()
+                except Exception:
+                    pass  # best-effort cleanup; process exits right after
+        """
+        assert fire(src, R.SwallowedExceptionRule()) == []
+
+    def test_narrow_handler_clean(self):
+        src = """
+            def f(fn):
+                try:
+                    fn()
+                except OSError:
+                    pass
+        """
+        assert fire(src, R.SwallowedExceptionRule()) == []
+
+    def test_handled_broad_clean(self):
+        src = """
+            def f(fn, log):
+                try:
+                    fn()
+                except Exception as e:
+                    log.warning("failed: %s", e)
+        """
+        assert fire(src, R.SwallowedExceptionRule()) == []
+
+
+# ---------------------------------------------------------------------------
+# engine: suppressions, baseline, fingerprints
+
+
+class TestSuppression:
+    SRC = """
+        import jax
+        def step(carry, x):
+            return carry + x
+        prog = jax.jit(step)
+    """
+
+    def test_same_line_suppression(self):
+        src = self.SRC.replace(
+            "prog = jax.jit(step)",
+            "prog = jax.jit(step)  # tpulint: disable=TPL001",
+        )
+        found = lint_source(
+            textwrap.dedent(src), "m.py", [R.DonatedCarryRule()]
+        )
+        assert len(found) == 1 and found[0].suppressed
+
+    def test_preceding_comment_line_suppression(self):
+        src = textwrap.dedent(self.SRC).replace(
+            "prog = jax.jit(step)",
+            "# tpulint: disable=TPL001\nprog = jax.jit(step)",
+        )
+        found = lint_source(src, "m.py", [R.DonatedCarryRule()])
+        assert found[0].suppressed
+
+    def test_disable_all(self):
+        src = self.SRC.replace(
+            "prog = jax.jit(step)",
+            "prog = jax.jit(step)  # tpulint: disable=all",
+        )
+        found = lint_source(
+            textwrap.dedent(src), "m.py", [R.DonatedCarryRule()]
+        )
+        assert found[0].suppressed
+
+    def test_other_rule_not_suppressed(self):
+        src = self.SRC.replace(
+            "prog = jax.jit(step)",
+            "prog = jax.jit(step)  # tpulint: disable=TPL002",
+        )
+        found = lint_source(
+            textwrap.dedent(src), "m.py", [R.DonatedCarryRule()]
+        )
+        assert not found[0].suppressed
+
+
+class TestBaseline:
+    def _finding(self, line=5):
+        return Finding(
+            rule="TPL001", path="a.py", line=line, col=0,
+            message="carry not donated", scope="make",
+        )
+
+    def test_fingerprint_ignores_line_drift(self):
+        assert self._finding(5).fingerprint == self._finding(50).fingerprint
+
+    def test_fingerprint_distinguishes_scope(self):
+        other = self._finding()
+        other.scope = "other_factory"
+        assert other.fingerprint != self._finding().fingerprint
+
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        f = self._finding()
+        Baseline.write(path, [f], notes={f.fingerprint: "why"})
+        loaded = Baseline.load(path)
+        fresh = self._finding(line=99)  # drifted
+        loaded.apply([fresh])
+        assert fresh.baselined and fresh.note == "why"
+        assert loaded.stale([fresh]) == []
+
+    def test_stale_detection(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        Baseline.write(path, [self._finding()])
+        loaded = Baseline.load(path)
+        stale = loaded.stale([])  # the finding was fixed
+        assert len(stale) == 1 and stale[0]["rule"] == "TPL001"
+
+    def test_missing_file_is_empty(self, tmp_path):
+        b = Baseline.load(str(tmp_path / "nope.json"))
+        assert b.entries == {}
+
+
+# ---------------------------------------------------------------------------
+# the meta-test: lint the live package exactly like CI does
+
+
+class TestLivePackage:
+    @pytest.fixture(scope="class")
+    def live(self):
+        paths = [os.path.join(REPO, p)
+                 for p in ("spark_rapids_ml_tpu", "tools", "bench.py")]
+        findings, errors = lint_paths(paths, R.all_rules(), root=REPO)
+        assert errors == [], errors
+        return findings
+
+    def test_repo_is_clean_modulo_baseline(self, live):
+        baseline = Baseline.load(
+            os.path.join(REPO, "tools", "tpulint_baseline.json")
+        )
+        unsuppressed = [f for f in live if not f.suppressed]
+        baseline.apply(unsuppressed)
+        live_findings = [f for f in unsuppressed if not f.baselined]
+        assert live_findings == [], "\n".join(
+            f.render() for f in live_findings
+        )
+        stale = baseline.stale(unsuppressed)
+        assert stale == [], f"stale baseline entries: {stale}"
+
+    def test_every_baseline_entry_has_real_note(self):
+        doc = json.load(
+            open(os.path.join(REPO, "tools", "tpulint_baseline.json"))
+        )
+        for e in doc["entries"]:
+            assert e["note"] and "blessed without note" not in e["note"], (
+                f"baseline entry for {e['path']} lacks a justification"
+            )
+
+    def test_cli_strict_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", "--strict"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_cli_nonzero_on_finding(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import jax
+            def step(carry, x):
+                return carry + x
+            prog = jax.jit(step)
+        """))
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", "--strict",
+             "--baseline", "", str(bad)],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        assert "TPL001" in proc.stdout
+
+    def test_cli_json_output(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\n\ndef f(backoff):\n    time.sleep(backoff)\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", "--json",
+             "--baseline", "", str(bad)],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        doc = json.loads(proc.stdout)
+        assert doc["live"] == 1
+        assert doc["findings"][0]["rule"] == "TPL004"
+        assert doc["findings"][0]["fingerprint"]
+
+    def test_readme_knob_table_in_sync(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", "--check-readme"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_list_knobs_lists_every_declaration(self):
+        from spark_rapids_ml_tpu.utils import knobs
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", "--list-knobs"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0
+        for name in knobs.KNOBS:
+            assert name in proc.stdout
+
+
+def test_each_rule_fixture_fails_strict(tmp_path):
+    """Acceptance: the CLI exits nonzero on a true positive of EVERY rule."""
+    fixtures = {
+        "TPL001": """
+            import jax
+            def step(carry, x):
+                return carry + x
+            prog = jax.jit(step)
+        """,
+        "TPL002": """
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x)
+        """,
+        "TPL003": """
+            import jax
+            def f(fn, xs):
+                return [jax.jit(fn)(x) for x in xs]
+        """,
+        "TPL004": """
+            import time
+            def f(fn):
+                while True:
+                    try:
+                        return fn()
+                    except OSError:
+                        time.sleep(1)
+        """,
+        "TPL005": """
+            def f(reg):
+                reg.counter_inc("not.a.real.metric", 1)
+        """,
+        "TPL006": """
+            import os
+            v = os.environ.get("TPU_ML_NOT_DECLARED_ANYWHERE")
+        """,
+        "TPL008": """
+            def f(fn):
+                try:
+                    fn()
+                except Exception:
+                    pass
+        """,
+    }
+    # TPL007 needs a telemetry/ path, exercised separately below
+    for rule_id, src in fixtures.items():
+        p = tmp_path / f"{rule_id.lower()}.py"
+        p.write_text(textwrap.dedent(src))
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", "--strict",
+             "--baseline", "", str(p)],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode != 0, f"{rule_id} fixture did not fail strict"
+        assert rule_id in proc.stdout, proc.stdout
+
+
+def test_tpl007_fixture_fails_strict(tmp_path):
+    pkg = tmp_path / "telemetry"
+    pkg.mkdir()
+    p = pkg / "mod.py"
+    p.write_text("_events = []\n\ndef record(e):\n    _events.append(e)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--strict",
+         "--baseline", "", str(p)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "TPL007" in proc.stdout

@@ -103,20 +103,33 @@ def main(argv=None) -> int:
             if v:
                 reg.counter_inc(name, v, estimator=est)
         coll = rec.get("collectives") or {}
-        for k in ("count", "bytes", "tree_combines"):
+        for name, k in (
+            ("collective.count", "count"),
+            ("collective.bytes", "bytes"),
+            ("collective.tree_combines", "tree_combines"),
+        ):
             if coll.get(k):
-                reg.counter_inc(f"collective.{k}", coll[k], estimator=est)
+                reg.counter_inc(name, coll[k], estimator=est)
         comp = rec.get("compile") or {}
-        for k in ("count", "cache_hits", "cache_misses", "cache_time_saved_s"):
+        for name, k in (
+            ("compile.count", "count"),
+            ("compile.cache_hits", "cache_hits"),
+            ("compile.cache_misses", "cache_misses"),
+            ("compile.cache_time_saved_s", "cache_time_saved_s"),
+        ):
             if comp.get(k):
-                reg.counter_inc(f"compile.{k}", comp[k], estimator=est)
+                reg.counter_inc(name, comp[k], estimator=est)
         reg.counter_inc("fits", 1, estimator=est)
         reg.histogram_record(
             "fit.wall_seconds", rec.get("wall_seconds", 0.0), estimator=est
         )
-        for k in ("seconds", "trace_seconds", "lower_seconds"):
+        for name, k in (
+            ("compile.seconds", "seconds"),
+            ("compile.trace_seconds", "trace_seconds"),
+            ("compile.lower_seconds", "lower_seconds"),
+        ):
             if comp.get(k):
-                reg.histogram_record(f"compile.{k}", comp[k], estimator=est)
+                reg.histogram_record(name, comp[k], estimator=est)
         _aggregate_cost_model(reg, rec, estimator=est)
         ov = rec.get("overlap_fraction")
         if ov is not None:

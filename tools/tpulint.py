@@ -1,0 +1,193 @@
+"""tpulint CLI — run the project-native JAX/TPU invariant linter.
+
+Usage (from the repo root):
+
+    python -m tools.tpulint                  # lint the default surface
+    python -m tools.tpulint --strict         # CI mode: nonzero on findings
+    python -m tools.tpulint --json           # machine-readable findings
+    python -m tools.tpulint --bless          # grandfather current findings
+    python -m tools.tpulint --list-rules     # rule IDs + docs
+    python -m tools.tpulint --list-knobs     # TPU_ML_* inventory
+    python -m tools.tpulint --list-knobs --markdown   # README table body
+    python -m tools.tpulint --check-readme   # README knob-table drift gate
+
+Default lint surface: the package, tools/, and bench.py (tests/ hold rule
+fixtures on purpose and are linted only by their own meta-test). Exit code
+0 means clean (suppressed/baselined findings do not count); with
+``--strict``, stale baseline entries and unparseable files also fail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_rapids_ml_tpu.analysis.engine import Baseline, lint_paths
+from spark_rapids_ml_tpu.analysis.rules import ALL_RULES
+from spark_rapids_ml_tpu.utils import knobs
+
+DEFAULT_PATHS = ("spark_rapids_ml_tpu", "tools", "bench.py")
+DEFAULT_BASELINE = os.path.join("tools", "tpulint_baseline.json")
+
+README_BEGIN = "<!-- tpulint:knob-table:begin -->"
+README_END = "<!-- tpulint:knob-table:end -->"
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _list_rules() -> str:
+    out = []
+    for r in ALL_RULES:
+        out.append(f"{r.id} ({r.name})")
+        out.append(f"    {r.doc}")
+    return "\n".join(out)
+
+
+def _list_knobs(markdown: bool) -> str:
+    if markdown:
+        return knobs.markdown_table()
+    out = []
+    for k in knobs.KNOBS.values():
+        default = k.default if k.default else "<unset>"
+        out.append(f"{k.name}  [{k.type}, default {default}]  ({k.module})")
+        out.append(f"    {k.doc}")
+    return "\n".join(out)
+
+
+def _check_readme(root: str) -> int:
+    """0 iff the README's generated knob table matches the declarations."""
+    path = os.path.join(root, "README.md")
+    with open(path, encoding="utf-8") as f:
+        readme = f.read()
+    try:
+        head, rest = readme.split(README_BEGIN, 1)
+        table, _ = rest.split(README_END, 1)
+    except ValueError:
+        print(
+            f"README.md: missing {README_BEGIN}/{README_END} markers",
+            file=sys.stderr,
+        )
+        return 1
+    if table.strip() != knobs.markdown_table().strip():
+        print(
+            "README.md knob table is stale — regenerate the block between "
+            "the tpulint:knob-table markers with:\n"
+            "    python -m tools.tpulint --list-knobs --markdown",
+            file=sys.stderr,
+        )
+        return 1
+    print("README.md knob table matches utils.knobs declarations")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint "
+                    f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON document")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on live findings, stale baseline "
+                    "entries, or unparseable files (the CI gate)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path ('' disables)")
+    ap.add_argument("--bless", action="store_true",
+                    help="write all current live findings into the "
+                    "baseline (existing notes survive; new entries get a "
+                    "placeholder note to fill in)")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print baselined/suppressed findings")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule IDs and docs, then exit")
+    ap.add_argument("--list-knobs", action="store_true",
+                    help="print the declared TPU_ML_* knob inventory")
+    ap.add_argument("--markdown", action="store_true",
+                    help="with --list-knobs: emit the README markdown table")
+    ap.add_argument("--check-readme", action="store_true",
+                    help="verify the README knob table matches the "
+                    "declarations (drift gate)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if args.list_knobs:
+        print(_list_knobs(args.markdown))
+        return 0
+
+    root = _repo_root()
+    if args.check_readme:
+        return _check_readme(root)
+
+    paths = args.paths or [os.path.join(root, p) for p in DEFAULT_PATHS]
+    findings, errors = lint_paths(paths, ALL_RULES, root=root)
+
+    baseline_path = (
+        os.path.join(root, args.baseline) if args.baseline
+        and not os.path.isabs(args.baseline) else args.baseline
+    )
+    baseline = Baseline.load(baseline_path) if baseline_path else Baseline()
+    unsuppressed = [f for f in findings if not f.suppressed]
+    baseline.apply(unsuppressed)
+    live = [f for f in unsuppressed if not f.baselined]
+    stale = baseline.stale(unsuppressed)
+
+    if args.bless:
+        if not baseline_path:
+            print("--bless needs a --baseline path", file=sys.stderr)
+            return 2
+        n = Baseline.write(baseline_path, unsuppressed)
+        print(f"blessed {n} finding(s) into {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    if args.as_json:
+        doc = {
+            "findings": [f.to_dict() for f in findings],
+            "live": len(live),
+            "baselined": sum(1 for f in findings if f.baselined),
+            "suppressed": sum(1 for f in findings if f.suppressed),
+            "stale_baseline": stale,
+            "errors": errors,
+        }
+        print(json.dumps(doc, indent=2))
+    else:
+        shown = findings if args.show_baselined else live
+        for f in shown:
+            print(f.render())
+        for e in errors:
+            print(f"error: {e}", file=sys.stderr)
+        for s in stale:
+            print(
+                f"stale baseline entry (finding no longer fires — remove "
+                f"it): {s['rule']} {s['path']} {s['message']!r}",
+                file=sys.stderr,
+            )
+        counts = (
+            f"{len(live)} live finding(s), "
+            f"{sum(1 for f in findings if f.baselined)} baselined, "
+            f"{sum(1 for f in findings if f.suppressed)} suppressed"
+        )
+        print(counts if shown or stale or errors else f"clean — {counts}")
+
+    if live:
+        return 1
+    if args.strict and (stale or errors):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `tpulint --list-rules | head`
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -149,7 +149,9 @@ def _recompile_storm(rec: dict) -> str | None:
             f"recompile storm: {count:g} backend compiles for "
             f"{len(kernels)} distinct cost-model kernel(s) — the same "
             "logical kernels are recompiling per input shape (check "
-            "TPU_ML_MIN_BUCKET row-bucketing and TPU_ML_COMPILE_CACHE)"
+            "TPU_ML_MIN_BUCKET row-bucketing and TPU_ML_COMPILE_CACHE; "
+            "if a code path builds jax.jit programs per call, "
+            "`python -m tools.tpulint` rule TPL003 finds it statically)"
         )
     return None
 

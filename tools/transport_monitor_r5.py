@@ -36,7 +36,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from spark_rapids_ml_tpu.utils import devicepolicy  # noqa: E402
+from spark_rapids_ml_tpu.utils import devicepolicy, knobs  # noqa: E402
 
 LOG_PATH = os.path.join(REPO, "TRANSPORT_LOG_r05.jsonl")
 # Output names are env-overridable so a SUPPLEMENTAL harvest instance can
@@ -45,17 +45,25 @@ LOG_PATH = os.path.join(REPO, "TRANSPORT_LOG_r05.jsonl")
 # _r05b file and the main-loop "already harvested?" check follows it).
 BENCH_OUT = os.path.join(
     REPO,
-    os.environ.get("TPU_ML_MONITOR_BENCH_OUT", "BENCH_OPPORTUNISTIC_r05.json"),
+    os.environ.get(
+        knobs.MONITOR_BENCH_OUT.name, "BENCH_OPPORTUNISTIC_r05.json"
+    ),
 )
 DRIFT_OUT = os.path.join(
-    REPO, os.environ.get("TPU_ML_MONITOR_DRIFT_OUT", "BENCH_DRIFT_r05.jsonl")
+    REPO, os.environ.get(knobs.MONITOR_DRIFT_OUT.name, "BENCH_DRIFT_r05.jsonl")
 )
 
-PROBE_INTERVAL_S = float(os.environ.get("TPU_ML_MONITOR_INTERVAL_S", "600"))
-PROBE_TIMEOUT_S = float(os.environ.get("TPU_ML_MONITOR_PROBE_TIMEOUT_S", "120"))
-ROUND_WINDOW_S = float(os.environ.get("TPU_ML_MONITOR_WINDOW_S", str(11.5 * 3600)))
-N_BENCH_RUNS = int(os.environ.get("TPU_ML_MONITOR_BENCH_RUNS", "5"))
-BENCH_TIMEOUT_S = float(os.environ.get("TPU_ML_MONITOR_BENCH_TIMEOUT_S", "3600"))
+PROBE_INTERVAL_S = float(os.environ.get(knobs.MONITOR_INTERVAL_S.name, "600"))
+PROBE_TIMEOUT_S = float(
+    os.environ.get(knobs.MONITOR_PROBE_TIMEOUT_S.name, "120")
+)
+ROUND_WINDOW_S = float(
+    os.environ.get(knobs.MONITOR_WINDOW_S.name, str(11.5 * 3600))
+)
+N_BENCH_RUNS = int(os.environ.get(knobs.MONITOR_BENCH_RUNS.name, "5"))
+BENCH_TIMEOUT_S = float(
+    os.environ.get(knobs.MONITOR_BENCH_TIMEOUT_S.name, "3600")
+)
 
 START = time.time()
 
@@ -76,7 +84,7 @@ def run_bench(run_idx: int) -> dict:
     env = dict(os.environ)
     # The monitor just proved the transport healthy; the bench's own
     # preamble only needs a short re-confirmation window.
-    env["TPU_ML_BENCH_PROBE_WINDOW_S"] = "300"
+    env[knobs.BENCH_PROBE_WINDOW_S.name] = "300"
     start = time.time()
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "bench.py")],
