@@ -1,0 +1,70 @@
+"""Measurement-driven autotuning for the streamed-fit hot path.
+
+Closes the loop the perf ledger opened: instead of hand-guessing
+``TPU_ML_STREAM_CHUNK_ROWS``, staging layout, and compute precision, the
+tuner measures candidates (:mod:`.search`, bounded successive halving),
+remembers winners per (kernel, shape bucket, dtype, device) in a blessable
+JSON cache (:mod:`.cache`), and stamps every resolution onto the FitReport
+so tuned runs are self-describing. Mixed-precision kernel policies
+(:mod:`.policy`) ride the same TuningConfig: bf16 operands with f32
+accumulators, and an opt-in int8 distance path for candidate scoring —
+accumulator dtypes never change, so donation and checkpoint/resume
+semantics are preserved under every policy.
+
+Modes (``TPU_ML_AUTOTUNE``): ``off`` (static knobs, seed behavior),
+``cache`` (default — consult blessed winners, never search), ``search``
+(additionally search unseen shape buckets on first fit). Offline tuning:
+``python tools/autotune.py``.
+"""
+
+from spark_rapids_ml_tpu.autotune import cache, policy, search
+from spark_rapids_ml_tpu.autotune.cache import (
+    cache_key,
+    decision_seq,
+    decisions_since,
+    reset,
+    shape_bucket,
+)
+from spark_rapids_ml_tpu.autotune.policy import (
+    FOLD_POLICIES,
+    LAYOUTS,
+    POLICIES,
+    PrecisionPolicy,
+    TuningConfig,
+    resolve_policy,
+    validate_policy,
+)
+from spark_rapids_ml_tpu.autotune.search import (
+    MODES,
+    candidate_grid,
+    mode,
+    resolve,
+    stream_fold_measure,
+    successive_halving,
+    trial_budget,
+)
+
+__all__ = [
+    "cache",
+    "policy",
+    "search",
+    "cache_key",
+    "decision_seq",
+    "decisions_since",
+    "reset",
+    "shape_bucket",
+    "FOLD_POLICIES",
+    "LAYOUTS",
+    "POLICIES",
+    "PrecisionPolicy",
+    "TuningConfig",
+    "resolve_policy",
+    "validate_policy",
+    "MODES",
+    "candidate_grid",
+    "mode",
+    "resolve",
+    "stream_fold_measure",
+    "successive_halving",
+    "trial_budget",
+]

@@ -1,0 +1,211 @@
+"""The persistent tuning cache + per-fit decision journal.
+
+Search winners are remembered per *(kernel signature, shape bucket, wire
+dtype, backend/device kind)* — the same bucketing that bounds distinct
+compiled shapes (``utils.columnar.bucket_rows``) bounds distinct tuning
+entries, so a 100k-row fit and a 120k-row fit of the same width share one
+entry. Two tiers:
+
+- **in-process** — every stored winner lands in a lock-guarded dict, so a
+  repeat fit in the same process is a pure cache hit (zero search trials).
+- **persistent JSON** at ``TPU_ML_TUNING_CACHE_PATH`` (empty = in-process
+  only) — the *blessed* tier, written by ``tools/autotune.py`` (or any
+  in-process search when the knob points at a file) and loaded lazily on
+  first lookup. The blessing workflow mirrors the perf-sentinel one:
+  search → inspect → ``--bless`` writes the file that CI and production
+  fits then consult read-only (``TPU_ML_AUTOTUNE=cache``).
+
+Every lookup books ``autotune.cache_hits`` / ``autotune.cache_misses``;
+every resolution (hit, searched winner, or fallback to the static knobs)
+is appended to a bounded decision journal that ``telemetry.report``
+drains into the FitReport ``tuning`` field — the report shows *which*
+config a fit actually ran with, not which one was configured.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+from spark_rapids_ml_tpu.autotune.policy import TuningConfig
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
+from spark_rapids_ml_tpu.utils import knobs
+
+logger = logging.getLogger("spark_rapids_ml_tpu")
+
+TUNING_CACHE_PATH_VAR = knobs.TUNING_CACHE_PATH.name
+
+CACHE_SCHEMA = 1
+
+# decision journal ring bound — aggregate truth stays in the counters
+MAX_JOURNAL_EVENTS = 256
+
+_LOCK = threading.Lock()
+_CACHE: dict[str, dict] = {}  # key -> {"config": {...}, ...provenance}
+_LOADED_PATH: str | None = None  # which file the persistent tier came from
+_JOURNAL: list[tuple[int, dict]] = []  # (seq, decision dict)
+_SEQ = 0
+
+
+def cache_path() -> str:
+    """The persistent-cache location ('' = in-process only)."""
+    return os.environ.get(TUNING_CACHE_PATH_VAR, "")
+
+
+def shape_bucket(n: int, rows: int | None) -> str:
+    """Bucket a fit shape: exact width (it keys the compiled programs) ×
+    pow2 row bucket (rows vary run to run; the chunk geometry that wins at
+    100k rows wins at 120k)."""
+    if rows is None or rows <= 0:
+        return f"n{int(n)}/rowsANY"
+    bucket = 1
+    while bucket < rows:
+        bucket <<= 1
+    return f"n{int(n)}/rows{bucket}"
+
+
+def device_kind() -> str:
+    """Backend/device identity of the cache key (lazy jax; 'unknown' when
+    no backend is reachable — entries still key consistently in-process)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return f"{dev.platform}/{dev.device_kind}".replace(" ", "_")
+    except Exception:  # noqa: BLE001 — cache must work without a backend
+        return "unknown"
+
+
+def cache_key(kernel: str, *, n: int, rows: int | None = None,
+              dtype=None, device: str | None = None) -> str:
+    """The full cache key: kernel signature, shape bucket, dtype, device."""
+    dt = str(dtype) if dtype is not None else "any"
+    dev = device if device is not None else device_kind()
+    return f"{kernel}|{shape_bucket(n, rows)}|{dt}|{dev}"
+
+
+def _ensure_loaded() -> None:
+    """Lazily merge the persistent tier under ``_LOCK`` (held by caller).
+
+    In-process entries win over file entries: a search that just ran in
+    this process is fresher than the blessed file it may not have written.
+    """
+    global _LOADED_PATH
+    path = cache_path()
+    if path == _LOADED_PATH:
+        return
+    _LOADED_PATH = path
+    if not path or not os.path.exists(path):
+        return
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        entries = doc.get("entries", {}) if isinstance(doc, dict) else {}
+        for key, entry in entries.items():
+            if key not in _CACHE and isinstance(entry, dict):
+                _CACHE[key] = dict(entry)
+    except (OSError, ValueError):
+        logger.warning("unreadable tuning cache at %s — ignoring", path,
+                       exc_info=True)
+
+
+def lookup(key: str) -> TuningConfig | None:
+    """Consult the cache; books the hit/miss counters."""
+    with _LOCK:
+        _ensure_loaded()
+        entry = _CACHE.get(key)
+    if entry is None:
+        REGISTRY.counter_inc("autotune.cache_misses")
+        return None
+    REGISTRY.counter_inc("autotune.cache_hits")
+    try:
+        return TuningConfig.from_dict(entry.get("config", {}))
+    except (TypeError, ValueError):
+        logger.warning("malformed tuning-cache entry for %s — ignoring", key)
+        return None
+
+
+def store(key: str, config: TuningConfig, *, measured_s: float | None = None,
+          trials: int | None = None, persist: bool = True) -> None:
+    """Remember a winner; rewrites the persistent file when a path is set."""
+    entry: dict = {"config": config.to_dict()}
+    if measured_s is not None:
+        entry["measured_s"] = float(measured_s)
+    if trials is not None:
+        entry["trials"] = int(trials)
+    with _LOCK:
+        _ensure_loaded()
+        _CACHE[key] = entry
+        snapshot = {k: dict(v) for k, v in _CACHE.items()}
+    if persist and cache_path():
+        write_cache(cache_path(), snapshot)
+
+
+def entries() -> dict[str, dict]:
+    """Copy of the merged cache (CLI ``--show``, tests)."""
+    with _LOCK:
+        _ensure_loaded()
+        return {k: dict(v) for k, v in _CACHE.items()}
+
+
+def write_cache(path: str, cache_entries: dict[str, dict]) -> None:
+    """Write the blessed persistent tier (atomic replace, sorted keys)."""
+    doc = {
+        "type": "tuning_cache",
+        "schema": CACHE_SCHEMA,
+        "entries": {k: cache_entries[k] for k in sorted(cache_entries)},
+    }
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def reset() -> None:
+    """Forget the in-process tier, journal, and file-load state (tests,
+    bench slope reps)."""
+    global _LOADED_PATH, _SEQ
+    with _LOCK:
+        _CACHE.clear()
+        _JOURNAL.clear()
+        _LOADED_PATH = None
+        _SEQ = 0
+
+
+# -- decision journal (drained into FitReport.tuning by telemetry.report) --
+
+
+def record_decision(*, kernel: str, key: str, source: str,
+                    config: TuningConfig | None) -> dict:
+    """Journal one tuner resolution. ``source`` is ``cache`` (hit),
+    ``search`` (fresh winner), or ``default`` (miss → static knobs)."""
+    decision = {
+        "kernel": kernel,
+        "key": key,
+        "source": source,
+        "cache_hit": source == "cache",
+        "config": config.to_dict() if config is not None else None,
+    }
+    global _SEQ
+    with _LOCK:
+        _SEQ += 1
+        _JOURNAL.append((_SEQ, decision))
+        del _JOURNAL[:-MAX_JOURNAL_EVENTS]
+    TIMELINE.record_instant("autotune.decision", kernel=kernel, source=source)
+    return decision
+
+
+def decision_seq() -> int:
+    """Current journal watermark (``begin_fit`` captures this)."""
+    with _LOCK:
+        return _SEQ
+
+
+def decisions_since(seq: int) -> list[dict]:
+    """Decisions journaled after ``seq`` (``end_fit`` drains these)."""
+    with _LOCK:
+        return [dict(d) for s, d in _JOURNAL if s > seq]

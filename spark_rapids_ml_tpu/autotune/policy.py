@@ -1,0 +1,140 @@
+"""Precision policies and tuning configurations — the *vocabulary* of the
+autotuner.
+
+A :class:`PrecisionPolicy` names how a kernel's matmuls treat operand and
+accumulator dtypes; a :class:`TuningConfig` bundles everything the tuner may
+vary for one kernel signature (chunk geometry, staging layout, precision
+policy, donation arrangement). Both are plain data: the numeric behavior
+lives in the ops kernels, which accept ``policy=`` and branch on the policy
+string, and the search/caching machinery (:mod:`.search`, :mod:`.cache`)
+only ever moves these objects around.
+
+The invariant every policy must preserve: **accumulators stay in the carry
+dtype** (f32/f64). ``bf16_f32acc`` casts only the matmul *operands* to
+bfloat16 and forces the MXU to accumulate in f32 via
+``preferred_element_type``; ``int8_dist`` quantizes only the distance cross
+term of kmeans/knn candidate scoring. The donated-carry fold contract
+(tpulint TPL001) and bitwise checkpoint/resume semantics therefore hold
+under every policy — a checkpoint written under ``bf16_f32acc`` resumes
+bitwise-identically because the carry never changes dtype.
+
+Import-pure apart from :mod:`utils.knobs` (no jax) so the linter, the CLI,
+and jax-free worker processes can load it.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass
+
+from spark_rapids_ml_tpu.utils import knobs
+
+PRECISION_POLICY_VAR = knobs.PRECISION_POLICY.name
+
+
+class PrecisionPolicy(str, enum.Enum):
+    """Named mixed-precision kernel policies.
+
+    - ``F32`` — full-precision operands (the matmul ``precision`` knob still
+      applies); the seed behavior and the default everywhere.
+    - ``BF16_F32ACC`` — matmul operands cast to bfloat16, accumulation
+      forced to f32 with ``preferred_element_type``; the result is upcast
+      back into the carry dtype. Roughly halves MXU operand bytes (bf16
+      tile (16, 128) vs f32 (8, 128)) at ~3 decimal digits of operand
+      mantissa.
+    - ``INT8_DIST`` — opt-in symmetric int8 quantization of the *distance
+      cross term only* (kmeans / knn candidate scoring): int8×int8 matmul
+      accumulated in int32, dequantized against f32 norms. Never used for
+      Gram/linear accumulation.
+    """
+
+    F32 = "f32"
+    BF16_F32ACC = "bf16_f32acc"
+    INT8_DIST = "int8_dist"
+
+
+POLICIES: tuple[str, ...] = tuple(p.value for p in PrecisionPolicy)
+
+#: Policies meaningful for accumulation kernels (Gram/moment/linear folds);
+#: ``int8_dist`` applies only to distance scoring and is rejected there.
+FOLD_POLICIES: tuple[str, ...] = (
+    PrecisionPolicy.F32.value,
+    PrecisionPolicy.BF16_F32ACC.value,
+)
+
+LAYOUTS: tuple[str, ...] = ("row", "col")
+
+
+def validate_policy(policy: str, *, allowed: tuple[str, ...] = POLICIES) -> str:
+    """Canonicalize ``policy`` (str or :class:`PrecisionPolicy`) or raise."""
+    value = policy.value if isinstance(policy, PrecisionPolicy) else policy
+    if value not in allowed:
+        raise ValueError(
+            f"precision policy {value!r} must be one of {allowed}"
+        )
+    return value
+
+
+def resolve_policy(policy: str | None,
+                   *, allowed: tuple[str, ...] = POLICIES) -> str:
+    """Resolve an explicit policy, or ``None`` → the process default from
+    ``TPU_ML_PRECISION_POLICY`` (default ``f32``).
+
+    Resolution happens *before* any ``lru_cache``'d program builder sees the
+    value, so an env change between calls selects a different cached
+    program instead of a stale one.
+    """
+    if policy is None:
+        policy = os.environ.get(PRECISION_POLICY_VAR, PrecisionPolicy.F32.value)
+    return validate_policy(policy, allowed=allowed)
+
+
+@dataclass(frozen=True)
+class TuningConfig:
+    """One point in the tuner's search space for one kernel signature.
+
+    ``chunk_rows=None`` means "keep the static knob" — a config that only
+    pins layout/policy. ``donate_carry`` records the donation arrangement
+    for the ledger; every shipped fold donates (TPL001), so search grids
+    only emit ``True``, but the field keeps tuned ledger entries
+    self-describing.
+    """
+
+    chunk_rows: int | None = None
+    layout: str = "row"
+    policy: str = PrecisionPolicy.F32.value
+    donate_carry: bool = True
+
+    def __post_init__(self) -> None:
+        if self.layout not in LAYOUTS:
+            raise ValueError(f"layout {self.layout!r} must be one of {LAYOUTS}")
+        validate_policy(self.policy)
+        if self.chunk_rows is not None and self.chunk_rows < 1:
+            raise ValueError(f"chunk_rows must be >= 1, got {self.chunk_rows}")
+
+    def to_dict(self) -> dict:
+        return {
+            "chunk_rows": self.chunk_rows,
+            "layout": self.layout,
+            "policy": self.policy,
+            "donate_carry": self.donate_carry,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TuningConfig":
+        return cls(
+            chunk_rows=d.get("chunk_rows"),
+            layout=d.get("layout", "row"),
+            policy=d.get("policy", PrecisionPolicy.F32.value),
+            donate_carry=bool(d.get("donate_carry", True)),
+        )
+
+    def key(self) -> str:
+        """Stable compact identity — ledger stamping and sentinel keying."""
+        chunk = "knob" if self.chunk_rows is None else str(self.chunk_rows)
+        donate = "1" if self.donate_carry else "0"
+        return (
+            f"chunk={chunk}|layout={self.layout}|policy={self.policy}"
+            f"|donate={donate}"
+        )

@@ -1,0 +1,234 @@
+"""Bounded measurement-driven search over tuning candidates.
+
+Successive halving: every surviving candidate gets one timing trial per
+round, the slower half is dropped, and rounds repeat until one candidate
+survives or the trial budget (``TPU_ML_AUTOTUNE_TRIALS``) is spent — the
+best mean among survivors wins. Timing reuses the existing ledger
+machinery: each trial is a ``monotonic()`` wall measurement around the
+caller-supplied ``measure(config)`` callable (which dispatches the real
+jitted fold, so XLA's per-signature cost model is captured as a side
+effect), wrapped in an ``autotune.trial`` span and a fault-injection gate
+so chaos plans can kill individual trials.
+
+A trial that raises drops *that candidate only* (``autotune.trial_failures``
+counter); if every candidate dies the search returns ``None`` and the
+caller falls back to the static knobs — a failed search never poisons the
+cache.
+
+:func:`resolve` is the one entry point the hot paths call: cache consult
+(``TPU_ML_AUTOTUNE=cache``, the default), opportunistic search on an
+unseen shape bucket (``search``), or nothing at all (``off``). Every
+resolution is journaled for the FitReport ``tuning`` stamp.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from spark_rapids_ml_tpu.autotune import cache
+from spark_rapids_ml_tpu.autotune.policy import TuningConfig
+from spark_rapids_ml_tpu.resilience import faults
+from spark_rapids_ml_tpu.resilience.sites import AUTOTUNE_TRIAL
+from spark_rapids_ml_tpu.telemetry import trace_range
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils import knobs
+
+logger = logging.getLogger("spark_rapids_ml_tpu")
+
+AUTOTUNE_VAR = knobs.AUTOTUNE.name
+AUTOTUNE_TRIALS_VAR = knobs.AUTOTUNE_TRIALS.name
+
+MODES = ("off", "cache", "search")
+DEFAULT_MODE = "cache"
+DEFAULT_TRIALS = 9
+
+
+def mode() -> str:
+    """Tuner mode from ``TPU_ML_AUTOTUNE`` (unknown values → default)."""
+    m = os.environ.get(AUTOTUNE_VAR, DEFAULT_MODE)
+    if m not in MODES:
+        logger.warning("%s=%r is not one of %s — using %r",
+                       AUTOTUNE_VAR, m, MODES, DEFAULT_MODE)
+        return DEFAULT_MODE
+    return m
+
+
+def trial_budget() -> int:
+    """Total timing-trial budget for one search (min 1)."""
+    try:
+        return max(1, int(os.environ.get(AUTOTUNE_TRIALS_VAR,
+                                         DEFAULT_TRIALS)))
+    except ValueError:
+        return DEFAULT_TRIALS
+
+
+def candidate_grid(base_chunk_rows: int, *, floor: int = 8,
+                   policy: str = "f32",
+                   layouts: tuple[str, ...] = ("row", "col"),
+                   ) -> list[TuningConfig]:
+    """The default streamed-fold candidate grid: chunk rows at {½×, 1×, 2×}
+    the static base × staging layouts, all donated (TPL001). The policy is
+    *not* searched — silently trading accuracy for speed is the user's
+    call, so it rides in from the resolved global policy unchanged."""
+    sizes: list[int] = []
+    for mult in (0.5, 1.0, 2.0):
+        rows = max(floor, int(base_chunk_rows * mult))
+        if rows not in sizes:
+            sizes.append(rows)
+    return [
+        TuningConfig(chunk_rows=rows, layout=layout, policy=policy)
+        for rows in sizes
+        for layout in layouts
+    ]
+
+
+def _trial(config: TuningConfig, measure) -> float:
+    """One timing trial: fault gate, span, wall-clock around ``measure``.
+
+    ``measure(config)`` may return its own seconds measurement (injected
+    timings in tests, per-row normalization in real measures); when it
+    returns None the trial's wall time is used.
+    """
+    REGISTRY.counter_inc("autotune.trials")
+    with trace_range("autotune.trial"):
+        faults.inject(AUTOTUNE_TRIAL)
+        t0 = time.monotonic()
+        reported = measure(config)
+        wall = time.monotonic() - t0
+    return float(reported) if reported is not None else wall
+
+
+def successive_halving(candidates, measure, *, budget: int | None = None,
+                       ) -> tuple[TuningConfig | None, int]:
+    """Run the search; returns ``(winner, trials_used)``.
+
+    Deterministic given deterministic timings: candidate order breaks ties,
+    each round measures every survivor once (budget permitting) and keeps
+    the faster half by mean observed seconds.
+    """
+    if budget is None:
+        budget = trial_budget()
+    # (config, [seconds...]) for every candidate still alive
+    alive: list[tuple[TuningConfig, list[float]]] = [
+        (c, []) for c in list(candidates)[:max(1, budget)]
+    ]
+    trials = 0
+    while alive and trials < budget:
+        survivors: list[tuple[TuningConfig, list[float]]] = []
+        for config, seen in alive:
+            if trials >= budget:
+                survivors.append((config, seen))
+                continue
+            trials += 1
+            try:
+                seen.append(_trial(config, measure))
+            except Exception:  # noqa: BLE001 — a dead trial drops only itself
+                REGISTRY.counter_inc("autotune.trial_failures")
+                logger.warning("autotune trial failed for %s — dropping "
+                               "candidate", config.key(), exc_info=True)
+                continue
+            survivors.append((config, seen))
+        alive = survivors
+        if len(alive) <= 1:
+            break
+        measured = [(c, s) for c, s in alive if s]
+        if not measured:
+            break
+        measured.sort(key=lambda cs: sum(cs[1]) / len(cs[1]))
+        keep = max(1, (len(measured) + 1) // 2)
+        if keep == len(measured):
+            break  # field can no longer shrink — winner is decided
+        alive = measured[:keep]
+    scored = [(c, sum(s) / len(s)) for c, s in alive if s]
+    if not scored:
+        return None, trials
+    winner = min(scored, key=lambda cs: cs[1])
+    return winner[0], trials
+
+
+def search(kernel: str, key: str, candidates, measure,
+           *, budget: int | None = None) -> TuningConfig | None:
+    """Full search for one cache key: span, counters, cache store on win."""
+    REGISTRY.counter_inc("autotune.search_runs")
+    with trace_range("autotune.search"):
+        winner, trials = successive_halving(candidates, measure,
+                                            budget=budget)
+    if winner is None:
+        logger.warning("autotune search for %s produced no winner — "
+                       "falling back to static knobs", key)
+        return None
+    cache.store(key, winner, trials=trials)
+    return winner
+
+
+def resolve(kernel: str, *, n: int, rows: int | None = None, dtype=None,
+            measure=None, candidates=None,
+            budget: int | None = None) -> TuningConfig | None:
+    """The hot-path entry point: pick a TuningConfig for ``kernel`` at this
+    shape, or ``None`` meaning "keep the static knobs".
+
+    - mode ``off``: always ``None``, nothing journaled.
+    - mode ``cache``: cache consult only.
+    - mode ``search``: cache consult; on a miss, run the bounded search
+      when the caller supplied ``measure`` + ``candidates``.
+    """
+    m = mode()
+    if m == "off":
+        return None
+    key = cache.cache_key(kernel, n=n, rows=rows, dtype=dtype)
+    config = cache.lookup(key)
+    if config is not None:
+        cache.record_decision(kernel=kernel, key=key, source="cache",
+                              config=config)
+        return config
+    if m == "search" and measure is not None and candidates is not None:
+        config = search(kernel, key, candidates, measure, budget=budget)
+        if config is not None:
+            cache.record_decision(kernel=kernel, key=key, source="search",
+                                  config=config)
+            return config
+    cache.record_decision(kernel=kernel, key=key, source="default",
+                          config=None)
+    return None
+
+
+def stream_fold_measure(fold_fn, carry, n: int, dtype, put,
+                        *, want_y: bool = False, reps: int = 1,
+                        seed: int = 0):
+    """Build a ``measure(config)`` for the streamed-fold hot path.
+
+    Each trial stages one synthetic host chunk at the candidate's geometry
+    (rows × layout), warms the fold once (paying the per-shape compile
+    outside the timed window), then times ``reps`` donated folds into a
+    throwaway zero carry and reports **seconds per row** so different chunk
+    sizes compare fairly. The caller's real carry is never touched — trials
+    donate only their own ``zeros_like`` copy.
+    """
+    import numpy as np  # lazy: keeps this module importable without jax
+
+    def measure(config: TuningConfig) -> float:
+        import jax
+        import jax.numpy as jnp
+
+        rows = max(1, int(config.chunk_rows or 1))
+        order = "F" if config.layout == "col" else "C"
+        rng = np.random.default_rng(seed)
+        x = np.asarray(rng.standard_normal((rows, n)), dtype=dtype,
+                       order=order)
+        args = [put(x)]
+        if want_y:
+            y = np.asarray(rng.standard_normal((rows,)), dtype=dtype)
+            args.append(put(y))
+        args.append(put(np.ones((rows,), dtype=dtype)))
+        trial_carry = jax.tree_util.tree_map(jnp.zeros_like, carry)
+        trial_carry = fold_fn(trial_carry, *args)  # warm (compile)
+        jax.block_until_ready(trial_carry)
+        t0 = time.monotonic()
+        for _ in range(max(1, reps)):
+            trial_carry = fold_fn(trial_carry, *args)
+        jax.block_until_ready(trial_carry)
+        return (time.monotonic() - t0) / (max(1, reps) * rows)
+
+    return measure

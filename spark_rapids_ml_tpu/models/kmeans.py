@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_ml_tpu.autotune.policy import resolve_policy
 from spark_rapids_ml_tpu.models.base import Estimator, Model
 from spark_rapids_ml_tpu.models.params import HasInputCol, HasOutputCol, Param
 from spark_rapids_ml_tpu.ops import kmeans as KM
@@ -285,10 +286,16 @@ class KMeans(_KMeansParams, Estimator):
                 f"the dataset has {n_cols}; is checkpoint_dir stale?"
             )
 
+        # env-selected distance policy (bf16 or int8 cross terms); the
+        # Lloyd accumulators inside kmeans_stats stay full precision
+        dist_policy = resolve_policy(None)
         with trace_range("kmeans lloyd"):
             for it in range(start_iter, self.getMaxIter()):
                 c = jnp.asarray(centers)
-                partials = [KM.kmeans_stats(x, c, w) for x, w in padded]
+                partials = [
+                    KM.kmeans_stats(x, c, w, policy=dist_policy)
+                    for x, w in padded
+                ]
                 stats = tree_reduce(partials, KM.combine_kmeans_stats)
                 new_centers = np.asarray(KM.update_centers(stats, c))
                 cost = float(stats.cost)

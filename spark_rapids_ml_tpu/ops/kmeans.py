@@ -24,7 +24,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from spark_rapids_ml_tpu.ops.linalg import DEFAULT_PRECISION
+from spark_rapids_ml_tpu.autotune.policy import PrecisionPolicy
+from spark_rapids_ml_tpu.ops.linalg import (
+    DEFAULT_PRECISION,
+    DEFAULT_POLICY,
+    int8_quantized_matmul,
+    policy_matmul,
+)
 
 
 class KMeansStats(NamedTuple):
@@ -40,30 +46,42 @@ def combine_kmeans_stats(a: KMeansStats, b: KMeansStats) -> KMeansStats:
 
 
 def pairwise_sq_dists(
-    x: jax.Array, centers: jax.Array, *, precision=DEFAULT_PRECISION
+    x: jax.Array, centers: jax.Array, *, precision=DEFAULT_PRECISION,
+    policy: str = DEFAULT_POLICY,
 ) -> jax.Array:
-    """[rows, k] squared distances via the MXU cross-term expansion."""
+    """[rows, k] squared distances via the MXU cross-term expansion.
+
+    Only the cross term honors the precision ``policy`` (bf16 operands or
+    the opt-in int8 quantized path); the row/center norms stay full
+    precision, so ranking error is bounded by the cross-term quantization
+    alone."""
     x_sq = jnp.sum(x * x, axis=1, keepdims=True)
     c_sq = jnp.sum(centers * centers, axis=1)[None, :]
-    cross = jnp.matmul(x, centers.T, precision=precision)
+    if policy == PrecisionPolicy.INT8_DIST.value:
+        cross = int8_quantized_matmul(x, centers.T)
+    else:
+        cross = policy_matmul(x, centers.T, precision=precision,
+                              policy=policy)
     return jnp.clip(x_sq + c_sq - 2.0 * cross, 0.0, None)
 
 
 def assign_clusters(
-    x: jax.Array, centers: jax.Array, *, precision=DEFAULT_PRECISION
+    x: jax.Array, centers: jax.Array, *, precision=DEFAULT_PRECISION,
+    policy: str = DEFAULT_POLICY,
 ) -> tuple[jax.Array, jax.Array]:
     """(labels [rows], min squared distances [rows])."""
-    d = pairwise_sq_dists(x, centers, precision=precision)
+    d = pairwise_sq_dists(x, centers, precision=precision, policy=policy)
     return jnp.argmin(d, axis=1), jnp.min(d, axis=1)
 
 
-@partial(jax.jit, static_argnames=("block_rows",))
+@partial(jax.jit, static_argnames=("block_rows", "policy"))
 def kmeans_stats(
     x: jax.Array,
     centers: jax.Array,
     weights: jax.Array | None = None,
     *,
     block_rows: int = 8192,
+    policy: str = DEFAULT_POLICY,
 ) -> KMeansStats:
     """One Lloyd accumulation pass over a row shard, scanned in blocks.
 
@@ -85,7 +103,7 @@ def kmeans_stats(
     def step(carry, blk):
         sums, counts, cost = carry
         xi, wi = blk
-        labels, dists = assign_clusters(xi, centers)
+        labels, dists = assign_clusters(xi, centers, policy=policy)
         onehot = (
             labels[:, None] == jnp.arange(k, dtype=labels.dtype)[None, :]
         ).astype(x.dtype) * wi[:, None]
@@ -132,10 +150,14 @@ def kmeans_plus_plus_init(
 
 
 def min_sq_dists(
-    x: jax.Array, centers: jax.Array, *, precision=DEFAULT_PRECISION
+    x: jax.Array, centers: jax.Array, *, precision=DEFAULT_PRECISION,
+    policy: str = DEFAULT_POLICY,
 ) -> jax.Array:
     """[rows] squared distance of each row to its nearest center."""
-    return jnp.min(pairwise_sq_dists(x, centers, precision=precision), axis=1)
+    return jnp.min(
+        pairwise_sq_dists(x, centers, precision=precision, policy=policy),
+        axis=1,
+    )
 
 
 def weighted_kmeans_plus_plus_init(

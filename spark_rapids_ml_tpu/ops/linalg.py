@@ -41,6 +41,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from spark_rapids_ml_tpu.autotune.policy import (
+    FOLD_POLICIES,
+    PrecisionPolicy,
+    resolve_policy,
+)
+
 # Matmul precision for the hot Gram/projection matmuls. HIGHEST on TPU means
 # multi-pass bf16 (6-pass) which recovers ~f32 accuracy on the MXU.
 DEFAULT_PRECISION = lax.Precision.HIGHEST
@@ -52,6 +58,54 @@ PRECISIONS = {
     "high": lax.Precision.HIGH,
     "default": lax.Precision.DEFAULT,
 }
+
+DEFAULT_POLICY = PrecisionPolicy.F32.value
+
+
+def policy_matmul(a: jax.Array, b: jax.Array, *,
+                  precision=DEFAULT_PRECISION,
+                  policy: str = DEFAULT_POLICY) -> jax.Array:
+    """The policy-aware matmul every accumulation kernel funnels through.
+
+    ``f32`` is the seed behavior (the ``precision`` knob applies verbatim).
+    ``bf16_f32acc`` casts the *operands* to bfloat16 and forces f32 MXU
+    accumulation with ``preferred_element_type``, then upcasts the result
+    back to the operand dtype — the downstream add into the f32/f64 carry
+    is exact in the carry dtype, so donation (TPL001) and bitwise
+    checkpoint/resume semantics are untouched; only operand mantissa is
+    traded (bf16 tile (16, 128) halves MXU operand bytes vs f32 (8, 128)).
+    """
+    if policy == PrecisionPolicy.BF16_F32ACC.value:
+        out = jnp.matmul(
+            a.astype(jnp.bfloat16),
+            b.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return out.astype(a.dtype)
+    return jnp.matmul(a, b, precision=precision)
+
+
+def int8_quantized_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Symmetric per-tensor int8 quantized ``a·b`` — the ``int8_dist``
+    policy's cross term for kmeans/knn candidate scoring.
+
+    Max-abs scales map each operand onto [−127, 127]; the int8×int8 matmul
+    accumulates in int32 (``preferred_element_type``, int8 MXU tile
+    (32, 128)) and dequantizes by the scale product. Strictly opt-in and
+    only ever used for *distance ranking* — never for Gram/linear
+    accumulation, where quantization error would compound over chunks.
+    """
+
+    def quant(t):
+        amax = jnp.max(jnp.abs(t))
+        scale = jnp.where(amax > 0, amax / 127.0, jnp.ones_like(amax))
+        q = jnp.clip(jnp.round(t / scale), -127.0, 127.0).astype(jnp.int8)
+        return q, scale
+
+    qa, sa = quant(a)
+    qb, sb = quant(b)
+    acc = jnp.matmul(qa, qb, preferred_element_type=jnp.int32)
+    return acc.astype(a.dtype) * (sa * sb)
 
 
 class GramStats(NamedTuple):
@@ -94,40 +148,57 @@ def combine_gram_stats(a: GramStats, b: GramStats) -> GramStats:
 
 
 def gram_stats_weighted(
-    x: jax.Array, w: jax.Array, *, precision=DEFAULT_PRECISION
+    x: jax.Array, w: jax.Array, *, precision=DEFAULT_PRECISION,
+    policy: str = DEFAULT_POLICY,
 ) -> GramStats:
     """GramStats under the framework-wide masking convention: ``w`` carries
     instance weights on true rows and 0.0 on pad rows, so XᵀWX, the weighted
     column sums, and the weight-sum count are exact over padded chunks with
     no count fix-up. With unit weights this reduces bit-for-bit to
-    :func:`gram_stats` of the zero-padded block (x·1.0 == x)."""
+    :func:`gram_stats` of the zero-padded block (x·1.0 == x).
+
+    Under ``policy='bf16_f32acc'`` only the XᵀWX matmul operands are cast
+    (``policy_matmul``); col_sum and count stay exact in the carry dtype."""
     xw = x * w[:, None]
     return GramStats(
-        xtx=jnp.matmul(x.T, xw, precision=precision),
+        xtx=policy_matmul(x.T, xw, precision=precision, policy=policy),
         col_sum=jnp.sum(xw, axis=0),
         count=jnp.sum(w),
     )
 
 
 def fold_gram_stats(
-    carry: GramStats, x: jax.Array, w: jax.Array, *, precision=DEFAULT_PRECISION
+    carry: GramStats, x: jax.Array, w: jax.Array, *,
+    precision=DEFAULT_PRECISION, policy: str = DEFAULT_POLICY,
 ) -> GramStats:
     """One streamed-fit fold step: carry + weighted stats of one chunk."""
-    return combine_gram_stats(carry, gram_stats_weighted(x, w, precision=precision))
+    return combine_gram_stats(
+        carry, gram_stats_weighted(x, w, precision=precision, policy=policy)
+    )
 
 
-@lru_cache(maxsize=None)
-def gram_fold_step(precision=DEFAULT_PRECISION):
+def gram_fold_step(precision=DEFAULT_PRECISION, policy: str | None = None):
     """The cached jitted fold step for streamed fits, with the carry
     **donated**: the [n, n] accumulator is updated in place on device, so a
     stream of C chunks allocates ONE set of carry buffers, not C — and the
     jitted call returns as soon as it is dispatched (JAX async dispatch),
     which is what lets the next chunk's host ingest overlap this chunk's
     MXU fold. Use ``carry = step(carry, x, w)`` and never touch the old
-    carry again — donation invalidates it."""
+    carry again — donation invalidates it.
 
+    ``policy=None`` resolves the process default (``TPU_ML_PRECISION_POLICY``)
+    *before* the cache lookup, so an env change selects a different cached
+    program instead of a stale one."""
+    return _gram_fold_step(
+        precision, resolve_policy(policy, allowed=FOLD_POLICIES)
+    )
+
+
+@lru_cache(maxsize=None)
+def _gram_fold_step(precision, policy: str):
     def _step(carry: GramStats, x: jax.Array, w: jax.Array) -> GramStats:
-        return fold_gram_stats(carry, x, w, precision=precision)
+        return fold_gram_stats(carry, x, w, precision=precision,
+                               policy=policy)
 
     return jax.jit(_step, donate_argnums=0)
 
@@ -141,13 +212,20 @@ def init_gram_carry(n: int, dtype) -> GramStats:
     )
 
 
-@lru_cache(maxsize=None)
-def gram_fold_xtx_step(precision=DEFAULT_PRECISION):
+def gram_fold_xtx_step(precision=DEFAULT_PRECISION,
+                       policy: str | None = None):
     """Donated fold of the bare [n, n] Gram (the TruncatedSVD accumulator —
     no col_sum/count companions). Pad rows are zero so no mask is needed."""
+    return _gram_fold_xtx_step(
+        precision, resolve_policy(policy, allowed=FOLD_POLICIES)
+    )
 
+
+@lru_cache(maxsize=None)
+def _gram_fold_xtx_step(precision, policy: str):
     def _step(carry: jax.Array, x: jax.Array) -> jax.Array:
-        return carry + gram(x, precision=precision)
+        return carry + policy_matmul(x.T, x, precision=precision,
+                                     policy=policy)
 
     return jax.jit(_step, donate_argnums=0)
 

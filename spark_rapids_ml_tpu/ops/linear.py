@@ -29,7 +29,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from spark_rapids_ml_tpu.ops.linalg import DEFAULT_PRECISION
+from spark_rapids_ml_tpu.autotune.policy import FOLD_POLICIES, resolve_policy
+from spark_rapids_ml_tpu.ops.linalg import (
+    DEFAULT_PRECISION,
+    DEFAULT_POLICY,
+    policy_matmul,
+)
 
 
 def augment(x: jax.Array) -> jax.Array:
@@ -59,8 +64,12 @@ def linear_stats(
     weights: jax.Array | None = None,
     *,
     precision=DEFAULT_PRECISION,
+    policy: str = DEFAULT_POLICY,
 ) -> LinearStats:
-    """One-pass statistics over a row shard; ``weights`` masks padded rows."""
+    """One-pass statistics over a row shard; ``weights`` masks padded rows.
+
+    ``policy='bf16_f32acc'`` casts only the XᵀX/Xᵀy matmul operands
+    (``linalg.policy_matmul``); the sums and count stay in the carry dtype."""
     if weights is not None:
         xw = x * weights[:, None]
         yw = y * weights
@@ -69,8 +78,8 @@ def linear_stats(
         xw, yw = x, y
         count = jnp.asarray(x.shape[0], x.dtype)
     return LinearStats(
-        xtx=jnp.matmul(x.T, xw, precision=precision),
-        xty=jnp.matmul(x.T, yw, precision=precision),
+        xtx=policy_matmul(x.T, xw, precision=precision, policy=policy),
+        xty=policy_matmul(x.T, yw, precision=precision, policy=policy),
         x_sum=jnp.sum(xw, axis=0),
         y_sum=jnp.sum(yw),
         y_sq=jnp.sum(yw * y),
@@ -89,22 +98,30 @@ def fold_linear_stats(
     w: jax.Array,
     *,
     precision=DEFAULT_PRECISION,
+    policy: str = DEFAULT_POLICY,
 ) -> LinearStats:
     """One streamed-fit fold step: carry + weighted stats of one chunk
     (``w`` is the instance-weight/pad-mask vector, 0.0 on pads)."""
     return combine_linear_stats(
-        carry, linear_stats(x, y, w, precision=precision)
+        carry, linear_stats(x, y, w, precision=precision, policy=policy)
+    )
+
+
+def linear_fold_step(precision=DEFAULT_PRECISION, policy: str | None = None):
+    """Cached jitted fold with the carry donated — the [n, n] normal-equation
+    accumulator updates in place and the dispatch returns before the device
+    fold completes (ops.linalg.gram_fold_step rationale). ``policy=None``
+    resolves ``TPU_ML_PRECISION_POLICY`` before the cache lookup."""
+    return _linear_fold_step(
+        precision, resolve_policy(policy, allowed=FOLD_POLICIES)
     )
 
 
 @lru_cache(maxsize=None)
-def linear_fold_step(precision=DEFAULT_PRECISION):
-    """Cached jitted fold with the carry donated — the [n, n] normal-equation
-    accumulator updates in place and the dispatch returns before the device
-    fold completes (ops.linalg.gram_fold_step rationale)."""
-
+def _linear_fold_step(precision, policy: str):
     def _step(carry, x, y, w):
-        return fold_linear_stats(carry, x, y, w, precision=precision)
+        return fold_linear_stats(carry, x, y, w, precision=precision,
+                                 policy=policy)
 
     return jax.jit(_step, donate_argnums=0)
 

@@ -29,7 +29,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from spark_rapids_ml_tpu.ops.linalg import DEFAULT_PRECISION
+from spark_rapids_ml_tpu.autotune.policy import PrecisionPolicy
+from spark_rapids_ml_tpu.ops.linalg import (
+    DEFAULT_PRECISION,
+    DEFAULT_POLICY,
+    int8_quantized_matmul,
+    policy_matmul,
+)
 
 #: metric → (score sign) — kernels rank by LARGEST score internally.
 #: "sqeuclidean": score = −‖x−y‖² (top-k = nearest);
@@ -38,10 +44,18 @@ _METRICS = ("sqeuclidean", "dot")
 
 
 def _block_scores(
-    queries: jax.Array, block: jax.Array, metric: str, precision
+    queries: jax.Array, block: jax.Array, metric: str, precision,
+    policy: str = DEFAULT_POLICY,
 ) -> jax.Array:
-    """[q, block] ranking scores (larger = better neighbor)."""
-    cross = jnp.matmul(queries, block.T, precision=precision)
+    """[q, block] ranking scores (larger = better neighbor).
+
+    The cross term honors the precision ``policy`` (bf16 operands or the
+    opt-in int8 quantized candidate scoring); norms stay full precision."""
+    if policy == PrecisionPolicy.INT8_DIST.value:
+        cross = int8_quantized_matmul(queries, block.T)
+    else:
+        cross = policy_matmul(queries, block.T, precision=precision,
+                              policy=policy)
     if metric == "dot":
         return cross
     q_sq = jnp.sum(queries * queries, axis=1, keepdims=True)
@@ -66,7 +80,8 @@ def merge_topk(
 
 
 @partial(
-    jax.jit, static_argnames=("k", "metric", "block_rows", "index_offset")
+    jax.jit,
+    static_argnames=("k", "metric", "block_rows", "index_offset", "policy"),
 )
 def knn_topk(
     queries: jax.Array,
@@ -78,6 +93,7 @@ def knn_topk(
     block_rows: int = 8192,
     index_offset: int = 0,
     precision=DEFAULT_PRECISION,
+    policy: str = DEFAULT_POLICY,
 ) -> tuple[jax.Array, jax.Array]:
     """Best-k corpus rows per query, streamed over corpus blocks.
 
@@ -109,7 +125,7 @@ def knn_topk(
     def step(carry, xs):
         best, bidx = carry
         block, vblock, b0 = xs
-        scores = _block_scores(queries, block, metric, precision)
+        scores = _block_scores(queries, block, metric, precision, policy)
         scores = jnp.where(vblock[None, :], scores, neg_inf)
         ids = jnp.broadcast_to(
             b0 + jnp.arange(blk, dtype=jnp.int32)[None, :], (q, blk)

@@ -314,14 +314,29 @@ def chunk_put(mesh: Mesh):
     return put
 
 
-def stream_chunk_rows_for_mesh(mesh: Mesh) -> int:
+def stream_chunk_rows_for_mesh(mesh: Mesh, *, n: int | None = None,
+                               rows: int | None = None,
+                               dtype=None) -> int:
     """The streamed chunk size rounded up to a data-axis multiple so every
     chunk shards evenly (power-of-two buckets already divide power-of-two
-    meshes; this covers odd device counts too)."""
+    meshes; this covers odd device counts too).
+
+    With the fit shape (``n``, optionally ``rows``/``dtype``) the tuning
+    cache is consulted first (``TPU_ML_AUTOTUNE=cache|search``; cache
+    lookups only here — mesh programs never search inline) and a blessed
+    winner's chunk geometry replaces the static knob; a miss falls back to
+    ``TPU_ML_STREAM_CHUNK_ROWS`` exactly as before."""
     from spark_rapids_ml_tpu.spark.ingest import stream_chunk_rows
 
     ndev = mesh.shape[DATA_AXIS]
     base = stream_chunk_rows()
+    if n is not None:
+        from spark_rapids_ml_tpu import autotune
+
+        tuned = autotune.resolve("stream.fold_step", n=n, rows=rows,
+                                 dtype=dtype)
+        if tuned is not None and tuned.chunk_rows:
+            base = int(tuned.chunk_rows)
     return -(-base // ndev) * ndev
 
 
@@ -395,21 +410,31 @@ def _chunk_fold_prog(mesh: Mesh, kernel, vec_args: int):
 
 
 @lru_cache(maxsize=None)
-def _gram_chunk_fold_prog(mesh: Mesh, precision):
+def _gram_chunk_fold_prog(mesh: Mesh, precision, policy: str):
     return _chunk_fold_prog(
         mesh,
-        lambda xl, wl: L.gram_stats_weighted(xl, wl, precision=precision),
+        lambda xl, wl: L.gram_stats_weighted(
+            xl, wl, precision=precision, policy=policy
+        ),
         1,
     )
 
 
 def sharded_gram_fold(
-    carry, x: jax.Array, w: jax.Array, mesh: Mesh, *, precision=L.DEFAULT_PRECISION
+    carry, x: jax.Array, w: jax.Array, mesh: Mesh, *,
+    precision=L.DEFAULT_PRECISION, policy: str | None = None,
 ):
     """One streamed GramStats fold: carry leaves are [ndev, ...] stacked
     partials (init_chunk_carry), ``x``/``w`` one sharded chunk. Donated —
-    reassign the carry and never touch the old one."""
-    return _gram_chunk_fold_prog(mesh, precision)(carry, x, w)
+    reassign the carry and never touch the old one. ``policy=None``
+    resolves ``TPU_ML_PRECISION_POLICY`` before the program-cache lookup."""
+    from spark_rapids_ml_tpu.autotune.policy import (
+        FOLD_POLICIES,
+        resolve_policy,
+    )
+
+    policy = resolve_policy(policy, allowed=FOLD_POLICIES)
+    return _gram_chunk_fold_prog(mesh, precision, policy)(carry, x, w)
 
 
 @lru_cache(maxsize=None)
@@ -425,12 +450,14 @@ def sharded_moment_fold(carry, x: jax.Array, w: jax.Array, mesh: Mesh):
 
 
 @lru_cache(maxsize=None)
-def _linear_chunk_fold_prog(mesh: Mesh, precision):
+def _linear_chunk_fold_prog(mesh: Mesh, precision, policy: str):
     from spark_rapids_ml_tpu.ops import linear as LIN
 
     return _chunk_fold_prog(
         mesh,
-        lambda xl, yl, wl: LIN.linear_stats(xl, yl, wl, precision=precision),
+        lambda xl, yl, wl: LIN.linear_stats(
+            xl, yl, wl, precision=precision, policy=policy
+        ),
         2,
     )
 
@@ -443,7 +470,15 @@ def sharded_linear_fold(
     mesh: Mesh,
     *,
     precision=L.DEFAULT_PRECISION,
+    policy: str | None = None,
 ):
     """One streamed LinearStats fold over a sharded labeled chunk (donated
-    carry; ``w`` is the instance-weight/pad mask)."""
-    return _linear_chunk_fold_prog(mesh, precision)(carry, x, y, w)
+    carry; ``w`` is the instance-weight/pad mask). ``policy=None`` resolves
+    ``TPU_ML_PRECISION_POLICY`` before the program-cache lookup."""
+    from spark_rapids_ml_tpu.autotune.policy import (
+        FOLD_POLICIES,
+        resolve_policy,
+    )
+
+    policy = resolve_policy(policy, allowed=FOLD_POLICIES)
+    return _linear_chunk_fold_prog(mesh, precision, policy)(carry, x, y, w)

@@ -19,6 +19,7 @@ DEVICE_INIT = "device.init"       # backend/device initialization
 FOLD_DISPATCH = "fold.dispatch"   # streamed-fit chunk dispatch
 FOLD_WAIT = "fold.wait"           # streamed-fit terminal device wait
 INGEST_CHUNK = "ingest.chunk"     # streamed-fit chunk staging
+AUTOTUNE_TRIAL = "autotune.trial"  # one timing trial of an autotune search
 
 FAULT_SITES: frozenset[str] = frozenset({
     WORKER_TASK,
@@ -27,4 +28,5 @@ FAULT_SITES: frozenset[str] = frozenset({
     FOLD_DISPATCH,
     FOLD_WAIT,
     INGEST_CHUNK,
+    AUTOTUNE_TRIAL,
 })

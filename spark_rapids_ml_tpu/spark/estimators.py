@@ -467,7 +467,9 @@ class SparkPCA(_HasDistribution, PCA):
                 n=n,
                 init=G.init_chunk_carry(example, mesh),
                 rows=rows,
-                chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
+                chunk_rows=G.stream_chunk_rows_for_mesh(
+                    mesh, n=n, rows=rows, dtype=dt
+                ),
                 put_fn=G.chunk_put(mesh),
                 checkpointer=ckpt,
                 checkpoint_every=checkpoint_every,
@@ -778,7 +780,9 @@ class SparkLinearRegression(_HasDistribution, LinearRegression):
                             weight_col=weight_col,
                             init=G.init_chunk_carry(example, mesh),
                             rows=rows,
-                            chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
+                            chunk_rows=G.stream_chunk_rows_for_mesh(
+                                mesh, n=n, rows=rows, dtype=dt
+                            ),
                             put_fn=G.chunk_put(mesh),
                             checkpointer=ckpt,
                             checkpoint_every=checkpoint_every,
@@ -1761,7 +1765,9 @@ class SparkStandardScaler(_HasDistribution, StandardScaler):
                         n=n,
                         init=G.init_chunk_carry(example, mesh),
                         rows=rows,
-                        chunk_rows=G.stream_chunk_rows_for_mesh(mesh),
+                        chunk_rows=G.stream_chunk_rows_for_mesh(
+                            mesh, n=n, rows=rows, dtype=dt
+                        ),
                         put_fn=G.chunk_put(mesh),
                     )
                     mstats = G.finalize_chunk_fold(res.carry, mesh)

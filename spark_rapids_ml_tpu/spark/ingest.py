@@ -675,8 +675,12 @@ def stream_fold(
     cfg = get_config()
     dt = wire_dtype()
     n_eff = n + 1 if augment_intercept else n
+    # a caller-pinned chunk_rows (mesh paths, tests) wins outright; only the
+    # unpinned path consults the ledger-driven tuner below
+    tune_geometry = chunk_rows is None
     if chunk_rows is None:
         chunk_rows = stream_chunk_rows()
+    layout = "row"  # staging-buffer memory order; the tuner may pick "col"
     if min_chunk_rows is None:
         min_chunk_rows = max(
             1,
@@ -734,12 +738,42 @@ def stream_fold(
 
     def fresh():
         return (
-            np.zeros((chunk_rows, n_eff), dt),
+            np.zeros(
+                (chunk_rows, n_eff), dt,
+                order="F" if layout == "col" else "C",
+            ),
             np.zeros(chunk_rows, dt) if want_y else None,
             np.zeros(chunk_rows, dt),
         )
 
     carry = init() if callable(init) else init
+
+    if tune_geometry:
+        # ledger-driven autotuner (TPU_ML_AUTOTUNE): a blessed/searched
+        # winner overrides chunk geometry + staging layout for this shape
+        # bucket; a miss (or mode=off) keeps the static knobs untouched.
+        # Search trials fold synthetic chunks into throwaway zero carries,
+        # so the real carry above is never consumed.
+        from spark_rapids_ml_tpu import autotune
+
+        tuned = autotune.resolve(
+            "stream.fold_step",
+            n=n_eff,
+            rows=rows,
+            dtype=dt,
+            measure=autotune.stream_fold_measure(
+                fold_fn, carry, n_eff, dt, put, want_y=want_y
+            ),
+            candidates=autotune.candidate_grid(
+                chunk_rows, floor=min_chunk_rows
+            ),
+        )
+        if tuned is not None:
+            if tuned.chunk_rows:
+                chunk_rows = max(
+                    min_chunk_rows, columnar.bucket_rows(int(tuned.chunk_rows))
+                )
+            layout = tuned.layout
     seen = 0
     skipped = 0
     n_chunks = 0

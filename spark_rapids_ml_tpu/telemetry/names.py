@@ -59,6 +59,12 @@ METRICS: frozenset[str] = frozenset({
     "transform.batches",
     "transform.partitions",
     "transform.partition_seconds",
+    # autotune (tuning-cache consults and searches)
+    "autotune.cache_hits",
+    "autotune.cache_misses",
+    "autotune.search_runs",
+    "autotune.trials",
+    "autotune.trial_failures",
     # cost model
     "costmodel.calls",
     "costmodel.flops",
@@ -87,6 +93,8 @@ SPAN_PHASES: frozenset[str] = frozenset({
     "fold.dispatch",
     "fold.wait",
     "ingest.chunk",
+    "autotune.search",
+    "autotune.trial",
     "transform.plan",
     "transform.dispatch",
     # cross-process timeline span events
@@ -180,4 +188,5 @@ INSTANTS: frozenset[str] = frozenset({
     "collective.dispatch",
     "retry",
     "fault.injected",
+    "autotune.decision",
 })

@@ -29,10 +29,12 @@ from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
 # v2: + fit_id (log↔report correlation) and overlap_fraction (H2D↔compute
 # overlap evidence from the streamed fold). v3: + cost_model (analytical
-# FLOPs/bytes + roofline utilization from telemetry.costmodel). Readers must
-# tolerate other versions (tools/trace_report.py skips-with-note rather than
-# KeyError).
-SCHEMA_VERSION = 3
+# FLOPs/bytes + roofline utilization from telemetry.costmodel). v4: + tuning
+# (the autotuner decisions drained from the per-fit journal — which
+# TuningConfig the fit actually ran with, and whether it was a cache hit).
+# Readers must tolerate other versions (tools/trace_report.py
+# skips-with-note rather than KeyError).
+SCHEMA_VERSION = 4
 
 # TransformReport wire schema (independent of the fit schema above).
 TRANSFORM_SCHEMA_VERSION = 1
@@ -73,6 +75,11 @@ class FitReport:
     # per-kernel calls + per-call FLOPs/bytes, window totals, roofline
     # utilization. Empty when no captured kernel dispatched in the window.
     cost_model: dict = field(default_factory=dict)
+    # autotuner resolutions journaled inside this fit's window (v4): the
+    # chosen config + source (cache/search/default) per decision, plus the
+    # last decision hoisted for at-a-glance reads. Empty when the tuner
+    # never ran (mode=off, resident path, caller-pinned geometry).
+    tuning: dict = field(default_factory=dict)
     schema: int = SCHEMA_VERSION
 
     @property
@@ -103,6 +110,7 @@ class FitReport:
             "peak_device_bytes": self.peak_device_bytes,
             "counters": self.counters,
             "cost_model": self.cost_model,
+            "tuning": self.tuning,
         }
 
     @classmethod
@@ -123,6 +131,7 @@ class FitReport:
             fit_id=d.get("fit_id", ""),
             overlap_fraction=d.get("overlap_fraction"),
             cost_model=d.get("cost_model", {}) or {},
+            tuning=d.get("tuning", {}) or {},
             schema=int(d.get("schema", SCHEMA_VERSION)),
         )
 
@@ -130,12 +139,12 @@ class FitReport:
 class _FitCapture:
     __slots__ = (
         "estimator", "uid", "token", "snap", "t0", "t_unix",
-        "fit_id", "fit_id_token", "tl_seq",
+        "fit_id", "fit_id_token", "tl_seq", "tuning_seq",
     )
 
     def __init__(
         self, estimator: str, uid: str, token, snap, t0: float,
-        fit_id: str, fit_id_token, tl_seq: int,
+        fit_id: str, fit_id_token, tl_seq: int, tuning_seq: int = 0,
     ):
         self.estimator = estimator
         self.uid = uid
@@ -146,6 +155,7 @@ class _FitCapture:
         self.fit_id = fit_id
         self.fit_id_token = fit_id_token
         self.tl_seq = tl_seq
+        self.tuning_seq = tuning_seq
 
 
 def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
@@ -156,6 +166,10 @@ def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
     compilemon.install_monitoring()
     spans.install_fit_id_filter()
     fit_id = uuid.uuid4().hex[:12]
+    # lazy: telemetry must stay importable before/without the autotune
+    # package (which itself imports telemetry.registry)
+    from spark_rapids_ml_tpu.autotune import cache as autotune_cache
+
     return _FitCapture(
         estimator=estimator,
         uid=uid,
@@ -165,6 +179,7 @@ def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
         fit_id=fit_id,
         fit_id_token=spans.set_current_fit_id(fit_id),
         tl_seq=TIMELINE.seq(),
+        tuning_seq=autotune_cache.decision_seq(),
     )
 
 
@@ -185,6 +200,19 @@ def end_fit(cap: _FitCapture) -> FitReport:
     spans.reset_current_fit_id(cap.fit_id_token)
     device_memory = compilemon.sample_device_memory()
     delta = REGISTRY.snapshot().delta(cap.snap)
+
+    from spark_rapids_ml_tpu.autotune import cache as autotune_cache
+
+    decisions = autotune_cache.decisions_since(cap.tuning_seq)
+    tuning: dict = {}
+    if decisions:
+        last = decisions[-1]
+        tuning = {
+            "decisions": decisions,
+            "source": last["source"],
+            "cache_hit": last["cache_hit"],
+            "config": last["config"],
+        }
 
     # mean per-stream overlap fraction recorded by stream_fold; None when
     # the fit never streamed (resident path, plain array fits)
@@ -237,6 +265,7 @@ def end_fit(cap: _FitCapture) -> FitReport:
         fit_id=cap.fit_id,
         overlap_fraction=overlap_fraction,
         cost_model=costmodel.window_summary(delta, wall),
+        tuning=tuning,
     )
 
 

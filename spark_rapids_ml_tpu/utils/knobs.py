@@ -132,6 +132,20 @@ _DECLARATIONS = (
     Knob("TPU_ML_OPPORTUNISTIC_MAX_AGE_S", "float", str(14 * 3600),
          "max age of an opportunistic bench harvest before it is ignored",
          "bench.py"),
+    # -- autotune (spark_rapids_ml_tpu.autotune) ----------------------------
+    Knob("TPU_ML_AUTOTUNE", "enum", "cache",
+         "`off`/`cache`/`search` tuner mode: ignore the tuning cache, "
+         "consult it read-only, or search unseen shape buckets on first "
+         "fit", "autotune.search"),
+    Knob("TPU_ML_AUTOTUNE_TRIALS", "int", "9",
+         "total timing-trial budget of one successive-halving search",
+         "autotune.search"),
+    Knob("TPU_ML_TUNING_CACHE_PATH", "path", "",
+         "persistent JSON tuning cache of blessed search winners (empty = "
+         "in-process only)", "autotune.cache"),
+    Knob("TPU_ML_PRECISION_POLICY", "enum", "f32",
+         "`f32`/`bf16_f32acc`/`int8_dist` mixed-precision kernel policy "
+         "default (accumulators stay f32)", "autotune.policy"),
     # -- transport monitor (tools/transport_monitor_r5.py) ------------------
     Knob("TPU_ML_MONITOR_BENCH_OUT", "path", "BENCH_OPPORTUNISTIC_r05.json",
          "opportunistic bench output file (relative to the repo)",
@@ -195,6 +209,10 @@ PERF_SENTINEL = KNOBS["TPU_ML_PERF_SENTINEL"]
 BENCH_PROBE_WINDOW_S = KNOBS["TPU_ML_BENCH_PROBE_WINDOW_S"]
 BENCH_PROBE_TIMEOUT = KNOBS["TPU_ML_BENCH_PROBE_TIMEOUT"]
 OPPORTUNISTIC_MAX_AGE_S = KNOBS["TPU_ML_OPPORTUNISTIC_MAX_AGE_S"]
+AUTOTUNE = KNOBS["TPU_ML_AUTOTUNE"]
+AUTOTUNE_TRIALS = KNOBS["TPU_ML_AUTOTUNE_TRIALS"]
+TUNING_CACHE_PATH = KNOBS["TPU_ML_TUNING_CACHE_PATH"]
+PRECISION_POLICY = KNOBS["TPU_ML_PRECISION_POLICY"]
 MONITOR_BENCH_OUT = KNOBS["TPU_ML_MONITOR_BENCH_OUT"]
 MONITOR_DRIFT_OUT = KNOBS["TPU_ML_MONITOR_DRIFT_OUT"]
 MONITOR_INTERVAL_S = KNOBS["TPU_ML_MONITOR_INTERVAL_S"]

@@ -1,0 +1,439 @@
+"""Ledger-driven autotuner + mixed-precision policy tests.
+
+Four claims, each load-bearing for the tuner PR:
+
+1. MECHANISM — successive halving deterministically selects the fastest
+   candidate under injected timings, respects the trial budget, and an
+   all-trials-dead search returns None without poisoning the cache.
+2. CACHE — winners round-trip through the persistent JSON tier, shape
+   bucketing collapses nearby row counts into one entry, and a repeat
+   resolve of the same bucket is a pure cache hit (zero new search trials,
+   counter-asserted).
+3. NUMERICS — the ``bf16_f32acc`` policy passes the f64-oracle gates at
+   the documented tolerances (PCA min |cosine| >= 0.99, linear coef
+   rel err <= 5e-2, gram rel err <= 2e-3) with accumulator dtype preserved,
+   and ``int8_dist`` keeps kmeans assignments >= 0.99 in agreement with
+   full precision on separated clusters.
+4. INTEGRATION — stream_fold consults the cache for chunk geometry and
+   staging layout, the FitReport stamps the decisions (schema v4), and a
+   chaos plan killing trials degrades the search instead of the fit.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu import autotune
+from spark_rapids_ml_tpu.autotune import cache
+from spark_rapids_ml_tpu.autotune import search
+from spark_rapids_ml_tpu.autotune.policy import (
+    FOLD_POLICIES,
+    PrecisionPolicy,
+    TuningConfig,
+    resolve_policy,
+)
+from spark_rapids_ml_tpu.ops import kmeans as KM
+from spark_rapids_ml_tpu.ops import linalg as L
+from spark_rapids_ml_tpu.ops import linear as LIN
+from spark_rapids_ml_tpu.resilience import faults
+from spark_rapids_ml_tpu.spark import ingest
+from spark_rapids_ml_tpu.telemetry import report
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils import knobs
+
+# documented mixed-precision tolerances (mirrored in README's policy table)
+BF16_GRAM_REL_ERR = 2e-3
+BF16_PCA_MIN_COSINE = 0.99
+BF16_LINEAR_COEF_REL_ERR = 5e-2
+INT8_KMEANS_AGREEMENT = 0.99
+
+
+@pytest.fixture(autouse=True)
+def clean_tuner(monkeypatch):
+    """Every test starts with an empty tuner: no mode/cache/policy env, no
+    in-process entries, no journal, no armed fault plan."""
+    for knob in (knobs.AUTOTUNE, knobs.AUTOTUNE_TRIALS,
+                 knobs.TUNING_CACHE_PATH, knobs.PRECISION_POLICY):
+        monkeypatch.delenv(knob.name, raising=False)
+    monkeypatch.delenv(faults.FAULT_PLAN_VAR, raising=False)
+    faults.reset_faults()
+    cache.reset()
+    yield
+    faults.reset_faults()
+    cache.reset()
+
+
+def _counters():
+    return REGISTRY.snapshot()
+
+
+class TestPolicyVocabulary:
+    def test_tuning_config_round_trip(self):
+        c = TuningConfig(chunk_rows=4096, layout="col",
+                         policy="bf16_f32acc", donate_carry=True)
+        assert TuningConfig.from_dict(c.to_dict()) == c
+        assert "chunk=4096" in c.key() and "layout=col" in c.key()
+
+    def test_tuning_config_validates(self):
+        with pytest.raises(ValueError):
+            TuningConfig(layout="diagonal")
+        with pytest.raises(ValueError):
+            TuningConfig(policy="fp8")
+        with pytest.raises(ValueError):
+            TuningConfig(chunk_rows=0)
+
+    def test_resolve_policy_env_default(self, monkeypatch):
+        assert resolve_policy(None) == "f32"
+        monkeypatch.setenv(knobs.PRECISION_POLICY.name, "bf16_f32acc")
+        assert resolve_policy(None) == "bf16_f32acc"
+        # explicit beats env
+        assert resolve_policy("f32") == "f32"
+
+    def test_fold_policies_exclude_int8(self, monkeypatch):
+        monkeypatch.setenv(knobs.PRECISION_POLICY.name, "int8_dist")
+        with pytest.raises(ValueError):
+            resolve_policy(None, allowed=FOLD_POLICIES)
+
+    def test_candidate_grid(self):
+        grid = search.candidate_grid(1024, floor=8)
+        sizes = sorted({c.chunk_rows for c in grid})
+        assert sizes == [512, 1024, 2048]
+        assert {c.layout for c in grid} == {"row", "col"}
+        # floor clamps the half-size candidate
+        low = search.candidate_grid(8, floor=8)
+        assert min(c.chunk_rows for c in low) == 8
+
+
+class TestCache:
+    def test_shape_bucketing(self):
+        # nearby row counts share a bucket; widths never collapse
+        k1 = cache.cache_key("k", n=16, rows=100_000, dtype="float64")
+        k2 = cache.cache_key("k", n=16, rows=120_000, dtype="float64")
+        k3 = cache.cache_key("k", n=32, rows=100_000, dtype="float64")
+        assert k1 == k2
+        assert k1 != k3
+        assert cache.shape_bucket(16, None) == "n16/rowsANY"
+
+    def test_persistent_round_trip(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "tuning.json")
+        monkeypatch.setenv(knobs.TUNING_CACHE_PATH.name, path)
+        key = cache.cache_key("k", n=8, rows=1000, dtype="float64")
+        cfg = TuningConfig(chunk_rows=256, layout="col")
+        cache.store(key, cfg, trials=3)
+        doc = json.loads(open(path).read())
+        assert doc["type"] == "tuning_cache"
+        # a fresh process (reset) reloads the blessed file lazily
+        cache.reset()
+        monkeypatch.setenv(knobs.TUNING_CACHE_PATH.name, path)
+        assert cache.lookup(key) == cfg
+
+    def test_lookup_books_counters(self):
+        key = cache.cache_key("k", n=8, rows=1000, dtype="float64")
+        before = _counters()
+        assert cache.lookup(key) is None
+        cache.store(key, TuningConfig(chunk_rows=64), persist=False)
+        assert cache.lookup(key) is not None
+        delta = _counters().delta(before)
+        assert delta.counter("autotune.cache_misses") == 1
+        assert delta.counter("autotune.cache_hits") == 1
+
+
+class TestSuccessiveHalving:
+    CONFIGS = [TuningConfig(chunk_rows=r) for r in (64, 128, 256, 512)]
+
+    def test_selects_fastest_under_injected_timings(self):
+        times = {64: 4.0, 128: 1.0, 256: 3.0, 512: 2.0}
+        winner, trials = search.successive_halving(
+            self.CONFIGS, lambda c: times[c.chunk_rows], budget=12
+        )
+        assert winner.chunk_rows == 128
+        assert trials <= 12
+
+    def test_budget_respected(self):
+        calls = []
+        winner, trials = search.successive_halving(
+            self.CONFIGS,
+            lambda c: calls.append(c) or 1.0,
+            budget=3,
+        )
+        assert trials == 3 == len(calls)
+        assert winner is not None
+
+    def test_all_failures_yield_none_and_empty_cache(self):
+        def boom(_config):
+            raise RuntimeError("trial died")
+
+        got = search.search(
+            "k", cache.cache_key("k", n=8, rows=1000, dtype="f8"),
+            self.CONFIGS, boom, budget=8,
+        )
+        assert got is None
+        assert cache.entries() == {}
+
+    def test_deterministic_tie_break(self):
+        # equal timings: candidate order decides, every run identically
+        winners = {
+            search.successive_halving(
+                self.CONFIGS, lambda c: 1.0, budget=8
+            )[0].chunk_rows
+            for _ in range(3)
+        }
+        assert len(winners) == 1
+
+
+class TestResolveModes:
+    KW = dict(n=8, rows=1000, dtype="float64")
+
+    def test_off_mode_is_silent(self, monkeypatch):
+        monkeypatch.setenv(knobs.AUTOTUNE.name, "off")
+        assert search.resolve("k", **self.KW) is None
+        assert cache.decisions_since(0) == []
+
+    def test_cache_mode_never_searches(self, monkeypatch):
+        monkeypatch.setenv(knobs.AUTOTUNE.name, "cache")
+        got = search.resolve(
+            "k", **self.KW,
+            measure=lambda c: 1.0,
+            candidates=[TuningConfig(chunk_rows=64)],
+        )
+        assert got is None  # miss -> static knobs, no search in cache mode
+        (decision,) = cache.decisions_since(0)
+        assert decision["source"] == "default"
+
+    def test_search_then_pure_cache_hit(self, monkeypatch):
+        monkeypatch.setenv(knobs.AUTOTUNE.name, "search")
+        times = {64: 2.0, 128: 1.0}
+        candidates = [TuningConfig(chunk_rows=r) for r in times]
+        before = _counters()
+        first = search.resolve(
+            "k", **self.KW,
+            measure=lambda c: times[c.chunk_rows],
+            candidates=candidates, budget=6,
+        )
+        assert first is not None and first.chunk_rows == 128
+        mid = _counters()
+        assert mid.delta(before).counter("autotune.search_runs") == 1
+        assert mid.delta(before).counter("autotune.trials") > 0
+
+        # the repeat resolve must not measure at all: zero new trials
+        again = search.resolve(
+            "k", **self.KW,
+            measure=lambda c: pytest.fail("measured on a cache hit"),
+            candidates=candidates,
+        )
+        assert again == first
+        delta = _counters().delta(mid)
+        assert delta.counter("autotune.trials") == 0
+        assert delta.counter("autotune.search_runs") == 0
+        assert delta.counter("autotune.cache_hits") == 1
+        sources = [d["source"] for d in cache.decisions_since(0)]
+        assert sources == ["search", "cache"]
+
+
+class TestChaos:
+    def test_faulted_trial_drops_only_that_candidate(self, monkeypatch):
+        # the FIRST trial (the would-be fastest candidate) dies; the search
+        # must finish on the survivors
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "autotune.trial:io:1")
+        faults.reset_faults()
+        times = {64: 1.0, 128: 2.0, 256: 3.0}
+        candidates = [TuningConfig(chunk_rows=r) for r in times]
+        before = _counters()
+        winner, _trials = search.successive_halving(
+            candidates, lambda c: times[c.chunk_rows], budget=9
+        )
+        assert winner.chunk_rows == 128  # 64 died with its trial
+        assert _counters().delta(before).counter(
+            "autotune.trial_failures") == 1
+
+    def test_all_trials_faulted_falls_back_to_defaults(self, monkeypatch):
+        budget = 4
+        plan = ",".join(f"autotune.trial:io:{i + 1}" for i in range(budget))
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, plan)
+        monkeypatch.setenv(knobs.AUTOTUNE.name, "search")
+        faults.reset_faults()
+        got = search.resolve(
+            "k", n=8, rows=1000, dtype="float64",
+            measure=lambda c: 1.0,
+            candidates=[TuningConfig(chunk_rows=r) for r in (64, 128)],
+            budget=budget,
+        )
+        assert got is None  # fit proceeds on static knobs
+        assert cache.entries() == {}  # a dead search never poisons the cache
+        assert cache.decisions_since(0)[-1]["source"] == "default"
+
+
+class TestMixedPrecisionNumerics:
+    @pytest.fixture(scope="class")
+    def spectral_data(self):
+        rng = np.random.default_rng(7)
+        n = 16
+        # strongly decaying column scales: well-separated top eigenpairs so
+        # the oracle comparison measures policy error, not eigengap noise
+        x = rng.normal(size=(2000, n)) * (2.0 ** -np.arange(n))
+        return np.asarray(x, np.float64)
+
+    def _fold_gram(self, x, policy):
+        import jax.numpy as jnp
+
+        step = L.gram_fold_step(policy=policy)
+        carry = L.init_gram_carry(x.shape[1], np.float64)
+        for at in range(0, len(x), 500):
+            chunk = jnp.asarray(x[at:at + 500])
+            carry = step(carry, chunk, jnp.ones(len(chunk), jnp.float64))
+        return carry
+
+    def test_bf16_gram_rel_err_and_carry_dtype(self, spectral_data):
+        x = spectral_data
+        c = self._fold_gram(x, "bf16_f32acc")
+        assert str(c.xtx.dtype) == "float64"  # accumulator NEVER narrows
+        ref = x.T @ x
+        rel = np.max(np.abs(np.asarray(c.xtx) - ref)) / np.max(np.abs(ref))
+        assert 0 < rel <= BF16_GRAM_REL_ERR
+        # count/col_sum stay exact: they never route through the matmul
+        assert float(c.count) == len(x)
+        np.testing.assert_allclose(np.asarray(c.col_sum), x.sum(axis=0))
+
+    def test_bf16_pca_cosine_vs_f64_oracle(self, spectral_data):
+        x = spectral_data
+        k = 4
+        c = self._fold_gram(x, "bf16_f32acc")
+        pc, _ev = L.pca_fit_from_cov(c.xtx, k)
+        assert L.min_cosine_vs_f64_oracle(x, pc, k) >= BF16_PCA_MIN_COSINE
+
+    def test_bf16_linear_coef_vs_f64_oracle(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        n = 8
+        x = rng.normal(size=(4000, n))
+        coef = rng.normal(size=n)
+        y = x @ coef + 0.01 * rng.normal(size=len(x))
+
+        step = LIN.linear_fold_step(policy="bf16_f32acc")
+        carry = LIN.init_linear_carry(n, np.float64)
+        for at in range(0, len(x), 1000):
+            xc = jnp.asarray(x[at:at + 1000])
+            yc = jnp.asarray(y[at:at + 1000])
+            carry = step(carry, xc, yc, jnp.ones(len(xc), jnp.float64))
+        got = np.linalg.solve(np.asarray(carry.xtx), np.asarray(carry.xty))
+        oracle = np.linalg.solve(x.T @ x, x.T @ y)
+        rel = np.linalg.norm(got - oracle) / np.linalg.norm(oracle)
+        assert rel <= BF16_LINEAR_COEF_REL_ERR
+
+    @pytest.mark.parametrize("policy", ["bf16_f32acc", "int8_dist"])
+    def test_distance_policy_assignment_agreement(self, policy):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(5)
+        k, n = 8, 16
+        centers = rng.normal(size=(k, n)) * 6.0  # separated
+        labels = rng.integers(0, k, size=3000)
+        x = centers[labels] + rng.normal(size=(3000, n))
+        xd, cd = jnp.asarray(x), jnp.asarray(centers)
+        base, _ = KM.assign_clusters(xd, cd)
+        got, _ = KM.assign_clusters(xd, cd, policy=policy)
+        agreement = float(np.mean(np.asarray(base) == np.asarray(got)))
+        assert agreement >= INT8_KMEANS_AGREEMENT
+
+    def test_int8_rejected_for_fold_kernels(self):
+        with pytest.raises(ValueError):
+            L.gram_fold_step(policy="int8_dist")
+        with pytest.raises(ValueError):
+            LIN.linear_fold_step(policy="int8_dist")
+
+
+class TestStreamFoldIntegration:
+    N = 6
+
+    def _chunks(self, rows=320):
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(rows, self.N))
+        return x, [x[at:at + 80] for at in range(0, rows, 80)]
+
+    def _fit(self):
+        x, parts = self._chunks()
+        res = ingest.stream_fold(
+            iter(parts), L.gram_fold_step(), n=self.N,
+            init=L.init_gram_carry(self.N, ingest.wire_dtype()),
+        )
+        return x, res
+
+    def test_cached_geometry_drives_chunking(self, monkeypatch):
+        monkeypatch.setenv(knobs.AUTOTUNE.name, "cache")
+        key = cache.cache_key(
+            "stream.fold_step", n=self.N, rows=None, dtype=ingest.wire_dtype()
+        )
+        # 128 is the TPU_ML_MIN_BUCKET floor: the tuned size lands as-is
+        cache.store(
+            key, TuningConfig(chunk_rows=128, layout="col"), persist=False
+        )
+        x, res = self._fit()
+        # tuned geometry (2x128 + ragged tail), not the 65536-row knob
+        assert res.chunks == -(-len(x) // 128) == 3
+        np.testing.assert_allclose(
+            np.asarray(res.carry.xtx), x.T @ x, rtol=1e-10, atol=1e-8
+        )
+        (decision,) = cache.decisions_since(0)
+        assert decision["cache_hit"] is True
+
+    def test_off_mode_keeps_static_knob(self, monkeypatch):
+        monkeypatch.setenv(knobs.AUTOTUNE.name, "off")
+        key = cache.cache_key(
+            "stream.fold_step", n=self.N, rows=None, dtype=ingest.wire_dtype()
+        )
+        cache.store(key, TuningConfig(chunk_rows=64), persist=False)
+        x, res = self._fit()
+        assert res.chunks == 1  # 320 rows < the 65536-row default chunk
+        assert cache.decisions_since(0) == []
+
+    def test_caller_pinned_chunk_rows_bypasses_tuner(self, monkeypatch):
+        monkeypatch.setenv(knobs.AUTOTUNE.name, "cache")
+        x, parts = self._chunks()
+        res = ingest.stream_fold(
+            iter(parts), L.gram_fold_step(), n=self.N,
+            init=L.init_gram_carry(self.N, ingest.wire_dtype()),
+            chunk_rows=128,
+        )
+        assert res.chunks == len(x) // 128 + 1  # 320 = 2x128 + ragged tail
+        assert cache.decisions_since(0) == []  # tuner never consulted
+
+
+class TestFitReportStamp:
+    def test_tuning_decisions_drain_into_report(self, monkeypatch):
+        monkeypatch.setenv(knobs.AUTOTUNE.name, "cache")
+        key = cache.cache_key("stream.fold_step", n=8, rows=1000,
+                              dtype="float64")
+        cache.store(key, TuningConfig(chunk_rows=256), persist=False)
+        cap = report.begin_fit("TunedEstimator")
+        got = search.resolve("stream.fold_step", n=8, rows=1000,
+                             dtype="float64")
+        rep = report.end_fit(cap)
+        assert got is not None
+        assert rep.schema == 4
+        assert rep.tuning["cache_hit"] is True
+        assert rep.tuning["source"] == "cache"
+        assert rep.tuning["config"]["chunk_rows"] == 256
+        d = rep.to_dict()
+        assert d["schema"] == 4 and d["tuning"]["source"] == "cache"
+        assert report.FitReport.from_dict(d).tuning == rep.tuning
+
+    def test_untuned_fit_has_empty_stamp(self):
+        cap = report.begin_fit("PlainEstimator")
+        rep = report.end_fit(cap)
+        assert rep.tuning == {}
+
+    def test_decisions_outside_window_excluded(self, monkeypatch):
+        monkeypatch.setenv(knobs.AUTOTUNE.name, "cache")
+        search.resolve("k", n=8, rows=10, dtype="float64")  # before window
+        cap = report.begin_fit("WindowedEstimator")
+        rep = report.end_fit(cap)
+        assert rep.tuning == {}
+
+
+def test_package_exports():
+    assert autotune.MODES == ("off", "cache", "search")
+    assert autotune.PrecisionPolicy is PrecisionPolicy
+    assert callable(autotune.resolve)
+    assert callable(autotune.stream_fold_measure)

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Offline autotuner CLI: search, inspect, and bless the tuning cache.
+
+The in-fit search (``TPU_ML_AUTOTUNE=search``) spends its trial budget on
+the user's first fit of an unseen shape bucket. This CLI moves that cost
+offline: run the same bounded successive-halving search on a synthetic
+stream of the production shape, inspect the winner, and ``--bless`` it
+into the persistent JSON cache that production fits then consult read-only
+(``TPU_ML_AUTOTUNE=cache``, the default mode) — the same
+search → inspect → bless workflow as tools/perf_sentinel.py::
+
+    # search the streamed-fold geometry for a 1M x 512 f64 fit
+    python -m tools.autotune --n 512 --rows 1048576
+
+    # same, and write the winner into the blessed cache file
+    TPU_ML_TUNING_CACHE_PATH=tuning_cache.json \\
+        python -m tools.autotune --n 512 --rows 1048576 --bless
+
+    # show every entry the current cache resolves to
+    python -m tools.autotune --show
+
+Trials dispatch the real jitted Gram fold (``ops.linalg.gram_fold_step``)
+on the current backend, so winners are per-device-kind by construction —
+the cache key embeds backend/device, and a cache blessed on CPU never
+misleads a TPU fit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable straight from a checkout (matches the other tools/ CLIs)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from spark_rapids_ml_tpu import autotune  # noqa: E402
+from spark_rapids_ml_tpu.autotune import cache  # noqa: E402
+from spark_rapids_ml_tpu.utils import knobs  # noqa: E402
+
+DEFAULT_KERNEL = "stream.fold_step"
+
+
+def _show(path: str) -> int:
+    entries = cache.entries()
+    if not entries:
+        print("tuning cache is empty" + (f" ({path})" if path else
+                                         " (no persistent path set)"))
+        return 0
+    for key in sorted(entries):
+        entry = entries[key]
+        config = entry.get("config", {})
+        provenance = ", ".join(
+            f"{k}={entry[k]}" for k in ("trials", "measured_s") if k in entry
+        )
+        print(f"{key}")
+        print(f"  config: {json.dumps(config, sort_keys=True)}"
+              + (f"  ({provenance})" if provenance else ""))
+    return 0
+
+
+def _search(args) -> int:
+    import numpy as np
+
+    import jax
+
+    from spark_rapids_ml_tpu.ops import linalg as L
+    from spark_rapids_ml_tpu.spark import ingest
+
+    dtype = np.dtype(args.dtype)
+    base = args.chunk_rows or ingest.stream_chunk_rows()
+    carry = L.init_gram_carry(args.n, dtype)
+    measure = autotune.stream_fold_measure(
+        L.gram_fold_step(), carry, args.n, dtype, jax.device_put,
+        reps=args.reps,
+    )
+    candidates = autotune.candidate_grid(base)
+    key = cache.cache_key(args.kernel, n=args.n, rows=args.rows, dtype=dtype)
+    print(f"searching {key}: {len(candidates)} candidate(s), "
+          f"budget {args.trials} trial(s)")
+    winner, trials = autotune.successive_halving(
+        candidates, measure, budget=args.trials
+    )
+    if winner is None:
+        print("no winner: every trial failed — cache left untouched",
+              file=sys.stderr)
+        return 1
+    print(f"winner after {trials} trial(s): {winner.key()}")
+    cache.store(key, winner, trials=trials, persist=False)
+    if args.bless or args.out:
+        path = args.out or cache.cache_path()
+        if not path:
+            print(
+                "error: --bless needs a destination — set "
+                f"{knobs.TUNING_CACHE_PATH.name} or pass --out",
+                file=sys.stderr,
+            )
+            return 1
+        cache.write_cache(path, cache.entries())
+        print(f"blessed: {path} now holds {len(cache.entries())} entry(ies); "
+              f"fits with {knobs.TUNING_CACHE_PATH.name}={path} consult it "
+              "read-only")
+    else:
+        print("dry run (in-process only): re-run with --bless to persist")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Search/inspect/bless the spark_rapids_ml_tpu tuning "
+        "cache offline"
+    )
+    ap.add_argument("--kernel", default=DEFAULT_KERNEL,
+                    help=f"kernel signature to tune (default {DEFAULT_KERNEL})")
+    ap.add_argument("--n", type=int, help="feature width of the target fit")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="row count of the target fit (bucketed; omit for "
+                    "a rows-agnostic entry)")
+    ap.add_argument("--dtype", default="float64",
+                    help="wire dtype of the target fit (default float64)")
+    ap.add_argument("--trials", type=int, default=None,
+                    help="trial budget (default "
+                    f"{knobs.AUTOTUNE_TRIALS.name} or "
+                    f"{autotune.search.DEFAULT_TRIALS})")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed folds per trial (default 3)")
+    ap.add_argument("--chunk-rows", type=int, default=None,
+                    help="base chunk rows for the candidate grid (default "
+                    f"{knobs.STREAM_CHUNK_ROWS.name})")
+    ap.add_argument("--out", default=None,
+                    help="write the blessed cache to this path instead of "
+                    f"{knobs.TUNING_CACHE_PATH.name}")
+    ap.add_argument("--bless", action="store_true",
+                    help="persist the winner into the blessed cache file")
+    ap.add_argument("--show", action="store_true",
+                    help="print the current cache entries and exit")
+    args = ap.parse_args(argv)
+
+    if args.show:
+        return _show(cache.cache_path())
+    if args.n is None:
+        ap.error("--n is required (or use --show)")
+    if args.trials is None:
+        args.trials = autotune.trial_budget()
+    return _search(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

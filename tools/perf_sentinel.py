@@ -17,7 +17,11 @@ becomes a perf gate (``TPU_ML_PERF_SENTINEL=1`` makes the bench invoke this
 itself after appending). A fresh ledger (fewer than 2 entries) always
 passes — there is no history to regress against. Smoke and full-shape runs
 are never compared with each other (filtered on the entry's ``smoke``
-flag), and metrics absent from history are reported as new, not judged.
+flag), tuned and untuned runs likewise (filtered on the entry's ``tuning``
+signature, so a bench run under a different autotuner config never judges
+— or poisons — the default-config history), autotuner search-trial
+entries (``search_trial`` flag) are excluded from history outright, and
+metrics absent from history are reported as new, not judged.
 
 Blessing an intentional perf change: ``--bless`` truncates the ledger to
 its last entry, making the new numbers the baseline history (see
@@ -63,6 +67,15 @@ def load_ledger(path: str) -> list[dict]:
 
 def lower_is_better(unit: str) -> bool:
     return unit.strip().lower() in _LOWER_IS_BETTER_UNITS
+
+
+def tuning_signature(entry: dict) -> str:
+    """Canonical form of an entry's autotuner configuration.
+
+    Entries written before the ``tuning`` field existed — and entries from
+    default-config runs, which omit it — normalize to the same ``"{}"``
+    signature, so pre-autotuner history keeps judging default runs."""
+    return json.dumps(entry.get("tuning") or {}, sort_keys=True)
 
 
 def compare(
@@ -151,10 +164,15 @@ def main(argv=None) -> int:
         return 0
 
     current = entries[-1]
-    # never judge a smoke run against full-shape history or vice versa
+    # never judge a smoke run against full-shape history or vice versa,
+    # never cross-compare runs under different tuning configs, and never
+    # let autotuner search trials (transient, intentionally varied
+    # geometry) into the baseline median
     history = [
         e for e in entries[:-1]
         if bool(e.get("smoke")) == bool(current.get("smoke"))
+        and not e.get("search_trial")
+        and tuning_signature(e) == tuning_signature(current)
     ]
     if args.last > 0:
         history = history[-args.last:]

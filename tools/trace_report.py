@@ -53,7 +53,7 @@ import sys
 # highest fit_report schema this renderer understands (telemetry.report
 # .SCHEMA_VERSION); newer records are skipped with a note, older ones
 # render with defaults for the fields they predate
-SUPPORTED_SCHEMA = 3
+SUPPORTED_SCHEMA = 4
 
 # highest transform_report schema understood
 # (telemetry.report.TRANSFORM_SCHEMA_VERSION)
@@ -261,6 +261,29 @@ def _print_cost_model(rec: dict, out) -> None:
         print(detail, file=out)
 
 
+def _print_tuning(rec: dict, out) -> None:
+    """The autotuner decision line (fit_report schema >= 4): which
+    TuningConfig the fit actually ran with and where it came from."""
+    tuning = rec.get("tuning") or {}
+    if not tuning:
+        return
+    source = tuning.get("source", "?")
+    config = tuning.get("config")
+    if config:
+        desc = (
+            f"chunk_rows={config.get('chunk_rows')}, "
+            f"layout={config.get('layout')}, policy={config.get('policy')}"
+        )
+    else:
+        desc = "static knobs (no tuned config)"
+    n_dec = len(tuning.get("decisions") or [])
+    hit = "cache hit" if tuning.get("cache_hit") else f"source={source}"
+    print(
+        f"autotune: {desc} ({hit}; {n_dec} decision(s) this fit)",
+        file=out,
+    )
+
+
 def render_record(rec: dict, out=sys.stdout) -> list[str]:
     """Print one fit_report; returns its anomaly list."""
     est = rec.get("estimator", "?")
@@ -310,6 +333,7 @@ def render_record(rec: dict, out=sys.stdout) -> list[str]:
             file=out,
         )
     _print_cost_model(rec, out)
+    _print_tuning(rec, out)
     peak = rec.get("peak_device_bytes", 0)
     if peak:
         print(f"peak device memory: {_fmt_bytes(peak)}", file=out)
