@@ -129,6 +129,7 @@ class _Worker:
             return
         try:
             import json
+            import time
 
             from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
             from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
@@ -139,6 +140,10 @@ class _Worker:
                 REGISTRY.merge_wire(t["registry"], partition=label)
             if t.get("events"):
                 TIMELINE.merge(t["events"], partition=label)
+            # worker-liveness recency for the health monitor: monotonic
+            # stamp of the last merged trailer (telemetry.health compares
+            # its age against TPU_ML_HEALTH_STALE_S)
+            REGISTRY.gauge_set("worker.last_trailer", time.monotonic())
         except Exception:
             logger.warning(
                 "dropping unmergeable worker telemetry trailer (partition=%s)",

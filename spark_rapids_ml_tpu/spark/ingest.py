@@ -809,6 +809,15 @@ def stream_fold(
     x_buf, y_buf, w_buf = fresh()
     fill = 0
 
+    # live-health heartbeat: the monitor (telemetry.health) compares
+    # stream.last_beat against time.monotonic() and flags the stream stale
+    # once the gap exceeds TPU_ML_HEALTH_STALE_S — but only while
+    # stream.active is set, so an idle process stays OK. Unlike the opt-in
+    # stderr progress line below this is always on: one gauge write per
+    # dispatched chunk.
+    REGISTRY.gauge_set("stream.active", 1)
+    REGISTRY.gauge_set("stream.last_beat", time.monotonic())
+
     # live progress heartbeat (TPU_ML_PROGRESS): opt-in stderr line so a
     # multi-minute out-of-core fit is not silent. Retry counts come from
     # the registry delta (the retries happen inside call_with_retry below).
@@ -906,110 +915,120 @@ def stream_fold(
     def dispatch():
         nonlocal x_buf, y_buf, w_buf, fill
         dispatch_buffers(x_buf, y_buf if want_y else None, w_buf)
+        REGISTRY.gauge_set("stream.last_beat", time.monotonic())
         # never reuse a put buffer: device_put of a host ndarray may alias
         # rather than copy on some backends (stream_to_mesh rationale)
         x_buf, y_buf, w_buf = fresh()
         fill = 0
 
-    for xc, yc, wc in timed_chunks():
-        REGISTRY.counter_inc("ingest.rows", len(xc))
-        REGISTRY.counter_inc("ingest.bytes", xc.nbytes)
-        REGISTRY.histogram_record("ingest.chunk_rows", len(xc))
-        TIMELINE.record_instant(
-            "stream.chunk", rows=len(xc), nbytes=int(xc.nbytes)
-        )
-        if xc.ndim != 2 or xc.shape[1] != n:
-            raise ValueError(
-                f"feature dimension changed mid-stream: expected {n}, got "
-                f"{xc.shape[1:]} in column {features_col!r}"
+    try:
+        for xc, yc, wc in timed_chunks():
+            REGISTRY.counter_inc("ingest.rows", len(xc))
+            REGISTRY.counter_inc("ingest.bytes", xc.nbytes)
+            REGISTRY.histogram_record("ingest.chunk_rows", len(xc))
+            TIMELINE.record_instant(
+                "stream.chunk", rows=len(xc), nbytes=int(xc.nbytes)
             )
-        if want_y and yc is None:
-            raise ValueError("label column missing from a streamed chunk")
-        if resume_skip:
-            # replaying an already-checkpointed prefix: drop the raw rows a
-            # prior run consumed (counted BEFORE any filtering, so the
-            # cursor is exact regardless of the non-finite policy)
-            drop = min(resume_skip, len(xc))
-            resume_skip -= drop
-            xc = xc[drop:]
-            yc = yc[drop:] if yc is not None else None
-            wc = wc[drop:] if wc is not None else None
-            if not len(xc):
-                continue
-        xc = R.call_with_retry(
-            lambda: faults.inject("ingest.chunk", xc),
-            site="ingest.chunk",
-            policy=policy,
-            retry_on=transient_only,
-        )
-        if nonfinite != "allow" and not (
-            # scalar pre-check keeps the all-finite fast path off the
-            # per-row mask allocation
-            np.isfinite(xc).all()
-            and (yc is None or np.isfinite(yc).all())
-            and (wc is None or np.isfinite(wc).all())
-        ):
-            bad = ~np.isfinite(xc).all(axis=1)
-            if yc is not None:
-                bad |= ~np.isfinite(yc)
-            if wc is not None:
-                bad |= ~np.isfinite(wc)
-            n_bad = int(bad.sum())
-            if n_bad:
-                if nonfinite == "raise":
-                    raise ValueError(
-                        f"{n_bad} non-finite input row(s) in a streamed "
-                        "chunk; set TPU_ML_NONFINITE_POLICY=skip to drop "
-                        "and count them instead"
-                    )
-                keep = ~bad
-                xc = xc[keep]
-                yc = yc[keep] if yc is not None else None
-                wc = wc[keep] if wc is not None else None
-                skipped += n_bad
-                REGISTRY.counter_inc("rows.nonfinite_skipped", n_bad)
+            if xc.ndim != 2 or xc.shape[1] != n:
+                raise ValueError(
+                    f"feature dimension changed mid-stream: expected {n}, "
+                    f"got {xc.shape[1:]} in column {features_col!r}"
+                )
+            if want_y and yc is None:
+                raise ValueError("label column missing from a streamed chunk")
+            if resume_skip:
+                # replaying an already-checkpointed prefix: drop the raw
+                # rows a prior run consumed (counted BEFORE any filtering,
+                # so the cursor is exact regardless of the non-finite
+                # policy)
+                drop = min(resume_skip, len(xc))
+                resume_skip -= drop
+                xc = xc[drop:]
+                yc = yc[drop:] if yc is not None else None
+                wc = wc[drop:] if wc is not None else None
                 if not len(xc):
                     continue
-        if wc is not None:
-            wc = columnar.validate_weights(wc, len(xc), allow_all_zero=True)
-        at = 0
-        while at < len(xc):
-            take = min(chunk_rows - fill, len(xc) - at)
-            x_buf[fill : fill + take, :n] = xc[at : at + take]
-            if augment_intercept:
-                x_buf[fill : fill + take, n] = 1.0
-            if want_y:
-                y_buf[fill : fill + take] = yc[at : at + take]
-            w_buf[fill : fill + take] = (
-                1.0 if wc is None else wc[at : at + take]
+            xc = R.call_with_retry(
+                lambda: faults.inject("ingest.chunk", xc),
+                site="ingest.chunk",
+                policy=policy,
+                retry_on=transient_only,
             )
-            fill += take
-            at += take
-            seen += take
-            if fill == chunk_rows:
-                dispatch()
-                maybe_heartbeat()
-                if (
-                    checkpointer is not None
-                    and n_chunks - last_ckpt >= checkpoint_every
-                ):
-                    _save_stream_checkpoint(
-                        checkpointer, carry, chunks=n_chunks, seen=seen,
-                        skipped=skipped, chunk_rows=chunk_rows,
-                    )
-                    last_ckpt = n_chunks
-    if fill:
-        dispatch()  # ragged tail: pads ride the w=0 mask, exactly
-    if seen == 0:
-        raise ValueError("empty dataset")
-    if rows is not None and seen + skipped != rows:
-        raise ValueError(
-            f"dataset produced {seen + skipped} rows while streaming but "
-            f"count() reported {rows}; cache() the DataFrame if its source "
-            "is nondeterministic"
-        )
-    with trace_range("fold.wait"):
-        carry = _bounded_wait(carry, fold_wait_timeout_s)
+            if nonfinite != "allow" and not (
+                # scalar pre-check keeps the all-finite fast path off the
+                # per-row mask allocation
+                np.isfinite(xc).all()
+                and (yc is None or np.isfinite(yc).all())
+                and (wc is None or np.isfinite(wc).all())
+            ):
+                bad = ~np.isfinite(xc).all(axis=1)
+                if yc is not None:
+                    bad |= ~np.isfinite(yc)
+                if wc is not None:
+                    bad |= ~np.isfinite(wc)
+                n_bad = int(bad.sum())
+                if n_bad:
+                    if nonfinite == "raise":
+                        raise ValueError(
+                            f"{n_bad} non-finite input row(s) in a streamed "
+                            "chunk; set TPU_ML_NONFINITE_POLICY=skip to "
+                            "drop and count them instead"
+                        )
+                    keep = ~bad
+                    xc = xc[keep]
+                    yc = yc[keep] if yc is not None else None
+                    wc = wc[keep] if wc is not None else None
+                    skipped += n_bad
+                    REGISTRY.counter_inc("rows.nonfinite_skipped", n_bad)
+                    if not len(xc):
+                        continue
+            if wc is not None:
+                wc = columnar.validate_weights(
+                    wc, len(xc), allow_all_zero=True
+                )
+            at = 0
+            while at < len(xc):
+                take = min(chunk_rows - fill, len(xc) - at)
+                x_buf[fill : fill + take, :n] = xc[at : at + take]
+                if augment_intercept:
+                    x_buf[fill : fill + take, n] = 1.0
+                if want_y:
+                    y_buf[fill : fill + take] = yc[at : at + take]
+                w_buf[fill : fill + take] = (
+                    1.0 if wc is None else wc[at : at + take]
+                )
+                fill += take
+                at += take
+                seen += take
+                if fill == chunk_rows:
+                    dispatch()
+                    maybe_heartbeat()
+                    if (
+                        checkpointer is not None
+                        and n_chunks - last_ckpt >= checkpoint_every
+                    ):
+                        _save_stream_checkpoint(
+                            checkpointer, carry, chunks=n_chunks, seen=seen,
+                            skipped=skipped, chunk_rows=chunk_rows,
+                        )
+                        last_ckpt = n_chunks
+        if fill:
+            dispatch()  # ragged tail: pads ride the w=0 mask, exactly
+        if seen == 0:
+            raise ValueError("empty dataset")
+        if rows is not None and seen + skipped != rows:
+            raise ValueError(
+                f"dataset produced {seen + skipped} rows while streaming "
+                f"but count() reported {rows}; cache() the DataFrame if "
+                "its source is nondeterministic"
+            )
+        with trace_range("fold.wait"):
+            carry = _bounded_wait(carry, fold_wait_timeout_s)
+    finally:
+        # clear on EVERY exit (raises included): the monitor treats an
+        # inactive stream as OK regardless of beat age, so a dead stream
+        # must not read as "wedged" forever
+        REGISTRY.gauge_set("stream.active", 0)
     # per-stream H2D↔compute overlap evidence: fraction of dispatches
     # issued while the prior fold was still on device. Recorded as a
     # histogram so end_fit's snapshot delta reads a per-fit mean into

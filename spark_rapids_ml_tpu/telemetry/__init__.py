@@ -19,6 +19,11 @@ Public surface (everything the rest of the framework and user code needs):
 - ``install_monitoring`` / ``sample_device_memory`` — jax.monitoring
   compile listeners and device-memory gauges (:mod:`.compilemon`).
 - ``snapshot_dict`` — full-registry JSON snapshot (bench embedding).
+- ``health`` / ``slo`` / ``httpd`` — the live health monitor, the
+  sliding-window SLO engine and the /metrics + /healthz HTTP exporter
+  (:mod:`.health`, :mod:`.slo`, :mod:`.httpd`); ``HealthMonitor`` /
+  ``start_monitor`` / ``stop_monitor`` / ``start_http_server`` /
+  ``stop_http_server`` re-exported for the common paths.
 """
 
 from spark_rapids_ml_tpu.telemetry.registry import (
@@ -78,6 +83,18 @@ from spark_rapids_ml_tpu.telemetry.export import (
     telemetry_path,
     timeline_path,
 )
+from spark_rapids_ml_tpu.telemetry import slo
+from spark_rapids_ml_tpu.telemetry import health
+from spark_rapids_ml_tpu.telemetry import httpd
+from spark_rapids_ml_tpu.telemetry.health import (
+    HealthMonitor,
+    start_monitor,
+    stop_monitor,
+)
+from spark_rapids_ml_tpu.telemetry.httpd import (
+    start_http_server,
+    stop_http_server,
+)
 
 __all__ = [
     "REGISTRY",
@@ -125,4 +142,12 @@ __all__ = [
     "read_jsonl",
     "telemetry_path",
     "timeline_path",
+    "slo",
+    "health",
+    "httpd",
+    "HealthMonitor",
+    "start_monitor",
+    "stop_monitor",
+    "start_http_server",
+    "stop_http_server",
 ]

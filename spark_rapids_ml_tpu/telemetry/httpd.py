@@ -1,0 +1,213 @@
+"""HTTP exporter: /metrics, /healthz, /slo and /report on a local port.
+
+The scrape surface the serving runtime (ROADMAP item 1) sits behind — a
+stdlib :class:`ThreadingHTTPServer`, off by default, enabled by setting
+``TPU_ML_HTTP_PORT`` (0 binds an ephemeral port; read it back from
+``HealthHTTPServer.port``). No new dependencies, no framework thread
+unless asked for.
+
+Endpoints:
+
+- ``/metrics``  — the full registry in Prometheus text exposition format
+  (:meth:`RegistrySnapshot.to_prometheus`), including the rolling SLO
+  percentile gauges the health monitor publishes each poll.
+- ``/healthz``  — the component rollup as JSON; HTTP 200 while the worst
+  component is OK or DEGRADED (degraded is *serving*, just impaired),
+  503 once anything is FAILING — load-balancer-ready semantics.
+- ``/slo``      — the last SLO evaluation (objectives, rolling windows,
+  breach totals) as JSON.
+- ``/report``   — the most recent Fit/Transform report dicts as JSON.
+
+``ensure_started()`` is the fit-path hook (called from ``begin_fit``):
+with ``TPU_ML_HTTP_PORT`` set, the first ``fit()`` of the process brings
+up the exporter *and* the health monitor, so a streamed fit is watchable
+live with zero code changes; without the variable it is a no-op. It never
+raises — a bound port must not be able to break a fit.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from spark_rapids_ml_tpu.telemetry import health as health_mod
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils import knobs
+
+logger = logging.getLogger("spark_rapids_ml_tpu.httpd")
+
+HTTP_PORT_VAR = knobs.HTTP_PORT.name
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tpu-ml-exporter/1.0"
+
+    # route access logs through the package logger instead of stderr
+    def log_message(self, fmt, *args):  # noqa: D102 - BaseHTTPRequestHandler
+        logger.debug("http %s", fmt % args)
+
+    def do_GET(self):  # noqa: N802 - http.server naming contract
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        REGISTRY.counter_inc("http.requests", path=path)
+        try:
+            if path == "/metrics":
+                self._respond(
+                    200,
+                    REGISTRY.snapshot().to_prometheus().encode(),
+                    PROM_CONTENT_TYPE,
+                )
+            elif path == "/healthz":
+                self._healthz()
+            elif path == "/slo":
+                self._json(200, self._rollup().get("slo", {}))
+            elif path == "/report":
+                from spark_rapids_ml_tpu.telemetry import report as report_mod
+
+                self._json(200, {"reports": report_mod.recent_reports()})
+            else:
+                self._json(404, {"error": f"no such endpoint: {path}"})
+        except Exception as e:  # pragma: no cover - handler must not die
+            logger.exception("http handler failed for %s", path)
+            try:
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:  # noqa: BLE001 - client already gone
+                pass
+
+    @staticmethod
+    def _rollup() -> dict:
+        mon = health_mod.get_monitor()
+        if mon is None:
+            return {}
+        if mon.polls == 0:
+            # first scrape before the monitor's first tick: poll inline so
+            # /healthz never serves a vacuous all-OK default
+            return mon.poll_once()
+        return mon.rollup()
+
+    def _healthz(self) -> None:
+        rollup = self._rollup()
+        if not rollup:
+            self._json(
+                200, {"state": "UNKNOWN", "detail": "no health monitor"}
+            )
+            return
+        code = 503 if rollup["state"] == "FAILING" else 200
+        self._json(code, rollup)
+
+    def _json(self, code: int, payload: dict) -> None:
+        self._respond(
+            code,
+            json.dumps(payload, indent=2).encode() + b"\n",
+            "application/json",
+        )
+
+    def _respond(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class HealthHTTPServer:
+    """A started/stoppable exporter bound to 127.0.0.1:``port``."""
+
+    def __init__(self, port: int = 0):
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "HealthHTTPServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name="tpu-ml-httpd",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+
+# -- module singleton --------------------------------------------------------
+
+_LOCK = threading.Lock()
+_SERVER: HealthHTTPServer | None = None
+
+
+def start_http_server(
+    port: int | None = None, *, with_monitor: bool = True
+) -> HealthHTTPServer:
+    """Start (or return) the process-wide exporter.
+
+    ``port=None`` reads ``TPU_ML_HTTP_PORT`` (which must then be set);
+    ``port=0`` binds an ephemeral port. By default the health monitor is
+    started alongside — the exporter without it serves ``/healthz`` as
+    UNKNOWN.
+    """
+    global _SERVER
+    if port is None:
+        raw = os.environ.get(HTTP_PORT_VAR, "")
+        if raw == "":
+            raise ValueError(
+                f"start_http_server(port=None) requires {HTTP_PORT_VAR}"
+            )
+        port = int(raw)
+    with _LOCK:
+        if _SERVER is None:
+            _SERVER = HealthHTTPServer(port).start()
+        server = _SERVER
+    if with_monitor:
+        health_mod.start_monitor()
+    return server
+
+
+def get_http_server() -> HealthHTTPServer | None:
+    with _LOCK:
+        return _SERVER
+
+
+def stop_http_server(timeout: float = 5.0, *, stop_monitor: bool = True) -> None:
+    """Stop and forget the exporter (and, by default, the monitor it
+    started). No-op when nothing is running."""
+    global _SERVER
+    with _LOCK:
+        server = _SERVER
+        _SERVER = None
+    if server is not None:
+        server.stop(timeout)
+    if stop_monitor:
+        health_mod.stop_monitor(timeout)
+
+
+def ensure_started() -> HealthHTTPServer | None:
+    """Fit-path hook: bring up exporter + monitor iff ``TPU_ML_HTTP_PORT``
+    is set. Idempotent, never raises."""
+    raw = os.environ.get(HTTP_PORT_VAR, "")
+    if raw == "":
+        return None
+    try:
+        return start_http_server(int(raw))
+    except Exception:  # pragma: no cover - an exporter must not break fits
+        logger.exception("could not start the telemetry HTTP exporter")
+        return None

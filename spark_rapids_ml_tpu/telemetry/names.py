@@ -53,6 +53,20 @@ METRICS: frozenset[str] = frozenset({
     "retry.attempts",
     "fault.injected",
     "degraded.cpu_fallback",
+    # live health monitor (telemetry.health)
+    "health.state",
+    "health.transitions",
+    "health.probe_seconds",
+    "stream.last_beat",
+    "stream.active",
+    "worker.last_trailer",
+    # sliding-window SLO engine (telemetry.slo)
+    "slo.breach",
+    "slo.value",
+    "slo.target",
+    "slo.rolling",
+    # HTTP exporter (telemetry.httpd)
+    "http.requests",
     # serve path
     "transform.rows",
     "transform.bytes",
@@ -75,6 +89,7 @@ METRICS: frozenset[str] = frozenset({
     "fit.wall_seconds",
     "transforms",
     "transform.wall_seconds",
+    "autotune.decisions",
 })
 
 # Metric families minted with a dynamic suffix (one registered prefix per
@@ -189,4 +204,6 @@ INSTANTS: frozenset[str] = frozenset({
     "retry",
     "fault.injected",
     "autotune.decision",
+    "health.transition",
+    "slo.breach",
 })

@@ -18,6 +18,8 @@ sink, so one user-visible ``fit()`` is one sink line.
 
 from __future__ import annotations
 
+import collections
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -32,9 +34,10 @@ from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 # FLOPs/bytes + roofline utilization from telemetry.costmodel). v4: + tuning
 # (the autotuner decisions drained from the per-fit journal — which
 # TuningConfig the fit actually ran with, and whether it was a cache hit).
-# Readers must tolerate other versions (tools/trace_report.py
-# skips-with-note rather than KeyError).
-SCHEMA_VERSION = 4
+# v5: + health (the live monitor's component rollup at fit end — empty when
+# no monitor runs). Readers must tolerate other versions
+# (tools/trace_report.py skips-with-note rather than KeyError).
+SCHEMA_VERSION = 5
 
 # TransformReport wire schema (independent of the fit schema above).
 TRANSFORM_SCHEMA_VERSION = 1
@@ -80,6 +83,10 @@ class FitReport:
     # last decision hoisted for at-a-glance reads. Empty when the tuner
     # never ran (mode=off, resident path, caller-pinned geometry).
     tuning: dict = field(default_factory=dict)
+    # live health rollup at fit end (v5): overall + per-component states,
+    # poll/transition counts and the window's SLO breach total from the
+    # background HealthMonitor. Empty when no monitor was running.
+    health: dict = field(default_factory=dict)
     schema: int = SCHEMA_VERSION
 
     @property
@@ -111,6 +118,7 @@ class FitReport:
             "counters": self.counters,
             "cost_model": self.cost_model,
             "tuning": self.tuning,
+            "health": self.health,
         }
 
     @classmethod
@@ -132,6 +140,7 @@ class FitReport:
             overlap_fraction=d.get("overlap_fraction"),
             cost_model=d.get("cost_model", {}) or {},
             tuning=d.get("tuning", {}) or {},
+            health=d.get("health", {}) or {},
             schema=int(d.get("schema", SCHEMA_VERSION)),
         )
 
@@ -165,6 +174,12 @@ def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
     the estimator name."""
     compilemon.install_monitoring()
     spans.install_fit_id_filter()
+    # with TPU_ML_HTTP_PORT set, the first fit brings up the /metrics +
+    # /healthz exporter and the health monitor (lazy import: httpd reads
+    # this module's recent-reports ring)
+    from spark_rapids_ml_tpu.telemetry import httpd
+
+    httpd.ensure_started()
     fit_id = uuid.uuid4().hex[:12]
     # lazy: telemetry must stay importable before/without the autotune
     # package (which itself imports telemetry.registry)
@@ -181,6 +196,24 @@ def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
         tl_seq=TIMELINE.seq(),
         tuning_seq=autotune_cache.decision_seq(),
     )
+
+
+# Ring of the most recent report dicts (fit and transform), served by the
+# HTTP exporter's /report endpoint. Bounded; lock-guarded (reports finish on
+# whatever thread ran the fit).
+_REPORTS_LOCK = threading.Lock()
+_RECENT_REPORTS: collections.deque = collections.deque(maxlen=16)
+
+
+def _remember_report(d: dict) -> None:
+    with _REPORTS_LOCK:
+        _RECENT_REPORTS.append(d)
+
+
+def recent_reports() -> list[dict]:
+    """The latest report dicts, oldest first (the ``/report`` payload)."""
+    with _REPORTS_LOCK:
+        return list(_RECENT_REPORTS)
 
 
 # counters folded into dedicated report fields; everything else lands in
@@ -219,6 +252,10 @@ def end_fit(cap: _FitCapture) -> FitReport:
     ov = delta.hist("stream.overlap_fraction")
     overlap_fraction = (ov.total / ov.count) if ov.count else None
 
+    from spark_rapids_ml_tpu.telemetry import health as health_mod
+
+    health = health_mod.current_summary()
+
     ingest_rows = int(delta.counter(_INGEST_ROWS))
     ingest_bytes = int(delta.counter(_INGEST_BYTES))
     # the streamed/mesh ingest layer re-extracts through columnar, so when
@@ -237,7 +274,7 @@ def end_fit(cap: _FitCapture) -> FitReport:
             ("compile.", "collective.", "h2d.", "costmodel.")
         )
     }
-    return FitReport(
+    report = FitReport(
         estimator=cap.estimator,
         uid=cap.uid,
         wall_seconds=wall,
@@ -266,7 +303,10 @@ def end_fit(cap: _FitCapture) -> FitReport:
         overlap_fraction=overlap_fraction,
         cost_model=costmodel.window_summary(delta, wall),
         tuning=tuning,
+        health=health,
     )
+    _remember_report(report.to_dict())
+    return report
 
 
 @dataclass
@@ -444,7 +484,7 @@ def end_transform(cap: _TransformCapture) -> TransformReport:
         and k[0]
         not in (_INGEST_ROWS, _INGEST_BYTES, _COLUMNAR_ROWS, _COLUMNAR_BYTES)
     }
-    return TransformReport(
+    report = TransformReport(
         transformer=cap.transformer,
         uid=cap.uid,
         wall_seconds=wall,
@@ -458,6 +498,8 @@ def end_transform(cap: _TransformCapture) -> TransformReport:
         timestamp_unix=cap.t_unix,
         transform_id=cap.transform_id,
     )
+    _remember_report(report.to_dict())
+    return report
 
 
 def attach_transform_report(model: Any, report: TransformReport) -> None:

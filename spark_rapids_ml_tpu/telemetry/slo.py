@@ -1,0 +1,269 @@
+"""Sliding-window service-level objectives over the metrics registry.
+
+Every latency surface in the framework already lands in log-scale
+histograms (:mod:`.registry`), but those accumulate per-process: a p99
+computed over the whole run hides a latency regression that started five
+minutes ago. This module keeps a short ring of timestamped registry
+snapshots and exploits the histogram *delta* algebra — newest minus the
+snapshot just outside the window IS the rolling window histogram — so
+rolling p50/p95/p99 cost O(window/poll) snapshots and zero raw samples.
+
+Two things come out of an evaluation:
+
+- **Rolling percentile gauges** (``slo.rolling{series,q}``) for a default
+  watchlist of hot-path series (``transform.partition_seconds``,
+  ``fold.wait``, ``ingest.chunk``) plus any series named by an objective —
+  the live Prometheus view of "how slow is it right now".
+- **Breach detection** against declarative ``TPU_ML_SLO`` targets with a
+  burn-rate filter: a target must stay breached for ``TPU_ML_SLO_BURN``
+  consecutive evaluations before ``slo.breach`` fires (one flapping poll
+  is noise; N in a row is an alert). Each firing increments the
+  ``slo.breach`` counter and records an ``slo.breach`` timeline instant,
+  turning ``tools/trace_report.py``'s post-hoc anomaly predicates into
+  live signals.
+
+Objective grammar (comma list, whitespace tolerated):
+
+    TPU_ML_SLO="fold.wait:p99:2.0,transform.partition_seconds:p95:0.5"
+    TPU_ML_SLO="ingest.rows:min_rate:50000"
+
+``series:pNN:ceiling_s`` bounds the rolling pNN of a histogram series —
+span phases (``fold.wait``, ``ingest.chunk``) resolve through
+``span.seconds{phase=...}``, anything else is a direct histogram name.
+``counter:min_rate:floor_per_s`` is a throughput floor over a counter's
+windowed rate; it only evaluates while the counter is moving (an idle
+process is not a breach).
+
+The engine is driven by :class:`telemetry.health.HealthMonitor`'s poll
+loop; standalone use (tests, tools) just calls :meth:`SloEngine.evaluate`.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from spark_rapids_ml_tpu.telemetry import names
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY, Histogram
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
+from spark_rapids_ml_tpu.utils import knobs
+
+SLO_VAR = knobs.SLO.name
+WINDOW_VAR = knobs.SLO_WINDOW_S.name
+BURN_VAR = knobs.SLO_BURN.name
+
+DEFAULT_WINDOW_S = 300.0
+DEFAULT_BURN = 2
+
+# Hot-path series whose rolling percentiles are always published, even with
+# no objectives declared — the "watch a fit live" Prometheus surface.
+DEFAULT_ROLLING: tuple[str, ...] = (
+    "transform.partition_seconds",
+    "fold.wait",
+    "ingest.chunk",
+)
+ROLLING_QUANTILES: tuple[int, ...] = (50, 95, 99)
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declarative target parsed from ``TPU_ML_SLO``."""
+
+    series: str   # histogram series / span phase / counter name
+    kind: str     # "p<NN>" latency ceiling | "min_rate" throughput floor
+    target: float
+
+    @property
+    def key(self) -> str:
+        """Stable label value for gauges/counters/instants."""
+        return f"{self.series}:{self.kind}"
+
+
+def parse_objectives(raw: str) -> tuple[Objective, ...]:
+    """Parse the ``TPU_ML_SLO`` comma grammar; '' → no objectives."""
+    out: list[Objective] = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"{SLO_VAR} entry {entry!r}: expected series:kind:target"
+            )
+        series, kind, target_raw = parts[0].strip(), parts[1].strip(), parts[2]
+        if kind != "min_rate" and not (
+            kind.startswith("p") and kind[1:].isdigit()
+            and 0 < int(kind[1:]) <= 100
+        ):
+            raise ValueError(
+                f"{SLO_VAR} entry {entry!r}: kind {kind!r} is neither "
+                "pNN (1..100) nor min_rate"
+            )
+        try:
+            target = float(target_raw)
+        except ValueError:
+            raise ValueError(
+                f"{SLO_VAR} entry {entry!r}: target {target_raw!r} is not a "
+                "number"
+            ) from None
+        out.append(Objective(series, kind, target))
+    return tuple(out)
+
+
+def _resolve_hist(snap, series: str) -> Histogram:
+    """A latency series is either a span phase (recorded under
+    ``span.seconds{phase=...}``) or a first-class histogram name."""
+    if series in names.SPAN_PHASES:
+        return snap.hist("span.seconds", phase=series)
+    return snap.hist(series)
+
+
+class SloEngine:
+    """Windowed objective evaluation over registry snapshot deltas.
+
+    Thread-safe; one instance is owned by the health monitor. ``registry``
+    is injectable for tests.
+    """
+
+    def __init__(
+        self,
+        objectives: tuple[Objective, ...] | None = None,
+        *,
+        window_s: float | None = None,
+        burn: int | None = None,
+        registry=None,
+    ):
+        if objectives is None:
+            objectives = parse_objectives(os.environ.get(SLO_VAR, ""))
+        if window_s is None:
+            window_s = float(
+                os.environ.get(WINDOW_VAR, str(DEFAULT_WINDOW_S))
+            )
+        if burn is None:
+            burn = int(os.environ.get(BURN_VAR, str(DEFAULT_BURN)))
+        self.objectives = objectives
+        self.window_s = max(1e-3, float(window_s))
+        self.burn = max(1, int(burn))
+        self._registry = registry if registry is not None else REGISTRY
+        self._lock = threading.Lock()
+        # ring of (monotonic_t, RegistrySnapshot); the newest entry older
+        # than the window is kept as the delta base. Seeded at construction
+        # so the very first evaluation already covers "since engine start".
+        self._snaps: collections.deque = collections.deque()
+        self._snaps.append((time.monotonic(), self._registry.snapshot()))
+        self._streak: dict[str, int] = {}
+        self._breaches: dict[str, int] = {}
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, now: float | None = None) -> dict:
+        """Take a snapshot, roll the window, publish gauges, detect burns.
+
+        Returns a JSON-shaped summary (the ``/slo`` endpoint payload).
+        """
+        t = time.monotonic() if now is None else now
+        snap = self._registry.snapshot()
+        with self._lock:
+            self._snaps.append((t, snap))
+            # drop everything older than the window EXCEPT the newest such
+            # entry — it is the base the window delta subtracts
+            cutoff = t - self.window_s
+            while len(self._snaps) >= 2 and self._snaps[1][0] <= cutoff:
+                self._snaps.popleft()
+            base_t, base = self._snaps[0]
+            streaks = dict(self._streak)
+        elapsed = max(1e-9, t - base_t)
+        delta = snap.delta(base) if base is not snap else snap.delta(snap)
+
+        rolling_series = dict.fromkeys(
+            DEFAULT_ROLLING
+            + tuple(o.series for o in self.objectives if o.kind != "min_rate")
+        )
+        rolling: dict[str, dict[str, float]] = {}
+        for series in rolling_series:
+            h = _resolve_hist(delta, series)
+            if not h.count:
+                continue
+            qs = {}
+            for q in ROLLING_QUANTILES:
+                v = h.percentile(q)
+                qs[f"p{q}"] = v
+                self._registry.gauge_set(
+                    "slo.rolling", v, series=series, q=f"p{q}"
+                )
+            rolling[series] = qs
+
+        results: list[dict] = []
+        fired: list[Objective] = []
+        for obj in self.objectives:
+            value = self._objective_value(obj, delta, elapsed)
+            breached = value is not None and (
+                value < obj.target if obj.kind == "min_rate"
+                else value > obj.target
+            )
+            if value is not None:
+                self._registry.gauge_set(
+                    "slo.value", value, objective=obj.key
+                )
+            self._registry.gauge_set("slo.target", obj.target, objective=obj.key)
+            streak = streaks.get(obj.key, 0) + 1 if breached else 0
+            streaks[obj.key] = streak
+            if breached and streak >= self.burn:
+                fired.append(obj)
+            results.append(
+                {
+                    "objective": obj.key,
+                    "series": obj.series,
+                    "kind": obj.kind,
+                    "target": obj.target,
+                    "value": value,
+                    "breached": breached,
+                    "streak": streak,
+                }
+            )
+        with self._lock:
+            self._streak = streaks
+            for obj in fired:
+                self._breaches[obj.key] = self._breaches.get(obj.key, 0) + 1
+            breaches = dict(self._breaches)
+        for obj in fired:
+            self._registry.counter_inc("slo.breach", objective=obj.key)
+            TIMELINE.record_instant("slo.breach", objective=obj.key)
+        for r in results:
+            r["breaches"] = breaches.get(r["objective"], 0)
+        return {
+            "window_s": self.window_s,
+            "burn": self.burn,
+            "elapsed_s": elapsed,
+            "objectives": results,
+            "rolling": rolling,
+            "total_breaches": sum(breaches.values()),
+        }
+
+    def _objective_value(self, obj: Objective, delta, elapsed: float):
+        if obj.kind == "min_rate":
+            moved = delta.counter(obj.series)
+            if not moved:
+                return None  # idle counter — a floor needs traffic to judge
+            return moved / elapsed
+        h = _resolve_hist(delta, obj.series)
+        if not h.count:
+            return None
+        return h.percentile(int(obj.kind[1:]))
+
+    # -- introspection -------------------------------------------------------
+
+    def total_breaches(self) -> int:
+        with self._lock:
+            return sum(self._breaches.values())
+
+    def reset(self) -> None:
+        """Forget windows, streaks and breach totals (tests)."""
+        with self._lock:
+            self._snaps.clear()
+            self._streak.clear()
+            self._breaches.clear()

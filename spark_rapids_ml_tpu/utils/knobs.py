@@ -146,27 +146,61 @@ _DECLARATIONS = (
     Knob("TPU_ML_PRECISION_POLICY", "enum", "f32",
          "`f32`/`bf16_f32acc`/`int8_dist` mixed-precision kernel policy "
          "default (accumulators stay f32)", "autotune.policy"),
-    # -- transport monitor (tools/transport_monitor_r5.py) ------------------
+    # -- transport monitor / health daemon (tools/healthd.py) ---------------
     Knob("TPU_ML_MONITOR_BENCH_OUT", "path", "BENCH_OPPORTUNISTIC_r05.json",
          "opportunistic bench output file (relative to the repo)",
-         "tools/transport_monitor_r5.py"),
+         "tools/healthd.py"),
     Knob("TPU_ML_MONITOR_DRIFT_OUT", "path", "BENCH_DRIFT_r05.jsonl",
          "transport-monitor drift log (relative to the repo)",
-         "tools/transport_monitor_r5.py"),
+         "tools/healthd.py"),
     Knob("TPU_ML_MONITOR_INTERVAL_S", "float", "600",
-         "seconds between transport probes", "tools/transport_monitor_r5.py"),
+         "seconds between transport probes", "tools/healthd.py"),
     Knob("TPU_ML_MONITOR_PROBE_TIMEOUT_S", "float", "120",
          "per-probe timeout of the transport monitor",
-         "tools/transport_monitor_r5.py"),
+         "tools/healthd.py"),
     Knob("TPU_ML_MONITOR_WINDOW_S", "float", str(11.5 * 3600),
          "total monitoring window before the monitor gives up",
-         "tools/transport_monitor_r5.py"),
+         "tools/healthd.py"),
     Knob("TPU_ML_MONITOR_BENCH_RUNS", "int", "5",
          "bench repetitions per opportunistic harvest",
-         "tools/transport_monitor_r5.py"),
+         "tools/healthd.py"),
     Knob("TPU_ML_MONITOR_BENCH_TIMEOUT_S", "float", "3600",
          "timeout of one opportunistic bench run",
-         "tools/transport_monitor_r5.py"),
+         "tools/healthd.py"),
+    # -- live health monitor (telemetry.health) -----------------------------
+    Knob("TPU_ML_HEALTH_INTERVAL_S", "float", "5.0",
+         "seconds between HealthMonitor poll cycles", "telemetry.health"),
+    Knob("TPU_ML_HEALTH_PROBE", "enum", "inline",
+         "`off`/`inline`/`subprocess` transport liveness probe mode of the "
+         "health monitor", "telemetry.health"),
+    Knob("TPU_ML_HEALTH_PROBE_TIMEOUT_S", "float", "20.0",
+         "deadline of one health-monitor liveness probe", "telemetry.health"),
+    Knob("TPU_ML_HEALTH_HBM_WATERMARK", "float", "0.92",
+         "bytes_in_use/bytes_limit fraction above which the device "
+         "component degrades", "telemetry.health"),
+    Knob("TPU_ML_HEALTH_STALE_S", "float", "60.0",
+         "stream-heartbeat / worker-trailer staleness threshold",
+         "telemetry.health"),
+    Knob("TPU_ML_HEALTH_FAILING_AFTER", "int", "3",
+         "consecutive degraded polls before a component turns FAILING",
+         "telemetry.health"),
+    Knob("TPU_ML_HEALTH_RETRY_STORM", "int", "8",
+         "retry.attempts delta per poll window that flags a retry storm",
+         "telemetry.health"),
+    # -- sliding-window SLOs (telemetry.slo) --------------------------------
+    Knob("TPU_ML_SLO", "str", "",
+         "comma list of `series:pNN:ceiling_s` latency objectives and "
+         "`counter:min_rate:floor_per_s` throughput floors (empty = rolling "
+         "percentiles only)", "telemetry.slo"),
+    Knob("TPU_ML_SLO_WINDOW_S", "float", "300",
+         "sliding evaluation window of the SLO engine", "telemetry.slo"),
+    Knob("TPU_ML_SLO_BURN", "int", "2",
+         "consecutive breached evaluations before slo.breach fires (burn "
+         "rate)", "telemetry.slo"),
+    # -- HTTP exporter (telemetry.httpd) ------------------------------------
+    Knob("TPU_ML_HTTP_PORT", "int", "",
+         "serve /metrics,/healthz,/slo,/report on this port (0 = ephemeral; "
+         "unset = exporter off)", "telemetry.httpd"),
 )
 
 KNOBS: dict[str, Knob] = {k.name: k for k in _DECLARATIONS}
@@ -220,6 +254,17 @@ MONITOR_PROBE_TIMEOUT_S = KNOBS["TPU_ML_MONITOR_PROBE_TIMEOUT_S"]
 MONITOR_WINDOW_S = KNOBS["TPU_ML_MONITOR_WINDOW_S"]
 MONITOR_BENCH_RUNS = KNOBS["TPU_ML_MONITOR_BENCH_RUNS"]
 MONITOR_BENCH_TIMEOUT_S = KNOBS["TPU_ML_MONITOR_BENCH_TIMEOUT_S"]
+HEALTH_INTERVAL_S = KNOBS["TPU_ML_HEALTH_INTERVAL_S"]
+HEALTH_PROBE = KNOBS["TPU_ML_HEALTH_PROBE"]
+HEALTH_PROBE_TIMEOUT_S = KNOBS["TPU_ML_HEALTH_PROBE_TIMEOUT_S"]
+HEALTH_HBM_WATERMARK = KNOBS["TPU_ML_HEALTH_HBM_WATERMARK"]
+HEALTH_STALE_S = KNOBS["TPU_ML_HEALTH_STALE_S"]
+HEALTH_FAILING_AFTER = KNOBS["TPU_ML_HEALTH_FAILING_AFTER"]
+HEALTH_RETRY_STORM = KNOBS["TPU_ML_HEALTH_RETRY_STORM"]
+SLO = KNOBS["TPU_ML_SLO"]
+SLO_WINDOW_S = KNOBS["TPU_ML_SLO_WINDOW_S"]
+SLO_BURN = KNOBS["TPU_ML_SLO_BURN"]
+HTTP_PORT = KNOBS["TPU_ML_HTTP_PORT"]
 
 
 def markdown_table() -> str:

@@ -76,6 +76,22 @@ def test_bench_smoke_json_contract(tmp_path):
     injected = [k for k in tel["counters"] if k.startswith("fault.injected")]
     assert injected == [], injected
 
+    # the live-exporter stage (ISSUE 8): the bench scraped its own /healthz
+    # (must be 200 on this healthy process) and /metrics (must contain the
+    # streamed-fit counter families) over real HTTP on an ephemeral port —
+    # a hard contract in --smoke, so rc=0 above already proves the scrape
+    # succeeded; the evidence block records what it saw
+    hl = data["health"]
+    assert hl["healthz"] == 200
+    assert hl["state"] == "OK"
+    assert hl["components"].get("transport") == "OK"
+    assert hl["components"].get("stream") == "OK"
+    assert hl["port"] > 0
+    assert hl["metrics_scrape_bytes"] > 0
+    # the monitor's poll published its gauges into the same registry the
+    # snapshot serialized
+    assert "health.state{component=overall}" in data["telemetry"]["gauges"]
+
     # the run appended one perf-ledger entry holding every emitted metric
     # plus the analytical cost-model numbers (ISSUE 5)
     with open(ledger, encoding="utf-8") as f:
@@ -88,6 +104,9 @@ def test_bench_smoke_json_contract(tmp_path):
     assert "streamed_fit_rows_per_s" in entry["metrics"]
     assert entry["metrics"]["streamed_fit_rows_per_s"]["unit"] == "rows/s"
     assert "analytical_flops" in entry["cost_model"]
+    # the health verdict stamps the ledger so the sentinel's reader can
+    # tell environment problems from genuine regressions (ISSUE 8)
+    assert entry["health_state"] == "OK"
     # TPU_ML_PERF_SENTINEL=1 already ran the gate in-process (exit 0 above
     # proves a fresh ledger passes); the standalone CLI agrees
     proc = subprocess.run(

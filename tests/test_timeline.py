@@ -457,6 +457,69 @@ class TestPrometheusExposition:
         )
         assert proc.returncode == 1
 
+    def test_metrics_dump_renders_every_names_family(self, tmp_path, capsys):
+        """Meta-check: every metric family declared in telemetry.names
+        survives the report→dump→Prometheus pipeline. A family the dump
+        silently drops (filters, sanitization, renames) would otherwise
+        vanish from dashboards without any test noticing."""
+        import importlib.util
+
+        from spark_rapids_ml_tpu.telemetry import names
+
+        spec = importlib.util.spec_from_file_location("metrics_dump", MD_CLI)
+        md = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(md)
+
+        rec = {
+            "type": "fit_report",
+            "schema": 5,
+            "estimator": "Meta",
+            "wall_seconds": 1.0,
+            "rows_ingested": 10,
+            "bytes_ingested": 80,
+            "h2d_bytes": 80,
+            "overlap_fraction": 0.5,
+            "collectives": {"count": 1, "bytes": 8, "tree_combines": 1},
+            "compile": {
+                "count": 1, "seconds": 0.1, "trace_seconds": 0.05,
+                "lower_seconds": 0.02, "cache_hits": 1, "cache_misses": 1,
+                "cache_time_saved_s": 0.1,
+            },
+            "cost_model": {
+                "analytical_flops": 100, "analytical_bytes": 100,
+                "roofline_utilization": 0.1,
+            },
+            "tuning": {
+                "decisions": [
+                    {"kernel": "stream.fold_step", "source": "cache",
+                     "cache_hit": True, "config": {}},
+                ],
+            },
+            # every declared family as a raw window counter: the generic
+            # pass-through must re-emit ALL of them
+            "counters": {name: 1.0 for name in sorted(names.METRICS)},
+        }
+        path = tmp_path / "t.jsonl"
+        path.write_text(json.dumps(rec) + "\n")
+        assert md.main([str(path)]) == 0
+        out = capsys.readouterr().out
+
+        def prom_name(name):
+            return "tpu_ml_" + "".join(
+                c if c.isalnum() or c == "_" else "_" for c in name
+            )
+
+        missing = [
+            n for n in sorted(names.METRICS)
+            if prom_name(n) + "{" not in out and prom_name(n) + " " not in out
+        ]
+        assert not missing, f"families dropped by metrics_dump: {missing}"
+        # the dedicated autotune decision family carries its labels
+        assert (
+            'tpu_ml_autotune_decisions{estimator="Meta",'
+            'kernel="stream.fold_step",source="cache"} 1' in out
+        )
+
 
 class TestTraceTimelineCli:
     def _record(self, **over):

@@ -219,3 +219,80 @@ def test_fault_injection_anomaly_fires_and_strict_exits_2(tmp_path):
     p.write_text(json.dumps(rec) + "\n")
     assert mod.main([str(p)]) == 0
     assert mod.main([str(p), "--strict"]) == 2
+
+
+def test_slo_breach_anomaly_fires_and_strict_exits_2(tmp_path):
+    """Schema-5 satellite: counted slo.breach during the fit window is the
+    slo-breach-during-fit anomaly, and --strict gates on it."""
+    mod = _load_cli_module()
+    import json
+
+    rec = {
+        "type": "fit_report",
+        "schema": 5,
+        "estimator": "X",
+        "wall_seconds": 1.0,
+        "rows_ingested": 100,
+        "phases": {},
+        "compile": {},
+        "counters": {"slo.breach{objective=fold.wait:p99}": 2.0},
+    }
+    anomalies = mod.check_anomalies(rec)
+    assert any("slo-breach-during-fit" in a for a in anomalies)
+    p = tmp_path / "t.jsonl"
+    p.write_text(json.dumps(rec) + "\n")
+    assert mod.main([str(p)]) == 0
+    assert mod.main([str(p), "--strict"]) == 2
+
+
+def test_health_summary_rendered_from_schema_5():
+    mod = _load_cli_module()
+    import io
+
+    rec = {
+        "type": "fit_report",
+        "schema": 5,
+        "estimator": "X",
+        "wall_seconds": 1.0,
+        "rows_ingested": 10,
+        "phases": {},
+        "compile": {},
+        "health": {
+            "state": "DEGRADED",
+            "components": {
+                "device": "OK",
+                "transport": "DEGRADED",
+                "stream": "OK",
+                "workers": "OK",
+                "resilience": "OK",
+            },
+            "polls": 7,
+            "transitions": 2,
+            "slo_breaches": 1,
+        },
+    }
+    buf = io.StringIO()
+    mod.render_record(rec, out=buf)
+    out = buf.getvalue()
+    assert "health: DEGRADED (transport=DEGRADED)" in out
+    assert "7 poll(s)" in out
+    assert "1 SLO breach(es)" in out
+
+
+def test_health_summary_absent_prints_nothing():
+    mod = _load_cli_module()
+    import io
+
+    rec = {
+        "type": "fit_report",
+        "schema": 5,
+        "estimator": "X",
+        "wall_seconds": 1.0,
+        "rows_ingested": 10,
+        "phases": {},
+        "compile": {},
+        "health": {},
+    }
+    buf = io.StringIO()
+    mod.render_record(rec, out=buf)
+    assert "health:" not in buf.getvalue()

@@ -1,0 +1,303 @@
+"""Live health daemon: probes, SLOs and the HTTP exporter from one CLI.
+
+Successor to ``tools/transport_monitor_r5.py`` (now a deprecation shim
+that execs this file). The old monitor hand-rolled one concern — a
+round-long transport probe loop with an opportunistic bench harvest; this
+CLI drives the framework's own :class:`telemetry.health.HealthMonitor`
+(device HBM watermarks, bounded transport probes, stream/worker liveness,
+resilience signals, windowed SLOs) and keeps the harvest glue on top.
+
+Modes:
+
+* **watch** (default) — start the background monitor (and, with
+  ``--port``, the ``/metrics`` + ``/healthz`` HTTP exporter), append one
+  JSON rollup line per tick to ``TRANSPORT_LOG_r05.jsonl``, and run the
+  opportunistic bench harvest the first time the transport probe comes
+  back healthy (same ``BENCH_OPPORTUNISTIC``/``BENCH_DRIFT`` contract and
+  ``TPU_ML_MONITOR_*`` knobs as the old monitor)::
+
+      setsid nohup python tools/healthd.py --port 9100 &
+
+* **--once** — single foreground poll, rollup JSON on stdout, exit code
+  by state: 0 while serving, 2 once any component is FAILING. With
+  ``--strict`` a DEGRADED component or any counted SLO breach also fails
+  (exit 1) — the CI gate shape.
+
+Safety notes inherited from the old monitor: bench children get a
+generous bound and are stopped with SIGTERM (60 s grace), never an
+immediate SIGKILL — hard-killing a JAX process mid-compile is what wedges
+the transport for every later process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from spark_rapids_ml_tpu.utils import knobs  # noqa: E402
+
+LOG_PATH = os.path.join(REPO, "TRANSPORT_LOG_r05.jsonl")
+# Output names are env-overridable so a SUPPLEMENTAL harvest instance can
+# run after the primary landed (e.g. when new bench extras are added
+# mid-round and deserve their own on-chip values: point BENCH_OUT at a
+# _r05b file and the "already harvested?" check follows it).
+BENCH_OUT = os.path.join(
+    REPO,
+    os.environ.get(
+        knobs.MONITOR_BENCH_OUT.name, "BENCH_OPPORTUNISTIC_r05.json"
+    ),
+)
+DRIFT_OUT = os.path.join(
+    REPO, os.environ.get(knobs.MONITOR_DRIFT_OUT.name, "BENCH_DRIFT_r05.jsonl")
+)
+
+PROBE_INTERVAL_S = float(os.environ.get(knobs.MONITOR_INTERVAL_S.name, "600"))
+PROBE_TIMEOUT_S = float(
+    os.environ.get(knobs.MONITOR_PROBE_TIMEOUT_S.name, "120")
+)
+ROUND_WINDOW_S = float(
+    os.environ.get(knobs.MONITOR_WINDOW_S.name, str(11.5 * 3600))
+)
+N_BENCH_RUNS = int(os.environ.get(knobs.MONITOR_BENCH_RUNS.name, "5"))
+BENCH_TIMEOUT_S = float(
+    os.environ.get(knobs.MONITOR_BENCH_TIMEOUT_S.name, "3600")
+)
+
+START = time.time()
+
+
+def now_iso() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+
+
+def append(path: str, record: dict) -> None:
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+# -- opportunistic bench harvest (ported from transport_monitor_r5) ----------
+
+
+def run_bench(run_idx: int) -> dict:
+    """One full bench run; returns the drift-log record."""
+    env = dict(os.environ)
+    # The monitor just proved the transport healthy; the bench's own
+    # preamble only needs a short re-confirmation window.
+    env[knobs.BENCH_PROBE_WINDOW_S.name] = "300"
+    start = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=BENCH_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        # SIGTERM the whole process group, generous grace, never jump
+        # straight to SIGKILL (a hard kill mid-compile wedges the tunnel).
+        os.killpg(proc.pid, signal.SIGTERM)
+        try:
+            out, err = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            out, err = proc.communicate()
+    took = time.time() - start
+    json_line = None
+    for line in (out or "").splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            json_line = line
+    record = {
+        "t": now_iso(),
+        "elapsed_s": round(time.time() - START, 1),
+        "run": run_idx,
+        "rc": proc.returncode,
+        "took_s": round(took, 1),
+        "json": json.loads(json_line) if json_line else None,
+    }
+    if proc.returncode != 0 or json_line is None:
+        record["stderr_tail"] = (err or "")[-2000:]
+    return record
+
+
+def harvest() -> bool:
+    """Run the bench N times; write BENCH_OPPORTUNISTIC on first full rc=0."""
+    wrote_primary = False
+    for i in range(1, N_BENCH_RUNS + 1):
+        rec = run_bench(i)
+        append(DRIFT_OUT, rec)
+        print(f"[healthd] bench run {i}/{N_BENCH_RUNS}: rc={rec['rc']} "
+              f"took={rec['took_s']}s", flush=True)
+        if not wrote_primary and rec["rc"] == 0 and rec["json"] is not None:
+            payload = dict(rec["json"])
+            # bench.py's snapshot-time fallback only trusts a harvest
+            # stamped fresh enough to be from the CURRENT round — a
+            # committed harvest from a past round must never be re-emitted
+            # as this round's measurement
+            payload["harvested_at_unix"] = round(time.time(), 1)
+            payload["harvested_at"] = now_iso()
+            with open(BENCH_OUT, "w") as f:
+                json.dump(payload, f, indent=2)
+                f.write("\n")
+            wrote_primary = True
+        if rec["rc"] != 0 and rec["json"] is None and i >= 2 and not wrote_primary:
+            # Transport re-wedged mid-harvest; go back to probing.
+            return False
+    return wrote_primary
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def _exit_code(rollup: dict, *, strict: bool) -> int:
+    state = rollup.get("state", "OK")
+    if state == "FAILING":
+        return 2
+    if strict:
+        if state == "DEGRADED":
+            return 1
+        if (rollup.get("slo") or {}).get("total_breaches", 0):
+            return 1
+    return 0
+
+
+def run_once(args) -> int:
+    from spark_rapids_ml_tpu.telemetry import health
+
+    mon = health.HealthMonitor(
+        interval_s=args.interval,
+        probe_mode=args.probe,
+        probe_timeout_s=args.probe_timeout,
+    )
+    try:
+        rollup = mon.poll_once()
+    finally:
+        mon.stop()
+    print(json.dumps(rollup, indent=2))
+    return _exit_code(rollup, strict=args.strict)
+
+
+def run_watch(args) -> int:
+    from spark_rapids_ml_tpu.telemetry import health, httpd
+
+    mon = health.start_monitor(
+        interval_s=args.interval,
+        probe_mode=args.probe,
+        probe_timeout_s=args.probe_timeout,
+    )
+    server = None
+    if args.port is not None:
+        server = httpd.start_http_server(args.port, with_monitor=False)
+        print(f"[healthd] exporter at {server.url}", flush=True)
+    harvested = args.no_harvest or os.path.exists(BENCH_OUT)
+    tick = threading.Event()
+    print(
+        f"[healthd] start {now_iso()} interval={args.interval}s "
+        f"probe={args.probe} window={ROUND_WINDOW_S}s harvested={harvested}",
+        flush=True,
+    )
+    try:
+        while time.time() - START < ROUND_WINDOW_S:
+            # the monitor thread polls on its own cadence; this loop is the
+            # durable on-disk timeline + harvest trigger
+            tick.wait(args.interval)
+            rollup = mon.rollup() if mon.polls else mon.poll_once()
+            transport = rollup["components"].get("transport", {})
+            append(LOG_PATH, {
+                "t": now_iso(),
+                "elapsed_s": round(time.time() - START, 1),
+                "state": rollup["state"],
+                "components": {
+                    c: v["state"] for c, v in rollup["components"].items()
+                },
+                "slo_breaches": (rollup.get("slo") or {}).get(
+                    "total_breaches", 0
+                ),
+            })
+            print(
+                f"[healthd] state={rollup['state']} "
+                f"transport={transport.get('state', '?')}",
+                flush=True,
+            )
+            if transport.get("state") == "OK" and not harvested:
+                append(LOG_PATH, {"t": now_iso(), "event": "harvest_start"})
+                harvested = harvest()
+                append(LOG_PATH, {
+                    "t": now_iso(),
+                    "event": "harvest_done",
+                    "complete": harvested,
+                })
+    except KeyboardInterrupt:
+        print("[healthd] interrupted", flush=True)
+    finally:
+        if server is not None:
+            httpd.stop_http_server(stop_monitor=False)
+        health.stop_monitor()
+    print(f"[healthd] window exhausted at {now_iso()}", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    from spark_rapids_ml_tpu.telemetry import health
+
+    p = argparse.ArgumentParser(
+        description="live health daemon: probes, SLOs, /metrics + /healthz"
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="poll once, print the rollup JSON, exit by state",
+    )
+    p.add_argument(
+        "--strict", action="store_true",
+        help="with --once: DEGRADED or any SLO breach also fails (CI gate)",
+    )
+    p.add_argument(
+        "--port", type=int, default=None,
+        help="also serve /metrics,/healthz,/slo,/report on this port "
+        "(0 = ephemeral; watch mode only)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=PROBE_INTERVAL_S,
+        help=f"poll interval seconds (default {knobs.MONITOR_INTERVAL_S.name} "
+        "or 600)",
+    )
+    p.add_argument(
+        "--probe", choices=health.PROBE_MODES, default="subprocess",
+        help="transport liveness probe mode (default subprocess, the only "
+        "mode safe against a wedged transport poisoning this process)",
+    )
+    p.add_argument(
+        "--probe-timeout", type=float, default=PROBE_TIMEOUT_S,
+        help=f"probe deadline seconds (default "
+        f"{knobs.MONITOR_PROBE_TIMEOUT_S.name} or 120)",
+    )
+    p.add_argument(
+        "--no-harvest", action="store_true",
+        help="watch mode: disable the opportunistic bench harvest",
+    )
+    args = p.parse_args(argv)
+    if args.once:
+        return run_once(args)
+    return run_watch(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

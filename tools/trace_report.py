@@ -27,6 +27,9 @@ that turn the numbers into a diagnosis:
   before it becomes a hard failure.
 - nonzero ``fault.injected`` ⇒ a TPU_ML_FAULT_PLAN was active; expected
   only in chaos tests, never in a production report.
+- nonzero ``slo.breach`` counted during the fit window ⇒ a declared
+  ``TPU_ML_SLO`` latency ceiling or throughput floor burned through its
+  tolerance while the fit ran (``slo-breach-during-fit``).
 - backend compiles far exceeding the distinct cost-model kernel count ⇒
   recompile storm: static-shape bucketing is not holding, so the same
   logical kernels keep recompiling per shape (check TPU_ML_MIN_BUCKET and
@@ -53,7 +56,7 @@ import sys
 # highest fit_report schema this renderer understands (telemetry.report
 # .SCHEMA_VERSION); newer records are skipped with a note, older ones
 # render with defaults for the fields they predate
-SUPPORTED_SCHEMA = 4
+SUPPORTED_SCHEMA = 5
 
 # highest transform_report schema understood
 # (telemetry.report.TRANSFORM_SCHEMA_VERSION)
@@ -127,6 +130,15 @@ def check_anomalies(rec: dict) -> list[str]:
             f"fault injection active: {injected:g} synthetic fault(s) fired "
             "— TPU_ML_FAULT_PLAN is set; expected only in chaos tests, "
             "never in production"
+        )
+    breaches = _counter_total(rec, "slo.breach")
+    if breaches:
+        out.append(
+            f"slo-breach-during-fit: {breaches:g} windowed SLO breach(es) "
+            "fired while this fit ran — a declared TPU_ML_SLO target "
+            "(latency ceiling or throughput floor) burned through its "
+            "tolerance; see the slo.breach timeline instants and the "
+            "/slo endpoint for which objective"
         )
     storm = _recompile_storm(rec)
     if storm:
@@ -284,6 +296,28 @@ def _print_tuning(rec: dict, out) -> None:
     )
 
 
+def _print_health(rec: dict, out) -> None:
+    """The live-monitor rollup stamped at fit end (fit_report schema >= 5):
+    worst component state, any non-OK components, and counted SLO
+    breaches. Absent (empty) when no monitor ran — nothing is printed."""
+    health = rec.get("health") or {}
+    if not health:
+        return
+    components = health.get("components") or {}
+    bad = ", ".join(
+        f"{c}={s}" for c, s in sorted(components.items()) if s != "OK"
+    )
+    line = f"health: {health.get('state', '?')}"
+    if bad:
+        line += f" ({bad})"
+    line += (
+        f"; {health.get('polls', 0)} poll(s), "
+        f"{health.get('transitions', 0)} transition(s), "
+        f"{health.get('slo_breaches', 0)} SLO breach(es)"
+    )
+    print(line, file=out)
+
+
 def render_record(rec: dict, out=sys.stdout) -> list[str]:
     """Print one fit_report; returns its anomaly list."""
     est = rec.get("estimator", "?")
@@ -334,6 +368,7 @@ def render_record(rec: dict, out=sys.stdout) -> list[str]:
         )
     _print_cost_model(rec, out)
     _print_tuning(rec, out)
+    _print_health(rec, out)
     peak = rec.get("peak_device_bytes", 0)
     if peak:
         print(f"peak device memory: {_fmt_bytes(peak)}", file=out)

@@ -1,189 +1,14 @@
-"""Round-long opportunistic on-chip evidence harvester (VERDICT r4 Next #1/#5).
+"""Deprecated: superseded by ``tools/healthd.py`` (same knobs, same
+harvest outputs, plus component health + SLOs + the HTTP exporter)."""
 
-Runs detached for the whole round (``setsid nohup python tools/transport_monitor_r5.py``).
-Every PROBE_INTERVAL_S it probes the accelerator transport in a THROWAWAY
-subprocess (`devicepolicy.probe_transport_subprocess` — an in-process timed-out
-probe poisons the interpreter, see utils/devicepolicy.py:267) and appends one
-JSON line to ``TRANSPORT_LOG_r05.jsonl``.  The moment a probe succeeds it runs
-the full benchmark N_BENCH_RUNS times back-to-back:
-
-* the first complete rc=0 JSON line becomes ``BENCH_OPPORTUNISTIC_r05.json``
-  (primary + spread + derived + extras + accuracy gate — the full contract);
-* every run (rc, duration, JSON line or stderr tail) is appended to
-  ``BENCH_DRIFT_r05.jsonl`` so the r1→r2 27% drift question (VERDICT r4
-  Weak #1 tail) gets an answer from runs executed minutes apart on one
-  transport session.
-
-After harvesting it keeps probing on the coarse interval so the committed log
-is a round-long health timeline either way: if the chip never heals, the log
-itself is the evidence the round asks for.
-
-Safety: bench children get a generous 1 h bound and are stopped with SIGTERM
-(60 s grace) — never an immediate SIGKILL — because hard-killing a JAX process
-mid-compile is what wedges the tunnel for every later process.
-"""
-
-from __future__ import annotations
-
-import datetime
-import json
 import os
-import signal
-import subprocess
+import runpy
 import sys
-import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-
-from spark_rapids_ml_tpu.utils import devicepolicy, knobs  # noqa: E402
-
-LOG_PATH = os.path.join(REPO, "TRANSPORT_LOG_r05.jsonl")
-# Output names are env-overridable so a SUPPLEMENTAL harvest instance can
-# run after the primary landed (e.g. when new bench extras are added
-# mid-round and deserve their own on-chip values: point BENCH_OUT at a
-# _r05b file and the main-loop "already harvested?" check follows it).
-BENCH_OUT = os.path.join(
-    REPO,
-    os.environ.get(
-        knobs.MONITOR_BENCH_OUT.name, "BENCH_OPPORTUNISTIC_r05.json"
-    ),
+sys.stderr.write(
+    "[transport_monitor_r5] deprecated — use tools/healthd.py; forwarding\n"
 )
-DRIFT_OUT = os.path.join(
-    REPO, os.environ.get(knobs.MONITOR_DRIFT_OUT.name, "BENCH_DRIFT_r05.jsonl")
+runpy.run_path(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "healthd.py"),
+    run_name="__main__",
 )
-
-PROBE_INTERVAL_S = float(os.environ.get(knobs.MONITOR_INTERVAL_S.name, "600"))
-PROBE_TIMEOUT_S = float(
-    os.environ.get(knobs.MONITOR_PROBE_TIMEOUT_S.name, "120")
-)
-ROUND_WINDOW_S = float(
-    os.environ.get(knobs.MONITOR_WINDOW_S.name, str(11.5 * 3600))
-)
-N_BENCH_RUNS = int(os.environ.get(knobs.MONITOR_BENCH_RUNS.name, "5"))
-BENCH_TIMEOUT_S = float(
-    os.environ.get(knobs.MONITOR_BENCH_TIMEOUT_S.name, "3600")
-)
-
-START = time.time()
-
-
-def now_iso() -> str:
-    return datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
-
-
-def append(path: str, record: dict) -> None:
-    with open(path, "a") as f:
-        f.write(json.dumps(record) + "\n")
-        f.flush()
-        os.fsync(f.fileno())
-
-
-def run_bench(run_idx: int) -> dict:
-    """One full bench run; returns the drift-log record."""
-    env = dict(os.environ)
-    # The monitor just proved the transport healthy; the bench's own
-    # preamble only needs a short re-confirmation window.
-    env[knobs.BENCH_PROBE_WINDOW_S.name] = "300"
-    start = time.time()
-    proc = subprocess.Popen(
-        [sys.executable, os.path.join(REPO, "bench.py")],
-        cwd=REPO,
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        text=True,
-        start_new_session=True,
-    )
-    try:
-        out, err = proc.communicate(timeout=BENCH_TIMEOUT_S)
-    except subprocess.TimeoutExpired:
-        # SIGTERM the whole process group, generous grace, never jump
-        # straight to SIGKILL (a hard kill mid-compile wedges the tunnel).
-        os.killpg(proc.pid, signal.SIGTERM)
-        try:
-            out, err = proc.communicate(timeout=60)
-        except subprocess.TimeoutExpired:
-            os.killpg(proc.pid, signal.SIGKILL)
-            out, err = proc.communicate()
-    took = time.time() - start
-    json_line = None
-    for line in (out or "").splitlines():
-        line = line.strip()
-        if line.startswith("{") and '"metric"' in line:
-            json_line = line
-    record = {
-        "t": now_iso(),
-        "elapsed_s": round(time.time() - START, 1),
-        "run": run_idx,
-        "rc": proc.returncode,
-        "took_s": round(took, 1),
-        "json": json.loads(json_line) if json_line else None,
-    }
-    if proc.returncode != 0 or json_line is None:
-        record["stderr_tail"] = (err or "")[-2000:]
-    return record
-
-
-def harvest() -> bool:
-    """Run the bench N times; write BENCH_OPPORTUNISTIC on first full rc=0."""
-    wrote_primary = False
-    for i in range(1, N_BENCH_RUNS + 1):
-        rec = run_bench(i)
-        append(DRIFT_OUT, rec)
-        print(f"[monitor] bench run {i}/{N_BENCH_RUNS}: rc={rec['rc']} "
-              f"took={rec['took_s']}s", flush=True)
-        if not wrote_primary and rec["rc"] == 0 and rec["json"] is not None:
-            payload = dict(rec["json"])
-            # bench.py's snapshot-time fallback only trusts a harvest
-            # stamped fresh enough to be from the CURRENT round — a
-            # committed harvest from a past round must never be re-emitted
-            # as this round's measurement
-            payload["harvested_at_unix"] = round(time.time(), 1)
-            payload["harvested_at"] = now_iso()
-            with open(BENCH_OUT, "w") as f:
-                json.dump(payload, f, indent=2)
-                f.write("\n")
-            wrote_primary = True
-        if rec["rc"] != 0 and rec["json"] is None and i >= 2 and not wrote_primary:
-            # Transport re-wedged mid-harvest; go back to probing.
-            return False
-    return wrote_primary
-
-
-def main() -> None:
-    harvested = os.path.exists(BENCH_OUT)
-    attempt = 0
-    print(f"[monitor] start {now_iso()} interval={PROBE_INTERVAL_S}s "
-          f"window={ROUND_WINDOW_S}s harvested={harvested}", flush=True)
-    while time.time() - START < ROUND_WINDOW_S:
-        attempt += 1
-        t0 = time.time()
-        ok, detail = devicepolicy.probe_transport_subprocess(timeout=PROBE_TIMEOUT_S)
-        # last non-empty line: the child's stderr opens with harmless
-        # platform warnings; the diagnostic is at the end
-        lines = [l for l in (detail or "").splitlines() if l.strip()]
-        append(LOG_PATH, {
-            "t": now_iso(),
-            "elapsed_s": round(time.time() - START, 1),
-            "attempt": attempt,
-            "ok": ok,
-            "took_s": round(time.time() - t0, 1),
-            "detail": (lines[-1] if lines else "")[:200],
-        })
-        print(f"[monitor] probe {attempt}: ok={ok} ({detail.splitlines()[0][:120] if detail else ''})",
-              flush=True)
-        if ok and not harvested:
-            append(LOG_PATH, {"t": now_iso(), "event": "harvest_start"})
-            harvested = harvest()
-            append(LOG_PATH, {
-                "t": now_iso(),
-                "event": "harvest_done",
-                "complete": harvested,
-            })
-        time.sleep(PROBE_INTERVAL_S)
-    print(f"[monitor] window exhausted at {now_iso()}", flush=True)
-
-
-if __name__ == "__main__":
-    main()
