@@ -228,6 +228,16 @@ def _ledger_entry(record: dict) -> dict:
         # stage's rollup): a DEGRADED/FAILING stamp tells the sentinel's
         # reader that a slow entry may be environment, not regression
         "health_state": (record.get("health") or {}).get("state"),
+        # elastic-scheduler counters for the whole bench process: a ledger
+        # entry whose wall-clock regressed WITH nonzero hedges/reassigns/
+        # quarantines is a sick run, not a perf regression — the sentinel's
+        # reader needs that distinction on the entry itself
+        "scheduler": {
+            "hedges": snap.counter("scheduler.hedge"),
+            "reassigns": snap.counter("scheduler.reassign"),
+            "quarantines": snap.counter("worker.quarantine"),
+            "barrier_retries": snap.counter("scheduler.barrier_retry"),
+        },
     }
     # stamp the tuning signature ONLY when the run deviates from the
     # defaults (tuner searching, or a non-f32 precision policy): default
@@ -493,6 +503,28 @@ def main() -> None:
 
     # --- end-to-end DataFrame fit (ingestion + worker hop + device Gram) --
     df_seconds = _bench_df_fit()
+
+    # --- elastic-scheduler healthy-path contract (this PR) ----------------
+    # the DataFrame fit above ran through the supervised work-queue
+    # scheduler: on a healthy host it must complete with ZERO speculative
+    # hedges and ZERO quarantined worker slots — a nonzero count here means
+    # the hedge threshold is firing on normal latency or a worker is
+    # crash-looping in the clean path; hard contract in --smoke, reported
+    # (not fatal) on the real chip where ambient stragglers are possible
+    from spark_rapids_ml_tpu.telemetry import REGISTRY as _SCHED_REG
+
+    _sched_snap = _SCHED_REG.snapshot()
+    _hedges = _sched_snap.counter("scheduler.hedge")
+    _quarantines = _sched_snap.counter("worker.quarantine")
+    if _hedges or _quarantines:
+        msg = (
+            f"healthy-path scheduler contract violated: "
+            f"{_hedges:g} hedge(s), {_quarantines:g} quarantine(s) "
+            "during a fault-free bench run"
+        )
+        if SMOKE:
+            raise SystemExit(msg)
+        print(f"# {msg}", file=sys.stderr)
 
     accuracy_ok = bool(min_cosine >= 0.9999)
     tag = "_SMOKE" if SMOKE else ""

@@ -6,10 +6,13 @@ from __future__ import annotations
 import atexit
 import logging
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
 import threading
+import time
+from collections import deque
 from typing import Any, Iterator
 
 import numpy as np
@@ -17,6 +20,13 @@ import pyarrow as pa
 
 from spark_rapids_ml_tpu.localspark import types as T
 from spark_rapids_ml_tpu.localspark import worker as W
+from spark_rapids_ml_tpu.resilience import faults, sites
+from spark_rapids_ml_tpu.resilience.supervisor import (
+    WorkerSupervisor,
+    hedge_config,
+)
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 from spark_rapids_ml_tpu.utils import devicepolicy, knobs
 from spark_rapids_ml_tpu.localspark.dataframe import (
     DataFrame,
@@ -31,6 +41,32 @@ logger = logging.getLogger("spark_rapids_ml_tpu")
 class WorkerException(RuntimeError):
     """A mapInArrow plan function raised inside a worker process; carries the
     worker-side traceback (the analog of pyspark's PythonException)."""
+
+
+class _BarrierInfraFailure(Exception):
+    """Internal: a barrier epoch failed on *infrastructure* (worker death,
+    injected preemption, rank-join deadline) — retryable with fresh workers,
+    unlike a plan error, which would only run the same bug twice."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+def _require_results(
+    results: list, stage: str
+) -> list:
+    """Every partition must have produced a result; a silent ``None`` used
+    to be yielded as an empty batch list — data loss dressed up as an empty
+    partition. Name the holes and refuse instead."""
+    missing = [i for i, r in enumerate(results) if r is None]
+    if missing:
+        raise WorkerException(
+            f"{stage} stage finished without a result for partition(s) "
+            f"{missing}: no worker returned a payload for them and no "
+            "failure was recorded — refusing to yield partial output"
+        )
+    return results
 
 
 class _Worker:
@@ -61,7 +97,8 @@ class _Worker:
         schema_bytes: bytes,
         context: dict | None = None,
         partition: int | None = None,
-    ) -> bytes:
+        defer_trailer: bool = False,
+    ) -> bytes | tuple[bytes, bytes]:
         trailer = b""
         with self._lock:
             try:
@@ -116,6 +153,10 @@ class _Worker:
                 "mapInArrow plan function failed in the worker process:\n"
                 + cloudpickle.loads(payload)
             )
+        if defer_trailer:
+            # the caller decides whether this attempt's telemetry counts —
+            # a hedge loser's trailer must be dropped, not merged twice
+            return payload, trailer
         self._merge_telemetry(trailer, partition)
         return payload
 
@@ -229,9 +270,22 @@ class LocalSparkSession:
             raise ValueError(
                 f"{knobs.BARRIER_TIMEOUT_S.name} must be > 0, got {raw_bt!r}"
             )
-        self._workers: list[_Worker] = []
+        # worker lifecycle is owned by the supervisor: leases, bounded
+        # respawn with backoff, per-slot circuit breaker (see
+        # resilience/supervisor.py) — replacing the old unbounded
+        # remove-dead-and-respawn loop
+        self._supervisor = WorkerSupervisor(
+            lambda extra: _Worker({**self._worker_env, **extra}),
+            num_workers,
+        )
         self._closed = False
         atexit.register(self.stop)
+
+    @property
+    def _workers(self) -> list[_Worker]:
+        """Live supervised workers in slot order — kept as a property for
+        the tests and diagnostics that peeked at the old worker list."""
+        return self._supervisor.live_workers()
 
     # -- DataFrame construction --------------------------------------------
 
@@ -309,61 +363,221 @@ class LocalSparkSession:
                 out.append(b.slice(at, self.max_records_per_batch))
         return W.batches_to_ipc(out, schema)
 
-    def _ensure_workers(self) -> list[_Worker]:
-        if self._closed:
-            raise RuntimeError("session is stopped")
-        # a crashed worker (segfault/OOM) is replaced, not reused — one
-        # transient death must not poison the session
-        for w in [w for w in self._workers if w.dead or w.proc.poll() is not None]:
-            self._workers.remove(w)
-            w.close()
-        while len(self._workers) < self.num_workers:
-            self._workers.append(_Worker(self._worker_env))
-        return self._workers
-
     def _run_map_in_arrow(
         self, func, task_parts: list[bytes], target: pa.Schema
     ) -> Iterator[list[pa.RecordBatch]]:
+        """Elastic stage scheduler.
+
+        Partitions flow through a work queue instead of the old static
+        round-robin split, which made every worker death fatal to the whole
+        stage. Three behaviors fall out:
+
+        - a worker death fails only the *attempt* — the partition is
+          re-queued and migrates to a surviving slot
+          (``scheduler.reassign``) while the supervisor respawns, backs
+          off, or quarantines the crashed slot;
+        - an idle slot *hedges* a straggler: once a running partition's age
+          exceeds ``max(TPU_ML_HEDGE_FLOOR_S, TPU_ML_HEDGE_FACTOR × p50)``
+          of completed-partition runtimes, a duplicate attempt launches and
+          the first result wins (``scheduler.hedge``); the loser's payload
+          AND telemetry trailer are discarded, so nothing double-counts;
+        - each slot is seeded its first partition deterministically (the
+          worker-reuse and both-workers-used placement contracts), only the
+          remainder is contended.
+
+        Plan errors — the worker survived, the user's function raised —
+        stay immediately fatal: re-running a deterministic bug is not
+        resilience, it is the same traceback twice.
+        """
         import cloudpickle
+
+        from spark_rapids_ml_tpu.utils.config import get_config
 
         fn_bytes = cloudpickle.dumps(func)  # fails here exactly like Spark would
         schema_bytes = target.serialize().to_pybytes()
-        workers = self._ensure_workers()
-        results: list[list[pa.RecordBatch] | None] = [None] * len(task_parts)
+        if self._closed:
+            raise RuntimeError("session is stopped")
+        n = len(task_parts)
+        if n == 0:
+            return
+        sup = self._supervisor
+        sup.begin_stage()
+        slots = sup.available_slots()
+        hedge_factor, hedge_floor = hedge_config()
+        max_attempts = 1 + max(0, get_config().task_retries)
 
-        def run_on(worker: _Worker, indices: list[int]) -> None:
-            for i in indices:
-                payload = worker.run_task(
-                    fn_bytes, task_parts[i], schema_bytes, partition=i
-                )
-                results[i], _ = W.batches_from_ipc(payload)
+        cv = threading.Condition()
+        results: list[list[pa.RecordBatch] | None] = [None] * n
+        seeds: dict[int, deque] = {s: deque() for s in slots}
+        queue: deque = deque()
+        for i in range(n):
+            if i < len(slots):
+                seeds[slots[i]].append(i)
+            else:
+                queue.append(i)
+        attempts_left = [max_attempts] * n
+        done = [False] * n
+        hedged = [False] * n
+        inflight: dict[int, dict] = {}  # idx -> {"t0": start, "count": live}
+        durations: list[float] = []
+        fatal: list[BaseException] = []
+        state = {"done": 0, "last_error": None}
 
-        assignments = [
-            (workers[w], [i for i in range(len(task_parts)) if i % len(workers) == w])
-            for w in range(len(workers))
+        def _pick(slot):
+            # under cv: the next (partition, is_hedge) for this slot, or None
+            if seeds[slot]:
+                return seeds[slot].popleft(), False
+            if queue:
+                return queue.popleft(), False
+            if hedge_factor > 0 and durations:
+                med = sorted(durations)[len(durations) // 2]
+                limit = max(hedge_floor, hedge_factor * med)
+                now = time.monotonic()
+                for idx, info in inflight.items():
+                    if (
+                        not done[idx]
+                        and not hedged[idx]
+                        and now - info["t0"] > limit
+                    ):
+                        hedged[idx] = True
+                        return idx, True
+            return None
+
+        def _depart(idx):
+            # under cv: one attempt of idx left flight
+            info = inflight.get(idx)
+            if info is not None:
+                info["count"] -= 1
+                if info["count"] <= 0:
+                    del inflight[idx]
+
+        def _attempt_failed(idx, exc):
+            # under cv: consume an attempt — requeue, defer to a live hedge
+            # twin, or fail the stage once every recourse is spent
+            state["last_error"] = exc
+            if done[idx]:
+                return
+            attempts_left[idx] -= 1
+            if inflight.get(idx, {"count": 0})["count"] > 0:
+                return  # a hedge twin is still running; let it decide
+            if attempts_left[idx] > 0:
+                queue.append(idx)
+                REGISTRY.counter_inc("scheduler.reassign", partition=str(idx))
+                TIMELINE.record_instant("scheduler.reassign", partition=str(idx))
+            else:
+                fatal.append(exc)
+
+        def _runner(slot):
+            worker = None
+            try:
+                while True:
+                    with cv:
+                        unit = None
+                        while unit is None:
+                            if fatal or state["done"] >= n:
+                                return
+                            unit = _pick(slot)
+                            if unit is None:
+                                cv.wait(0.05)
+                        idx, is_hedge = unit
+                        info = inflight.setdefault(
+                            idx, {"t0": time.monotonic(), "count": 0}
+                        )
+                        info["count"] += 1
+                        if is_hedge:
+                            REGISTRY.counter_inc(
+                                "scheduler.hedge", partition=str(idx)
+                            )
+                            TIMELINE.record_instant(
+                                "scheduler.hedge",
+                                partition=str(idx),
+                                slot=str(slot),
+                            )
+                            logger.info(
+                                "hedging straggler partition %d on slot %d",
+                                idx, slot,
+                            )
+                        else:
+                            REGISTRY.counter_inc("scheduler.tasks")
+                    if worker is None or worker.dead:
+                        worker = sup.checkout(slot)
+                        if worker is None:  # quarantined/stopped under us
+                            with cv:
+                                _depart(idx)
+                                _attempt_failed(
+                                    idx,
+                                    WorkerException(
+                                        f"worker slot {slot} is unavailable"
+                                    ),
+                                )
+                                cv.notify_all()
+                            return
+                    t0 = time.monotonic()
+                    try:
+                        faults.inject(sites.SCHEDULER_TASK)
+                        payload, trailer = worker.run_task(
+                            fn_bytes,
+                            task_parts[idx],
+                            schema_bytes,
+                            partition=idx,
+                            defer_trailer=True,
+                        )
+                        batches, _ = W.batches_from_ipc(payload)
+                    except faults.FaultInjected as e:
+                        # injected dispatch failure: the worker is fine,
+                        # the attempt is spent
+                        with cv:
+                            _depart(idx)
+                            _attempt_failed(idx, e)
+                            cv.notify_all()
+                        continue
+                    except WorkerException as e:
+                        if worker.dead:
+                            quarantined = sup.report_crash(slot, e)
+                            worker = None
+                            with cv:
+                                _depart(idx)
+                                _attempt_failed(idx, e)
+                                cv.notify_all()
+                            if quarantined:
+                                return
+                            continue
+                        with cv:  # plan error: fatal, never retried
+                            _depart(idx)
+                            fatal.append(e)
+                            cv.notify_all()
+                        return
+                    sup.report_success(slot)
+                    accept = False
+                    with cv:
+                        _depart(idx)
+                        if not done[idx]:
+                            done[idx] = True
+                            state["done"] += 1
+                            results[idx] = batches
+                            durations.append(time.monotonic() - t0)
+                            accept = True
+                        cv.notify_all()
+                    if accept:
+                        _Worker._merge_telemetry(trailer, idx)
+            except BaseException as e:  # noqa: BLE001 - surfaced to the stage
+                with cv:
+                    fatal.append(e)
+                    cv.notify_all()
+
+        threads = [
+            threading.Thread(target=_runner, args=(s,), daemon=True)
+            for s in slots
         ]
-        live = [a for a in assignments if a[1]]
-        if len(live) == 1:
-            run_on(*live[0])
-        elif live:
-            errors: list[BaseException] = []
-
-            def guarded(a):
-                try:
-                    run_on(*a)
-                except BaseException as e:  # noqa: BLE001 - re-raised below
-                    errors.append(e)
-
-            threads = [
-                threading.Thread(target=guarded, args=(a,), daemon=True) for a in live
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            if errors:
-                raise errors[0]
-        yield from (r if r is not None else [] for r in results)
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if fatal:
+            raise fatal[0]
+        if state["done"] < n and state["last_error"] is not None:
+            raise state["last_error"]
+        yield from _require_results(results, "mapInArrow")
 
     def _run_map_in_arrow_barrier(
         self, func, task_parts: list[bytes], target: pa.Schema
@@ -381,63 +595,156 @@ class LocalSparkSession:
         Spark executors finishing a barrier stage. The startup probe is
         disarmed for the same reason; the bootstrap-trigger scrub (the part
         that prevents the accelerator hang) still applies.
+
+        A barrier stage is all-or-nothing — its membership is fixed at
+        launch, so a single lost rank dooms the epoch. Instead of turning
+        one preemption into a failed fit, the whole round is retried with
+        fresh workers up to ``TPU_ML_BARRIER_RETRIES`` times
+        (``scheduler.barrier_retry``). Only *infrastructure* failures
+        (worker death, injected preemption, rank-join deadline) retry; a
+        plan error raises immediately, every time.
         """
         import cloudpickle
 
-        from spark_rapids_ml_tpu.utils import devicepolicy
-
         if self._closed:
             raise RuntimeError("session is stopped")
-        n = len(task_parts)
         fn_bytes = cloudpickle.dumps(func)
         schema_bytes = target.serialize().to_pybytes()
+        raw = os.environ.get(knobs.BARRIER_RETRIES.name, "")
+        try:
+            retries = max(0, int(raw)) if raw else 1
+        except ValueError:
+            retries = 1
+        results = None
+        for epoch in range(retries + 1):
+            try:
+                results = self._run_barrier_epoch(
+                    fn_bytes, task_parts, schema_bytes
+                )
+                break
+            except _BarrierInfraFailure as e:
+                if epoch >= retries:
+                    raise e.cause
+                REGISTRY.counter_inc("scheduler.barrier_retry")
+                TIMELINE.record_instant(
+                    "scheduler.barrier_retry", epoch=str(epoch)
+                )
+                logger.warning(
+                    "barrier epoch %d lost a rank to infrastructure (%s); "
+                    "retrying the whole round with fresh workers (%d "
+                    "retry(ies) left)",
+                    epoch, e, retries - epoch,
+                )
+        yield from _require_results(results, "mapInArrow(barrier)")
+
+    def _run_barrier_epoch(
+        self, fn_bytes: bytes, task_parts: list[bytes], schema_bytes: bytes
+    ) -> list:
+        """One all-or-nothing barrier round: fresh workers, deadline-bounded
+        rank joins, teardown + scratch-dir cleanup guaranteed by finally.
+
+        Raises :class:`_BarrierInfraFailure` when the round died to
+        infrastructure (retryable), or the plan error itself when user code
+        raised with its worker still alive (never retried).
+        """
+        n = len(task_parts)
         barrier_dir = tempfile.mkdtemp(prefix="localspark-barrier-")
         env = dict(self._worker_env)
         env.pop(devicepolicy.PROBE_VAR, None)
-        workers = [_Worker(env) for _ in range(n)]
+        workers: list[_Worker] = []
         results: list[list[pa.RecordBatch] | None] = [None] * n
-        errors: list[BaseException] = []
+        errors: list[tuple[int, BaseException]] = []
+        torn_down = False
 
-        def run_one(rank: int) -> None:
-            context = {
-                "partition_id": rank,
-                "num_tasks": n,
-                "barrier_dir": barrier_dir,
-                "timeout": self.barrier_timeout,
-            }
-            try:
-                payload = workers[rank].run_task(
-                    fn_bytes, task_parts[rank], schema_bytes, context,
-                    partition=rank,
-                )
-                results[rank], _ = W.batches_from_ipc(payload)
-            except BaseException as e:  # noqa: BLE001 - re-raised below
-                errors.append(e)
+        def close_all() -> None:
+            for w in workers:
+                w.close()
 
-        threads = [
-            threading.Thread(target=run_one, args=(r,), daemon=True)
-            for r in range(n)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        for w in workers:
-            w.close()
-        import shutil
+        try:
+            workers.extend(_Worker(env) for _ in range(n))
 
-        shutil.rmtree(barrier_dir, ignore_errors=True)
+            def run_one(rank: int) -> None:
+                context = {
+                    "partition_id": rank,
+                    "num_tasks": n,
+                    "barrier_dir": barrier_dir,
+                    "timeout": self.barrier_timeout,
+                }
+                try:
+                    REGISTRY.counter_inc("scheduler.tasks")
+                    faults.inject(sites.SCHEDULER_RANK)
+                    payload = workers[rank].run_task(
+                        fn_bytes, task_parts[rank], schema_bytes, context,
+                        partition=rank,
+                    )
+                    results[rank], _ = W.batches_from_ipc(payload)
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    errors.append((rank, e))
+
+            threads = [
+                threading.Thread(target=run_one, args=(r,), daemon=True)
+                for r in range(n)
+            ]
+            for t in threads:
+                t.start()
+            # bounded joins: the in-worker rendezvous is already capped at
+            # barrier_timeout, so 2x + grace only catches a wedged compute
+            deadline = time.monotonic() + 2.0 * self.barrier_timeout + 30.0
+            pending = list(threads)
+            while pending:
+                for t in list(pending):
+                    t.join(timeout=0.1)
+                    if not t.is_alive():
+                        pending.remove(t)
+                if not pending:
+                    break
+                if errors and not torn_down:
+                    # membership is fixed: one failed rank dooms the epoch.
+                    # Kill the survivors now rather than letting them wait
+                    # out the rendezvous timeout on a rank that never comes.
+                    torn_down = True
+                    close_all()
+                elif time.monotonic() > deadline:
+                    errors.append((-1, WorkerException(
+                        f"barrier rank(s) failed to join within "
+                        f"{2.0 * self.barrier_timeout + 30.0:.0f}s "
+                        f"(2x {knobs.BARRIER_TIMEOUT_S.name} + grace); "
+                        "tearing the epoch down"
+                    )))
+                    torn_down = True
+                    close_all()
+                    for t in pending:
+                        t.join(timeout=15)
+                    break
+        finally:
+            close_all()
+            shutil.rmtree(barrier_dir, ignore_errors=True)
         if errors:
-            raise errors[0]
-        yield from (r if r is not None else [] for r in results)
+            def _infra(rank: int, exc: BaseException) -> bool:
+                return (
+                    isinstance(exc, faults.FaultInjected)
+                    or rank < 0
+                    or (rank < len(workers) and workers[rank].dead)
+                )
+
+            plan_errors = [e for r, e in errors if not _infra(r, e)]
+            if plan_errors:
+                raise plan_errors[0]
+            # prefer an injected fault as the representative cause: the
+            # early teardown above kills the surviving ranks, so their
+            # died-mid-task errors are downstream noise of the first fault
+            cause = next(
+                (e for _, e in errors if isinstance(e, faults.FaultInjected)),
+                errors[0][1],
+            )
+            raise _BarrierInfraFailure(cause)
+        return results
 
     # -- lifecycle -----------------------------------------------------------
 
     def stop(self) -> None:
         self._closed = True
-        workers, self._workers = self._workers, []
-        for w in workers:
-            w.close()
+        self._supervisor.close()
 
     def __enter__(self) -> "LocalSparkSession":
         return self

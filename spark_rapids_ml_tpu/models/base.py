@@ -346,9 +346,16 @@ def _instrumented_fit(fit):
 
         depth = getattr(_fit_depth, "value", 0)
         _fit_depth.value = depth + 1
-        cap = telemetry.begin_fit(
-            type(self).__name__, getattr(self, "uid", "") or ""
-        )
+        try:
+            cap = telemetry.begin_fit(
+                type(self).__name__, getattr(self, "uid", "") or ""
+            )
+        except BaseException:
+            # begin_fit can refuse the fit (health-driven admission
+            # control); the depth must not leak or every later fit in this
+            # thread would be treated as nested and never exported
+            _fit_depth.value = depth
+            raise
         try:
             model = fit(self, *args, **kwargs)
         finally:

@@ -13,16 +13,28 @@ The backoff loop itself is ``resilience.retry.call_with_retry`` — the
 shared policy, configured here for Spark-task semantics (ANY exception
 consumes an attempt, no deadline, no jitter) — which also counts retries
 in telemetry and never sleeps after the final failed attempt.
+
+The parallel path also *hedges* stragglers (Spark's speculative
+execution): once a running task exceeds ``max(TPU_ML_HEDGE_FLOOR_S,
+TPU_ML_HEDGE_FACTOR × p50)`` of completed-task runtimes, one duplicate
+attempt is submitted and the first success wins (``scheduler.hedge``).
+Retry answers "it failed"; hedging answers "it is *taking* too long" —
+a wedged device call never fails, so no retry budget ever fires for it.
 """
 
 from __future__ import annotations
 
 import logging
-from concurrent.futures import ThreadPoolExecutor
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Callable, Sequence, TypeVar
 
 from spark_rapids_ml_tpu.resilience import faults
 from spark_rapids_ml_tpu.resilience import retry as _retry
+from spark_rapids_ml_tpu.resilience.supervisor import hedge_config
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
 logger = logging.getLogger("spark_rapids_ml_tpu")
 
@@ -97,5 +109,75 @@ def run_partition_tasks(
 
     if len(items) == 1 or max_workers <= 1:
         return [attempt((i, it)) for i, it in enumerate(items)]
-    with ThreadPoolExecutor(max_workers=min(max_workers, len(items))) as pool:
-        return list(pool.map(attempt, enumerate(items)))
+
+    hedge_factor, hedge_floor = hedge_config()
+    n = len(items)
+    lk = threading.Lock()
+    t_start: dict[int, float] = {}   # idx -> when an attempt actually RAN
+    completed: list[float] = []      # durations of finished attempts (p50)
+
+    def timed_attempt(idx_item):
+        idx, _ = idx_item
+        t0 = time.monotonic()
+        with lk:
+            t_start.setdefault(idx, t0)
+        out = attempt(idx_item)
+        with lk:
+            completed.append(time.monotonic() - t0)
+        return out
+
+    results: dict[int, R] = {}
+    with ThreadPoolExecutor(max_workers=min(max_workers, n)) as pool:
+        futs = {
+            i: [pool.submit(timed_attempt, (i, it))]
+            for i, it in enumerate(items)
+        }
+        pending = set(range(n))
+        while pending:
+            wait(
+                [f for i in pending for f in futs[i]],
+                timeout=0.05,
+                return_when=FIRST_COMPLETED,
+            )
+            now = time.monotonic()
+            for i in list(pending):
+                fs = futs[i]
+                done_fs = [f for f in fs if f.done()]
+                ok = next(
+                    (f for f in done_fs if f.exception() is None), None
+                )
+                if ok is not None:
+                    # first success wins; a queued twin is cancelled, a
+                    # running one finishes into the void
+                    results[i] = ok.result()
+                    pending.discard(i)
+                    for f in fs:
+                        f.cancel()
+                elif len(done_fs) == len(fs):
+                    raise done_fs[0].exception()
+            if hedge_factor <= 0 or not pending:
+                continue
+            with lk:
+                med = (
+                    sorted(completed)[len(completed) // 2]
+                    if completed else None
+                )
+                starts = dict(t_start)
+            if med is None:
+                continue
+            limit = max(hedge_floor, hedge_factor * med)
+            for i in list(pending):
+                t0 = starts.get(i)
+                if (
+                    len(futs[i]) == 1   # hedge a straggler at most once
+                    and t0 is not None
+                    and now - t0 > limit
+                ):
+                    REGISTRY.counter_inc("scheduler.hedge", task=str(i))
+                    TIMELINE.record_instant("scheduler.hedge", task=str(i))
+                    logger.info(
+                        "hedging straggler partition task %d "
+                        "(%.2fs > %.2fs)", i, now - t0, limit,
+                    )
+                    futs[i].append(pool.submit(timed_attempt, (i, items[i])))
+    return [results[i] for i in range(n)]

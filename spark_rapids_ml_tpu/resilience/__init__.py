@@ -21,6 +21,13 @@ contract instead, in two halves:
   and ``utils/devicepolicy`` — and unlike the loop it replaced, it never
   sleeps after the final failed attempt.
 
+- :mod:`.supervisor` — worker-slot supervision for ``localspark``: leases
+  (spawn time, task count, last-trailer heartbeat), bounded respawn with
+  exponential backoff, and a per-slot circuit breaker that quarantines a
+  crash-looping slot instead of respawning it forever. The elastic stage
+  scheduler in ``localspark.session`` builds on it to migrate a dead
+  worker's partitions to survivors and hedge stragglers.
+
 The recovery behaviors themselves live at the choke points they protect:
 ``spark.ingest.stream_fold`` self-heals device OOM by bisecting the chunk
 size, checkpoints its carry + chunk cursor through
@@ -38,6 +45,12 @@ from spark_rapids_ml_tpu.resilience.faults import (  # noqa: F401
     inject,
     parse_plan,
     reset_faults,
+)
+from spark_rapids_ml_tpu.resilience.supervisor import (  # noqa: F401
+    SlotLease,
+    WorkerSupervisor,
+    active_summary,
+    hedge_config,
 )
 from spark_rapids_ml_tpu.resilience.retry import (  # noqa: F401
     ErrorClass,

@@ -20,6 +20,11 @@ FOLD_DISPATCH = "fold.dispatch"   # streamed-fit chunk dispatch
 FOLD_WAIT = "fold.wait"           # streamed-fit terminal device wait
 INGEST_CHUNK = "ingest.chunk"     # streamed-fit chunk staging
 AUTOTUNE_TRIAL = "autotune.trial"  # one timing trial of an autotune search
+# driver-side elastic-scheduler gates: unlike worker.task (which every
+# worker process counts independently), these count in the DRIVER, so a
+# plan can fail exactly one dispatch / one rank of one epoch
+SCHEDULER_TASK = "scheduler.task"  # one task dispatch by the work queue
+SCHEDULER_RANK = "scheduler.rank"  # one rank launch of a barrier epoch
 
 FAULT_SITES: frozenset[str] = frozenset({
     WORKER_TASK,
@@ -29,4 +34,6 @@ FAULT_SITES: frozenset[str] = frozenset({
     FOLD_WAIT,
     INGEST_CHUNK,
     AUTOTUNE_TRIAL,
+    SCHEDULER_TASK,
+    SCHEDULER_RANK,
 })

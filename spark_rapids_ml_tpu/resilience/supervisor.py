@@ -1,0 +1,348 @@
+"""Worker-slot supervision: leases, bounded respawn, per-slot circuit breaker.
+
+``localspark``'s original worker pool replaced a crashed worker by
+unconditionally spawning another (session.py ``_ensure_workers``) — correct
+for one transient death, an infinite respawn loop for a poisoned slot (bad
+device, corrupt env, a plan function that kills every process it touches).
+This module owns the lifecycle instead:
+
+- every worker occupies a numbered **slot** and holds a **lease** (spawn
+  time, tasks completed, last telemetry-trailer heartbeat) the health
+  monitor and ``/healthz`` can inspect;
+- a crashed slot respawns with **exponential backoff**
+  (``TPU_ML_WORKER_RESPAWN_BACKOFF_S`` base, doubling per consecutive
+  crash) instead of immediately;
+- ``TPU_ML_WORKER_BREAKER_THRESHOLD`` consecutive crashes open the slot's
+  **circuit breaker**: the slot is quarantined — no further respawns — and
+  the stage continues on the surviving slots (counted as
+  ``worker.quarantine``, surfaced as the ``scheduler`` health component);
+- when *every* slot is quarantined, the next stage moves the
+  longest-quarantined slot to **half-open** (one probe respawn, breaker
+  re-opens instantly on another crash) so a session poisoned by a
+  since-cleared condition — e.g. a fault plan removed from the env — can
+  recover instead of being bricked.
+
+The supervisor publishes ``worker.slots`` / ``worker.quarantined`` gauges
+(the health monitor's evidence) and registers itself in a module-level
+registry so the HTTP exporter can stamp live lease/quarantine state into
+the ``/healthz`` payload.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
+from spark_rapids_ml_tpu.utils import knobs
+
+logger = logging.getLogger("spark_rapids_ml_tpu")
+
+BREAKER_THRESHOLD_VAR = knobs.WORKER_BREAKER_THRESHOLD.name
+RESPAWN_BACKOFF_VAR = knobs.WORKER_RESPAWN_BACKOFF_S.name
+HEDGE_FACTOR_VAR = knobs.HEDGE_FACTOR.name
+HEDGE_FLOOR_VAR = knobs.HEDGE_FLOOR_S.name
+WORKER_SLOT_VAR = knobs.WORKER_SLOT.name
+
+# backoff is bounded: a quarantine decision, not a sleep, is how a
+# crash-looping slot stops consuming the stage's wall clock
+_MAX_BACKOFF_S = 2.0
+
+
+def _env_float(var: str, default: float) -> float:
+    try:
+        return float(os.environ.get(var, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(var: str, default: int) -> int:
+    try:
+        return int(os.environ.get(var, "") or default)
+    except ValueError:
+        return default
+
+
+def hedge_config() -> tuple[float, float]:
+    """(factor, floor_s) for straggler hedging; factor 0 disables."""
+    return (
+        max(0.0, _env_float(HEDGE_FACTOR_VAR, 4.0)),
+        max(0.0, _env_float(HEDGE_FLOOR_VAR, 1.0)),
+    )
+
+
+@dataclass
+class SlotLease:
+    """The supervised state of one worker slot."""
+
+    slot: int
+    worker: object | None = None          # live _Worker (or None)
+    spawned_at: float = 0.0               # monotonic spawn stamp
+    tasks_done: int = 0
+    last_trailer: float = 0.0             # monotonic last-success stamp
+    consecutive_crashes: int = 0
+    total_crashes: int = 0
+    respawns: int = 0
+    quarantined: bool = False
+    quarantined_at: float = 0.0
+    next_spawn_at: float = 0.0            # backoff gate (monotonic)
+    last_error: str = ""
+
+    def summary(self, now: float) -> dict:
+        return {
+            "live": self.worker is not None,
+            "age_s": round(now - self.spawned_at, 3) if self.worker else None,
+            "tasks_done": self.tasks_done,
+            "last_trailer_age_s": (
+                round(now - self.last_trailer, 3) if self.last_trailer else None
+            ),
+            "consecutive_crashes": self.consecutive_crashes,
+            "total_crashes": self.total_crashes,
+            "respawns": self.respawns,
+            "quarantined": self.quarantined,
+            "last_error": self.last_error[:160],
+        }
+
+
+class WorkerSupervisor:
+    """Supervise ``num_slots`` worker processes built by ``spawn_fn``.
+
+    ``spawn_fn(extra_env)`` must return an object with ``dead``/``proc``/
+    ``close()`` (the session's ``_Worker``); ``extra_env`` carries the
+    slot stamp (``TPU_ML_WORKER_SLOT``) so diagnostics — and slot-targeted
+    chaos plans — can tell slots apart.
+    """
+
+    def __init__(
+        self,
+        spawn_fn: Callable[[dict], object],
+        num_slots: int,
+        *,
+        breaker_threshold: int | None = None,
+        backoff_s: float | None = None,
+    ):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self._spawn_fn = spawn_fn
+        self.num_slots = num_slots
+        self.breaker_threshold = max(
+            1,
+            _env_int(BREAKER_THRESHOLD_VAR, 3)
+            if breaker_threshold is None
+            else breaker_threshold,
+        )
+        self.backoff_s = max(
+            0.0,
+            _env_float(RESPAWN_BACKOFF_VAR, 0.05)
+            if backoff_s is None
+            else backoff_s,
+        )
+        self._lock = threading.Lock()
+        self._slots = [SlotLease(slot=i) for i in range(num_slots)]
+        self._closed = False
+        REGISTRY.gauge_set("worker.slots", num_slots)
+        REGISTRY.gauge_set("worker.quarantined", 0)
+        _register(self)
+
+    # -- stage boundary ------------------------------------------------------
+
+    def begin_stage(self) -> None:
+        """Called at every stage start. If the breaker is open on EVERY
+        slot, half-open the longest-quarantined one: a single probe respawn
+        gets one task to prove the condition cleared (its breaker re-opens
+        on the very next crash)."""
+        with self._lock:
+            if self._closed or not all(s.quarantined for s in self._slots):
+                return
+            probe = min(self._slots, key=lambda s: s.quarantined_at)
+            probe.quarantined = False
+            probe.consecutive_crashes = self.breaker_threshold - 1
+            probe.next_spawn_at = 0.0
+        logger.warning(
+            "all %d worker slot(s) quarantined; half-opening slot %d for a "
+            "probe respawn", self.num_slots, probe.slot,
+        )
+        self._publish_quarantine_gauge()
+
+    # -- checkout / report ---------------------------------------------------
+
+    def checkout(self, slot: int):
+        """The live worker for ``slot``, respawning (after any backoff due)
+        when needed. Returns ``None`` when the slot is quarantined."""
+        with self._lock:
+            lease = self._slots[slot]
+            if self._closed or lease.quarantined:
+                return None
+            w = lease.worker
+            if w is not None and not w.dead and w.proc.poll() is None:
+                return w
+            # the previous incumbent (if any) is gone; pay the backoff
+            # OUTSIDE the lock, then spawn
+            wait = max(0.0, lease.next_spawn_at - time.monotonic())
+            stale, lease.worker = lease.worker, None
+        if stale is not None:
+            stale.close()
+        if wait:
+            # not a retry loop: this paces the respawn of an already-dead
+            # worker — there is no callable to re-attempt under the shared
+            # policy, and the breaker (not a deadline) bounds the spend
+            time.sleep(min(wait, _MAX_BACKOFF_S))  # tpulint: disable=TPL004
+        worker = self._spawn_fn({WORKER_SLOT_VAR: str(slot)})
+        with self._lock:
+            lease = self._slots[slot]
+            if lease.quarantined or self._closed:  # raced with a quarantine
+                pass
+            elif lease.worker is None:
+                first = lease.spawned_at == 0.0
+                lease.worker = worker
+                lease.spawned_at = time.monotonic()
+                if not first:
+                    lease.respawns += 1
+                    REGISTRY.counter_inc("worker.respawn", slot=str(slot))
+                return worker
+            else:
+                worker, lease.worker = lease.worker, worker  # lost a race
+                return worker
+        worker.close()
+        return None
+
+    def report_success(self, slot: int) -> None:
+        """A task completed on ``slot``: refresh the lease, close the
+        breaker's crash streak."""
+        with self._lock:
+            lease = self._slots[slot]
+            lease.tasks_done += 1
+            lease.last_trailer = time.monotonic()
+            lease.consecutive_crashes = 0
+            lease.next_spawn_at = 0.0
+
+    def report_crash(self, slot: int, error: BaseException | str = "") -> bool:
+        """A worker on ``slot`` died. Close it, advance the breaker, arm
+        the respawn backoff. Returns True when the slot is now quarantined."""
+        with self._lock:
+            lease = self._slots[slot]
+            stale, lease.worker = lease.worker, None
+            lease.consecutive_crashes += 1
+            lease.total_crashes += 1
+            lease.last_error = str(error)
+            crashes = lease.consecutive_crashes
+            opened = (not lease.quarantined
+                      and crashes >= self.breaker_threshold)
+            if opened:
+                lease.quarantined = True
+                lease.quarantined_at = time.monotonic()
+            else:
+                lease.next_spawn_at = time.monotonic() + min(
+                    _MAX_BACKOFF_S,
+                    self.backoff_s * (2.0 ** (crashes - 1)),
+                )
+        if stale is not None:
+            stale.close()
+        if opened:
+            REGISTRY.counter_inc("worker.quarantine", slot=str(slot))
+            TIMELINE.record_instant(
+                "worker.quarantine", slot=str(slot), crashes=crashes,
+            )
+            logger.warning(
+                "DEGRADED: worker slot %d quarantined after %d consecutive "
+                "crash(es) (circuit breaker open; last error: %s)",
+                slot, crashes, str(error)[:200],
+            )
+            self._publish_quarantine_gauge()
+        return opened
+
+    # -- introspection -------------------------------------------------------
+
+    def live_workers(self) -> list:
+        """Live worker objects, slot order (the session's ``_workers``)."""
+        with self._lock:
+            return [
+                s.worker for s in self._slots
+                if s.worker is not None and not s.worker.dead
+            ]
+
+    def available_slots(self) -> list[int]:
+        with self._lock:
+            return [s.slot for s in self._slots if not s.quarantined]
+
+    def quarantined_slots(self) -> list[int]:
+        with self._lock:
+            return [s.slot for s in self._slots if s.quarantined]
+
+    def summary(self) -> dict:
+        """Lease/quarantine state for ``/healthz``."""
+        now = time.monotonic()
+        with self._lock:
+            leases = {str(s.slot): s.summary(now) for s in self._slots}
+            quarantined = [s.slot for s in self._slots if s.quarantined]
+        return {
+            "slots": self.num_slots,
+            "quarantined": quarantined,
+            "breaker_threshold": self.breaker_threshold,
+            "leases": leases,
+        }
+
+    def _publish_quarantine_gauge(self) -> None:
+        with self._lock:
+            n = sum(1 for s in self._slots if s.quarantined)
+        REGISTRY.gauge_set("worker.quarantined", n)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            workers = [s.worker for s in self._slots if s.worker is not None]
+            for s in self._slots:
+                s.worker = None
+        for w in workers:
+            w.close()
+        _unregister(self)
+        # republish the gauges from the survivors: a quarantine stamped by
+        # a now-closed session must not haunt the health monitor forever
+        with _REG_LOCK:
+            sups = list(_ACTIVE)
+        REGISTRY.gauge_set("worker.slots", sum(s.num_slots for s in sups))
+        REGISTRY.gauge_set(
+            "worker.quarantined",
+            sum(len(s.quarantined_slots()) for s in sups),
+        )
+
+
+# -- module registry (what /healthz stamps) ---------------------------------
+
+_REG_LOCK = threading.Lock()
+_ACTIVE: list[WorkerSupervisor] = []
+
+
+def _register(sup: WorkerSupervisor) -> None:
+    with _REG_LOCK:
+        _ACTIVE.append(sup)
+
+
+def _unregister(sup: WorkerSupervisor) -> None:
+    with _REG_LOCK:
+        try:
+            _ACTIVE.remove(sup)
+        except ValueError:
+            pass
+
+
+def active_summary() -> dict:
+    """Merged lease/quarantine state of every live supervisor (the
+    ``scheduler`` section of the ``/healthz`` payload); ``{}`` when no
+    session is supervising workers."""
+    with _REG_LOCK:
+        sups = list(_ACTIVE)
+    if not sups:
+        return {}
+    if len(sups) == 1:
+        return sups[0].summary()
+    return {"supervisors": [s.summary() for s in sups]}

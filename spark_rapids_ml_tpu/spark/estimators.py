@@ -116,12 +116,26 @@ def _mesh_or_fallback():
     exhausted device, poisoned client — or an injected fault at site
     ``device.init``) downgrades to the single-device fallback path (returns
     None) with a loud warning and a ``degraded.cpu_fallback`` telemetry
-    flag, instead of failing a fit that the host can still finish."""
+    flag, instead of failing a fit that the host can still finish.
+
+    A fit admitted under ``TPU_ML_ADMISSION_POLICY=degrade`` while a health
+    component is FAILING takes the same fallback *before* touching the
+    device — the point of degrading at admission is not to poke the sick
+    accelerator again."""
     from spark_rapids_ml_tpu.parallel import mesh as M
     from spark_rapids_ml_tpu.resilience import faults
     from spark_rapids_ml_tpu.resilience import retry as _retry
+    from spark_rapids_ml_tpu.telemetry import health as health_mod
     from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 
+    if health_mod.admission_degrade_active():
+        logger.warning(
+            "DEGRADED: admission control admitted this fit under the "
+            "degrade policy (a health component is FAILING); skipping mesh "
+            "creation and streaming through the single-device fallback path"
+        )
+        REGISTRY.counter_inc("degraded.cpu_fallback")
+        return None
     try:
         faults.inject("device.init")
         return M.create_mesh()

@@ -32,6 +32,15 @@ Components and their evidence:
   ``retry.attempts`` delta ≥ ``TPU_ML_HEALTH_RETRY_STORM`` per poll
   (retry storm), any ``degraded.cpu_fallback``, or fault injection
   firing, each flag DEGRADED.
+- ``scheduler``   — worker-slot supervision (``resilience.supervisor``):
+  any quarantined slot (``worker.quarantined`` gauge) is DEGRADED; every
+  slot quarantined is FAILING — the session cannot run a stage.
+
+The monitor also feeds **admission control**: :func:`admission_check`
+consults the rollup before a fit starts and — per
+``TPU_ML_ADMISSION_POLICY`` — refuses (:class:`AdmissionRefused`) or
+CPU-degrades fits while any component is FAILING, instead of letting them
+burn hours against a sick device.
 
 Every state change sets ``health.state{component}``, counts
 ``health.transitions{component,to}`` and records a ``health.transition``
@@ -67,13 +76,24 @@ HBM_WATERMARK_VAR = knobs.HEALTH_HBM_WATERMARK.name
 STALE_VAR = knobs.HEALTH_STALE_S.name
 FAILING_AFTER_VAR = knobs.HEALTH_FAILING_AFTER.name
 RETRY_STORM_VAR = knobs.HEALTH_RETRY_STORM.name
+ADMISSION_POLICY_VAR = knobs.ADMISSION_POLICY.name
 
 OK, DEGRADED, FAILING = 0, 1, 2
 STATE_NAMES = {OK: "OK", DEGRADED: "DEGRADED", FAILING: "FAILING"}
 
-COMPONENTS = ("device", "transport", "stream", "workers", "resilience")
+COMPONENTS = (
+    "device", "transport", "stream", "workers", "resilience", "scheduler",
+)
 
 PROBE_MODES = ("off", "inline", "subprocess")
+
+ADMISSION_POLICIES = ("off", "refuse", "degrade")
+
+
+class AdmissionRefused(RuntimeError):
+    """A fit was refused admission because a health component is FAILING
+    and ``TPU_ML_ADMISSION_POLICY=refuse`` (the default). Fix the failing
+    component, stop the monitor, or set the policy to ``degrade``/``off``."""
 
 
 def _env_float(var: str, default: float) -> float:
@@ -236,6 +256,7 @@ class HealthMonitor:
         self._eval_stream(snap, now)
         self._eval_workers(snap, now)
         self._eval_resilience(snap)
+        self._eval_scheduler(snap)
 
         last_slo = self.slo.evaluate(now)
         with self._lock:
@@ -411,6 +432,30 @@ class HealthMonitor:
         else:
             self._set_state("resilience", OK, "quiet")
 
+    def _eval_scheduler(self, snap) -> None:
+        slots = _gauge_max(snap, "worker.slots")
+        quarantined = _gauge_max(snap, "worker.quarantined") or 0
+        if slots is None:
+            self._set_state("scheduler", OK, "no supervised workers")
+            return
+        if slots and quarantined >= slots:
+            self._set_state(
+                "scheduler",
+                FAILING,
+                f"all {int(slots)} worker slot(s) quarantined "
+                "(circuit breaker open everywhere)",
+            )
+        elif quarantined > 0:
+            self._set_state(
+                "scheduler",
+                DEGRADED,
+                f"{int(quarantined)}/{int(slots)} worker slot(s) quarantined",
+            )
+        else:
+            self._set_state(
+                "scheduler", OK, f"{int(slots)} worker slot(s) healthy"
+            )
+
     # -- rollup --------------------------------------------------------------
 
     def rollup(self) -> dict:
@@ -422,7 +467,7 @@ class HealthMonitor:
             transitions = self._transitions
             last_slo = dict(self._last_slo)
         overall = max(states.values()) if states else OK
-        return {
+        out = {
             "state": STATE_NAMES[overall],
             "components": {
                 c: {"state": STATE_NAMES[states[c]], "detail": details[c]}
@@ -432,9 +477,20 @@ class HealthMonitor:
             "transitions": transitions,
             "slo": last_slo,
         }
+        # live lease/quarantine state from any supervised worker pools, so
+        # /healthz shows per-slot evidence alongside the component verdict
+        try:
+            from spark_rapids_ml_tpu.resilience import supervisor as sup_mod
+
+            sched = sup_mod.active_summary()
+        except Exception:  # pragma: no cover - rollup must never break
+            sched = {}
+        if sched:
+            out["scheduler"] = sched
+        return out
 
     def fit_summary(self) -> dict:
-        """Compact rollup stamped onto FitReport schema 5 (no per-poll SLO
+        """Compact rollup stamped onto FitReport schema 6 (no per-poll SLO
         detail — the breach counter already rides in ``counters``)."""
         r = self.rollup()
         return {
@@ -496,3 +552,86 @@ def current_summary() -> dict:
     except Exception:  # pragma: no cover - stamping must never break a fit
         logger.exception("health summary failed")
         return {}
+
+
+# -- health-driven admission control ----------------------------------------
+
+
+def admission_policy() -> str:
+    """The configured ``TPU_ML_ADMISSION_POLICY`` (``refuse`` by default)."""
+    v = os.environ.get(ADMISSION_POLICY_VAR, "refuse") or "refuse"
+    if v not in ADMISSION_POLICIES:
+        raise ValueError(
+            f"{ADMISSION_POLICY_VAR}={v!r} must be one of {ADMISSION_POLICIES}"
+        )
+    return v
+
+
+def admission_check() -> dict:
+    """Consult the live monitor before admitting a fit.
+
+    Returns the decision dict stamped onto FitReport schema 6:
+    ``{"policy", "action", "health_state", "reason"}`` where ``action`` is
+    ``admit``, ``refuse`` or ``degrade``. Decisions other than ``admit``
+    are counted (``scheduler.admission{action}``) and land on the timeline;
+    actually *enforcing* them (raising :class:`AdmissionRefused`, opening
+    the degrade window) is the caller's job — ``telemetry.report.begin_fit``.
+    Without a monitor, or before its first poll, there is no evidence and
+    the fit is admitted.
+    """
+    policy = admission_policy()
+    decision = {
+        "policy": policy,
+        "action": "admit",
+        "health_state": "UNKNOWN",
+        "reason": "",
+    }
+    if policy == "off":
+        decision["reason"] = "admission control off"
+        return decision
+    mon = get_monitor()
+    if mon is None or mon.polls == 0:
+        decision["reason"] = "no health evidence (monitor absent or unpolled)"
+        return decision
+    r = mon.rollup()
+    decision["health_state"] = r["state"]
+    if r["state"] != STATE_NAMES[FAILING]:
+        decision["reason"] = f"health {r['state']}"
+        return decision
+    failing = [
+        c for c, v in r["components"].items()
+        if v["state"] == STATE_NAMES[FAILING]
+    ]
+    detail = "; ".join(
+        f"{c}: {r['components'][c]['detail']}" for c in failing
+    )
+    decision["action"] = policy  # "refuse" or "degrade"
+    decision["reason"] = (
+        f"component(s) {', '.join(failing)} FAILING — {detail}"[:300]
+    )
+    REGISTRY.counter_inc("scheduler.admission", action=policy)
+    TIMELINE.record_instant(
+        "scheduler.admission", action=policy, components=",".join(failing)
+    )
+    logger.warning("admission control: %s fit (%s)", policy, decision["reason"])
+    return decision
+
+
+# Degrade window: while a fit admitted under policy "degrade" runs, mesh
+# creation must not touch the failing accelerator — estimators consult
+# admission_degrade_active() and take the CPU fallback path instead.
+# Thread-local because fits are (report.py's _fit_depth contract).
+_DEGRADE = threading.local()
+
+
+def begin_degrade_window() -> None:
+    _DEGRADE.depth = getattr(_DEGRADE, "depth", 0) + 1
+
+
+def end_degrade_window() -> None:
+    _DEGRADE.depth = max(0, getattr(_DEGRADE, "depth", 0) - 1)
+
+
+def admission_degrade_active() -> bool:
+    """True inside a fit the admission controller degraded to CPU."""
+    return getattr(_DEGRADE, "depth", 0) > 0

@@ -53,6 +53,16 @@ METRICS: frozenset[str] = frozenset({
     "retry.attempts",
     "fault.injected",
     "degraded.cpu_fallback",
+    # elastic stage scheduler (resilience.supervisor + localspark.session)
+    "scheduler.tasks",
+    "scheduler.hedge",
+    "scheduler.reassign",
+    "scheduler.barrier_retry",
+    "scheduler.admission",
+    "worker.respawn",
+    "worker.quarantine",
+    "worker.slots",
+    "worker.quarantined",
     # live health monitor (telemetry.health)
     "health.state",
     "health.transitions",
@@ -206,4 +216,9 @@ INSTANTS: frozenset[str] = frozenset({
     "autotune.decision",
     "health.transition",
     "slo.breach",
+    "scheduler.hedge",
+    "scheduler.reassign",
+    "scheduler.barrier_retry",
+    "scheduler.admission",
+    "worker.quarantine",
 })

@@ -35,9 +35,11 @@ from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 # (the autotuner decisions drained from the per-fit journal — which
 # TuningConfig the fit actually ran with, and whether it was a cache hit).
 # v5: + health (the live monitor's component rollup at fit end — empty when
-# no monitor runs). Readers must tolerate other versions
+# no monitor runs). v6: + admission (the health-driven admission-control
+# decision taken at fit start — policy/action/health_state/reason; empty
+# when no check ran). Readers must tolerate other versions
 # (tools/trace_report.py skips-with-note rather than KeyError).
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 # TransformReport wire schema (independent of the fit schema above).
 TRANSFORM_SCHEMA_VERSION = 1
@@ -87,6 +89,10 @@ class FitReport:
     # poll/transition counts and the window's SLO breach total from the
     # background HealthMonitor. Empty when no monitor was running.
     health: dict = field(default_factory=dict)
+    # admission-control decision at fit start (v6):
+    # {policy, action, health_state, reason} from health.admission_check —
+    # proves WHY a fit ran degraded (or that the gate saw a healthy system)
+    admission: dict = field(default_factory=dict)
     schema: int = SCHEMA_VERSION
 
     @property
@@ -119,6 +125,7 @@ class FitReport:
             "cost_model": self.cost_model,
             "tuning": self.tuning,
             "health": self.health,
+            "admission": self.admission,
         }
 
     @classmethod
@@ -141,6 +148,7 @@ class FitReport:
             cost_model=d.get("cost_model", {}) or {},
             tuning=d.get("tuning", {}) or {},
             health=d.get("health", {}) or {},
+            admission=d.get("admission", {}) or {},
             schema=int(d.get("schema", SCHEMA_VERSION)),
         )
 
@@ -148,12 +156,13 @@ class FitReport:
 class _FitCapture:
     __slots__ = (
         "estimator", "uid", "token", "snap", "t0", "t_unix",
-        "fit_id", "fit_id_token", "tl_seq", "tuning_seq",
+        "fit_id", "fit_id_token", "tl_seq", "tuning_seq", "admission",
     )
 
     def __init__(
         self, estimator: str, uid: str, token, snap, t0: float,
         fit_id: str, fit_id_token, tl_seq: int, tuning_seq: int = 0,
+        admission: dict | None = None,
     ):
         self.estimator = estimator
         self.uid = uid
@@ -165,6 +174,7 @@ class _FitCapture:
         self.fit_id_token = fit_id_token
         self.tl_seq = tl_seq
         self.tuning_seq = tuning_seq
+        self.admission = admission or {}
 
 
 def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
@@ -180,6 +190,20 @@ def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
     from spark_rapids_ml_tpu.telemetry import httpd
 
     httpd.ensure_started()
+    # health-driven admission control: while a component is FAILING, the
+    # fit is refused (default) or pinned to the CPU-degraded path for its
+    # whole window — the decision rides on the report either way
+    from spark_rapids_ml_tpu.telemetry import health as health_mod
+
+    admission = health_mod.admission_check()
+    if admission["action"] == "refuse":
+        raise health_mod.AdmissionRefused(
+            f"fit of {estimator} refused by admission control: "
+            f"{admission['reason']} (set {health_mod.ADMISSION_POLICY_VAR}="
+            "degrade/off to override)"
+        )
+    if admission["action"] == "degrade":
+        health_mod.begin_degrade_window()
     fit_id = uuid.uuid4().hex[:12]
     # lazy: telemetry must stay importable before/without the autotune
     # package (which itself imports telemetry.registry)
@@ -195,6 +219,7 @@ def begin_fit(estimator: str, uid: str = "") -> _FitCapture:
         fit_id_token=spans.set_current_fit_id(fit_id),
         tl_seq=TIMELINE.seq(),
         tuning_seq=autotune_cache.decision_seq(),
+        admission=admission,
     )
 
 
@@ -231,6 +256,10 @@ def end_fit(cap: _FitCapture) -> FitReport:
     wall = time.perf_counter() - cap.t0
     spans.reset_current_estimator(cap.token)
     spans.reset_current_fit_id(cap.fit_id_token)
+    from spark_rapids_ml_tpu.telemetry import health as health_mod
+
+    if cap.admission.get("action") == "degrade":
+        health_mod.end_degrade_window()
     device_memory = compilemon.sample_device_memory()
     delta = REGISTRY.snapshot().delta(cap.snap)
 
@@ -251,8 +280,6 @@ def end_fit(cap: _FitCapture) -> FitReport:
     # the fit never streamed (resident path, plain array fits)
     ov = delta.hist("stream.overlap_fraction")
     overlap_fraction = (ov.total / ov.count) if ov.count else None
-
-    from spark_rapids_ml_tpu.telemetry import health as health_mod
 
     health = health_mod.current_summary()
 
@@ -304,6 +331,7 @@ def end_fit(cap: _FitCapture) -> FitReport:
         cost_model=costmodel.window_summary(delta, wall),
         tuning=tuning,
         health=health,
+        admission=cap.admission,
     )
     _remember_report(report.to_dict())
     return report

@@ -88,6 +88,33 @@ _DECLARATIONS = (
          "`site:kind:nth[:arg]` comma list of deterministic synthetic "
          "faults (chaos tests only — never production)",
          "resilience.faults"),
+    # -- elastic stage scheduler (resilience.supervisor + localspark) -------
+    Knob("TPU_ML_HEDGE_FACTOR", "float", "4.0",
+         "speculatively re-dispatch a partition once its runtime exceeds "
+         "this multiple of the completed-partition p50 (0 disables "
+         "hedging)", "resilience.supervisor"),
+    Knob("TPU_ML_HEDGE_FLOOR_S", "float", "1.0",
+         "minimum straggler runtime before a hedge may fire (keeps tiny "
+         "tasks from hedging on scheduler noise)", "resilience.supervisor"),
+    Knob("TPU_ML_BARRIER_RETRIES", "int", "1",
+         "barrier-stage epoch retries after an infrastructure rank failure "
+         "(fresh workers per epoch; plan errors never retry)",
+         "localspark.session"),
+    Knob("TPU_ML_WORKER_BREAKER_THRESHOLD", "int", "3",
+         "consecutive crashes after which a worker slot's circuit breaker "
+         "opens and the slot is quarantined", "resilience.supervisor"),
+    Knob("TPU_ML_WORKER_RESPAWN_BACKOFF_S", "float", "0.05",
+         "base of the exponential backoff between respawns of a crashed "
+         "worker slot", "resilience.supervisor"),
+    Knob("TPU_ML_WORKER_SLOT", "int", "",
+         "slot index the supervisor stamps into each worker's environment "
+         "(diagnostics and slot-targeted chaos plans; never set manually)",
+         "resilience.supervisor"),
+    Knob("TPU_ML_ADMISSION_POLICY", "enum", "refuse",
+         "`off`/`refuse`/`degrade`: what begin_fit does while the live "
+         "health monitor reports FAILING — admit anyway, raise "
+         "AdmissionRefused, or force the CPU-degraded fallback path",
+         "telemetry.health"),
     # -- ingestion / streaming (spark.ingest) -------------------------------
     Knob("TPU_ML_MESH_LOCAL_WIRE_DTYPE", "enum", "float64",
          "wire dtype for mesh-local ingestion staging (`float32` halves "
@@ -228,6 +255,13 @@ STREAM_CHECKPOINT_EVERY_CHUNKS = KNOBS["TPU_ML_STREAM_CHECKPOINT_EVERY_CHUNKS"]
 FOLD_WAIT_TIMEOUT_S = KNOBS["TPU_ML_FOLD_WAIT_TIMEOUT_S"]
 NONFINITE_POLICY = KNOBS["TPU_ML_NONFINITE_POLICY"]
 FAULT_PLAN = KNOBS["TPU_ML_FAULT_PLAN"]
+HEDGE_FACTOR = KNOBS["TPU_ML_HEDGE_FACTOR"]
+HEDGE_FLOOR_S = KNOBS["TPU_ML_HEDGE_FLOOR_S"]
+BARRIER_RETRIES = KNOBS["TPU_ML_BARRIER_RETRIES"]
+WORKER_BREAKER_THRESHOLD = KNOBS["TPU_ML_WORKER_BREAKER_THRESHOLD"]
+WORKER_RESPAWN_BACKOFF_S = KNOBS["TPU_ML_WORKER_RESPAWN_BACKOFF_S"]
+WORKER_SLOT = KNOBS["TPU_ML_WORKER_SLOT"]
+ADMISSION_POLICY = KNOBS["TPU_ML_ADMISSION_POLICY"]
 MESH_LOCAL_WIRE_DTYPE = KNOBS["TPU_ML_MESH_LOCAL_WIRE_DTYPE"]
 MESH_LOCAL_MAX_BYTES = KNOBS["TPU_ML_MESH_LOCAL_MAX_BYTES"]
 MESH_LOCAL_ARROW_MAX_BYTES = KNOBS["TPU_ML_MESH_LOCAL_ARROW_MAX_BYTES"]

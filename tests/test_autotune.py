@@ -411,12 +411,12 @@ class TestFitReportStamp:
                              dtype="float64")
         rep = report.end_fit(cap)
         assert got is not None
-        assert rep.schema == 5
+        assert rep.schema == 6
         assert rep.tuning["cache_hit"] is True
         assert rep.tuning["source"] == "cache"
         assert rep.tuning["config"]["chunk_rows"] == 256
         d = rep.to_dict()
-        assert d["schema"] == 5 and d["tuning"]["source"] == "cache"
+        assert d["schema"] == 6 and d["tuning"]["source"] == "cache"
         assert report.FitReport.from_dict(d).tuning == rep.tuning
 
     def test_untuned_fit_has_empty_stamp(self):

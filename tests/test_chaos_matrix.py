@@ -10,10 +10,14 @@ checkpoint + bitwise resume), non-finite rows (raise/skip policy),
 collective blips (finalize retry), device-init failure (CPU degradation).
 """
 
+import os
+
 import jax
 import numpy as np
 import pytest
 
+from spark_rapids_ml_tpu.localspark import LocalSparkSession
+from spark_rapids_ml_tpu.localspark import types as LT
 from spark_rapids_ml_tpu.models.linear import LinearRegression
 from spark_rapids_ml_tpu.models.pca import PCA
 from spark_rapids_ml_tpu.ops import linalg as L
@@ -315,3 +319,263 @@ class TestEstimatorChaosParity:
         x, _ = data
         PCA().setInputCol("f").setK(3).fit(x, num_partitions=3)
         assert snap.delta().counter("fault.injected") == 0
+
+
+# -- elastic stage scheduler: supervision, reassignment, hedging, barriers ----
+
+
+def _ls_features_df(session, rows=36, dim=4, partitions=None, seed=5):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(rows, dim))
+    schema = LT.StructType(
+        [
+            LT.StructField("features", LT.ArrayType(LT.DoubleType())),
+            LT.StructField("idx", LT.LongType()),
+        ]
+    )
+    df = session.createDataFrame(
+        [(row.tolist(), i) for i, row in enumerate(x)],
+        schema,
+        numPartitions=partitions,
+    )
+    return df, x
+
+
+def _rows_key(rows):
+    """Order-independent exact row content (the floats are bit-identical
+    across runs: same source array, no arithmetic in the plan fn)."""
+    return sorted((r.idx, tuple(r.features)) for r in rows)
+
+
+def _local_ident():
+    # defined per-call so cloudpickle ships it BY VALUE: a module-level
+    # function would pickle by reference to this test module, which is not
+    # importable inside a worker process
+    def ident(batches):
+        yield from batches
+
+    return ident
+
+
+class TestElasticScheduler:
+    def test_worker_kill_mid_stage_reassigns(self, tmp_path, snap):
+        """One worker SIGKILLs itself mid-stage: the supervisor respawns
+        the slot, the dead attempt's partition migrates, and the output is
+        identical to a clean run."""
+        marker = str(tmp_path / "died_once")
+
+        def die_once(batches):
+            import os as wos
+
+            data = list(batches)
+            try:
+                # O_EXCL: exactly one worker across the stage takes the hit
+                wos.close(
+                    wos.open(marker, wos.O_CREAT | wos.O_EXCL | wos.O_WRONLY)
+                )
+                wos.kill(wos.getpid(), 9)
+            except FileExistsError:
+                pass
+            yield from data
+
+        with LocalSparkSession(parallelism=6, num_workers=2) as s:
+            df, _ = _ls_features_df(s, rows=36)
+            clean = _rows_key(df.mapInArrow(_local_ident(), df.schema).collect())
+            out = _rows_key(df.mapInArrow(die_once, df.schema).collect())
+        assert out == clean
+        d = snap.delta()
+        assert d.counter("scheduler.reassign") >= 1
+        assert d.counter("worker.respawn") >= 1
+        assert d.counter("worker.quarantine") == 0
+
+    def test_crash_loop_slot_quarantined_stage_completes(
+        self, monkeypatch, snap
+    ):
+        """A slot whose every worker dies on arrival trips the circuit
+        breaker; the stage finishes (degraded) on the surviving slot
+        instead of respawning forever."""
+        monkeypatch.setenv("TPU_ML_WORKER_BREAKER_THRESHOLD", "2")
+        monkeypatch.setenv("TPU_ML_WORKER_RESPAWN_BACKOFF_S", "0.01")
+
+        def die_on_slot0(batches):
+            import os as wos
+
+            data = list(batches)
+            if wos.environ.get("TPU_ML_WORKER_SLOT") == "0":
+                wos._exit(113)
+            yield from data
+
+        with LocalSparkSession(parallelism=6, num_workers=2) as s:
+            df, _ = _ls_features_df(s, rows=36)
+            out = _rows_key(df.mapInArrow(die_on_slot0, df.schema).collect())
+            clean = _rows_key(df.mapInArrow(_local_ident(), df.schema).collect())
+            assert out == clean
+            assert s._supervisor.quarantined_slots() == [0]
+            assert s._supervisor.summary()["leases"]["0"]["quarantined"]
+        d = snap.delta()
+        assert d.counter("worker.quarantine", slot="0") == 1
+        assert d.counter("scheduler.reassign") >= 2
+
+    def test_straggler_hedge_is_deterministic(self, monkeypatch, snap):
+        """Each worker's 2nd task hangs 1s: with hedging on, an idle slot
+        duplicates the straggler and the first result wins; results are
+        bit-identical with hedging on, off, and with no fault at all."""
+        # each worker process hangs on its 3rd task: occurrence 1 is the
+        # warm-up below, 2 is the stage's seeded partition, 3 is the
+        # straggler (primary on one worker, its hedge twin on the other)
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "worker.task:hang:3:1.0")
+        monkeypatch.setenv("TPU_ML_HEDGE_FLOOR_S", "0.05")
+
+        def run(factor):
+            with LocalSparkSession(parallelism=3, num_workers=2) as s:
+                # warm both workers first (hedging off, one seeded task
+                # each) so the measured p50 reflects task time, not the
+                # 1s worker spawn — the hedge threshold must see the hang
+                # as a straggler, not as a normal first-task latency
+                monkeypatch.setenv("TPU_ML_HEDGE_FACTOR", "0")
+                warm, _ = _ls_features_df(s, rows=8, partitions=2)
+                warm.mapInArrow(_local_ident(), warm.schema).collect()
+                monkeypatch.setenv("TPU_ML_HEDGE_FACTOR", factor)
+                df, _ = _ls_features_df(s, rows=30)
+                return _rows_key(
+                    df.mapInArrow(_local_ident(), df.schema).collect()
+                )
+
+        hedged = run("2.0")
+        assert snap.delta().counter("scheduler.hedge") >= 1
+
+        s1 = REGISTRY.snapshot()
+        unhedged = run("0")
+        assert REGISTRY.snapshot().delta(s1).counter("scheduler.hedge") == 0
+
+        monkeypatch.delenv(faults.FAULT_PLAN_VAR)
+        clean = run("0")
+        assert hedged == unhedged == clean
+
+    def test_barrier_epoch_retry_after_rank_preemption(
+        self, monkeypatch, snap
+    ):
+        """A preempted rank dooms the barrier epoch; the stage retries the
+        WHOLE round with fresh workers and matches the clean result."""
+        with LocalSparkSession(parallelism=3) as s:
+            df, _ = _ls_features_df(s, rows=30, partitions=3)
+            clean = _rows_key(
+                df.mapInArrow(_local_ident(), df.schema, barrier=True).collect()
+            )
+            monkeypatch.setenv(faults.FAULT_PLAN_VAR, "scheduler.rank:preempt:2")
+            retried = _rows_key(
+                df.mapInArrow(_local_ident(), df.schema, barrier=True).collect()
+            )
+        assert retried == clean
+        d = snap.delta()
+        assert d.counter("scheduler.barrier_retry") == 1
+        assert (
+            d.counter("fault.injected", site="scheduler.rank", kind="preempt")
+            == 1
+        )
+
+    def test_barrier_failure_leaves_no_workers_or_dirs(self, monkeypatch):
+        """Retries exhausted: the epoch's failure must still tear down every
+        rank worker and remove the rendezvous scratch dir (try/finally —
+        the old path leaked both on a failed rank)."""
+        import tempfile
+
+        def _barrier_dirs():
+            return {
+                n
+                for n in os.listdir(tempfile.gettempdir())
+                if n.startswith("localspark-barrier-")
+            }
+
+        def _live_children():
+            me, kids = str(os.getpid()), set()
+            for pid in os.listdir("/proc"):
+                if not pid.isdigit():
+                    continue
+                try:
+                    with open(f"/proc/{pid}/stat", "rb") as f:
+                        raw = f.read()
+                    # parse after the parenthesized comm (may hold spaces)
+                    state, ppid = raw[raw.rindex(b")") + 2:].split()[:2]
+                    if ppid == me.encode() and state != b"Z":
+                        kids.add(int(pid))
+                except (OSError, ValueError):
+                    continue
+            return kids
+
+        monkeypatch.setenv("TPU_ML_BARRIER_RETRIES", "0")
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "scheduler.rank:preempt:1")
+        dirs0, kids0 = _barrier_dirs(), _live_children()
+        with LocalSparkSession(parallelism=3) as s:
+            df, _ = _ls_features_df(s, rows=12, partitions=3)
+            with pytest.raises(faults.InjectedPreemption):
+                df.mapInArrow(_local_ident(), df.schema, barrier=True).collect()
+        assert _barrier_dirs() == dirs0
+        assert _live_children() - kids0 == set()
+
+
+class TestAdmissionControl:
+    """begin_fit consults the health monitor: a FAILING component refuses
+    the fit under the default policy, or admits it CPU-degraded under
+    ``TPU_ML_ADMISSION_POLICY=degrade`` — decision stamped on the report."""
+
+    @pytest.fixture(autouse=True)
+    def _monitor_lifecycle(self):
+        from spark_rapids_ml_tpu.telemetry import health
+
+        health.stop_monitor(timeout=10.0)
+        yield
+        health.stop_monitor(timeout=10.0)
+
+    def _wedge_monitor(self):
+        from spark_rapids_ml_tpu.telemetry import health
+
+        health.start_monitor(
+            interval_s=3600.0,
+            probe_mode="inline",
+            probe_fn=lambda: (False, "injected transport wedge"),
+            failing_after=1,
+        ).poll_once()
+
+    def test_failing_health_refuses_fit_by_default(self, data, snap):
+        from spark_rapids_ml_tpu.telemetry import health
+
+        self._wedge_monitor()
+        x, _ = data
+        with pytest.raises(
+            health.AdmissionRefused, match="refused by admission control"
+        ):
+            PCA().setInputCol("f").setK(3).fit(x)
+        assert snap.delta().counter("scheduler.admission", action="refuse") == 1
+
+    def test_degrade_policy_admits_and_stamps_report(
+        self, data, monkeypatch, snap
+    ):
+        monkeypatch.setenv("TPU_ML_ADMISSION_POLICY", "degrade")
+        self._wedge_monitor()
+        x, _ = data
+        model = PCA().setInputCol("f").setK(3).fit(x)
+        rep = model.fit_report
+        assert rep.admission["action"] == "degrade"
+        assert rep.admission["health_state"] == "FAILING"
+        assert "injected transport wedge" in rep.admission["reason"]
+        assert snap.delta().counter("scheduler.admission", action="degrade") == 1
+
+    def test_healthy_monitor_admits_plainly(self, data):
+        from spark_rapids_ml_tpu.telemetry import health
+
+        health.start_monitor(
+            interval_s=3600.0,
+            probe_mode="inline",
+            probe_fn=lambda: (True, "ok"),
+        ).poll_once()
+        x, _ = data
+        model = PCA().setInputCol("f").setK(3).fit(x)
+        assert model.fit_report.admission["action"] == "admit"
+
+    def test_no_monitor_means_no_gatekeeping(self, data):
+        x, _ = data
+        model = PCA().setInputCol("f").setK(3).fit(x)
+        adm = model.fit_report.admission
+        assert adm["action"] == "admit"
+        assert "no health evidence" in adm["reason"]

@@ -13,7 +13,7 @@ Covers the ISSUE-8 acceptance scenarios without hardware:
   live ``stream.active`` gauge and rolling SLO percentiles;
 - the monitor thread (and any straggling probe thread) shuts down
   cleanly — no dangling named threads after ``stop()``;
-- FitReport schema 5 carries the monitor's ``health`` summary.
+- FitReport schema 6 carries the monitor's ``health`` summary.
 """
 
 from __future__ import annotations
@@ -409,7 +409,7 @@ class TestHttpExporter:
         assert "tpu-ml-health-monitor" not in alive
 
 
-# -- FitReport schema 5 stamping ---------------------------------------------
+# -- FitReport schema 6 stamping ---------------------------------------------
 
 
 class TestFitReportHealthStamp:
@@ -417,7 +417,7 @@ class TestFitReportHealthStamp:
         from spark_rapids_ml_tpu.models.pca import PCA
         from spark_rapids_ml_tpu.telemetry.report import SCHEMA_VERSION
 
-        assert SCHEMA_VERSION == 5
+        assert SCHEMA_VERSION == 6
         health.start_monitor(
             interval_s=3600.0, probe_mode="inline",
             probe_fn=lambda: (True, "ok"),
@@ -430,7 +430,7 @@ class TestFitReportHealthStamp:
         assert rep.health["polls"] >= 1
         assert "slo_breaches" in rep.health
         d = rep.to_dict()
-        assert d["schema"] == 5 and d["health"] == rep.health
+        assert d["schema"] == 6 and d["health"] == rep.health
 
     def test_fit_report_health_empty_without_monitor(self):
         from spark_rapids_ml_tpu.models.pca import PCA

@@ -341,6 +341,16 @@ class TestReviewRegressions:
             assert df.mapInArrow(ident, df.schema).count() == 10
             assert s._workers[0].proc.pid != doomed_pid
 
+    def test_missing_partition_result_raises_not_silent(self):
+        # a None in the results list used to be yielded as an EMPTY batch
+        # list — silent data loss dressed up as an empty partition. It must
+        # raise, naming the partition(s) that never produced a payload.
+        from spark_rapids_ml_tpu.localspark import session as S
+
+        with pytest.raises(WorkerException, match=r"partition\(s\) \[1\]"):
+            S._require_results([[], None, []], "mapInArrow")
+        assert S._require_results([[], []], "mapInArrow") == [[], []]
+
     def test_rand_offset_continuation(self):
         # rand(seed) must yield the same per-row stream regardless of how a
         # partition is chunked: evaluating at row offset k must continue the

@@ -255,3 +255,54 @@ class TestExecutorMigration:
             retry_backoff_s=0.0,
         )
         assert out == list(range(6))
+
+
+class TestExecutorHedging:
+    """Speculative duplicates for stragglers: retry answers 'it failed',
+    hedging answers 'it is taking too long' — a wedged attempt never fails,
+    so only a duplicate can rescue the task's wall-clock."""
+
+    def test_straggler_hedged_first_result_wins(self, monkeypatch):
+        import threading
+        import time
+
+        monkeypatch.setenv("TPU_ML_HEDGE_FACTOR", "2.0")
+        monkeypatch.setenv("TPU_ML_HEDGE_FLOOR_S", "0.05")
+        lock = threading.Lock()
+        calls = {"slow": 0}
+
+        def fn(v):
+            if v == 2:
+                with lock:
+                    calls["slow"] += 1
+                    wedged = calls["slow"] == 1
+                if wedged:  # only the FIRST attempt of item 2 is stuck
+                    time.sleep(1.0)
+            return v * 10
+
+        snap0 = REGISTRY.snapshot()
+        out = executor.run_partition_tasks(
+            fn, list(range(4)), max_workers=4, max_retries=0
+        )
+        assert out == [0, 10, 20, 30]
+        d = REGISTRY.snapshot().delta(snap0)
+        assert d.counter("scheduler.hedge", task="2") == 1
+        assert calls["slow"] == 2  # the hedge twin really ran
+
+    def test_factor_zero_disables_hedging(self, monkeypatch):
+        import time
+
+        monkeypatch.setenv("TPU_ML_HEDGE_FACTOR", "0")
+        monkeypatch.setenv("TPU_ML_HEDGE_FLOOR_S", "0.0")
+
+        def fn(v):
+            if v == 1:
+                time.sleep(0.2)
+            return v
+
+        snap0 = REGISTRY.snapshot()
+        out = executor.run_partition_tasks(
+            fn, list(range(3)), max_workers=3, max_retries=0
+        )
+        assert out == [0, 1, 2]
+        assert REGISTRY.snapshot().delta(snap0).counter("scheduler.hedge") == 0

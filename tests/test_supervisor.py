@@ -1,0 +1,125 @@
+"""Unit tests for resilience.supervisor: slot leases, bounded respawn,
+the per-slot circuit breaker, and the all-quarantined half-open probe.
+
+The session-level behavior (real worker processes dying under a stage)
+lives in test_chaos_matrix.py; these tests drive the supervisor directly
+with fake workers so every breaker transition is cheap and exact.
+"""
+
+import types
+
+import pytest
+
+from spark_rapids_ml_tpu.resilience.supervisor import (
+    WorkerSupervisor,
+    active_summary,
+)
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+
+class FakeWorker:
+    def __init__(self, env):
+        self.env = env
+        self.dead = False
+        self.closed = False
+        self.proc = types.SimpleNamespace(poll=lambda: None, pid=id(self))
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture
+def spawned():
+    return []
+
+
+@pytest.fixture
+def sup(spawned):
+    def spawn(extra):
+        spawned.append(FakeWorker(extra))
+        return spawned[-1]
+
+    s = WorkerSupervisor(spawn, 2, breaker_threshold=2, backoff_s=0.0)
+    yield s
+    s.close()
+
+
+class TestLeases:
+    def test_checkout_spawns_once_and_reuses(self, sup, spawned):
+        w = sup.checkout(0)
+        assert sup.checkout(0) is w
+        assert len(spawned) == 1
+        assert w.env["TPU_ML_WORKER_SLOT"] == "0"
+
+    def test_success_resets_the_breaker_streak(self, sup):
+        sup.checkout(0)
+        sup.report_crash(0, "boom")
+        sup.checkout(0)
+        sup.report_success(0)
+        sup.report_crash(0, "boom")  # streak restarted: 1 < threshold 2
+        assert sup.quarantined_slots() == []
+
+    def test_summary_carries_lease_state(self, sup):
+        sup.checkout(1)
+        sup.report_success(1)
+        summ = sup.summary()
+        assert summ["slots"] == 2 and summ["breaker_threshold"] == 2
+        lease = summ["leases"]["1"]
+        assert lease["live"] and lease["tasks_done"] == 1
+        assert not lease["quarantined"]
+
+
+class TestCircuitBreaker:
+    def test_crash_loop_quarantines_at_threshold(self, sup):
+        snap0 = REGISTRY.snapshot()
+        sup.checkout(0)
+        assert sup.report_crash(0, "boom") is False
+        sup.checkout(0)  # respawn after the first crash
+        assert sup.report_crash(0, "boom") is True
+        assert sup.quarantined_slots() == [0]
+        assert sup.checkout(0) is None  # breaker open: no more respawns
+        assert sup.available_slots() == [1]
+        d = REGISTRY.snapshot().delta(snap0)
+        assert d.counter("worker.quarantine", slot="0") == 1
+        assert d.counter("worker.respawn", slot="0") == 1
+
+    def test_all_quarantined_half_opens_one_probe(self, sup):
+        for slot in (0, 1):
+            for err in ("a", "b"):
+                sup.checkout(slot)
+                sup.report_crash(slot, err)
+        assert sorted(sup.quarantined_slots()) == [0, 1]
+        sup.begin_stage()
+        probes = sup.available_slots()
+        assert len(probes) == 1  # exactly one half-open probe slot
+        assert sup.checkout(probes[0]) is not None
+        # the probe gets ONE chance: the next crash re-opens instantly
+        assert sup.report_crash(probes[0], "still bad") is True
+        assert sorted(sup.quarantined_slots()) == [0, 1]
+
+
+class TestLifecycle:
+    def test_close_closes_workers_and_refuses_checkout(self):
+        spawned = []
+
+        def spawn(extra):
+            spawned.append(FakeWorker(extra))
+            return spawned[-1]
+
+        s = WorkerSupervisor(spawn, 1, breaker_threshold=2, backoff_s=0.0)
+        w = s.checkout(0)
+        s.close()
+        assert w.closed
+        assert s.checkout(0) is None
+        s.close()  # idempotent
+
+    def test_active_summary_lists_live_supervisors(self):
+        s = WorkerSupervisor(
+            lambda e: FakeWorker(e), 3, breaker_threshold=2, backoff_s=0.0
+        )
+        try:
+            summ = active_summary()
+            sups = summ.get("supervisors", [summ])
+            assert any(entry.get("slots") == 3 for entry in sups)
+        finally:
+            s.close()

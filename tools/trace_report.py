@@ -34,6 +34,14 @@ that turn the numbers into a diagnosis:
   recompile storm: static-shape bucketing is not holding, so the same
   logical kernels keep recompiling per shape (check TPU_ML_MIN_BUCKET and
   TPU_ML_COMPILE_CACHE).
+- ``scheduler.hedge`` count > 20% of ``scheduler.tasks`` ⇒ hedge storm:
+  speculative duplicates are no longer the exception — the hedge
+  threshold is mis-tuned for this workload or most partitions are
+  stragglers (check TPU_ML_HEDGE_FACTOR / TPU_ML_HEDGE_FLOOR_S and the
+  partition sizing).
+- nonzero ``worker.quarantine`` ⇒ a worker slot crash-looped until its
+  circuit breaker opened; the fit finished on the surviving slots with
+  reduced parallelism.
 - transform reports: slowest partition > 3× the median partition ⇒
   partition skew; one straggler sets the wall clock.
 
@@ -56,7 +64,7 @@ import sys
 # highest fit_report schema this renderer understands (telemetry.report
 # .SCHEMA_VERSION); newer records are skipped with a note, older ones
 # render with defaults for the fields they predate
-SUPPORTED_SCHEMA = 5
+SUPPORTED_SCHEMA = 6
 
 # highest transform_report schema understood
 # (telemetry.report.TRANSFORM_SCHEMA_VERSION)
@@ -143,6 +151,25 @@ def check_anomalies(rec: dict) -> list[str]:
     storm = _recompile_storm(rec)
     if storm:
         out.append(storm)
+    hedges = _counter_total(rec, "scheduler.hedge")
+    tasks = _counter_total(rec, "scheduler.tasks")
+    if tasks > 0 and hedges > 0.2 * tasks:
+        out.append(
+            f"hedge-storm: {hedges:g} speculative hedge(s) for {tasks:g} "
+            "scheduled task(s) (> 20%) — hedging should be the exception, "
+            "not the norm; the straggler threshold is mis-tuned for this "
+            "workload (check TPU_ML_HEDGE_FACTOR / TPU_ML_HEDGE_FLOOR_S "
+            "and the partition sizing)"
+        )
+    quarantined = _counter_total(rec, "worker.quarantine")
+    if quarantined:
+        out.append(
+            f"worker-quarantined: {quarantined:g} worker slot(s) crash-"
+            "looped until the circuit breaker opened — the fit finished on "
+            "the surviving slots with reduced parallelism; inspect the "
+            "worker.quarantine timeline instants and the slot's last error "
+            "in /healthz before the next run"
+        )
     return out
 
 
@@ -296,6 +323,25 @@ def _print_tuning(rec: dict, out) -> None:
     )
 
 
+def _print_admission(rec: dict, out) -> None:
+    """The admission-control decision stamped at fit start (fit_report
+    schema >= 6): which policy ran and what it decided. Only non-plain
+    admits are printed — a healthy admit under the default policy is the
+    uninteresting common case."""
+    adm = rec.get("admission") or {}
+    if not adm:
+        return
+    action = adm.get("action", "?")
+    policy = adm.get("policy", "?")
+    if action == "admit" and policy in ("refuse", "degrade"):
+        return  # healthy-path admit: no news is good news
+    print(
+        f"admission: action={action} policy={policy} "
+        f"health={adm.get('health_state', '?')} — {adm.get('reason', '')}",
+        file=out,
+    )
+
+
 def _print_health(rec: dict, out) -> None:
     """The live-monitor rollup stamped at fit end (fit_report schema >= 5):
     worst component state, any non-OK components, and counted SLO
@@ -369,6 +415,7 @@ def render_record(rec: dict, out=sys.stdout) -> list[str]:
     _print_cost_model(rec, out)
     _print_tuning(rec, out)
     _print_health(rec, out)
+    _print_admission(rec, out)
     peak = rec.get("peak_device_bytes", 0)
     if peak:
         print(f"peak device memory: {_fmt_bytes(peak)}", file=out)
