@@ -212,13 +212,20 @@ class HostSyncRule(Rule):
         "worse, silently force a device->host sync per call. ops/ kernel "
         "modules must additionally stay sync-free everywhere: they are the "
         "pure jittable compute layer and dispatch decides when to wait. "
-        "Shape/dtype reads (static under tracing) are exempt; telemetry/ "
-        "is exempt (measurement is allowed to sync)."
+        "serving/ holds the same whole-module bar — its kernels feed the "
+        "AOT registry and a stray sync is per-request latency on the warm "
+        "path. Shape/dtype reads (static under tracing) are exempt; "
+        "telemetry/ is exempt (measurement is allowed to sync)."
     )
 
     SYNC_BUILTINS = frozenset({"float", "int", "bool"})
     SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
     NP_FUNCS = ("numpy.asarray", "numpy.array")
+    # scopes held to the whole-module sync-method bar, not just traced fns
+    SYNC_SCOPES = {
+        "/ops/": "ops/ kernel module",
+        "/serving/": "serving/ warm-path module",
+    }
 
     def check(self, mod: LintedModule) -> Iterator[Finding]:
         if "/telemetry/" in mod.relpath:
@@ -230,7 +237,11 @@ class HostSyncRule(Rule):
                 for n in ast.walk(stmt):
                     # nested defs inside a traced fn still trace (closures)
                     yield from self._check_node(mod, n, f"traced {label}")
-        if "/ops/" in mod.relpath:
+        scope_ctx = next(
+            (c for s, c in self.SYNC_SCOPES.items() if s in mod.relpath),
+            None,
+        )
+        if scope_ctx is not None:
             traced_nodes = {
                 id(x) for fn in traced for x in ast.walk(fn)
             }
@@ -238,7 +249,7 @@ class HostSyncRule(Rule):
                 if id(n) in traced_nodes:
                     continue  # already reported with traced context
                 yield from self._check_node(
-                    mod, n, "ops/ kernel module", methods_only=True
+                    mod, n, scope_ctx, methods_only=True
                 )
 
     def _check_node(self, mod, n, ctx, methods_only=False):
@@ -298,16 +309,26 @@ class RecompileHazardRule(Rule):
         "cache and retraces every call — the recompile storm the "
         "trace-report anomaly check flags at runtime. Build programs at "
         "module scope or in an @functools.lru_cache'd factory (the "
-        "parallel/ convention). Shape hazards are the runtime half of "
-        "this rule: Python scalars that vary per call belong in "
+        "parallel/ convention). In serving/ the same discipline covers "
+        "AOT lowering: a .lower(avals) call is a full trace+lower even "
+        "when the executable would be cache-hit, so it must live in a "
+        "cached factory (serving.registry._compiled_for), never per "
+        "request or per loop iteration. Shape hazards are the runtime "
+        "half of this rule: Python scalars that vary per call belong in "
         "static_argnums only if they are genuinely low-cardinality; "
         "varying data shapes belong in buckets (TPU_ML_MIN_BUCKET)."
     )
 
     def check(self, mod: LintedModule) -> Iterator[Finding]:
         for n in ast.walk(mod.tree):
-            if not _is_jit_call(mod, n):
+            if not (
+                _is_jit_call(mod, n) or self._is_aot_lower(mod, n)
+            ):
                 continue
+            what = (
+                "AOT .lower() trace" if self._is_aot_lower(mod, n)
+                else "jax.jit program"
+            )
             in_loop = any(
                 isinstance(a, (ast.For, ast.While, ast.AsyncFor))
                 for a in mod.ancestors(n)
@@ -315,8 +336,8 @@ class RecompileHazardRule(Rule):
             if in_loop:
                 yield self.finding(
                     mod, n,
-                    "jax.jit program constructed inside a loop — every "
-                    "iteration retraces; hoist the jit out of the loop",
+                    f"{what} constructed inside a loop — every "
+                    "iteration retraces; hoist it out of the loop",
                 )
                 continue
             encl = mod.enclosing_function(n)
@@ -332,10 +353,22 @@ class RecompileHazardRule(Rule):
                 continue  # jit-of-jit inside traced code is inlined, fine
             yield self.finding(
                 mod, n,
-                f"jax.jit program built per call of {encl.name}() — cache "
+                f"{what} built per call of {encl.name}() — cache "
                 "the factory with @functools.lru_cache or hoist to module "
-                "scope so repeat fits reuse the executable",
+                "scope so repeat calls reuse the executable",
             )
+
+    @staticmethod
+    def _is_aot_lower(mod: LintedModule, n: ast.AST) -> bool:
+        """A ``<jit-program>.lower(avals)`` AOT trace in serving/ — the
+        argumentless form is str.lower() and stays exempt everywhere."""
+        return (
+            "/serving/" in mod.relpath
+            and isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "lower"
+            and bool(n.args or n.keywords)
+        )
 
     @staticmethod
     def _has_cache_decorator(mod: LintedModule, fn: ast.FunctionDef) -> bool:

@@ -1,0 +1,67 @@
+"""Warm-path serving runtime: AOT registry, shape buckets, micro-batching.
+
+The fit path optimizes throughput; this package optimizes the *other* end
+of the model lifecycle — low-latency scoring of already-fitted models:
+
+- :mod:`.registry` — servable extraction + AOT compilation. Registering a
+  fitted model lowers its pure ``kernel(params, x)`` transform for every
+  rung of the serve bucket ladder up front (``jit(...).lower(...).compile()``)
+  and persists the executables through the XLA compilation cache
+  (``TPU_ML_SERVE_COMPILE_CACHE_DIR``), so a fresh process warms from disk
+  instead of recompiling.
+- :mod:`.buckets` — power-of-two row buckets with zero padding and
+  valid-row slicing; the enumerable bucket ladder is what makes the
+  zero-recompile regime a hard guarantee rather than a hope.
+- :mod:`.batcher` — bounded-queue micro-batching: concurrent requests for
+  the same ``(model, bucket)`` coalesce into one device dispatch inside a
+  ``TPU_ML_SERVE_MAX_DELAY_US`` window.
+- :mod:`.server` — ``/v1/models`` + ``/v1/models/<name>:predict`` HTTP
+  front-end grafted onto the telemetry exporter, so ``serve.latency``
+  lands in the same registry the SLO engine and ``/metrics`` read.
+
+Submodules are loaded lazily: ``buckets`` is importable without jax, and
+tooling that only wants the ladder math never pays the model-layer import.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_SUBMODULES = ("buckets", "registry", "batcher", "server")
+
+_LAZY_ATTRS = {
+    # buckets
+    "serve_bucket": "buckets",
+    "bucket_ladder": "buckets",
+    "pad_to_bucket": "buckets",
+    # registry
+    "ModelRegistry": "registry",
+    "ServableEntry": "registry",
+    "servable_from_model": "registry",
+    "get_registry": "registry",
+    "reset_for_tests": "registry",
+    # batcher
+    "MicroBatcher": "batcher",
+    "ServeFuture": "batcher",
+    # server
+    "ServingHTTPServer": "server",
+    "start_serving": "server",
+    "stop_serving": "server",
+    "get_serving_server": "server",
+}
+
+__all__ = list(_SUBMODULES) + sorted(_LAZY_ATTRS)
+
+
+def __getattr__(name: str):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"{__name__}.{name}")
+    target = _LAZY_ATTRS.get(name)
+    if target is not None:
+        module = importlib.import_module(f"{__name__}.{target}")
+        return getattr(module, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

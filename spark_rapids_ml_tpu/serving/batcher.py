@@ -1,0 +1,247 @@
+"""Micro-batcher: coalesce concurrent requests into one device dispatch.
+
+Single-row scoring at high concurrency wastes the device: each request pays
+its own dispatch + transfer for a matmul that is ~free at bucket width. The
+micro-batcher holds a bounded queue per ``(model, bucket)`` key; the first
+request of a group opens a coalescing window of ``TPU_ML_SERVE_MAX_DELAY_US``
+(default 2 ms), and everything that arrives for the same key inside the
+window rides the same dispatch — the prepared request blocks are stacked,
+padded to the combined bucket, run through the registry's AOT-compiled
+executable once, and the output rows are unpacked back to their per-request
+futures. The combined row count is capped at the model's largest AOT-warm
+bucket (itself bounded by ``TPU_ML_SERVE_MAX_BATCH_ROWS``, the ladder cap),
+so the coalesced dispatch always lands on a precompiled signature —
+coalescing can never cause a compile, even for a model registered with a
+truncated ``bucket_list``.
+
+The latency budget is explicit: worst-case added latency is the window, and
+every request's actual queue time is booked on the
+``serve.queue_delay_seconds`` histogram (tools/serve_report.py renders the
+percentiles). A request alone in its window costs only the window; the
+window only ever *saves* wall clock once two requests share a dispatch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.serving import buckets
+from spark_rapids_ml_tpu.serving.registry import ModelRegistry, get_registry
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils import knobs
+
+logger = logging.getLogger("spark_rapids_ml_tpu.serving")
+
+SERVE_MAX_DELAY_US_VAR = knobs.SERVE_MAX_DELAY_US.name
+
+
+def coalesce_window_s() -> float:
+    raw = os.environ.get(SERVE_MAX_DELAY_US_VAR, "")
+    try:
+        us = float(raw) if raw else float(knobs.SERVE_MAX_DELAY_US.default)
+    except ValueError:
+        us = float(knobs.SERVE_MAX_DELAY_US.default)
+    return max(0.0, us) / 1e6
+
+
+class ServeFuture:
+    """The per-request rendezvous: the batcher worker fills it, the serving
+    thread blocks on :meth:`result`."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result: np.ndarray | None = None
+        self._error: BaseException | None = None
+
+    def set_result(self, value: np.ndarray) -> None:
+        self._result = value
+        self._done.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._done.set()
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError("serve dispatch did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Pending:
+    __slots__ = ("mat", "rows", "future", "t_submit")
+
+    def __init__(self, mat: np.ndarray):
+        self.mat = mat
+        self.rows = mat.shape[0]
+        self.future = ServeFuture()
+        self.t_submit = time.perf_counter()
+
+
+class MicroBatcher:
+    """Bounded coalescing queue in front of the model registry."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry | None = None,
+        *,
+        max_delay_s: float | None = None,
+    ):
+        self.registry = registry if registry is not None else get_registry()
+        self.max_delay_s = (
+            max_delay_s if max_delay_s is not None else coalesce_window_s()
+        )
+        self._groups: dict[tuple[str, int], list[_Pending]] = {}
+        self._cond = threading.Condition()
+        self._thread: threading.Thread | None = None
+        self._stopping = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        with self._cond:
+            if self._thread is None or not self._thread.is_alive():
+                self._stopping = False
+                self._thread = threading.Thread(
+                    target=self._loop, name="tpu-ml-serve-batcher", daemon=True
+                )
+                self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._cond:
+            self._stopping = True
+            drained = [p for g in self._groups.values() for p in g]
+            self._groups.clear()
+            self._cond.notify_all()
+        for p in drained:
+            p.future.set_error(RuntimeError("micro-batcher stopped"))
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, model: str, x) -> ServeFuture:
+        """Queue one request; returns its future. ``prepare`` runs on the
+        caller thread (host preprocessing parallelizes across requests);
+        the device dispatch happens on the batcher worker."""
+        entry = self.registry.get(model)
+        mat = np.asarray(x, dtype=np.float64)
+        if mat.ndim == 1:
+            mat = mat[None, :]
+        if mat.ndim != 2 or mat.shape[1] != entry.n_features:
+            raise ValueError(
+                f"expected [rows, {entry.n_features}] input for {model!r}, "
+                f"got shape {mat.shape}"
+            )
+        prepared = entry.prepare(mat)
+        bucket = buckets.serve_bucket(prepared.shape[0])  # admission check
+        pending = _Pending(prepared)
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError("micro-batcher is stopped")
+            self._groups.setdefault((model, bucket), []).append(pending)
+            self._cond.notify_all()
+        return pending.future
+
+    # -- worker -------------------------------------------------------------
+
+    def _coalesce_cap(self, model: str) -> int:
+        """Largest row count one coalesced dispatch may reach for a model:
+        the model's largest AOT-warm bucket, never above the ladder cap.
+        Capping at the global ladder alone would let two warm-sized
+        requests combine into a bucket the registry never compiled — a
+        cold compile in steady state caused BY coalescing, which the
+        module contract forbids."""
+        cap = buckets.max_batch_rows()
+        try:
+            warm = self.registry.get(model).warm_buckets
+        except KeyError:
+            return cap
+        return min(cap, max(warm)) if warm else cap
+
+    def _loop(self) -> None:
+        while True:
+            batch = None
+            with self._cond:
+                while not self._stopping and not self._groups:
+                    self._cond.wait()
+                if self._stopping:
+                    return
+                now = time.perf_counter()
+                key, deadline = min(
+                    (
+                        (k, g[0].t_submit + self.max_delay_s)
+                        for k, g in self._groups.items()
+                    ),
+                    key=lambda kv: kv[1],
+                )
+                cap = self._coalesce_cap(key[0])
+                group = self._groups[key]
+                full = sum(p.rows for p in group) >= cap
+                if now < deadline and not full:
+                    self._cond.wait(deadline - now)
+                    continue
+                # take requests up to the ladder cap; the remainder opens
+                # the next window
+                taken, total = [], 0
+                while group and total + group[0].rows <= cap:
+                    total += group[0].rows
+                    taken.append(group.pop(0))
+                if not taken:
+                    # a single request larger than the model's warm set was
+                    # always a cold compile (same as the direct predict
+                    # path); it just must not drag neighbors into one
+                    taken.append(group.pop(0))
+                if not group:
+                    del self._groups[key]
+                batch = (key[0], taken)
+            if batch is not None:
+                self._dispatch(*batch)
+
+    def _dispatch(self, model: str, taken: list[_Pending]) -> None:
+        t0 = time.perf_counter()
+        try:
+            entry = self.registry.get(model)
+            for p in taken:
+                REGISTRY.histogram_record(
+                    "serve.queue_delay_seconds", t0 - p.t_submit, model=model
+                )
+            total = sum(p.rows for p in taken)
+            combined = (
+                taken[0].mat
+                if len(taken) == 1
+                else np.concatenate([p.mat for p in taken], axis=0)
+            )
+            bucket = buckets.serve_bucket(total)
+            REGISTRY.counter_inc(
+                "serve.bucket_hits", model=model, bucket=bucket
+            )
+            padded, _ = buckets.pad_to_bucket(combined, bucket)
+            raw = self.registry.dispatch_padded(entry, padded, bucket)
+            REGISTRY.counter_inc("serve.batches", model=model)
+            REGISTRY.histogram_record("serve.batch_rows", total, model=model)
+            REGISTRY.counter_inc("serve.rows", total, model=model)
+            offset = 0
+            for p in taken:
+                if entry.row_axis == 0:
+                    segment = raw[offset:offset + p.rows]
+                else:
+                    segment = np.take(
+                        raw, np.arange(offset, offset + p.rows),
+                        axis=entry.row_axis,
+                    )
+                p.future.set_result(entry.finalize(segment, p.rows))
+                offset += p.rows
+        except BaseException as e:  # noqa: BLE001 - fan the error out to
+            # every waiting request; the worker itself must survive
+            logger.exception("micro-batch dispatch failed for %s", model)
+            for p in taken:
+                p.future.set_error(e)

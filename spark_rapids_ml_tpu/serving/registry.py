@@ -1,0 +1,543 @@
+"""AOT-compiled model registry: the warm-path half of the serving runtime.
+
+The fit path can afford ``jax.jit``'s lazy compile-on-first-call; a scoring
+request cannot — a cold compile is tens of milliseconds to seconds, and the
+DataFrame plan machinery around ``Model.transform`` adds host work that
+dwarfs a single-row matmul. This module strips both away:
+
+- **Pure kernel extraction.** Each servable model family exposes its
+  transform as a pure ``kernel(params, x)`` function over device arrays
+  (project, predict_linear, standardize, forest_apply) plus host-side
+  ``prepare``/``finalize`` hooks for the parts that are host work in the
+  eager path too (PCA's pre-pad standardization, the forest's per-tree
+  vote normalization + argmax). The eager ``transform()`` and the serve
+  path therefore run the *same* device computation — the serving test
+  asserts bitwise equality.
+
+- **AOT compilation at registration.** ``register()`` lowers and compiles
+  the kernel for EVERY rung of the serve bucket ladder
+  (``serving.buckets.bucket_ladder``) via
+  ``jax.jit(kernel).lower(avals).compile()`` — so after registration,
+  arbitrary request sizes hit a precompiled signature and steady-state
+  serving is a zero-recompile regime (``serve_recompiles_after_warmup``
+  is a hard perf-ledger gate). The build lives in an
+  ``@functools.lru_cache`` factory keyed by (entry token, bucket), the
+  TPL003-sanctioned shape for program construction.
+
+- **Persistent warm start.** Compiles go through the XLA compilation
+  cache: ``TPU_ML_SERVE_COMPILE_CACHE_DIR`` names a serve-specific cache
+  dir (falling back to the shared ``TPU_ML_COMPILE_CACHE``), and the
+  persistence floor is dropped to zero so even fast kernels are written —
+  a fresh process re-registering the same models warms from disk
+  (``compile.cache_hits > 0``) instead of recompiling.
+
+- **Tuning-cache consult.** The registry asks the PR 7 tuning cache for a
+  blessed serve-kernel precision policy (key ``serve.<family>``); an
+  explicit ``bf16_f32acc`` entry swaps in the bf16-operand matmul variant
+  for the matmul families. Default stays ``f32`` — the eager-parity path.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from spark_rapids_ml_tpu.serving import buckets
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils import knobs
+
+logger = logging.getLogger("spark_rapids_ml_tpu.serving")
+
+SERVE_COMPILE_CACHE_DIR_VAR = knobs.SERVE_COMPILE_CACHE_DIR.name
+
+FAMILIES = ("pca", "linear", "scaler", "forest")
+
+
+# -- compile cache ----------------------------------------------------------
+
+_CACHE_LOCK = threading.Lock()
+_CACHE_DIR: str | None = None
+_CACHE_READY = False
+
+
+def enable_serve_compile_cache() -> str | None:
+    """Point the XLA compilation cache at the serve cache dir and drop the
+    persistence floor to zero, so every AOT serve kernel is written to disk
+    and a fresh process warms from it. Idempotent; returns the dir in use
+    (None when caching is disabled)."""
+    global _CACHE_DIR, _CACHE_READY
+    with _CACHE_LOCK:
+        if _CACHE_READY:
+            return _CACHE_DIR
+        import jax
+
+        from spark_rapids_ml_tpu.utils import config as config_mod
+
+        serve_dir = os.environ.get(SERVE_COMPILE_CACHE_DIR_VAR, "")
+        if serve_dir:
+            serve_dir = os.path.abspath(os.path.expanduser(serve_dir))
+            os.makedirs(serve_dir, exist_ok=True)
+            # enable_compilation_cache respects a pre-set dir, so set ours
+            # first and let it finish the wiring
+            jax.config.update("jax_compilation_cache_dir", serve_dir)
+        used = config_mod.enable_compilation_cache()
+        try:
+            # serve kernels are tiny: without this, fast compiles fall
+            # under the 0.5s persistence floor and never reach disk,
+            # which would silently defeat the warm start
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:  # noqa: BLE001 - older jax: keep the floor
+            logger.debug("jax_persistent_cache_min_compile_time_secs unsupported")
+        if used:
+            try:
+                # jax memoizes its cache-or-not decision at the FIRST
+                # backend compile of the process (compilation_cache
+                # ._cache_checked) — and model fits compile before any
+                # registration can set the dir, permanently disabling
+                # persistence for this process. Reset to pristine so the
+                # AOT serve compiles below re-evaluate with the dir set.
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _jax_cc,
+                )
+
+                _jax_cc.reset_cache()
+            except Exception:  # noqa: BLE001 - private-ish API: warm start
+                # degrades to cold compiles, never to a serve failure
+                logger.warning(
+                    "could not reset jax compilation cache; persistent "
+                    "serve warm start may be inactive", exc_info=True
+                )
+        _CACHE_DIR = used
+        _CACHE_READY = True
+        return used
+
+
+# -- pure serve kernels (params, x) -> out ----------------------------------
+# Module-scope so the AOT factory jits stable function objects; each mirrors
+# the device computation of the family's eager transform exactly (bitwise
+# parity is asserted in tests/test_serving.py).
+
+
+def _pca_kernel(params, x):
+    from spark_rapids_ml_tpu.ops import linalg as L
+
+    (pc,) = params
+    return L.project(x, pc)
+
+
+def _pca_kernel_bf16(params, x):
+    import jax.numpy as jnp
+
+    (pc,) = params
+    return jnp.matmul(
+        x.astype(jnp.bfloat16),
+        pc.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _linear_kernel(params, x):
+    from spark_rapids_ml_tpu.ops import linear as LIN
+
+    coef, intercept = params
+    return LIN.predict_linear(x, coef, intercept)
+
+
+def _linear_kernel_bf16(params, x):
+    import jax.numpy as jnp
+
+    coef, intercept = params
+    return (
+        jnp.matmul(
+            x.astype(jnp.bfloat16),
+            coef.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        + intercept
+    )
+
+
+def _scaler_kernel(params, x, *, with_mean, with_std):
+    from spark_rapids_ml_tpu.ops import scaler as S
+
+    mean, std = params
+    return S.standardize(x, mean, std, with_mean=with_mean, with_std=with_std)
+
+
+def _forest_kernel(params, x, *, max_depth):
+    from spark_rapids_ml_tpu.ops import forest as FO
+
+    trees, thresholds = params
+    return FO.forest_apply(
+        FO.TreeArrays(*trees), x, thresholds, max_depth=max_depth
+    )
+
+
+# -- servable entries -------------------------------------------------------
+
+_TOKEN_LOCK = threading.Lock()
+_TOKEN_SEQ = 0
+_ENTRIES_BY_TOKEN: dict[int, "ServableEntry"] = {}
+
+
+def _next_token(entry: "ServableEntry") -> int:
+    global _TOKEN_SEQ
+    with _TOKEN_LOCK:
+        _TOKEN_SEQ += 1
+        _ENTRIES_BY_TOKEN[_TOKEN_SEQ] = entry
+        return _TOKEN_SEQ
+
+
+@dataclass
+class ServableEntry:
+    """One registered model: its pure kernel, device params, host hooks,
+    and the set of buckets already AOT-compiled (warm)."""
+
+    name: str
+    family: str
+    model_cls: str
+    n_features: int
+    kernel: Callable
+    params: Any                       # device-array pytree the kernel takes
+    prepare: Callable                 # host pre-pad hook, np -> np
+    finalize: Callable                # host post hook, (np, true_rows) -> np
+    x_dtype: Any                      # device dtype of the padded block
+    policy: str = "f32"
+    row_axis: int = 0                 # rows axis of the raw kernel output
+    token: int = 0
+    warm_buckets: set[int] = field(default_factory=set)
+    model: Any = None
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "family": self.family,
+            "model_class": self.model_cls,
+            "n_features": self.n_features,
+            "policy": self.policy,
+            "buckets": sorted(self.warm_buckets),
+        }
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_for(token: int, bucket: int):
+    """AOT build: lower + compile one (entry, bucket) signature. Cached, so
+    the warmup loop and any steady-state miss share one executable; the
+    compile itself goes through the persistent XLA cache enabled above."""
+    import jax
+
+    entry = _ENTRIES_BY_TOKEN[token]
+    params_avals = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), entry.params
+    )
+    x_aval = jax.ShapeDtypeStruct((bucket, entry.n_features), entry.x_dtype)
+    compiled = jax.jit(entry.kernel).lower(params_avals, x_aval).compile()
+    REGISTRY.counter_inc(
+        "serve.aot_compiles", model=entry.name, bucket=bucket
+    )
+    return compiled
+
+
+# -- kernel extraction per model family -------------------------------------
+
+
+def _device_dtype() -> Any:
+    """The dtype ``jnp.asarray`` gives a float64 host block — f32 unless
+    x64 is enabled, matching every eager transform's conversion."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.zeros((), np.float64)).dtype
+
+
+def _consult_policy(family: str, n_features: int) -> str:
+    """Ask the PR 7 tuning cache for a blessed serve-kernel precision
+    policy. Only an explicit cache entry deviates from f32 — the tuner's
+    accuracy gates, not this registry, decide when bf16 operands are safe."""
+    try:
+        from spark_rapids_ml_tpu.autotune import cache as tuning_cache
+
+        cfg = tuning_cache.lookup(
+            tuning_cache.cache_key(f"serve.{family}", n=n_features)
+        )
+    except Exception:  # noqa: BLE001 - a tuner problem must not block serving
+        logger.exception("tuning-cache consult failed for serve.%s", family)
+        return "f32"
+    if cfg is not None and cfg.policy == "bf16_f32acc":
+        return cfg.policy
+    return "f32"
+
+
+def _identity_prepare(mat: np.ndarray) -> np.ndarray:
+    return mat
+
+
+def _identity_finalize(out: np.ndarray, true_rows: int) -> np.ndarray:
+    return out[:true_rows]
+
+
+def servable_from_model(name: str, model: Any) -> ServableEntry:
+    """Extract the pure ``kernel(params, x)`` + host hooks from a fitted
+    model. Raises ``TypeError`` for model families without a serve contract
+    (see CONTRIBUTING: adding a servable model)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.models.linear import _GLMModel
+    from spark_rapids_ml_tpu.models.pca import PCAModel
+    from spark_rapids_ml_tpu.models.scaler import StandardScalerModel
+    from spark_rapids_ml_tpu.utils import columnar
+
+    x_dtype = _device_dtype()
+
+    if isinstance(model, PCAModel):
+        pc = jnp.asarray(model.pc, dtype=x_dtype)
+        mean, std = model.mean, model.std
+
+        def prepare(mat, _mean=mean, _std=std):
+            # eager parity: standardization is host work applied BEFORE
+            # padding so pad rows stay zero (models/pca.py)
+            return columnar.standardize_host(mat, _mean, _std)
+
+        policy = _consult_policy("pca", int(model.pc.shape[0]))
+        kernel = _pca_kernel_bf16 if policy == "bf16_f32acc" else _pca_kernel
+        return ServableEntry(
+            name=name,
+            family="pca",
+            model_cls=type(model).__name__,
+            n_features=int(model.pc.shape[0]),
+            kernel=kernel,
+            params=(pc,),
+            prepare=prepare,
+            finalize=_identity_finalize,
+            x_dtype=x_dtype,
+            policy=policy,
+            model=model,
+        )
+
+    if isinstance(model, _GLMModel) and getattr(model, "coefficients", None) is not None:
+        coef = np.asarray(model.coefficients)
+        if coef.ndim != 1:
+            raise TypeError(
+                f"{type(model).__name__} is not single-output — the linear "
+                "serve contract covers [n]-coefficient GLMs"
+            )
+        n = int(coef.shape[0])
+        policy = _consult_policy("linear", n)
+        kernel = (
+            _linear_kernel_bf16 if policy == "bf16_f32acc" else _linear_kernel
+        )
+        return ServableEntry(
+            name=name,
+            family="linear",
+            model_cls=type(model).__name__,
+            n_features=n,
+            kernel=kernel,
+            params=(
+                jnp.asarray(coef, dtype=x_dtype),
+                jnp.asarray(model.intercept, dtype=x_dtype),
+            ),
+            prepare=_identity_prepare,
+            finalize=_identity_finalize,
+            x_dtype=x_dtype,
+            policy=policy,
+            model=model,
+        )
+
+    if isinstance(model, StandardScalerModel):
+        n = int(np.asarray(model.std).shape[0])
+        return ServableEntry(
+            name=name,
+            family="scaler",
+            model_cls=type(model).__name__,
+            n_features=n,
+            kernel=functools.partial(
+                _scaler_kernel,
+                with_mean=model.getWithMean(),
+                with_std=model.getWithStd(),
+            ),
+            params=(jnp.asarray(model.mean), jnp.asarray(model.std)),
+            prepare=_identity_prepare,
+            finalize=_identity_finalize,
+            x_dtype=x_dtype,
+            policy="f32",
+            model=model,
+        )
+
+    # forest classifier: device descent kernel + the host vote-normalization
+    # / argmax decision rule (eager parity: proba_and_predictions)
+    trees = getattr(model, "trees", None)
+    if trees is not None and hasattr(model, "proba_and_predictions"):
+        max_depth = int(np.log2(trees.feature.shape[1] + 1) - 1)
+        n = int(model.numFeatures)
+        num_trees = int(trees.feature.shape[0])
+
+        def finalize(leaf, true_rows, _t=num_trees):
+            leaf = leaf[:, :true_rows]
+            tot = leaf.sum(-1, keepdims=True)
+            per_tree = np.divide(
+                leaf, np.where(tot > 0, tot, 1.0), dtype=leaf.dtype
+            )
+            proba = per_tree.sum(0) / _t
+            return np.argmax(proba, axis=1).astype(np.float64)
+
+        return ServableEntry(
+            name=name,
+            family="forest",
+            model_cls=type(model).__name__,
+            n_features=n,
+            kernel=functools.partial(_forest_kernel, max_depth=max_depth),
+            params=(
+                tuple(jnp.asarray(a) for a in trees),
+                jnp.asarray(model.thresholds),
+            ),
+            prepare=_identity_prepare,
+            finalize=finalize,
+            x_dtype=x_dtype,
+            policy="f32",
+            row_axis=1,
+            model=model,
+        )
+
+    raise TypeError(
+        f"{type(model).__name__} has no serve contract — servable families: "
+        f"{', '.join(FAMILIES)} (see CONTRIBUTING, 'Adding a servable model')"
+    )
+
+
+# -- the registry -----------------------------------------------------------
+
+
+class ModelRegistry:
+    """Loads fitted models, AOT-compiles their kernels across the bucket
+    ladder, and dispatches padded blocks to the compiled executables."""
+
+    def __init__(self):
+        self._entries: dict[str, ServableEntry] = {}
+        self._lock = threading.RLock()
+
+    def register(
+        self,
+        name: str,
+        model: Any,
+        *,
+        bucket_list: tuple[int, ...] | None = None,
+    ) -> ServableEntry:
+        """Extract the model's pure kernel and AOT-compile it for every
+        bucket in ``bucket_list`` (default: the whole serve ladder). After
+        this returns, requests up to the ladder cap never compile."""
+        enable_serve_compile_cache()
+        from spark_rapids_ml_tpu.telemetry import compilemon
+
+        compilemon.install_monitoring()
+        entry = servable_from_model(name, model)
+        entry.token = _next_token(entry)
+        ladder = tuple(bucket_list) if bucket_list else buckets.bucket_ladder()
+        for b in ladder:
+            _compiled_for(entry.token, b)
+            entry.warm_buckets.add(b)
+        with self._lock:
+            self._entries[name] = entry
+            REGISTRY.gauge_set("serve.models", len(self._entries))
+        logger.info(
+            "registered servable %s (%s, n=%d, policy=%s, %d buckets)",
+            name, entry.family, entry.n_features, entry.policy, len(ladder),
+        )
+        return entry
+
+    def get(self, name: str) -> ServableEntry:
+        with self._lock:
+            try:
+                return self._entries[name]
+            except KeyError:
+                raise KeyError(
+                    f"no servable model {name!r} (registered: "
+                    f"{sorted(self._entries) or 'none'})"
+                ) from None
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def describe(self) -> list[dict]:
+        with self._lock:
+            return [e.describe() for _, e in sorted(self._entries.items())]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            REGISTRY.gauge_set("serve.models", 0)
+
+    # -- dispatch -----------------------------------------------------------
+
+    def dispatch_padded(
+        self, entry: ServableEntry, padded: np.ndarray, bucket: int
+    ) -> np.ndarray:
+        """Run one padded [bucket, n] block through the compiled executable;
+        returns the RAW (still padded) kernel output as a host array. A
+        bucket outside the warm set still works — it compiles on demand and
+        books ``serve.cold_compiles``, the steady-state anomaly
+        tools/serve_report.py flags."""
+        import jax.numpy as jnp
+
+        cold = bucket not in entry.warm_buckets
+        compiled = _compiled_for(entry.token, bucket)
+        if cold:
+            REGISTRY.counter_inc(
+                "serve.cold_compiles", model=entry.name, bucket=bucket
+            )
+            entry.warm_buckets.add(bucket)
+        xd = jnp.asarray(padded)  # same conversion the eager transform does
+        return np.asarray(compiled(entry.params, xd))
+
+    def predict(self, name: str, x: Any) -> np.ndarray:
+        """The direct (un-batched) serve path: prepare, pad, dispatch,
+        finalize. The micro-batcher uses the same pieces but coalesces
+        several requests into one dispatch."""
+        entry = self.get(name)
+        mat = np.asarray(x, dtype=np.float64)
+        if mat.ndim == 1:
+            mat = mat[None, :]
+        if mat.ndim != 2 or mat.shape[1] != entry.n_features:
+            raise ValueError(
+                f"expected [rows, {entry.n_features}] input for {name!r}, "
+                f"got shape {mat.shape}"
+            )
+        prepared = entry.prepare(mat)
+        bucket = buckets.serve_bucket(prepared.shape[0])
+        REGISTRY.counter_inc("serve.bucket_hits", model=name, bucket=bucket)
+        padded, true_rows = buckets.pad_to_bucket(prepared, bucket)
+        raw = self.dispatch_padded(entry, padded, bucket)
+        REGISTRY.counter_inc("serve.rows", true_rows, model=name)
+        return entry.finalize(raw, true_rows)
+
+
+_REGISTRY_LOCK = threading.Lock()
+_MODEL_REGISTRY: ModelRegistry | None = None
+
+
+def get_registry() -> ModelRegistry:
+    """The process-wide registry the serve front-end publishes."""
+    global _MODEL_REGISTRY
+    with _REGISTRY_LOCK:
+        if _MODEL_REGISTRY is None:
+            _MODEL_REGISTRY = ModelRegistry()
+        return _MODEL_REGISTRY
+
+
+def reset_for_tests() -> None:
+    """Drop the singleton registry and every cached executable (tests
+    only — production processes register once and keep everything warm)."""
+    global _MODEL_REGISTRY, _CACHE_READY, _CACHE_DIR
+    with _REGISTRY_LOCK:
+        _MODEL_REGISTRY = None
+    with _TOKEN_LOCK:
+        _ENTRIES_BY_TOKEN.clear()
+    _compiled_for.cache_clear()
+    with _CACHE_LOCK:
+        _CACHE_READY = False
+        _CACHE_DIR = None

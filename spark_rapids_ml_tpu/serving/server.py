@@ -1,0 +1,244 @@
+"""Serve front-end: /v1/models and /v1/models/<name>:predict over loopback.
+
+Extends the telemetry HTTP exporter (``telemetry/httpd.py``) rather than
+growing a second server: the handler subclasses the exporter's, so one port
+serves both the scrape surface (``/metrics``, ``/healthz``, ``/slo``,
+``/report``) and the prediction API — exactly the deployment shape the SLO
+engine wants, since the ``serve.latency`` histograms the predict handler
+books are evaluated by the same health monitor the exporter publishes
+(``TPU_ML_SLO=serve.latency:p99:0.005`` declares the warm-path objective).
+
+Endpoints:
+
+- ``GET  /v1/models`` — registered servables (name, family, feature count,
+  precision policy, warm buckets).
+- ``POST /v1/models/<name>:predict`` — body ``{"instances": [[...], ...]}``
+  (one row per instance); responds ``{"predictions": [...], "rows": N,
+  "latency_ms": ...}``. Requests ride the micro-batcher, so concurrent
+  callers of the same (model, bucket) share one device dispatch.
+
+Every request books ``serve.requests``/``serve.rows`` counters and a
+``serve.latency`` histogram sample labeled by model; failures book
+``serve.errors``. Oversized requests are refused with HTTP 413 at admission
+(the bucket ladder cap), malformed bodies with 400, unknown models 404.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
+from spark_rapids_ml_tpu.serving.registry import ModelRegistry, get_registry
+from spark_rapids_ml_tpu.telemetry import httpd
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+logger = logging.getLogger("spark_rapids_ml_tpu.serving")
+
+PREDICT_SUFFIX = ":predict"
+
+
+class ServeHandler(httpd._Handler):
+    """The exporter handler plus the model-serving API. GET falls through
+    to the exporter for everything under its routes."""
+
+    server_version = "tpu-ml-serve/1.0"
+
+    @property
+    def _registry(self) -> ModelRegistry:
+        return self.server.model_registry
+
+    @property
+    def _batcher(self) -> MicroBatcher:
+        return self.server.batcher
+
+    def do_GET(self):  # noqa: N802 - http.server naming contract
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/v1/models":
+            REGISTRY.counter_inc("http.requests", path=path)
+            self._json(200, {"models": self._registry.describe()})
+            return
+        super().do_GET()
+
+    def do_POST(self):  # noqa: N802 - http.server naming contract
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        REGISTRY.counter_inc("http.requests", path=path)
+        if not (
+            path.startswith("/v1/models/") and path.endswith(PREDICT_SUFFIX)
+        ):
+            self._json(404, {"error": f"no such endpoint: {path}"})
+            return
+        name = path[len("/v1/models/"):-len(PREDICT_SUFFIX)]
+        t0 = time.perf_counter()
+        try:
+            instances = self._read_instances()
+            future = self._batcher.submit(name, instances)
+            out = future.result(timeout=30.0)
+        except KeyError as e:
+            self._serve_error(name, 404, str(e))
+            return
+        except ValueError as e:
+            code = 413 if "ladder cap" in str(e) else 400
+            self._serve_error(name, code, str(e))
+            return
+        except Exception as e:  # noqa: BLE001 - predict must answer, not die
+            logger.exception("predict failed for model %s", name)
+            self._serve_error(name, 500, f"{type(e).__name__}: {e}")
+            return
+        latency = time.perf_counter() - t0
+        # serve.rows is booked once per dispatch by the batcher; here we
+        # book the request-level series the SLO engine watches.
+        REGISTRY.counter_inc("serve.requests", model=name, code=200)
+        REGISTRY.histogram_record("serve.latency", latency, model=name)
+        self._json(
+            200,
+            {
+                "model": name,
+                "rows": int(np.shape(out)[0]),
+                # host numpy -> JSON; no device sync involved
+                "predictions": np.asarray(out).tolist(),  # tpulint: disable=TPL002
+                "latency_ms": round(latency * 1e3, 3),
+            },
+        )
+
+    def _read_instances(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            raise ValueError("empty request body — expected JSON instances")
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except json.JSONDecodeError as e:
+            raise ValueError(f"request body is not valid JSON: {e}") from e
+        instances = (
+            payload.get("instances") if isinstance(payload, dict) else payload
+        )
+        if instances is None:
+            raise ValueError('missing "instances" in request body')
+        return instances
+
+    def _serve_error(self, model: str, code: int, detail: str) -> None:
+        REGISTRY.counter_inc("serve.errors", model=model, code=code)
+        REGISTRY.counter_inc("serve.requests", model=model, code=code)
+        self._json(code, {"error": detail, "model": model})
+
+
+class ServingHTTPServer(httpd.HealthHTTPServer):
+    """The exporter server with the serve handler, a model registry, and a
+    running micro-batcher attached."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        *,
+        registry: ModelRegistry | None = None,
+        batcher: MicroBatcher | None = None,
+    ):
+        from http.server import ThreadingHTTPServer
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), ServeHandler)
+        self._httpd.daemon_threads = True
+        self._thread = None
+        self._httpd.model_registry = (
+            registry if registry is not None else get_registry()
+        )
+        self._httpd.batcher = (
+            batcher
+            if batcher is not None
+            else MicroBatcher(self._httpd.model_registry)
+        )
+
+    @property
+    def registry(self) -> ModelRegistry:
+        return self._httpd.model_registry
+
+    @property
+    def batcher(self) -> MicroBatcher:
+        return self._httpd.batcher
+
+    def start(self) -> "ServingHTTPServer":
+        self.batcher.start()
+        super().start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        super().stop(timeout)
+        self.batcher.stop(timeout)
+
+
+def serve_summary(snap) -> dict:
+    """JSON-safe summary of the serving activity inside one snapshot window
+    (pass ``REGISTRY.snapshot().delta(prev)``): request/batch/compile
+    counters, per-bucket hit counts, and the latency + queue-delay
+    histogram digests. This is the evidence blob ``bench.py --smoke`` rides
+    on the perf ledger and ``tools/serve_report.py`` renders."""
+    bucket_hits: dict[str, float] = {}
+    for (n, lbl), v in snap.counters.items():
+        if n == "serve.bucket_hits":
+            b = str(dict(lbl).get("bucket", "?"))
+            bucket_hits[b] = bucket_hits.get(b, 0) + v
+    from spark_rapids_ml_tpu.serving.batcher import coalesce_window_s
+
+    return {
+        "type": "serve_summary",
+        "coalesce_window_s": coalesce_window_s(),
+        "requests": snap.counter("serve.requests"),
+        "errors": snap.counter("serve.errors"),
+        "rows": snap.counter("serve.rows"),
+        "batches": snap.counter("serve.batches"),
+        "aot_compiles": snap.counter("serve.aot_compiles"),
+        "cold_compiles": snap.counter("serve.cold_compiles"),
+        "bucket_hits": bucket_hits,
+        "latency": snap.hist("serve.latency").to_dict(),
+        "queue_delay": snap.hist("serve.queue_delay_seconds").to_dict(),
+        "batch_rows": snap.hist("serve.batch_rows").to_dict(),
+    }
+
+
+# -- module singleton --------------------------------------------------------
+
+_LOCK = threading.Lock()
+_SERVER: ServingHTTPServer | None = None
+
+
+def start_serving(
+    port: int = 0,
+    *,
+    registry: ModelRegistry | None = None,
+    with_monitor: bool = True,
+) -> ServingHTTPServer:
+    """Start (or return) the process-wide serve front-end. The health
+    monitor rides along by default so declared SLOs (``TPU_ML_SLO``) are
+    evaluated live against the ``serve.latency`` series."""
+    global _SERVER
+    with _LOCK:
+        if _SERVER is None:
+            _SERVER = ServingHTTPServer(port, registry=registry).start()
+        server = _SERVER
+    if with_monitor:
+        from spark_rapids_ml_tpu.telemetry import health as health_mod
+
+        health_mod.start_monitor()
+    return server
+
+
+def get_serving_server() -> ServingHTTPServer | None:
+    with _LOCK:
+        return _SERVER
+
+
+def stop_serving(timeout: float = 5.0, *, stop_monitor: bool = True) -> None:
+    """Stop and forget the serve front-end. No-op when nothing runs."""
+    global _SERVER
+    with _LOCK:
+        server = _SERVER
+        _SERVER = None
+    if server is not None:
+        server.stop(timeout)
+    if stop_monitor:
+        from spark_rapids_ml_tpu.telemetry import health as health_mod
+
+        health_mod.stop_monitor(timeout)

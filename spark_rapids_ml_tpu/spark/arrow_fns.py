@@ -34,6 +34,7 @@ instead of JVM serialization.
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from typing import Callable, Iterable, Iterator, NamedTuple
 
@@ -884,6 +885,9 @@ class NanRangePartitionFn(_StatsAccumulatorFn):
 
 
 
+_transform_nesting = threading.local()
+
+
 class _InstrumentedTransformFn:
     """Serve-side instrumentation shared by every transform partition body.
 
@@ -895,6 +899,15 @@ class _InstrumentedTransformFn:
     driver labeled ``partition=N``, where ``end_transform`` folds them into
     the TransformReport. The ``finally`` booking means a partition that
     dies mid-batch still reports the rows it consumed.
+
+    Chained lazy plans drive these generators re-entrantly: the final
+    stage's generator pulls the previous stage's inside ONE thread, so a
+    two-stage pipeline would double-book every input row on the volume
+    counters. Mirroring the nested-fit guard in ``models.base``
+    (``_fit_depth``), a thread-local depth marks the outermost generator,
+    and only it books ``transform.rows``/``bytes``/``batches``; the
+    per-stage latency histogram and timeline span stay unconditional —
+    stage timing is real work, row volume is not per-stage.
     """
 
     def __call__(
@@ -913,14 +926,18 @@ class _InstrumentedTransformFn:
                 nbatches += 1
                 yield b
 
+        entry_depth = getattr(_transform_nesting, "depth", 0)
+        _transform_nesting.depth = entry_depth + 1
         t0 = time.perf_counter()
         try:
             yield from self._run(counted(batches))
         finally:
+            _transform_nesting.depth = entry_depth
             t1 = time.perf_counter()
-            REGISTRY.counter_inc("transform.rows", rows, fn=fn)
-            REGISTRY.counter_inc("transform.bytes", nbytes, fn=fn)
-            REGISTRY.counter_inc("transform.batches", nbatches, fn=fn)
+            if entry_depth == 0:
+                REGISTRY.counter_inc("transform.rows", rows, fn=fn)
+                REGISTRY.counter_inc("transform.bytes", nbytes, fn=fn)
+                REGISTRY.counter_inc("transform.batches", nbatches, fn=fn)
             REGISTRY.histogram_record(
                 "transform.partition_seconds", t1 - t0, fn=fn
             )

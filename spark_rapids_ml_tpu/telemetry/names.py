@@ -77,6 +77,18 @@ METRICS: frozenset[str] = frozenset({
     "slo.rolling",
     # HTTP exporter (telemetry.httpd)
     "http.requests",
+    # warm-path serving runtime (spark_rapids_ml_tpu.serving)
+    "serve.requests",
+    "serve.rows",
+    "serve.errors",
+    "serve.latency",
+    "serve.queue_delay_seconds",
+    "serve.batches",
+    "serve.batch_rows",
+    "serve.bucket_hits",
+    "serve.models",
+    "serve.aot_compiles",
+    "serve.cold_compiles",
     # serve path
     "transform.rows",
     "transform.bytes",

@@ -173,6 +173,22 @@ _DECLARATIONS = (
     Knob("TPU_ML_PRECISION_POLICY", "enum", "f32",
          "`f32`/`bf16_f32acc`/`int8_dist` mixed-precision kernel policy "
          "default (accumulators stay f32)", "autotune.policy"),
+    # -- warm-path serving runtime (spark_rapids_ml_tpu.serving) ------------
+    Knob("TPU_ML_SERVE_COMPILE_CACHE_DIR", "path", "",
+         "persistent XLA cache dir for AOT-compiled serve kernels (fresh "
+         "processes warm from disk; empty = share TPU_ML_COMPILE_CACHE)",
+         "serving.registry"),
+    Knob("TPU_ML_SERVE_MIN_BUCKET", "int", "8",
+         "serve-path row-bucket floor (smaller than the fit-path "
+         "TPU_ML_MIN_BUCKET so single-row scoring pads less)",
+         "serving.buckets"),
+    Knob("TPU_ML_SERVE_MAX_BATCH_ROWS", "int", "4096",
+         "largest serve row bucket; caps one micro-batched dispatch and "
+         "bounds the AOT-compiled signature ladder", "serving.buckets"),
+    Knob("TPU_ML_SERVE_MAX_DELAY_US", "float", "2000",
+         "micro-batcher coalescing window: a queued request waits at most "
+         "this long for same-(model,bucket) company before dispatch",
+         "serving.batcher"),
     # -- transport monitor / health daemon (tools/healthd.py) ---------------
     Knob("TPU_ML_MONITOR_BENCH_OUT", "path", "BENCH_OPPORTUNISTIC_r05.json",
          "opportunistic bench output file (relative to the repo)",
@@ -281,6 +297,10 @@ AUTOTUNE = KNOBS["TPU_ML_AUTOTUNE"]
 AUTOTUNE_TRIALS = KNOBS["TPU_ML_AUTOTUNE_TRIALS"]
 TUNING_CACHE_PATH = KNOBS["TPU_ML_TUNING_CACHE_PATH"]
 PRECISION_POLICY = KNOBS["TPU_ML_PRECISION_POLICY"]
+SERVE_COMPILE_CACHE_DIR = KNOBS["TPU_ML_SERVE_COMPILE_CACHE_DIR"]
+SERVE_MIN_BUCKET = KNOBS["TPU_ML_SERVE_MIN_BUCKET"]
+SERVE_MAX_BATCH_ROWS = KNOBS["TPU_ML_SERVE_MAX_BATCH_ROWS"]
+SERVE_MAX_DELAY_US = KNOBS["TPU_ML_SERVE_MAX_DELAY_US"]
 MONITOR_BENCH_OUT = KNOBS["TPU_ML_MONITOR_BENCH_OUT"]
 MONITOR_DRIFT_OUT = KNOBS["TPU_ML_MONITOR_DRIFT_OUT"]
 MONITOR_INTERVAL_S = KNOBS["TPU_ML_MONITOR_INTERVAL_S"]

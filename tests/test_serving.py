@@ -1,0 +1,584 @@
+"""Warm-path serving runtime: AOT registry, shape buckets, micro-batching.
+
+Covers the ISSUE-10 acceptance list: after a 2-request warmup per bucket,
+50 mixed-size concurrent requests across 2 models produce ZERO new compiles
+(asserted via the telemetry compile counters) and every response is bitwise
+equal to the eager ``transform()`` result on the unpadded rows; a fresh
+process re-registering the same model warms from the persistent XLA cache
+(``compile.cache_hits > 0``, no slow lowering); the bucket ladder rounds,
+pads and rejects correctly; the micro-batcher coalesces concurrent
+same-(model,bucket) requests into one device dispatch; and the HTTP
+front-end serves ``/v1/models`` + ``:predict`` with the documented error
+codes while keeping the exporter's ``/metrics`` surface alive.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.serving import buckets
+from spark_rapids_ml_tpu.serving import registry as registry_mod
+from spark_rapids_ml_tpu.serving import server as server_mod
+from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BUCKETS = (8, 16, 32, 64)
+
+
+@pytest.fixture(autouse=True)
+def serve_clean():
+    yield
+    server_mod.stop_serving(stop_monitor=False)
+    registry_mod.reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def fitted_models():
+    """One dataset and two fitted models (PCA + linear) shared across the
+    serving tests; registration happens per-test against a fresh registry."""
+    from spark_rapids_ml_tpu.models.linear import LinearRegression
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(200, 6))
+    y = x @ rng.normal(size=6) + 0.5
+    pca = PCA().setInputCol("features").setK(3).fit(x)
+    lin = LinearRegression().fit((x, y))
+    return x, pca, lin
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post(port: int, path: str, payload) -> tuple[int, dict]:
+    data = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=data,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# -- bucket ladder ----------------------------------------------------------
+
+
+class TestBuckets:
+    @pytest.fixture(autouse=True)
+    def _ladder_env(self, monkeypatch):
+        monkeypatch.setenv("TPU_ML_SERVE_MIN_BUCKET", "8")
+        monkeypatch.setenv("TPU_ML_SERVE_MAX_BATCH_ROWS", "64")
+
+    def test_serve_bucket_rounds_up_to_power_of_two(self):
+        assert buckets.serve_bucket(1) == 8
+        assert buckets.serve_bucket(8) == 8
+        assert buckets.serve_bucket(9) == 16
+        assert buckets.serve_bucket(33) == 64
+        assert buckets.serve_bucket(64) == 64
+
+    def test_empty_and_oversized_requests_rejected(self):
+        with pytest.raises(ValueError, match="at least one row"):
+            buckets.serve_bucket(0)
+        with pytest.raises(ValueError, match="ladder cap"):
+            buckets.serve_bucket(65)
+
+    def test_ladder_enumerates_every_rung(self):
+        assert buckets.bucket_ladder() == (8, 16, 32, 64)
+
+    def test_non_power_of_two_knobs_round_up(self, monkeypatch):
+        monkeypatch.setenv("TPU_ML_SERVE_MIN_BUCKET", "6")
+        monkeypatch.setenv("TPU_ML_SERVE_MAX_BATCH_ROWS", "100")
+        assert buckets.min_bucket() == 8
+        assert buckets.max_batch_rows() == 128
+        assert buckets.bucket_ladder() == (8, 16, 32, 64, 128)
+
+    def test_pad_to_bucket_zero_fills_and_reports_true_rows(self):
+        x = np.arange(12.0).reshape(3, 4)
+        padded, true_rows = buckets.pad_to_bucket(x)
+        assert padded.shape == (8, 4)
+        assert true_rows == 3
+        assert np.array_equal(padded[:3], x)
+        assert not padded[3:].any()
+        # exact fit returns the block untouched
+        full = np.ones((8, 4))
+        same, rows = buckets.pad_to_bucket(full)
+        assert same is full and rows == 8
+        with pytest.raises(ValueError, match="do not fit"):
+            buckets.pad_to_bucket(x, bucket=2)
+
+
+# -- registry: kernel extraction + eager parity -----------------------------
+
+
+class TestRegistryParity:
+    SIZES = (1, 3, 8, 17, 40, 60)
+
+    def _assert_parity(self, name, model, x):
+        reg = registry_mod.get_registry()
+        reg.register(name, model, bucket_list=BUCKETS)
+        for n in self.SIZES:
+            got = reg.predict(name, x[:n])
+            expected = np.asarray(model.transform(x[:n]))
+            assert got.shape == expected.shape, n
+            assert np.array_equal(got, expected), (
+                f"serve/eager mismatch for {name} at {n} rows"
+            )
+
+    def test_pca_bitwise_parity(self, fitted_models):
+        x, pca, _ = fitted_models
+        self._assert_parity("pca", pca, x)
+
+    def test_linear_bitwise_parity(self, fitted_models):
+        x, _, lin = fitted_models
+        self._assert_parity("linear", lin, x)
+
+    def test_scaler_bitwise_parity(self, rng):
+        from spark_rapids_ml_tpu.models.scaler import StandardScaler
+
+        x = rng.normal(loc=3.0, scale=2.0, size=(120, 5))
+        scaler = (
+            StandardScaler()
+            .setInputCol("features")
+            .setWithMean(True)
+            .setWithStd(True)
+            .fit(x)
+        )
+        self._assert_parity("scaler", scaler, x)
+
+    def test_forest_bitwise_parity(self, rng):
+        from spark_rapids_ml_tpu.models.forest import RandomForestClassifier
+
+        x = rng.normal(size=(150, 4))
+        yc = (x[:, 0] + x[:, 1] > 0).astype(np.int64)
+        forest = (
+            RandomForestClassifier().setNumTrees(5).setSeed(3).fit((x, yc))
+        )
+        self._assert_parity("forest", forest, x)
+
+    def test_unservable_model_raises_type_error(self):
+        with pytest.raises(TypeError, match="no serve contract"):
+            registry_mod.get_registry().register("bad", object())
+
+    def test_unknown_model_raises_key_error(self):
+        with pytest.raises(KeyError, match="no servable model"):
+            registry_mod.get_registry().predict("ghost", [[1.0]])
+
+    def test_describe_reports_warm_buckets(self, fitted_models):
+        _, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8, 16))
+        (desc,) = reg.describe()
+        assert desc["name"] == "p"
+        assert desc["family"] == "pca"
+        assert desc["n_features"] == 6
+        assert desc["buckets"] == [8, 16]
+
+    def test_unwarmed_bucket_books_cold_compile(self, fitted_models):
+        """A bucket outside the registered list still serves — but books
+        serve.cold_compiles, the steady-state anomaly the report flags."""
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        snap = REGISTRY.snapshot()
+        got = reg.predict("p", x[:9])  # rounds to 16: never AOT-compiled
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("serve.cold_compiles") == 1
+        assert np.array_equal(got, np.asarray(pca.transform(x[:9])))
+        # the miss is now warm: a second hit does not re-book
+        snap = REGISTRY.snapshot()
+        reg.predict("p", x[:9])
+        assert REGISTRY.snapshot().delta(snap).counter("serve.cold_compiles") == 0
+
+
+# -- micro-batcher ----------------------------------------------------------
+
+
+class TestMicroBatcher:
+    def test_concurrent_requests_share_one_dispatch(self, fitted_models):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8, 16))
+        batcher = MicroBatcher(reg, max_delay_s=0.2).start()
+        try:
+            snap = REGISTRY.snapshot()
+            futures = [batcher.submit("p", x[i : i + 1]) for i in range(8)]
+            outs = [f.result(timeout=30.0) for f in futures]
+        finally:
+            batcher.stop()
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("serve.batches") == 1  # 8 requests, 1 dispatch
+        assert delta.counter("serve.rows") == 8
+        assert delta.hist("serve.queue_delay_seconds").count == 8
+        expected = np.asarray(pca.transform(x[:8]))
+        for i, out in enumerate(outs):
+            assert np.array_equal(np.asarray(out), expected[i : i + 1])
+
+    def test_coalescing_never_exceeds_the_warm_bucket_set(self, fitted_models):
+        """Requests that would combine past the model's largest AOT-warm
+        bucket split into multiple warm dispatches instead of coalescing
+        into an unwarmed (cold-compiling) one."""
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8, 16))
+        batcher = MicroBatcher(reg, max_delay_s=0.2).start()
+        try:
+            snap = REGISTRY.snapshot()
+            # 4 x 8 rows inside one window: 32 combined would round to an
+            # unwarmed 32-bucket — must dispatch as 2 x 16 instead
+            futures = [batcher.submit("p", x[8 * i : 8 * i + 8]) for i in range(4)]
+            for f in futures:
+                f.result(timeout=30.0)
+        finally:
+            batcher.stop()
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("serve.cold_compiles") == 0
+        assert delta.counter("serve.batches") == 2
+        assert delta.counter("serve.rows") == 32
+
+    def test_submit_validates_before_queueing(self, fitted_models, monkeypatch):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        batcher = MicroBatcher(reg)  # not started: all paths raise at submit
+        with pytest.raises(KeyError):
+            batcher.submit("ghost", x[:1])
+        with pytest.raises(ValueError, match="expected"):
+            batcher.submit("p", np.ones((2, 4)))
+        monkeypatch.setenv("TPU_ML_SERVE_MAX_BATCH_ROWS", "16")
+        with pytest.raises(ValueError, match="ladder cap"):
+            batcher.submit("p", np.ones((17, 6)))
+
+    def test_stop_fans_error_to_waiting_requests(self, fitted_models):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        batcher = MicroBatcher(reg, max_delay_s=60.0).start()
+        future = batcher.submit("p", x[:1])
+        batcher.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            future.result(timeout=5.0)
+
+
+# -- HTTP front-end ---------------------------------------------------------
+
+
+class TestServeHTTP:
+    def test_models_listing_and_predict(self, fitted_models):
+        x, pca, _ = fitted_models
+        registry_mod.get_registry().register("pca_http", pca, bucket_list=(8,))
+        srv = server_mod.start_serving(0, with_monitor=False)
+        code, raw = _get(srv.port, "/v1/models")
+        assert code == 200
+        (desc,) = json.loads(raw)["models"]
+        assert desc["name"] == "pca_http" and desc["family"] == "pca"
+
+        code, body = _post(
+            srv.port, "/v1/models/pca_http:predict", {"instances": x[:3].tolist()}
+        )
+        assert code == 200
+        assert body["model"] == "pca_http" and body["rows"] == 3
+        assert body["latency_ms"] >= 0
+        expected = np.asarray(pca.transform(x[:3]))
+        assert np.array_equal(
+            np.asarray(body["predictions"], dtype=expected.dtype), expected
+        )
+
+    def test_error_codes(self, fitted_models, monkeypatch):
+        x, pca, _ = fitted_models
+        registry_mod.get_registry().register("p", pca, bucket_list=(8,))
+        srv = server_mod.start_serving(0, with_monitor=False)
+        # unknown model with a valid body -> 404
+        code, body = _post(
+            srv.port, "/v1/models/ghost:predict", {"instances": [[1.0] * 6]}
+        )
+        assert code == 404 and "ghost" in body["error"]
+        # malformed body (no instances) -> 400
+        code, body = _post(srv.port, "/v1/models/p:predict", {})
+        assert code == 400
+        # oversized request -> 413 at admission
+        monkeypatch.setenv("TPU_ML_SERVE_MAX_BATCH_ROWS", "16")
+        code, body = _post(
+            srv.port,
+            "/v1/models/p:predict",
+            {"instances": np.ones((17, 6)).tolist()},
+        )
+        assert code == 413 and "ladder cap" in body["error"]
+        # wrong endpoint -> 404
+        code, _ = _post(srv.port, "/v1/nonsense", {"instances": []})
+        assert code == 404
+
+    def test_exporter_surface_still_served(self, fitted_models):
+        """The serve front-end extends the telemetry exporter: /metrics on
+        the SAME port carries the serve.* series the SLO engine watches."""
+        x, pca, _ = fitted_models
+        registry_mod.get_registry().register("p", pca, bucket_list=(8,))
+        srv = server_mod.start_serving(0, with_monitor=False)
+        _post(srv.port, "/v1/models/p:predict", {"instances": x[:2].tolist()})
+        code, raw = _get(srv.port, "/metrics")
+        assert code == 200
+        text = raw.decode()
+        assert "tpu_ml_serve_requests" in text
+        assert "tpu_ml_serve_latency" in text
+
+
+# -- the acceptance test ----------------------------------------------------
+
+
+class TestWarmPathAcceptance:
+    def test_zero_recompiles_and_bitwise_parity_under_concurrency(
+        self, fitted_models
+    ):
+        """2-request warmup per (model, bucket), then 50 mixed-size
+        concurrent requests across 2 models: zero new compiles (telemetry
+        compile counters) and every response bitwise-equal to the eager
+        transform() on the unpadded rows."""
+        x, pca, lin = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("pca_a", pca, bucket_list=BUCKETS)
+        reg.register("lin_b", lin, bucket_list=BUCKETS)
+        srv = server_mod.start_serving(0, with_monitor=False)
+
+        for name in ("pca_a", "lin_b"):
+            for bucket in BUCKETS:
+                for _ in range(2):
+                    code, _ = _post(
+                        srv.port,
+                        f"/v1/models/{name}:predict",
+                        {"instances": x[:bucket].tolist()},
+                    )
+                    assert code == 200
+
+        snap_warm = REGISTRY.snapshot()
+
+        sizes = (1, 2, 3, 5, 8, 12, 17, 30, 40, 60)
+        requests = []
+        for i in range(50):
+            n = sizes[i % len(sizes)]
+            name, model = ("pca_a", pca) if i % 2 == 0 else ("lin_b", lin)
+            start = (i * 3) % (len(x) - n)
+            requests.append((name, model, x[start : start + n]))
+
+        def call(req):
+            name, model, xs = req
+            code, body = _post(
+                srv.port,
+                f"/v1/models/{name}:predict",
+                {"instances": xs.tolist()},
+            )
+            return code, body, model, xs
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            results = list(pool.map(call, requests))
+
+        window = REGISTRY.snapshot().delta(snap_warm)
+        # the hard gate: nothing compiled after warmup
+        assert window.hist("compile.seconds").count == 0
+        assert window.counter("serve.cold_compiles") == 0
+        assert window.counter("serve.requests") >= 50
+        assert window.counter("serve.errors") == 0
+        assert window.hist("serve.latency").count == 50
+        # every response bitwise-equal to the eager transform (JSON carries
+        # float64 exactly via repr round-trip)
+        for code, body, model, xs in results:
+            assert code == 200
+            expected = np.asarray(model.transform(xs))
+            got = np.asarray(body["predictions"], dtype=expected.dtype)
+            assert got.shape == expected.shape
+            assert np.array_equal(got, expected)
+        # the evidence blob bench rides on the ledger renders from this window
+        summary = server_mod.serve_summary(window)
+        assert summary["requests"] >= 50
+        assert summary["cold_compiles"] == 0
+        assert summary["latency"]["count"] == 50
+        assert sum(summary["bucket_hits"].values()) > 0
+
+
+# -- persistent compile-cache warm start (subprocess) -----------------------
+
+
+_WARM_SCRIPT = """
+import json
+import numpy as np
+from spark_rapids_ml_tpu.models.pca import PCA
+from spark_rapids_ml_tpu.serving import registry as serve_registry
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+x = np.linspace(0.0, 1.0, 64 * 6).reshape(64, 6)
+model = PCA().setInputCol("features").setK(3).fit(x)
+snap = REGISTRY.snapshot()
+serve_registry.get_registry().register("warm_pca", model, bucket_list=(8, 16))
+delta = REGISTRY.snapshot().delta(snap)
+lower = delta.hist("compile.lower_seconds")
+print(json.dumps({
+    "cache_hits": delta.counter("compile.cache_hits"),
+    "cache_misses": delta.counter("compile.cache_misses"),
+    "lower_max_s": float(lower.vmax) if lower.count else 0.0,
+    "aot_compiles": delta.counter("serve.aot_compiles"),
+}))
+"""
+
+
+class TestCompileCacheWarmStart:
+    def test_second_process_warms_from_disk(self, tmp_path):
+        """Two fresh processes register the same model against the same
+        TPU_ML_SERVE_COMPILE_CACHE_DIR: the second reports cache hits and
+        no slow lowering — the registration-time compiles were loads."""
+        cache_dir = tmp_path / "serve_cache"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TPU_ML_SERVE_COMPILE_CACHE_DIR"] = str(cache_dir)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+        def run_once():
+            proc = subprocess.run(
+                [sys.executable, "-c", _WARM_SCRIPT],
+                capture_output=True,
+                text=True,
+                env=env,
+                cwd=REPO,
+                timeout=300,
+            )
+            assert proc.returncode == 0, proc.stderr
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        first = run_once()
+        assert first["aot_compiles"] == 2
+        assert first["cache_misses"] > 0, first
+        cached = [p for p in cache_dir.rglob("*") if p.is_file()]
+        assert cached, "registration wrote nothing to the serve cache dir"
+
+        second = run_once()
+        assert second["aot_compiles"] == 2
+        assert second["cache_hits"] > 0, second
+        # a warm start never re-lowers slowly: the AOT .lower() still runs
+        # (tracing is not cached) but stays far under a cold XLA compile
+        assert second["lower_max_s"] < 2.0, second
+
+
+# -- serve_report CLI -------------------------------------------------------
+
+
+def _load_serve_report():
+    spec = importlib.util.spec_from_file_location(
+        "serve_report", os.path.join(REPO, "tools", "serve_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _summary_blob(**over):
+    blob = {
+        "type": "serve_summary",
+        "coalesce_window_s": 0.002,
+        "requests": 50.0,
+        "errors": 0.0,
+        "rows": 400.0,
+        "batches": 30.0,
+        "aot_compiles": 8.0,
+        "cold_compiles": 0.0,
+        "bucket_hits": {"8": 20.0, "16": 6.0, "32": 4.0},
+        "latency": {
+            "count": 50, "p50": 0.004, "p90": 0.006, "p99": 0.009,
+            "max": 0.012,
+        },
+        "queue_delay": {
+            "count": 50, "p50": 0.001, "p90": 0.0015, "p99": 0.002,
+            "max": 0.004,
+        },
+        "batch_rows": {"count": 30, "p50": 8, "p90": 16, "p99": 32, "max": 32},
+    }
+    blob.update(over)
+    return blob
+
+
+class TestServeReport:
+    def _write(self, tmp_path, records):
+        path = tmp_path / "perf.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+        return str(path)
+
+    def test_clean_ledger_entry_renders_and_passes_strict(self, tmp_path, capsys):
+        sr = _load_serve_report()
+        path = self._write(
+            tmp_path,
+            [
+                {"bench": "smoke", "other": 1},  # no serving evidence: ignored
+                {
+                    "bench": "smoke",
+                    "timestamp": "2026-08-05T00:00:00Z",
+                    "serving": _summary_blob(),
+                    "metrics": {
+                        "serve_recompiles_after_warmup": {"value": 0}
+                    },
+                },
+            ],
+        )
+        assert sr.main([path, "--strict"]) == 0
+        out = capsys.readouterr().out
+        assert "requests/dispatch" in out
+        assert "anomaly checks: ok" in out
+        assert "bucket" in out and "share" in out
+
+    def test_cold_compile_anomaly_fails_strict(self, tmp_path, capsys):
+        sr = _load_serve_report()
+        path = self._write(
+            tmp_path, [{"serving": _summary_blob(cold_compiles=2.0)}]
+        )
+        assert sr.main([path]) == 0  # render-only stays green
+        assert sr.main([path, "--strict"]) == 2
+        assert "cold-start-compile-in-steady-state" in capsys.readouterr().out
+
+    def test_wrapper_recompile_metric_fails_strict(self, tmp_path):
+        sr = _load_serve_report()
+        path = self._write(
+            tmp_path,
+            [{
+                "serving": _summary_blob(),
+                "metrics": {"serve_recompiles_after_warmup": {"value": 1}},
+            }],
+        )
+        assert sr.main([path, "--strict"]) == 2
+
+    def test_queue_delay_and_error_anomalies(self, tmp_path, capsys):
+        sr = _load_serve_report()
+        blob = _summary_blob(
+            errors=3.0,
+            queue_delay={
+                "count": 50, "p50": 0.01, "p90": 0.02, "p99": 0.05,
+                "max": 0.06,
+            },
+        )
+        path = self._write(tmp_path, [blob])  # bare blob, no wrapper
+        assert sr.main([path, "--strict"]) == 2
+        out = capsys.readouterr().out
+        assert "serve-errors" in out
+        assert "queue-delay-above-window" in out
+
+    def test_no_evidence_is_an_error(self, tmp_path):
+        sr = _load_serve_report()
+        path = self._write(tmp_path, [{"bench": "smoke"}])
+        assert sr.main([path]) == 1

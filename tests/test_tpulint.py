@@ -192,6 +192,19 @@ class TestHostSync:
         """
         assert fire(src, R.HostSyncRule()) == []
 
+    def test_serving_module_methods_flagged_everywhere(self):
+        """serving/ holds the ops/ whole-module bar: a sync method is
+        warm-path latency even outside a traced function."""
+        src = """
+            def helper(x):
+                x.block_until_ready()
+        """
+        found = fire(
+            src, R.HostSyncRule(), "spark_rapids_ml_tpu/serving/foo.py"
+        )
+        assert len(found) == 1
+        assert "serving/" in found[0].message
+
 
 # ---------------------------------------------------------------------------
 # TPL003 recompile-hazard
@@ -246,6 +259,62 @@ class TestRecompileHazard:
             def build(fn):
                 # hand-rolled once-guard  # tpulint: disable=TPL003
                 return jax.jit(fn)
+        """
+        assert fire(src, R.RecompileHazardRule()) == []
+
+    def test_aot_lower_per_call_in_serving_fires(self):
+        src = """
+            def dispatch(prog, avals):
+                return prog.lower(avals).compile()
+        """
+        (f,) = fire(
+            src,
+            R.RecompileHazardRule(),
+            "spark_rapids_ml_tpu/serving/foo.py",
+        )
+        assert "AOT .lower()" in f.message and "per call" in f.message
+
+    def test_aot_lower_in_loop_in_serving_fires(self):
+        src = """
+            def warm(prog, ladder):
+                for avals in ladder:
+                    prog.lower(avals).compile()
+        """
+        (f,) = fire(
+            src,
+            R.RecompileHazardRule(),
+            "spark_rapids_ml_tpu/serving/foo.py",
+        )
+        assert "loop" in f.message
+
+    def test_aot_lower_in_cached_factory_clean(self):
+        src = """
+            from functools import lru_cache
+            @lru_cache(maxsize=None)
+            def compiled_for(prog, avals):
+                return prog.lower(avals).compile()
+        """
+        assert fire(
+            src,
+            R.RecompileHazardRule(),
+            "spark_rapids_ml_tpu/serving/foo.py",
+        ) == []
+
+    def test_str_lower_exempt_in_serving(self):
+        src = """
+            def norm(name):
+                return name.lower()
+        """
+        assert fire(
+            src,
+            R.RecompileHazardRule(),
+            "spark_rapids_ml_tpu/serving/foo.py",
+        ) == []
+
+    def test_aot_lower_outside_serving_not_flagged(self):
+        src = """
+            def dispatch(prog, avals):
+                return prog.lower(avals).compile()
         """
         assert fire(src, R.RecompileHazardRule()) == []
 

@@ -216,3 +216,30 @@ class TestWindowSummaryUnit:
         snap = REGISTRY.snapshot()
         delta = REGISTRY.snapshot().delta(snap)
         assert costmodel.window_summary(delta, 1.0) == {}
+
+
+class TestNestedTransformGuard:
+    def test_chained_stages_book_rows_once(self):
+        """Chained lazy plans drive transform generators re-entrantly in one
+        thread; only the outermost stage may book the volume counters, or a
+        two-stage pipeline double-counts every input row. Per-stage latency
+        stays unconditional — stage timing is real work."""
+        import pyarrow as pa
+
+        from spark_rapids_ml_tpu.spark import arrow_fns
+        from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+        class _Stage(arrow_fns._InstrumentedTransformFn):
+            def _run(self, batches):
+                yield from batches
+
+        batch = pa.RecordBatch.from_arrays(
+            [pa.array([1.0, 2.0, 3.0])], names=["x"]
+        )
+        snap = REGISTRY.snapshot()
+        out = list(_Stage()(_Stage()(iter([batch]))))
+        assert out[0].num_rows == 3
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("transform.rows") == 3  # once, not per stage
+        assert delta.counter("transform.batches") == 1
+        assert delta.hist("transform.partition_seconds").count == 2

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Render serving-runtime evidence: bucket hit rates, queue delay, compiles.
+
+Usage::
+
+    python tools/serve_report.py /path/to/perf.jsonl [--last N] [--strict]
+
+Reads JSONL (or a single JSON document) and renders every record that
+carries serving evidence — either a perf-ledger entry whose ``serving``
+key holds the blob ``bench.py --smoke`` embeds
+(``spark_rapids_ml_tpu.serving.server.serve_summary``), or a bare
+``serve_summary`` record written directly. For each:
+
+- the per-bucket hit table — which rungs of the serve ladder actually
+  absorbed traffic, and each rung's share. A healthy warm path
+  concentrates hits on a few small buckets; a flat spread means request
+  sizes straddle rungs and the ladder constants
+  (``TPU_ML_SERVE_MIN_BUCKET`` / ``TPU_ML_SERVE_MAX_BATCH_ROWS``) are
+  mis-sized for the workload.
+- micro-batcher queue-delay percentiles (p50/p90/p99/max) against the
+  configured coalescing window — p99 well above
+  ``TPU_ML_SERVE_MAX_DELAY_US`` means the batcher worker, not the window,
+  is the bottleneck.
+- request latency percentiles and the batching ratio
+  (requests per device dispatch).
+- anomaly checks:
+
+  - ``cold-start-compile-in-steady-state`` — nonzero
+    ``serve.cold_compiles``: a request landed on a bucket the registry
+    never AOT-compiled and paid a synchronous XLA compile on the serve
+    path. Registration is supposed to make the compiled-signature set
+    total (serving.registry); a cold compile in steady state means a
+    model was registered with a truncated ``bucket_list`` or the ladder
+    knobs changed after registration.
+  - ``serve-errors`` — nonzero ``serve.errors`` booked in the window.
+  - ``queue-delay-above-window`` — queue-delay p99 exceeded 5x the
+    coalescing window (when the record carries the window).
+
+Exit status: 0 normally; with ``--strict``, 2 when any anomaly fired OR
+any record had to be skipped (CI gate). Stdlib-only — renders on hosts
+without jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def _table(rows: list[list[str]], header: list[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    def line(cells):
+        return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths)).rstrip()
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([line(header), sep] + [line(r) for r in rows])
+
+
+def extract_summary(rec: dict) -> dict | None:
+    """Pull the serve_summary blob out of a record, whatever wrapper it
+    arrived in: a perf-ledger entry (``serving`` key), or the bare blob."""
+    if isinstance(rec.get("serving"), dict):
+        return rec["serving"]
+    if rec.get("type") == "serve_summary" or "bucket_hits" in rec:
+        return rec
+    return None
+
+
+def check_anomalies(summary: dict, wrapper: dict) -> list[str]:
+    out: list[str] = []
+    cold = summary.get("cold_compiles", 0) or 0
+    recompiles = _wrapper_metric(wrapper, "serve_recompiles_after_warmup")
+    if cold or (recompiles or 0) > 0:
+        n = cold or recompiles
+        out.append(
+            f"cold-start-compile-in-steady-state: {n:g} serve dispatch(es) "
+            "paid a synchronous XLA compile — a request landed on a bucket "
+            "the registry never AOT-compiled at registration (truncated "
+            "bucket_list, or the ladder knobs TPU_ML_SERVE_MIN_BUCKET/"
+            "TPU_ML_SERVE_MAX_BATCH_ROWS changed after registration)"
+        )
+    errors = summary.get("errors", 0) or 0
+    if errors:
+        out.append(
+            f"serve-errors: {errors:g} request(s) failed in the window — "
+            "see the serve.errors label sets on /metrics for the model "
+            "and status code"
+        )
+    qd = summary.get("queue_delay") or {}
+    window = summary.get("coalesce_window_s")
+    if window and qd.get("p99", 0) > 5.0 * window:
+        out.append(
+            f"queue-delay-above-window: batcher queue-delay p99 "
+            f"{_fmt_s(qd['p99'])} is >5x the {_fmt_s(window)} coalescing "
+            "window — the batcher worker (or the device dispatch it wraps) "
+            "is the bottleneck, not the window; check device contention "
+            "and TPU_ML_SERVE_MAX_BATCH_ROWS"
+        )
+    return out
+
+
+def _wrapper_metric(wrapper: dict, name: str) -> float | None:
+    m = (wrapper.get("metrics") or {}).get(name)
+    if isinstance(m, dict):
+        return m.get("value")
+    return m if isinstance(m, (int, float)) else None
+
+
+def render_record(rec: dict, out=sys.stdout) -> list[str] | None:
+    """Render one record's serving evidence; returns its anomaly list, or
+    None when the record carries no serving evidence."""
+    summary = extract_summary(rec)
+    if summary is None:
+        return None
+    tag = rec.get("bench") or rec.get("name") or "serving"
+    when = rec.get("timestamp") or rec.get("time") or ""
+    head = f"\n=== {tag} serving window"
+    if when:
+        head += f" @ {when}"
+    print(head + " ===", file=out)
+
+    requests = summary.get("requests", 0) or 0
+    batches = summary.get("batches", 0) or 0
+    line = (
+        f"traffic: {requests:g} request(s), {summary.get('rows', 0):g} "
+        f"row(s), {batches:g} device dispatch(es)"
+    )
+    if batches:
+        line += f" ({requests / batches:.2f} requests/dispatch)"
+    print(line, file=out)
+
+    hits = summary.get("bucket_hits") or {}
+    total_hits = sum(hits.values())
+    if hits:
+        def _bkey(kv):
+            return (0, int(kv[0])) if str(kv[0]).isdigit() else (1, 0)
+        rows = [
+            [b, f"{v:g}", f"{v / total_hits:.1%}" if total_hits else "-"]
+            for b, v in sorted(hits.items(), key=_bkey)
+        ]
+        print(_table(rows, ["bucket", "hits", "share"]), file=out)
+
+    lat = summary.get("latency") or {}
+    if lat.get("count"):
+        print(
+            f"request latency: {lat['count']:g} sample(s), "
+            f"p50 {_fmt_s(lat.get('p50', 0.0))} / "
+            f"p90 {_fmt_s(lat.get('p90', 0.0))} / "
+            f"p99 {_fmt_s(lat.get('p99', 0.0))}, "
+            f"max {_fmt_s(lat.get('max', 0.0))}",
+            file=out,
+        )
+    qd = summary.get("queue_delay") or {}
+    if qd.get("count"):
+        line = (
+            f"batcher queue delay: p50 {_fmt_s(qd.get('p50', 0.0))} / "
+            f"p90 {_fmt_s(qd.get('p90', 0.0))} / "
+            f"p99 {_fmt_s(qd.get('p99', 0.0))}, "
+            f"max {_fmt_s(qd.get('max', 0.0))}"
+        )
+        window = summary.get("coalesce_window_s")
+        if window:
+            line += f" (window {_fmt_s(window)})"
+        print(line, file=out)
+    comp_line = (
+        f"compiles: {summary.get('aot_compiles', 0):g} AOT at "
+        f"registration, {summary.get('cold_compiles', 0):g} cold in "
+        "steady state"
+    )
+    print(comp_line, file=out)
+
+    anomalies = check_anomalies(summary, rec)
+    for a in anomalies:
+        print(f"  !! {a}", file=out)
+    if not anomalies:
+        print("  anomaly checks: ok", file=out)
+    return anomalies
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render spark_rapids_ml_tpu serving evidence"
+    )
+    ap.add_argument(
+        "path",
+        help="perf-ledger JSONL (bench.py --smoke) or serve_summary JSON",
+    )
+    ap.add_argument(
+        "--last", type=int, default=0, metavar="N",
+        help="only render the last N serving records",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="exit 2 when any anomaly check fires or a record is skipped",
+    )
+    args = ap.parse_args(argv)
+
+    records = []
+    skipped = 0
+    try:
+        with open(args.path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            print("# skipping corrupt line", file=sys.stderr)
+            skipped += 1
+            continue
+        if isinstance(rec, dict) and extract_summary(rec) is not None:
+            records.append(rec)
+    if not records:
+        print(f"no serving evidence in {args.path}", file=sys.stderr)
+        return 1
+    if args.last > 0:
+        records = records[-args.last:]
+
+    print(f"{len(records)} serving record(s) from {args.path}")
+    any_anomaly = False
+    for i, rec in enumerate(records):
+        try:
+            anomalies = render_record(rec)
+        except Exception as e:  # noqa: BLE001 — a bad record must not
+            # hide the rest of the file
+            print(
+                f"# skipping unrenderable record {i} "
+                f"({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
+            skipped += 1
+            continue
+        if anomalies:
+            any_anomaly = True
+    if skipped:
+        print(f"# {skipped} record(s) skipped", file=sys.stderr)
+    return 2 if (args.strict and (any_anomaly or skipped)) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
