@@ -207,6 +207,11 @@ def _ledger_entry(record: dict) -> dict:
             "value": extra["value"],
             "unit": extra.get("unit", ""),
         }
+        # a declared absolute bound rides the ledger entry itself so the
+        # sentinel can enforce it regardless of history (and --bless
+        # cannot wave it through)
+        if isinstance(extra.get("ceiling"), (int, float)):
+            metrics[extra["metric"]]["ceiling"] = extra["ceiling"]
     from spark_rapids_ml_tpu.telemetry import REGISTRY, costmodel
 
     snap = REGISTRY.snapshot()
@@ -685,14 +690,23 @@ def main() -> None:
                             "metric": "serve_p50_ms",
                             "value": serving_evidence["serve_p50_ms"],
                             "unit": "ms",
-                            "note": "warm-path HTTP predict latency "
-                            "(AOT registry + micro-batcher), mixed-size "
-                            "concurrent window",
+                            "note": "warm-path predict latency (AOT "
+                            "registry + micro-batcher), mixed-size "
+                            "mixed-transport concurrent window",
                         },
                         {
                             "metric": "serve_p99_ms",
                             "value": serving_evidence["serve_p99_ms"],
                             "unit": "ms",
+                            **(
+                                {
+                                    "ceiling": serving_evidence[
+                                        "serve_p99_gate_ms"
+                                    ]
+                                }
+                                if serving_evidence.get("serve_p99_gate_ms")
+                                else {}
+                            ),
                         },
                         {
                             "metric": "serve_recompiles_after_warmup",
@@ -1002,25 +1016,35 @@ def _bench_health() -> dict:
 
 
 def _bench_serving() -> dict:
-    """Prove the warm-path serving runtime end to end in this process:
-    register a fitted PCA + linear model (AOT-compiling the serve bucket
-    ladder), warm every bucket with 2 HTTP requests, then fire 50
-    mixed-size concurrent requests across both models and assert ZERO new
-    backend compiles in the measured window — the compiled-signature set
-    must be total after warmup. Returns the evidence dict riding the bench
-    JSON line; its p50/p99 and recompile count also land on the perf
-    ledger as ``serve_p50_ms`` / ``serve_p99_ms`` /
-    ``serve_recompiles_after_warmup``. A declared ``TPU_ML_SLO``
+    """Prove the serving fast path end to end in this process: register a
+    fitted PCA + linear model (AOT-compiling the serve bucket ladder),
+    warm every bucket and every transport, then fire 52 mixed-size
+    concurrent requests spread across the four transport/wire combinations
+    (HTTP+JSON, HTTP+binary f32, UDS+JSON, UDS+binary) plus the in-process
+    client — with a streamed Gram fit looping on the same device for the
+    whole measured window — and assert ZERO new backend compiles: the
+    compiled-signature set must be total after warmup, fit contention
+    included. Returns the evidence dict riding the bench JSON line; its
+    p50/p99 and recompile count also land on the perf ledger as
+    ``serve_p50_ms`` / ``serve_p99_ms`` / ``serve_recompiles_after_warmup``
+    (with ``TPU_ML_SERVE_P99_GATE_MS`` set, serve_p99_ms carries that
+    absolute ceiling for tools/perf_sentinel.py). A declared ``TPU_ML_SLO``
     serve.latency objective is evaluated over the measured window and a
     breach is fatal (the --strict serving gate)."""
     import json as _json
+    import socket
+    import tempfile
+    import threading
     import urllib.request
     from concurrent.futures import ThreadPoolExecutor
 
     from spark_rapids_ml_tpu import PCA
     from spark_rapids_ml_tpu.models.linear import LinearRegression
+    from spark_rapids_ml_tpu.ops import linalg as L
+    from spark_rapids_ml_tpu.serving import client as serve_client
     from spark_rapids_ml_tpu.serving import registry as serve_registry
     from spark_rapids_ml_tpu.serving import server as serve_server
+    from spark_rapids_ml_tpu.spark import ingest
     from spark_rapids_ml_tpu.telemetry import slo as slo_mod
     from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 
@@ -1036,7 +1060,13 @@ def _bench_serving() -> dict:
     reg = serve_registry.get_registry()
     reg.register(models[0], pca, bucket_list=serve_buckets)
     reg.register(models[1], lin, bucket_list=serve_buckets)
-    server = serve_server.start_serving(0, with_monitor=False)
+    uds_path = os.path.join(
+        tempfile.gettempdir(), f"tpu-ml-serve-bench-{os.getpid()}.sock"
+    )
+    server = serve_server.start_serving(
+        0, with_monitor=False, uds_path=uds_path
+    )
+    _uds_local = threading.local()
     try:
         url = server.url
 
@@ -1048,15 +1078,102 @@ def _bench_serving() -> dict:
             with urllib.request.urlopen(req, timeout=30) as r:
                 return _json.load(r)
 
-        # 2-request warmup per (model, bucket): the bucket ladder is
-        # already AOT-compiled at registration, so this warms the dispatch
-        # path (executable lookup, batcher, HTTP) rather than XLA
+        def post_binary(model: str, rows: np.ndarray) -> np.ndarray:
+            x32 = np.ascontiguousarray(rows, dtype="<f4")
+            req = urllib.request.Request(
+                f"{url}/v1/models/{model}:predict",
+                data=x32.tobytes(),
+                headers={
+                    "Content-Type": serve_server.BINARY_CONTENT_TYPE,
+                    serve_server.SHAPE_HEADER: (
+                        f"{x32.shape[0]},{x32.shape[1]}"
+                    ),
+                    "Accept": serve_server.BINARY_CONTENT_TYPE,
+                },
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return np.frombuffer(r.read(), dtype="<f4")
+
+        def uds_call(model: str, rows: np.ndarray, wire: str) -> dict:
+            conn = getattr(_uds_local, "conn", None)
+            if conn is None:
+                s = socket.socket(socket.AF_UNIX)
+                s.connect(uds_path)
+                conn = (s, s.makefile("rb"), s.makefile("wb"))
+                _uds_local.conn = conn
+            _, rf, wf = conn
+            if wire == "binary":
+                x32 = np.ascontiguousarray(rows, dtype="<f4")
+                header = {
+                    "model": model, "wire": "binary", "accept": "binary",
+                    "shape": list(x32.shape), "payload_bytes": x32.nbytes,
+                }
+                payload = x32.tobytes()
+            else:
+                header = {
+                    "model": model, "wire": "json",
+                    "instances": rows.tolist(),
+                }
+                payload = b""
+            raw = _json.dumps(header).encode()
+            wf.write(len(raw).to_bytes(4, "big") + raw + payload)
+            wf.flush()
+            resp = _json.loads(rf.read(int.from_bytes(rf.read(4), "big")))
+            if resp.get("payload_bytes"):
+                rf.read(int(resp["payload_bytes"]))
+            if not resp.get("ok"):
+                raise RuntimeError(
+                    f"uds predict failed: {resp.get('error')}"
+                )
+            return resp
+
+        transports = (
+            lambda m, r: post(m, r),
+            lambda m, r: post_binary(m, r),
+            lambda m, r: uds_call(m, r, "json"),
+            lambda m, r: uds_call(m, r, "binary"),
+        )
+
+        # 2-request warmup per (model, bucket) over HTTP+JSON — the bucket
+        # ladder is already AOT-compiled at registration, so this warms the
+        # dispatch path (executable lookup, batcher, HTTP) rather than XLA
+        # — plus one pass per transport and the in-process client
         warmup = 0
         for model in models:
             for b in serve_buckets:
                 for _ in range(2):
                     post(model, xs[:b])
                     warmup += 1
+            for call in transports[1:]:
+                call(model, xs[:8])
+                warmup += 1
+            serve_client.predict(model, xs[:8])
+            warmup += 1
+
+        # the concurrent streamed fit contending for the same device during
+        # the measured window (warmed first: its compile must not land in
+        # the recompile budget)
+        fit_chunk = rng.normal(size=(SF_CHUNK, SF_N)).astype(
+            ingest.wire_dtype()
+        )
+
+        def one_fit():
+            return ingest.stream_fold(
+                (fit_chunk for _ in range(2)),
+                L.gram_fold_step(),
+                n=SF_N,
+                init=L.init_gram_carry(SF_N, ingest.wire_dtype()),
+                chunk_rows=SF_CHUNK,
+            )
+
+        one_fit()
+        fit_stop = threading.Event()
+        fit_rounds = [0]
+
+        def fit_loop():
+            while not fit_stop.is_set():
+                one_fit()
+                fit_rounds[0] += 1
 
         # declared serve.latency objectives (TPU_ML_SLO) get their own
         # engine seeded at the start of the measured window, burn=1: any
@@ -1074,12 +1191,28 @@ def _bench_serving() -> dict:
         )
 
         snap_warm = REGISTRY.snapshot()
+        fit_thread = threading.Thread(target=fit_loop, daemon=True)
+        fit_thread.start()
         sizes = (1, 2, 3, 5, 8, 12, 17, 30, 40, 100)
+        # mixed traffic: every 13th request rides the in-process client,
+        # the rest cycle through HTTP+JSON / HTTP+binary / UDS+JSON /
+        # UDS+binary — all five combinations land in the measured window
         reqs = [
-            (models[i % 2], xs[: sizes[i % len(sizes)]]) for i in range(50)
+            (
+                (lambda m, r: serve_client.predict(m, r))
+                if i % 13 == 12
+                else transports[i % len(transports)],
+                models[i % 2],
+                xs[: sizes[i % len(sizes)]],
+            )
+            for i in range(52)
         ]
-        with ThreadPoolExecutor(max_workers=8) as pool:
-            list(pool.map(lambda mr: post(*mr), reqs))
+        try:
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                list(pool.map(lambda cmr: cmr[0](cmr[1], cmr[2]), reqs))
+        finally:
+            fit_stop.set()
+            fit_thread.join(timeout=60)
         window = REGISTRY.snapshot().delta(snap_warm)
 
         # the zero-recompile contract: compile.seconds counts every backend
@@ -1110,16 +1243,24 @@ def _bench_serving() -> dict:
                     "time(s) during the serving smoke window"
                 )
 
+        gate_raw = os.environ.get(knobs.SERVE_P99_GATE_MS.name, "").strip()
         evidence = serve_server.serve_summary(window)
         evidence.pop("type", None)
         evidence.update(
             port=server.port,
+            uds_path=uds_path,
             models=list(models),
             buckets=list(serve_buckets),
             warmup_requests=warmup,
             measured_requests=len(reqs),
+            concurrent_streamed_fit={
+                "rounds": fit_rounds[0],
+                "chunk_rows": SF_CHUNK,
+                "n": SF_N,
+            },
             serve_p50_ms=round(lat.percentile(50) * 1e3, 3),
             serve_p99_ms=round(lat.percentile(99) * 1e3, 3),
+            serve_p99_gate_ms=float(gate_raw) if gate_raw else None,
             serve_recompiles_after_warmup=recompiles,
             slo={
                 "declared": bool(slo_objectives),

@@ -16,8 +16,15 @@ of the model lifecycle — low-latency scoring of already-fitted models:
   the same ``(model, bucket)`` coalesce into one device dispatch inside a
   ``TPU_ML_SERVE_MAX_DELAY_US`` window.
 - :mod:`.server` — ``/v1/models`` + ``/v1/models/<name>:predict`` HTTP
-  front-end grafted onto the telemetry exporter, so ``serve.latency``
-  lands in the same registry the SLO engine and ``/metrics`` read.
+  front-end (JSON and the zero-copy ``application/x-tpu-ml-f32`` binary
+  wire format) grafted onto the telemetry exporter, so ``serve.latency``
+  lands in the same registry the SLO engine and ``/metrics`` read — plus
+  the framing-free ``TPU_ML_SERVE_UDS_PATH`` Unix-socket listener.
+- :mod:`.client` — the in-process transport: ``predict`` straight into the
+  shared micro-batcher, zero framing, same telemetry.
+- :mod:`.hbm` — the multi-model HBM fleet manager: resident param byte
+  accounting against the live watermark, LRU weight paging
+  (``serve.page_in``/``serve.page_out``), SLO-burn load shedding.
 
 Submodules are loaded lazily: ``buckets`` is importable without jax, and
 tooling that only wants the ladder math never pays the model-layer import.
@@ -27,7 +34,7 @@ from __future__ import annotations
 
 import importlib
 
-_SUBMODULES = ("buckets", "registry", "batcher", "server")
+_SUBMODULES = ("buckets", "registry", "batcher", "server", "client", "hbm")
 
 _LAZY_ATTRS = {
     # buckets
@@ -40,14 +47,23 @@ _LAZY_ATTRS = {
     "servable_from_model": "registry",
     "get_registry": "registry",
     "reset_for_tests": "registry",
+    "validate_request": "registry",
     # batcher
     "MicroBatcher": "batcher",
     "ServeFuture": "batcher",
     # server
     "ServingHTTPServer": "server",
+    "ServeUDSListener": "server",
     "start_serving": "server",
     "stop_serving": "server",
     "get_serving_server": "server",
+    # client
+    "ServeClient": "client",
+    "get_client": "client",
+    # hbm
+    "HbmFleetManager": "hbm",
+    "ServeShed": "hbm",
+    "get_fleet": "hbm",
 }
 
 __all__ = list(_SUBMODULES) + sorted(_LAZY_ATTRS)

@@ -3,22 +3,41 @@
 Single-row scoring at high concurrency wastes the device: each request pays
 its own dispatch + transfer for a matmul that is ~free at bucket width. The
 micro-batcher holds a bounded queue per ``(model, bucket)`` key; the first
-request of a group opens a coalescing window of ``TPU_ML_SERVE_MAX_DELAY_US``
-(default 2 ms), and everything that arrives for the same key inside the
-window rides the same dispatch — the prepared request blocks are stacked,
-padded to the combined bucket, run through the registry's AOT-compiled
-executable once, and the output rows are unpacked back to their per-request
-futures. The combined row count is capped at the model's largest AOT-warm
-bucket (itself bounded by ``TPU_ML_SERVE_MAX_BATCH_ROWS``, the ladder cap),
-so the coalesced dispatch always lands on a precompiled signature —
-coalescing can never cause a compile, even for a model registered with a
-truncated ``bucket_list``.
+request of a group opens a coalescing window, and everything that arrives
+for the same key before the batch leaves rides the same dispatch — the
+prepared request blocks are stacked, padded to the combined bucket, run
+through the registry's AOT-compiled executable once, and the output rows
+are unpacked back to their per-request futures. The combined row count is
+capped at the model's largest AOT-warm bucket (itself bounded by
+``TPU_ML_SERVE_MAX_BATCH_ROWS``, the ladder cap), so the coalesced dispatch
+always lands on a precompiled signature — coalescing can never cause a
+compile, even for a model registered with a truncated ``bucket_list``.
 
-The latency budget is explicit: worst-case added latency is the window, and
-every request's actual queue time is booked on the
+Batching is *continuous*, not windowed-only:
+
+- A full bucket leaves immediately; the window is a ceiling, not a tax.
+- A late request joins the already-forming dispatch right up to the moment
+  the padded block is built, riding the in-flight pad slack of the chosen
+  bucket for free (``serve.joined_in_flight`` counts riders that did not
+  open the window).
+- The window itself is adaptive (``TPU_ML_SERVE_ADAPTIVE_WINDOW``): it
+  tracks an EWMA of the model's observed device dispatch time, so drain
+  latency ~= device time under load instead of the fixed
+  ``TPU_ML_SERVE_MAX_DELAY_US`` ceiling. Every dispatch books the window
+  it actually used on ``serve.window_effective_seconds``.
+
+The latency budget is explicit: worst-case added latency is the window
+ceiling, and every request's actual queue time is booked on the
 ``serve.queue_delay_seconds`` histogram (tools/serve_report.py renders the
 percentiles). A request alone in its window costs only the window; the
 window only ever *saves* wall clock once two requests share a dispatch.
+
+Ingest is dtype-preserving: float32 payloads (the binary wire format) stay
+float32 end to end — no ``float64`` host round-trip — and float64 payloads
+(JSON) are converted to the device dtype exactly once, after ``prepare``,
+with the same rounding ``jnp.asarray`` applied before. Accepted input
+dtypes are ``ACCEPTED_DTYPES``; anything else is refused at submission
+with an error that documents them.
 """
 
 from __future__ import annotations
@@ -30,23 +49,50 @@ import time
 
 import numpy as np
 
-from spark_rapids_ml_tpu.serving import buckets
-from spark_rapids_ml_tpu.serving.registry import ModelRegistry, get_registry
+from spark_rapids_ml_tpu.serving import buckets, hbm
+from spark_rapids_ml_tpu.serving.registry import (
+    ACCEPTED_DTYPES,
+    ModelRegistry,
+    get_registry,
+    validate_request,
+)
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 from spark_rapids_ml_tpu.utils import knobs
 
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
 
 SERVE_MAX_DELAY_US_VAR = knobs.SERVE_MAX_DELAY_US.name
+SERVE_ADAPTIVE_WINDOW_VAR = knobs.SERVE_ADAPTIVE_WINDOW.name
+
+__all__ = [
+    "ACCEPTED_DTYPES",
+    "MicroBatcher",
+    "ServeFuture",
+    "adaptive_window_enabled",
+    "coalesce_window_s",
+    "validate_request",
+]
+
+#: Floor of the adaptive window: below this, shrinking further only buys
+#: scheduler churn, not latency.
+_WINDOW_FLOOR_S = 25e-6
 
 
 def coalesce_window_s() -> float:
+    """The coalescing-window CEILING (``TPU_ML_SERVE_MAX_DELAY_US``)."""
     raw = os.environ.get(SERVE_MAX_DELAY_US_VAR, "")
     try:
         us = float(raw) if raw else float(knobs.SERVE_MAX_DELAY_US.default)
     except ValueError:
         us = float(knobs.SERVE_MAX_DELAY_US.default)
     return max(0.0, us) / 1e6
+
+
+def adaptive_window_enabled() -> bool:
+    raw = os.environ.get(
+        SERVE_ADAPTIVE_WINDOW_VAR, knobs.SERVE_ADAPTIVE_WINDOW.default
+    ).strip().lower()
+    return raw not in ("0", "false", "off", "")
 
 
 class ServeFuture:
@@ -85,20 +131,25 @@ class _Pending:
 
 
 class MicroBatcher:
-    """Bounded coalescing queue in front of the model registry."""
+    """Bounded continuous-batching queue in front of the model registry."""
 
     def __init__(
         self,
         registry: ModelRegistry | None = None,
         *,
         max_delay_s: float | None = None,
+        adaptive: bool | None = None,
     ):
         self.registry = registry if registry is not None else get_registry()
         self.max_delay_s = (
             max_delay_s if max_delay_s is not None else coalesce_window_s()
         )
+        self.adaptive = (
+            adaptive if adaptive is not None else adaptive_window_enabled()
+        )
         self._groups: dict[tuple[str, int], list[_Pending]] = {}
         self._cond = threading.Condition()
+        self._device_ewma: dict[str, float] = {}
         self._thread: threading.Thread | None = None
         self._stopping = False
 
@@ -131,17 +182,20 @@ class MicroBatcher:
     def submit(self, model: str, x) -> ServeFuture:
         """Queue one request; returns its future. ``prepare`` runs on the
         caller thread (host preprocessing parallelizes across requests);
-        the device dispatch happens on the batcher worker."""
+        the device dispatch happens on the batcher worker. Input stays in
+        the caller's dtype (see ``ACCEPTED_DTYPES``) — float32 payloads
+        never round-trip through float64."""
         entry = self.registry.get(model)
-        mat = np.asarray(x, dtype=np.float64)
-        if mat.ndim == 1:
-            mat = mat[None, :]
-        if mat.ndim != 2 or mat.shape[1] != entry.n_features:
-            raise ValueError(
-                f"expected [rows, {entry.n_features}] input for {model!r}, "
-                f"got shape {mat.shape}"
-            )
+        hbm.get_fleet().check_admission(model)
+        mat = validate_request(x, entry.n_features, model)
         prepared = entry.prepare(mat)
+        # one conversion to the device dtype, up front: the queued blocks
+        # are uniform, so the coalesced concat + pad never copies again and
+        # the dispatch-side jnp.asarray is a no-op. Converting here applies
+        # the exact rounding jnp.asarray applied at dispatch before, so
+        # results are bitwise-unchanged.
+        if prepared.dtype != entry.x_dtype:
+            prepared = prepared.astype(entry.x_dtype)
         bucket = buckets.serve_bucket(prepared.shape[0])  # admission check
         pending = _Pending(prepared)
         with self._cond:
@@ -167,6 +221,18 @@ class MicroBatcher:
             return cap
         return min(cap, max(warm)) if warm else cap
 
+    def effective_window_s(self, model: str) -> float:
+        """The coalescing window in force for a model right now: the
+        configured ceiling, or — adaptive mode — the EWMA of the model's
+        device dispatch time clamped to [floor, ceiling], so a loaded
+        batcher drains at device speed instead of idling out the ceiling."""
+        if not self.adaptive:
+            return self.max_delay_s
+        ewma = self._device_ewma.get(model)
+        if ewma is None:
+            return self.max_delay_s
+        return min(self.max_delay_s, max(_WINDOW_FLOOR_S, ewma))
+
     def _loop(self) -> None:
         while True:
             batch = None
@@ -176,10 +242,11 @@ class MicroBatcher:
                 if self._stopping:
                     return
                 now = time.perf_counter()
-                key, deadline = min(
+                key, deadline, window = min(
                     (
-                        (k, g[0].t_submit + self.max_delay_s)
+                        (k, g[0].t_submit + w, w)
                         for k, g in self._groups.items()
+                        for w in (self.effective_window_s(k[0]),)
                     ),
                     key=lambda kv: kv[1],
                 )
@@ -187,6 +254,9 @@ class MicroBatcher:
                 group = self._groups[key]
                 full = sum(p.rows for p in group) >= cap
                 if now < deadline and not full:
+                    # a full bucket leaves immediately (the submit-side
+                    # notify wakes this wait); otherwise hold the group
+                    # open until its window elapses
                     self._cond.wait(deadline - now)
                     continue
                 # take requests up to the ladder cap; the remainder opens
@@ -202,17 +272,50 @@ class MicroBatcher:
                     taken.append(group.pop(0))
                 if not group:
                     del self._groups[key]
-                batch = (key[0], taken)
+                batch = (key, taken, window)
             if batch is not None:
                 self._dispatch(*batch)
 
-    def _dispatch(self, model: str, taken: list[_Pending]) -> None:
+    def _late_join(
+        self, key: tuple[str, int], taken: list[_Pending], bucket: int
+    ) -> int:
+        """Continuous batching: pull requests that arrived after this batch
+        was taken into it, as long as they fit the already-chosen bucket's
+        pad slack — they ride the in-flight dispatch for free instead of
+        opening (and waiting out) a fresh window."""
+        total = sum(p.rows for p in taken)
+        joined = 0
+        with self._cond:
+            group = self._groups.get(key)
+            while group and total + group[0].rows <= bucket:
+                p = group.pop(0)
+                taken.append(p)
+                total += p.rows
+                joined += 1
+            if group is not None and not group:
+                del self._groups[key]
+        return joined
+
+    def _dispatch(
+        self, key: tuple[str, int], taken: list[_Pending], window_s: float
+    ) -> None:
+        model = key[0]
         t0 = time.perf_counter()
         try:
             entry = self.registry.get(model)
+            bucket = buckets.serve_bucket(sum(p.rows for p in taken))
+            self._late_join(key, taken, bucket)
             for p in taken:
                 REGISTRY.histogram_record(
                     "serve.queue_delay_seconds", t0 - p.t_submit, model=model
+                )
+            REGISTRY.histogram_record(
+                "serve.window_effective_seconds", window_s, model=model
+            )
+            riders = len(taken) - 1
+            if riders > 0:
+                REGISTRY.counter_inc(
+                    "serve.joined_in_flight", riders, model=model
                 )
             total = sum(p.rows for p in taken)
             combined = (
@@ -220,12 +323,17 @@ class MicroBatcher:
                 if len(taken) == 1
                 else np.concatenate([p.mat for p in taken], axis=0)
             )
-            bucket = buckets.serve_bucket(total)
             REGISTRY.counter_inc(
                 "serve.bucket_hits", model=model, bucket=bucket
             )
             padded, _ = buckets.pad_to_bucket(combined, bucket)
+            t_dev = time.perf_counter()
             raw = self.registry.dispatch_padded(entry, padded, bucket)
+            dev_s = time.perf_counter() - t_dev
+            prev = self._device_ewma.get(model)
+            self._device_ewma[model] = (
+                dev_s if prev is None else 0.5 * prev + 0.5 * dev_s
+            )
             REGISTRY.counter_inc("serve.batches", model=model)
             REGISTRY.histogram_record("serve.batch_rows", total, model=model)
             REGISTRY.counter_inc("serve.rows", total, model=model)

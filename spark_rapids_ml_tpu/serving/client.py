@@ -1,0 +1,124 @@
+"""In-process serve transport: co-located callers skip framing entirely.
+
+HTTP (and even UDS) framing is pure overhead for a caller living in the
+serving process — a refresh daemon scoring shadow traffic, a bench loop, a
+notebook next to the registry. ``ServeClient`` is the zero-framing path:
+``predict`` submits straight to the SAME micro-batcher the HTTP/UDS
+front-ends use, so in-process requests coalesce into the same device
+dispatches as network traffic and land on the same ``serve.*`` telemetry
+(``serve.requests``/``serve.latency``/``serve.transport{transport=inproc}``
+— the SLO engine sees one traffic stream, not three).
+
+When the process-wide serve front-end (``serving.server.start_serving``)
+is running, the client binds to its batcher; otherwise it lazily starts a
+private batcher over the model registry, so library users get micro-batched
+in-process serving without ever opening a port.
+
+Error contract mirrors the HTTP layer's status mapping (the ``code`` label
+on ``serve.requests``/``serve.errors`` stays comparable across
+transports): unknown model 404, bad payload 400, ladder-cap overflow 413,
+SLO shed 503, dispatch failure 500 — but the original exception is
+re-raised, not wrapped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
+from spark_rapids_ml_tpu.serving.registry import ModelRegistry, get_registry
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+
+class ServeClient:
+    """Zero-framing in-process predict path over the shared micro-batcher."""
+
+    def __init__(
+        self,
+        batcher: MicroBatcher | None = None,
+        *,
+        registry: ModelRegistry | None = None,
+    ):
+        self._registry = registry
+        self._explicit = batcher
+        self._own: MicroBatcher | None = None
+        self._lock = threading.Lock()
+
+    def _batcher(self) -> MicroBatcher:
+        if self._explicit is not None:
+            return self._explicit
+        from spark_rapids_ml_tpu.serving import server as server_mod
+
+        srv = server_mod.get_serving_server()
+        if srv is not None:
+            return srv.batcher
+        with self._lock:
+            if self._own is None:
+                self._own = MicroBatcher(
+                    self._registry
+                    if self._registry is not None
+                    else get_registry()
+                ).start()
+            return self._own
+
+    def predict(self, model: str, x, timeout: float = 30.0) -> np.ndarray:
+        """Score one request through the shared batcher; blocks for the
+        coalesced dispatch and returns the finalized host array."""
+        from spark_rapids_ml_tpu.serving.server import status_for_error
+
+        t0 = time.perf_counter()
+        try:
+            out = self._batcher().submit(model, x).result(timeout)
+        except BaseException as e:
+            code = status_for_error(e)
+            REGISTRY.counter_inc("serve.errors", model=model, code=code)
+            REGISTRY.counter_inc("serve.requests", model=model, code=code)
+            raise
+        latency = time.perf_counter() - t0
+        REGISTRY.counter_inc("serve.requests", model=model, code=200)
+        REGISTRY.counter_inc(
+            "serve.transport", transport="inproc", wire="array"
+        )
+        REGISTRY.histogram_record("serve.latency", latency, model=model)
+        return out
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the private batcher, if one was started. The shared
+        front-end batcher is never stopped from here."""
+        with self._lock:
+            own, self._own = self._own, None
+        if own is not None:
+            own.stop(timeout)
+
+
+# -- module singleton --------------------------------------------------------
+
+_CLIENT_LOCK = threading.Lock()
+_CLIENT: ServeClient | None = None
+
+
+def get_client() -> ServeClient:
+    """The process-wide in-process client (binds to the running serve
+    front-end's batcher when one exists)."""
+    global _CLIENT
+    with _CLIENT_LOCK:
+        if _CLIENT is None:
+            _CLIENT = ServeClient()
+        return _CLIENT
+
+
+def predict(model: str, x, timeout: float = 30.0) -> np.ndarray:
+    """Convenience: ``get_client().predict(...)``."""
+    return get_client().predict(model, x, timeout)
+
+
+def reset_client() -> None:
+    """Drop (and stop) the singleton client (tests only)."""
+    global _CLIENT
+    with _CLIENT_LOCK:
+        client, _CLIENT = _CLIENT, None
+    if client is not None:
+        client.close()

@@ -1,0 +1,294 @@
+"""Multi-model HBM fleet manager: resident param accounting, LRU paging,
+and SLO-burn load shedding.
+
+A registry that AOT-compiles every model it is handed implicitly promises
+the device can hold every model's params forever. That promise breaks the
+moment a fleet of models shares one chip: HBM is the scarce resource, and
+the PR 8 health monitor already watches it (``bytes_in_use/bytes_limit``
+against ``TPU_ML_HEALTH_HBM_WATERMARK``). This module makes the serving
+side live within that watermark:
+
+- **Byte accounting.** Every registered servable's param pytree is
+  measured (``param_bytes``) and booked against the fleet budget —
+  ``TPU_ML_SERVE_HBM_BUDGET_BYTES`` when set (the synthetic budget tests
+  use), else the live device ``bytes_limit`` scaled by the health
+  monitor's HBM watermark. The resident total is published as the
+  ``serve.hbm_bytes`` gauge.
+
+- **LRU weight paging.** When admitting a model would overflow the
+  budget, the least-recently-used resident models are paged out — their
+  params copied to host numpy and the device buffers dropped
+  (``serve.page_out``). A request for a paged-out model repages it on
+  demand (``serve.page_in``) before dispatch, evicting colder models to
+  make room. Paging never touches the compiled executables: the AOT cache
+  is keyed by shape/dtype, so a repaged model re-serves warm, and
+  repaged predictions are bitwise-identical (asserted in tests).
+
+- **Load shedding.** ``check_admission`` consults the PR 9 admission
+  policy (``TPU_ML_ADMISSION_POLICY``) against the live monitor's SLO
+  burn: while declared objectives are breaching, newly observed breaches
+  shed incoming requests (``serve.shed``, surfaced as HTTP 503) instead
+  of letting them pile onto a latency cliff. ``off`` disables shedding;
+  ``degrade`` admits but still counts.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any
+
+import numpy as np
+
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils import knobs
+
+logger = logging.getLogger("spark_rapids_ml_tpu.serving")
+
+SERVE_HBM_BUDGET_BYTES_VAR = knobs.SERVE_HBM_BUDGET_BYTES.name
+HEALTH_HBM_WATERMARK_VAR = knobs.HEALTH_HBM_WATERMARK.name
+
+
+class ServeShed(RuntimeError):
+    """A serve request was shed by the admission policy while the SLO
+    engine reports active burn (HTTP 503 at the transport layer)."""
+
+
+def param_bytes(params: Any) -> int:
+    """Total bytes of a param pytree's array leaves."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(params)
+    return int(sum(a.size * a.dtype.itemsize for a in leaves))
+
+
+def budget_bytes() -> int | None:
+    """The fleet's resident-param byte budget: the synthetic
+    ``TPU_ML_SERVE_HBM_BUDGET_BYTES`` override when set, else the live
+    device ``bytes_limit`` scaled by ``TPU_ML_HEALTH_HBM_WATERMARK``.
+    ``None`` (no accounting) when neither is known — e.g. CPU backends,
+    which expose no memory stats."""
+    raw = os.environ.get(SERVE_HBM_BUDGET_BYTES_VAR, "").strip()
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            logger.warning(
+                "ignoring non-integer %s=%r", SERVE_HBM_BUDGET_BYTES_VAR, raw
+            )
+    try:
+        from spark_rapids_ml_tpu.telemetry import compilemon
+
+        stats = compilemon.sample_device_memory()
+    except Exception:  # noqa: BLE001 - no stats means no budget, not a crash
+        return None
+    limits = [
+        s.get("bytes_limit", 0) for s in stats.values() if s.get("bytes_limit")
+    ]
+    if not limits:
+        return None
+    try:
+        watermark = float(
+            os.environ.get(HEALTH_HBM_WATERMARK_VAR, "") or 0.92
+        )
+    except ValueError:
+        watermark = 0.92
+    return int(max(limits) * watermark)
+
+
+class _Resident:
+    __slots__ = ("entry", "nbytes", "resident", "seq")
+
+    def __init__(self, entry: Any, nbytes: int, seq: int):
+        self.entry = entry
+        self.nbytes = nbytes
+        self.resident = True
+        self.seq = seq
+
+
+class HbmFleetManager:
+    """Tracks every registered servable's param bytes against the HBM
+    budget, pages cold models to host, and sheds load on SLO burn."""
+
+    def __init__(self, budget: int | None = None):
+        self._explicit_budget = budget
+        self._lock = threading.RLock()
+        self._models: dict[str, _Resident] = {}
+        self._seq = 0
+        self._last_breaches = 0
+
+    # -- accounting ---------------------------------------------------------
+
+    def budget(self) -> int | None:
+        if self._explicit_budget is not None:
+            return self._explicit_budget
+        return budget_bytes()
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(r.nbytes for r in self._models.values() if r.resident)
+
+    def account(self, entry: Any) -> None:
+        """Admit a (re-)registered servable: measure its params, mark it
+        most-recently-used, and page colder models out until the fleet
+        fits the budget again."""
+        with self._lock:
+            self._seq += 1
+            self._models[entry.name] = _Resident(
+                entry, param_bytes(entry.params), self._seq
+            )
+            self._evict_to_fit(protect=entry.name)
+            self._publish()
+
+    def forget(self, name: str) -> None:
+        with self._lock:
+            self._models.pop(name, None)
+            self._publish()
+
+    def _publish(self) -> None:
+        REGISTRY.gauge_set(
+            "serve.hbm_bytes",
+            sum(r.nbytes for r in self._models.values() if r.resident),
+        )
+
+    # -- paging -------------------------------------------------------------
+
+    def ensure_resident(self, entry: Any) -> None:
+        """Dispatch-path hook: touch the model's LRU clock and repage its
+        params onto the device if a colder model's pressure evicted them."""
+        with self._lock:
+            rec = self._models.get(entry.name)
+            if rec is None:
+                return
+            self._seq += 1
+            rec.seq = self._seq
+            if rec.resident:
+                return
+            self._page_in(rec)
+            self._evict_to_fit(protect=entry.name)
+            self._publish()
+
+    def _page_in(self, rec: _Resident) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        rec.entry.params = jax.tree_util.tree_map(
+            jnp.asarray, rec.entry.params
+        )
+        rec.resident = True
+        REGISTRY.counter_inc("serve.page_in", model=rec.entry.name)
+        logger.info(
+            "paged in servable %s (%d bytes)", rec.entry.name, rec.nbytes
+        )
+
+    def _page_out(self, rec: _Resident) -> None:
+        import jax
+
+        rec.entry.params = jax.tree_util.tree_map(
+            np.asarray, rec.entry.params
+        )
+        rec.resident = False
+        REGISTRY.counter_inc("serve.page_out", model=rec.entry.name)
+        logger.info(
+            "paged out servable %s (%d bytes)", rec.entry.name, rec.nbytes
+        )
+
+    def _evict_to_fit(self, protect: str) -> None:
+        """Page out least-recently-used residents (never ``protect``) until
+        the resident total fits the budget. With no budget (CPU, no
+        override) everything stays resident."""
+        budget = self.budget()
+        if budget is None:
+            return
+        used = sum(r.nbytes for r in self._models.values() if r.resident)
+        victims = sorted(
+            (
+                r for r in self._models.values()
+                if r.resident and r.entry.name != protect
+            ),
+            key=lambda r: r.seq,
+        )
+        for rec in victims:
+            if used <= budget:
+                break
+            self._page_out(rec)
+            used -= rec.nbytes
+        if used > budget:
+            logger.warning(
+                "HBM fleet over budget even after paging: %d > %d bytes "
+                "(the active model alone exceeds the budget)", used, budget
+            )
+
+    # -- load shedding ------------------------------------------------------
+
+    def check_admission(self, model: str) -> None:
+        """Shed one incoming request per newly observed SLO breach while
+        the declared objectives are burning, per the PR 9 admission policy:
+        ``refuse`` raises :class:`ServeShed` (HTTP 503), ``degrade`` admits
+        but books the shed counter, ``off`` disables the check."""
+        from spark_rapids_ml_tpu.telemetry import health
+
+        try:
+            policy = health.admission_policy()
+        except ValueError:
+            policy = "refuse"
+        if policy == "off":
+            return
+        monitor = health.get_monitor()
+        if monitor is None:
+            return
+        breaches = int(monitor.slo.total_breaches())
+        with self._lock:
+            burned = breaches - self._last_breaches
+            self._last_breaches = breaches
+        if burned <= 0:
+            return
+        REGISTRY.counter_inc("serve.shed", model=model, policy=policy)
+        if policy == "refuse":
+            raise ServeShed(
+                f"request for {model!r} shed: serve SLO burning "
+                f"({burned} new breach(es)) and "
+                f"TPU_ML_ADMISSION_POLICY=refuse"
+            )
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "budget_bytes": self.budget(),
+                "resident_bytes": sum(
+                    r.nbytes for r in self._models.values() if r.resident
+                ),
+                "models": {
+                    name: {
+                        "bytes": r.nbytes,
+                        "resident": r.resident,
+                        "lru_seq": r.seq,
+                    }
+                    for name, r in sorted(self._models.items())
+                },
+            }
+
+
+# -- module singleton --------------------------------------------------------
+
+_FLEET_LOCK = threading.Lock()
+_FLEET: HbmFleetManager | None = None
+
+
+def get_fleet() -> HbmFleetManager:
+    """The process-wide fleet manager the registry and batcher consult."""
+    global _FLEET
+    with _FLEET_LOCK:
+        if _FLEET is None:
+            _FLEET = HbmFleetManager()
+        return _FLEET
+
+
+def reset_fleet() -> None:
+    """Drop the singleton (tests only)."""
+    global _FLEET
+    with _FLEET_LOCK:
+        _FLEET = None

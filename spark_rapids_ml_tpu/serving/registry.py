@@ -48,7 +48,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from spark_rapids_ml_tpu.serving import buckets
+from spark_rapids_ml_tpu.serving import buckets, hbm
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 from spark_rapids_ml_tpu.utils import knobs
 
@@ -57,6 +57,38 @@ logger = logging.getLogger("spark_rapids_ml_tpu.serving")
 SERVE_COMPILE_CACHE_DIR_VAR = knobs.SERVE_COMPILE_CACHE_DIR.name
 
 FAMILIES = ("pca", "linear", "scaler", "forest")
+
+#: Input dtypes a serve request may carry. Integer/bool payloads (JSON
+#: numbers decode to them) are widened to float64 first; float16/bfloat16/
+#: complex/object payloads are refused — silently widening them would
+#: reintroduce the hidden float64 host copy the fast path removed.
+ACCEPTED_DTYPES = ("float32", "float64")
+
+
+def validate_request(x: Any, n_features: int, model: str) -> np.ndarray:
+    """Dtype-preserving request validation: returns a ``[rows, n]`` float32
+    or float64 matrix without ever forcing a float64 host copy. Raises
+    ``ValueError`` (the transport layers' 400) for anything else, naming
+    the accepted dtypes."""
+    mat = np.asarray(x)
+    if mat.dtype.kind in ("i", "u", "b"):
+        # JSON integers and bools are exact in f64; widening them is the
+        # eager path's behavior too
+        mat = mat.astype(np.float64)
+    if mat.dtype.name not in ACCEPTED_DTYPES:
+        raise ValueError(
+            f"unsupported input dtype {mat.dtype.name!r} for {model!r} — "
+            f"accepted dtypes: {', '.join(ACCEPTED_DTYPES)} (and integers, "
+            "widened to float64)"
+        )
+    if mat.ndim == 1:
+        mat = mat[None, :]
+    if mat.ndim != 2 or mat.shape[1] != n_features:
+        raise ValueError(
+            f"expected [rows, {n_features}] input for {model!r}, "
+            f"got shape {mat.shape}"
+        )
+    return mat
 
 
 # -- compile cache ----------------------------------------------------------
@@ -443,6 +475,9 @@ class ModelRegistry:
         with self._lock:
             self._entries[name] = entry
             REGISTRY.gauge_set("serve.models", len(self._entries))
+        # book the params against the HBM fleet budget; registering past it
+        # pages the least-recently-used cold models to host
+        hbm.get_fleet().account(entry)
         logger.info(
             "registered servable %s (%s, n=%d, policy=%s, %d buckets)",
             name, entry.family, entry.n_features, entry.policy, len(ladder),
@@ -484,6 +519,10 @@ class ModelRegistry:
         tools/serve_report.py flags."""
         import jax.numpy as jnp
 
+        # repage the model's params if fleet pressure evicted them to host
+        # (touches its LRU clock either way); the compiled executable is
+        # shape-keyed and survives paging untouched
+        hbm.get_fleet().ensure_resident(entry)
         cold = bucket not in entry.warm_buckets
         compiled = _compiled_for(entry.token, bucket)
         if cold:
@@ -499,15 +538,12 @@ class ModelRegistry:
         finalize. The micro-batcher uses the same pieces but coalesces
         several requests into one dispatch."""
         entry = self.get(name)
-        mat = np.asarray(x, dtype=np.float64)
-        if mat.ndim == 1:
-            mat = mat[None, :]
-        if mat.ndim != 2 or mat.shape[1] != entry.n_features:
-            raise ValueError(
-                f"expected [rows, {entry.n_features}] input for {name!r}, "
-                f"got shape {mat.shape}"
-            )
+        mat = validate_request(x, entry.n_features, name)
         prepared = entry.prepare(mat)
+        if prepared.dtype != entry.x_dtype:
+            # the one conversion to the device dtype (the rounding
+            # jnp.asarray applied at dispatch before — bitwise-unchanged)
+            prepared = prepared.astype(entry.x_dtype)
         bucket = buckets.serve_bucket(prepared.shape[0])
         REGISTRY.counter_inc("serve.bucket_hits", model=name, bucket=bucket)
         padded, true_rows = buckets.pad_to_bucket(prepared, bucket)
@@ -538,6 +574,7 @@ def reset_for_tests() -> None:
     with _TOKEN_LOCK:
         _ENTRIES_BY_TOKEN.clear()
     _compiled_for.cache_clear()
+    hbm.reset_fleet()
     with _CACHE_LOCK:
         _CACHE_READY = False
         _CACHE_DIR = None

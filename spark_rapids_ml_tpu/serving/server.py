@@ -1,4 +1,4 @@
-"""Serve front-end: /v1/models and /v1/models/<name>:predict over loopback.
+"""Serve front-ends: HTTP (JSON + binary) and a framing-free UDS listener.
 
 Extends the telemetry HTTP exporter (``telemetry/httpd.py``) rather than
 growing a second server: the handler subclasses the exporter's, so one port
@@ -12,41 +12,118 @@ Endpoints:
 
 - ``GET  /v1/models`` — registered servables (name, family, feature count,
   precision policy, warm buckets).
-- ``POST /v1/models/<name>:predict`` — body ``{"instances": [[...], ...]}``
-  (one row per instance); responds ``{"predictions": [...], "rows": N,
-  "latency_ms": ...}``. Requests ride the micro-batcher, so concurrent
-  callers of the same (model, bucket) share one device dispatch.
+- ``POST /v1/models/<name>:predict`` — JSON body ``{"instances": [[...],
+  ...]}`` (one row per instance), or the zero-copy binary wire format:
+  ``Content-Type: application/x-tpu-ml-f32`` with an ``X-Shape:
+  rows,features`` header and a row-major little-endian float32 body. The
+  binary payload is viewed in place (``np.frombuffer``) and stays float32
+  end to end — no JSON decode, no float64 round-trip; its first copy is
+  directly into the padded staging block the device reads. Responses
+  stream back as binary (f32 body + ``X-Shape``) when the request sends
+  ``Accept: application/x-tpu-ml-f32``. Requests ride the micro-batcher,
+  so concurrent callers of the same (model, bucket) share one device
+  dispatch.
+
+Co-located callers can skip HTTP framing entirely: ``TPU_ML_SERVE_UDS_PATH``
+starts a Unix-domain-socket listener speaking a minimal length-prefixed
+protocol (one 4-byte big-endian header length, a JSON header, then an
+optional raw f32 payload — see ``_uds_handle_one``), sharing the same
+batcher and booking the same ``serve.*`` telemetry with
+``serve.transport{transport=uds}``. Fully in-process callers use
+``serving.client`` instead.
 
 Every request books ``serve.requests``/``serve.rows`` counters and a
 ``serve.latency`` histogram sample labeled by model; failures book
 ``serve.errors``. Oversized requests are refused with HTTP 413 at admission
-(the bucket ladder cap), malformed bodies with 400, unknown models 404.
+(the bucket ladder cap), malformed bodies with 400 (the error body names
+the accepted dtypes), unknown models 404, and SLO-burn load shedding
+(serving/hbm.py) with 503.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
+import socketserver
 import threading
 import time
 
 import numpy as np
 
-from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
-from spark_rapids_ml_tpu.serving.registry import ModelRegistry, get_registry
+from spark_rapids_ml_tpu.serving import hbm
+from spark_rapids_ml_tpu.serving.batcher import (
+    MicroBatcher,
+    adaptive_window_enabled,
+    coalesce_window_s,
+)
+from spark_rapids_ml_tpu.serving.registry import (
+    ACCEPTED_DTYPES,
+    ModelRegistry,
+    get_registry,
+)
 from spark_rapids_ml_tpu.telemetry import httpd
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils import knobs
 
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
 
 PREDICT_SUFFIX = ":predict"
+
+#: The zero-copy wire format: row-major little-endian float32.
+BINARY_CONTENT_TYPE = "application/x-tpu-ml-f32"
+SHAPE_HEADER = "X-Shape"
+
+SERVE_UDS_PATH_VAR = knobs.SERVE_UDS_PATH.name
+
+
+def status_for_error(err: BaseException) -> int:
+    """The HTTP status code an exception maps to — shared by every
+    transport so the ``code`` labels on ``serve.requests``/``serve.errors``
+    stay comparable across HTTP, UDS and in-process callers."""
+    if isinstance(err, KeyError):
+        return 404
+    if isinstance(err, hbm.ServeShed):
+        return 503
+    if isinstance(err, ValueError):
+        return 413 if "ladder cap" in str(err) else 400
+    return 500
+
+
+def parse_binary_payload(body: bytes, shape_header: str) -> np.ndarray:
+    """View a binary f32 request body as a ``[rows, features]`` matrix —
+    ``np.frombuffer`` keeps it zero-copy; the only copy the request ever
+    pays is into the padded staging block the device reads."""
+    dims = [d.strip() for d in (shape_header or "").split(",") if d.strip()]
+    if len(dims) != 2 or not all(d.lstrip("-").isdigit() for d in dims):
+        raise ValueError(
+            f"binary payload needs {SHAPE_HEADER}: rows,features "
+            f"(got {shape_header!r})"
+        )
+    rows, cols = int(dims[0]), int(dims[1])
+    if rows <= 0 or cols <= 0:
+        raise ValueError(f"{SHAPE_HEADER} dims must be positive, got "
+                         f"{rows},{cols}")
+    expected = rows * cols * 4
+    if len(body) != expected:
+        raise ValueError(
+            f"binary payload is {len(body)} byte(s), expected {expected} "
+            f"for {rows}x{cols} float32"
+        )
+    return np.frombuffer(body, dtype="<f4").reshape(rows, cols)
+
+
+def binary_response_bytes(out: np.ndarray) -> tuple[bytes, str]:
+    """(body, shape-header) of a prediction streamed back as f32."""
+    arr = np.ascontiguousarray(np.asarray(out), dtype="<f4")
+    return arr.tobytes(), ",".join(str(d) for d in arr.shape)
 
 
 class ServeHandler(httpd._Handler):
     """The exporter handler plus the model-serving API. GET falls through
     to the exporter for everything under its routes."""
 
-    server_version = "tpu-ml-serve/1.0"
+    server_version = "tpu-ml-serve/1.1"
 
     @property
     def _registry(self) -> ModelRegistry:
@@ -75,25 +152,32 @@ class ServeHandler(httpd._Handler):
         name = path[len("/v1/models/"):-len(PREDICT_SUFFIX)]
         t0 = time.perf_counter()
         try:
-            instances = self._read_instances()
+            instances, wire = self._read_payload(name)
             future = self._batcher.submit(name, instances)
             out = future.result(timeout=30.0)
-        except KeyError as e:
-            self._serve_error(name, 404, str(e))
-            return
-        except ValueError as e:
-            code = 413 if "ladder cap" in str(e) else 400
-            self._serve_error(name, code, str(e))
-            return
         except Exception as e:  # noqa: BLE001 - predict must answer, not die
-            logger.exception("predict failed for model %s", name)
-            self._serve_error(name, 500, f"{type(e).__name__}: {e}")
+            code = status_for_error(e)
+            if code == 500:
+                logger.exception("predict failed for model %s", name)
+            self._serve_error(name, code, f"{type(e).__name__}: {e}"
+                              if code == 500 else str(e))
             return
         latency = time.perf_counter() - t0
         # serve.rows is booked once per dispatch by the batcher; here we
         # book the request-level series the SLO engine watches.
         REGISTRY.counter_inc("serve.requests", model=name, code=200)
+        REGISTRY.counter_inc("serve.transport", transport="http", wire=wire)
         REGISTRY.histogram_record("serve.latency", latency, model=name)
+        if BINARY_CONTENT_TYPE in (self.headers.get("Accept") or ""):
+            body, shape = binary_response_bytes(out)
+            self._respond(
+                200, body, BINARY_CONTENT_TYPE,
+                extra_headers={
+                    SHAPE_HEADER: shape,
+                    "X-Latency-Ms": f"{latency * 1e3:.3f}",
+                },
+            )
+            return
         self._json(
             200,
             {
@@ -105,12 +189,40 @@ class ServeHandler(httpd._Handler):
             },
         )
 
-    def _read_instances(self):
+    def _respond(self, code, body, content_type, extra_headers=None):
+        # the exporter's _respond predates per-response headers; add them
+        # here for the binary wire format's shape/latency trailers
+        if not extra_headers:
+            super()._respond(code, body, content_type)
+            return
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_payload(self, model: str):
+        """Decode one predict request body: returns ``(instances, wire)``
+        where instances is a JSON-decoded list or a zero-copy f32 matrix
+        and wire is ``"json"`` | ``"binary"``."""
         length = int(self.headers.get("Content-Length") or 0)
         if length <= 0:
-            raise ValueError("empty request body — expected JSON instances")
+            raise ValueError(
+                "empty request body — expected JSON instances or a "
+                f"{BINARY_CONTENT_TYPE} payload (accepted dtypes: "
+                f"{', '.join(ACCEPTED_DTYPES)})"
+            )
+        body = self.rfile.read(length)
+        ctype = (self.headers.get("Content-Type") or "").split(";", 1)[0]
+        if ctype.strip().lower() == BINARY_CONTENT_TYPE:
+            return (
+                parse_binary_payload(body, self.headers.get(SHAPE_HEADER)),
+                "binary",
+            )
         try:
-            payload = json.loads(self.rfile.read(length))
+            payload = json.loads(body)
         except json.JSONDecodeError as e:
             raise ValueError(f"request body is not valid JSON: {e}") from e
         instances = (
@@ -118,7 +230,7 @@ class ServeHandler(httpd._Handler):
         )
         if instances is None:
             raise ValueError('missing "instances" in request body')
-        return instances
+        return instances, "json"
 
     def _serve_error(self, model: str, code: int, detail: str) -> None:
         REGISTRY.counter_inc("serve.errors", model=model, code=code)
@@ -126,9 +238,159 @@ class ServeHandler(httpd._Handler):
         self._json(code, {"error": detail, "model": model})
 
 
+# -- UDS listener ------------------------------------------------------------
+#
+# Wire protocol (both directions): a 4-byte big-endian header length, then a
+# JSON header, then an optional raw payload the header describes. Request
+# header: {"model", "wire": "json"|"binary", "accept": "json"|"binary",
+# "instances": [...]} for json wire, or {"shape": [rows, features],
+# "payload_bytes": N} for binary wire followed by N raw f32 bytes. Response
+# header: {"ok", "code", "model", "rows", "latency_ms", "wire"} plus either
+# "predictions" inline (json) or {"shape", "payload_bytes"} followed by the
+# raw f32 body. One connection may carry any number of requests.
+
+
+def _read_exact(rfile, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = rfile.read(n)
+        if not chunk:
+            raise EOFError("peer closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _uds_send(wfile, header: dict, payload: bytes = b"") -> None:
+    raw = json.dumps(header).encode()
+    wfile.write(len(raw).to_bytes(4, "big") + raw + payload)
+    wfile.flush()
+
+
+def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
+    """Serve one framed request; returns False on clean EOF."""
+    try:
+        head = rfile.read(4)
+    except OSError:
+        return False
+    if not head:
+        return False
+    if len(head) < 4:
+        raise EOFError("peer closed mid-frame")
+    header = json.loads(_read_exact(rfile, int.from_bytes(head, "big")))
+    model = str(header.get("model", ""))
+    wire = str(header.get("wire", "json"))
+    accept = str(header.get("accept", wire))
+    t0 = time.perf_counter()
+    try:
+        if wire == "binary":
+            shape = header.get("shape") or []
+            payload = _read_exact(rfile, int(header.get("payload_bytes", 0)))
+            instances = parse_binary_payload(
+                payload, ",".join(str(d) for d in shape)
+            )
+        else:
+            instances = header.get("instances")
+            if instances is None:
+                raise ValueError(
+                    'missing "instances" in request header (accepted '
+                    f"dtypes: {', '.join(ACCEPTED_DTYPES)})"
+                )
+        out = batcher.submit(model, instances).result(timeout=30.0)
+    except Exception as e:  # noqa: BLE001 - answer the frame, keep the conn
+        code = status_for_error(e)
+        if code == 500:
+            logger.exception("uds predict failed for model %s", model)
+        REGISTRY.counter_inc("serve.errors", model=model, code=code)
+        REGISTRY.counter_inc("serve.requests", model=model, code=code)
+        _uds_send(
+            wfile,
+            {"ok": False, "code": code, "model": model, "error": str(e)},
+        )
+        return True
+    latency = time.perf_counter() - t0
+    REGISTRY.counter_inc("serve.requests", model=model, code=200)
+    REGISTRY.counter_inc("serve.transport", transport="uds", wire=wire)
+    REGISTRY.histogram_record("serve.latency", latency, model=model)
+    base = {
+        "ok": True,
+        "code": 200,
+        "model": model,
+        "rows": int(np.shape(out)[0]),
+        "latency_ms": round(latency * 1e3, 3),
+    }
+    if accept == "binary":
+        body, shape = binary_response_bytes(out)
+        base.update(
+            wire="binary",
+            shape=[int(d) for d in shape.split(",")],
+            payload_bytes=len(body),
+        )
+        _uds_send(wfile, base, body)
+    else:
+        base.update(
+            wire="json",
+            predictions=np.asarray(out).tolist(),  # tpulint: disable=TPL002
+        )
+        _uds_send(wfile, base)
+    return True
+
+
+class _UDSHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        try:
+            while _uds_handle_one(
+                self.rfile, self.wfile, self.server.batcher
+            ):
+                pass
+        except (EOFError, BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception:  # noqa: BLE001 - one bad conn must not log-spam
+            logger.exception("uds connection failed")
+
+
+class ServeUDSListener:
+    """Unix-domain-socket front-end sharing the HTTP server's batcher."""
+
+    def __init__(self, path: str, batcher: MicroBatcher):
+        self.path = path
+        if os.path.exists(path):
+            os.unlink(path)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._server = socketserver.ThreadingUnixStreamServer(
+            path, _UDSHandler
+        )
+        self._server.daemon_threads = True
+        self._server.batcher = batcher
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ServeUDSListener":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                kwargs={"poll_interval": 0.05},
+                name="tpu-ml-serve-uds",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
 class ServingHTTPServer(httpd.HealthHTTPServer):
-    """The exporter server with the serve handler, a model registry, and a
-    running micro-batcher attached."""
+    """The exporter server with the serve handler, a model registry, a
+    running micro-batcher, and (``TPU_ML_SERVE_UDS_PATH``) a UDS listener
+    attached."""
 
     def __init__(
         self,
@@ -136,6 +398,7 @@ class ServingHTTPServer(httpd.HealthHTTPServer):
         *,
         registry: ModelRegistry | None = None,
         batcher: MicroBatcher | None = None,
+        uds_path: str | None = None,
     ):
         from http.server import ThreadingHTTPServer
 
@@ -150,6 +413,12 @@ class ServingHTTPServer(httpd.HealthHTTPServer):
             if batcher is not None
             else MicroBatcher(self._httpd.model_registry)
         )
+        self.uds_path = (
+            uds_path
+            if uds_path is not None
+            else os.environ.get(SERVE_UDS_PATH_VAR, "")
+        )
+        self._uds: ServeUDSListener | None = None
 
     @property
     def registry(self) -> ModelRegistry:
@@ -159,12 +428,21 @@ class ServingHTTPServer(httpd.HealthHTTPServer):
     def batcher(self) -> MicroBatcher:
         return self._httpd.batcher
 
+    @property
+    def uds(self) -> ServeUDSListener | None:
+        return self._uds
+
     def start(self) -> "ServingHTTPServer":
         self.batcher.start()
         super().start()
+        if self.uds_path and self._uds is None:
+            self._uds = ServeUDSListener(self.uds_path, self.batcher).start()
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
+        if self._uds is not None:
+            self._uds.stop(timeout)
+            self._uds = None
         super().stop(timeout)
         self.batcher.stop(timeout)
 
@@ -172,28 +450,45 @@ class ServingHTTPServer(httpd.HealthHTTPServer):
 def serve_summary(snap) -> dict:
     """JSON-safe summary of the serving activity inside one snapshot window
     (pass ``REGISTRY.snapshot().delta(prev)``): request/batch/compile
-    counters, per-bucket hit counts, and the latency + queue-delay
+    counters, per-bucket hit counts, the transport mix, HBM paging
+    activity, the adaptive-window trace, and the latency + queue-delay
     histogram digests. This is the evidence blob ``bench.py --smoke`` rides
     on the perf ledger and ``tools/serve_report.py`` renders."""
     bucket_hits: dict[str, float] = {}
+    transport_mix: dict[str, float] = {}
     for (n, lbl), v in snap.counters.items():
         if n == "serve.bucket_hits":
             b = str(dict(lbl).get("bucket", "?"))
             bucket_hits[b] = bucket_hits.get(b, 0) + v
-    from spark_rapids_ml_tpu.serving.batcher import coalesce_window_s
-
+        elif n == "serve.transport":
+            d = dict(lbl)
+            k = f"{d.get('transport', '?')}/{d.get('wire', '?')}"
+            transport_mix[k] = transport_mix.get(k, 0) + v
+    hbm_bytes = [
+        v for (n, _), v in snap.gauges.items() if n == "serve.hbm_bytes"
+    ]
     return {
         "type": "serve_summary",
         "coalesce_window_s": coalesce_window_s(),
+        "adaptive_window": adaptive_window_enabled(),
         "requests": snap.counter("serve.requests"),
         "errors": snap.counter("serve.errors"),
         "rows": snap.counter("serve.rows"),
         "batches": snap.counter("serve.batches"),
         "aot_compiles": snap.counter("serve.aot_compiles"),
         "cold_compiles": snap.counter("serve.cold_compiles"),
+        "joined_in_flight": snap.counter("serve.joined_in_flight"),
+        "shed": snap.counter("serve.shed"),
+        "page_in": snap.counter("serve.page_in"),
+        "page_out": snap.counter("serve.page_out"),
+        "hbm_bytes": max(hbm_bytes) if hbm_bytes else 0,
+        "transport_mix": transport_mix,
         "bucket_hits": bucket_hits,
         "latency": snap.hist("serve.latency").to_dict(),
         "queue_delay": snap.hist("serve.queue_delay_seconds").to_dict(),
+        "window_effective": snap.hist(
+            "serve.window_effective_seconds"
+        ).to_dict(),
         "batch_rows": snap.hist("serve.batch_rows").to_dict(),
     }
 
@@ -209,14 +504,19 @@ def start_serving(
     *,
     registry: ModelRegistry | None = None,
     with_monitor: bool = True,
+    uds_path: str | None = None,
 ) -> ServingHTTPServer:
     """Start (or return) the process-wide serve front-end. The health
     monitor rides along by default so declared SLOs (``TPU_ML_SLO``) are
-    evaluated live against the ``serve.latency`` series."""
+    evaluated live against the ``serve.latency`` series; a UDS listener
+    rides along when ``uds_path`` (or ``TPU_ML_SERVE_UDS_PATH``) names a
+    socket."""
     global _SERVER
     with _LOCK:
         if _SERVER is None:
-            _SERVER = ServingHTTPServer(port, registry=registry).start()
+            _SERVER = ServingHTTPServer(
+                port, registry=registry, uds_path=uds_path
+            ).start()
         server = _SERVER
     if with_monitor:
         from spark_rapids_ml_tpu.telemetry import health as health_mod

@@ -89,6 +89,14 @@ METRICS: frozenset[str] = frozenset({
     "serve.models",
     "serve.aot_compiles",
     "serve.cold_compiles",
+    # serving fast path (transports, continuous batching, HBM fleet)
+    "serve.transport",
+    "serve.joined_in_flight",
+    "serve.window_effective_seconds",
+    "serve.page_in",
+    "serve.page_out",
+    "serve.hbm_bytes",
+    "serve.shed",
     # serve path
     "transform.rows",
     "transform.bytes",

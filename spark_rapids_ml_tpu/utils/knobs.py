@@ -186,9 +186,26 @@ _DECLARATIONS = (
          "largest serve row bucket; caps one micro-batched dispatch and "
          "bounds the AOT-compiled signature ladder", "serving.buckets"),
     Knob("TPU_ML_SERVE_MAX_DELAY_US", "float", "2000",
-         "micro-batcher coalescing window: a queued request waits at most "
-         "this long for same-(model,bucket) company before dispatch",
+         "micro-batcher coalescing window CEILING: a queued request waits "
+         "at most this long for same-(model,bucket) company before dispatch "
+         "(the adaptive window shrinks below it under load)",
          "serving.batcher"),
+    Knob("TPU_ML_SERVE_ADAPTIVE_WINDOW", "flag", "1",
+         "`1`: the coalescing window tracks the observed device dispatch "
+         "time (drain latency ~= device time); `0`: fixed "
+         "TPU_ML_SERVE_MAX_DELAY_US window", "serving.batcher"),
+    Knob("TPU_ML_SERVE_UDS_PATH", "path", "",
+         "Unix-domain-socket path for the framing-free serve listener "
+         "(empty = UDS transport off; co-located callers skip HTTP "
+         "entirely)", "serving.server"),
+    Knob("TPU_ML_SERVE_HBM_BUDGET_BYTES", "int", "",
+         "byte budget of the HBM fleet manager for resident model params "
+         "(unset = live device bytes_limit x TPU_ML_HEALTH_HBM_WATERMARK; "
+         "cold models page to host beyond it)", "serving.hbm"),
+    Knob("TPU_ML_SERVE_P99_GATE_MS", "float", "",
+         "absolute serve_p99_ms ceiling bench stamps on the ledger entry "
+         "for tools/perf_sentinel.py to enforce (unset = relative history "
+         "gating only)", "bench.py"),
     # -- transport monitor / health daemon (tools/healthd.py) ---------------
     Knob("TPU_ML_MONITOR_BENCH_OUT", "path", "BENCH_OPPORTUNISTIC_r05.json",
          "opportunistic bench output file (relative to the repo)",
@@ -301,6 +318,10 @@ SERVE_COMPILE_CACHE_DIR = KNOBS["TPU_ML_SERVE_COMPILE_CACHE_DIR"]
 SERVE_MIN_BUCKET = KNOBS["TPU_ML_SERVE_MIN_BUCKET"]
 SERVE_MAX_BATCH_ROWS = KNOBS["TPU_ML_SERVE_MAX_BATCH_ROWS"]
 SERVE_MAX_DELAY_US = KNOBS["TPU_ML_SERVE_MAX_DELAY_US"]
+SERVE_ADAPTIVE_WINDOW = KNOBS["TPU_ML_SERVE_ADAPTIVE_WINDOW"]
+SERVE_UDS_PATH = KNOBS["TPU_ML_SERVE_UDS_PATH"]
+SERVE_HBM_BUDGET_BYTES = KNOBS["TPU_ML_SERVE_HBM_BUDGET_BYTES"]
+SERVE_P99_GATE_MS = KNOBS["TPU_ML_SERVE_P99_GATE_MS"]
 MONITOR_BENCH_OUT = KNOBS["TPU_ML_MONITOR_BENCH_OUT"]
 MONITOR_DRIFT_OUT = KNOBS["TPU_ML_MONITOR_DRIFT_OUT"]
 MONITOR_INTERVAL_S = KNOBS["TPU_ML_MONITOR_INTERVAL_S"]

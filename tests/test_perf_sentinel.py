@@ -159,3 +159,80 @@ class TestCli:
         p.write_text("\n".join(lines) + "\n")
         assert len(sentinel.load_ledger(str(p))) == 3
         assert sentinel.main([str(p), "--strict"]) == 0
+
+
+class TestVanishedMetrics:
+    def test_metric_present_in_all_history_must_not_vanish(self):
+        history = [entry(serve_p99_ms=(4.0, "ms")) for _ in range(4)]
+        regs, _ = sentinel.compare(entry(), history, 0.35)
+        assert [r["metric"] for r in regs] == ["serve_p99_ms"]
+        assert regs[0]["vanished"] is True
+
+    def test_metric_absent_from_some_history_may_vanish(self):
+        # a metric that was never in EVERY comparable entry (e.g. gated
+        # behind an opt-in stage) is not a gated series
+        history = [entry(serve_p99_ms=(4.0, "ms")), entry(), entry()]
+        regs, _ = sentinel.compare(entry(), history, 0.35)
+        assert regs == []
+
+    def test_vanished_metric_gates_strict(self, tmp_path, capsys):
+        entries = [entry(serve_p99_ms=(4.0, "ms")) for _ in range(4)]
+        entries.append(entry())
+        p = write_ledger(tmp_path / "l.jsonl", entries)
+        assert sentinel.main([p, "--strict"]) == 2
+        out = capsys.readouterr().out
+        assert "REGRESSION serve_p99_ms" in out
+        assert "missing from the current entry" in out
+
+
+class TestAbsoluteCeilings:
+    def ceiled(self, value, ceiling, unit="ms"):
+        e = entry()
+        e["metrics"]["serve_p99_ms"] = {
+            "value": value, "unit": unit, "ceiling": ceiling,
+        }
+        return e
+
+    def test_crossed_ceiling_is_a_regression_despite_flat_history(self):
+        # history sits at the same value, so the ratio gate would pass —
+        # the declared absolute bound still fails it
+        history = [self.ceiled(6.0, 5.0) for _ in range(4)]
+        regs, _ = sentinel.compare(self.ceiled(6.0, 5.0), history, 0.35)
+        assert [r["metric"] for r in regs] == ["serve_p99_ms"]
+        assert regs[0]["ceiling"] is True
+        assert regs[0]["baseline_median"] == 5.0
+
+    def test_within_ceiling_passes(self):
+        history = [self.ceiled(4.0, 5.0) for _ in range(4)]
+        regs, _ = sentinel.compare(self.ceiled(4.9, 5.0), history, 0.35)
+        assert regs == []
+
+    def test_higher_is_better_units_read_ceiling_as_floor(self):
+        e = entry()
+        e["metrics"]["fit.throughput"] = {
+            "value": 90.0, "unit": "rows/s", "ceiling": 100.0,
+        }
+        regs, _ = sentinel.compare(e, [entry()], 0.35)
+        assert [r["metric"] for r in regs] == ["fit.throughput"]
+        assert regs[0]["ceiling"] is True
+
+    def test_ceiling_gates_even_a_fresh_ledger(self, tmp_path, capsys):
+        # the bound rides the entry itself, so neither an empty history
+        # nor --bless waves it through
+        p = write_ledger(tmp_path / "l.jsonl", [self.ceiled(9.0, 5.0)])
+        assert sentinel.main([p, "--strict"]) == 2
+        assert "absolute ceiling" in capsys.readouterr().out
+        assert sentinel.main([p, "--bless"]) == 0
+        assert sentinel.main([p, "--strict"]) == 2  # still over after bless
+
+    def test_serve_p99_history_regression_and_bless_workflow(
+        self, tmp_path
+    ):
+        # the serving gate end to end: ms unit derives lower-is-better, a
+        # p99 jump fails --strict, blessing accepts the new baseline
+        entries = [entry(serve_p99_ms=(4.0, "ms")) for _ in range(5)]
+        entries.append(entry(serve_p99_ms=(9.0, "ms")))
+        p = write_ledger(tmp_path / "l.jsonl", entries)
+        assert sentinel.main([p, "--strict"]) == 2
+        assert sentinel.main([p, "--bless"]) == 0
+        assert sentinel.main([p, "--strict"]) == 0

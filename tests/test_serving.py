@@ -17,8 +17,10 @@ from __future__ import annotations
 import importlib.util
 import json
 import os
+import socket
 import subprocess
 import sys
+import types
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
@@ -27,6 +29,8 @@ import numpy as np
 import pytest
 
 from spark_rapids_ml_tpu.serving import buckets
+from spark_rapids_ml_tpu.serving import client as client_mod
+from spark_rapids_ml_tpu.serving import hbm as hbm_mod
 from spark_rapids_ml_tpu.serving import registry as registry_mod
 from spark_rapids_ml_tpu.serving import server as server_mod
 from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
@@ -40,6 +44,7 @@ BUCKETS = (8, 16, 32, 64)
 @pytest.fixture(autouse=True)
 def serve_clean():
     yield
+    client_mod.reset_client()
     server_mod.stop_serving(stop_monitor=False)
     registry_mod.reset_for_tests()
 
@@ -582,3 +587,618 @@ class TestServeReport:
         sr = _load_serve_report()
         path = self._write(tmp_path, [{"bench": "smoke"}])
         assert sr.main([path]) == 1
+
+
+# -- zero-copy ingest: dtype preservation + binary wire ----------------------
+
+
+class TestZeroCopyIngest:
+    def test_validate_request_preserves_dtype(self):
+        f32 = registry_mod.validate_request(
+            np.ones((2, 6), dtype=np.float32), 6, "m"
+        )
+        assert f32.dtype == np.float32
+        f64 = registry_mod.validate_request(np.ones((2, 6)), 6, "m")
+        assert f64.dtype == np.float64
+        # JSON integers/bools widen to exact float64, like the eager path
+        ints = registry_mod.validate_request(
+            np.ones((2, 6), dtype=np.int64), 6, "m"
+        )
+        assert ints.dtype == np.float64
+
+    def test_unsupported_dtype_names_accepted_set(self):
+        with pytest.raises(ValueError) as ei:
+            registry_mod.validate_request(
+                np.ones((2, 6), dtype=np.float16), 6, "m"
+            )
+        msg = str(ei.value)
+        assert "float16" in msg
+        assert "float32" in msg and "float64" in msg
+
+    def test_float32_never_round_trips_through_float64(self, fitted_models):
+        """The batcher queues the request block in the device dtype: a f32
+        payload must reach the staging block as f32, not as a f64 copy."""
+        x, _, lin = fitted_models
+        reg = registry_mod.get_registry()
+        entry = reg.register("lin32", lin, bucket_list=(8,))
+        x32 = np.asarray(x[:3], dtype=np.float32)
+        prepared = entry.prepare(
+            registry_mod.validate_request(x32, entry.n_features, "lin32")
+        )
+        assert prepared.dtype == np.float32
+
+    def test_binary_http_round_trip_bitwise(self, fitted_models):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        srv = server_mod.start_serving(0, with_monitor=False)
+        x32 = np.ascontiguousarray(x[:5], dtype="<f4")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/models/p:predict",
+            data=x32.tobytes(),
+            headers={
+                "Content-Type": server_mod.BINARY_CONTENT_TYPE,
+                server_mod.SHAPE_HEADER: "5,6",
+                "Accept": server_mod.BINARY_CONTENT_TYPE,
+            },
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == server_mod.BINARY_CONTENT_TYPE
+            shape = tuple(
+                int(d) for d in r.headers[server_mod.SHAPE_HEADER].split(",")
+            )
+            got = np.frombuffer(r.read(), dtype="<f4").reshape(shape)
+        expected = np.asarray(
+            reg.predict("p", x32), dtype="<f4"
+        )
+        assert np.array_equal(got, expected)
+
+    def test_binary_request_json_response(self, fitted_models):
+        """No binary Accept header: a binary request still answers JSON."""
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        srv = server_mod.start_serving(0, with_monitor=False)
+        x32 = np.ascontiguousarray(x[:2], dtype="<f4")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/models/p:predict",
+            data=x32.tobytes(),
+            headers={
+                "Content-Type": server_mod.BINARY_CONTENT_TYPE,
+                server_mod.SHAPE_HEADER: "2,6",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["rows"] == 2
+        expected = reg.predict("p", x32)
+        assert np.allclose(body["predictions"], expected)
+
+    def test_binary_payload_validation_is_400(self, fitted_models):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        srv = server_mod.start_serving(0, with_monitor=False)
+
+        def binary_post(data, shape_header):
+            headers = {"Content-Type": server_mod.BINARY_CONTENT_TYPE}
+            if shape_header is not None:
+                headers[server_mod.SHAPE_HEADER] = shape_header
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/models/p:predict",
+                data=data,
+                headers=headers,
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        x32 = np.ones((2, 6), dtype="<f4")
+        # byte length does not match the declared shape
+        code, body = binary_post(x32.tobytes()[:-4], "2,6")
+        assert code == 400 and "expected" in body["error"]
+        # missing shape header
+        code, body = binary_post(x32.tobytes(), None)
+        assert code == 400 and server_mod.SHAPE_HEADER in body["error"]
+
+    def test_dtype_error_body_names_accepted_dtypes(self, fitted_models):
+        x, pca, _ = fitted_models
+        registry_mod.get_registry().register("p", pca, bucket_list=(8,))
+        srv = server_mod.start_serving(0, with_monitor=False)
+        code, body = _post(
+            srv.port,
+            "/v1/models/p:predict",
+            {"instances": [["not", "a", "number", "x", "y", "z"]]},
+        )
+        assert code == 400
+        assert "accepted dtypes" in body["error"]
+        assert "float32" in body["error"] and "float64" in body["error"]
+
+
+# -- UDS transport -----------------------------------------------------------
+
+
+def _uds_read_exact(rf, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = rf.read(n)
+        assert chunk, "peer closed mid-frame"
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _uds_exchange(sock, header: dict, payload: bytes = b""):
+    raw = json.dumps(header).encode()
+    sock.sendall(len(raw).to_bytes(4, "big") + raw + payload)
+    rf = sock.makefile("rb")
+    n = int.from_bytes(_uds_read_exact(rf, 4), "big")
+    resp = json.loads(_uds_read_exact(rf, n))
+    body = (
+        _uds_read_exact(rf, int(resp["payload_bytes"]))
+        if resp.get("payload_bytes")
+        else b""
+    )
+    return resp, body
+
+
+class TestUDSTransport:
+    def _serve(self, tmp_path, fitted_models):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        path = str(tmp_path / "serve.sock")
+        server_mod.start_serving(0, with_monitor=False, uds_path=path)
+        return x, reg, path
+
+    def test_json_round_trip(self, tmp_path, fitted_models):
+        x, reg, path = self._serve(tmp_path, fitted_models)
+        snap = REGISTRY.snapshot()
+        with socket.socket(socket.AF_UNIX) as s:
+            s.connect(path)
+            resp, _ = _uds_exchange(
+                s, {"model": "p", "wire": "json", "instances": x[:3].tolist()}
+            )
+        assert resp["ok"] and resp["code"] == 200 and resp["rows"] == 3
+        expected = reg.predict("p", x[:3])
+        assert np.array_equal(np.asarray(resp["predictions"]), expected)
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter(
+            "serve.transport", transport="uds", wire="json"
+        ) == 1
+        assert delta.hist("serve.latency").count == 1
+
+    def test_binary_round_trip_bitwise(self, tmp_path, fitted_models):
+        x, reg, path = self._serve(tmp_path, fitted_models)
+        x32 = np.ascontiguousarray(x[:4], dtype="<f4")
+        with socket.socket(socket.AF_UNIX) as s:
+            s.connect(path)
+            resp, body = _uds_exchange(
+                s,
+                {
+                    "model": "p",
+                    "wire": "binary",
+                    "accept": "binary",
+                    "shape": [4, 6],
+                    "payload_bytes": x32.nbytes,
+                },
+                x32.tobytes(),
+            )
+        assert resp["ok"] and resp["wire"] == "binary"
+        got = np.frombuffer(body, dtype="<f4").reshape(resp["shape"])
+        expected = np.asarray(reg.predict("p", x32), dtype="<f4")
+        assert np.array_equal(got, expected)
+
+    def test_one_connection_many_requests_and_errors(
+        self, tmp_path, fitted_models
+    ):
+        x, _, path = self._serve(tmp_path, fitted_models)
+        with socket.socket(socket.AF_UNIX) as s:
+            s.connect(path)
+            # an error frame answers without killing the connection
+            resp, _ = _uds_exchange(
+                s,
+                {"model": "ghost", "wire": "json",
+                 "instances": x[:1].tolist()},
+            )
+            assert not resp["ok"] and resp["code"] == 404
+            resp, _ = _uds_exchange(
+                s, {"model": "p", "wire": "json", "instances": x[:2].tolist()}
+            )
+            assert resp["ok"] and resp["rows"] == 2
+
+    def test_stop_serving_unlinks_socket(self, tmp_path, fitted_models):
+        _, _, path = self._serve(tmp_path, fitted_models)
+        assert os.path.exists(path)
+        server_mod.stop_serving(stop_monitor=False)
+        assert not os.path.exists(path)
+
+
+# -- in-process client -------------------------------------------------------
+
+
+class TestInprocClient:
+    def test_client_shares_server_batcher(self, fitted_models):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        srv = server_mod.start_serving(0, with_monitor=False)
+        snap = REGISTRY.snapshot()
+        out = client_mod.predict("p", x[:3])
+        assert np.array_equal(out, reg.predict("p", x[:3]))
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter(
+            "serve.transport", transport="inproc", wire="array"
+        ) == 1
+        # bound to the front-end's batcher, not a private one
+        assert client_mod.get_client()._batcher() is srv.batcher
+
+    def test_client_without_server_starts_private_batcher(self, fitted_models):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        client = client_mod.ServeClient()
+        try:
+            out = client.predict("p", x[:2])
+            assert np.array_equal(out, reg.predict("p", x[:2]))
+        finally:
+            client.close()
+
+    def test_client_error_books_status_code(self, fitted_models):
+        x, pca, _ = fitted_models
+        registry_mod.get_registry().register("p", pca, bucket_list=(8,))
+        client = client_mod.ServeClient()
+        snap = REGISTRY.snapshot()
+        try:
+            with pytest.raises(KeyError):
+                client.predict("ghost", x[:1])
+        finally:
+            client.close()
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("serve.errors", model="ghost", code=404) == 1
+
+
+# -- continuous batching -----------------------------------------------------
+
+
+class TestContinuousBatching:
+    def test_full_bucket_leaves_immediately(self, fitted_models):
+        """The window is a ceiling, not a tax: a full min-bucket dispatches
+        without waiting out a 60 s window."""
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        batcher = MicroBatcher(reg, max_delay_s=60.0).start()
+        try:
+            out = batcher.submit("p", x[:8]).result(timeout=10.0)
+        finally:
+            batcher.stop()
+        assert np.array_equal(out, np.asarray(pca.transform(x[:8])))
+
+    def test_late_request_joins_in_flight_dispatch(self, fitted_models):
+        """A request arriving after the batch was taken but before the
+        padded block is built rides the in-flight dispatch's pad slack —
+        and its result is bitwise what a solo dispatch would produce."""
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        batcher = MicroBatcher(reg, max_delay_s=60.0, adaptive=False)
+        # worker not started: drive the take/dispatch sequence by hand so
+        # the "late" arrival is deterministic
+        fut_a = batcher.submit("p", x[:1])
+        key = ("p", 8)
+        with batcher._cond:
+            taken = batcher._groups.pop(key)
+        fut_b = batcher.submit("p", x[1:3])  # arrives after the take
+        snap = REGISTRY.snapshot()
+        batcher._dispatch(key, taken, 0.0)
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("serve.batches") == 1
+        assert delta.counter("serve.joined_in_flight", model="p") == 1
+        assert delta.hist("serve.queue_delay_seconds").count == 2
+        out_a = fut_a.result(timeout=5.0)
+        out_b = fut_b.result(timeout=5.0)
+        assert np.array_equal(out_a, np.asarray(pca.transform(x[:1])))
+        assert np.array_equal(out_b, np.asarray(pca.transform(x[1:3])))
+
+    def test_late_join_never_overflows_the_bucket(self, fitted_models):
+        """Riders only join up to the chosen bucket's pad slack; the rest
+        stay queued for their own window."""
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8, 16))
+        batcher = MicroBatcher(reg, max_delay_s=60.0, adaptive=False)
+        batcher.submit("p", x[:6])
+        key = ("p", 8)
+        with batcher._cond:
+            taken = batcher._groups.pop(key)
+        fut_fits = batcher.submit("p", x[6:8])    # 6+2 = 8: fits
+        fut_next = batcher.submit("p", x[8:16])   # would overflow: stays
+        batcher._dispatch(key, taken, 0.0)
+        assert fut_fits.result(timeout=5.0).shape[0] == 2
+        with batcher._cond:
+            assert sum(
+                p.rows for g in batcher._groups.values() for p in g
+            ) == 8
+        # drain the leftover so no future leaks
+        with batcher._cond:
+            leftover = batcher._groups.pop(("p", 8))
+        batcher._dispatch(("p", 8), leftover, 0.0)
+        assert fut_next.result(timeout=5.0).shape[0] == 8
+
+    def test_adaptive_window_tracks_device_time(self, fitted_models):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        fixed = MicroBatcher(reg, max_delay_s=0.2, adaptive=False)
+        assert fixed.effective_window_s("p") == 0.2
+        adaptive = MicroBatcher(reg, max_delay_s=0.2, adaptive=True).start()
+        try:
+            # no device observation yet: the ceiling is the window
+            assert adaptive.effective_window_s("p") == 0.2
+            adaptive.submit("p", x[:8]).result(timeout=30.0)
+            # one dispatch seeded the EWMA: the window left the ceiling
+            assert adaptive.effective_window_s("p") < 0.2
+            assert adaptive.effective_window_s("p") >= 25e-6
+        finally:
+            adaptive.stop()
+
+    def test_adaptive_window_cuts_queue_delay_under_burst(self, fitted_models):
+        """The ISSUE acceptance: under a burst that does NOT fill the
+        bucket, the adaptive window drains at ~device time while the fixed
+        window idles out its full ceiling — queue-delay p99 drops by well
+        over 3x."""
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8, 16))
+        ceiling = 0.12
+
+        def burst(batcher):
+            snap = REGISTRY.snapshot()
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                futs = list(
+                    pool.map(
+                        lambda i: batcher.submit("p", x[i : i + 1]), range(4)
+                    )
+                )
+            outs = [f.result(timeout=30.0) for f in futs]
+            for i, out in enumerate(outs):
+                assert np.array_equal(
+                    out, np.asarray(pca.transform(x[i : i + 1]))
+                )
+            delta = REGISTRY.snapshot().delta(snap)
+            return delta.hist("serve.queue_delay_seconds").percentile(99)
+
+        fixed = MicroBatcher(reg, max_delay_s=ceiling, adaptive=False).start()
+        try:
+            p99_fixed = burst(fixed)
+        finally:
+            fixed.stop()
+
+        adaptive = MicroBatcher(
+            reg, max_delay_s=ceiling, adaptive=True
+        ).start()
+        try:
+            # seed the device EWMA with one full-bucket dispatch
+            adaptive.submit("p", x[:8]).result(timeout=30.0)
+            p99_adaptive = burst(adaptive)
+        finally:
+            adaptive.stop()
+
+        assert p99_fixed >= 0.8 * ceiling
+        assert p99_adaptive < p99_fixed / 3
+
+    def test_every_dispatch_books_effective_window(self, fitted_models):
+        x, pca, _ = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("p", pca, bucket_list=(8,))
+        batcher = MicroBatcher(reg, max_delay_s=0.01).start()
+        try:
+            snap = REGISTRY.snapshot()
+            batcher.submit("p", x[:8]).result(timeout=30.0)
+            delta = REGISTRY.snapshot().delta(snap)
+            assert delta.hist(
+                "serve.window_effective_seconds", model="p"
+            ).count == 1
+        finally:
+            batcher.stop()
+
+
+# -- HBM fleet manager -------------------------------------------------------
+
+
+class TestHbmFleet:
+    def test_lru_paging_order_counters_and_repaged_parity(
+        self, fitted_models, monkeypatch
+    ):
+        x, _, lin = fitted_models
+        reg = registry_mod.get_registry()
+        e1 = reg.register("m1", lin, bucket_list=(8,))
+        per_model = hbm_mod.param_bytes(e1.params)
+        assert per_model > 0
+        # budget fits exactly two models
+        monkeypatch.setenv(
+            hbm_mod.SERVE_HBM_BUDGET_BYTES_VAR, str(2 * per_model)
+        )
+        reg.register("m2", lin, bucket_list=(8,))
+        reg.predict("m1", x[:2])  # touch m1: m2 becomes LRU
+        snap = REGISTRY.snapshot()
+        reg.register("m3", lin, bucket_list=(8,))
+        delta = REGISTRY.snapshot().delta(snap)
+        # true LRU: the un-touched m2 was evicted, not the older m1
+        assert delta.counter("serve.page_out", model="m2") == 1
+        assert delta.counter("serve.page_out", model="m1") == 0
+        fleet = hbm_mod.get_fleet()
+        stats = fleet.stats()
+        assert stats["budget_bytes"] == 2 * per_model
+        assert stats["resident_bytes"] == 2 * per_model
+        assert not stats["models"]["m2"]["resident"]
+        assert stats["models"]["m1"]["resident"]
+        assert stats["models"]["m3"]["resident"]
+
+        # predicting the paged-out model repages it (evicting the new LRU,
+        # m1) and its predictions are bitwise what they were when resident
+        expected = np.asarray(lin.transform(x[:3]))
+        snap = REGISTRY.snapshot()
+        got = reg.predict("m2", x[:3])
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("serve.page_in", model="m2") == 1
+        assert delta.counter("serve.page_out", model="m1") == 1
+        assert np.array_equal(got, expected)
+        stats = fleet.stats()
+        assert stats["models"]["m2"]["resident"]
+        assert not stats["models"]["m1"]["resident"]
+
+    def test_no_budget_means_no_paging(self, fitted_models, monkeypatch):
+        """CPU backends expose no memory stats and set no override: every
+        model stays resident and nothing pages."""
+        monkeypatch.delenv(
+            hbm_mod.SERVE_HBM_BUDGET_BYTES_VAR, raising=False
+        )
+        monkeypatch.setattr(hbm_mod, "budget_bytes", lambda: None)
+        x, _, lin = fitted_models
+        reg = registry_mod.get_registry()
+        snap = REGISTRY.snapshot()
+        for name in ("a", "b", "c"):
+            reg.register(name, lin, bucket_list=(8,))
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("serve.page_out") == 0
+        assert all(
+            r["resident"]
+            for r in hbm_mod.get_fleet().stats()["models"].values()
+        )
+
+    def test_hbm_bytes_gauge_tracks_residency(self, fitted_models, monkeypatch):
+        x, _, lin = fitted_models
+        reg = registry_mod.get_registry()
+        e1 = reg.register("g1", lin, bucket_list=(8,))
+        per_model = hbm_mod.param_bytes(e1.params)
+        monkeypatch.setenv(
+            hbm_mod.SERVE_HBM_BUDGET_BYTES_VAR, str(per_model)
+        )
+        reg.register("g2", lin, bucket_list=(8,))
+        snap = REGISTRY.snapshot()
+        gauge = [
+            v for (n, _), v in snap.gauges.items() if n == "serve.hbm_bytes"
+        ]
+        assert gauge == [per_model]
+
+    def test_shed_on_slo_burn(self, monkeypatch):
+        from spark_rapids_ml_tpu.telemetry import health
+
+        breaches = [0]
+        fake = types.SimpleNamespace(
+            slo=types.SimpleNamespace(total_breaches=lambda: breaches[0])
+        )
+        monkeypatch.setattr(health, "get_monitor", lambda: fake)
+        monkeypatch.setenv("TPU_ML_ADMISSION_POLICY", "refuse")
+        fleet = hbm_mod.get_fleet()
+        fleet.check_admission("m")  # no burn yet: admits
+        breaches[0] = 2
+        snap = REGISTRY.snapshot()
+        with pytest.raises(hbm_mod.ServeShed):
+            fleet.check_admission("m")
+        # the shed surfaces as 503 at every transport
+        assert server_mod.status_for_error(hbm_mod.ServeShed("x")) == 503
+        # one shed per newly observed breach: the same burn does not
+        # re-shed the next request
+        fleet.check_admission("m")
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("serve.shed", model="m", policy="refuse") == 1
+
+    def test_degrade_policy_counts_but_admits(self, monkeypatch):
+        from spark_rapids_ml_tpu.telemetry import health
+
+        fake = types.SimpleNamespace(
+            slo=types.SimpleNamespace(total_breaches=lambda: 1)
+        )
+        monkeypatch.setattr(health, "get_monitor", lambda: fake)
+        monkeypatch.setenv("TPU_ML_ADMISSION_POLICY", "degrade")
+        fleet = hbm_mod.get_fleet()
+        snap = REGISTRY.snapshot()
+        fleet.check_admission("m")  # burns, but admits
+        delta = REGISTRY.snapshot().delta(snap)
+        assert delta.counter("serve.shed", model="m", policy="degrade") == 1
+
+    def test_off_policy_disables_shedding(self, monkeypatch):
+        from spark_rapids_ml_tpu.telemetry import health
+
+        fake = types.SimpleNamespace(
+            slo=types.SimpleNamespace(total_breaches=lambda: 99)
+        )
+        monkeypatch.setattr(health, "get_monitor", lambda: fake)
+        monkeypatch.setenv("TPU_ML_ADMISSION_POLICY", "off")
+        snap = REGISTRY.snapshot()
+        hbm_mod.get_fleet().check_admission("m")
+        assert REGISTRY.snapshot().delta(snap).counter("serve.shed") == 0
+
+
+# -- serve_report: fast-path additions ---------------------------------------
+
+
+class TestServeReportFastPath:
+    def _write(self, tmp_path, records):
+        path = tmp_path / "perf.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+        return str(path)
+
+    def test_transport_mix_paging_and_window_render(self, tmp_path, capsys):
+        sr = _load_serve_report()
+        blob = _summary_blob(
+            transport_mix={
+                "http/json": 20.0, "http/binary": 10.0,
+                "uds/binary": 15.0, "inproc/array": 5.0,
+            },
+            joined_in_flight=7.0,
+            page_in=1.0,
+            page_out=2.0,
+            hbm_bytes=4096.0,
+            adaptive_window=True,
+            window_effective={
+                "count": 30, "p50": 0.0004, "p90": 0.001, "p99": 0.002,
+                "max": 0.002,
+            },
+        )
+        path = self._write(tmp_path, [blob])
+        assert sr.main([path, "--strict"]) == 0
+        out = capsys.readouterr().out
+        assert "transport/wire" in out and "uds/binary" in out
+        assert "7 rider(s) joined in-flight" in out
+        assert "hbm paging: 1 page-in(s), 2 page-out(s)" in out
+        assert "adaptive window" in out and "ceiling" in out
+
+    def test_page_thrash_anomaly_fails_strict(self, tmp_path, capsys):
+        sr = _load_serve_report()
+        blob = _summary_blob(page_in=20.0, requests=50.0)
+        path = self._write(tmp_path, [blob])
+        assert sr.main([path, "--strict"]) == 2
+        assert "page-thrash" in capsys.readouterr().out
+
+    def test_window_never_adapts_anomaly(self, tmp_path, capsys):
+        sr = _load_serve_report()
+        blob = _summary_blob(
+            adaptive_window=True,
+            window_effective={
+                "count": 12, "p50": 0.002, "p90": 0.002, "p99": 0.002,
+                "max": 0.002,
+            },
+        )
+        path = self._write(tmp_path, [blob])
+        assert sr.main([path, "--strict"]) == 2
+        assert "window-never-adapts" in capsys.readouterr().out
+
+    def test_sparse_window_traffic_is_not_an_anomaly(self, tmp_path):
+        """Too few dispatches to judge adaptation: no anomaly."""
+        sr = _load_serve_report()
+        blob = _summary_blob(
+            adaptive_window=True,
+            window_effective={
+                "count": 4, "p50": 0.002, "p90": 0.002, "p99": 0.002,
+                "max": 0.002,
+            },
+        )
+        path = self._write(tmp_path, [blob])
+        assert sr.main([path, "--strict"]) == 0

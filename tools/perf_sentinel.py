@@ -23,6 +23,18 @@ signature, so a bench run under a different autotuner config never judges
 entries (``search_trial`` flag) are excluded from history outright, and
 metrics absent from history are reported as new, not judged.
 
+Two gates stack on top of the history comparison:
+
+- **Vanished metrics.** A metric present in every comparable history
+  entry but absent from the current one is itself a regression — the
+  gated series (``serve_p99_ms``, throughputs, ...) cannot silently drop
+  out of the bench and out of gating with it.
+- **Absolute ceilings.** A metric may carry a ``ceiling`` alongside its
+  value/unit (``bench.py`` stamps one on ``serve_p99_ms`` when
+  ``TPU_ML_SERVE_P99_GATE_MS`` is set); crossing it in the unit's worse
+  direction is a regression regardless of history — and since ceilings
+  ride the entry itself, ``--bless`` cannot wave one through.
+
 Blessing an intentional perf change: ``--bless`` truncates the ledger to
 its last entry, making the new numbers the baseline history (see
 CONTRIBUTING.md for the workflow).
@@ -86,17 +98,54 @@ def compare(
     """(regressions, notes) of the current entry vs the history median.
 
     A regression is a metric whose value moved more than ``threshold``
-    (relative) in the worse direction for its unit. Notes cover metrics
-    with no usable history (new metric, zero baseline).
+    (relative) in the worse direction for its unit, crossed its declared
+    absolute ``ceiling``, or vanished from the current entry despite being
+    present in every history entry. Notes cover metrics with no usable
+    history (new metric, zero baseline).
     """
     regressions: list[dict] = []
     notes: list[str] = []
-    for name, cur in sorted((current.get("metrics") or {}).items()):
+    current_metrics = current.get("metrics") or {}
+    # a gated metric must not silently drop out of the bench: present in
+    # every comparable history entry + absent now = regression
+    for name in sorted(
+        set.intersection(
+            *(set(e.get("metrics") or {}) for e in history)
+        ) - set(current_metrics)
+        if history else ()
+    ):
+        regressions.append({
+            "metric": name,
+            "unit": "",
+            "value": None,
+            "baseline_median": None,
+            "ratio": None,
+            "n_history": len(history),
+            "vanished": True,
+        })
+    for name, cur in sorted(current_metrics.items()):
         try:
             value = float(cur.get("value"))
         except (TypeError, ValueError):
             continue
         unit = str(cur.get("unit", ""))
+        ceiling = cur.get("ceiling")
+        if isinstance(ceiling, (int, float)):
+            beyond = (
+                value > float(ceiling) if lower_is_better(unit)
+                else value < float(ceiling)
+            )
+            if beyond:
+                regressions.append({
+                    "metric": name,
+                    "unit": unit,
+                    "value": value,
+                    "baseline_median": float(ceiling),
+                    "ratio": value / ceiling if ceiling else float("inf"),
+                    "n_history": 0,
+                    "ceiling": True,
+                })
+                continue
         past = []
         for entry in history:
             m = (entry.get("metrics") or {}).get(name)
@@ -186,13 +235,18 @@ def main(argv=None) -> int:
         )
         return 0
 
-    if not history:
-        print(
-            "perf-sentinel: fresh ledger (no comparable history) — pass"
-        )
-        return 0
-
     regressions, notes = compare(current, history, args.threshold)
+    if not history:
+        # a declared absolute ceiling rides the entry itself, so it gates
+        # even a fresh ledger (and right after --bless); history-relative
+        # notes are meaningless without comparable history
+        regressions = [r for r in regressions if r.get("ceiling")]
+        notes = []
+        if not regressions:
+            print(
+                "perf-sentinel: fresh ledger (no comparable history) — pass"
+            )
+            return 0
     for note in notes:
         print(f"  note: {note}")
     if not regressions:
@@ -208,6 +262,22 @@ def main(argv=None) -> int:
         f"{args.threshold:.0%} vs the median of {len(history)} prior runs:"
     )
     for r in regressions:
+        if r.get("vanished"):
+            print(
+                f"  REGRESSION {r['metric']}: present in all "
+                f"{r['n_history']} comparable history entries but missing "
+                "from the current entry — the gated series dropped out of "
+                "the bench"
+            )
+            continue
+        if r.get("ceiling"):
+            bound = "ceiling" if lower_is_better(r["unit"]) else "floor"
+            print(
+                f"  REGRESSION {r['metric']}: {r['value']:g} {r['unit']} "
+                f"crossed the declared absolute {bound} "
+                f"{r['baseline_median']:g} ({r['ratio']:.2f}x)"
+            )
+            continue
         direction = "slower" if lower_is_better(r["unit"]) else "lower"
         print(
             f"  REGRESSION {r['metric']}: {r['value']:g} {r['unit']} vs "

@@ -21,6 +21,13 @@ key holds the blob ``bench.py --smoke`` embeds
   configured coalescing window — p99 well above
   ``TPU_ML_SERVE_MAX_DELAY_US`` means the batcher worker, not the window,
   is the bottleneck.
+- the transport mix (http/uds/inproc x json/binary) — how much traffic
+  still pays HTTP+JSON framing vs the fast paths.
+- the adaptive-window trace (``serve.window_effective_seconds``
+  percentiles vs the configured ceiling) and continuous-batching riders
+  (``serve.joined_in_flight``).
+- HBM fleet paging: ``serve.page_in``/``serve.page_out`` counts and the
+  page-in rate per request.
 - request latency percentiles and the batching ratio
   (requests per device dispatch).
 - anomaly checks:
@@ -35,6 +42,15 @@ key holds the blob ``bench.py --smoke`` embeds
   - ``serve-errors`` — nonzero ``serve.errors`` booked in the window.
   - ``queue-delay-above-window`` — queue-delay p99 exceeded 5x the
     coalescing window (when the record carries the window).
+  - ``page-thrash`` — the HBM fleet paged weights in on a quarter or
+    more of the window's requests: the resident working set does not fit
+    ``TPU_ML_SERVE_HBM_BUDGET_BYTES`` and models are ping-ponging
+    between host and device on the hot path.
+  - ``window-never-adapts`` — adaptive windowing is on and the window
+    saw sustained dispatch traffic, yet its p50 never left the
+    ``TPU_ML_SERVE_MAX_DELAY_US`` ceiling: the device-time feedback is
+    not reaching the batcher (or every dispatch is slower than the
+    ceiling, which is its own problem).
 
 Exit status: 0 normally; with ``--strict``, 2 when any anomaly fired OR
 any record had to be skipped (CI gate). Stdlib-only — renders on hosts
@@ -106,6 +122,31 @@ def check_anomalies(summary: dict, wrapper: dict) -> list[str]:
             "is the bottleneck, not the window; check device contention "
             "and TPU_ML_SERVE_MAX_BATCH_ROWS"
         )
+    requests = summary.get("requests", 0) or 0
+    page_in = summary.get("page_in", 0) or 0
+    if page_in >= 4 and requests and page_in >= 0.25 * requests:
+        out.append(
+            f"page-thrash: {page_in:g} HBM page-in(s) across {requests:g} "
+            "request(s) — the resident model working set does not fit the "
+            "fleet budget and weights are ping-ponging between host and "
+            "device on the hot path; raise TPU_ML_SERVE_HBM_BUDGET_BYTES "
+            "or shrink the fleet"
+        )
+    win_hist = summary.get("window_effective") or {}
+    if (
+        summary.get("adaptive_window")
+        and window
+        and win_hist.get("count", 0) >= 8
+        and win_hist.get("p50", 0) >= 0.95 * window
+    ):
+        out.append(
+            f"window-never-adapts: adaptive windowing is on but the "
+            f"effective-window p50 ({_fmt_s(win_hist['p50'])}) sat at the "
+            f"{_fmt_s(window)} TPU_ML_SERVE_MAX_DELAY_US ceiling across "
+            f"{win_hist['count']:g} dispatch(es) — the device-time "
+            "feedback never shrank the window (or every dispatch outran "
+            "the ceiling)"
+        )
     return out
 
 
@@ -137,7 +178,48 @@ def render_record(rec: dict, out=sys.stdout) -> list[str] | None:
     )
     if batches:
         line += f" ({requests / batches:.2f} requests/dispatch)"
+    joined = summary.get("joined_in_flight", 0) or 0
+    if joined:
+        line += f", {joined:g} rider(s) joined in-flight"
+    shed = summary.get("shed", 0) or 0
+    if shed:
+        line += f", {shed:g} shed"
     print(line, file=out)
+
+    mix = summary.get("transport_mix") or {}
+    total_mix = sum(mix.values())
+    if mix:
+        rows = [
+            [t, f"{v:g}", f"{v / total_mix:.1%}" if total_mix else "-"]
+            for t, v in sorted(mix.items())
+        ]
+        print(_table(rows, ["transport/wire", "requests", "share"]), file=out)
+
+    page_in = summary.get("page_in", 0) or 0
+    page_out = summary.get("page_out", 0) or 0
+    if page_in or page_out:
+        line = (
+            f"hbm paging: {page_in:g} page-in(s), {page_out:g} page-out(s)"
+        )
+        if requests:
+            line += f" ({page_in / requests:.3f} page-ins/request)"
+        hbm_bytes = summary.get("hbm_bytes", 0) or 0
+        if hbm_bytes:
+            line += f", {hbm_bytes:g} resident byte(s)"
+        print(line, file=out)
+
+    win = summary.get("window_effective") or {}
+    if win.get("count"):
+        line = (
+            f"adaptive window: p50 {_fmt_s(win.get('p50', 0.0))} / "
+            f"p90 {_fmt_s(win.get('p90', 0.0))} / "
+            f"p99 {_fmt_s(win.get('p99', 0.0))} across "
+            f"{win['count']:g} dispatch(es)"
+        )
+        ceiling = summary.get("coalesce_window_s")
+        if ceiling:
+            line += f" (ceiling {_fmt_s(ceiling)})"
+        print(line, file=out)
 
     hits = summary.get("bucket_hits") or {}
     total_hits = sum(hits.values())
