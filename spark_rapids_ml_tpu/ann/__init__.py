@@ -1,0 +1,59 @@
+"""ANN vector search subsystem: IVF index lifecycle on TPU-first plumbing.
+
+The reference family ships ``approximate_nearest_neighbors`` (cuML
+ivfflat) as a fit-and-query estimator; this package grows that kernel
+(ops/ivf.py) into a full index subsystem spanning build, storage and
+serving:
+
+- :mod:`.index` — ``IVFFlatIndex``: an out-of-core index build. The coarse
+  quantizer is a kmeans||-initialized fit driven through ``stream_fold``'s
+  donated-carry pipeline (the corpus is never device-resident; Lloyd
+  statistics fold chunk by chunk, mesh-sharded via ``parallel/`` when the
+  backend has more than one device), followed by a streamed assignment +
+  bucket-packing pass with skew-aware capping (percentile cap + exact
+  overflow spill — ops/ivf.py). Index persistence rides
+  ``utils/persistence.py`` (save/load parquet + metadata).
+- :mod:`.serving` — indexes as a servable family (``"ann"``) in the PR
+  10/11 serving runtime: queries ride the bucket ladder, the continuous
+  micro-batcher, and the HBM fleet manager (inverted lists are paged
+  params; the per-(bucket, nprobe) AOT executables survive paging), and
+  are exposed at ``/v1/indexes/<name>:query`` over HTTP, UDS and the
+  in-process client with JSON and binary-f32 wires.
+
+Everything is lazy-imported so jax-free tooling can read the package
+docstring and the linter never pays the model-layer import.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_SUBMODULES = ("index", "serving")
+
+_LAZY_ATTRS = {
+    # index
+    "IVFFlatIndex": "index",
+    "IVFFlatIndexModel": "index",
+    # serving
+    "register_index": "serving",
+    "servable_from_index": "serving",
+    "query": "serving",
+    "query_direct": "serving",
+    "unpack_query_result": "serving",
+}
+
+__all__ = list(_SUBMODULES) + sorted(_LAZY_ATTRS)
+
+
+def __getattr__(name: str):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"{__name__}.{name}")
+    target = _LAZY_ATTRS.get(name)
+    if target is not None:
+        module = importlib.import_module(f"{__name__}.{target}")
+        return getattr(module, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -363,15 +363,17 @@ class ApproximateNearestNeighbors(_ANNParams, Estimator):
             labels, _ = KM.assign_clusters(
                 jnp.asarray(prepared), jnp.asarray(centroids)
             )
-            bucket_items, bucket_ids, _ = IVF.build_ivf_buckets(
+            packed = IVF.build_ivf_buckets(
                 prepared, np.asarray(labels), nlist
             )
         model = ApproximateNearestNeighborsModel(
             uid=self.uid,
             centroids=centroids,
-            bucketItems=bucket_items,
-            bucketIds=bucket_ids,
+            bucketItems=packed.bucket_items,
+            bucketIds=packed.bucket_ids,
             itemIds=ids,
+            spillItems=packed.spill_items,
+            spillIds=packed.spill_ids,
         )
         return self._copyValues(model)
 
@@ -384,6 +386,8 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
         bucketItems: np.ndarray | None = None,
         bucketIds: np.ndarray | None = None,
         itemIds: np.ndarray | None = None,
+        spillItems: np.ndarray | None = None,
+        spillIds: np.ndarray | None = None,
     ):
         super().__init__(uid)
         self.centroids = None if centroids is None else np.asarray(centroids)
@@ -392,6 +396,17 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
         )
         self.bucketIds = None if bucketIds is None else np.asarray(bucketIds)
         self.itemIds = None if itemIds is None else np.asarray(itemIds)
+        # pre-spill saves / direct construction: an empty spill list is the
+        # exact equivalent of the old pad-to-largest-cluster packing
+        if spillItems is None and self.bucketItems is not None:
+            spillItems = np.zeros(
+                (0, self.bucketItems.shape[2]), dtype=self.bucketItems.dtype
+            )
+            spillIds = np.full(0, -1, dtype=np.int32)
+        self.spillItems = (
+            None if spillItems is None else np.asarray(spillItems)
+        )
+        self.spillIds = None if spillIds is None else np.asarray(spillIds)
 
     @property
     def numItems(self) -> int:
@@ -424,6 +439,10 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
         cd = jnp.asarray(self.centroids)
         bi = jnp.asarray(self.bucketItems)
         bd = jnp.asarray(self.bucketIds)
+        si = sd = None
+        if self.spillItems is not None and self.spillItems.shape[0] > 0:
+            si = jnp.asarray(self.spillItems)
+            sd = jnp.asarray(self.spillIds)
         nprobe = self.getNprobe()
 
         out_scores = np.empty((queries.shape[0], k), dtype=fdt)
@@ -433,7 +452,8 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
                 chunk = queries[lo : lo + _QUERY_CHUNK]
                 qpad, q_rows = columnar.pad_rows(chunk)
                 scores, idx = IVF.ivf_search(
-                    jnp.asarray(qpad), cd, bi, bd, k, nprobe
+                    jnp.asarray(qpad), cd, bi, bd, k, nprobe,
+                    spill_items=si, spill_ids=sd,
                 )
                 out_scores[lo : lo + q_rows] = np.asarray(scores)[:q_rows]
                 out_idx[lo : lo + q_rows] = np.asarray(idx)[:q_rows]
@@ -467,14 +487,19 @@ class ApproximateNearestNeighborsModel(_ANNParams, Model):
             "bucketItems": self.bucketItems,
             "bucketIds": self.bucketIds,
             "itemIds": self.itemIds,
+            "spillItems": self.spillItems,
+            "spillIds": self.spillIds,
         }
 
     @classmethod
     def _fromSaved(cls, uid, data):
+        spill_ids = data.get("spillIds")
         return cls(
             uid=uid,
             centroids=data["centroids"],
             bucketItems=data["bucketItems"],
             bucketIds=data["bucketIds"].astype(np.int32),
             itemIds=data["itemIds"],
+            spillItems=data.get("spillItems"),
+            spillIds=None if spill_ids is None else spill_ids.astype(np.int32),
         )

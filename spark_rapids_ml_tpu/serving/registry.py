@@ -56,7 +56,7 @@ logger = logging.getLogger("spark_rapids_ml_tpu.serving")
 
 SERVE_COMPILE_CACHE_DIR_VAR = knobs.SERVE_COMPILE_CACHE_DIR.name
 
-FAMILIES = ("pca", "linear", "scaler", "forest")
+FAMILIES = ("pca", "linear", "scaler", "forest", "ann")
 
 #: Input dtypes a serve request may carry. Integer/bool payloads (JSON
 #: numbers decode to them) are widened to float64 first; float16/bfloat16/
@@ -434,6 +434,16 @@ def servable_from_model(name: str, model: Any) -> ServableEntry:
             row_axis=1,
             model=model,
         )
+
+    if (
+        getattr(model, "bucketItems", None) is not None
+        and getattr(model, "centroids", None) is not None
+    ):
+        # fitted IVF index (ApproximateNearestNeighborsModel or the
+        # streamed IVFFlatIndexModel) — the ann subsystem owns the contract
+        from spark_rapids_ml_tpu.ann import serving as ann_serving
+
+        return ann_serving.servable_from_index(name, model)
 
     raise TypeError(
         f"{type(model).__name__} has no serve contract — servable families: "

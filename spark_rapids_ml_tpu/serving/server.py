@@ -23,6 +23,13 @@ Endpoints:
   ``Accept: application/x-tpu-ml-f32``. Requests ride the micro-batcher,
   so concurrent callers of the same (model, bucket) share one device
   dispatch.
+- ``GET  /v1/indexes`` — registered ANN indexes (the ``"ann"`` family
+  subset of ``/v1/models``).
+- ``POST /v1/indexes/<name>:query`` — k-NN queries against a registered
+  IVF index; same request wires as ``:predict``. JSON responses carry
+  ``ids`` + ``distances``; binary responses carry the packed ``[rows, 2k]``
+  block (distances | ids) with ``X-ANN-K`` naming k. The UDS protocol
+  reaches the same path via ``"kind": "query"`` in the request header.
 
 Co-located callers can skip HTTP framing entirely: ``TPU_ML_SERVE_UDS_PATH``
 starts a Unix-domain-socket listener speaking a minimal length-prefixed
@@ -69,6 +76,11 @@ from spark_rapids_ml_tpu.utils import knobs
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
 
 PREDICT_SUFFIX = ":predict"
+QUERY_SUFFIX = ":query"
+
+#: Binary query responses carry k here — the packed body is [rows, 2k]
+#: (distances | ids), and the client needs k to split it.
+ANN_K_HEADER = "X-ANN-K"
 
 #: The zero-copy wire format: row-major little-endian float32.
 BINARY_CONTENT_TYPE = "application/x-tpu-ml-f32"
@@ -139,26 +151,53 @@ class ServeHandler(httpd._Handler):
             REGISTRY.counter_inc("http.requests", path=path)
             self._json(200, {"models": self._registry.describe()})
             return
+        if path == "/v1/indexes":
+            REGISTRY.counter_inc("http.requests", path=path)
+            self._json(
+                200,
+                {
+                    "indexes": [
+                        e for e in self._registry.describe()
+                        if e["family"] == "ann"
+                    ]
+                },
+            )
+            return
         super().do_GET()
 
     def do_POST(self):  # noqa: N802 - http.server naming contract
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         REGISTRY.counter_inc("http.requests", path=path)
-        if not (
-            path.startswith("/v1/models/") and path.endswith(PREDICT_SUFFIX)
-        ):
-            self._json(404, {"error": f"no such endpoint: {path}"})
+        if path.startswith("/v1/models/") and path.endswith(PREDICT_SUFFIX):
+            name = path[len("/v1/models/"):-len(PREDICT_SUFFIX)]
+            self._infer(name, kind="predict")
             return
-        name = path[len("/v1/models/"):-len(PREDICT_SUFFIX)]
+        if path.startswith("/v1/indexes/") and path.endswith(QUERY_SUFFIX):
+            name = path[len("/v1/indexes/"):-len(QUERY_SUFFIX)]
+            self._infer(name, kind="query")
+            return
+        self._json(404, {"error": f"no such endpoint: {path}"})
+
+    def _infer(self, name: str, *, kind: str) -> None:
+        """One predict OR index-query request — same payload decode, same
+        batcher ride, same telemetry; only the response shape differs (a
+        query answer unpacks into ids + distances)."""
         t0 = time.perf_counter()
         try:
+            if kind == "query":
+                entry = self._registry.get(name)
+                if entry.family != "ann":
+                    raise KeyError(
+                        f"{name!r} is a {entry.family} servable, not an "
+                        "ann index"
+                    )
             instances, wire = self._read_payload(name)
             future = self._batcher.submit(name, instances)
             out = future.result(timeout=30.0)
         except Exception as e:  # noqa: BLE001 - predict must answer, not die
             code = status_for_error(e)
             if code == 500:
-                logger.exception("predict failed for model %s", name)
+                logger.exception("%s failed for model %s", kind, name)
             self._serve_error(name, code, f"{type(e).__name__}: {e}"
                               if code == 500 else str(e))
             return
@@ -168,13 +207,36 @@ class ServeHandler(httpd._Handler):
         REGISTRY.counter_inc("serve.requests", model=name, code=200)
         REGISTRY.counter_inc("serve.transport", transport="http", wire=wire)
         REGISTRY.histogram_record("serve.latency", latency, model=name)
-        if BINARY_CONTENT_TYPE in (self.headers.get("Accept") or ""):
+        if kind == "query":
+            REGISTRY.counter_inc(
+                "ann.queries", int(np.shape(out)[0]), index=name
+            )
+        binary = BINARY_CONTENT_TYPE in (self.headers.get("Accept") or "")
+        if binary:
             body, shape = binary_response_bytes(out)
-            self._respond(
-                200, body, BINARY_CONTENT_TYPE,
-                extra_headers={
-                    SHAPE_HEADER: shape,
-                    "X-Latency-Ms": f"{latency * 1e3:.3f}",
+            extra = {
+                SHAPE_HEADER: shape,
+                "X-Latency-Ms": f"{latency * 1e3:.3f}",
+            }
+            if kind == "query":
+                # the packed [rows, 2k] block rides the f32 wire as-is;
+                # ids stay exact up to 2^24 (JSON carries them to 2^53)
+                extra[ANN_K_HEADER] = str(int(np.shape(out)[1]) // 2)
+            self._respond(200, body, BINARY_CONTENT_TYPE, extra_headers=extra)
+            return
+        if kind == "query":
+            from spark_rapids_ml_tpu.ann.serving import unpack_query_result
+
+            dists, ids = unpack_query_result(out)
+            self._json(
+                200,
+                {
+                    "index": name,
+                    "rows": int(ids.shape[0]),
+                    # host numpy -> JSON; no device sync involved
+                    "ids": ids.tolist(),  # tpulint: disable=TPL002
+                    "distances": dists.tolist(),  # tpulint: disable=TPL002
+                    "latency_ms": round(latency * 1e3, 3),
                 },
             )
             return
@@ -281,8 +343,18 @@ def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
     model = str(header.get("model", ""))
     wire = str(header.get("wire", "json"))
     accept = str(header.get("accept", wire))
+    kind = str(header.get("kind", "predict"))
     t0 = time.perf_counter()
     try:
+        if kind == "query":
+            entry = batcher.registry.get(model)
+            if entry.family != "ann":
+                raise KeyError(
+                    f"{model!r} is a {entry.family} servable, not an ann "
+                    "index"
+                )
+        elif kind != "predict":
+            raise ValueError(f'kind must be "predict" or "query", got {kind!r}')
         if wire == "binary":
             shape = header.get("shape") or []
             payload = _read_exact(rfile, int(header.get("payload_bytes", 0)))
@@ -319,6 +391,9 @@ def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
         "rows": int(np.shape(out)[0]),
         "latency_ms": round(latency * 1e3, 3),
     }
+    if kind == "query":
+        REGISTRY.counter_inc("ann.queries", int(np.shape(out)[0]), index=model)
+        base["k"] = int(np.shape(out)[1]) // 2
     if accept == "binary":
         body, shape = binary_response_bytes(out)
         base.update(
@@ -327,6 +402,16 @@ def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
             payload_bytes=len(body),
         )
         _uds_send(wfile, base, body)
+    elif kind == "query":
+        from spark_rapids_ml_tpu.ann.serving import unpack_query_result
+
+        dists, ids = unpack_query_result(out)
+        base.update(
+            wire="json",
+            ids=ids.tolist(),  # tpulint: disable=TPL002
+            distances=dists.tolist(),  # tpulint: disable=TPL002
+        )
+        _uds_send(wfile, base)
     else:
         base.update(
             wire="json",

@@ -5,8 +5,8 @@ Public surface (everything the rest of the framework and user code needs):
 - ``REGISTRY`` / ``counter_inc`` / ``gauge_set`` / ``histogram_record`` —
   the process-local metric store (:mod:`.registry`).
 - ``trace_range`` — host+device trace span with latency accounting
-  (:mod:`.spans`); ``metrics()`` / ``reset_metrics()`` keep the legacy
-  ``utils.tracing`` read shape.
+  (:mod:`.spans`); ``metrics()`` / ``reset_metrics()`` keep the read
+  shape of the long-removed ``utils.tracing`` module.
 - ``FitReport`` / ``begin_fit`` / ``end_fit`` — per-fit capture windows
   (:mod:`.report`), wired automatically through ``models.base``.
 - ``TransformReport`` / ``begin_transform`` / ``end_transform`` — the

@@ -1,7 +1,8 @@
 """Live component health: a background monitor with a tiny state machine.
 
 Bench rounds 3-5 lost >14 h to 120 s device-probe timeouts that were only
-visible to a detached one-off script (``tools/transport_monitor_r5.py``) —
+visible to a detached one-off script (the since-retired
+``transport_monitor_r5``, whose probe loop ``tools/healthd.py`` absorbed) —
 nothing inside the framework watched device health *while work ran*. This
 module closes that gap: a daemon :class:`HealthMonitor` thread polls a
 fixed set of components every ``TPU_ML_HEALTH_INTERVAL_S`` seconds and
@@ -15,7 +16,7 @@ Components and their evidence:
   (:func:`telemetry.compilemon.sample_device_memory`): DEGRADED above
   ``TPU_ML_HEALTH_HBM_WATERMARK`` of ``bytes_limit``.
 - ``transport``   — a bounded-deadline liveness probe, generalizing the
-  ``transport_monitor_r5`` loop: ``inline`` (default) runs a cheap
+  retired ``transport_monitor_r5`` loop: ``inline`` (default) runs a cheap
   in-process check on a throwaway thread; ``subprocess`` runs the full
   :func:`utils.devicepolicy.probe_transport_subprocess` (repeatable even
   when a probe wedges); ``off`` disables. Consecutive failures escalate

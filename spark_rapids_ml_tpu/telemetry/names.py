@@ -97,6 +97,11 @@ METRICS: frozenset[str] = frozenset({
     "serve.page_out",
     "serve.hbm_bytes",
     "serve.shed",
+    # ANN vector search subsystem (spark_rapids_ml_tpu.ann)
+    "ann.queries",
+    "ann.build_rows",
+    "ann.spill_fraction",
+    "ann.cells_reseeded",
     # serve path
     "transform.rows",
     "transform.bytes",
@@ -216,6 +221,9 @@ SPAN_PHASES: frozenset[str] = frozenset({
     "knn kneighbors",
     "ivf build",
     "ivf kneighbors",
+    "ann build",
+    "ann pack",
+    "ann query",
     "umap init",
     "umap knn graph",
     "umap fuzzy graph",

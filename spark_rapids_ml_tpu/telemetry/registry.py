@@ -256,8 +256,9 @@ class MetricsRegistry:
             )
 
     def span_totals(self) -> dict[str, dict[str, float]]:
-        """Legacy ``utils.tracing.metrics()`` shape: per-span-name wall
-        totals and counts, aggregated over every other label."""
+        """Read shape of the removed ``utils.tracing`` module's
+        ``metrics()``: per-span-name wall totals and counts, aggregated
+        over every other label."""
         out: dict[str, dict[str, float]] = {}
         with self._lock:
             for (name, labels), h in self._hists.items():

@@ -173,6 +173,15 @@ _DECLARATIONS = (
     Knob("TPU_ML_PRECISION_POLICY", "enum", "f32",
          "`f32`/`bf16_f32acc`/`int8_dist` mixed-precision kernel policy "
          "default (accumulators stay f32)", "autotune.policy"),
+    # -- ANN vector search (spark_rapids_ml_tpu.ann + ops.ivf) --------------
+    Knob("TPU_ML_ANN_CAP_PERCENTILE", "float", "99.0",
+         "IVF bucket-cap percentile over cluster sizes; members beyond the "
+         "cap land on the exact spill list (100 = pad every bucket to the "
+         "largest cluster)", "ops.ivf"),
+    Knob("TPU_ML_ANN_SAMPLE_ROWS", "int", "32768",
+         "row budget of the sampled kmeans|| coarse-quantizer training set "
+         "for streamed IVF index builds (0 = train on the full stream)",
+         "ann.index"),
     # -- warm-path serving runtime (spark_rapids_ml_tpu.serving) ------------
     Knob("TPU_ML_SERVE_COMPILE_CACHE_DIR", "path", "",
          "persistent XLA cache dir for AOT-compiled serve kernels (fresh "
@@ -314,6 +323,8 @@ AUTOTUNE = KNOBS["TPU_ML_AUTOTUNE"]
 AUTOTUNE_TRIALS = KNOBS["TPU_ML_AUTOTUNE_TRIALS"]
 TUNING_CACHE_PATH = KNOBS["TPU_ML_TUNING_CACHE_PATH"]
 PRECISION_POLICY = KNOBS["TPU_ML_PRECISION_POLICY"]
+ANN_CAP_PERCENTILE = KNOBS["TPU_ML_ANN_CAP_PERCENTILE"]
+ANN_SAMPLE_ROWS = KNOBS["TPU_ML_ANN_SAMPLE_ROWS"]
 SERVE_COMPILE_CACHE_DIR = KNOBS["TPU_ML_SERVE_COMPILE_CACHE_DIR"]
 SERVE_MIN_BUCKET = KNOBS["TPU_ML_SERVE_MIN_BUCKET"]
 SERVE_MAX_BATCH_ROWS = KNOBS["TPU_ML_SERVE_MAX_BATCH_ROWS"]

@@ -1,0 +1,448 @@
+"""ANN subsystem tests: streamed IVF build, serving-native queries.
+
+The streamed ``IVFFlatIndex`` build must agree with the in-memory
+``ApproximateNearestNeighbors`` packing (same kernels, exhaustive probe →
+exact neighbors), survive persistence bitwise, drop nothing under skew,
+and serve through the registry/batcher/HTTP stack as the ``"ann"`` family
+with zero steady-state compiles. conftest forces 8 host devices, so every
+build here exercises the mesh-sharded Lloyd fold.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.ann import (
+    IVFFlatIndex,
+    IVFFlatIndexModel,
+    query,
+    query_direct,
+    register_index,
+)
+from spark_rapids_ml_tpu.serving import client as client_mod
+from spark_rapids_ml_tpu.serving import registry as registry_mod
+from spark_rapids_ml_tpu.serving import server as server_mod
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def serve_clean():
+    yield
+    client_mod.reset_client()
+    server_mod.stop_serving(stop_monitor=False)
+    registry_mod.reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(0)
+    centers = rng.normal(scale=8, size=(24, 12))
+    labels = rng.integers(0, 24, 6000)
+    x = (centers[labels] + rng.normal(size=(6000, 12))).astype(np.float32)
+    return x
+
+
+def _chunks(x, rows=1500):
+    return [x[i : i + rows] for i in range(0, len(x), rows)]
+
+
+def _recall(ids, oracle_ids):
+    k = oracle_ids.shape[1]
+    return np.mean(
+        [len(set(a.tolist()) & set(b.tolist())) / k
+         for a, b in zip(ids, oracle_ids)]
+    )
+
+
+def test_streamed_build_matches_exact_at_full_probe(corpus):
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+    model = (
+        IVFFlatIndex(k=8, nlist=24, nprobe=24, maxIter=3, seed=1)
+        .fit(_chunks(corpus))
+    )
+    exact_d, exact_i = (
+        NearestNeighbors().setK(8).fit(corpus).kneighbors(corpus[:128])
+    )
+    d, i = model.search(corpus[:128])
+    np.testing.assert_array_equal(i, exact_i)
+    # queries ARE corpus rows, so the exact self-distance is 0 and the
+    # f32 q²+x²−2qx cancellation leaves ~√eps·scale after the sqrt —
+    # atol must cover that; everything else agrees to ~1e-5 relative
+    np.testing.assert_allclose(d, exact_d, rtol=1e-3, atol=0.05)
+
+
+def test_streamed_build_source_forms(corpus):
+    """ndarray, chunk list and chunk-factory sources build the same index."""
+    kw = dict(k=5, nlist=16, nprobe=16, maxIter=2, seed=2)
+    m_arr = IVFFlatIndex(**kw).fit(corpus)
+    m_list = IVFFlatIndex(**kw).fit(_chunks(corpus))
+    m_fact = IVFFlatIndex(**kw).fit(lambda: iter(_chunks(corpus)))
+    np.testing.assert_array_equal(m_arr.bucketIds, m_list.bucketIds)
+    np.testing.assert_array_equal(m_list.bucketIds, m_fact.bucketIds)
+    np.testing.assert_array_equal(m_list.bucketItems, m_fact.bucketItems)
+
+
+def test_streamed_build_drops_nothing_under_skew():
+    """100:1-skewed stream: every corpus row lands in a bucket or the spill
+    list, and the dense tensor stays percentile-capped."""
+    rng = np.random.default_rng(3)
+    hot = rng.normal(loc=0.0, scale=0.05, size=(5000, 8))
+    cold = rng.normal(scale=20.0, size=(2500, 8))
+    x = np.concatenate([hot, cold]).astype(np.float32)
+    model = (
+        IVFFlatIndex(k=5, nlist=64, nprobe=64, maxIter=3, seed=4)
+        .fit(_chunks(x, 1024))
+    )
+    kept = np.concatenate([
+        model.bucketIds[model.bucketIds >= 0],
+        model.spillIds[model.spillIds >= 0],
+    ])
+    np.testing.assert_array_equal(np.sort(kept), np.arange(len(x)))
+    assert model.bucketItems.shape[1] < 5000
+
+
+def test_rebalance_reseeds_empty_cells_greedily():
+    """Two empty cells and two uncovered clusters: greedy farthest-point
+    reseeding must give each uncovered cluster its own cell (a plain
+    top-k by distance would drop both seeds into the farthest cluster),
+    and must leave live cells bitwise untouched."""
+    from spark_rapids_ml_tpu.ann.index import _rebalance_cells
+
+    rng = np.random.default_rng(11)
+    true = np.array(
+        [[0.0, 0.0], [30.0, 0.0], [0.0, 30.0], [30.0, 40.0]], np.float32
+    )
+    labels = np.arange(1200) % 4
+    x = (true[labels] + rng.normal(scale=0.1, size=(1200, 2))).astype(
+        np.float32
+    )
+    # init double-covered cluster 0; clusters 2 and 3 got no center
+    centers = np.array(
+        [[0.1, 0.0], [-0.1, 0.0], [30.0, 0.1], [0.2, 0.1]], np.float32
+    )
+    counts2 = np.array([150, 0, 300, 0])
+    repaired2, n2 = _rebalance_cells(centers, counts2, x)
+    assert n2 == 2
+    d_to_true = np.linalg.norm(
+        repaired2[[1, 3], None, :] - true[None, :, :], axis=2
+    )
+    nearest = set(np.argmin(d_to_true, axis=1).tolist())
+    assert nearest == {2, 3}  # one seed per uncovered cluster, not two in one
+
+    same, zero = _rebalance_cells(
+        centers, np.array([300, 300, 300, 300]), x
+    )
+    assert zero == 0 and same is centers
+
+
+def test_rebalance_splits_merged_cells():
+    """The no-empty-cell local minimum: cluster 3 has no center, so its
+    rows pile onto cluster 1's cell (doubling it) while two duplicate
+    centers split cluster 0. Repair must move a duplicate (the smallest
+    cell) into the absorbed cluster, leaving every cell near-balanced."""
+    from spark_rapids_ml_tpu.ann.index import _rebalance_cells
+
+    rng = np.random.default_rng(13)
+    true = np.array(
+        [[0.0, 0.0], [30.0, 0.0], [0.0, 30.0], [33.0, 3.0]], np.float32
+    )
+    labels = np.arange(1200) % 4
+    x = (true[labels] + rng.normal(scale=0.1, size=(1200, 2))).astype(
+        np.float32
+    )
+    centers = np.array(
+        [[0.1, 0.0], [-0.1, 0.0], [30.5, 1.5], [0.0, 30.0]], np.float32
+    )
+    # stream counts: duplicates split cluster 0, cell 2 absorbed cluster 3
+    counts = np.array([150, 130, 620, 300])
+    repaired, n = _rebalance_cells(centers, counts, x)
+    assert n == 1
+    # the donated center (smallest cell, slot 1) lands inside cluster 3,
+    # the farthest region of the overfull cell
+    assert np.linalg.norm(repaired[1] - true[3]) < 1.0
+    np.testing.assert_array_equal(repaired[[0, 2, 3]], centers[[0, 2, 3]])
+
+
+def test_custom_ids_and_mismatch(corpus):
+    ids = np.arange(len(corpus), dtype=np.int64) * 7 + 3
+    model = (
+        IVFFlatIndex(k=3, nlist=16, nprobe=16, maxIter=2, seed=5)
+        .fit(_chunks(corpus), ids=ids)
+    )
+    _, i = model.search(corpus[:10])
+    np.testing.assert_array_equal(i[:, 0], ids[:10])
+    with pytest.raises(ValueError, match="ids has"):
+        IVFFlatIndex(k=3, nlist=8, maxIter=1).fit(
+            _chunks(corpus), ids=ids[:-1]
+        )
+
+
+def test_non_reiterable_source_is_detected(corpus):
+    """A bare generator drains on the first pass; the build must fail
+    loudly instead of packing an empty index."""
+    gen = (c for c in _chunks(corpus))
+    with pytest.raises(ValueError):
+        IVFFlatIndex(k=3, nlist=8, maxIter=1).fit(lambda: gen)
+
+
+def test_persistence_roundtrip(tmp_path, corpus):
+    model = (
+        IVFFlatIndex(k=6, nlist=16, nprobe=4, maxIter=2, seed=6)
+        .fit(_chunks(corpus))
+    )
+    path = str(tmp_path / "ivf_index")
+    model.save(path)
+    loaded = IVFFlatIndexModel.load(path)
+    assert isinstance(loaded, IVFFlatIndexModel)
+    assert loaded.getNprobe() == 4
+    d0, i0 = model.search(corpus[:32])
+    d1, i1 = loaded.search(corpus[:32])
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(d0, d1)
+    d2, i2 = loaded.search(corpus[:32], nprobe=16)
+    assert loaded.getNprobe() == 4  # override is per-call
+
+
+def test_serving_registration_and_query(corpus):
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+    model = (
+        IVFFlatIndex(k=10, nlist=24, nprobe=8, maxIter=3, seed=7)
+        .fit(_chunks(corpus))
+    )
+    entry = register_index("vecs", model, bucket_list=(8, 64, 256))
+    assert entry.family == "ann"
+    assert any(
+        e["family"] == "ann" for e in registry_mod.get_registry().describe()
+    )
+
+    q = corpus[:200]
+    cold_before = REGISTRY.snapshot().counter("serve.cold_compiles")
+    d, i = query("vecs", q)
+    d2, i2 = query("vecs", q)  # steady state: no new compiles
+    cold_after = REGISTRY.snapshot().counter("serve.cold_compiles")
+    assert cold_after == cold_before
+    np.testing.assert_array_equal(i, i2)
+
+    # parity with the model's own search at the registered operating point
+    d_ref, i_ref = model.search(q)
+    np.testing.assert_array_equal(i, i_ref)
+    np.testing.assert_allclose(d, d_ref, rtol=1e-5, atol=1e-5)
+
+    # recall vs the exact oracle at nprobe=8/24 on separable clusters
+    _, oracle = NearestNeighbors().setK(10).fit(corpus).kneighbors(q)
+    assert _recall(i, oracle) >= 0.95
+
+    # query_direct sweeps nprobe without re-registering
+    _, i_full = query_direct("vecs", q, nprobe=24)
+    assert _recall(i_full, oracle) == 1.0
+
+
+def test_serving_cosine_prepare_hook(corpus):
+    """Cosine indexes normalize queries in the serve prepare hook — the
+    served answer must match the model's own (normalizing) search path."""
+    model = (
+        IVFFlatIndex(k=5, metric="cosine", nlist=16, nprobe=16, maxIter=2,
+                     seed=8)
+        .fit(_chunks(corpus))
+    )
+    register_index("cos", model, bucket_list=(64,))
+    q = corpus[:50] * 3.7  # scaling must not change cosine neighbors
+    d, i = query("cos", q)
+    d_ref, i_ref = model.search(q)
+    np.testing.assert_array_equal(i, i_ref)
+    np.testing.assert_allclose(d, d_ref, rtol=1e-5, atol=1e-5)
+    assert np.all((d >= 0) & (d <= 2))
+
+
+def test_http_index_endpoints(corpus):
+    model = (
+        IVFFlatIndex(k=4, nlist=16, nprobe=16, maxIter=2, seed=9)
+        .fit(_chunks(corpus))
+    )
+    register_index("web", model, bucket_list=(8, 16))
+    srv = server_mod.start_serving(0, with_monitor=False)
+    port = srv._httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+
+    with urllib.request.urlopen(f"{base}/v1/indexes") as r:
+        listing = json.loads(r.read())
+    assert [e["name"] for e in listing["indexes"]] == ["web"]
+
+    body = json.dumps({"instances": corpus[:3].tolist()}).encode()
+    req = urllib.request.Request(
+        f"{base}/v1/indexes/web:query", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        resp = json.loads(r.read())
+    assert resp["rows"] == 3
+    assert resp["ids"][0][0] == 0  # self-match
+    assert len(resp["distances"][0]) == 4
+
+    # binary wire: packed [rows, 2k] + X-ANN-K
+    raw = np.ascontiguousarray(corpus[:2], dtype="<f4").tobytes()
+    req = urllib.request.Request(
+        f"{base}/v1/indexes/web:query", data=raw,
+        headers={
+            "Content-Type": server_mod.BINARY_CONTENT_TYPE,
+            server_mod.SHAPE_HEADER: "2,12",
+            "Accept": server_mod.BINARY_CONTENT_TYPE,
+        },
+    )
+    with urllib.request.urlopen(req) as r:
+        k = int(r.headers[server_mod.ANN_K_HEADER])
+        shape = [int(d) for d in r.headers[server_mod.SHAPE_HEADER].split(",")]
+        packed = np.frombuffer(r.read(), dtype="<f4").reshape(shape)
+    assert k == 4 and shape == [2, 8]
+    np.testing.assert_array_equal(packed[:, k].astype(int), [0, 1])
+
+    # :query against a non-ann servable is a 404
+    from spark_rapids_ml_tpu.models.pca import PCA
+
+    srv.registry.register("p", PCA(k=2).fit(corpus), bucket_list=(8,))
+    req = urllib.request.Request(
+        f"{base}/v1/indexes/p:query", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req)
+    assert err.value.code == 404
+
+
+# -- ann_report CLI ----------------------------------------------------------
+
+
+def _load_ann_report():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ann_report", os.path.join(repo, "tools", "ann_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ann_blob(**over):
+    blob = {
+        "rows": 1_048_576,
+        "n_features": 32,
+        "nlist": 2048,
+        "nprobe": 1,
+        "k": 10,
+        "build_seconds": 75.0,
+        "build_rows_per_s": 13981,
+        "bucket_cap": 512,
+        "bucket_fill": {"mean": 512.0, "p50": 512, "p99": 512, "max": 512},
+        "spill_rows": 0,
+        "spill_fraction": 0.0,
+        "ann_qps": 36651,
+        "knn_qps": 221,
+        "qps_ratio": 165.7,
+        "ann_recall_at_10": 0.9996,
+        "recall_vs_nprobe": [
+            {"nprobe": 1, "recall_at_10": 0.9996},
+            {"nprobe": 2, "recall_at_10": 0.9996},
+            {"nprobe": 4, "recall_at_10": 0.9996},
+        ],
+        "ann_recompiles_after_warmup": 0,
+    }
+    blob.update(over)
+    return blob
+
+
+class TestAnnReport:
+    def _write(self, tmp_path, records):
+        path = tmp_path / "perf.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+        return str(path)
+
+    def test_clean_ledger_entry_renders_and_passes_strict(
+        self, tmp_path, capsys
+    ):
+        ar = _load_ann_report()
+        path = self._write(
+            tmp_path,
+            [
+                {"bench": "smoke", "other": 1},  # no ann evidence: ignored
+                {
+                    "bench": "smoke",
+                    "timestamp": "2026-08-05T00:00:00Z",
+                    "ann": _ann_blob(),
+                },
+            ],
+        )
+        assert ar.main([path, "--strict"]) == 0
+        out = capsys.readouterr().out
+        assert "anomaly checks: ok" in out
+        assert "nprobe" in out and "recall@10" in out
+        assert "registered operating point" in out
+
+    def test_probe_skew_anomaly_fails_strict(self, tmp_path, capsys):
+        ar = _load_ann_report()
+        blob = _ann_blob(
+            bucket_cap=1024,
+            bucket_fill={"mean": 512.0, "p50": 512, "p99": 1088, "max": 1100},
+        )
+        path = self._write(tmp_path, [{"ann": blob}])
+        assert ar.main([path]) == 0  # render-only stays green
+        assert ar.main([path, "--strict"]) == 2
+        assert "probe-skew" in capsys.readouterr().out
+
+    def test_recall_cliff_anomaly(self, tmp_path, capsys):
+        ar = _load_ann_report()
+        blob = _ann_blob(
+            ann_recall_at_10=0.93,
+            recall_vs_nprobe=[
+                {"nprobe": 1, "recall_at_10": 0.93},
+                {"nprobe": 4, "recall_at_10": 0.999},
+            ],
+        )
+        path = self._write(tmp_path, [blob])  # bare blob, no wrapper
+        assert ar.main([path, "--strict"]) == 2
+        out = capsys.readouterr().out
+        assert "recall-cliff" in out and "nprobe=4" in out
+
+    def test_monotonicity_and_recompile_anomalies(self, tmp_path, capsys):
+        ar = _load_ann_report()
+        blob = _ann_blob(
+            ann_recompiles_after_warmup=2,
+            recall_vs_nprobe=[
+                {"nprobe": 1, "recall_at_10": 0.9996},
+                {"nprobe": 2, "recall_at_10": 0.91},
+            ],
+        )
+        path = self._write(tmp_path, [{"ann": blob}])
+        assert ar.main([path, "--strict"]) == 2
+        out = capsys.readouterr().out
+        assert "recall-not-monotone" in out
+        assert "query-path-recompile" in out
+
+    def test_low_recall_ratio_and_spill_anomalies(self, tmp_path, capsys):
+        ar = _load_ann_report()
+        blob = _ann_blob(
+            ann_recall_at_10=0.80,
+            qps_ratio=19.4,
+            spill_fraction=0.12,
+            spill_rows=125_829,
+            recall_vs_nprobe=[],
+        )
+        path = self._write(tmp_path, [{"ann": blob}])
+        assert ar.main([path, "--strict"]) == 2
+        out = capsys.readouterr().out
+        assert "recall-below-bar" in out
+        assert "index-no-speedup" in out
+        assert "spill-heavy" in out
+
+    def test_no_evidence_is_an_error(self, tmp_path):
+        ar = _load_ann_report()
+        path = self._write(tmp_path, [{"bench": "smoke"}])
+        assert ar.main([path]) == 1

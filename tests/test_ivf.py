@@ -101,6 +101,107 @@ def test_unfilled_slots_are_inf_not_two():
     assert np.all(np.isfinite(d[~phantom]))
 
 
+def test_skewed_corpus_cap_and_spill():
+    """100:1 cluster skew regression: the percentile cap keeps the dense
+    tensor near the corpus footprint (the old pad-to-largest packing
+    allocated ~nlist × hot-cluster-size), and NO item is dropped — every
+    corpus row appears exactly once across buckets + spill."""
+    from spark_rapids_ml_tpu.ops import ivf as IVF
+
+    rng = np.random.default_rng(7)
+    n, nlist = 8, 100
+    hot = rng.normal(size=(5000, n))          # one hot cluster, 100:1 skew
+    cold = rng.normal(size=(99, 50, n))       # 99 clusters of 50
+    items = np.concatenate([hot, cold.reshape(-1, n)]).astype(np.float32)
+    labels = np.concatenate(
+        [np.zeros(5000, np.int64),
+         np.repeat(np.arange(1, nlist), 50)]
+    )
+    b = IVF.build_ivf_buckets(items, labels, nlist)
+    # memory: the 99th-percentile cap excludes the hot cluster, so the
+    # dense tensor must be far under the old nlist*max_count*n packing
+    assert b.cap < 5000
+    old_bytes = nlist * 5000 * n * items.itemsize
+    assert b.bucket_items.nbytes < old_bytes / 10
+    # completeness: ids partition exactly into buckets + spill
+    kept = np.concatenate(
+        [b.bucket_ids[b.bucket_ids >= 0], b.spill_ids[b.spill_ids >= 0]]
+    )
+    np.testing.assert_array_equal(np.sort(kept), np.arange(len(items)))
+    # and the spilled overflow stays searchable: full probe == exact
+    exact_d, exact_i = (
+        NearestNeighbors().setK(5).fit(items).kneighbors(items[:32])
+    )
+    ann = (
+        ApproximateNearestNeighbors().setK(5).setNlist(nlist)
+        .setNprobe(nlist).setSeed(0).fit(items)
+    )
+    d, i = ann.kneighbors(items[:32])
+    np.testing.assert_array_equal(i, exact_i)
+
+
+@pytest.mark.parametrize(
+    "policy,tol",
+    [
+        # the tolerances ops/ivf.py documents for unit-scale data
+        ("bf16_f32acc", 1e-2),
+        ("int8_dist", 5e-2),
+    ],
+)
+def test_quantized_full_probe_parity(policy, tol):
+    """nprobe == nlist under the quantized scan variants: distances agree
+    with the f32 kernel within the documented relative tolerance, and the
+    neighbor sets stay essentially exact on separable data."""
+    from spark_rapids_ml_tpu.ops import ivf as IVF
+
+    rng = np.random.default_rng(11)
+    items = rng.normal(size=(2000, 16)).astype(np.float32)
+    queries = items[:64]
+    k, nlist = 8, 16
+    ann = (
+        ApproximateNearestNeighbors().setK(k).setNlist(nlist)
+        .setNprobe(nlist).setSeed(3).fit(items)
+    )
+    d_f, i_f = IVF.ivf_search(
+        queries, ann.centroids, ann.bucketItems, ann.bucketIds, k, nlist,
+        spill_items=ann.spillItems, spill_ids=ann.spillIds,
+    )
+    d_q, i_q = IVF.ivf_search(
+        queries, ann.centroids, ann.bucketItems, ann.bucketIds, k, nlist,
+        spill_items=ann.spillItems, spill_ids=ann.spillIds, policy=policy,
+    )
+    d_f, d_q = np.asarray(d_f), np.asarray(d_q)
+    scale = np.abs(d_f).max()
+    np.testing.assert_allclose(d_q, d_f, rtol=tol, atol=tol * scale)
+    overlap = np.mean(
+        [len(set(a.tolist()) & set(b.tolist())) / k
+         for a, b in zip(np.asarray(i_f), np.asarray(i_q))]
+    )
+    assert overlap >= 0.95, overlap
+
+
+def test_recall_monotone_in_nprobe(clustered):
+    """recall@10 is non-decreasing in nprobe: the probe set at nprobe+1 is
+    a strict superset of the one at nprobe (same coarse ranking), so the
+    merged top-k can only improve."""
+    items, queries = clustered
+    k = 10
+    _, exact_i = NearestNeighbors().setK(k).fit(items).kneighbors(queries)
+    ann = (
+        ApproximateNearestNeighbors().setK(k).setNlist(25).setNprobe(1)
+        .setSeed(1).fit(items)
+    )
+    recalls = []
+    for nprobe in (1, 2, 4, 8, 16, 25):
+        ann._set(nprobe=nprobe)
+        _, i = ann.kneighbors(queries)
+        recalls.append(np.mean(
+            [len(set(a) & set(b)) / k for a, b in zip(i, exact_i)]
+        ))
+    assert all(b >= a for a, b in zip(recalls, recalls[1:])), recalls
+    assert recalls[-1] == 1.0, recalls
+
+
 def test_id_col_and_validation(clustered):
     pd = pytest.importorskip("pandas")
     items, queries = clustered

@@ -1,7 +1,8 @@
 """The health daemon's opportunistic harvest glue (tools/healthd.py).
 
-The harvest path (ported from the retired tools/transport_monitor_r5.py,
-now a shim) only executes when the accelerator transport heals — which may
+The harvest path (ported from the retired-and-deleted
+tools/transport_monitor_r5.py) only executes when the accelerator
+transport heals — which may
 never happen in a round. These tests drive the glue with a stubbed bench
 runner so the file contracts (drift log lines, the stamped
 BENCH_OPPORTUNISTIC payload bench.py's fallback consumes, the re-wedge
@@ -126,8 +127,9 @@ class TestExitCodes:
         assert monitor._exit_code(breached, strict=True) == 1
 
 
-def test_transport_monitor_shim_forwards(tmp_path):
-    """The retired entry point must still exist and exec healthd."""
-    src = (_TOOLS / "transport_monitor_r5.py").read_text()
-    assert "healthd.py" in src
-    assert "runpy" in src
+def test_transport_monitor_shim_is_retired():
+    """The deprecation shim had one release of grace and is now deleted;
+    only healthd remains. (Resurrecting the old entry point would hide
+    the migration from anyone still scripting against it.)"""
+    assert not (_TOOLS / "transport_monitor_r5.py").exists()
+    assert (_TOOLS / "healthd.py").exists()

@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""Render ANN vector-search evidence: recall curve, fill skew, speedup.
+
+Usage::
+
+    python tools/ann_report.py /path/to/perf.jsonl [--last N] [--strict]
+
+Reads JSONL (or a single JSON document) and renders every record that
+carries ANN evidence — either a perf-ledger entry whose ``ann`` key holds
+the blob ``bench.py --smoke`` embeds, or a bare blob written directly.
+For each:
+
+- the index geometry and build line (rows, nlist, streamed build rate);
+- the bucket-fill distribution vs the packed cap — the cap is the bytes
+  EVERY probe gathers, so a skewed tail (p99 far above p50) means most
+  probes pay for the fattest cells;
+- the headline operating point: serving-native q/s, the exact-KNN q/s
+  measured on the same corpus/batch, their ratio (the "what did the
+  index buy" number), and recall@k vs the exact oracle;
+- the recall-vs-nprobe operating curve — what the next rung of probe
+  cost would buy;
+- anomaly checks:
+
+  - ``probe-skew`` — bucket-fill p99 exceeds twice the median: the
+    quantizer left merged or starved cells, the percentile cap is paying
+    for the fat tail, and every probe's gather is correspondingly wider.
+    The streamed build's between-pass rebalance (empty-cell reseeding +
+    overfull splits) should prevent this; a skewed corpus that defeats
+    it wants a larger ``TPU_ML_ANN_SAMPLE_ROWS`` or more ``maxIter``.
+  - ``recall-cliff`` — recall at the registered nprobe sits more than
+    0.05 below what the sweep reaches at higher nprobe: the operating
+    point is under the cliff, and one more probe rung would buy real
+    recall (raise ``nprobe`` at registration).
+  - ``recall-not-monotone`` — the sweep DECREASES as nprobe grows,
+    which a correct top-k merge cannot do: the scan or merge kernel is
+    broken, not the tuning.
+  - ``recall-below-bar`` — recall@k at the operating point is under
+    0.95, the acceptance floor the smoke bench gates on.
+  - ``index-no-speedup`` — ann q/s is under 100x the exact baseline:
+    the index is not buying its complexity on this geometry.
+  - ``query-path-recompile`` — nonzero backend compiles in the timed
+    query window: a query landed outside the AOT (bucket, nprobe)
+    ladder and paid a synchronous XLA compile on the serve path.
+  - ``spill-heavy`` — more than 5% of the corpus overflowed into the
+    exact-scan spill list every query must cross; the percentile cap
+    (``TPU_ML_ANN_CAP_PERCENTILE``) is mis-sized for the skew.
+
+Exit status: 0 normally; with ``--strict``, 2 when any anomaly fired OR
+any record had to be skipped (CI gate). Stdlib-only — renders on hosts
+without jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+RECALL_BAR = 0.95
+RATIO_BAR = 100.0
+CLIFF_GAP = 0.05
+SKEW_FACTOR = 2.0
+SPILL_FRACTION_BAR = 0.05
+
+
+def _table(rows: list[list[str]], header: list[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    def line(cells):
+        return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths)).rstrip()
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([line(header), sep] + [line(r) for r in rows])
+
+
+def extract_evidence(rec: dict) -> dict | None:
+    """Pull the ANN blob out of a record, whatever wrapper it arrived in:
+    a perf-ledger entry (``ann`` key), or the bare blob."""
+    if isinstance(rec.get("ann"), dict):
+        return rec["ann"]
+    if rec.get("type") == "ann_evidence" or "ann_recall_at_10" in rec:
+        return rec
+    return None
+
+
+def check_anomalies(ev: dict) -> list[str]:
+    out: list[str] = []
+    fill = ev.get("bucket_fill") or {}
+    p50, p99 = fill.get("p50", 0) or 0, fill.get("p99", 0) or 0
+    if p50 and p99 > SKEW_FACTOR * p50:
+        out.append(
+            f"probe-skew: bucket-fill p99 ({p99:g}) is more than "
+            f"{SKEW_FACTOR:g}x the median ({p50:g}) — merged or starved "
+            "quantizer cells are inflating the packed cap, and every "
+            "probe's gather pays for the fat tail; raise "
+            "TPU_ML_ANN_SAMPLE_ROWS or maxIter so the between-pass "
+            "rebalance can level the cells"
+        )
+    sweep = ev.get("recall_vs_nprobe") or []
+    recalls = [s.get("recall_at_10", 0.0) for s in sweep]
+    operating = ev.get("ann_recall_at_10")
+    if operating is not None and recalls:
+        best = max(recalls)
+        if best - operating > CLIFF_GAP:
+            at = next(
+                (s["nprobe"] for s in sweep
+                 if s.get("recall_at_10", 0.0) >= best - 1e-9),
+                "?",
+            )
+            out.append(
+                f"recall-cliff: recall at the registered nprobe="
+                f"{ev.get('nprobe', '?')} is {operating:.4f} but the sweep "
+                f"reaches {best:.4f} at nprobe={at} — the operating point "
+                "sits under the cliff; re-register with a higher nprobe"
+            )
+    drops = [
+        (sweep[i - 1], sweep[i])
+        for i in range(1, len(sweep))
+        if recalls[i] < recalls[i - 1] - 1e-6
+    ]
+    if drops:
+        a, b = drops[0]
+        out.append(
+            f"recall-not-monotone: recall fell from "
+            f"{a['recall_at_10']:.4f} at nprobe={a['nprobe']} to "
+            f"{b['recall_at_10']:.4f} at nprobe={b['nprobe']} — widening "
+            "the probe set can only add candidates to a correct top-k "
+            "merge, so the scan/merge kernel is broken"
+        )
+    if operating is not None and operating < RECALL_BAR:
+        out.append(
+            f"recall-below-bar: recall@{ev.get('k', '?')} {operating:.4f} "
+            f"is under the {RECALL_BAR} acceptance floor"
+        )
+    ratio = ev.get("qps_ratio")
+    if ratio is not None and ratio < RATIO_BAR:
+        out.append(
+            f"index-no-speedup: ann q/s is only {ratio:g}x the exact "
+            f"brute-force baseline (floor {RATIO_BAR:g}x) — the index is "
+            "not buying its complexity on this geometry"
+        )
+    recompiles = ev.get("ann_recompiles_after_warmup", 0) or 0
+    if recompiles:
+        out.append(
+            f"query-path-recompile: {recompiles:g} backend compile(s) in "
+            "the timed query window — a query landed outside the AOT "
+            "(bucket, nprobe) ladder and paid a synchronous XLA compile "
+            "on the serve path"
+        )
+    spill = ev.get("spill_fraction", 0.0) or 0.0
+    if spill > SPILL_FRACTION_BAR:
+        out.append(
+            f"spill-heavy: {spill:.1%} of the corpus lives in the exact-"
+            "scan spill list every query must cross (floor "
+            f"{SPILL_FRACTION_BAR:.0%}); TPU_ML_ANN_CAP_PERCENTILE is "
+            "mis-sized for this skew"
+        )
+    return out
+
+
+def render_record(rec: dict, out=sys.stdout) -> list[str] | None:
+    """Render one record's ANN evidence; returns its anomaly list, or
+    None when the record carries none."""
+    ev = extract_evidence(rec)
+    if ev is None:
+        return None
+    tag = rec.get("bench") or rec.get("name") or "ann"
+    when = rec.get("timestamp") or rec.get("time") or ""
+    head = f"\n=== {tag} ann index"
+    if when:
+        head += f" @ {when}"
+    print(head + " ===", file=out)
+
+    print(
+        f"geometry: {ev.get('rows', 0):g} rows x "
+        f"{ev.get('n_features', 0):g} features, nlist="
+        f"{ev.get('nlist', 0):g}, nprobe={ev.get('nprobe', 0):g}, "
+        f"k={ev.get('k', 0):g}",
+        file=out,
+    )
+    if ev.get("build_seconds"):
+        print(
+            f"streamed build: {ev['build_seconds']:g}s "
+            f"({ev.get('build_rows_per_s', 0):g} rows/s, corpus never "
+            "fully resident)",
+            file=out,
+        )
+    fill = ev.get("bucket_fill") or {}
+    if fill:
+        print(
+            f"bucket fill vs cap {ev.get('bucket_cap', 0):g}: mean "
+            f"{fill.get('mean', 0):g}, p50 {fill.get('p50', 0):g}, p99 "
+            f"{fill.get('p99', 0):g}, max {fill.get('max', 0):g}; spill "
+            f"{ev.get('spill_rows', 0):g} row(s) "
+            f"({ev.get('spill_fraction', 0.0):.2%})",
+            file=out,
+        )
+    if ev.get("ann_qps") is not None:
+        line = (
+            f"throughput: {ev['ann_qps']:g} q/s served vs "
+            f"{ev.get('knn_qps', 0):g} q/s exact"
+        )
+        if ev.get("qps_ratio") is not None:
+            line += f" ({ev['qps_ratio']:g}x)"
+        line += (
+            f", recall@{ev.get('k', 0):g} "
+            f"{ev.get('ann_recall_at_10', 0.0):.4f}"
+        )
+        print(line, file=out)
+    sweep = ev.get("recall_vs_nprobe") or []
+    if sweep:
+        reg = ev.get("nprobe")
+        rows = [
+            [
+                f"{s.get('nprobe', 0):g}"
+                + (" *" if s.get("nprobe") == reg else ""),
+                f"{s.get('recall_at_10', 0.0):.4f}",
+            ]
+            for s in sweep
+        ]
+        print(_table(rows, ["nprobe", "recall@10"]), file=out)
+        if reg is not None:
+            print("  (* = registered operating point)", file=out)
+
+    anomalies = check_anomalies(ev)
+    for a in anomalies:
+        print(f"  !! {a}", file=out)
+    if not anomalies:
+        print("  anomaly checks: ok", file=out)
+    return anomalies
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render spark_rapids_ml_tpu ANN index evidence"
+    )
+    ap.add_argument(
+        "path",
+        help="perf-ledger JSONL (bench.py --smoke) or bare ANN blob JSON",
+    )
+    ap.add_argument(
+        "--last", type=int, default=0, metavar="N",
+        help="only render the last N ANN records",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="exit 2 when any anomaly check fires or a record is skipped",
+    )
+    args = ap.parse_args(argv)
+
+    records = []
+    skipped = 0
+    try:
+        with open(args.path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            print("# skipping corrupt line", file=sys.stderr)
+            skipped += 1
+            continue
+        if isinstance(rec, dict) and extract_evidence(rec) is not None:
+            records.append(rec)
+    if not records:
+        print(f"no ann evidence in {args.path}", file=sys.stderr)
+        return 1
+    if args.last > 0:
+        records = records[-args.last:]
+
+    print(f"{len(records)} ann record(s) from {args.path}")
+    any_anomaly = False
+    for i, rec in enumerate(records):
+        try:
+            anomalies = render_record(rec)
+        except Exception as e:  # noqa: BLE001 — a bad record must not
+            # hide the rest of the file
+            print(
+                f"# skipping unrenderable record {i} "
+                f"({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
+            skipped += 1
+            continue
+        if anomalies:
+            any_anomaly = True
+    if skipped:
+        print(f"# {skipped} record(s) skipped", file=sys.stderr)
+    return 2 if (args.strict and (any_anomaly or skipped)) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

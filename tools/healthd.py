@@ -1,7 +1,7 @@
 """Live health daemon: probes, SLOs and the HTTP exporter from one CLI.
 
-Successor to ``tools/transport_monitor_r5.py`` (now a deprecation shim
-that execs this file). The old monitor hand-rolled one concern — a
+Successor to the retired ``tools/transport_monitor_r5.py``. The old
+monitor hand-rolled one concern — a
 round-long transport probe loop with an opportunistic bench harvest; this
 CLI drives the framework's own :class:`telemetry.health.HealthMonitor`
 (device HBM watermarks, bounded transport probes, stream/worker liveness,
@@ -89,7 +89,7 @@ def append(path: str, record: dict) -> None:
         os.fsync(f.fileno())
 
 
-# -- opportunistic bench harvest (ported from transport_monitor_r5) ----------
+# -- opportunistic bench harvest (ported from the retired r5 monitor) --------
 
 
 def run_bench(run_idx: int) -> dict:
