@@ -532,6 +532,19 @@ def main() -> None:
         print(f"# serving bench skipped: {e!r}", file=sys.stderr)
         serving_evidence = None
 
+    # --- multi-process serve fleet proof (this PR) ------------------------
+    # 2 supervised replicas behind the consistent-hash router, loadgen on
+    # both wires, a rolling drain/restart mid-window with zero failed
+    # requests and a cache-warm respawn; hard contract in --smoke,
+    # guarded on-chip like its siblings
+    try:
+        fleet_evidence = _bench_fleet()
+    except Exception as e:
+        if SMOKE:
+            raise
+        print(f"# fleet bench skipped: {e!r}", file=sys.stderr)
+        fleet_evidence = None
+
     # --- ANN vector-search proof (this PR) --------------------------------
     # streamed IVF build → "ann" servable family → recall@10 and q/s vs
     # the exact-KNN oracle stamped on the same corpus; hard contract in
@@ -646,6 +659,10 @@ def main() -> None:
                 # tools/serve_report.py; only its three headline numbers
                 # enter the sentinel as extra_metrics below
                 "serving": serving_evidence,
+                # fleet evidence rides whole for tools/serve_report.py;
+                # its headline p99/qps/hedge numbers enter the sentinel
+                # as extra_metrics below
+                "fleet": fleet_evidence,
                 # ann evidence likewise rides whole for tools/ann_report.py
                 # (recall-vs-nprobe curve, bucket fill skew, spill); its
                 # three headline numbers enter the sentinel below
@@ -749,8 +766,51 @@ def main() -> None:
                             "note": "backend compiles in the measured "
                             "window; the warm-path contract pins this to 0",
                         },
+                        {
+                            "metric": "serve_hedges",
+                            "value": serving_evidence.get("hedges", 0) or 0,
+                            "unit": "count",
+                            "note": "tail-aware hedged serve dispatches "
+                            "issued in the measured window (second-device "
+                            "re-issue past the hedge threshold; first "
+                            "result wins)",
+                        },
                     ]
                     if serving_evidence is not None
+                    else []
+                )
+                + (
+                    [
+                        {
+                            "metric": "fleet_p99_ms",
+                            "value": fleet_evidence["fleet_p99_ms"],
+                            "unit": "ms",
+                            "note": "fleet-wide p99 through the router "
+                            "(mixed wires) with a rolling replica "
+                            "restart mid-window",
+                            **(
+                                {
+                                    "ceiling": fleet_evidence[
+                                        "fleet_p99_gate_ms"
+                                    ]
+                                }
+                                if fleet_evidence.get("fleet_p99_gate_ms")
+                                else {}
+                            ),
+                        },
+                        {
+                            "metric": "fleet_qps",
+                            "value": fleet_evidence["fleet_qps"],
+                            "unit": "queries/s",
+                            "note": (
+                                "closed-loop q/s through the "
+                                f"{fleet_evidence['replicas']}-replica "
+                                "router; qps_ratio_vs_single "
+                                f"{fleet_evidence['qps_ratio_vs_single']}"
+                            ),
+                        },
+                    ]
+                    if fleet_evidence is not None
                     else []
                 )
                 + (
@@ -1332,6 +1392,163 @@ def _bench_serving() -> dict:
         return evidence
     finally:
         serve_server.stop_serving(stop_monitor=False)
+
+
+def _bench_fleet() -> dict:
+    """Multi-process serve-fleet proof: spawn a 2-replica fleet behind the
+    consistent-hash router, drive it with ``tools/serve_loadgen.py``'s
+    closed-loop generator on both wires, and stamp fleet-wide p99 and q/s
+    on the ledger (``fleet_p99_ms`` carries the same absolute
+    ``TPU_ML_SERVE_P99_GATE_MS`` ceiling as the single-process
+    ``serve_p99_ms``). The same window also proves the operational story:
+
+      * a single-replica baseline is measured first (loadgen straight at
+        replica 0's socket) so the stamped ``qps_ratio`` is
+        fleet-vs-one-server on identical traffic — on an N-chip host this
+        is the scale-out number; on a 1-core CI host it documents the
+        host ceiling rather than replica scaling,
+      * mid-window, replica 1 takes a rolling drain/restart under live
+        load — ZERO failed requests is a hard contract (the router walks
+        the ring past the draining replica; the respawn re-admits on
+        READY),
+      * the respawned replica's shutdown report must show
+        ``cache_misses == 0``: it re-AOT'd entirely from the shared
+        persistent compile cache (zero fresh XLA compiles after restart).
+
+    Hard contract in --smoke, guarded on-chip like its siblings."""
+    import tempfile
+    import threading
+
+    from spark_rapids_ml_tpu import PCA
+    from spark_rapids_ml_tpu.models.linear import LinearRegression
+    from spark_rapids_ml_tpu.serving import fleet as serve_fleet
+    from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+    from tools.serve_loadgen import run_load
+
+    rng = np.random.default_rng(29)
+    n = 16
+    xs = rng.normal(size=(256, n))
+    ys = xs @ rng.normal(size=n) + 0.25
+    models = {
+        "fleet_pca": PCA().setInputCol("features").setK(4).fit(xs),
+        "fleet_linear": LinearRegression().fit((xs, ys)),
+    }
+
+    replicas = 2
+    connections = 64 if SMOKE else 500
+    duration = 2.0 if SMOKE else 5.0
+    cache_dir = os.path.join(
+        tempfile.gettempdir(), "tpu-ml-fleet-bench-cache"
+    )
+    snap0 = REGISTRY.snapshot()
+    fleet = serve_fleet.ServeFleet(
+        models,
+        replicas=replicas,
+        bucket_list=(8, 16),
+        extra_env={knobs.SERVE_COMPILE_CACHE_DIR.name: cache_dir},
+    ).start()
+    restarted_worker = None
+    try:
+        # single-replica baseline: identical closed-loop traffic straight
+        # at replica 0 (no router), the denominator of qps_ratio
+        single = run_load(
+            fleet.replica_socket(0), "fleet_linear",
+            connections=connections, duration_s=duration,
+            wire="fast", rows=4, cols=n,
+        )
+
+        # fleet window: same traffic through the router on both wires,
+        # with a rolling restart of replica 1 landing mid-window
+        fleet_result: dict = {}
+
+        def drive():
+            fleet_result.update(run_load(
+                fleet.router_path, "fleet_linear",
+                connections=connections, duration_s=duration,
+                wire="mixed", rows=4, cols=n,
+            ))
+
+        loader = threading.Thread(target=drive)
+        loader.start()
+        time.sleep(duration / 3.0)
+        restart_ok = fleet.restart_replica(1)
+        loader.join(timeout=duration * 10 + 60)
+        if loader.is_alive():
+            raise RuntimeError("fleet loadgen wedged past its window")
+        restarted_worker = fleet._supervisor._slots[1].worker
+
+        if not restart_ok:
+            raise SystemExit(
+                "fleet rolling restart failed: the respawned replica "
+                "never reported READY"
+            )
+        if fleet_result.get("failures", 1) or not fleet_result.get(
+            "requests"
+        ):
+            raise SystemExit(
+                "fleet contract violated: "
+                f"{fleet_result.get('failures')} failed request(s) "
+                f"across {fleet_result.get('requests')} during the "
+                "rolling-restart window — drain/reroute must make a "
+                "replica restart invisible to clients"
+            )
+        stats = fleet.stats()
+    finally:
+        fleet.stop()
+
+    # the respawned replica's shutdown report: cache_misses == 0 means it
+    # re-AOT'd entirely from the shared persistent cache
+    respawn_misses = (
+        restarted_worker.cache_misses
+        if restarted_worker is not None
+        else None
+    )
+    if respawn_misses:
+        raise SystemExit(
+            f"fleet warm-respawn contract violated: {respawn_misses} "
+            "compile-cache miss(es) on the restarted replica — the "
+            "respawn recompiled instead of loading the shared AOT cache"
+        )
+
+    window = REGISTRY.snapshot().delta(snap0)
+    hits = window.counter("serve.route_hits")
+    misses = window.counter("serve.route_misses")
+    gate_raw = os.environ.get(knobs.SERVE_P99_GATE_MS.name, "").strip()
+    return {
+        "replicas": replicas,
+        "connections": connections,
+        "duration_s": duration,
+        "placement": stats["placement"],
+        "single_replica": single,
+        "fleet": fleet_result,
+        "fleet_qps": fleet_result["qps"],
+        "fleet_p50_ms": fleet_result["p50_ms"],
+        "fleet_p99_ms": fleet_result["p99_ms"],
+        "fleet_p99_gate_ms": float(gate_raw) if gate_raw else None,
+        "qps_ratio_vs_single": (
+            round(fleet_result["qps"] / single["qps"], 3)
+            if single["qps"]
+            else None
+        ),
+        "routing": {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / (hits + misses), 4)
+            if (hits + misses)
+            else None,
+        },
+        "rolling_restart": {
+            "ok": True,
+            "drain_events": window.counter("serve.drain_events"),
+            "replica_restarts": window.counter("serve.replica_restarts"),
+            "respawn_cache_hits": restarted_worker.cache_hits
+            if restarted_worker is not None
+            else None,
+            "respawn_cache_misses": respawn_misses,
+            "failed_requests": fleet_result["failures"],
+        },
+        "served_per_replica": stats["served_per_replica"],
+    }
 
 
 def _bench_ann() -> dict:

@@ -76,6 +76,26 @@ def hedge_config() -> tuple[float, float]:
     )
 
 
+def hedge_threshold_s(observed_s: float, *, floor_s: float | None = None):
+    """Seconds a dispatch may run before a hedge is issued, or ``None``
+    when hedging is off.
+
+    One discipline for every hedger in the repo: the threshold is
+    ``max(floor, TPU_ML_HEDGE_FACTOR x observed)``, where ``observed`` is
+    the caller's running estimate of a healthy attempt (partition EWMA for
+    localspark, device-dispatch EWMA for the serve batcher). ``floor_s``
+    defaults to the stage-scale ``TPU_ML_HEDGE_FLOOR_S``; latency-scale
+    callers pass their own floor (the serve batcher passes
+    ``TPU_ML_SERVE_HEDGE_FLOOR_US``). No estimate yet (``observed <= 0``)
+    or ``TPU_ML_HEDGE_FACTOR=0`` means no hedge — never hedge blind.
+    """
+    factor, default_floor = hedge_config()
+    if factor <= 0.0 or observed_s <= 0.0:
+        return None
+    return max(default_floor if floor_s is None else floor_s,
+               factor * observed_s)
+
+
 @dataclass
 class SlotLease:
     """The supervised state of one worker slot."""

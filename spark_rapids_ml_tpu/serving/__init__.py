@@ -25,6 +25,12 @@ of the model lifecycle — low-latency scoring of already-fitted models:
 - :mod:`.hbm` — the multi-model HBM fleet manager: resident param byte
   accounting against the live watermark, LRU weight paging
   (``serve.page_in``/``serve.page_out``), SLO-burn load shedding.
+- :mod:`.fastlane` — the JSON-free dispatch lane: magic-framed binary
+  wire straight from socket to batcher, pinned response-buffer pool, and
+  the counted JSON codec that proves the hot path stays dict-free.
+- :mod:`.fleet` — multi-process scale-out: N supervised replica servers
+  with per-device affinity behind one consistent-hash router, rolling
+  drain/restart with zero failed requests and zero warm-respawn compiles.
 
 Submodules are loaded lazily: ``buckets`` is importable without jax, and
 tooling that only wants the ladder math never pays the model-layer import.
@@ -34,7 +40,10 @@ from __future__ import annotations
 
 import importlib
 
-_SUBMODULES = ("buckets", "registry", "batcher", "server", "client", "hbm")
+_SUBMODULES = (
+    "buckets", "registry", "batcher", "server", "client", "hbm",
+    "fastlane", "fleet",
+)
 
 _LAZY_ATTRS = {
     # buckets
@@ -64,6 +73,14 @@ _LAZY_ATTRS = {
     "HbmFleetManager": "hbm",
     "ServeShed": "hbm",
     "get_fleet": "hbm",
+    # fastlane
+    "FastlaneError": "fastlane",
+    "ResponseBufferPool": "fastlane",
+    "RESPONSE_POOL": "fastlane",
+    # fleet
+    "ServeFleet": "fleet",
+    "HashRing": "fleet",
+    "plan_placement": "fleet",
 }
 
 __all__ = list(_SUBMODULES) + sorted(_LAZY_ATTRS)

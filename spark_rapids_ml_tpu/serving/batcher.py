@@ -29,8 +29,21 @@ Batching is *continuous*, not windowed-only:
 The latency budget is explicit: worst-case added latency is the window
 ceiling, and every request's actual queue time is booked on the
 ``serve.queue_delay_seconds`` histogram (tools/serve_report.py renders the
-percentiles). A request alone in its window costs only the window; the
-window only ever *saves* wall clock once two requests share a dispatch.
+percentiles) *and* on the µs-resolution ``serve.queue_delay_us`` series —
+the seconds histogram's log buckets flatten exactly where the sub-ms tail
+hunt happens, so the µs series is the one the tail is read from (the
+seconds series stays for ledger continuity). A request alone in its window
+costs only the window; the window only ever *saves* wall clock once two
+requests share a dispatch.
+
+Dispatch is tail-aware: when a device dispatch overruns
+``max(TPU_ML_SERVE_HEDGE_FLOOR_US, TPU_ML_HEDGE_FACTOR x EWMA)`` the batch
+is re-issued (``serve.hedges``) under the PR 9 hedging discipline — first
+result wins (``serve.hedge_wins``), the loser's telemetry is discarded the
+same way a hedged partition's trailer is dropped in localspark
+(``defer_trailer``): only the winner's device time feeds the adaptive
+window's EWMA. ``TPU_ML_HEDGE_FACTOR=0`` disables serve hedging exactly as
+it disables stage hedging.
 
 Ingest is dtype-preserving: float32 payloads (the binary wire format) stay
 float32 end to end — no ``float64`` host round-trip — and float64 payloads
@@ -42,6 +55,7 @@ with an error that documents them.
 
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import os
 import threading
@@ -49,6 +63,7 @@ import time
 
 import numpy as np
 
+from spark_rapids_ml_tpu.resilience import supervisor
 from spark_rapids_ml_tpu.serving import buckets, hbm
 from spark_rapids_ml_tpu.serving.registry import (
     ACCEPTED_DTYPES,
@@ -63,6 +78,7 @@ logger = logging.getLogger("spark_rapids_ml_tpu.serving")
 
 SERVE_MAX_DELAY_US_VAR = knobs.SERVE_MAX_DELAY_US.name
 SERVE_ADAPTIVE_WINDOW_VAR = knobs.SERVE_ADAPTIVE_WINDOW.name
+SERVE_HEDGE_FLOOR_US_VAR = knobs.SERVE_HEDGE_FLOOR_US.name
 
 __all__ = [
     "ACCEPTED_DTYPES",
@@ -70,6 +86,7 @@ __all__ = [
     "ServeFuture",
     "adaptive_window_enabled",
     "coalesce_window_s",
+    "serve_hedge_floor_s",
     "validate_request",
 ]
 
@@ -85,6 +102,22 @@ def coalesce_window_s() -> float:
         us = float(raw) if raw else float(knobs.SERVE_MAX_DELAY_US.default)
     except ValueError:
         us = float(knobs.SERVE_MAX_DELAY_US.default)
+    return max(0.0, us) / 1e6
+
+
+def serve_hedge_floor_s() -> float:
+    """The serve-scale hedge floor (``TPU_ML_SERVE_HEDGE_FLOOR_US``) in
+    seconds — the stage-scale ``TPU_ML_HEDGE_FLOOR_S`` default (1 s) is
+    three orders of magnitude above the serve SLO, so serve hedging
+    carries its own floor."""
+    raw = os.environ.get(SERVE_HEDGE_FLOOR_US_VAR, "")
+    try:
+        us = (
+            float(raw) if raw
+            else float(knobs.SERVE_HEDGE_FLOOR_US.default)
+        )
+    except ValueError:
+        us = float(knobs.SERVE_HEDGE_FLOOR_US.default)
     return max(0.0, us) / 1e6
 
 
@@ -152,6 +185,9 @@ class MicroBatcher:
         self._device_ewma: dict[str, float] = {}
         self._thread: threading.Thread | None = None
         self._stopping = False
+        # lazily-built 2-worker pool for hedged dispatch (primary + one
+        # re-issue); joined in stop() so teardown leaves no stray threads
+        self._hedge_pool: concurrent.futures.ThreadPoolExecutor | None = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -175,7 +211,16 @@ class MicroBatcher:
             p.future.set_error(RuntimeError("micro-batcher stopped"))
         if self._thread is not None:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                logger.warning(
+                    "micro-batcher worker did not join within %.1fs", timeout
+                )
             self._thread = None
+        pool, self._hedge_pool = self._hedge_pool, None
+        if pool is not None:
+            # deterministic teardown: the hedge workers are joined here,
+            # not abandoned — the teardown-leak test counts threads
+            pool.shutdown(wait=True)
 
     # -- submission ---------------------------------------------------------
 
@@ -296,6 +341,65 @@ class MicroBatcher:
                 del self._groups[key]
         return joined
 
+    def _ensure_hedge_pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        if self._hedge_pool is None:
+            self._hedge_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="tpu-ml-serve-hedge"
+            )
+        return self._hedge_pool
+
+    def _device_dispatch(
+        self,
+        entry,
+        model: str,
+        padded: np.ndarray,
+        bucket: int,
+    ) -> tuple[np.ndarray, float]:
+        """One device dispatch under the hedging discipline; returns the
+        raw output and the *winner's* device seconds.
+
+        The threshold is ``max(TPU_ML_SERVE_HEDGE_FLOOR_US,
+        TPU_ML_HEDGE_FACTOR x device EWMA)`` — the same shape every hedger
+        in the repo uses (``supervisor.hedge_threshold_s``), with the floor
+        swapped from stage scale to serve scale. No EWMA yet (first
+        dispatch of a model) or factor 0 means no hedge: never hedge
+        blind. On overrun the batch is re-issued via the registry's hedge
+        path (second device when warm, same executable otherwise); first
+        result wins and fulfills the futures, and the loser's telemetry is
+        discarded exactly as a hedged partition's trailer is dropped under
+        ``defer_trailer`` — only the winner's timing feeds the EWMA.
+        """
+        threshold = supervisor.hedge_threshold_s(
+            self._device_ewma.get(model, 0.0), floor_s=serve_hedge_floor_s()
+        )
+
+        def timed(dispatch) -> tuple[np.ndarray, float]:
+            t = time.perf_counter()
+            out = dispatch(entry, padded, bucket)
+            return out, time.perf_counter() - t
+
+        if threshold is None:
+            return timed(self.registry.dispatch_padded)
+        pool = self._ensure_hedge_pool()
+        primary = pool.submit(timed, self.registry.dispatch_padded)
+        try:
+            return primary.result(timeout=threshold)
+        except concurrent.futures.TimeoutError:
+            pass
+        REGISTRY.counter_inc("serve.hedges", model=model)
+        hedge = pool.submit(timed, self.registry.hedge_dispatch_padded)
+        done, _ = concurrent.futures.wait(
+            {primary, hedge},
+            return_when=concurrent.futures.FIRST_COMPLETED,
+        )
+        winner = primary if primary in done else hedge
+        raw, dev_s = winner.result()
+        REGISTRY.counter_inc(
+            "serve.hedge_wins", model=model,
+            winner="primary" if winner is primary else "hedge",
+        )
+        return raw, dev_s
+
     def _dispatch(
         self, key: tuple[str, int], taken: list[_Pending], window_s: float
     ) -> None:
@@ -306,8 +410,15 @@ class MicroBatcher:
             bucket = buckets.serve_bucket(sum(p.rows for p in taken))
             self._late_join(key, taken, bucket)
             for p in taken:
+                delay_s = t0 - p.t_submit
                 REGISTRY.histogram_record(
-                    "serve.queue_delay_seconds", t0 - p.t_submit, model=model
+                    "serve.queue_delay_seconds", delay_s, model=model
+                )
+                # µs-resolution twin of the same measurement: the seconds
+                # histogram's log buckets flatten below ~1 ms, which is
+                # exactly where the serve tail lives
+                REGISTRY.histogram_record(
+                    "serve.queue_delay_us", delay_s * 1e6, model=model
                 )
             REGISTRY.histogram_record(
                 "serve.window_effective_seconds", window_s, model=model
@@ -327,9 +438,7 @@ class MicroBatcher:
                 "serve.bucket_hits", model=model, bucket=bucket
             )
             padded, _ = buckets.pad_to_bucket(combined, bucket)
-            t_dev = time.perf_counter()
-            raw = self.registry.dispatch_padded(entry, padded, bucket)
-            dev_s = time.perf_counter() - t_dev
+            raw, dev_s = self._device_dispatch(entry, model, padded, bucket)
             prev = self._device_ewma.get(model)
             self._device_ewma[model] = (
                 dev_s if prev is None else 0.5 * prev + 0.5 * dev_s

@@ -82,12 +82,20 @@ class ServeClient:
         REGISTRY.counter_inc(
             "serve.transport", transport="inproc", wire="array"
         )
-        REGISTRY.histogram_record("serve.latency", latency, model=model)
+        REGISTRY.histogram_record(
+            "serve.latency", latency, model=model,
+            transport="inproc", wire="array",
+        )
         return out
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop the private batcher, if one was started. The shared
-        front-end batcher is never stopped from here."""
+        front-end batcher is never stopped from here.
+
+        Teardown is deterministic: ``MicroBatcher.stop`` joins the worker
+        thread and the hedge pool before returning, so repeated
+        start/stop cycles leak neither threads nor socket files (the
+        teardown-leak regression test counts both)."""
         with self._lock:
             own, self._own = self._own, None
         if own is not None:

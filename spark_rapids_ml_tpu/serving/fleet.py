@@ -1,0 +1,816 @@
+"""Multi-process serve fleet: N replica servers behind one router.
+
+One serve process tops out on the host, not the device — the GIL
+serializes framing, and a single batcher thread owns every dispatch. The
+fleet is the scale-out axis: ``ServeFleet`` spawns N **replica**
+processes (each a full serve runtime: registry + AOT warmup + micro
+batcher + UDS listener) and fronts them with an in-process **router**
+that speaks the exact same UDS wire protocols the single server does —
+JSON, binary, and the fast lane — so clients need no fleet awareness.
+
+Design points, each riding machinery an earlier PR shipped:
+
+- **Replica supervision (PR 9).** Replicas are spawned through
+  ``resilience.supervisor.WorkerSupervisor`` — the same lease/breaker/
+  backoff discipline the fit-path worker pool uses. A crash-looping
+  replica trips its breaker instead of eating the fleet's wall clock;
+  ``TPU_ML_WORKER_SLOT`` stamps each replica's identity.
+
+- **Warm respawns (PR 13).** Every replica shares
+  ``TPU_ML_SERVE_COMPILE_CACHE_DIR``, so a respawned replica re-AOTs
+  from the persistent XLA cache — zero fresh compiles after a rolling
+  restart (asserted by test). Models travel to replicas as an
+  ``.npz`` + JSON spec (param arrays + family), reconstructed and
+  registered on the replica side.
+
+- **Consistent-hash routing.** ``HashRing`` maps ``(model, bucket)`` to
+  a preference order over replicas (md5, virtual nodes), so a given
+  request shape always lands on the same replica — its AOT executables
+  and HBM-resident weights stay hot. A request served by its home
+  replica books ``serve.route_hits``; one re-routed around a draining or
+  dead replica books ``serve.route_misses``.
+
+- **Rolling drain/restart.** ``restart_replica`` marks the slot
+  draining (the ring walks past it), waits for its in-flight count to
+  reach zero (bounded by ``TPU_ML_SERVE_DRAIN_TIMEOUT_S``), respawns it
+  through the supervisor, and re-admits it once it reports READY — under
+  live load, zero requests fail (``serve.drain_events``,
+  ``serve.replica_restarts``).
+
+- **Placement vs HBM (PR 13).** ``plan_placement`` checks the fleet's
+  per-replica param bytes against the HBM fleet manager's budget before
+  spawn; an over-budget plan is surfaced (the in-replica HBM manager
+  still pages, but the operator sees the pressure up front).
+
+The router is plain host orchestration — bytes in, bytes out; device
+work happens only inside replicas. Per-device affinity: each replica
+pins its default device to ``slot % device_count``, so an N-chip host
+runs N replicas with one chip each.
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import hashlib
+import json
+import logging
+import os
+import socket
+import socketserver
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from spark_rapids_ml_tpu.resilience.supervisor import WorkerSupervisor
+from spark_rapids_ml_tpu.serving import buckets, fastlane
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.utils import knobs
+
+logger = logging.getLogger("spark_rapids_ml_tpu.serving")
+
+SERVE_FLEET_REPLICAS_VAR = knobs.SERVE_FLEET_REPLICAS.name
+SERVE_FLEET_SOCKET_DIR_VAR = knobs.SERVE_FLEET_SOCKET_DIR.name
+SERVE_DRAIN_TIMEOUT_S_VAR = knobs.SERVE_DRAIN_TIMEOUT_S.name
+WORKER_SLOT_VAR = knobs.WORKER_SLOT.name
+
+_READY_SENTINEL = "READY"
+_COMPILES_SENTINEL = "COMPILES"
+_SPAWN_TIMEOUT_S = 120.0
+# spill threshold: how far past the least-loaded replica the home
+# replica's in-flight count may run before affinity yields to throughput
+_SPILL_IN_FLIGHT = 8
+
+
+def drain_timeout_s() -> float:
+    raw = os.environ.get(SERVE_DRAIN_TIMEOUT_S_VAR, "")
+    try:
+        return max(
+            0.0,
+            float(raw) if raw else float(knobs.SERVE_DRAIN_TIMEOUT_S.default),
+        )
+    except ValueError:
+        return float(knobs.SERVE_DRAIN_TIMEOUT_S.default)
+
+
+# -- model spec: how fitted models travel to replica processes ---------------
+
+
+def _model_arrays(model) -> tuple[str, dict[str, np.ndarray]]:
+    """(family, arrays) a replica needs to reconstruct ``model``."""
+    from spark_rapids_ml_tpu.models.linear import _GLMModel
+    from spark_rapids_ml_tpu.models.pca import PCAModel
+
+    if isinstance(model, PCAModel):
+        arrays = {"pc": model.pc, "explainedVariance": model.explainedVariance}
+        if model.mean is not None:
+            arrays["mean"] = model.mean
+            arrays["std"] = model.std
+        return "pca", arrays
+    if isinstance(model, _GLMModel) and model.coefficients is not None:
+        return "linear", {
+            "coefficients": model.coefficients,
+            "intercept": np.asarray([model.intercept]),
+        }
+    raise TypeError(
+        f"{type(model).__name__} has no fleet spec — the fleet ships pca "
+        "and linear-family servables (extend _model_arrays for new "
+        "families)"
+    )
+
+
+def _model_from_arrays(name: str, family: str, arrays: dict):
+    if family == "pca":
+        from spark_rapids_ml_tpu.models.pca import PCAModel
+
+        return PCAModel(
+            f"fleet-{name}",
+            arrays["pc"],
+            arrays["explainedVariance"],
+            arrays.get("mean"),
+            arrays.get("std"),
+        )
+    if family == "linear":
+        from spark_rapids_ml_tpu.models.linear import LinearRegressionModel
+
+        return LinearRegressionModel(
+            uid=f"fleet-{name}",
+            coefficients=arrays["coefficients"],
+            intercept=float(arrays["intercept"][0]),
+        )
+    raise TypeError(f"unknown fleet spec family {family!r}")
+
+
+def write_spec(path: str, models: dict[str, object]) -> dict[str, int]:
+    """Write the fleet model spec (one ``.npz`` + manifest); returns the
+    per-model param byte counts used by ``plan_placement``."""
+    blobs: dict[str, np.ndarray] = {}
+    manifest: dict[str, dict] = {}
+    param_bytes: dict[str, int] = {}
+    for name, model in sorted(models.items()):
+        family, arrays = _model_arrays(model)
+        manifest[name] = {"family": family, "arrays": sorted(arrays)}
+        param_bytes[name] = int(
+            sum(np.asarray(a).nbytes for a in arrays.values())
+        )
+        for field, arr in arrays.items():
+            blobs[f"{name}::{field}"] = np.asarray(arr)
+    np.savez(path, **blobs)
+    with open(path + ".json", "w", encoding="utf-8") as f:
+        json.dump(manifest, f)
+    return param_bytes
+
+
+def load_spec(path: str) -> dict[str, object]:
+    with open(path + ".json", encoding="utf-8") as f:
+        manifest = json.load(f)
+    out: dict[str, object] = {}
+    with np.load(path) as blobs:
+        for name, meta in manifest.items():
+            arrays = {
+                field: blobs[f"{name}::{field}"] for field in meta["arrays"]
+            }
+            out[name] = _model_from_arrays(name, meta["family"], arrays)
+    return out
+
+
+def plan_placement(
+    param_bytes: dict[str, int],
+    replicas: int,
+    *,
+    budget_bytes: int | None = None,
+) -> dict:
+    """Check full-replication placement against the HBM budget.
+
+    Routing is traffic placement, not weight placement: every replica
+    registers every model (so any replica can absorb a re-route), and the
+    per-replica HBM fleet manager pages cold weights within its budget.
+    This plan surfaces the resident pressure up front: per-replica param
+    bytes vs the budget the replicas will run under."""
+    from spark_rapids_ml_tpu.serving import hbm
+
+    if budget_bytes is None:
+        budget_bytes = hbm.budget_bytes()
+    total = int(sum(param_bytes.values()))
+    fits = budget_bytes is None or total <= budget_bytes
+    return {
+        "replicas": replicas,
+        "models": sorted(param_bytes),
+        "param_bytes_per_replica": total,
+        "budget_bytes": budget_bytes,
+        "fits": fits,
+    }
+
+
+# -- consistent-hash ring ----------------------------------------------------
+
+
+class HashRing:
+    """Consistent hash over replica slots, keyed by (model, bucket).
+
+    Virtual nodes flatten the load split; md5 keeps placement stable
+    across processes and runs (``hash()`` is salted per process). The
+    preference order lets the router walk past drained/dead replicas
+    deterministically — the same key always tries the same sequence."""
+
+    def __init__(self, slots: list[int], vnodes: int = 32):
+        points: list[tuple[int, int]] = []
+        for slot in slots:
+            for v in range(vnodes):
+                digest = hashlib.md5(
+                    f"replica-{slot}:vnode-{v}".encode()
+                ).digest()
+                points.append((int.from_bytes(digest[:8], "big"), slot))
+        points.sort()
+        self._points = points
+        self._hashes = [p[0] for p in points]
+        self.slots = sorted(set(slots))
+
+    @staticmethod
+    def key(model: str, bucket: int) -> str:
+        return f"{model}/{bucket}"
+
+    def preference(self, key: str) -> list[int]:
+        """Replica slots in routing-preference order for ``key`` (the
+        first entry is the home replica; later entries absorb re-routes)."""
+        if not self._points:
+            return []
+        h = int.from_bytes(
+            hashlib.md5(key.encode()).digest()[:8], "big"
+        )
+        start = bisect.bisect_right(self._hashes, h) % len(self._points)
+        seen: list[int] = []
+        for i in range(len(self._points)):
+            slot = self._points[(start + i) % len(self._points)][1]
+            if slot not in seen:
+                seen.append(slot)
+                if len(seen) == len(self.slots):
+                    break
+        return seen
+
+
+# -- replica process ---------------------------------------------------------
+
+
+class ReplicaProcess:
+    """One spawned replica server (the supervisor's worker contract:
+    ``dead``/``proc``/``close()``)."""
+
+    def __init__(
+        self,
+        slot: int,
+        spec_path: str,
+        socket_path: str,
+        bucket_list: tuple[int, ...],
+        extra_env: dict | None = None,
+    ):
+        self.slot = slot
+        self.socket_path = socket_path
+        cmd = [
+            sys.executable, "-m", "spark_rapids_ml_tpu.serving.fleet",
+            "--replica", "--spec", spec_path, "--socket", socket_path,
+            "--buckets", ",".join(str(b) for b in bucket_list),
+        ]
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        self.proc = subprocess.Popen(
+            cmd,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        self._ready = False
+        # filled by close() from the replica's shutdown report (the
+        # warm-respawn proof reads these; None = no report). cache_misses
+        # == 0 means every compile was a persistent-cache load.
+        self.compiles: int | None = None
+        self.cache_hits: int | None = None
+        self.cache_misses: int | None = None
+
+    @property
+    def dead(self) -> bool:
+        return self.proc.poll() is not None
+
+    def wait_ready(self, timeout: float = _SPAWN_TIMEOUT_S) -> bool:
+        """Block until the replica prints READY (registration + AOT warmup
+        done and the socket is listening) or dies."""
+        if self._ready:
+            return True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                return False  # died before READY
+            if line.strip().startswith(_READY_SENTINEL):
+                self._ready = True
+                return True
+        return False
+
+    def close(self) -> None:
+        """EOF on stdin is the shutdown sentinel; escalate if ignored."""
+        try:
+            if self.proc.stdin is not None:
+                self.proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=5.0)
+        try:
+            # the replica's shutdown report ("COMPILES <n>") trails READY
+            # on the same pipe; it is the evidence that a warm respawn
+            # re-AOT'd from the shared cache instead of recompiling
+            tail = self.proc.stdout.read() if self.proc.stdout else ""
+            for line in (tail or "").splitlines():
+                if line.startswith(_COMPILES_SENTINEL):
+                    parts = line.split()
+                    self.compiles = int(parts[1])
+                    self.cache_hits = int(parts[2])
+                    self.cache_misses = int(parts[3])
+        except (OSError, ValueError, IndexError):
+            pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+
+def _replica_main(argv: list[str]) -> int:
+    """Entry point of one replica process: load the spec, register every
+    model (AOT warmup against the shared compile cache), serve UDS until
+    stdin EOF."""
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spec", required=True)
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--buckets", default="")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # per-device affinity: replica i owns device i (mod device count), so
+    # an N-chip host runs N replicas with one chip each
+    slot = int(os.environ.get(WORKER_SLOT_VAR, "0") or 0)
+    devices = jax.devices()
+    if len(devices) > 1:
+        jax.config.update("jax_default_device", devices[slot % len(devices)])
+
+    from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
+    from spark_rapids_ml_tpu.serving.registry import get_registry
+    from spark_rapids_ml_tpu.serving.server import ServeUDSListener
+
+    bucket_list = tuple(
+        int(b) for b in args.buckets.split(",") if b.strip()
+    ) or None
+    registry = get_registry()
+    for name, model in load_spec(args.spec).items():
+        registry.register(name, model, bucket_list=bucket_list)
+    batcher = MicroBatcher(registry).start()
+    listener = ServeUDSListener(args.socket, batcher).start()
+    print(f"{_READY_SENTINEL} {args.socket}", flush=True)
+    try:
+        sys.stdin.read()  # blocks until the parent closes our stdin
+    except KeyboardInterrupt:
+        pass
+    finally:
+        listener.stop()
+        batcher.stop()
+        # shutdown report: this replica's compile traffic. A respawn
+        # warmed from the shared AOT cache reports cache_misses == 0 —
+        # every registration-time compile was a disk load, not fresh XLA
+        snap = REGISTRY.snapshot()
+        print(
+            f"{_COMPILES_SENTINEL} "
+            f"{int(snap.hist('compile.seconds').count)} "
+            f"{int(snap.counter('compile.cache_hits'))} "
+            f"{int(snap.counter('compile.cache_misses'))}",
+            flush=True,
+        )
+    return 0
+
+
+# -- router ------------------------------------------------------------------
+
+
+class _RouterHandler(socketserver.StreamRequestHandler):
+    """One client connection: read a frame, pick a replica by consistent
+    hash, forward the raw bytes, relay the raw response. Per-replica
+    upstream connections persist for the life of the client connection,
+    so a steady client pays connection setup once per replica."""
+
+    def setup(self):
+        super().setup()
+        self._upstream: dict[int, socket.socket] = {}
+
+    def finish(self):
+        for s in self._upstream.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        super().finish()
+
+    # frame IO ---------------------------------------------------------------
+
+    def _read_exact(self, rfile, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            chunk = rfile.read(n)
+            if not chunk:
+                raise EOFError("peer closed mid-frame")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _read_request(self) -> tuple[str, int, bytes] | None:
+        """Read one client frame; returns (model, rows, raw_frame) or None
+        on clean EOF. The frame is parsed only far enough to route."""
+        head = self.rfile.read(4)
+        if not head:
+            return None
+        if len(head) < 4:
+            raise EOFError("peer closed mid-frame")
+        if fastlane.is_fastlane_head(head):
+            # fast lane: fixed struct carries (name_len, rows, cols) — the
+            # router routes with zero JSON and zero dict churn, same as
+            # the replica will serve it
+            struct_raw = self._read_exact(self.rfile, fastlane.request_struct_size())
+            name_len, rows, cols = fastlane.peek_request(struct_raw)
+            name = self._read_exact(self.rfile, name_len)
+            payload = self._read_exact(self.rfile, rows * cols * 4)
+            return (
+                name.decode("utf-8"), rows,
+                b"".join((head, struct_raw, name, payload)),
+            )
+        header_raw = self._read_exact(self.rfile, int.from_bytes(head, "big"))
+        header = fastlane.json_loads(header_raw)
+        model = str(header.get("model", ""))
+        if header.get("wire") == "binary":
+            payload = self._read_exact(
+                self.rfile, int(header.get("payload_bytes", 0))
+            )
+            rows = int((header.get("shape") or [1])[0])
+        else:
+            payload = b""
+            rows = len(header.get("instances") or [None])
+        return model, rows, head + header_raw + payload
+
+    def _relay_response(self, rfile) -> bytes:
+        """Read one complete replica response frame, verbatim."""
+        head = self._read_exact(rfile, 4)
+        if fastlane.is_fastlane_head(head):
+            struct_raw = self._read_exact(
+                rfile, fastlane.response_struct_size()
+            )
+            payload_len = fastlane.peek_response_payload_len(struct_raw)
+            return head + struct_raw + self._read_exact(rfile, payload_len)
+        header_raw = self._read_exact(rfile, int.from_bytes(head, "big"))
+        header = fastlane.json_loads(header_raw)
+        payload = self._read_exact(rfile, int(header.get("payload_bytes", 0)))
+        return head + header_raw + payload
+
+    def _upstream_for(self, slot: int) -> socket.socket:
+        s = self._upstream.get(slot)
+        if s is None:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(self.server.fleet.replica_socket(slot))
+            self._upstream[slot] = s
+        return s
+
+    def _drop_upstream(self, slot: int, s: socket.socket) -> None:
+        self._upstream.pop(slot, None)
+        try:
+            s.close()
+        except OSError:
+            pass
+
+    def _forward(self, slot: int, frame: bytes) -> bytes:
+        cached = slot in self._upstream
+        s = self._upstream_for(slot)
+        try:
+            s.sendall(frame)
+            return self._relay_response(s.makefile("rb"))
+        except (OSError, EOFError):
+            self._drop_upstream(slot, s)
+            if not cached:
+                raise
+        # the cached upstream went stale between requests (the replica
+        # was rolling-restarted and its listener re-created); the frame
+        # is fully buffered and nothing has been relayed to the client,
+        # so one fresh-connection retry on the same slot is safe
+        s = self._upstream_for(slot)
+        try:
+            s.sendall(frame)
+            return self._relay_response(s.makefile("rb"))
+        except (OSError, EOFError):
+            self._drop_upstream(slot, s)
+            raise
+
+    def handle(self):
+        fleet: ServeFleet = self.server.fleet
+        try:
+            while True:
+                req = self._read_request()
+                if req is None:
+                    return
+                model, rows, frame = req
+                try:
+                    bucket = buckets.serve_bucket(max(1, rows))
+                except ValueError:
+                    bucket = buckets.max_batch_rows()
+                response = fleet.route(
+                    model, bucket, frame, self._forward
+                )
+                self.wfile.write(response)
+                self.wfile.flush()
+        except (EOFError, BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception:  # noqa: BLE001 - one bad conn must not kill the router
+            logger.exception("fleet router connection failed")
+
+
+class ServeFleet:
+    """N supervised replica processes behind one consistent-hash router."""
+
+    def __init__(
+        self,
+        models: dict[str, object],
+        *,
+        replicas: int | None = None,
+        socket_dir: str | None = None,
+        bucket_list: tuple[int, ...] = (),
+        extra_env: dict | None = None,
+    ):
+        if replicas is None:
+            raw = os.environ.get(SERVE_FLEET_REPLICAS_VAR, "")
+            replicas = int(raw) if raw.strip() else int(
+                knobs.SERVE_FLEET_REPLICAS.default
+            )
+        if replicas < 1:
+            raise ValueError("a serve fleet needs at least 1 replica")
+        self.replicas = replicas
+        self.bucket_list = tuple(bucket_list)
+        self._extra_env = dict(extra_env or {})
+        socket_dir = socket_dir or os.environ.get(
+            SERVE_FLEET_SOCKET_DIR_VAR, ""
+        )
+        if not socket_dir:
+            socket_dir = tempfile.mkdtemp(prefix="tpu-ml-fleet-")
+        self.socket_dir = socket_dir
+        os.makedirs(socket_dir, exist_ok=True)
+        self.spec_path = os.path.join(socket_dir, "fleet-spec.npz")
+        self.param_bytes = write_spec(self.spec_path, models)
+        self.placement = plan_placement(self.param_bytes, replicas)
+        if not self.placement["fits"]:
+            logger.warning(
+                "fleet placement exceeds the HBM budget (%d bytes/replica "
+                "vs %s) — replicas will page weights under pressure",
+                self.placement["param_bytes_per_replica"],
+                self.placement["budget_bytes"],
+            )
+        self.router_path = os.path.join(socket_dir, "router.sock")
+        self.ring = HashRing(list(range(replicas)))
+        self._supervisor = WorkerSupervisor(self._spawn, replicas)
+        self._state_lock = threading.Lock()
+        self._state_cond = threading.Condition(self._state_lock)
+        self._draining: set[int] = set()
+        self._in_flight: dict[int, int] = {i: 0 for i in range(replicas)}
+        self._served: dict[int, int] = {i: 0 for i in range(replicas)}
+        self._router: socketserver.ThreadingUnixStreamServer | None = None
+        self._router_thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _spawn(self, extra_env: dict) -> ReplicaProcess:
+        slot = int(extra_env.get(WORKER_SLOT_VAR, "0") or 0)
+        env = dict(self._extra_env)
+        env.update(extra_env)
+        return ReplicaProcess(
+            slot,
+            self.spec_path,
+            self.replica_socket(slot),
+            self.bucket_list,
+            extra_env=env,
+        )
+
+    def replica_socket(self, slot: int) -> str:
+        return os.path.join(self.socket_dir, f"replica-{slot}.sock")
+
+    def start(self, timeout: float = _SPAWN_TIMEOUT_S) -> "ServeFleet":
+        """Spawn every replica, wait until all report READY, then open the
+        router socket."""
+        self._supervisor.begin_stage()
+        for slot in range(self.replicas):
+            worker = self._supervisor.checkout(slot)
+            if worker is None or not worker.wait_ready(timeout):
+                raise RuntimeError(
+                    f"fleet replica {slot} failed to become ready"
+                    + self._replica_stderr(worker)
+                )
+            self._supervisor.report_success(slot)
+        if os.path.exists(self.router_path):
+            os.unlink(self.router_path)
+        self._router = socketserver.ThreadingUnixStreamServer(
+            self.router_path, _RouterHandler
+        )
+        self._router.daemon_threads = True
+        self._router.fleet = self
+        self._router_thread = threading.Thread(
+            target=self._router.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="tpu-ml-fleet-router",
+            daemon=True,
+        )
+        self._router_thread.start()
+        REGISTRY.gauge_set("serve.fleet_replicas", self.live_replicas())
+        return self
+
+    @staticmethod
+    def _replica_stderr(worker) -> str:
+        if worker is None or worker.proc.stderr is None:
+            return ""
+        try:
+            tail = worker.proc.stderr.read() or ""
+        except (OSError, ValueError):
+            return ""
+        return ("\n--- replica stderr ---\n" + tail[-2000:]) if tail else ""
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._router is not None:
+            self._router.shutdown()
+            self._router.server_close()
+            self._router = None
+        if self._router_thread is not None:
+            self._router_thread.join(timeout)
+            self._router_thread = None
+        try:
+            os.unlink(self.router_path)
+        except OSError:
+            pass
+        self._supervisor.close()
+        REGISTRY.gauge_set("serve.fleet_replicas", 0)
+
+    # -- routing ------------------------------------------------------------
+
+    def live_replicas(self) -> int:
+        n = 0
+        for slot in range(self.replicas):
+            lease = self._supervisor._slots[slot]
+            w = lease.worker
+            if w is not None and not w.dead:
+                n += 1
+        return n
+
+    def _available(self, slot: int) -> bool:
+        with self._state_lock:
+            if slot in self._draining:
+                return False
+        lease = self._supervisor._slots[slot]
+        w = lease.worker
+        return w is not None and not w.dead
+
+    def route(self, model: str, bucket: int, frame: bytes, forward) -> bytes:
+        """Pick a replica for (model, bucket) and forward the frame.
+
+        The home replica (first in the ring's preference order) gets the
+        request unless it is draining, dead, or **saturated**: models are
+        fully replicated (every replica AOT-warms every servable), so
+        when the home replica's in-flight count runs ``_SPILL_IN_FLIGHT``
+        past the least-loaded replica's, the request spills there —
+        affinity is a cache-warmth preference, not a throughput ceiling.
+        Anything that lands off-home books ``serve.route_misses``
+        (fallback and spill alike; the hit-rate is the affinity measure).
+        A transport failure marks the replica crashed with the supervisor
+        and retries the (fully buffered) frame on the next preference — a
+        mid-request replica death is a retry, not a client-visible
+        failure."""
+        last_err: Exception | None = None
+        prefs = self.ring.preference(HashRing.key(model, bucket))
+        order = [s for s in prefs if self._available(s)]
+        if len(order) > 1:
+            with self._state_lock:
+                in_flight = {s: self._in_flight[s] for s in order}
+            least = min(order, key=in_flight.get)
+            if in_flight[order[0]] - in_flight[least] >= _SPILL_IN_FLIGHT:
+                order.remove(least)
+                order.insert(0, least)
+        for slot in order:
+            if not self._available(slot):
+                continue
+            with self._state_lock:
+                # the draining re-check and the in-flight increment must
+                # be one atomic step against drain(): once admitted here,
+                # the slot's in-flight count holds the drain open until
+                # the finally below releases it
+                if slot in self._draining:
+                    continue
+                self._in_flight[slot] += 1
+            try:
+                response = forward(slot, frame)
+            except (OSError, EOFError) as e:
+                last_err = e
+                worker = self._supervisor._slots[slot].worker
+                if worker is not None and worker.dead:
+                    self._supervisor.report_crash(slot, e)
+                continue
+            finally:
+                with self._state_cond:
+                    self._in_flight[slot] -= 1
+                    self._state_cond.notify_all()
+            with self._state_lock:
+                self._served[slot] += 1
+            if prefs and slot == prefs[0]:
+                REGISTRY.counter_inc("serve.route_hits", model=model)
+            else:
+                REGISTRY.counter_inc("serve.route_misses", model=model)
+            return response
+        raise last_err or RuntimeError(
+            f"no live replica for {model!r} (all draining or dead)"
+        )
+
+    # -- rolling drain / restart --------------------------------------------
+
+    def drain(self, slot: int, timeout: float | None = None) -> bool:
+        """Stop routing to ``slot`` and wait for its in-flight requests to
+        finish; returns True when the replica drained fully inside the
+        bound."""
+        timeout = drain_timeout_s() if timeout is None else timeout
+        with self._state_cond:
+            self._draining.add(slot)
+            REGISTRY.counter_inc("serve.drain_events", slot=str(slot))
+            deadline = time.monotonic() + timeout
+            while self._in_flight[slot] > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._state_cond.wait(left)
+        return True
+
+    def undrain(self, slot: int) -> None:
+        with self._state_lock:
+            self._draining.discard(slot)
+
+    def restart_replica(
+        self, slot: int, timeout: float = _SPAWN_TIMEOUT_S
+    ) -> bool:
+        """Rolling restart of one replica under live load: drain, respawn
+        through the supervisor (lease/backoff/breaker), re-admit on READY.
+        The shared AOT cache makes the respawn warm — zero fresh compiles,
+        verified by test."""
+        drained = self.drain(slot)
+        if not drained:
+            logger.warning(
+                "replica %d drain timed out with requests in flight; "
+                "restarting anyway", slot,
+            )
+        lease = self._supervisor._slots[slot]
+        worker = lease.worker
+        if worker is not None:
+            worker.close()
+        replacement = self._supervisor.checkout(slot)
+        ok = replacement is not None and replacement.wait_ready(timeout)
+        if ok:
+            self._supervisor.report_success(slot)
+            REGISTRY.counter_inc("serve.replica_restarts", slot=str(slot))
+        else:
+            self._supervisor.report_crash(
+                slot, RuntimeError("replica respawn did not become ready")
+            )
+        self.undrain(slot)
+        REGISTRY.gauge_set("serve.fleet_replicas", self.live_replicas())
+        return ok
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._state_lock:
+            served = dict(self._served)
+            in_flight = dict(self._in_flight)
+            draining = sorted(self._draining)
+        return {
+            "replicas": self.replicas,
+            "live_replicas": self.live_replicas(),
+            "router_socket": self.router_path,
+            "served_per_replica": {str(k): v for k, v in served.items()},
+            "in_flight": {str(k): v for k, v in in_flight.items()},
+            "draining": draining,
+            "placement": self.placement,
+            "supervisor": self._supervisor.summary(),
+        }
+
+
+if __name__ == "__main__":
+    if "--replica" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--replica"]
+        raise SystemExit(_replica_main(argv))
+    raise SystemExit(
+        "serving.fleet is a library (use ServeFleet) — only --replica "
+        "runs standalone"
+    )

@@ -276,6 +276,45 @@ def _compiled_for(token: int, bucket: int):
     return compiled
 
 
+@functools.lru_cache(maxsize=None)
+def _hedge_compiled_for(token: int, bucket: int, device_index: int):
+    """AOT build bound to a specific alternate device, for hedged
+    dispatch: the straggler re-issue lands on its own executable (and its
+    own copy of the params) so it never queues behind the stuck primary.
+    Compiled only by an explicit ``warm_hedge`` — never on the request
+    path."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    entry = _ENTRIES_BY_TOKEN[token]
+    sharding = SingleDeviceSharding(jax.devices()[device_index])
+    params_avals = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sharding),
+        entry.params,
+    )
+    x_aval = jax.ShapeDtypeStruct(
+        (bucket, entry.n_features), entry.x_dtype, sharding=sharding
+    )
+    compiled = jax.jit(entry.kernel).lower(params_avals, x_aval).compile()
+    REGISTRY.counter_inc(
+        "serve.aot_compiles", model=entry.name, bucket=bucket, device="hedge"
+    )
+    return compiled
+
+
+@functools.lru_cache(maxsize=None)
+def _hedge_params(token: int, device_index: int):
+    """The entry's params replicated onto the hedge device (one copy per
+    (entry, device), reused by every hedged dispatch)."""
+    import jax
+
+    entry = _ENTRIES_BY_TOKEN[token]
+    device = jax.devices()[device_index]
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, device), entry.params
+    )
+
+
 # -- kernel extraction per model family -------------------------------------
 
 
@@ -461,6 +500,8 @@ class ModelRegistry:
     def __init__(self):
         self._entries: dict[str, ServableEntry] = {}
         self._lock = threading.RLock()
+        # (token, device_index) pairs with warm hedge executables + params
+        self._hedge_warm: set[tuple[int, int]] = set()
 
     def register(
         self,
@@ -515,6 +556,7 @@ class ModelRegistry:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._hedge_warm.clear()
             REGISTRY.gauge_set("serve.models", 0)
 
     # -- dispatch -----------------------------------------------------------
@@ -542,6 +584,61 @@ class ModelRegistry:
             entry.warm_buckets.add(bucket)
         xd = jnp.asarray(padded)  # same conversion the eager transform does
         return np.asarray(compiled(entry.params, xd))
+
+    # -- hedged dispatch (second-device re-issue) ---------------------------
+
+    def warm_hedge(
+        self,
+        name: str,
+        *,
+        bucket_list: tuple[int, ...] | None = None,
+        device_index: int = 1,
+    ) -> int:
+        """Pre-compile a model's executables on an alternate device so a
+        hedged re-issue runs there instead of queueing behind the primary.
+        Returns the number of warmed buckets (0 when the host has a single
+        device — hedging then re-issues on the primary executable, which
+        still races the host-side tail)."""
+        import jax
+
+        if device_index >= len(jax.devices()):
+            return 0
+        entry = self.get(name)
+        ladder = (
+            tuple(bucket_list) if bucket_list
+            else tuple(sorted(entry.warm_buckets))
+        )
+        warmed = 0
+        for b in ladder:
+            if b not in entry.warm_buckets:
+                continue
+            _hedge_compiled_for(entry.token, b, device_index)
+            warmed += 1
+        if warmed:
+            _hedge_params(entry.token, device_index)
+            with self._lock:
+                self._hedge_warm.add((entry.token, device_index))
+        return warmed
+
+    def hedge_dispatch_padded(
+        self, entry: ServableEntry, padded: np.ndarray, bucket: int
+    ) -> np.ndarray:
+        """The straggler re-issue: dispatch on the warm hedge device when
+        one exists, else re-run the primary executable. The hedged tail is
+        usually host-side (GIL, allocator, scheduler stall), so even the
+        same-executable race wins back most of it; a warm second device
+        additionally covers device-side stragglers."""
+        key = (entry.token, 1)
+        with self._lock:
+            warm = key in self._hedge_warm and bucket in entry.warm_buckets
+        if not warm:
+            return self.dispatch_padded(entry, padded, bucket)
+        import jax
+        import jax.numpy as jnp
+
+        compiled = _hedge_compiled_for(entry.token, bucket, 1)
+        xd = jax.device_put(jnp.asarray(padded), jax.devices()[1])
+        return np.asarray(compiled(_hedge_params(entry.token, 1), xd))
 
     def predict(self, name: str, x: Any) -> np.ndarray:
         """The direct (un-batched) serve path: prepare, pad, dispatch,
@@ -584,6 +681,8 @@ def reset_for_tests() -> None:
     with _TOKEN_LOCK:
         _ENTRIES_BY_TOKEN.clear()
     _compiled_for.cache_clear()
+    _hedge_compiled_for.cache_clear()
+    _hedge_params.cache_clear()
     hbm.reset_fleet()
     with _CACHE_LOCK:
         _CACHE_READY = False

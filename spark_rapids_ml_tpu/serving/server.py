@@ -39,6 +39,14 @@ batcher and booking the same ``serve.*`` telemetry with
 ``serve.transport{transport=uds}``. Fully in-process callers use
 ``serving.client`` instead.
 
+The UDS listener additionally speaks the JSON-free **fast lane**
+(``serving.fastlane``): a frame opening with ``FASTLANE_MAGIC`` in place
+of the JSON-header length goes straight from the fixed binary struct to
+the batcher — no dict is built, no JSON codec runs (the counted codec's
+``serve.json_codec`` series proves it), and the response is cast into a
+pooled, pre-sized (model, bucket) buffer instead of a fresh per-request
+``tobytes()``. HTTP binary responses reuse the same buffer pool.
+
 Every request books ``serve.requests``/``serve.rows`` counters and a
 ``serve.latency`` histogram sample labeled by model; failures book
 ``serve.errors``. Oversized requests are refused with HTTP 413 at admission
@@ -49,6 +57,7 @@ the accepted dtypes), unknown models 404, and SLO-burn load shedding
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -58,7 +67,7 @@ import time
 
 import numpy as np
 
-from spark_rapids_ml_tpu.serving import hbm
+from spark_rapids_ml_tpu.serving import buckets, fastlane, hbm
 from spark_rapids_ml_tpu.serving.batcher import (
     MicroBatcher,
     adaptive_window_enabled,
@@ -129,6 +138,22 @@ def binary_response_bytes(out: np.ndarray) -> tuple[bytes, str]:
     """(body, shape-header) of a prediction streamed back as f32."""
     arr = np.ascontiguousarray(np.asarray(out), dtype="<f4")
     return arr.tobytes(), ",".join(str(d) for d in arr.shape)
+
+
+@contextlib.contextmanager
+def pooled_binary_response(model: str, out: np.ndarray):
+    """Lease a pinned response buffer and yield ``(view, shape_header)``
+    with the f32 wire form already cast in place. The pool key buckets the
+    row count (power-of-two ladder) so a handful of recycled buffers cover
+    every response size a (model, bucket) pair produces."""
+    mat = np.asarray(out)
+    if mat.ndim != 2:
+        mat = np.reshape(mat, (mat.shape[0], -1))
+    nbytes = mat.shape[0] * mat.shape[1] * 4
+    pool_bucket = buckets.serve_bucket(max(1, mat.shape[0]))
+    with fastlane.RESPONSE_POOL.lease(model, pool_bucket, nbytes) as view:
+        rows, cols = fastlane.fill_f32(view, mat)
+        yield view, f"{rows},{cols}"
 
 
 class ServeHandler(httpd._Handler):
@@ -206,29 +231,34 @@ class ServeHandler(httpd._Handler):
         # book the request-level series the SLO engine watches.
         REGISTRY.counter_inc("serve.requests", model=name, code=200)
         REGISTRY.counter_inc("serve.transport", transport="http", wire=wire)
-        REGISTRY.histogram_record("serve.latency", latency, model=name)
+        REGISTRY.histogram_record(
+            "serve.latency", latency, model=name, transport="http", wire=wire
+        )
         if kind == "query":
             REGISTRY.counter_inc(
                 "ann.queries", int(np.shape(out)[0]), index=name
             )
         binary = BINARY_CONTENT_TYPE in (self.headers.get("Accept") or "")
         if binary:
-            body, shape = binary_response_bytes(out)
-            extra = {
-                SHAPE_HEADER: shape,
-                "X-Latency-Ms": f"{latency * 1e3:.3f}",
-            }
+            extra = {"X-Latency-Ms": f"{latency * 1e3:.3f}"}
             if kind == "query":
                 # the packed [rows, 2k] block rides the f32 wire as-is;
                 # ids stay exact up to 2^24 (JSON carries them to 2^53)
                 extra[ANN_K_HEADER] = str(int(np.shape(out)[1]) // 2)
-            self._respond(200, body, BINARY_CONTENT_TYPE, extra_headers=extra)
+            # the response is cast into a pooled pre-sized buffer, not a
+            # fresh tobytes() — zero per-request response allocation in
+            # steady state
+            with pooled_binary_response(name, out) as (view, shape):
+                extra[SHAPE_HEADER] = shape
+                self._respond(
+                    200, view, BINARY_CONTENT_TYPE, extra_headers=extra
+                )
             return
         if kind == "query":
             from spark_rapids_ml_tpu.ann.serving import unpack_query_result
 
             dists, ids = unpack_query_result(out)
-            self._json(
+            self._serve_json(
                 200,
                 {
                     "index": name,
@@ -240,7 +270,7 @@ class ServeHandler(httpd._Handler):
                 },
             )
             return
-        self._json(
+        self._serve_json(
             200,
             {
                 "model": name,
@@ -249,6 +279,16 @@ class ServeHandler(httpd._Handler):
                 "predictions": np.asarray(out).tolist(),  # tpulint: disable=TPL002
                 "latency_ms": round(latency * 1e3, 3),
             },
+        )
+
+    def _serve_json(self, code: int, payload: dict) -> None:
+        """The exporter's ``_json`` through the counted codec — serve-path
+        JSON encodes are visible on ``serve.json_codec`` (scrape-surface
+        responses stay uncounted; they are not the serve hot path)."""
+        self._respond(
+            code,
+            fastlane.json_dumps(payload).encode() + b"\n",
+            "application/json",
         )
 
     def _respond(self, code, body, content_type, extra_headers=None):
@@ -284,7 +324,7 @@ class ServeHandler(httpd._Handler):
                 "binary",
             )
         try:
-            payload = json.loads(body)
+            payload = fastlane.json_loads(body)
         except json.JSONDecodeError as e:
             raise ValueError(f"request body is not valid JSON: {e}") from e
         instances = (
@@ -297,7 +337,7 @@ class ServeHandler(httpd._Handler):
     def _serve_error(self, model: str, code: int, detail: str) -> None:
         REGISTRY.counter_inc("serve.errors", model=model, code=code)
         REGISTRY.counter_inc("serve.requests", model=model, code=code)
-        self._json(code, {"error": detail, "model": model})
+        self._serve_json(code, {"error": detail, "model": model})
 
 
 # -- UDS listener ------------------------------------------------------------
@@ -324,9 +364,55 @@ def _read_exact(rfile, n: int) -> bytes:
 
 
 def _uds_send(wfile, header: dict, payload: bytes = b"") -> None:
-    raw = json.dumps(header).encode()
+    raw = fastlane.json_dumps(header).encode()
     wfile.write(len(raw).to_bytes(4, "big") + raw + payload)
     wfile.flush()
+
+
+def _fastlane_handle(rfile, wfile, batcher: MicroBatcher) -> bool:
+    """One fast-lane frame: fixed struct -> batcher -> pooled buffer.
+
+    No dict is materialized and the counted JSON codec never runs — the
+    per-transport parity test holds this path to a zero
+    ``serve.json_codec`` delta."""
+    model, mat, is_query = fastlane.read_request(
+        lambda n: _read_exact(rfile, n)
+    )
+    t0 = time.perf_counter()
+    try:
+        if is_query:
+            entry = batcher.registry.get(model)
+            if entry.family != "ann":
+                raise KeyError(
+                    f"{model!r} is a {entry.family} servable, not an ann "
+                    "index"
+                )
+        out = batcher.submit(model, mat).result(timeout=30.0)
+    except Exception as e:  # noqa: BLE001 - answer the frame, keep the conn
+        code = status_for_error(e)
+        if code == 500:
+            logger.exception("fastlane predict failed for model %s", model)
+        REGISTRY.counter_inc("serve.errors", model=model, code=code)
+        REGISTRY.counter_inc("serve.requests", model=model, code=code)
+        wfile.write(fastlane.pack_error_response(code, str(e)))
+        wfile.flush()
+        return True
+    latency = time.perf_counter() - t0
+    REGISTRY.counter_inc("serve.requests", model=model, code=200)
+    REGISTRY.counter_inc("serve.transport", transport="uds", wire="fast")
+    REGISTRY.histogram_record(
+        "serve.latency", latency, model=model, transport="uds", wire="fast"
+    )
+    if is_query:
+        REGISTRY.counter_inc("ann.queries", int(np.shape(out)[0]), index=model)
+    with pooled_binary_response(model, out) as (view, shape):
+        rows, cols = (int(d) for d in shape.split(","))
+        wfile.write(
+            fastlane.pack_response_header(200, rows, cols, len(view))
+        )
+        wfile.write(view)
+    wfile.flush()
+    return True
 
 
 def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
@@ -339,7 +425,12 @@ def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
         return False
     if len(head) < 4:
         raise EOFError("peer closed mid-frame")
-    header = json.loads(_read_exact(rfile, int.from_bytes(head, "big")))
+    if fastlane.is_fastlane_head(head):
+        # JSON-free dispatch lane: framing straight to the batcher
+        return _fastlane_handle(rfile, wfile, batcher)
+    header = fastlane.json_loads(
+        _read_exact(rfile, int.from_bytes(head, "big"))
+    )
     model = str(header.get("model", ""))
     wire = str(header.get("wire", "json"))
     accept = str(header.get("accept", wire))
@@ -383,7 +474,9 @@ def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
     latency = time.perf_counter() - t0
     REGISTRY.counter_inc("serve.requests", model=model, code=200)
     REGISTRY.counter_inc("serve.transport", transport="uds", wire=wire)
-    REGISTRY.histogram_record("serve.latency", latency, model=model)
+    REGISTRY.histogram_record(
+        "serve.latency", latency, model=model, transport="uds", wire=wire
+    )
     base = {
         "ok": True,
         "code": 200,
@@ -552,6 +645,27 @@ def serve_summary(snap) -> dict:
     hbm_bytes = [
         v for (n, _), v in snap.gauges.items() if n == "serve.hbm_bytes"
     ]
+    # per-transport/wire latency digests: merge serve.latency across the
+    # label sets that share one (transport, wire) pair — the breakdown the
+    # fast-lane satellite's serve_report table renders
+    lanes = set()
+    for (n, lbl), _h in snap.hists.items():
+        if n == "serve.latency":
+            d = dict(lbl)
+            if "transport" in d and "wire" in d:
+                lanes.add((d["transport"], d["wire"]))
+    lat_by_transport = {
+        f"{t}/{w}": snap.hist("serve.latency", transport=t, wire=w).to_dict()
+        for t, w in sorted(lanes)
+    }
+    hedge_wins: dict[str, float] = {}
+    for (n, lbl), v in snap.counters.items():
+        if n == "serve.hedge_wins":
+            w = str(dict(lbl).get("winner", "?"))
+            hedge_wins[w] = hedge_wins.get(w, 0) + v
+    replica_gauges = [
+        v for (n, _), v in snap.gauges.items() if n == "serve.fleet_replicas"
+    ]
     return {
         "type": "serve_summary",
         "coalesce_window_s": coalesce_window_s(),
@@ -570,11 +684,27 @@ def serve_summary(snap) -> dict:
         "transport_mix": transport_mix,
         "bucket_hits": bucket_hits,
         "latency": snap.hist("serve.latency").to_dict(),
+        "latency_by_transport": lat_by_transport,
         "queue_delay": snap.hist("serve.queue_delay_seconds").to_dict(),
+        "queue_delay_us": snap.hist("serve.queue_delay_us").to_dict(),
         "window_effective": snap.hist(
             "serve.window_effective_seconds"
         ).to_dict(),
         "batch_rows": snap.hist("serve.batch_rows").to_dict(),
+        "hedges": snap.counter("serve.hedges"),
+        "hedge_wins": hedge_wins,
+        "json_codec": {
+            "encode": snap.counter("serve.json_codec", op="encode"),
+            "decode": snap.counter("serve.json_codec", op="decode"),
+        },
+        "response_pool": fastlane.RESPONSE_POOL.stats(),
+        "fleet": {
+            "replicas": int(max(replica_gauges)) if replica_gauges else 0,
+            "route_hits": snap.counter("serve.route_hits"),
+            "route_misses": snap.counter("serve.route_misses"),
+            "drain_events": snap.counter("serve.drain_events"),
+            "replica_restarts": snap.counter("serve.replica_restarts"),
+        },
     }
 
 

@@ -97,6 +97,17 @@ METRICS: frozenset[str] = frozenset({
     "serve.page_out",
     "serve.hbm_bytes",
     "serve.shed",
+    # serve tail hunt: µs queue-delay series, JSON-free lane, hedged
+    # dispatch, multi-process fleet (serving.fastlane / serving.fleet)
+    "serve.queue_delay_us",
+    "serve.json_codec",
+    "serve.hedges",
+    "serve.hedge_wins",
+    "serve.fleet_replicas",
+    "serve.route_hits",
+    "serve.route_misses",
+    "serve.drain_events",
+    "serve.replica_restarts",
     # ANN vector search subsystem (spark_rapids_ml_tpu.ann)
     "ann.queries",
     "ann.build_rows",

@@ -214,7 +214,26 @@ _DECLARATIONS = (
     Knob("TPU_ML_SERVE_P99_GATE_MS", "float", "",
          "absolute serve_p99_ms ceiling bench stamps on the ledger entry "
          "for tools/perf_sentinel.py to enforce (unset = relative history "
-         "gating only)", "bench.py"),
+         "gating only; also gates fleet_p99_ms in the fleet bench stage)",
+         "bench.py"),
+    Knob("TPU_ML_SERVE_HEDGE_FLOOR_US", "float", "2000",
+         "serve-scale floor (microseconds) of the hedged-dispatch "
+         "threshold: a micro-batch is re-issued when the primary dispatch "
+         "exceeds max(this, TPU_ML_HEDGE_FACTOR x device-time EWMA); "
+         "TPU_ML_HEDGE_FACTOR=0 disables serve hedging too",
+         "serving.batcher"),
+    Knob("TPU_ML_SERVE_FLEET_REPLICAS", "int", "0",
+         "replica count of the multi-process serve fleet (0 = fleet off; "
+         "each replica is a UDS server process with its own AOT cache "
+         "warmed from TPU_ML_SERVE_COMPILE_CACHE_DIR)", "serving.fleet"),
+    Knob("TPU_ML_SERVE_FLEET_SOCKET_DIR", "path", "",
+         "directory for fleet replica + router UDS sockets (empty = a "
+         "fresh tempdir per fleet; must be short enough for AF_UNIX's "
+         "~100-byte path limit)", "serving.fleet"),
+    Knob("TPU_ML_SERVE_DRAIN_TIMEOUT_S", "float", "30",
+         "rolling drain bound: max seconds the fleet router waits for a "
+         "draining replica's in-flight requests to reach zero before the "
+         "replica is restarted anyway", "serving.fleet"),
     # -- transport monitor / health daemon (tools/healthd.py) ---------------
     Knob("TPU_ML_MONITOR_BENCH_OUT", "path", "BENCH_OPPORTUNISTIC_r05.json",
          "opportunistic bench output file (relative to the repo)",
@@ -333,6 +352,10 @@ SERVE_ADAPTIVE_WINDOW = KNOBS["TPU_ML_SERVE_ADAPTIVE_WINDOW"]
 SERVE_UDS_PATH = KNOBS["TPU_ML_SERVE_UDS_PATH"]
 SERVE_HBM_BUDGET_BYTES = KNOBS["TPU_ML_SERVE_HBM_BUDGET_BYTES"]
 SERVE_P99_GATE_MS = KNOBS["TPU_ML_SERVE_P99_GATE_MS"]
+SERVE_HEDGE_FLOOR_US = KNOBS["TPU_ML_SERVE_HEDGE_FLOOR_US"]
+SERVE_FLEET_REPLICAS = KNOBS["TPU_ML_SERVE_FLEET_REPLICAS"]
+SERVE_FLEET_SOCKET_DIR = KNOBS["TPU_ML_SERVE_FLEET_SOCKET_DIR"]
+SERVE_DRAIN_TIMEOUT_S = KNOBS["TPU_ML_SERVE_DRAIN_TIMEOUT_S"]
 MONITOR_BENCH_OUT = KNOBS["TPU_ML_MONITOR_BENCH_OUT"]
 MONITOR_DRIFT_OUT = KNOBS["TPU_ML_MONITOR_DRIFT_OUT"]
 MONITOR_INTERVAL_S = KNOBS["TPU_ML_MONITOR_INTERVAL_S"]

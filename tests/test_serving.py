@@ -20,6 +20,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 import types
 import urllib.error
 import urllib.request
@@ -30,6 +31,7 @@ import pytest
 
 from spark_rapids_ml_tpu.serving import buckets
 from spark_rapids_ml_tpu.serving import client as client_mod
+from spark_rapids_ml_tpu.serving import fastlane
 from spark_rapids_ml_tpu.serving import hbm as hbm_mod
 from spark_rapids_ml_tpu.serving import registry as registry_mod
 from spark_rapids_ml_tpu.serving import server as server_mod
@@ -1202,3 +1204,285 @@ class TestServeReportFastPath:
         )
         path = self._write(tmp_path, [blob])
         assert sr.main([path, "--strict"]) == 0
+
+
+# -- fast lane: JSON-free dispatch -------------------------------------------
+
+
+class TestFastlaneProtocol:
+    def test_request_round_trip_zero_copy(self):
+        x = np.arange(12, dtype="<f4").reshape(4, 3)
+        frame = fastlane.pack_request("m", x)
+        assert fastlane.is_fastlane_head(frame[:4])
+        buf = memoryview(frame[4:])
+        pos = [0]
+
+        def read_exact(n):
+            out = buf[pos[0]:pos[0] + n]
+            pos[0] += n
+            return out
+
+        model, mat, is_query = fastlane.read_request(read_exact)
+        assert model == "m" and not is_query
+        assert np.array_equal(mat, x) and mat.dtype == np.dtype("<f4")
+
+    def test_peek_matches_read(self):
+        x = np.zeros((8, 5), dtype="<f4")
+        frame = fastlane.pack_request("abc", x)
+        struct_raw = frame[4:4 + fastlane.request_struct_size()]
+        assert fastlane.peek_request(struct_raw) == (3, 8, 5)
+
+    def test_error_frame_raises_with_status(self):
+        frame = fastlane.pack_error_response(404, "model 'x' not found")
+        buf, pos = memoryview(frame), [0]
+
+        def read_exact(n):
+            out = buf[pos[0]:pos[0] + n]
+            pos[0] += n
+            return bytes(out)
+
+        with pytest.raises(fastlane.FastlaneError) as e:
+            fastlane.read_response(read_exact)
+        assert e.value.status == 404 and "not found" in e.value.message
+
+    def test_magic_unreachable_as_json_header_length(self):
+        # the discriminator rides in place of the 4-byte header length;
+        # a real JSON header can never be ~4.1 GB long
+        assert fastlane.FASTLANE_MAGIC > 2**31
+
+    def test_response_pool_recycles_buffers(self):
+        pool = fastlane.ResponseBufferPool()
+        with pool.lease("m", 8, 64) as view:
+            first = view.obj
+            assert len(view) == 64
+        with pool.lease("m", 8, 64) as view:
+            assert view.obj is first  # recycled, not reallocated
+        with pool.lease("m", 8, 32) as view:
+            assert view.obj is first and len(view) == 32  # shrunk lease
+        stats = pool.stats()
+        assert stats == {"leases": 3, "allocations": 1, "keys": 1}
+
+    def test_fill_f32_casts_into_leased_buffer(self):
+        pool = fastlane.ResponseBufferPool()
+        out = np.arange(6, dtype=np.float64).reshape(3, 2)
+        with pool.lease("m", 8, out.size * 4) as view:
+            rows, cols = fastlane.fill_f32(view, out)
+            assert (rows, cols) == (3, 2)
+            got = np.frombuffer(view, dtype="<f4").reshape(3, 2)
+            assert np.array_equal(got, out.astype("<f4"))
+
+
+class TestFastlaneE2E:
+    def _serve(self, tmp_path, fitted_models):
+        x, _, lin = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("lin", lin, bucket_list=(8,))
+        path = str(tmp_path / "serve.sock")
+        server_mod.start_serving(0, with_monitor=False, uds_path=path)
+        return x, reg, path
+
+    def test_zero_json_on_hot_path_and_bitwise_parity(
+        self, tmp_path, fitted_models
+    ):
+        """The fast lane books ZERO serve.json_codec activity (the counted
+        codec proves the no-dict-churn claim) and its f32 payload is
+        bitwise identical to the JSON lane's predictions for the same
+        f32-representable request (linear model: identity prepare, so
+        both lanes run the exact same f32 kernel)."""
+        x, reg, path = self._serve(tmp_path, fitted_models)
+        x32 = np.ascontiguousarray(x[:4], dtype="<f4")
+        with socket.socket(socket.AF_UNIX) as s:
+            s.connect(path)
+            rf = s.makefile("rb")
+            snap = REGISTRY.snapshot()
+            s.sendall(fastlane.pack_request("lin", x32))
+            fast_out = fastlane.read_response(
+                lambda n: _uds_read_exact(rf, n)
+            )
+            delta = REGISTRY.snapshot().delta(snap)
+            assert delta.counter("serve.json_codec") == 0
+            assert delta.counter(
+                "serve.transport", transport="uds", wire="fast"
+            ) == 1
+            assert delta.hist(
+                "serve.latency", transport="uds", wire="fast"
+            ).count == 1
+
+            # same request on the JSON lane of the same connection
+            resp, _ = _uds_exchange(
+                s,
+                {"model": "lin", "wire": "json",
+                 "instances": x32.tolist()},
+            )
+        assert resp["ok"]
+        json_out = np.asarray(resp["predictions"], dtype="<f4")
+        assert fast_out.tobytes() == json_out.reshape(fast_out.shape).tobytes()
+        # ...and the JSON lane DID run the counted codec
+        post = REGISTRY.snapshot().delta(snap)
+        assert post.counter("serve.json_codec", op="decode") >= 1
+        assert post.counter("serve.json_codec", op="encode") >= 1
+
+    def test_fastlane_pooled_response_buffers_recycle(
+        self, tmp_path, fitted_models
+    ):
+        x, _, path = self._serve(tmp_path, fitted_models)
+        x32 = np.ascontiguousarray(x[:4], dtype="<f4")
+        before = fastlane.RESPONSE_POOL.stats()
+        with socket.socket(socket.AF_UNIX) as s:
+            s.connect(path)
+            rf = s.makefile("rb")
+            for _ in range(5):
+                s.sendall(fastlane.pack_request("lin", x32))
+                fastlane.read_response(lambda n: _uds_read_exact(rf, n))
+        after = fastlane.RESPONSE_POOL.stats()
+        assert after["leases"] - before["leases"] == 5
+        # steady state allocates at most once for this (model, bucket)
+        assert after["allocations"] - before["allocations"] <= 1
+
+    def test_error_frame_keeps_connection_alive(
+        self, tmp_path, fitted_models
+    ):
+        x, _, path = self._serve(tmp_path, fitted_models)
+        x32 = np.ascontiguousarray(x[:2], dtype="<f4")
+        with socket.socket(socket.AF_UNIX) as s:
+            s.connect(path)
+            rf = s.makefile("rb")
+            s.sendall(fastlane.pack_request("ghost", x32))
+            with pytest.raises(fastlane.FastlaneError) as e:
+                fastlane.read_response(lambda n: _uds_read_exact(rf, n))
+            assert e.value.status == 404
+            # the connection survives the error frame
+            s.sendall(fastlane.pack_request("lin", x32))
+            out = fastlane.read_response(lambda n: _uds_read_exact(rf, n))
+        assert out.shape[0] == 2
+
+
+# -- deterministic teardown (no leaked threads / sockets) --------------------
+
+
+def _serve_threads() -> list[str]:
+    import threading as _threading
+
+    return sorted(
+        t.name for t in _threading.enumerate()
+        if t.name.startswith(("tpu-ml-serve", "tpu-ml-fleet"))
+    )
+
+
+class TestTeardownLeak:
+    def test_repeated_start_stop_cycles_leak_nothing(
+        self, tmp_path, fitted_models
+    ):
+        """stop_serving/reset_client must deterministically join every
+        worker thread and unlink the UDS socket: after each of several
+        start/serve/stop cycles the process has zero tpu-ml serve threads
+        and no stray socket file."""
+        x, _, lin = fitted_models
+        x32 = np.ascontiguousarray(x[:4], dtype="<f4")
+        for cycle in range(3):
+            reg = registry_mod.get_registry()
+            if "lin" not in {d["name"] for d in reg.describe()}:
+                reg.register("lin", lin, bucket_list=(8,))
+            path = str(tmp_path / f"serve-{cycle}.sock")
+            server_mod.start_serving(0, with_monitor=False, uds_path=path)
+            with socket.socket(socket.AF_UNIX) as s:
+                s.connect(path)
+                rf = s.makefile("rb")
+                s.sendall(fastlane.pack_request("lin", x32))
+                fastlane.read_response(lambda n: _uds_read_exact(rf, n))
+            client_mod.predict("lin", x32)
+            server_mod.stop_serving(stop_monitor=False)
+            client_mod.reset_client()
+            assert _serve_threads() == [], (
+                f"cycle {cycle} leaked threads: {_serve_threads()}"
+            )
+            assert not os.path.exists(path), (
+                f"cycle {cycle} left the UDS socket behind"
+            )
+
+    def test_private_client_batcher_joins_on_reset(self, fitted_models):
+        _, _, lin = fitted_models
+        reg = registry_mod.get_registry()
+        reg.register("lin", lin, bucket_list=(8,))
+        # no server running: the client lazily starts a private batcher
+        out = client_mod.predict("lin", np.zeros((2, 6), dtype="<f4"))
+        assert out.shape[0] == 2
+        assert "tpu-ml-serve-batcher" in _serve_threads()
+        client_mod.reset_client()
+        assert _serve_threads() == []
+
+
+# -- tail-aware hedged dispatch ----------------------------------------------
+
+
+class TestHedgedDispatch:
+    def test_hedge_fires_past_threshold_and_first_result_wins(
+        self, fitted_models, monkeypatch
+    ):
+        """A stalled primary dispatch past the hedge threshold re-issues
+        the batch; the hedge's result answers the request and the
+        telemetry books the hedge + the winner (the loser's device time
+        never reaches the adaptive-window EWMA)."""
+        _, _, lin = fitted_models
+        monkeypatch.setenv("TPU_ML_HEDGE_FACTOR", "1.5")
+        monkeypatch.setenv("TPU_ML_SERVE_HEDGE_FLOOR_US", "1000")
+        reg = registry_mod.get_registry()
+        reg.register("lin", lin, bucket_list=(8,))
+        mb = MicroBatcher(reg).start()
+        try:
+            x32 = np.ascontiguousarray(
+                np.linspace(0.0, 1.0, 12).reshape(2, 6), dtype="<f4"
+            )
+            # seed the device-time EWMA (no hedging while it is unknown:
+            # "never hedge blind")
+            expected = mb.submit("lin", x32).result(timeout=30)
+
+            real_dispatch = reg.dispatch_padded
+            stalls = iter([0.4])
+
+            def stalling_dispatch(entry, padded, bucket):
+                delay = next(stalls, 0.0)
+                if delay:
+                    time.sleep(delay)
+                return real_dispatch(entry, padded, bucket)
+
+            monkeypatch.setattr(reg, "dispatch_padded", stalling_dispatch)
+            snap = REGISTRY.snapshot()
+            out = mb.submit("lin", x32).result(timeout=30)
+            delta = REGISTRY.snapshot().delta(snap)
+            assert np.array_equal(np.asarray(out), np.asarray(expected))
+            assert delta.counter("serve.hedges", model="lin") == 1
+            assert delta.counter(
+                "serve.hedge_wins", model="lin", winner="hedge"
+            ) == 1
+        finally:
+            mb.stop()
+
+    def test_no_hedge_without_observed_device_time(
+        self, fitted_models, monkeypatch
+    ):
+        from spark_rapids_ml_tpu.resilience import supervisor
+
+        monkeypatch.setenv("TPU_ML_HEDGE_FACTOR", "2.0")
+        # observed == 0 -> never hedge blind
+        assert supervisor.hedge_threshold_s(0.0, floor_s=0.001) is None
+        # factor <= 0 -> hedging disabled outright
+        monkeypatch.setenv("TPU_ML_HEDGE_FACTOR", "0")
+        assert supervisor.hedge_threshold_s(0.5, floor_s=0.001) is None
+
+    def test_threshold_respects_serve_floor(self, monkeypatch):
+        from spark_rapids_ml_tpu.resilience import supervisor
+        from spark_rapids_ml_tpu.serving import batcher as batcher_mod
+
+        monkeypatch.setenv("TPU_ML_HEDGE_FACTOR", "2.0")
+        monkeypatch.setenv("TPU_ML_SERVE_HEDGE_FLOOR_US", "5000")
+        floor = batcher_mod.serve_hedge_floor_s()
+        assert floor == pytest.approx(0.005)
+        # tiny observed latency: the floor wins (no microsecond hedges)
+        assert supervisor.hedge_threshold_s(
+            1e-5, floor_s=floor
+        ) == pytest.approx(0.005)
+        # big observed latency: factor x observed wins
+        assert supervisor.hedge_threshold_s(
+            0.1, floor_s=floor
+        ) == pytest.approx(0.2)

@@ -1,0 +1,312 @@
+#!/usr/bin/env python
+"""Drive a serve endpoint (single server or fleet router) with thousands
+of concurrent UDS connections and report fleet-wide p50/p99 and q/s.
+
+Usage::
+
+    python -m tools.serve_loadgen --socket /tmp/router.sock --model lin \
+        --connections 500 --duration 5 --wire fast --rows 4 --cols 6
+
+Importable as ``run_load(...)`` — the bench fleet stage calls it in
+process and stamps the result on the perf ledger.
+
+Design: one OS thread, a ``selectors`` event loop, closed-loop load —
+every connection keeps exactly one request in flight, so ``connections``
+IS the concurrency and the measured latency is honest queueing latency
+(an open-loop generator would smear queue buildup into the tail). Each
+connection speaks either the JSON UDS wire or the fast lane; ``mixed``
+alternates per connection so one run exercises both. Request frames are
+packed once and reused verbatim — the generator does no per-request
+encode work, so the measured tail belongs to the server, not the client.
+
+The soft fd limit is raised toward the hard limit when ``connections``
+needs it (500 client conns + the router's upstream sockets blow through
+the usual 1024 default).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import resource
+import selectors
+import socket
+import struct
+import sys
+import time
+
+# frame constants mirrored from serving.fastlane (kept in sync by the
+# parity test there) — mirroring keeps this tool importable and its
+# request loop free of repo imports that would book telemetry
+_MAGIC = struct.pack(">I", 0xF5A57A4E)
+_REQ_STRUCT = struct.Struct(">BBHII")
+_RESP_STRUCT = struct.Struct(">BBHIII")
+_FASTLANE_VERSION = 1
+_FLAG_ERROR = 0x01
+
+
+def _raise_nofile(need: int) -> None:
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < need:
+        resource.setrlimit(
+            resource.RLIMIT_NOFILE, (min(need, hard), hard)
+        )
+
+
+def pack_fast_request(model: str, rows: int, cols: int, payload: bytes) -> bytes:
+    name = model.encode("utf-8")
+    return b"".join((
+        _MAGIC,
+        _REQ_STRUCT.pack(_FASTLANE_VERSION, 0, len(name), rows, cols),
+        name,
+        payload,
+    ))
+
+
+def pack_json_request(model: str, rows: int, cols: int, payload: bytes) -> bytes:
+    header = json.dumps({
+        "model": model,
+        "wire": "binary",
+        "accept": "binary",
+        "shape": [rows, cols],
+        "payload_bytes": len(payload),
+    }).encode("utf-8")
+    return len(header).to_bytes(4, "big") + header + payload
+
+
+class _Conn:
+    """One closed-loop connection: send the canned frame, parse one
+    response (incrementally — the loop never blocks), repeat."""
+
+    __slots__ = (
+        "sock", "frame", "wire", "outview", "inbuf", "need", "stage",
+        "header_len", "payload_len", "sent_at", "latencies", "failures",
+    )
+
+    def __init__(self, path: str, frame: bytes, wire: str):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(path)
+        self.sock.setblocking(False)
+        self.frame = frame
+        self.wire = wire
+        self.outview = memoryview(b"")
+        self.inbuf = b""
+        self.need = 4
+        self.stage = "head"
+        self.header_len = 0
+        self.payload_len = 0
+        self.sent_at = 0.0
+        self.latencies: list[float] = []
+        self.failures = 0
+
+    def begin_request(self, now: float) -> None:
+        self.outview = memoryview(self.frame)
+        self.inbuf = b""
+        self.need = 4
+        self.stage = "head"
+        self.sent_at = now
+
+    def on_writable(self) -> bool:
+        """Push pending request bytes; True when fully sent."""
+        while self.outview:
+            n = self.sock.send(self.outview)
+            self.outview = self.outview[n:]
+        return not self.outview
+
+    def on_readable(self) -> bool:
+        """Consume response bytes; True when one full response landed."""
+        chunk = self.sock.recv(65536)
+        if not chunk:
+            raise EOFError("server closed connection")
+        self.inbuf += chunk
+        while len(self.inbuf) >= self.need:
+            if self.stage == "head":
+                head = self.inbuf[:4]
+                self.inbuf = self.inbuf[4:]
+                if head == _MAGIC:
+                    self.stage = "fast_struct"
+                    self.need = _RESP_STRUCT.size
+                else:
+                    self.header_len = int.from_bytes(head, "big")
+                    self.stage = "json_header"
+                    self.need = self.header_len
+            elif self.stage == "fast_struct":
+                raw = self.inbuf[:_RESP_STRUCT.size]
+                self.inbuf = self.inbuf[_RESP_STRUCT.size:]
+                _v, flags, _status, _r, _c, plen = _RESP_STRUCT.unpack(raw)
+                if flags & _FLAG_ERROR:
+                    self.failures += 1
+                self.payload_len = plen
+                self.stage = "payload"
+                self.need = plen
+            elif self.stage == "json_header":
+                header = json.loads(self.inbuf[:self.header_len])
+                self.inbuf = self.inbuf[self.header_len:]
+                if not header.get("ok", True):
+                    self.failures += 1
+                self.payload_len = int(header.get("payload_bytes", 0))
+                self.stage = "payload"
+                self.need = self.payload_len
+            elif self.stage == "payload":
+                self.inbuf = self.inbuf[self.payload_len:]
+                self.latencies.append(time.perf_counter() - self.sent_at)
+                self.stage = "head"
+                self.need = 4
+                return True
+        return False
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q / 100.0 * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def run_load(
+    socket_path: str,
+    model: str,
+    *,
+    connections: int = 64,
+    duration_s: float = 5.0,
+    wire: str = "fast",
+    rows: int = 4,
+    cols: int = 6,
+    seed: int = 0,
+) -> dict:
+    """Closed-loop load against ``socket_path``; returns the measurement
+    dict (overall + per-wire p50/p99 in ms, q/s, failure count)."""
+    if wire not in ("fast", "json", "mixed"):
+        raise ValueError(f"unknown wire {wire!r}")
+    _raise_nofile(connections * 4 + 256)
+
+    # deterministic payload without numpy: a fixed f32 ramp scaled by the
+    # seed, identical for every request (the server's work is shape-, not
+    # value-, dependent)
+    vals = [((seed + i) % 97) / 97.0 for i in range(rows * cols)]
+    payload = struct.pack(f"<{rows * cols}f", *vals)
+    frames = {
+        "fast": pack_fast_request(model, rows, cols, payload),
+        "json": pack_json_request(model, rows, cols, payload),
+    }
+
+    sel = selectors.DefaultSelector()
+    conns: list[_Conn] = []
+    try:
+        for i in range(connections):
+            w = wire if wire != "mixed" else ("fast" if i % 2 == 0 else "json")
+            conn = _Conn(socket_path, frames[w], w)
+            conns.append(conn)
+        t_start = time.perf_counter()
+        deadline = t_start + duration_s
+        for conn in conns:
+            conn.begin_request(time.perf_counter())
+            sel.register(conn.sock, selectors.EVENT_WRITE, conn)
+        in_flight = len(conns)
+        disconnects = 0
+        while in_flight > 0:
+            now = time.perf_counter()
+            for key, events in sel.select(timeout=1.0):
+                conn: _Conn = key.data
+                try:
+                    if events & selectors.EVENT_WRITE:
+                        if conn.on_writable():
+                            sel.modify(conn.sock, selectors.EVENT_READ, conn)
+                    if events & selectors.EVENT_READ:
+                        if conn.on_readable():
+                            if now < deadline:
+                                conn.begin_request(time.perf_counter())
+                                sel.modify(
+                                    conn.sock, selectors.EVENT_WRITE, conn
+                                )
+                            else:
+                                sel.unregister(conn.sock)
+                                in_flight -= 1
+                except (OSError, EOFError, BlockingIOError) as e:
+                    if isinstance(e, BlockingIOError):
+                        continue
+                    disconnects += 1
+                    sel.unregister(conn.sock)
+                    conn.close()
+                    in_flight -= 1
+            if time.perf_counter() > deadline + 30.0:
+                # straggler guard: a wedged server must not hang the tool
+                disconnects += in_flight
+                break
+        elapsed = time.perf_counter() - t_start
+    finally:
+        for conn in conns:
+            conn.close()
+        sel.close()
+
+    by_wire: dict[str, dict] = {}
+    all_lat: list[float] = []
+    failures = disconnects
+    for w in ("fast", "json"):
+        lat = sorted(
+            v for c in conns if c.wire == w for v in c.latencies
+        )
+        failures += sum(c.failures for c in conns if c.wire == w)
+        if lat:
+            by_wire[w] = {
+                "count": len(lat),
+                "p50_ms": _percentile(lat, 50) * 1e3,
+                "p99_ms": _percentile(lat, 99) * 1e3,
+            }
+        all_lat.extend(lat)
+    all_lat.sort()
+    return {
+        "socket": socket_path,
+        "model": model,
+        "wire": wire,
+        "connections": connections,
+        "duration_s": round(elapsed, 3),
+        "requests": len(all_lat),
+        "failures": failures,
+        "qps": round(len(all_lat) / elapsed, 1) if elapsed > 0 else 0.0,
+        "p50_ms": round(_percentile(all_lat, 50) * 1e3, 3),
+        "p99_ms": round(_percentile(all_lat, 99) * 1e3, 3),
+        "by_wire": by_wire,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Closed-loop UDS load generator for the serve runtime"
+    )
+    ap.add_argument("--socket", required=True, help="UDS path (server or router)")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--connections", type=int, default=64)
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument(
+        "--wire", choices=("fast", "json", "mixed"), default="fast"
+    )
+    ap.add_argument("--rows", type=int, default=4)
+    ap.add_argument("--cols", type=int, default=6)
+    ap.add_argument(
+        "--ledger", default="",
+        help="append the result as a JSONL record to this perf ledger",
+    )
+    args = ap.parse_args(argv)
+    result = run_load(
+        args.socket, args.model,
+        connections=args.connections, duration_s=args.duration,
+        wire=args.wire, rows=args.rows, cols=args.cols,
+    )
+    print(json.dumps(result, indent=2))
+    if args.ledger:
+        record = {"type": "serve_loadgen", "timestamp": time.time()}
+        record.update(result)
+        with open(args.ledger, "a", encoding="utf-8") as f:
+            f.write(json.dumps(record) + "\n")
+    return 0 if result["failures"] == 0 and result["requests"] > 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

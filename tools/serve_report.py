@@ -22,7 +22,12 @@ key holds the blob ``bench.py --smoke`` embeds
   ``TPU_ML_SERVE_MAX_DELAY_US`` means the batcher worker, not the window,
   is the bottleneck.
 - the transport mix (http/uds/inproc x json/binary) — how much traffic
-  still pays HTTP+JSON framing vs the fast paths.
+  still pays HTTP+JSON framing vs the fast paths — and the per-lane
+  latency breakdown (p50/p99 per transport/wire pair), which is where a
+  regression in one lane shows up before it moves the blended tail.
+- fleet evidence when the record carries it: replica count, routing
+  hit-rate (consistent-hash affinity vs spill/fallback), drain events and
+  rolling restarts, hedged dispatches and which side won.
 - the adaptive-window trace (``serve.window_effective_seconds``
   percentiles vs the configured ceiling) and continuous-batching riders
   (``serve.joined_in_flight``).
@@ -51,6 +56,11 @@ key holds the blob ``bench.py --smoke`` embeds
     ``TPU_ML_SERVE_MAX_DELAY_US`` ceiling: the device-time feedback is
     not reaching the batcher (or every dispatch is slower than the
     ceiling, which is its own problem).
+  - ``binary-wire-slower-than-json`` — a transport's binary/fast lane
+    posted a higher p99 than its JSON lane. The binary lanes exist to
+    delete codec work; when they lose to JSON the fast path has picked
+    up a regression (pool contention, framing bug) that the blended
+    latency histogram would hide.
 
 Exit status: 0 normally; with ``--strict``, 2 when any anomaly fired OR
 any record had to be skipped (CI gate). Stdlib-only — renders on hosts
@@ -132,6 +142,22 @@ def check_anomalies(summary: dict, wrapper: dict) -> list[str]:
             "device on the hot path; raise TPU_ML_SERVE_HBM_BUDGET_BYTES "
             "or shrink the fleet"
         )
+    by_lane = summary.get("latency_by_transport") or {}
+    for lane, hist in sorted(by_lane.items()):
+        transport, _, lane_wire = lane.partition("/")
+        if lane_wire not in ("fast", "binary") or hist.get("count", 0) < 8:
+            continue
+        json_hist = by_lane.get(f"{transport}/json") or {}
+        if json_hist.get("count", 0) < 8:
+            continue
+        if hist.get("p99", 0.0) > json_hist.get("p99", 0.0):
+            out.append(
+                f"binary-wire-slower-than-json: {lane} p99 "
+                f"{_fmt_s(hist['p99'])} exceeds {transport}/json p99 "
+                f"{_fmt_s(json_hist['p99'])} — the codec-free lane lost "
+                "to the lane it exists to beat; look for response-pool "
+                "contention or framing overhead on the fast path"
+            )
     win_hist = summary.get("window_effective") or {}
     if (
         summary.get("adaptive_window")
@@ -195,6 +221,49 @@ def render_record(rec: dict, out=sys.stdout) -> list[str] | None:
         ]
         print(_table(rows, ["transport/wire", "requests", "share"]), file=out)
 
+    by_lane = summary.get("latency_by_transport") or {}
+    lane_rows = [
+        [
+            lane, f"{h.get('count', 0):g}",
+            _fmt_s(h.get("p50", 0.0)), _fmt_s(h.get("p99", 0.0)),
+            _fmt_s(h.get("max", 0.0)),
+        ]
+        for lane, h in sorted(by_lane.items())
+        if h.get("count")
+    ]
+    if lane_rows:
+        print(
+            _table(lane_rows, ["lane", "requests", "p50", "p99", "max"]),
+            file=out,
+        )
+
+    fleet = summary.get("fleet") or {}
+    if fleet.get("replicas"):
+        hits = fleet.get("route_hits", 0) or 0
+        misses = fleet.get("route_misses", 0) or 0
+        routed = hits + misses
+        line = f"fleet: {fleet['replicas']:g} replica(s)"
+        if routed:
+            line += (
+                f", routing hit-rate {hits / routed:.1%} "
+                f"({hits:g} home / {misses:g} spill-or-fallback)"
+            )
+        drains = fleet.get("drain_events", 0) or 0
+        restarts = fleet.get("replica_restarts", 0) or 0
+        if drains or restarts:
+            line += f", {drains:g} drain(s), {restarts:g} rolling restart(s)"
+        print(line, file=out)
+
+    hedges = summary.get("hedges", 0) or 0
+    if hedges:
+        wins = summary.get("hedge_wins") or {}
+        line = f"hedged dispatches: {hedges:g} issued"
+        if wins:
+            line += " (" + ", ".join(
+                f"{k} won {v:g}" for k, v in sorted(wins.items())
+            ) + ")"
+        print(line, file=out)
+
     page_in = summary.get("page_in", 0) or 0
     page_out = summary.get("page_out", 0) or 0
     if page_in or page_out:
@@ -254,6 +323,17 @@ def render_record(rec: dict, out=sys.stdout) -> list[str] | None:
         if window:
             line += f" (window {_fmt_s(window)})"
         print(line, file=out)
+    qd_us = summary.get("queue_delay_us") or {}
+    if qd_us.get("count"):
+        # the µs-resolution series (values are microseconds, not seconds)
+        print(
+            f"batcher queue delay (us series): "
+            f"p50 {qd_us.get('p50', 0.0):.1f}us / "
+            f"p90 {qd_us.get('p90', 0.0):.1f}us / "
+            f"p99 {qd_us.get('p99', 0.0):.1f}us, "
+            f"max {qd_us.get('max', 0.0):.1f}us",
+            file=out,
+        )
     comp_line = (
         f"compiles: {summary.get('aot_compiles', 0):g} AOT at "
         f"registration, {summary.get('cold_compiles', 0):g} cold in "
