@@ -251,6 +251,9 @@ def _ledger_entry(record: dict) -> dict:
         # serving-stage evidence blob (bucket hits, queue delay, compiles)
         # so tools/serve_report.py renders straight off the ledger
         "serving": record.get("serving"),
+        # hot-swap-under-load proof (blackout, refresh lag, probation):
+        # serve_report's torn-swap checks read this off the same line
+        "refresh": record.get("refresh"),
         # elastic-scheduler counters for the whole bench process: a ledger
         # entry whose wall-clock regressed WITH nonzero hedges/reassigns/
         # quarantines is a sick run, not a perf regression — the sentinel's
@@ -532,6 +535,20 @@ def main() -> None:
         print(f"# serving bench skipped: {e!r}", file=sys.stderr)
         serving_evidence = None
 
+    # --- closed-loop refresh proof (this PR) ------------------------------
+    # live in-process load across an atomic hot-swap: the refresh daemon
+    # folds a delta off the hot path, the shadow-gated swap publishes with
+    # a lock-hold blackout, zero failed requests, zero post-swap compiles,
+    # and probation promotes; hard contract in --smoke, guarded on-chip
+    # like its siblings
+    try:
+        refresh_evidence = _bench_refresh()
+    except Exception as e:
+        if SMOKE:
+            raise
+        print(f"# refresh bench skipped: {e!r}", file=sys.stderr)
+        refresh_evidence = None
+
     # --- multi-process serve fleet proof (this PR) ------------------------
     # 2 supervised replicas behind the consistent-hash router, loadgen on
     # both wires, a rolling drain/restart mid-window with zero failed
@@ -659,6 +676,10 @@ def main() -> None:
                 # tools/serve_report.py; only its three headline numbers
                 # enter the sentinel as extra_metrics below
                 "serving": serving_evidence,
+                # refresh evidence rides whole for tools/serve_report.py
+                # (swap/rollback/probation trail); its blackout + lag
+                # numbers enter the sentinel as extra_metrics below
+                "refresh": refresh_evidence,
                 # fleet evidence rides whole for tools/serve_report.py;
                 # its headline p99/qps/hedge numbers enter the sentinel
                 # as extra_metrics below
@@ -777,6 +798,28 @@ def main() -> None:
                         },
                     ]
                     if serving_evidence is not None
+                    else []
+                )
+                + (
+                    [
+                        {
+                            "metric": "swap_blackout_ms",
+                            "value": refresh_evidence["swap_blackout_ms"],
+                            "unit": "ms",
+                            "note": "registry lock-hold during the atomic "
+                            "hot-swap publish (in-flight dispatches finish "
+                            "on the old kernel; candidate AOT + shadow gate "
+                            "run outside the blackout)",
+                        },
+                        {
+                            "metric": "refresh_lag_s",
+                            "value": refresh_evidence["refresh_lag_s"],
+                            "unit": "seconds",
+                            "note": "last delta fold -> candidate serving "
+                            "(finalize + AOT warm + shadow gate + publish)",
+                        },
+                    ]
+                    if refresh_evidence is not None
                     else []
                 )
                 + (
@@ -1392,6 +1435,136 @@ def _bench_serving() -> dict:
         return evidence
     finally:
         serve_server.stop_serving(stop_monitor=False)
+
+
+def _bench_refresh() -> dict:
+    """Closed-loop refresh proof: serve live in-process traffic while the
+    refresh daemon folds a data delta off the hot path, checkpoints it
+    durably, and atomically hot-swaps the finalized candidate into the
+    registry. Hard contracts: ZERO failed requests across the swap window,
+    ZERO backend compiles after the publish (the candidate AOT-compiles
+    over the live ladder strictly pre-publish), the swap passes the shadow
+    gate, and probation clears to promotion. The swap blackout (registry
+    lock-hold) and refresh lag (last delta fold -> candidate serving) land
+    on the perf ledger as ``swap_blackout_ms`` / ``refresh_lag_s`` for
+    tools/serve_report.py and the sentinel."""
+    import tempfile
+    import threading
+    import time as _time
+
+    from spark_rapids_ml_tpu.models.incremental import (
+        IncrementalLinearRegression,
+    )
+    from spark_rapids_ml_tpu.refresh import RefreshDaemon
+    from spark_rapids_ml_tpu.serving import client as serve_client
+    from spark_rapids_ml_tpu.serving import server as serve_server
+    from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+    rng = np.random.default_rng(29)
+    n = 16
+    coef = rng.normal(size=n)
+
+    def _delta(rows: int, seed: int):
+        r = np.random.default_rng(seed)
+        x = r.normal(size=(rows, n))
+        return x, x @ coef + 0.25
+
+    name = "bench_refresh"
+    ck_dir = tempfile.mkdtemp(prefix="tpu-ml-refresh-bench-")
+    daemon = RefreshDaemon(
+        name,
+        IncrementalLinearRegression(),
+        checkpoint_dir=ck_dir,
+        min_rows=1,
+        shadow_rows=64,
+        probation_s=0.0,
+        probation_slo="serve.latency:p99:10",
+    )
+    try:
+        # v1: seed batch folds, checkpoints, registers (full serve ladder
+        # AOT-compiled at registration — the swap later reuses exactly
+        # these warm buckets)
+        daemon.fold(_delta(4096, 1))
+        daemon.checkpoint()
+        status = daemon.try_swap()
+        if status.get("status") != "registered":
+            raise RuntimeError(f"refresh v1 registration failed: {status}")
+
+        probe = _delta(8, 99)[0]
+        for _ in range(4):  # dispatch-path warmup (AOT is already done)
+            serve_client.predict(name, probe)
+
+        stop = threading.Event()
+        failures: list[Exception] = []
+        completed = [0]
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    serve_client.predict(name, probe)
+                    completed[0] += 1
+                except Exception as e:  # noqa: BLE001 - asserted empty below
+                    failures.append(e)
+                    return
+
+        snap_warm = REGISTRY.snapshot()
+        threads = [threading.Thread(target=hammer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            # the delta arrives, folds off the hot path, and swaps in
+            daemon.fold(_delta(4096, 2))
+            daemon.checkpoint()
+            res = daemon.try_swap()
+            if res.get("status") != "swapped":
+                raise SystemExit(
+                    f"refresh swap did not publish under live load: {res}"
+                )
+            snap_postswap = REGISTRY.snapshot()
+            _time.sleep(0.25)  # post-swap traffic in the measured window
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        now = REGISTRY.snapshot()
+        window = now.delta(snap_warm)
+        post = now.delta(snap_postswap)
+
+        if failures:
+            raise SystemExit(
+                f"refresh swap contract violated: {len(failures)} client "
+                f"request(s) failed across the swap ({failures[0]!r})"
+            )
+        post_recompiles = int(post.hist("compile.seconds").count)
+        if post_recompiles:
+            raise SystemExit(
+                f"refresh swap contract violated: {post_recompiles} backend "
+                "compile(s) AFTER the publish — the candidate ladder was "
+                "not fully AOT-warmed pre-publish"
+            )
+        promotion = daemon.probation_check()
+        if promotion.get("status") != "promoted":
+            raise SystemExit(
+                f"refresh probation did not promote: {promotion}"
+            )
+
+        blackout = window.hist("serve.swap_blackout_seconds").to_dict()
+        evidence = serve_server.serve_summary(window)
+        evidence.pop("type", None)
+        evidence.update(
+            model=name,
+            swap_version=res["version"],
+            swap_blackout_ms=round(blackout.get("max", 0.0) * 1e3, 3),
+            refresh_lag_s=round(res["refresh_lag_s"], 3),
+            requests_during_swap=completed[0],
+            failed_requests=len(failures),
+            post_swap_recompiles=post_recompiles,
+            probation=promotion,
+            checkpoint_dir=ck_dir,
+        )
+        return evidence
+    finally:
+        serve_client.reset_client()
 
 
 def _bench_fleet() -> dict:

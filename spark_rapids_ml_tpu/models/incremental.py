@@ -62,6 +62,22 @@ from spark_rapids_ml_tpu.ops import linear as LIN
 # the fold completes, so the caller's next batch extraction overlaps the
 # device work for free.
 
+# Durable state: every incremental estimator round-trips its carry through
+# ``to_state() -> (arrays, scalars)`` / ``from_state(arrays, scalars)``,
+# the (npz, json) shape utils.checkpoint.TrainingCheckpointer persists
+# atomically. The carries are exact sufficient statistics, so a
+# save/restore mid-stream resumes BITWISE-identically: the restored fold
+# sequence produces the same finalize() as the uninterrupted one (the
+# refresh daemon's restart-survival contract, asserted in tests).
+
+
+def _check_state_kind(est, state: dict) -> None:
+    kind = state.get("kind")
+    if kind != type(est).__name__:
+        raise ValueError(
+            f"checkpoint state is for {kind!r}, not {type(est).__name__}"
+        )
+
 
 def _as_matrix(est, batch: Any) -> np.ndarray:
     """Extract the batch matrix AND pin/verify the stream's feature width."""
@@ -170,6 +186,40 @@ class IncrementalPCA(PCA):
         self._rows_seen = 0
         return self
 
+    def to_state(self) -> tuple[dict[str, np.ndarray], dict]:
+        arrays: dict[str, np.ndarray] = {}
+        if self._acc is not None:
+            arrays["gram_xtx"] = np.asarray(self._acc.xtx)
+            arrays["gram_col_sum"] = np.asarray(self._acc.col_sum)
+            arrays["gram_count"] = np.asarray(self._acc.count)
+        if self._r_acc is not None:
+            arrays["r_acc"] = np.asarray(self._r_acc)
+        return arrays, {
+            "kind": type(self).__name__,
+            "n_cols": self._n_cols,
+            "rows_seen": int(self._rows_seen),
+            "solver_used": getattr(self, "_solver_used", None),
+        }
+
+    def from_state(
+        self, arrays: dict[str, np.ndarray], state: dict
+    ) -> "IncrementalPCA":
+        _check_state_kind(self, state)
+        self.reset()
+        if "gram_xtx" in arrays:
+            self._acc = L.GramStats(
+                jnp.asarray(arrays["gram_xtx"]),
+                jnp.asarray(arrays["gram_col_sum"]),
+                jnp.asarray(arrays["gram_count"]),
+            )
+        if "r_acc" in arrays:
+            self._r_acc = jnp.asarray(arrays["r_acc"])
+        self._n_cols = state.get("n_cols")
+        self._rows_seen = int(state.get("rows_seen", 0))
+        if state.get("solver_used") is not None:
+            self._solver_used = state["solver_used"]
+        return self
+
 
 class IncrementalTruncatedSVD(TruncatedSVD):
     """TruncatedSVD fitted by streaming batches (gram or svd route)."""
@@ -217,6 +267,32 @@ class IncrementalTruncatedSVD(TruncatedSVD):
         self._gram = self._r_acc = self._n_cols = self._solver_used = None
         return self
 
+    def to_state(self) -> tuple[dict[str, np.ndarray], dict]:
+        arrays: dict[str, np.ndarray] = {}
+        if self._gram is not None:
+            arrays["gram"] = np.asarray(self._gram)
+        if self._r_acc is not None:
+            arrays["r_acc"] = np.asarray(self._r_acc)
+        return arrays, {
+            "kind": type(self).__name__,
+            "n_cols": self._n_cols,
+            "solver_used": getattr(self, "_solver_used", None),
+        }
+
+    def from_state(
+        self, arrays: dict[str, np.ndarray], state: dict
+    ) -> "IncrementalTruncatedSVD":
+        _check_state_kind(self, state)
+        self.reset()
+        if "gram" in arrays:
+            self._gram = jnp.asarray(arrays["gram"])
+        if "r_acc" in arrays:
+            self._r_acc = jnp.asarray(arrays["r_acc"])
+        self._n_cols = state.get("n_cols")
+        if state.get("solver_used") is not None:
+            self._solver_used = state["solver_used"]
+        return self
+
 
 class IncrementalStandardScaler(StandardScaler):
     """StandardScaler fitted by streaming batches."""
@@ -248,6 +324,28 @@ class IncrementalStandardScaler(StandardScaler):
 
     def reset(self) -> "IncrementalStandardScaler":
         self._acc = self._n_cols = None
+        return self
+
+    def to_state(self) -> tuple[dict[str, np.ndarray], dict]:
+        arrays: dict[str, np.ndarray] = {}
+        if self._acc is not None:
+            arrays["moment_count"] = np.asarray(self._acc.count)
+            arrays["moment_total"] = np.asarray(self._acc.total)
+            arrays["moment_total_sq"] = np.asarray(self._acc.total_sq)
+        return arrays, {"kind": type(self).__name__, "n_cols": self._n_cols}
+
+    def from_state(
+        self, arrays: dict[str, np.ndarray], state: dict
+    ) -> "IncrementalStandardScaler":
+        _check_state_kind(self, state)
+        self.reset()
+        if "moment_count" in arrays:
+            self._acc = S.MomentStats(
+                jnp.asarray(arrays["moment_count"]),
+                jnp.asarray(arrays["moment_total"]),
+                jnp.asarray(arrays["moment_total_sq"]),
+            )
+        self._n_cols = state.get("n_cols")
         return self
 
 
@@ -308,6 +406,33 @@ class IncrementalLinearRegression(LinearRegression):
     def reset(self) -> "IncrementalLinearRegression":
         self._acc = self._n_cols = None
         self._rows_seen = 0
+        return self
+
+    def to_state(self) -> tuple[dict[str, np.ndarray], dict]:
+        arrays: dict[str, np.ndarray] = {}
+        if self._acc is not None:
+            for fld, value in zip(self._acc._fields, self._acc):
+                arrays[f"linear_{fld}"] = np.asarray(value)
+        return arrays, {
+            "kind": type(self).__name__,
+            "n_cols": self._n_cols,
+            "rows_seen": int(self._rows_seen),
+        }
+
+    def from_state(
+        self, arrays: dict[str, np.ndarray], state: dict
+    ) -> "IncrementalLinearRegression":
+        _check_state_kind(self, state)
+        self.reset()
+        if "linear_xtx" in arrays:
+            self._acc = LIN.LinearStats(
+                *(
+                    jnp.asarray(arrays[f"linear_{fld}"])
+                    for fld in LIN.LinearStats._fields
+                )
+            )
+        self._n_cols = state.get("n_cols")
+        self._rows_seen = int(state.get("rows_seen", 0))
         return self
 
 
@@ -477,6 +602,39 @@ class IncrementalKMeans(KMeans):
         self._rows_seen = 0
         self._last_cost = float("nan")
         self._seed_rows, self._seed_weights = [], []
+        return self
+
+    def to_state(self) -> tuple[dict[str, np.ndarray], dict]:
+        arrays: dict[str, np.ndarray] = {}
+        if self._centers is not None:
+            arrays["centers"] = np.asarray(self._centers)
+            arrays["cum_weights"] = np.asarray(self._cum_weights)
+        if self._seed_rows:
+            # pre-seeding buffers persist concatenated; only the
+            # concatenation is ever consumed downstream
+            arrays["seed_rows"] = np.concatenate(self._seed_rows)
+            arrays["seed_weights"] = np.concatenate(self._seed_weights)
+        return arrays, {
+            "kind": type(self).__name__,
+            "n_cols": self._n_cols,
+            "rows_seen": int(self._rows_seen),
+            "last_cost": self._last_cost,
+        }
+
+    def from_state(
+        self, arrays: dict[str, np.ndarray], state: dict
+    ) -> "IncrementalKMeans":
+        _check_state_kind(self, state)
+        self.reset()
+        if "centers" in arrays:
+            self._centers = jnp.asarray(arrays["centers"])
+            self._cum_weights = jnp.asarray(arrays["cum_weights"])
+        if "seed_rows" in arrays:
+            self._seed_rows = [np.asarray(arrays["seed_rows"])]
+            self._seed_weights = [np.asarray(arrays["seed_weights"])]
+        self._n_cols = state.get("n_cols")
+        self._rows_seen = int(state.get("rows_seen", 0))
+        self._last_cost = float(state.get("last_cost", float("nan")))
         return self
 
 

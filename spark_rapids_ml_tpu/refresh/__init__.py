@@ -1,0 +1,22 @@
+"""Closed-loop model refresh: fold deltas off the hot path, hot-swap safely.
+
+The serving stack was fit-once-serve-forever; this package closes the loop.
+:class:`~.daemon.RefreshDaemon` owns one registry slot's lifecycle:
+
+    deltas → partial_fit (off the hot path) → durable checkpoint →
+    finalize candidate → shadow gate → atomic swap → probation →
+    promoted | rolled back
+
+Every transition is guarded by the robustness machinery earlier PRs built:
+the carry checkpoints ride ``utils.checkpoint.TrainingCheckpointer``'s
+atomic tmp-sweep discipline, the swap is the registry's versioned-slot
+publish (in-flight dispatches finish on the old kernel), probation reuses
+the sliding-window SLO burn detector, and the chaos plan can fault every
+stage (``refresh.fold``, ``refresh.checkpoint``, ``serve.swap``,
+``serve.dispatch``) — with the invariant that every failure mode ends on
+exactly one consistent serving version.
+"""
+
+from spark_rapids_ml_tpu.refresh.daemon import RefreshDaemon
+
+__all__ = ["RefreshDaemon"]

@@ -1,0 +1,379 @@
+"""The refresh daemon: one registry slot's closed fold→swap→probation loop.
+
+Design constraints, in order:
+
+- **Off the hot path.** ``feed()`` only enqueues; all device work
+  (``partial_fit`` folds, candidate AOT compiles, shadow scoring) happens
+  in ``run_once`` — the daemon's thread when started, or the caller's when
+  driven synchronously (tests and the bench drive it synchronously for
+  determinism).
+
+- **Restart survival.** Every fold batch is durable before it can be
+  swapped in: ``checkpoint()`` writes the estimator's exact sufficient
+  statistics (``to_state``) through the atomic
+  :class:`~spark_rapids_ml_tpu.utils.checkpoint.TrainingCheckpointer`;
+  ``resume()`` restores them bitwise, so a daemon killed between folds
+  finalizes the same candidate it would have. A corrupt or truncated
+  checkpoint is skipped by ``latest()``'s readability walk — the daemon
+  comes back with fewer pending rows and simply refuses to swap until the
+  deltas re-fold (the old version keeps serving; chaos-matrix case).
+
+- **Guarded promotion.** The swap itself is
+  :meth:`ModelRegistry.swap` — shadow-scoring parity gate, AOT-warmed
+  ladder, atomic publish — followed by a probation window watched by a
+  fresh :class:`~spark_rapids_ml_tpu.telemetry.slo.SloEngine` seeded at
+  swap time (burn=1: probation is strict — one confirmed burn rolls
+  back). Rollback restores the HBM-resident prior atomically and
+  propagates fleet-wide; probation clearing prunes it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from spark_rapids_ml_tpu.resilience import faults, sites
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.slo import Objective, SloEngine, parse_objectives
+from spark_rapids_ml_tpu.utils import knobs
+from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+logger = logging.getLogger("spark_rapids_ml_tpu.refresh")
+
+REFRESH_INTERVAL_S_VAR = knobs.REFRESH_INTERVAL_S.name
+REFRESH_MIN_ROWS_VAR = knobs.REFRESH_MIN_ROWS.name
+REFRESH_CHECKPOINT_DIR_VAR = knobs.REFRESH_CHECKPOINT_DIR.name
+SWAP_SHADOW_ROWS_VAR = knobs.SWAP_SHADOW_ROWS.name
+SWAP_PROBATION_S_VAR = knobs.SWAP_PROBATION_S.name
+SLO_VAR = knobs.SLO.name
+
+#: npz key the daemon rides its held-back shadow sample on inside the
+#: estimator's checkpoint (from_state ignores unknown keys by design)
+_SHADOW_KEY = "daemon_shadow"
+
+
+def _env_float(var: str, default: str) -> float:
+    raw = os.environ.get(var, "").strip()
+    try:
+        return float(raw) if raw else float(default)
+    except ValueError:
+        return float(default)
+
+
+def _env_int(var: str, default: str) -> int:
+    raw = os.environ.get(var, "").strip()
+    try:
+        return int(raw) if raw else int(default)
+    except ValueError:
+        return int(default)
+
+
+@dataclass
+class _Probation:
+    """One post-swap probation window: a dedicated SLO engine (seeded at
+    swap, so its window covers exactly the post-swap traffic) plus the
+    wall-clock deadline after which the swap is promoted."""
+
+    engine: SloEngine
+    deadline: float
+    version: int
+    evaluations: int = 0
+    extra: dict = field(default_factory=dict)
+
+
+class RefreshDaemon:
+    """Folds data deltas into an incremental estimator and hot-swaps the
+    finalized candidate into the serving registry under guard.
+
+    >>> daemon = RefreshDaemon("lr", IncrementalLinearRegression())
+    >>> daemon.fold((x0, y0)); daemon.try_swap()   # initial version
+    >>> daemon.fold((x1, y1))                      # delta arrives
+    >>> daemon.try_swap()                          # gate → swap → probation
+    >>> daemon.probation_check()                   # promoted / rolled_back
+
+    ``feed``/``run_once``/``start`` wrap the same verbs for background
+    operation; every verb is safe to drive synchronously.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        estimator: Any,
+        *,
+        registry=None,
+        fleet=None,
+        checkpoint_dir: str | None = None,
+        keep: int = 2,
+        min_rows: int | None = None,
+        shadow_rows: int | None = None,
+        tolerance: float | None = None,
+        probation_s: float | None = None,
+        probation_burn: int = 1,
+        probation_slo: str | None = None,
+    ):
+        from spark_rapids_ml_tpu.serving.registry import get_registry
+
+        self.name = name
+        self.estimator = estimator
+        self.registry = registry if registry is not None else get_registry()
+        self.fleet = fleet
+        if checkpoint_dir is None:
+            checkpoint_dir = os.environ.get(
+                REFRESH_CHECKPOINT_DIR_VAR, ""
+            ).strip() or None
+        self.checkpointer = (
+            TrainingCheckpointer(checkpoint_dir, keep=keep)
+            if checkpoint_dir else None
+        )
+        self.min_rows = (
+            min_rows if min_rows is not None
+            else _env_int(REFRESH_MIN_ROWS_VAR, knobs.REFRESH_MIN_ROWS.default)
+        )
+        self.shadow_rows = (
+            shadow_rows if shadow_rows is not None
+            else _env_int(SWAP_SHADOW_ROWS_VAR, knobs.SWAP_SHADOW_ROWS.default)
+        )
+        self.tolerance = tolerance
+        self.probation_s = (
+            probation_s if probation_s is not None
+            else _env_float(SWAP_PROBATION_S_VAR, knobs.SWAP_PROBATION_S.default)
+        )
+        self.probation_burn = max(1, int(probation_burn))
+        self._probation_objectives: tuple[Objective, ...] = parse_objectives(
+            probation_slo if probation_slo is not None
+            else os.environ.get(SLO_VAR, "")
+        )
+        self.refresh_lag_s: float | None = None
+        self._rows_pending = 0
+        self._last_fold_t: float | None = None
+        self._shadow: np.ndarray | None = None
+        self._step = 0
+        self._probation: _Probation | None = None
+        self._queue: list[Any] = []
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- delta intake --------------------------------------------------------
+
+    @staticmethod
+    def _split(batch: Any) -> tuple[np.ndarray, tuple | None]:
+        if isinstance(batch, tuple):
+            return np.asarray(batch[0]), tuple(batch[1:])
+        return np.asarray(batch), None
+
+    def fold(self, batch: Any) -> "RefreshDaemon":
+        """Fold one delta batch into the carry (``refresh.fold`` chaos
+        gate first — before the donated carry consumes anything, so an
+        injected failure leaves the fold retryable)."""
+        x, rest = self._split(batch)
+        x = faults.inject(sites.REFRESH_FOLD, x)
+        self.estimator.partial_fit((x, *rest) if rest is not None else x)
+        rows = int(len(x))
+        self._rows_pending += rows
+        self._last_fold_t = time.monotonic()
+        REGISTRY.counter_inc("refresh.folds")
+        REGISTRY.counter_inc("refresh.rows", rows)
+        if self.shadow_rows > 0:
+            held = x[-self.shadow_rows:]
+            if self._shadow is None or len(held) >= self.shadow_rows:
+                self._shadow = np.array(held, copy=True)
+            else:
+                self._shadow = np.concatenate(
+                    [self._shadow, held]
+                )[-self.shadow_rows:]
+        return self
+
+    @property
+    def rows_pending(self) -> int:
+        return self._rows_pending
+
+    # -- durable state -------------------------------------------------------
+
+    def checkpoint(self) -> int | None:
+        """Persist the carry atomically; returns the step written (None
+        without a checkpoint dir). The ``refresh.checkpoint`` chaos gate
+        fires before the write — an injected I/O failure or kill leaves
+        the previous durable step intact (tmp-sweep discipline)."""
+        if self.checkpointer is None:
+            return None
+        faults.inject(sites.REFRESH_CHECKPOINT)
+        self._step += 1
+        arrays, state = self.estimator.to_state()
+        state["rows_pending"] = self._rows_pending
+        if self._shadow is not None:
+            arrays = {**arrays, _SHADOW_KEY: self._shadow}
+        self.checkpointer.save(self._step, arrays, state)
+        REGISTRY.counter_inc("refresh.checkpoints")
+        return self._step
+
+    def resume(self) -> bool:
+        """Restore the newest readable checkpoint (bitwise — the restored
+        fold stream finalizes identically). Returns False when nothing
+        durable is readable; the daemon then starts empty and the swap
+        gate's min-rows floor keeps the old version serving."""
+        if self.checkpointer is None:
+            return False
+        latest = self.checkpointer.latest()
+        if latest is None:
+            return False
+        step, arrays, state = latest
+        shadow = arrays.pop(_SHADOW_KEY, None)
+        try:
+            self.estimator.from_state(arrays, state)
+        except Exception:  # noqa: BLE001 - schema drift = start empty, not crash
+            logger.exception(
+                "refresh checkpoint step %d unusable; starting empty", step
+            )
+            return False
+        self._step = step
+        self._rows_pending = int(state.get("rows_pending", 0))
+        if shadow is not None:
+            self._shadow = np.asarray(shadow)
+        REGISTRY.counter_inc("refresh.resumes")
+        return True
+
+    # -- swap / probation ----------------------------------------------------
+
+    def try_swap(self) -> dict:
+        """Finalize a candidate from the pending deltas and hot-swap it —
+        shadow gate, atomic publish, fleet propagation, then probation.
+        Returns a status dict; ``refused``/``waiting`` leave the old
+        version serving untouched."""
+        from spark_rapids_ml_tpu.serving.registry import SwapRefused
+
+        if self._probation is not None:
+            return self.probation_check()
+        if self._rows_pending < self.min_rows:
+            return {
+                "status": "waiting",
+                "rows_pending": self._rows_pending,
+                "min_rows": self.min_rows,
+            }
+        model = self.estimator.finalize()
+        REGISTRY.counter_inc("refresh.finalizes")
+        shadow = self._shadow if self.shadow_rows > 0 else None
+        try:
+            entry = self.registry.swap(
+                self.name, model,
+                shadow_sample=shadow, tolerance=self.tolerance,
+            )
+        except KeyError:
+            # nothing live yet: first finalize registers the slot
+            entry = self.registry.register(self.name, model)
+            self._rows_pending = 0
+            return {"status": "registered", "version": entry.version}
+        except SwapRefused as e:
+            logger.warning("swap of %s refused: %s", self.name, e)
+            return {"status": "refused", "reason": str(e)}
+        lag = (
+            time.monotonic() - self._last_fold_t
+            if self._last_fold_t is not None else 0.0
+        )
+        self.refresh_lag_s = lag
+        REGISTRY.gauge_set("refresh.lag_seconds", lag, model=self.name)
+        self._rows_pending = 0
+        if self.fleet is not None:
+            self.fleet.swap_models({self.name: model})
+        self._probation = _Probation(
+            engine=SloEngine(
+                self._probation_objectives,
+                window_s=max(1.0, self.probation_s),
+                burn=self.probation_burn,
+            ),
+            deadline=time.monotonic() + self.probation_s,
+            version=entry.version,
+        )
+        return {
+            "status": "swapped",
+            "version": entry.version,
+            "refresh_lag_s": lag,
+        }
+
+    def probation_check(self) -> dict:
+        """One probation evaluation: an SLO burn since the swap rolls back
+        to the retained prior (fleet-wide); an expired deadline promotes
+        the candidate and prunes the prior."""
+        p = self._probation
+        if p is None:
+            return {"status": "idle"}
+        p.engine.evaluate()
+        p.evaluations += 1
+        if p.engine.total_breaches() > 0:
+            prior = self.registry.rollback(self.name)
+            if self.fleet is not None and prior.model is not None:
+                self.fleet.swap_models({self.name: prior.model})
+            self._probation = None
+            return {
+                "status": "rolled_back",
+                "version": prior.version,
+                "from_version": p.version,
+            }
+        if time.monotonic() >= p.deadline:
+            self.registry.prune_prior(self.name)
+            self._probation = None
+            return {"status": "promoted", "version": p.version}
+        return {
+            "status": "probation",
+            "version": p.version,
+            "evaluations": p.evaluations,
+        }
+
+    @property
+    def in_probation(self) -> bool:
+        return self._probation is not None
+
+    # -- background operation ------------------------------------------------
+
+    def feed(self, batch: Any) -> None:
+        """Enqueue a delta without touching the device (hot-path safe)."""
+        with self._lock:
+            self._queue.append(batch)
+
+    def run_once(self) -> dict:
+        """One daemon cycle: drain queued deltas, fold, checkpoint, then
+        either advance probation or attempt a swap."""
+        with self._lock:
+            drained, self._queue = self._queue, []
+        for batch in drained:
+            self.fold(batch)
+        if drained and self.checkpointer is not None:
+            self.checkpoint()
+        if self._probation is not None:
+            return self.probation_check()
+        return self.try_swap()
+
+    def start(self, interval_s: float | None = None) -> "RefreshDaemon":
+        if self._thread is not None:
+            return self
+        if interval_s is None:
+            interval_s = _env_float(
+                REFRESH_INTERVAL_S_VAR, knobs.REFRESH_INTERVAL_S.default
+            )
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.run_once()
+                except Exception:  # noqa: BLE001 - the loop must survive a bad cycle
+                    logger.exception("refresh cycle failed for %s", self.name)
+
+        self._thread = threading.Thread(
+            target=_loop, name=f"tpu-ml-refresh-{self.name}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout)
+        self._thread = None

@@ -25,6 +25,15 @@ AUTOTUNE_TRIAL = "autotune.trial"  # one timing trial of an autotune search
 # plan can fail exactly one dispatch / one rank of one epoch
 SCHEDULER_TASK = "scheduler.task"  # one task dispatch by the work queue
 SCHEDULER_RANK = "scheduler.rank"  # one rank launch of a barrier epoch
+# serving/refresh plane: the closed-loop model-refresh chaos surface.
+# serve.dispatch counts per process (a fleet replica counts its own
+# dispatches, so a plan can kill exactly one replica mid-request);
+# serve.swap fires BEFORE the atomic registry publish, so any injected
+# death/hang leaves the old version serving consistently — never torn
+SERVE_DISPATCH = "serve.dispatch"  # one compiled-kernel dispatch
+SERVE_SWAP = "serve.swap"          # hot-swap barrier, pre-publish
+REFRESH_FOLD = "refresh.fold"      # one delta partial_fit fold
+REFRESH_CHECKPOINT = "refresh.checkpoint"  # one durable carry checkpoint
 
 FAULT_SITES: frozenset[str] = frozenset({
     WORKER_TASK,
@@ -36,4 +45,8 @@ FAULT_SITES: frozenset[str] = frozenset({
     AUTOTUNE_TRIAL,
     SCHEDULER_TASK,
     SCHEDULER_RANK,
+    SERVE_DISPATCH,
+    SERVE_SWAP,
+    REFRESH_FOLD,
+    REFRESH_CHECKPOINT,
 })

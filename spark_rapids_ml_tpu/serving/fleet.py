@@ -787,6 +787,28 @@ class ServeFleet:
         REGISTRY.gauge_set("serve.fleet_replicas", self.live_replicas())
         return ok
 
+    # -- fleet-wide hot-swap propagation -------------------------------------
+
+    def swap_models(
+        self, models: dict[str, object], timeout: float = _SPAWN_TIMEOUT_S
+    ) -> bool:
+        """Propagate a hot-swap to every replica: merge ``models`` into the
+        fleet spec, then rolling-restart each slot through the existing
+        drain discipline — a draining slot finishes its in-flight requests
+        on the old spec while the ring routes new admissions around it, so
+        the fleet converges replica-by-replica to the new version with
+        zero client-visible failures (the chaos matrix kills a replica in
+        the middle of exactly this walk). Returns True when every replica
+        came back READY on the new spec."""
+        current = load_spec(self.spec_path)
+        current.update(models)
+        self.param_bytes = write_spec(self.spec_path, current)
+        self.placement = plan_placement(self.param_bytes, self.replicas)
+        ok = True
+        for slot in range(self.replicas):
+            ok = self.restart_replica(slot, timeout) and ok
+        return ok
+
     # -- introspection -------------------------------------------------------
 
     def stats(self) -> dict:

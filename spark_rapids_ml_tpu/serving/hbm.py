@@ -129,16 +129,19 @@ class HbmFleetManager:
         with self._lock:
             return sum(r.nbytes for r in self._models.values() if r.resident)
 
-    def account(self, entry: Any) -> None:
+    def account(self, entry: Any, *, key: str | None = None) -> None:
         """Admit a (re-)registered servable: measure its params, mark it
         most-recently-used, and page colder models out until the fleet
-        fits the budget again."""
+        fits the budget again. ``key`` overrides the booking key (the hot
+        swap books the prior version under ``<name>@prior`` so it stays
+        HBM-resident — and rollback-ready — until probation clears)."""
+        key = key or entry.name
         with self._lock:
             self._seq += 1
-            self._models[entry.name] = _Resident(
+            self._models[key] = _Resident(
                 entry, param_bytes(entry.params), self._seq
             )
-            self._evict_to_fit(protect=entry.name)
+            self._evict_to_fit(protect=key)
             self._publish()
 
     def forget(self, name: str) -> None:
@@ -204,8 +207,8 @@ class HbmFleetManager:
         used = sum(r.nbytes for r in self._models.values() if r.resident)
         victims = sorted(
             (
-                r for r in self._models.values()
-                if r.resident and r.entry.name != protect
+                r for k, r in self._models.items()
+                if r.resident and k != protect
             ),
             key=lambda r: r.seq,
         )

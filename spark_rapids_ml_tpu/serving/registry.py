@@ -43,20 +43,30 @@ import functools
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 import numpy as np
 
+from spark_rapids_ml_tpu.resilience import faults, sites
 from spark_rapids_ml_tpu.serving import buckets, hbm
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 from spark_rapids_ml_tpu.utils import knobs
 
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
 
 SERVE_COMPILE_CACHE_DIR_VAR = knobs.SERVE_COMPILE_CACHE_DIR.name
+SWAP_SHADOW_TOLERANCE_VAR = knobs.SWAP_SHADOW_TOLERANCE.name
 
 FAMILIES = ("pca", "linear", "scaler", "forest", "ann")
+
+
+class SwapRefused(RuntimeError):
+    """A hot-swap candidate was refused before publish — shadow-scoring
+    divergence past tolerance, or a structural mismatch with the live
+    entry. The old version keeps serving; nothing was torn."""
 
 #: Input dtypes a serve request may carry. Integer/bool payloads (JSON
 #: numbers decode to them) are widened to float64 first; float16/bfloat16/
@@ -243,6 +253,7 @@ class ServableEntry:
     policy: str = "f32"
     row_axis: int = 0                 # rows axis of the raw kernel output
     token: int = 0
+    version: int = 1                  # bumped by every hot-swap of the slot
     warm_buckets: set[int] = field(default_factory=set)
     model: Any = None
 
@@ -253,6 +264,7 @@ class ServableEntry:
             "model_class": self.model_cls,
             "n_features": self.n_features,
             "policy": self.policy,
+            "version": self.version,
             "buckets": sorted(self.warm_buckets),
         }
 
@@ -499,6 +511,9 @@ class ModelRegistry:
 
     def __init__(self):
         self._entries: dict[str, ServableEntry] = {}
+        # prior version of a hot-swapped slot, kept dispatchable (and
+        # HBM-resident) until probation clears or rollback restores it
+        self._prior: dict[str, ServableEntry] = {}
         self._lock = threading.RLock()
         # (token, device_index) pairs with warm hedge executables + params
         self._hedge_warm: set[tuple[int, int]] = set()
@@ -526,6 +541,7 @@ class ModelRegistry:
         with self._lock:
             self._entries[name] = entry
             REGISTRY.gauge_set("serve.models", len(self._entries))
+        REGISTRY.gauge_set("serve.model_version", entry.version, model=name)
         # book the params against the HBM fleet budget; registering past it
         # pages the least-recently-used cold models to host
         hbm.get_fleet().account(entry)
@@ -556,8 +572,193 @@ class ModelRegistry:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._prior.clear()
             self._hedge_warm.clear()
             REGISTRY.gauge_set("serve.models", 0)
+
+    # -- versioned hot-swap / rollback --------------------------------------
+
+    @staticmethod
+    def _prior_key(name: str) -> str:
+        return f"{name}@prior"
+
+    def _run_entry(self, entry: ServableEntry, mat: np.ndarray) -> np.ndarray:
+        """Score a prepared-dtype host matrix through one specific entry —
+        the shadow gate's scorer and ``predict``'s body, minus the name
+        lookup (so a gate never races the slot it is gating)."""
+        prepared = entry.prepare(mat)
+        if prepared.dtype != entry.x_dtype:
+            prepared = prepared.astype(entry.x_dtype)
+        bucket = buckets.serve_bucket(prepared.shape[0])
+        padded, true_rows = buckets.pad_to_bucket(prepared, bucket)
+        raw = self.dispatch_padded(entry, padded, bucket)
+        return entry.finalize(raw, true_rows)
+
+    @staticmethod
+    def _shadow_divergence(
+        live_out: np.ndarray, cand_out: np.ndarray
+    ) -> float:
+        """Relative divergence of the candidate's shadow scores against the
+        live model's: max absolute difference over the live output's max
+        magnitude. Shape mismatches are infinite divergence."""
+        a = np.asarray(live_out, dtype=np.float64)
+        b = np.asarray(cand_out, dtype=np.float64)
+        if a.shape != b.shape or not (
+            np.all(np.isfinite(a)) and np.all(np.isfinite(b))
+        ):
+            return float("inf")
+        scale = float(np.max(np.abs(a))) + 1e-12
+        return float(np.max(np.abs(a - b))) / scale
+
+    def shadow_tolerance(self) -> float:
+        raw = os.environ.get(SWAP_SHADOW_TOLERANCE_VAR, "").strip()
+        try:
+            return float(raw) if raw else float(
+                knobs.SWAP_SHADOW_TOLERANCE.default
+            )
+        except ValueError:
+            return float(knobs.SWAP_SHADOW_TOLERANCE.default)
+
+    def swap(
+        self,
+        name: str,
+        model: Any,
+        *,
+        shadow_sample: np.ndarray | None = None,
+        tolerance: float | None = None,
+        bucket_list: tuple[int, ...] | None = None,
+    ) -> ServableEntry:
+        """Atomically hot-swap slot ``name`` to a freshly fitted ``model``.
+
+        Everything expensive happens BEFORE the atomic section: the
+        candidate's kernel is AOT-compiled across the live entry's warm
+        bucket ladder (a swap never compiles on the request path — the
+        zero-recompile contract survives the swap), and the shadow-scoring
+        gate scores candidate vs live on ``shadow_sample``, raising
+        :class:`SwapRefused` past ``tolerance`` (default
+        ``TPU_ML_SWAP_SHADOW_TOLERANCE``). The publish itself is one dict
+        store under the lock — in-flight dispatches hold their entry
+        reference and finish on the old kernel while new admissions route
+        to the new one; the lock-hold time is the swap blackout
+        (``serve.swap_blackout_seconds``, stamped on the perf ledger as
+        ``swap_blackout_ms``).
+
+        The displaced version is retained (HBM-resident, booked under
+        ``<name>@prior``) until :meth:`prune_prior` — the probation
+        contract — or :meth:`rollback` restores it."""
+        live = self.get(name)
+        enable_serve_compile_cache()
+        candidate = servable_from_model(name, model)
+        if candidate.n_features != live.n_features:
+            REGISTRY.counter_inc("serve.swap_refused", model=name,
+                                 reason="shape")
+            raise SwapRefused(
+                f"swap of {name!r} refused: candidate n_features "
+                f"{candidate.n_features} != live {live.n_features}"
+            )
+        candidate.token = _next_token(candidate)
+        ladder = (
+            tuple(bucket_list) if bucket_list
+            else tuple(sorted(live.warm_buckets)) or buckets.bucket_ladder()
+        )
+        for b in ladder:
+            _compiled_for(candidate.token, b)
+            candidate.warm_buckets.add(b)
+        if shadow_sample is not None and len(shadow_sample):
+            sample = validate_request(
+                shadow_sample, live.n_features, name
+            )
+            div = self._shadow_divergence(
+                self._run_entry(live, sample),
+                self._run_entry(candidate, sample),
+            )
+            tol = self.shadow_tolerance() if tolerance is None else tolerance
+            if div > tol:
+                REGISTRY.counter_inc("serve.swap_refused", model=name,
+                                     reason="shadow")
+                raise SwapRefused(
+                    f"swap of {name!r} refused by the shadow gate: "
+                    f"relative divergence {div:.3g} > tolerance {tol:.3g} "
+                    f"on {len(sample)} held-back rows"
+                )
+        # the swap barrier: a chaos plan can hang or kill here — both land
+        # strictly before the publish, so the old version keeps serving
+        # consistently (never a torn slot)
+        faults.inject(sites.SERVE_SWAP)
+        t0 = time.perf_counter()
+        with self._lock:
+            prior = self._entries.get(name, live)
+            candidate.version = prior.version + 1
+            self._entries[name] = candidate
+            self._prior[name] = prior
+        blackout = time.perf_counter() - t0
+        REGISTRY.histogram_record(
+            "serve.swap_blackout_seconds", blackout, model=name
+        )
+        REGISTRY.counter_inc("serve.swaps", model=name)
+        REGISTRY.gauge_set(
+            "serve.model_version", candidate.version, model=name
+        )
+        TIMELINE.record_instant(
+            "serve.swap", model=name, version=candidate.version
+        )
+        # the prior stays HBM-resident (rollback must not page) until
+        # probation clears; the candidate books under the live key
+        fleet = hbm.get_fleet()
+        fleet.account(prior, key=self._prior_key(name))
+        fleet.account(candidate)
+        logger.info(
+            "hot-swapped servable %s to version %d (blackout %.3f ms)",
+            name, candidate.version, blackout * 1e3,
+        )
+        return candidate
+
+    def rollback(self, name: str) -> ServableEntry:
+        """Restore the retained prior version of ``name`` — the SLO-burn
+        probation escape hatch. Atomic like the swap; the demoted candidate
+        is dropped from the registry (in-flight dispatches on it still
+        finish on their entry reference)."""
+        with self._lock:
+            prior = self._prior.pop(name, None)
+            if prior is None:
+                raise KeyError(
+                    f"no prior version of {name!r} to roll back to"
+                )
+            self._entries[name] = prior
+        REGISTRY.counter_inc("serve.rollback", model=name)
+        REGISTRY.gauge_set("serve.model_version", prior.version, model=name)
+        TIMELINE.record_instant(
+            "serve.rollback", model=name, version=prior.version
+        )
+        fleet = hbm.get_fleet()
+        fleet.account(prior)  # rebook under the live key, MRU again
+        fleet.forget(self._prior_key(name))
+        logger.warning(
+            "rolled back servable %s to version %d", name, prior.version
+        )
+        return prior
+
+    def prune_prior(self, name: str) -> bool:
+        """Probation cleared: release the retained prior version (its HBM
+        booking is forgotten; its executables age out of the AOT cache with
+        the token)."""
+        with self._lock:
+            prior = self._prior.pop(name, None)
+        if prior is None:
+            return False
+        hbm.get_fleet().forget(self._prior_key(name))
+        logger.info(
+            "pruned prior version %d of servable %s (probation cleared)",
+            prior.version, name,
+        )
+        return True
+
+    def prior_entry(self, name: str) -> ServableEntry | None:
+        with self._lock:
+            return self._prior.get(name)
+
+    def current_version(self, name: str) -> int:
+        return self.get(name).version
 
     # -- dispatch -----------------------------------------------------------
 
@@ -571,6 +772,10 @@ class ModelRegistry:
         tools/serve_report.py flags."""
         import jax.numpy as jnp
 
+        # chaos gate: counted per process, so a fleet plan can kill exactly
+        # one replica mid-request (the router's buffered-frame retry is the
+        # recovery under test). Before any state — a retry re-enters clean.
+        faults.inject(sites.SERVE_DISPATCH)
         # repage the model's params if fleet pressure evicted them to host
         # (touches its LRU clock either way); the compiled executable is
         # shape-keyed and survives paging untouched

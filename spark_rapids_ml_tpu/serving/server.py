@@ -705,6 +705,29 @@ def serve_summary(snap) -> dict:
             "drain_events": snap.counter("serve.drain_events"),
             "replica_restarts": snap.counter("serve.replica_restarts"),
         },
+        "refresh": {
+            "swaps": snap.counter("serve.swaps"),
+            "swap_refused": snap.counter("serve.swap_refused"),
+            "rollbacks": snap.counter("serve.rollback"),
+            "swap_blackout": snap.hist("serve.swap_blackout_seconds").to_dict(),
+            "folds": snap.counter("refresh.folds"),
+            "rows": snap.counter("refresh.rows"),
+            "finalizes": snap.counter("refresh.finalizes"),
+            "checkpoints": snap.counter("refresh.checkpoints"),
+            "resumes": snap.counter("refresh.resumes"),
+            "versions": {
+                str(dict(lbl).get("model", "?")): int(v)
+                for (n, lbl), v in snap.gauges.items()
+                if n == "serve.model_version"
+            },
+            "lag_seconds": max(
+                (
+                    v for (n, _), v in snap.gauges.items()
+                    if n == "refresh.lag_seconds"
+                ),
+                default=None,
+            ),
+        },
     }
 
 

@@ -108,6 +108,18 @@ METRICS: frozenset[str] = frozenset({
     "serve.route_misses",
     "serve.drain_events",
     "serve.replica_restarts",
+    # closed-loop model refresh / atomic hot-swap (refresh + serving.registry)
+    "serve.swaps",
+    "serve.swap_refused",
+    "serve.rollback",
+    "serve.swap_blackout_seconds",
+    "serve.model_version",
+    "refresh.folds",
+    "refresh.rows",
+    "refresh.checkpoints",
+    "refresh.resumes",
+    "refresh.finalizes",
+    "refresh.lag_seconds",
     # ANN vector search subsystem (spark_rapids_ml_tpu.ann)
     "ann.queries",
     "ann.build_rows",
@@ -260,4 +272,6 @@ INSTANTS: frozenset[str] = frozenset({
     "scheduler.barrier_retry",
     "scheduler.admission",
     "worker.quarantine",
+    "serve.swap",
+    "serve.rollback",
 })

@@ -234,6 +234,28 @@ _DECLARATIONS = (
          "rolling drain bound: max seconds the fleet router waits for a "
          "draining replica's in-flight requests to reach zero before the "
          "replica is restarted anyway", "serving.fleet"),
+    # -- closed-loop model refresh (spark_rapids_ml_tpu.refresh) ------------
+    Knob("TPU_ML_REFRESH_INTERVAL_S", "float", "30",
+         "seconds between refresh-daemon cycles (fold pending deltas, "
+         "checkpoint, attempt a hot-swap)", "refresh.daemon"),
+    Knob("TPU_ML_REFRESH_MIN_ROWS", "int", "1",
+         "delta rows that must fold before the daemon finalizes a "
+         "candidate and attempts a swap", "refresh.daemon"),
+    Knob("TPU_ML_REFRESH_CHECKPOINT_DIR", "path", "",
+         "directory for the refresh daemon's durable carry checkpoints "
+         "(atomic npz; empty = memory-only, no restart survival)",
+         "refresh.daemon"),
+    Knob("TPU_ML_SWAP_SHADOW_ROWS", "int", "256",
+         "held-back sample rows the shadow-scoring gate scores a swap "
+         "candidate against the live model on (0 disables the gate)",
+         "refresh.daemon"),
+    Knob("TPU_ML_SWAP_SHADOW_TOLERANCE", "float", "0.25",
+         "max relative divergence between candidate and live outputs on "
+         "the shadow sample before the swap is refused", "serving.registry"),
+    Knob("TPU_ML_SWAP_PROBATION_S", "float", "60",
+         "post-swap probation window: an SLO burn inside it rolls back to "
+         "the prior version (which stays HBM-resident until probation "
+         "clears)", "refresh.daemon"),
     # -- transport monitor / health daemon (tools/healthd.py) ---------------
     Knob("TPU_ML_MONITOR_BENCH_OUT", "path", "BENCH_OPPORTUNISTIC_r05.json",
          "opportunistic bench output file (relative to the repo)",
@@ -356,6 +378,12 @@ SERVE_HEDGE_FLOOR_US = KNOBS["TPU_ML_SERVE_HEDGE_FLOOR_US"]
 SERVE_FLEET_REPLICAS = KNOBS["TPU_ML_SERVE_FLEET_REPLICAS"]
 SERVE_FLEET_SOCKET_DIR = KNOBS["TPU_ML_SERVE_FLEET_SOCKET_DIR"]
 SERVE_DRAIN_TIMEOUT_S = KNOBS["TPU_ML_SERVE_DRAIN_TIMEOUT_S"]
+REFRESH_INTERVAL_S = KNOBS["TPU_ML_REFRESH_INTERVAL_S"]
+REFRESH_MIN_ROWS = KNOBS["TPU_ML_REFRESH_MIN_ROWS"]
+REFRESH_CHECKPOINT_DIR = KNOBS["TPU_ML_REFRESH_CHECKPOINT_DIR"]
+SWAP_SHADOW_ROWS = KNOBS["TPU_ML_SWAP_SHADOW_ROWS"]
+SWAP_SHADOW_TOLERANCE = KNOBS["TPU_ML_SWAP_SHADOW_TOLERANCE"]
+SWAP_PROBATION_S = KNOBS["TPU_ML_SWAP_PROBATION_S"]
 MONITOR_BENCH_OUT = KNOBS["TPU_ML_MONITOR_BENCH_OUT"]
 MONITOR_DRIFT_OUT = KNOBS["TPU_ML_MONITOR_DRIFT_OUT"]
 MONITOR_INTERVAL_S = KNOBS["TPU_ML_MONITOR_INTERVAL_S"]

@@ -579,3 +579,414 @@ class TestAdmissionControl:
         adm = model.fit_report.admission
         assert adm["action"] == "admit"
         assert "no health evidence" in adm["reason"]
+
+
+# -- serving plane: hot-swap, refresh, rollback (ISSUE-18) -------------------
+# invariant under every fault below: the registry ends on exactly ONE
+# consistent serving version — never a torn slot, never a client-visible
+# wrong answer
+
+
+def _fit_lin_pair():
+    """Live model + a genuinely different candidate (flipped target)."""
+    rng = np.random.default_rng(41)
+    x = rng.normal(size=(128, 6))
+    y = x @ np.arange(1.0, 7.0)
+    return (
+        x,
+        LinearRegression().fit((x, y)),
+        LinearRegression().fit((x, -y)),
+    )
+
+
+class TestServingSwapChaos:
+    @pytest.fixture(autouse=True)
+    def serve_clean(self):
+        yield
+        from spark_rapids_ml_tpu.serving import client as client_mod
+        from spark_rapids_ml_tpu.serving import registry as registry_mod
+        from spark_rapids_ml_tpu.serving import server as server_mod
+
+        client_mod.reset_client()
+        server_mod.stop_serving(stop_monitor=False)
+        registry_mod.reset_for_tests()
+
+    def test_swap_barrier_fault_never_tears_the_slot(self, monkeypatch, snap):
+        """An I/O fault at the serve.swap barrier lands strictly before
+        the publish: the old version keeps serving bitwise, and the
+        retried swap completes cleanly."""
+        from spark_rapids_ml_tpu.serving import registry as registry_mod
+
+        x, old, new = _fit_lin_pair()
+        reg = registry_mod.get_registry()
+        reg.register("lin", old, bucket_list=(8, 16))
+        out_old = reg.predict("lin", x[:8])
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "serve.swap:io:1")
+        faults.reset_faults()
+        with pytest.raises(faults.InjectedTransientIOError):
+            reg.swap("lin", new, tolerance=100.0)
+        assert reg.current_version("lin") == 1
+        assert np.array_equal(reg.predict("lin", x[:8]), out_old)
+        d = snap.delta()
+        assert d.counter("fault.injected", site="serve.swap", kind="io") == 1
+        assert d.counter("serve.swaps") == 0
+        assert d.hist("serve.swap_blackout_seconds").count == 0
+        # the nth-occurrence plan is spent: the retry publishes v2
+        entry = reg.swap("lin", new, tolerance=100.0)
+        assert entry.version == 2
+        d = snap.delta()
+        assert d.counter("serve.swaps") == 1
+        assert d.hist("serve.swap_blackout_seconds").count == 1
+
+    def test_swap_hang_does_not_extend_the_blackout(self, monkeypatch, snap):
+        """A hang at the barrier delays the swap, not the serving plane:
+        the blackout (lock-hold) stays tiny because every slow step sits
+        outside the atomic section."""
+        from spark_rapids_ml_tpu.serving import registry as registry_mod
+
+        x, old, new = _fit_lin_pair()
+        reg = registry_mod.get_registry()
+        reg.register("lin", old, bucket_list=(8,))
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "serve.swap:hang:1:0.3")
+        faults.reset_faults()
+        entry = reg.swap("lin", new, tolerance=100.0)
+        assert entry.version == 2
+        d = snap.delta()
+        assert d.counter("fault.injected", site="serve.swap", kind="hang") == 1
+        black = d.hist("serve.swap_blackout_seconds")
+        assert black.count == 1
+        # the 0.3s hang fired pre-publish; the publish itself stayed fast
+        assert black.total < 0.25
+
+    def test_dispatch_fault_is_one_request_not_a_torn_slot(
+        self, monkeypatch, snap
+    ):
+        from spark_rapids_ml_tpu.serving import registry as registry_mod
+
+        x, old, _ = _fit_lin_pair()
+        reg = registry_mod.get_registry()
+        reg.register("lin", old, bucket_list=(8,))
+        out = reg.predict("lin", x[:8])
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "serve.dispatch:io:1")
+        faults.reset_faults()
+        with pytest.raises(faults.InjectedTransientIOError):
+            reg.predict("lin", x[:8])
+        # the very next request serves the same consistent version
+        assert np.array_equal(reg.predict("lin", x[:8]), out)
+        assert reg.current_version("lin") == 1
+        d = snap.delta()
+        assert d.counter("fault.injected", site="serve.dispatch", kind="io") == 1
+
+
+class TestRefreshChaos:
+    @pytest.fixture(autouse=True)
+    def serve_clean(self):
+        yield
+        from spark_rapids_ml_tpu.serving import client as client_mod
+        from spark_rapids_ml_tpu.serving import registry as registry_mod
+        from spark_rapids_ml_tpu.serving import server as server_mod
+
+        client_mod.reset_client()
+        server_mod.stop_serving(stop_monitor=False)
+        registry_mod.reset_for_tests()
+
+    @staticmethod
+    def _delta(n: int, seed: int, flip: float = 1.0):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, 6))
+        return x, flip * (x @ np.arange(1.0, 7.0))
+
+    def test_fold_fault_leaves_carry_retryable(self, monkeypatch, snap):
+        """An injected fold failure consumes nothing: the carry and the
+        pending-row count are untouched, and refolding the same delta
+        finalizes bitwise with the never-faulted oracle."""
+        from spark_rapids_ml_tpu.models.incremental import (
+            IncrementalLinearRegression,
+        )
+        from spark_rapids_ml_tpu.refresh import RefreshDaemon
+
+        d = RefreshDaemon(
+            "lr", IncrementalLinearRegression(), min_rows=1, shadow_rows=0
+        )
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "refresh.fold:io:1")
+        faults.reset_faults()
+        with pytest.raises(faults.InjectedTransientIOError):
+            d.fold(self._delta(64, 0))
+        assert d.rows_pending == 0
+        d.fold(self._delta(64, 0))
+        oracle = IncrementalLinearRegression().partial_fit(self._delta(64, 0))
+        assert np.array_equal(
+            np.asarray(d.estimator.finalize().coefficients),
+            np.asarray(oracle.finalize().coefficients),
+        )
+        dlt = snap.delta()
+        assert dlt.counter("fault.injected", site="refresh.fold", kind="io") == 1
+        assert dlt.counter("refresh.folds") == 1
+
+    def test_checkpoint_fault_keeps_previous_durable_step(
+        self, monkeypatch, tmp_path, snap
+    ):
+        from spark_rapids_ml_tpu.models.incremental import (
+            IncrementalLinearRegression,
+        )
+        from spark_rapids_ml_tpu.refresh import RefreshDaemon
+
+        d = RefreshDaemon(
+            "lr", IncrementalLinearRegression(),
+            checkpoint_dir=str(tmp_path), min_rows=1, shadow_rows=0,
+        )
+        d.fold(self._delta(64, 0))
+        assert d.checkpoint() == 1
+        d.fold(self._delta(32, 1))
+        monkeypatch.setenv(faults.FAULT_PLAN_VAR, "refresh.checkpoint:io:1")
+        faults.reset_faults()
+        with pytest.raises(faults.InjectedTransientIOError):
+            d.checkpoint()
+        # step 1 is still the durable truth, readable and complete
+        step, arrays, state = d.checkpointer.latest()
+        assert step == 1 and state["rows_pending"] == 64
+        # and the spent plan lets the next checkpoint land as step 2
+        assert d.checkpoint() == 2
+        assert d.checkpointer.latest()[2]["rows_pending"] == 96
+        assert snap.delta().counter(
+            "fault.injected", site="refresh.checkpoint", kind="io"
+        ) == 1
+
+    def test_corrupt_checkpoint_refuses_swap_old_keeps_serving(
+        self, tmp_path, snap
+    ):
+        """A truncated checkpoint must not produce a candidate: resume
+        skips the unreadable step, the min-rows floor refuses the swap,
+        and the registered version keeps serving untouched."""
+        from spark_rapids_ml_tpu.models.incremental import (
+            IncrementalLinearRegression,
+        )
+        from spark_rapids_ml_tpu.refresh import RefreshDaemon
+        from spark_rapids_ml_tpu.serving import registry as registry_mod
+
+        reg = registry_mod.get_registry()
+        ckdir = str(tmp_path)
+        d1 = RefreshDaemon(
+            "lr", IncrementalLinearRegression(),
+            checkpoint_dir=ckdir, min_rows=32, shadow_rows=0,
+        )
+        d1.fold(self._delta(64, 0))
+        assert d1.try_swap()["status"] == "registered"
+        x_probe = self._delta(8, 9)[0]
+        out_v1 = reg.predict("lr", x_probe)
+        # the delta folds and checkpoints... then the file is truncated
+        d1.fold(self._delta(64, 1))
+        step = d1.checkpoint()
+        npz = os.path.join(
+            ckdir, f"step-{step:09d}", "arrays.npz"
+        )
+        with open(npz, "r+b") as f:
+            f.truncate(16)
+        # the daemon restarts: nothing durable is readable, so it comes
+        # back empty and the swap gate refuses on the min-rows floor
+        d2 = RefreshDaemon(
+            "lr", IncrementalLinearRegression(),
+            checkpoint_dir=ckdir, min_rows=32, shadow_rows=0,
+        )
+        assert d2.resume() is False
+        res = d2.try_swap()
+        assert res["status"] == "waiting" and res["rows_pending"] == 0
+        assert reg.current_version("lr") == 1
+        assert np.array_equal(reg.predict("lr", x_probe), out_v1)
+        dlt = snap.delta()
+        assert dlt.counter("serve.swaps") == 0
+        assert dlt.counter("refresh.resumes") == 0
+
+    def test_post_swap_latency_burn_rolls_back(self, monkeypatch, snap):
+        """The headline closed-loop contract: a latency burn on live
+        post-swap traffic fires the probation SLO, the daemon rolls back
+        to the HBM-retained prior, and serving resumes bitwise on the old
+        version — all under load, no process restart."""
+        from spark_rapids_ml_tpu.models.incremental import (
+            IncrementalLinearRegression,
+        )
+        from spark_rapids_ml_tpu.refresh import RefreshDaemon
+        from spark_rapids_ml_tpu.serving import client as client_mod
+        from spark_rapids_ml_tpu.serving import registry as registry_mod
+
+        reg = registry_mod.get_registry()
+        d = RefreshDaemon(
+            "lr", IncrementalLinearRegression(),
+            min_rows=1, shadow_rows=0, tolerance=100.0,
+            probation_s=3600.0, probation_burn=1,
+            probation_slo="serve.latency:p99:0.05",
+        )
+        d.fold(self._delta(64, 0))
+        assert d.try_swap()["status"] == "registered"
+        x_probe = self._delta(8, 9)[0]
+        out_v1 = reg.predict("lr", x_probe)
+        d.fold(self._delta(64, 1, flip=-1.0))
+        assert d.try_swap()["status"] == "swapped"
+        assert reg.current_version("lr") == 2
+        # live post-swap traffic through the in-process serve path, with
+        # an injected hang on every dispatch: the p99 burns the 50ms SLO
+        monkeypatch.setenv(
+            faults.FAULT_PLAN_VAR,
+            ",".join(f"serve.dispatch:hang:{i}:0.12" for i in range(1, 4)),
+        )
+        faults.reset_faults()
+        for _ in range(3):
+            client_mod.predict("lr", x_probe)
+        res = d.probation_check()
+        assert res["status"] == "rolled_back"
+        assert res["from_version"] == 2 and res["version"] == 1
+        assert reg.current_version("lr") == 1
+        assert np.array_equal(reg.predict("lr", x_probe), out_v1)
+        dlt = snap.delta()
+        assert dlt.counter("serve.rollback") == 1
+        assert dlt.counter(
+            "fault.injected", site="serve.dispatch", kind="hang"
+        ) == 3
+
+    def test_healthy_probation_promotes_under_load(self, snap):
+        """The control case for the burn test: identical swap, healthy
+        latency, the deadline promotes and the prior is released."""
+        from spark_rapids_ml_tpu.models.incremental import (
+            IncrementalLinearRegression,
+        )
+        from spark_rapids_ml_tpu.refresh import RefreshDaemon
+        from spark_rapids_ml_tpu.serving import client as client_mod
+        from spark_rapids_ml_tpu.serving import registry as registry_mod
+
+        reg = registry_mod.get_registry()
+        d = RefreshDaemon(
+            "lr", IncrementalLinearRegression(),
+            min_rows=1, shadow_rows=0, tolerance=100.0,
+            probation_s=0.0, probation_slo="serve.latency:p99:10",
+        )
+        d.fold(self._delta(64, 0))
+        d.try_swap()
+        d.fold(self._delta(64, 1))
+        assert d.try_swap()["status"] == "swapped"
+        x_probe = self._delta(8, 9)[0]
+        for _ in range(3):
+            client_mod.predict("lr", x_probe)
+        assert d.probation_check()["status"] == "promoted"
+        assert reg.current_version("lr") == 2
+        assert reg.prior_entry("lr") is None
+        assert snap.delta().counter("serve.rollback") == 0
+
+
+class TestFleetSwapChaos:
+    """Fleet-wide hot-swap propagation under a replica kill: the rolling
+    walk converges every replica to the new version with ZERO failed
+    client requests, and every response is attributable to exactly one
+    version (old or new) — never a torn mix."""
+
+    @staticmethod
+    def _read_exact(rf, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            chunk = rf.read(n)
+            assert chunk, "peer closed mid-frame"
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _fast_call(self, sock, rf, model, x32):
+        from spark_rapids_ml_tpu.serving import fastlane
+
+        sock.sendall(fastlane.pack_request(model, x32))
+        return fastlane.read_response(lambda n: self._read_exact(rf, n))
+
+    def test_replica_killed_mid_swap_zero_failed_requests(
+        self, tmp_path, snap
+    ):
+        import socket
+        import threading
+
+        from spark_rapids_ml_tpu.serving import fleet as fleet_mod
+
+        rng = np.random.default_rng(41)
+        xf = rng.normal(size=(128, 6))
+        yf = xf @ np.arange(1.0, 7.0)
+        old = LinearRegression().fit((xf, yf))
+        new = LinearRegression().fit((xf, -yf))
+        x32 = np.ascontiguousarray(xf[:4], dtype="<f4")
+        want_old = np.asarray(old.transform(x32)).ravel()
+        want_new = np.asarray(new.transform(x32)).ravel()
+
+        fleet = fleet_mod.ServeFleet(
+            {"lin": old},
+            replicas=3,
+            socket_dir=str(tmp_path / "sock"),
+            bucket_list=(8,),
+            extra_env={
+                "TPU_ML_SERVE_COMPILE_CACHE_DIR": str(tmp_path / "cache")
+            },
+        ).start()
+        stop = threading.Event()
+        failures: list[Exception] = []
+        responses: list[np.ndarray] = []
+
+        def hammer():
+            try:
+                with socket.socket(socket.AF_UNIX) as s:
+                    s.connect(fleet.router_path)
+                    rf = s.makefile("rb")
+                    while not stop.is_set():
+                        responses.append(
+                            self._fast_call(s, rf, "lin", x32)
+                        )
+            except Exception as e:  # noqa: BLE001 — collected + asserted
+                failures.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(2)]
+        try:
+            for t in threads:
+                t.start()
+            # SIGKILL the last-walked slot 0.15s into the rolling swap:
+            # the walk is still respawning slot 0 (seconds), so the kill
+            # lands squarely mid-swap on a not-yet-swapped replica
+            victim = fleet._supervisor._slots[2].worker
+            killer = threading.Timer(0.15, victim.proc.kill)
+            killer.start()
+            ok = fleet.swap_models({"lin": new})
+            killer.join()
+            assert ok, "a replica never came back READY on the new spec"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        try:
+            assert victim.proc.poll() is not None, "the kill never landed"
+            assert not failures, (
+                f"client requests failed during the killed swap: "
+                f"{failures[:3]}"
+            )
+            assert len(responses) > 0
+            # every response is exactly one version's answer — never torn
+            n_old = n_new = 0
+            for r in responses:
+                flat = np.asarray(r, dtype=np.float64).ravel()
+                if np.allclose(flat, want_old, rtol=1e-4, atol=1e-4):
+                    n_old += 1
+                elif np.allclose(flat, want_new, rtol=1e-4, atol=1e-4):
+                    n_new += 1
+                else:
+                    raise AssertionError(
+                        f"response matches neither version: {flat[:4]}"
+                    )
+            assert n_old > 0, "no pre-swap traffic observed"
+            # after the walk every replica serves the NEW version only
+            assert fleet.live_replicas() == 3
+            with socket.socket(socket.AF_UNIX) as s:
+                s.connect(fleet.router_path)
+                rf = s.makefile("rb")
+                for _ in range(6):
+                    final = np.asarray(
+                        self._fast_call(s, rf, "lin", x32), np.float64
+                    ).ravel()
+                    assert np.allclose(
+                        final, want_new, rtol=1e-4, atol=1e-4
+                    )
+            d = snap.delta()
+            assert d.counter("serve.replica_restarts") >= 3
+            assert d.counter("serve.drain_events") >= 3
+        finally:
+            fleet.stop()

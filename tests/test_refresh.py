@@ -1,0 +1,413 @@
+"""Closed-loop model refresh: durable carry state, guarded hot-swap,
+probation.
+
+Covers the ISSUE-18 acceptance list: every incremental estimator's
+``to_state``/``from_state`` round-trips its exact sufficient statistics so
+an interrupted fold stream finalizes **bitwise** the same model; the
+registry's versioned swap publishes atomically (version bump, blackout
+sample, zero post-swap compiles), refuses divergent candidates at the
+shadow gate, and rolls back bitwise to the HBM-retained prior; and the
+:class:`~spark_rapids_ml_tpu.refresh.RefreshDaemon` drives the whole
+fold → checkpoint → finalize → swap → probation loop, including the
+SLO-burn rollback and the resume-from-durable-checkpoint path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.models import incremental as inc
+from spark_rapids_ml_tpu.refresh import RefreshDaemon
+from spark_rapids_ml_tpu.serving import client as client_mod
+from spark_rapids_ml_tpu.serving import registry as registry_mod
+from spark_rapids_ml_tpu.serving import server as server_mod
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+BUCKETS = (8, 16, 32)
+
+
+@pytest.fixture(autouse=True)
+def serve_clean():
+    yield
+    client_mod.reset_client()
+    server_mod.stop_serving(stop_monitor=False)
+    registry_mod.reset_for_tests()
+
+
+@pytest.fixture
+def snap():
+    s0 = REGISTRY.snapshot()
+
+    class _Snap:
+        @staticmethod
+        def delta():
+            return REGISTRY.snapshot().delta(s0)
+
+    return _Snap
+
+
+def _xy(n: int, seed: int, n_cols: int = 6):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, n_cols))
+    y = x @ np.arange(1.0, n_cols + 1.0)
+    return x, y
+
+
+# -- durable carry state -----------------------------------------------------
+
+
+ESTIMATORS = [
+    pytest.param(lambda: inc.IncrementalPCA(k=3), False, id="pca"),
+    pytest.param(lambda: inc.IncrementalTruncatedSVD(k=3), False, id="svd"),
+    pytest.param(lambda: inc.IncrementalStandardScaler(), False, id="scaler"),
+    pytest.param(lambda: inc.IncrementalLinearRegression(), True, id="linear"),
+    # seedRows=16 so the first batch seeds: the checkpoint carries live
+    # centers + cumulative weights, not just the pre-seed buffer
+    pytest.param(
+        lambda: inc.IncrementalKMeans(k=3).setSeedRows(16), False, id="kmeans"
+    ),
+]
+
+
+def _model_arrays(model) -> list[np.ndarray]:
+    """Every public array the finalized model exposes — the parity probe."""
+    out = []
+    for attr in (
+        "components_", "components", "pc", "mean", "coefficients",
+        "intercept", "clusterCenters", "scale", "std", "singularValues",
+        "explainedVariance",
+    ):
+        v = getattr(model, attr, None)
+        if v is None or callable(v) or isinstance(v, (str, bool)):
+            continue
+        out.append(np.asarray(v))
+    assert out, f"no comparable arrays on {type(model).__name__}"
+    return out
+
+
+def _assert_models_bitwise(a, b):
+    for va, vb in zip(_model_arrays(a), _model_arrays(b)):
+        assert va.dtype == vb.dtype and np.array_equal(va, vb)
+
+
+class TestStateRoundTrip:
+    @pytest.mark.parametrize("make,labeled", ESTIMATORS)
+    def test_resume_finalizes_bitwise(self, make, labeled):
+        """partial_fit(a) → save/restore → partial_fit(b) must finalize
+        bitwise-identical to the uninterrupted fold stream."""
+        a_batch = _xy(64, 0) if labeled else _xy(64, 0)[0]
+        b_batch = _xy(48, 1) if labeled else _xy(48, 1)[0]
+        cont = make().partial_fit(a_batch)
+        arrays, state = cont.to_state()
+        # simulate the durable hop: round-trip through host numpy copies
+        arrays = {k: np.array(v, copy=True) for k, v in arrays.items()}
+        resumed = make().from_state(arrays, state)
+        cont.partial_fit(b_batch)
+        resumed.partial_fit(b_batch)
+        _assert_models_bitwise(cont.finalize(), resumed.finalize())
+
+    @pytest.mark.parametrize("make,labeled", ESTIMATORS)
+    def test_empty_estimator_round_trips(self, make, labeled):
+        arrays, state = make().to_state()
+        resumed = make().from_state(arrays, state)
+        batch = _xy(40, 3) if labeled else _xy(40, 3)[0]
+        cont = make().partial_fit(batch)
+        resumed.partial_fit(batch)
+        _assert_models_bitwise(cont.finalize(), resumed.finalize())
+
+    def test_kind_mismatch_raises(self):
+        arrays, state = inc.IncrementalPCA(k=3).partial_fit(
+            _xy(32, 2)[0]
+        ).to_state()
+        with pytest.raises(ValueError, match="state"):
+            inc.IncrementalStandardScaler().from_state(arrays, state)
+
+    def test_checkpointer_round_trip_is_durable(self, tmp_path):
+        """Through the atomic TrainingCheckpointer (npz+json on disk, not
+        in-memory dicts) the restored stream still finalizes bitwise."""
+        from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+        ck = TrainingCheckpointer(str(tmp_path), keep=2)
+        cont = inc.IncrementalLinearRegression().partial_fit(_xy(64, 0))
+        arrays, state = cont.to_state()
+        ck.save(1, arrays, state)
+        step, arrays2, state2 = ck.latest()
+        assert step == 1
+        resumed = inc.IncrementalLinearRegression().from_state(
+            arrays2, state2
+        )
+        cont.partial_fit(_xy(48, 1))
+        resumed.partial_fit(_xy(48, 1))
+        _assert_models_bitwise(cont.finalize(), resumed.finalize())
+
+
+# -- versioned registry swap -------------------------------------------------
+
+
+def _fit_lin(n: int, seed: int):
+    from spark_rapids_ml_tpu.models.linear import LinearRegression
+
+    x, y = _xy(n, seed)
+    return LinearRegression().fit((x, y))
+
+
+class TestRegistrySwap:
+    def test_swap_bumps_version_and_serves_candidate(self, snap):
+        reg = registry_mod.get_registry()
+        old = _fit_lin(128, 0)
+        new = _fit_lin(128, 1)
+        reg.register("lin", old, bucket_list=BUCKETS)
+        x = _xy(8, 9)[0]
+        out_old = reg.predict("lin", x)
+        entry = reg.swap("lin", new, shadow_sample=x, tolerance=100.0)
+        assert entry.version == 2
+        assert reg.current_version("lin") == 2
+        out_new = reg.predict("lin", x)
+        assert np.array_equal(out_new, np.asarray(new.transform(x)))
+        assert not np.array_equal(out_old, out_new)
+        d = snap.delta()
+        assert d.counter("serve.swaps") == 1
+        assert d.hist("serve.swap_blackout_seconds").count == 1
+        assert d.gauges[("serve.model_version", (("model", "lin"),))] == 2
+
+    def test_swap_causes_zero_post_swap_compiles(self):
+        """The swap pre-compiles the candidate over the live entry's warm
+        ladder; dispatches after the publish never compile."""
+        reg = registry_mod.get_registry()
+        reg.register("lin", _fit_lin(128, 0), bucket_list=BUCKETS)
+        reg.swap("lin", _fit_lin(128, 1), tolerance=100.0)
+        s0 = REGISTRY.snapshot()
+        for rows in (3, 8, 11, 16, 30):
+            reg.predict("lin", _xy(rows, rows)[0])
+        d = REGISTRY.snapshot().delta(s0)
+        assert d.hist("compile.seconds").count == 0
+        assert d.counter("serve.cold_compiles") == 0
+
+    def test_shadow_gate_refuses_divergent_candidate(self, snap):
+        reg = registry_mod.get_registry()
+        old = _fit_lin(128, 0)
+        reg.register("lin", old, bucket_list=BUCKETS)
+        x = _xy(16, 9)[0]
+        out_old = reg.predict("lin", x)
+        from spark_rapids_ml_tpu.models.linear import LinearRegression
+
+        xd, yd = _xy(128, 31)
+        divergent = LinearRegression().fit((xd, -2.0 * yd))
+        with pytest.raises(registry_mod.SwapRefused, match="shadow gate"):
+            reg.swap("lin", divergent, shadow_sample=x, tolerance=1e-3)
+        # the refusal leaves version 1 serving, bitwise untouched
+        assert reg.current_version("lin") == 1
+        assert np.array_equal(reg.predict("lin", x), out_old)
+        d = snap.delta()
+        assert d.counter("serve.swap_refused", model="lin", reason="shadow") == 1
+        assert d.counter("serve.swaps") == 0
+
+    def test_shape_mismatch_refused(self, snap):
+        reg = registry_mod.get_registry()
+        reg.register("lin", _fit_lin(128, 0), bucket_list=BUCKETS)
+        from spark_rapids_ml_tpu.models.linear import LinearRegression
+
+        x4, _ = _xy(128, 0, n_cols=4)
+        y4 = x4 @ np.arange(1.0, 5.0)
+        narrow = LinearRegression().fit((x4, y4))
+        with pytest.raises(registry_mod.SwapRefused, match="n_features"):
+            reg.swap("lin", narrow)
+        d = snap.delta()
+        assert d.counter("serve.swap_refused", model="lin", reason="shape") == 1
+        assert reg.current_version("lin") == 1
+
+    def test_rollback_restores_prior_bitwise(self, snap):
+        reg = registry_mod.get_registry()
+        old = _fit_lin(128, 0)
+        reg.register("lin", old, bucket_list=BUCKETS)
+        x = _xy(8, 9)[0]
+        out_old = reg.predict("lin", x)
+        reg.swap("lin", _fit_lin(128, 1), tolerance=100.0)
+        prior = reg.rollback("lin")
+        assert prior.version == 1
+        assert reg.current_version("lin") == 1
+        assert np.array_equal(reg.predict("lin", x), out_old)
+        d = snap.delta()
+        assert d.counter("serve.rollback") == 1
+        # a second rollback has nothing retained to restore
+        with pytest.raises(KeyError):
+            reg.rollback("lin")
+
+    def test_prune_prior_releases_retained_version(self):
+        reg = registry_mod.get_registry()
+        reg.register("lin", _fit_lin(128, 0), bucket_list=BUCKETS)
+        reg.swap("lin", _fit_lin(128, 1), tolerance=100.0)
+        assert reg.prior_entry("lin") is not None
+        assert reg.prune_prior("lin") is True
+        assert reg.prior_entry("lin") is None
+        assert reg.prune_prior("lin") is False
+        with pytest.raises(KeyError):
+            reg.rollback("lin")
+
+    def test_swap_of_unknown_model_is_key_error(self):
+        with pytest.raises(KeyError):
+            registry_mod.get_registry().swap("ghost", _fit_lin(64, 0))
+
+
+# -- the refresh daemon ------------------------------------------------------
+
+
+class TestRefreshDaemon:
+    def test_full_lifecycle_promotes(self, tmp_path, snap):
+        d = RefreshDaemon(
+            "lr", inc.IncrementalLinearRegression(),
+            checkpoint_dir=str(tmp_path), min_rows=32, shadow_rows=16,
+            tolerance=100.0, probation_s=0.0,
+            probation_slo="serve.latency:p99:10",
+        )
+        d.fold(_xy(64, 0))
+        d.checkpoint()
+        assert d.try_swap() == {"status": "registered", "version": 1}
+        d.fold(_xy(64, 1))
+        d.checkpoint()
+        res = d.try_swap()
+        assert res["status"] == "swapped" and res["version"] == 2
+        assert res["refresh_lag_s"] >= 0.0
+        assert d.in_probation
+        # probation_s=0 -> the deadline has passed; next check promotes
+        assert d.probation_check()["status"] == "promoted"
+        assert not d.in_probation
+        reg = registry_mod.get_registry()
+        assert reg.current_version("lr") == 2
+        assert reg.prior_entry("lr") is None
+        dlt = snap.delta()
+        assert dlt.counter("refresh.folds") == 2
+        assert dlt.counter("refresh.rows") == 128
+        assert dlt.counter("refresh.checkpoints") == 2
+        assert dlt.counter("refresh.finalizes") == 2
+        assert dlt.counter("serve.swaps") == 1
+
+    def test_min_rows_floor_blocks_swap(self):
+        d = RefreshDaemon(
+            "lr", inc.IncrementalLinearRegression(),
+            min_rows=100, shadow_rows=0,
+        )
+        d.fold(_xy(64, 0))
+        res = d.try_swap()
+        assert res["status"] == "waiting"
+        assert res["rows_pending"] == 64
+
+    def test_slo_burn_rolls_back_and_counts(self, snap):
+        """A confirmed SLO burn during probation restores the prior
+        version (bitwise) and books serve.rollback."""
+        reg = registry_mod.get_registry()
+        d = RefreshDaemon(
+            "lr", inc.IncrementalLinearRegression(),
+            min_rows=1, shadow_rows=0, tolerance=100.0,
+            probation_s=3600.0, probation_burn=1,
+            probation_slo="serve.latency:p99:0.001",
+        )
+        d.fold(_xy(64, 0))
+        assert d.try_swap()["status"] == "registered"
+        x = _xy(8, 9)[0]
+        out_v1 = reg.predict("lr", x)
+        d.fold(_xy(64, 1))
+        assert d.try_swap()["status"] == "swapped"
+        # post-swap traffic burns the probation SLO (p99 >> 1ms)
+        for _ in range(8):
+            REGISTRY.histogram_record("serve.latency", 0.5, model="lr")
+        res = d.probation_check()
+        assert res["status"] == "rolled_back"
+        assert res["version"] == 1 and res["from_version"] == 2
+        assert not d.in_probation
+        assert reg.current_version("lr") == 1
+        assert np.array_equal(reg.predict("lr", x), out_v1)
+        assert snap.delta().counter("serve.rollback") == 1
+
+    def test_healthy_probation_promotes_after_deadline(self):
+        reg = registry_mod.get_registry()
+        d = RefreshDaemon(
+            "lr", inc.IncrementalLinearRegression(),
+            min_rows=1, shadow_rows=0, tolerance=100.0,
+            probation_s=0.0, probation_slo="serve.latency:p99:10",
+        )
+        d.fold(_xy(64, 0))
+        d.try_swap()
+        d.fold(_xy(64, 1))
+        assert d.try_swap()["status"] == "swapped"
+        # while in probation, try_swap defers to the probation check
+        assert d.try_swap()["status"] == "promoted"
+        assert reg.current_version("lr") == 2
+
+    def test_resume_restores_pending_rows_and_finalizes_bitwise(
+        self, tmp_path
+    ):
+        """Kill-between-folds survival: a fresh daemon resumed from the
+        durable checkpoint swaps in the SAME candidate the dead one
+        would have."""
+        ckdir = str(tmp_path)
+        d1 = RefreshDaemon(
+            "lr", inc.IncrementalLinearRegression(),
+            checkpoint_dir=ckdir, min_rows=1, shadow_rows=8,
+        )
+        d1.fold(_xy(64, 0))
+        d1.checkpoint()
+        # the continuation the dead daemon never made
+        oracle = inc.IncrementalLinearRegression().partial_fit(_xy(64, 0))
+        oracle.partial_fit(_xy(32, 1))
+
+        d2 = RefreshDaemon(
+            "lr", inc.IncrementalLinearRegression(),
+            checkpoint_dir=ckdir, min_rows=1, shadow_rows=8,
+        )
+        assert d2.resume() is True
+        assert d2.rows_pending == 64
+        assert d2._shadow is not None and len(d2._shadow) == 8
+        d2.fold(_xy(32, 1))
+        _assert_models_bitwise(d2.estimator.finalize(), oracle.finalize())
+
+    def test_resume_with_nothing_durable_is_false(self, tmp_path):
+        d = RefreshDaemon(
+            "lr", inc.IncrementalLinearRegression(),
+            checkpoint_dir=str(tmp_path),
+        )
+        assert d.resume() is False
+        assert RefreshDaemon(
+            "lr2", inc.IncrementalLinearRegression(), checkpoint_dir=None
+        ).resume() is False
+
+    def test_feed_run_once_background_verbs(self, tmp_path):
+        d = RefreshDaemon(
+            "lr", inc.IncrementalLinearRegression(),
+            checkpoint_dir=str(tmp_path), min_rows=1, shadow_rows=0,
+            tolerance=100.0, probation_s=0.0,
+            probation_slo="serve.latency:p99:10",
+        )
+        d.feed(_xy(32, 0))
+        d.feed(_xy(32, 1))
+        res = d.run_once()  # drains both, checkpoints, registers
+        assert res == {"status": "registered", "version": 1}
+        assert d.rows_pending == 0
+        ck = d.checkpointer.latest()
+        assert ck is not None and ck[2]["rows_pending"] == 64
+        d.feed(_xy(32, 2))
+        assert d.run_once()["status"] == "swapped"
+        assert d.run_once()["status"] == "promoted"
+
+    def test_refused_swap_keeps_pending_rows(self):
+        """A shadow-gate refusal must not drop the folded deltas — the
+        daemon retries after the next fold."""
+        reg = registry_mod.get_registry()
+        d = RefreshDaemon(
+            "lr", inc.IncrementalLinearRegression(),
+            min_rows=1, shadow_rows=16, tolerance=100.0,
+        )
+        d.fold(_xy(64, 0))
+        assert d.try_swap()["status"] == "registered"
+        d.tolerance = 1e-3
+        xd, yd = _xy(256, 5)
+        d.fold((xd, -yd))  # the delta flips the target: candidate diverges
+        res = d.try_swap()
+        assert res["status"] == "refused"
+        assert d.rows_pending == 256
+        assert reg.current_version("lr") == 1
+        d.tolerance = 100.0
+        assert d.try_swap()["status"] == "swapped"

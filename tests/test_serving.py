@@ -1486,3 +1486,66 @@ class TestHedgedDispatch:
         assert supervisor.hedge_threshold_s(
             0.1, floor_s=floor
         ) == pytest.approx(0.2)
+
+
+# -- hot-swap under concurrent load (ISSUE-18) -------------------------------
+
+
+class TestSwapUnderConcurrentLoad:
+    def test_every_response_is_bitwise_one_version(self, fitted_models):
+        """Hammer the registry from worker threads while the main thread
+        hot-swaps the model: zero errors, and every single response is
+        bitwise-identical to exactly one version's eager ``transform()``
+        — in-flight dispatches finish on the old kernel, new admissions
+        land on the new one, nothing ever serves a torn mix."""
+        from spark_rapids_ml_tpu.models.linear import LinearRegression
+
+        x, _, _ = fitted_models
+        rng = np.random.default_rng(13)
+        y = x @ rng.normal(size=6) + 0.25
+        old = LinearRegression().fit((x, y))
+        new = LinearRegression().fit((x, -y))
+        reg = registry_mod.get_registry()
+        reg.register("hot", old, bucket_list=(8, 16))
+        probe = x[:8]
+        want_old = np.asarray(old.transform(probe))
+        want_new = np.asarray(new.transform(probe))
+        assert not np.array_equal(want_old, want_new)
+
+        stop = False
+        errors: list[Exception] = []
+        outs: list[np.ndarray] = []
+
+        def hammer():
+            while not stop:
+                try:
+                    outs.append(reg.predict("hot", probe))
+                except Exception as e:  # noqa: BLE001 — asserted empty
+                    errors.append(e)
+                    return
+
+        import threading
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(0.1)  # guaranteed pre-swap traffic
+            entry = reg.swap(
+                "hot", new, shadow_sample=probe, tolerance=100.0
+            )
+            assert entry.version == 2
+            time.sleep(0.1)  # guaranteed post-swap traffic
+        finally:
+            stop = True
+            for t in threads:
+                t.join(timeout=30)
+        assert not errors, f"requests failed during swap: {errors[:3]}"
+        n_old = sum(1 for o in outs if np.array_equal(o, want_old))
+        n_new = sum(1 for o in outs if np.array_equal(o, want_new))
+        assert n_old + n_new == len(outs), (
+            "a response matched neither version bitwise — torn swap"
+        )
+        assert n_old > 0 and n_new > 0
+        # post-swap steady state: the new version, bitwise, every time
+        assert np.array_equal(reg.predict("hot", probe), want_new)

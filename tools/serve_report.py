@@ -61,6 +61,18 @@ key holds the blob ``bench.py --smoke`` embeds
     delete codec work; when they lose to JSON the fast path has picked
     up a regression (pool contention, framing bug) that the blended
     latency histogram would hide.
+  - ``torn-swap`` — the window's ``serve.swaps`` count disagrees with
+    its ``serve.swap_blackout_seconds`` sample count. Every completed
+    hot-swap publish records exactly one blackout sample from inside
+    the atomic section; a mismatch means a swap died mid-publish (a
+    torn serving slot — the one state the refresh subsystem promises
+    can never exist) or the swap telemetry is lying.
+  - ``rollback-exceeds-swaps`` — more rollbacks than swaps in one
+    window: a prior was restored that this window never displaced
+    (crash-looping probation, duplicated rollback calls).
+  - ``refresh-failed-requests`` / ``refresh-post-swap-compiles`` — the
+    refresh bench stage saw a client-visible failure or a backend
+    compile after the publish; both are hard swap-contract violations.
 
 Exit status: 0 normally; with ``--strict``, 2 when any anomaly fired OR
 any record had to be skipped (CI gate). Stdlib-only — renders on hosts
@@ -176,11 +188,116 @@ def check_anomalies(summary: dict, wrapper: dict) -> list[str]:
     return out
 
 
+def check_refresh_anomalies(refresh: dict) -> list[str]:
+    """Consistency checks on one window's swap/rollback counters (the
+    ``refresh`` block of ``serve_summary``)."""
+    out: list[str] = []
+    swaps = refresh.get("swaps", 0) or 0
+    blackout = (refresh.get("swap_blackout") or {}).get("count", 0) or 0
+    if swaps != blackout:
+        out.append(
+            f"torn-swap: {swaps:g} swap(s) published but {blackout:g} "
+            "blackout sample(s) booked — every completed publish records "
+            "exactly one serve.swap_blackout_seconds sample from inside "
+            "the atomic section; a mismatch means a swap died mid-publish "
+            "(a torn serving slot) or the swap telemetry is lying"
+        )
+    rollbacks = refresh.get("rollbacks", 0) or 0
+    if rollbacks > swaps:
+        out.append(
+            f"rollback-exceeds-swaps: {rollbacks:g} rollback(s) vs "
+            f"{swaps:g} swap(s) in the same window — a prior was restored "
+            "that this window never displaced; look for a crash-looping "
+            "probation or duplicated rollback calls"
+        )
+    return out
+
+
 def _wrapper_metric(wrapper: dict, name: str) -> float | None:
     m = (wrapper.get("metrics") or {}).get(name)
     if isinstance(m, dict):
         return m.get("value")
     return m if isinstance(m, (int, float)) else None
+
+
+def _render_refresh(refresh: dict, out) -> None:
+    """Print one window's model-refresh counters (swap/rollback plane)."""
+    swaps = refresh.get("swaps", 0) or 0
+    refused = refresh.get("swap_refused", 0) or 0
+    rollbacks = refresh.get("rollbacks", 0) or 0
+    folds = refresh.get("folds", 0) or 0
+    checkpoints = refresh.get("checkpoints", 0) or 0
+    if not (swaps or refused or rollbacks or folds or checkpoints):
+        return
+    line = (
+        f"model refresh: {swaps:g} swap(s), {refused:g} refused, "
+        f"{rollbacks:g} rollback(s)"
+    )
+    blackout = refresh.get("swap_blackout") or {}
+    if blackout.get("count"):
+        line += (
+            f", blackout p99 {_fmt_s(blackout.get('p99', 0.0))} / "
+            f"max {_fmt_s(blackout.get('max', 0.0))}"
+        )
+    print(line, file=out)
+    if folds or checkpoints:
+        line = (
+            f"  delta plane: {folds:g} fold(s) over "
+            f"{refresh.get('rows', 0) or 0:g} row(s), "
+            f"{refresh.get('finalizes', 0) or 0:g} finalize(s), "
+            f"{checkpoints:g} checkpoint(s), "
+            f"{refresh.get('resumes', 0) or 0:g} resume(s)"
+        )
+        lag = refresh.get("lag_seconds")
+        if lag is not None:
+            line += f", lag {_fmt_s(lag)}"
+        print(line, file=out)
+    versions = refresh.get("versions") or {}
+    if versions:
+        print(
+            "  serving versions: " + ", ".join(
+                f"{m} v{v:g}" for m, v in sorted(versions.items())
+            ),
+            file=out,
+        )
+
+
+def _render_refresh_stage(stage: dict, out) -> list[str]:
+    """Render the bench ``refresh`` stage evidence (the hot-swap-under-load
+    proof) and return its anomaly list."""
+    anomalies: list[str] = []
+    probation = stage.get("probation")
+    if isinstance(probation, dict):
+        probation = probation.get("status", "?")
+    print(
+        f"refresh stage: model {stage.get('model', '?')} swapped to "
+        f"v{stage.get('swap_version', 0):g} under load — blackout "
+        f"{stage.get('swap_blackout_ms', 0.0):g}ms, refresh lag "
+        f"{stage.get('refresh_lag_s', 0.0):g}s, probation {probation}",
+        file=out,
+    )
+    requests = stage.get("requests_during_swap", 0) or 0
+    failed = stage.get("failed_requests", 0) or 0
+    recompiles = stage.get("post_swap_recompiles", 0) or 0
+    print(
+        f"  swap-window traffic: {requests:g} request(s), {failed:g} "
+        f"failed, {recompiles:g} post-swap compile(s)",
+        file=out,
+    )
+    if failed:
+        anomalies.append(
+            f"refresh-failed-requests: {failed:g} request(s) failed while "
+            "a hot-swap was in flight — the atomic publish leaked onto the "
+            "request path"
+        )
+    if recompiles:
+        anomalies.append(
+            f"refresh-post-swap-compiles: {recompiles:g} backend compile(s) "
+            "after the publish — the candidate was not AOT-compiled over "
+            "the live bucket ladder before the swap"
+        )
+    anomalies.extend(check_refresh_anomalies(stage.get("refresh") or {}))
+    return anomalies
 
 
 def render_record(rec: dict, out=sys.stdout) -> list[str] | None:
@@ -253,6 +370,9 @@ def render_record(rec: dict, out=sys.stdout) -> list[str] | None:
         if drains or restarts:
             line += f", {drains:g} drain(s), {restarts:g} rolling restart(s)"
         print(line, file=out)
+
+    refresh = summary.get("refresh") or {}
+    _render_refresh(refresh, out)
 
     hedges = summary.get("hedges", 0) or 0
     if hedges:
@@ -342,6 +462,10 @@ def render_record(rec: dict, out=sys.stdout) -> list[str] | None:
     print(comp_line, file=out)
 
     anomalies = check_anomalies(summary, rec)
+    anomalies.extend(check_refresh_anomalies(refresh))
+    stage = rec.get("refresh")
+    if isinstance(stage, dict) and "swap_blackout_ms" in stage:
+        anomalies.extend(_render_refresh_stage(stage, out))
     for a in anomalies:
         print(f"  !! {a}", file=out)
     if not anomalies:
