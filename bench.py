@@ -254,6 +254,10 @@ def _ledger_entry(record: dict) -> dict:
         # hot-swap-under-load proof (blackout, refresh lag, probation):
         # serve_report's torn-swap checks read this off the same line
         "refresh": record.get("refresh"),
+        # fleet-stage evidence (routing, rolling restart, cross-process
+        # trace coverage + clock offsets): serve_report's fleet tracing
+        # render and orphan-span anomaly read it off the ledger entry
+        "fleet": record.get("fleet"),
         # elastic-scheduler counters for the whole bench process: a ledger
         # entry whose wall-clock regressed WITH nonzero hedges/reassigns/
         # quarantines is a sick run, not a perf regression — the sentinel's
@@ -796,6 +800,17 @@ def main() -> None:
                             "re-issue past the hedge threshold; first "
                             "result wins)",
                         },
+                        {
+                            "metric": "trace_coverage",
+                            "value": (
+                                serving_evidence.get("trace_coverage")
+                                or {}
+                            ).get("coverage", 1.0),
+                            "unit": "fraction",
+                            "note": "sampled requests stitching into one "
+                            "complete span tree (zero orphans) over the "
+                            "serving window; the stage pins this >= 0.99",
+                        },
                     ]
                     if serving_evidence is not None
                     else []
@@ -851,6 +866,19 @@ def main() -> None:
                                 "router; qps_ratio_vs_single "
                                 f"{fleet_evidence['qps_ratio_vs_single']}"
                             ),
+                        },
+                        {
+                            "metric": "fleet_trace_coverage",
+                            "value": (
+                                fleet_evidence.get("trace_coverage")
+                                or {}
+                            ).get("coverage", 1.0),
+                            "unit": "fraction",
+                            "note": "sampled cross-process traces "
+                            "(router relay + replica fragments) stitching "
+                            "complete across the rolling-restart window; "
+                            "the stage pins this >= 0.99 with zero "
+                            "orphan spans",
                         },
                     ]
                     if fleet_evidence is not None
@@ -1211,7 +1239,9 @@ def _bench_serving() -> dict:
     from spark_rapids_ml_tpu.serving import server as serve_server
     from spark_rapids_ml_tpu.spark import ingest
     from spark_rapids_ml_tpu.telemetry import slo as slo_mod
+    from spark_rapids_ml_tpu.telemetry import tracectx
     from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+    from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
     rng = np.random.default_rng(23)
     n = 16
@@ -1356,6 +1386,7 @@ def _bench_serving() -> dict:
         )
 
         snap_warm = REGISTRY.snapshot()
+        seq_warm = TIMELINE.seq()
         fit_thread = threading.Thread(target=fit_loop, daemon=True)
         fit_thread.start()
         sizes = (1, 2, 3, 5, 8, 12, 17, 30, 40, 100)
@@ -1408,6 +1439,26 @@ def _bench_serving() -> dict:
                     "time(s) during the serving smoke window"
                 )
 
+        # trace-stitching contract over the measured window: every sampled
+        # request must form exactly one complete span tree (>=99% stitched,
+        # zero orphan spans) — a dropped context on any wire or a missing
+        # span parent fails the stage, not a dashboard three weeks later
+        trace_cov = tracectx.coverage(TIMELINE.events(seq_warm))
+        sampled_all = tracectx.trace_sample_rate() >= 1.0
+        if (
+            not trace_cov["traces"]
+            or (sampled_all and trace_cov["traces"] < len(reqs))
+            or trace_cov["coverage"] < 0.99
+            or trace_cov["orphan_spans"]
+        ):
+            raise SystemExit(
+                "serving trace contract violated: "
+                f"{trace_cov['complete']}/{trace_cov['traces']} trace(s) "
+                f"stitched complete ({trace_cov['coverage']:.1%}) with "
+                f"{trace_cov['orphan_spans']} orphan span(s) across "
+                f"{len(reqs)} measured request(s)"
+            )
+
         gate_raw = os.environ.get(knobs.SERVE_P99_GATE_MS.name, "").strip()
         evidence = serve_server.serve_summary(window)
         evidence.pop("type", None)
@@ -1427,6 +1478,7 @@ def _bench_serving() -> dict:
             serve_p99_ms=round(lat.percentile(99) * 1e3, 3),
             serve_p99_gate_ms=float(gate_raw) if gate_raw else None,
             serve_recompiles_after_warmup=recompiles,
+            trace_coverage=trace_cov,
             slo={
                 "declared": bool(slo_objectives),
                 "breaches": slo_breaches,
@@ -1595,7 +1647,9 @@ def _bench_fleet() -> dict:
     from spark_rapids_ml_tpu import PCA
     from spark_rapids_ml_tpu.models.linear import LinearRegression
     from spark_rapids_ml_tpu.serving import fleet as serve_fleet
+    from spark_rapids_ml_tpu.telemetry import tracectx
     from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+    from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
     from tools.serve_loadgen import run_load
 
     rng = np.random.default_rng(29)
@@ -1613,12 +1667,25 @@ def _bench_fleet() -> dict:
     cache_dir = os.path.join(
         tempfile.gettempdir(), "tpu-ml-fleet-bench-cache"
     )
+    # trace a slice of the loadgen window: at full rate a multi-thousand-
+    # request window would blow through the flight-recorder ring
+    # (TPU_ML_TIMELINE_EVENTS) and evict span parents, manufacturing
+    # orphans. 2% keeps every process's ring comfortable while still
+    # stitching tens of cross-process traces. The router mints in THIS
+    # process, so the env var has to move here too, not just to replicas.
+    fleet_sample = "0.02"
+    prev_sample = os.environ.get(knobs.TRACE_SAMPLE.name)
+    os.environ[knobs.TRACE_SAMPLE.name] = fleet_sample
+    seq_fleet = TIMELINE.seq()
     snap0 = REGISTRY.snapshot()
     fleet = serve_fleet.ServeFleet(
         models,
         replicas=replicas,
         bucket_list=(8, 16),
-        extra_env={knobs.SERVE_COMPILE_CACHE_DIR.name: cache_dir},
+        extra_env={
+            knobs.SERVE_COMPILE_CACHE_DIR.name: cache_dir,
+            knobs.TRACE_SAMPLE.name: fleet_sample,
+        },
     ).start()
     restarted_worker = None
     try:
@@ -1668,6 +1735,35 @@ def _bench_fleet() -> dict:
         stats = fleet.stats()
     finally:
         fleet.stop()
+        if prev_sample is None:
+            os.environ.pop(knobs.TRACE_SAMPLE.name, None)
+        else:
+            os.environ[knobs.TRACE_SAMPLE.name] = prev_sample
+
+    # cross-process trace stitching: router relay spans + both replicas'
+    # harvested fragments (live STATS scrapes + teardown trailers, the
+    # restarted replica's pre-restart fragment included) must merge into
+    # complete trees — >=99% stitched, zero orphan spans — with the
+    # rolling restart landing mid-window. Scoped to this stage's router
+    # events so earlier stages' ring residue can't skew the audit.
+    pid_self = os.getpid()
+    fleet_events = [
+        e for e in fleet.fleet_events()
+        if e.get("pid") != pid_self or e.get("seq", 0) > seq_fleet
+    ]
+    trace_cov = tracectx.coverage(fleet_events)
+    if (
+        not trace_cov["traces"]
+        or trace_cov["coverage"] < 0.99
+        or trace_cov["orphan_spans"]
+    ):
+        raise SystemExit(
+            "fleet trace contract violated: "
+            f"{trace_cov['complete']}/{trace_cov['traces']} cross-process "
+            f"trace(s) stitched complete ({trace_cov['coverage']:.1%}) "
+            f"with {trace_cov['orphan_spans']} orphan span(s) across the "
+            "rolling-restart window"
+        )
 
     # the respawned replica's shutdown report: cache_misses == 0 means it
     # re-AOT'd entirely from the shared persistent cache
@@ -1710,6 +1806,9 @@ def _bench_fleet() -> dict:
             if (hits + misses)
             else None,
         },
+        "trace_coverage": trace_cov,
+        "trace_sample_rate": float(fleet_sample),
+        "clock_offsets_us": stats.get("clock_offsets_us"),
         "rolling_restart": {
             "ok": True,
             "drain_events": window.counter("serve.drain_events"),

@@ -39,8 +39,10 @@ from typing import Any
 import numpy as np
 
 from spark_rapids_ml_tpu.resilience import faults, sites
+from spark_rapids_ml_tpu.telemetry import tracectx
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 from spark_rapids_ml_tpu.telemetry.slo import Objective, SloEngine, parse_objectives
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 from spark_rapids_ml_tpu.utils import knobs
 from spark_rapids_ml_tpu.utils.checkpoint import TrainingCheckpointer
 
@@ -159,6 +161,29 @@ class RefreshDaemon:
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # the refresh cycle's trace: one sampled chain of
+        # refresh.fold -> refresh.swap -> refresh.probation spans per
+        # fold-to-promotion cycle; _trace_last is the span the next hop
+        # parents to (None = untraced cycle)
+        self._trace_last: tracectx.TraceContext | None = None
+
+    def _trace_span(self, name: str, t0: float, **labels) -> None:
+        """Record one hop of the cycle chain: the first hop mints the
+        trace (sampling decides) and becomes the root; later hops chain as
+        children, so the stitched tree shows fold→swap→probation end to
+        end with no orphan edges."""
+        parent = self._trace_last
+        ctx = (
+            parent.child() if parent is not None
+            else tracectx.mint(origin="refresh")
+        )
+        if ctx is None:
+            return
+        TIMELINE.record_span(
+            name, t0, time.perf_counter(), model=self.name,
+            **labels, **tracectx.span_labels(ctx, parent=parent),
+        )
+        self._trace_last = ctx
 
     # -- delta intake --------------------------------------------------------
 
@@ -172,6 +197,7 @@ class RefreshDaemon:
         """Fold one delta batch into the carry (``refresh.fold`` chaos
         gate first — before the donated carry consumes anything, so an
         injected failure leaves the fold retryable)."""
+        t0 = time.perf_counter()
         x, rest = self._split(batch)
         x = faults.inject(sites.REFRESH_FOLD, x)
         self.estimator.partial_fit((x, *rest) if rest is not None else x)
@@ -180,6 +206,7 @@ class RefreshDaemon:
         self._last_fold_t = time.monotonic()
         REGISTRY.counter_inc("refresh.folds")
         REGISTRY.counter_inc("refresh.rows", rows)
+        self._trace_span("refresh.fold", t0, rows=str(rows))
         if self.shadow_rows > 0:
             held = x[-self.shadow_rows:]
             if self._shadow is None or len(held) >= self.shadow_rows:
@@ -259,6 +286,7 @@ class RefreshDaemon:
         model = self.estimator.finalize()
         REGISTRY.counter_inc("refresh.finalizes")
         shadow = self._shadow if self.shadow_rows > 0 else None
+        t_swap = time.perf_counter()
         try:
             entry = self.registry.swap(
                 self.name, model,
@@ -268,9 +296,11 @@ class RefreshDaemon:
             # nothing live yet: first finalize registers the slot
             entry = self.registry.register(self.name, model)
             self._rows_pending = 0
+            self._trace_last = None
             return {"status": "registered", "version": entry.version}
         except SwapRefused as e:
             logger.warning("swap of %s refused: %s", self.name, e)
+            self._trace_span("refresh.swap", t_swap, status="refused")
             return {"status": "refused", "reason": str(e)}
         lag = (
             time.monotonic() - self._last_fold_t
@@ -281,6 +311,9 @@ class RefreshDaemon:
         self._rows_pending = 0
         if self.fleet is not None:
             self.fleet.swap_models({self.name: model})
+        self._trace_span(
+            "refresh.swap", t_swap, version=str(entry.version)
+        )
         self._probation = _Probation(
             engine=SloEngine(
                 self._probation_objectives,
@@ -303,6 +336,7 @@ class RefreshDaemon:
         p = self._probation
         if p is None:
             return {"status": "idle"}
+        t0 = time.perf_counter()
         p.engine.evaluate()
         p.evaluations += 1
         if p.engine.total_breaches() > 0:
@@ -310,6 +344,12 @@ class RefreshDaemon:
             if self.fleet is not None and prior.model is not None:
                 self.fleet.swap_models({self.name: prior.model})
             self._probation = None
+            # terminal hop of the cycle chain; the next fold starts a
+            # fresh trace
+            self._trace_span(
+                "refresh.probation", t0, status="rolled_back"
+            )
+            self._trace_last = None
             return {
                 "status": "rolled_back",
                 "version": prior.version,
@@ -318,6 +358,8 @@ class RefreshDaemon:
         if time.monotonic() >= p.deadline:
             self.registry.prune_prior(self.name)
             self._probation = None
+            self._trace_span("refresh.probation", t0, status="promoted")
+            self._trace_last = None
             return {"status": "promoted", "version": p.version}
         return {
             "status": "probation",

@@ -71,7 +71,9 @@ from spark_rapids_ml_tpu.serving.registry import (
     get_registry,
     validate_request,
 )
+from spark_rapids_ml_tpu.telemetry import tracectx
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 from spark_rapids_ml_tpu.utils import knobs
 
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
@@ -154,13 +156,14 @@ class ServeFuture:
 
 
 class _Pending:
-    __slots__ = ("mat", "rows", "future", "t_submit")
+    __slots__ = ("mat", "rows", "future", "t_submit", "trace")
 
-    def __init__(self, mat: np.ndarray):
+    def __init__(self, mat: np.ndarray, trace=None):
         self.mat = mat
         self.rows = mat.shape[0]
         self.future = ServeFuture()
         self.t_submit = time.perf_counter()
+        self.trace = trace  # TraceContext of the request span, or None
 
 
 class MicroBatcher:
@@ -224,12 +227,16 @@ class MicroBatcher:
 
     # -- submission ---------------------------------------------------------
 
-    def submit(self, model: str, x) -> ServeFuture:
+    def submit(self, model: str, x, trace=None) -> ServeFuture:
         """Queue one request; returns its future. ``prepare`` runs on the
         caller thread (host preprocessing parallelizes across requests);
         the device dispatch happens on the batcher worker. Input stays in
         the caller's dtype (see ``ACCEPTED_DTYPES``) — float32 payloads
-        never round-trip through float64."""
+        never round-trip through float64.
+
+        ``trace`` is the request's :class:`tracectx.TraceContext` (falls
+        back to the ambient contextvar); a traced request gets a
+        ``serve.queue`` span and rides the batch dispatch span's links."""
         entry = self.registry.get(model)
         hbm.get_fleet().check_admission(model)
         mat = validate_request(x, entry.n_features, model)
@@ -242,7 +249,9 @@ class MicroBatcher:
         if prepared.dtype != entry.x_dtype:
             prepared = prepared.astype(entry.x_dtype)
         bucket = buckets.serve_bucket(prepared.shape[0])  # admission check
-        pending = _Pending(prepared)
+        if trace is None:
+            trace = tracectx.current_trace()
+        pending = _Pending(prepared, trace)
         with self._cond:
             if self._stopping:
                 raise RuntimeError("micro-batcher is stopped")
@@ -354,6 +363,7 @@ class MicroBatcher:
         model: str,
         padded: np.ndarray,
         bucket: int,
+        links: str = "",
     ) -> tuple[np.ndarray, float]:
         """One device dispatch under the hedging discipline; returns the
         raw output and the *winner's* device seconds.
@@ -381,12 +391,14 @@ class MicroBatcher:
         if threshold is None:
             return timed(self.registry.dispatch_padded)
         pool = self._ensure_hedge_pool()
+        t_primary = time.perf_counter()
         primary = pool.submit(timed, self.registry.dispatch_padded)
         try:
             return primary.result(timeout=threshold)
         except concurrent.futures.TimeoutError:
             pass
         REGISTRY.counter_inc("serve.hedges", model=model)
+        t_hedge = time.perf_counter()
         hedge = pool.submit(timed, self.registry.hedge_dispatch_padded)
         done, _ = concurrent.futures.wait(
             {primary, hedge},
@@ -398,6 +410,15 @@ class MicroBatcher:
             "serve.hedge_wins", model=model,
             winner="primary" if winner is primary else "hedge",
         )
+        if links:
+            # the loser's metrics are discarded (defer_trailer discipline)
+            # but its trace edge survives: a hedge_lost-marked dispatch
+            # span closed at decision time, linked to the same requests
+            t_lost = t_hedge if winner is primary else t_primary
+            TIMELINE.record_span(
+                "serve.dispatch", t_lost, time.perf_counter(),
+                model=model, links=links, hedge_lost="1",
+            )
         return raw, dev_s
 
     def _dispatch(
@@ -409,17 +430,33 @@ class MicroBatcher:
             entry = self.registry.get(model)
             bucket = buckets.serve_bucket(sum(p.rows for p in taken))
             self._late_join(key, taken, bucket)
+            # one batch dispatch fans in N request spans: the dispatch span
+            # belongs to no single trace, it *links* to every traced rider
+            links = " ".join(
+                tracectx.link_token(p.trace) for p in taken
+                if p.trace is not None
+            )
             for p in taken:
                 delay_s = t0 - p.t_submit
+                exemplar = p.trace.trace_hex if p.trace is not None else ""
                 REGISTRY.histogram_record(
-                    "serve.queue_delay_seconds", delay_s, model=model
+                    "serve.queue_delay_seconds", delay_s,
+                    exemplar=exemplar, model=model,
                 )
                 # µs-resolution twin of the same measurement: the seconds
                 # histogram's log buckets flatten below ~1 ms, which is
                 # exactly where the serve tail lives
                 REGISTRY.histogram_record(
-                    "serve.queue_delay_us", delay_s * 1e6, model=model
+                    "serve.queue_delay_us", delay_s * 1e6,
+                    exemplar=exemplar, model=model,
                 )
+                if p.trace is not None:
+                    TIMELINE.record_span(
+                        "serve.queue", p.t_submit, t0, model=model,
+                        **tracectx.span_labels(
+                            p.trace.child(), parent=p.trace
+                        ),
+                    )
             REGISTRY.histogram_record(
                 "serve.window_effective_seconds", window_s, model=model
             )
@@ -438,7 +475,15 @@ class MicroBatcher:
                 "serve.bucket_hits", model=model, bucket=bucket
             )
             padded, _ = buckets.pad_to_bucket(combined, bucket)
-            raw, dev_s = self._device_dispatch(entry, model, padded, bucket)
+            t_disp = time.perf_counter()
+            raw, dev_s = self._device_dispatch(
+                entry, model, padded, bucket, links=links
+            )
+            if links:
+                TIMELINE.record_span(
+                    "serve.dispatch", t_disp, time.perf_counter(),
+                    model=model, bucket=str(bucket), links=links,
+                )
             prev = self._device_ewma.get(model)
             self._device_ewma[model] = (
                 dev_s if prev is None else 0.5 * prev + 0.5 * dev_s

@@ -30,7 +30,9 @@ import numpy as np
 
 from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
 from spark_rapids_ml_tpu.serving.registry import ModelRegistry, get_registry
+from spark_rapids_ml_tpu.telemetry import tracectx
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 
 
 class ServeClient:
@@ -70,12 +72,25 @@ class ServeClient:
         from spark_rapids_ml_tpu.serving.server import status_for_error
 
         t0 = time.perf_counter()
+        # in-process admission point: adopt an ambient context (a traced
+        # caller, e.g. the refresh daemon's probation scoring) or mint a
+        # sampled one — same trace semantics as the network front-ends
+        parent = tracectx.current_trace()
+        ctx = parent.child() if parent is not None else tracectx.mint(
+            origin="inproc"
+        )
         try:
-            out = self._batcher().submit(model, x).result(timeout)
+            out = self._batcher().submit(model, x, trace=ctx).result(timeout)
         except BaseException as e:
             code = status_for_error(e)
             REGISTRY.counter_inc("serve.errors", model=model, code=code)
             REGISTRY.counter_inc("serve.requests", model=model, code=code)
+            if ctx is not None:
+                TIMELINE.record_span(
+                    "serve.request", t0, time.perf_counter(),
+                    model=model, transport="inproc", code=str(code),
+                    **tracectx.span_labels(ctx, parent=parent),
+                )
             raise
         latency = time.perf_counter() - t0
         REGISTRY.counter_inc("serve.requests", model=model, code=200)
@@ -83,9 +98,16 @@ class ServeClient:
             "serve.transport", transport="inproc", wire="array"
         )
         REGISTRY.histogram_record(
-            "serve.latency", latency, model=model,
-            transport="inproc", wire="array",
+            "serve.latency", latency,
+            exemplar=ctx.trace_hex if ctx is not None else "",
+            model=model, transport="inproc", wire="array",
         )
+        if ctx is not None:
+            TIMELINE.record_span(
+                "serve.request", t0, time.perf_counter(),
+                model=model, transport="inproc", wire="array",
+                **tracectx.span_labels(ctx, parent=parent),
+            )
         return out
 
     def close(self, timeout: float = 5.0) -> None:

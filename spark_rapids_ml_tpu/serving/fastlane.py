@@ -12,12 +12,16 @@ a fixed frame:
   its frame with ``FASTLANE_MAGIC`` — a value (~4.1 GB) no sane JSON
   header length can reach — so one ``recv`` discriminates the lanes and
   JSON callers are untouched. The request that follows is a fixed
-  12-byte struct (version, flags, name length, rows, cols) + model name
-  + raw little-endian f32 rows; the response is a 16-byte struct
-  (version, flags, HTTP-equivalent status, rows, cols, payload length)
-  + raw f32 (or a UTF-8 error message when the error flag is set). No
-  dict is built on either side; the payload goes ``frombuffer`` ->
-  batcher -> pooled buffer -> socket.
+  32-byte struct (version, flags, name length, rows, cols, then the
+  trace-context fields: trace_id u64 / span_id u32 / origin_us u64, all
+  zero on an untraced request) + model name + raw little-endian f32
+  rows; the response is a 16-byte struct (version, flags,
+  HTTP-equivalent status, rows, cols, payload length) + raw f32 (or a
+  UTF-8 error message when the error flag is set). No dict is built on
+  either side; the payload goes ``frombuffer`` -> batcher -> pooled
+  buffer -> socket, and trace propagation stays binary — the fleet
+  router re-parents a relayed frame by rewriting the trace bytes at a
+  fixed offset, zero JSON either way.
 
 - **Pinned response buffers.** ``ResponseBufferPool`` keeps pre-sized
   ``bytearray``s per (model, bucket) and leases them out per response:
@@ -42,6 +46,7 @@ import threading
 
 import numpy as np
 
+from spark_rapids_ml_tpu.telemetry import tracectx
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 
 # Rides in place of the 4-byte JSON-header length that opens every UDS
@@ -50,10 +55,16 @@ from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 FASTLANE_MAGIC = 0xF5A57A4E
 _MAGIC_BYTES = struct.pack(">I", FASTLANE_MAGIC)
 
-FASTLANE_VERSION = 1
+FASTLANE_VERSION = 2
 
-# request: version u8, flags u8, name_len u16, rows u32, cols u32
-_REQ_STRUCT = struct.Struct(">BBHII")
+# request: version u8, flags u8, name_len u16, rows u32, cols u32,
+# trace_id u64, span_id u32, origin_us u64 (trace fields all-zero on an
+# untraced request; the trace tail mirrors telemetry.tracectx.TRACE_STRUCT)
+_REQ_STRUCT = struct.Struct(">BBHIIQIQ")
+# fixed byte offset of the trace tail inside the packed request struct —
+# the fleet router rewrites these 20 bytes in place to inject/re-parent a
+# relayed frame's context without any decode
+_TRACE_OFFSET = _REQ_STRUCT.size - tracectx.TRACE_STRUCT.size
 # response: version u8, flags u8, status u16, rows u32, cols u32,
 # payload_len u32 (== rows*cols*4 on success, error-message bytes on error)
 _RESP_STRUCT = struct.Struct(">BBHII I".replace(" ", ""))
@@ -91,8 +102,14 @@ def is_fastlane_head(head: bytes) -> bool:
     return head == _MAGIC_BYTES
 
 
-def pack_request(model: str, x: np.ndarray, *, query: bool = False) -> bytes:
-    """One contiguous fast-lane request frame (magic included)."""
+def pack_request(
+    model: str, x: np.ndarray, *, query: bool = False, trace=None
+) -> bytes:
+    """One contiguous fast-lane request frame (magic included).
+
+    ``trace`` is an optional :class:`telemetry.tracectx.TraceContext`;
+    ``None`` packs the all-zero (untraced) trace tail.
+    """
     mat = np.ascontiguousarray(x, dtype=_DTYPE)
     if mat.ndim != 2:
         raise ValueError("fastlane payload must be 2-D (rows, features)")
@@ -101,28 +118,33 @@ def pack_request(model: str, x: np.ndarray, *, query: bool = False) -> bytes:
         raise ValueError("model name too long for fastlane frame")
     flags = FLAG_QUERY if query else 0
     header = _REQ_STRUCT.pack(
-        FASTLANE_VERSION, flags, len(name), mat.shape[0], mat.shape[1]
+        FASTLANE_VERSION, flags, len(name), mat.shape[0], mat.shape[1],
+        trace.trace_id if trace is not None else 0,
+        trace.span_id if trace is not None else 0,
+        trace.origin_us if trace is not None else 0,
     )
     return b"".join((_MAGIC_BYTES, header, name, mat.tobytes()))
 
 
-def read_request(read_exact) -> tuple[str, np.ndarray, bool]:
+def read_request(read_exact):
     """Parse one request after the magic has been consumed.
 
     ``read_exact(n)`` must return exactly ``n`` bytes (the server's
     ``_read_exact`` over the socket rfile). Returns
-    ``(model, matrix, is_query)``; the matrix is a zero-copy
-    ``frombuffer`` view over the received payload.
+    ``(model, matrix, is_query, trace)``; the matrix is a zero-copy
+    ``frombuffer`` view over the received payload and ``trace`` is a
+    ``TraceContext`` (``None`` when the frame's trace tail is zero).
     """
-    version, flags, name_len, rows, cols = _REQ_STRUCT.unpack(
-        read_exact(_REQ_STRUCT.size)
+    version, flags, name_len, rows, cols, trace_id, span_id, origin_us = (
+        _REQ_STRUCT.unpack(read_exact(_REQ_STRUCT.size))
     )
     if version != FASTLANE_VERSION:
         raise ValueError(f"unsupported fastlane version {version}")
     model = bytes(read_exact(name_len)).decode("utf-8")
     payload = read_exact(rows * cols * _DTYPE.itemsize)
     mat = np.frombuffer(payload, dtype=_DTYPE).reshape(rows, cols)
-    return model, mat, bool(flags & FLAG_QUERY)
+    trace = tracectx.from_wire(trace_id, span_id, origin_us)
+    return model, mat, bool(flags & FLAG_QUERY), trace
 
 
 def request_struct_size() -> int:
@@ -133,10 +155,32 @@ def request_struct_size() -> int:
 def peek_request(raw: bytes) -> tuple[int, int, int]:
     """(name_len, rows, cols) from a packed request struct — all a router
     needs to route the frame without touching the payload."""
-    version, _flags, name_len, rows, cols = _REQ_STRUCT.unpack(raw)
+    version, _flags, name_len, rows, cols = _REQ_STRUCT.unpack(raw)[:5]
     if version != FASTLANE_VERSION:
         raise ValueError(f"unsupported fastlane version {version}")
     return name_len, rows, cols
+
+
+def peek_trace(raw: bytes):
+    """The trace tail of a packed request struct as a ``TraceContext``
+    (``None`` when untraced) — the router's zero-decode context read."""
+    trace_id, span_id, origin_us = tracectx.TRACE_STRUCT.unpack_from(
+        raw, _TRACE_OFFSET
+    )
+    return tracectx.from_wire(trace_id, span_id, origin_us)
+
+
+def rewrite_trace(raw: bytes, trace) -> bytes:
+    """A copy of a packed request struct with its trace tail replaced —
+    how the fleet router injects a freshly minted context (or re-parents
+    a propagated one to its relay span) into the bytes it already
+    buffered. Pure byte surgery at a fixed offset: no JSON, no decode of
+    the surrounding frame."""
+    return raw[:_TRACE_OFFSET] + tracectx.TRACE_STRUCT.pack(
+        trace.trace_id if trace is not None else 0,
+        trace.span_id if trace is not None else 0,
+        trace.origin_us if trace is not None else 0,
+    )
 
 
 def response_struct_size() -> int:

@@ -42,6 +42,20 @@ Design points, each riding machinery an earlier PR shipped:
   spawn; an over-budget plan is surfaced (the in-replica HBM manager
   still pages, but the operator sees the pressure up front).
 
+- **Unified observability plane.** The router is the fleet's trace
+  admission point: it adopts a propagated context or mints one
+  (``telemetry.tracectx``), injects it into the forwarded frame (fixed
+  offset byte surgery on the fast lane — zero JSON), and records a
+  ``serve.relay`` span per request; a silent retry leaves a ``retry``
+  instant on the trace. Replicas answer a ``STATS`` frame on their serve
+  socket (registry + flight-recorder tail) and persist a telemetry
+  trailer next to their socket at READY and on teardown, so even a
+  replica killed before its first request leaves its fragment behind.
+  ``FleetExporter`` serves the merged view over one port: ``/metrics``
+  (replica-labeled Prometheus rollup whose sums equal the per-replica
+  registries), ``/healthz`` (worst-of component rollup), and
+  ``/traces/<id>`` (stitched cross-process span trees).
+
 The router is plain host orchestration — bytes in, bytes out; device
 work happens only inside replicas. Per-device affinity: each replica
 pins its default device to ``slot % device_count``, so an N-chip host
@@ -53,6 +67,7 @@ from __future__ import annotations
 import argparse
 import bisect
 import hashlib
+import http.server
 import json
 import logging
 import os
@@ -68,7 +83,9 @@ import numpy as np
 
 from spark_rapids_ml_tpu.resilience.supervisor import WorkerSupervisor
 from spark_rapids_ml_tpu.serving import buckets, fastlane
-from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry import tracectx
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 from spark_rapids_ml_tpu.utils import knobs
 
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
@@ -95,6 +112,44 @@ def drain_timeout_s() -> float:
         )
     except ValueError:
         return float(knobs.SERVE_DRAIN_TIMEOUT_S.default)
+
+
+# -- replica telemetry trailer -----------------------------------------------
+#
+# Each replica persists its registry + flight-recorder tail next to its
+# socket: once right after READY (so a replica that dies before its first
+# request still leaves its fragment behind — the crash-window gap the chaos
+# matrix exercises) and again on graceful teardown (the final word). The
+# router harvests the file exactly once per replica incarnation, so the
+# fleet-wide /metrics sum and the stitched trace stream survive restarts.
+
+
+def trailer_path(socket_path: str) -> str:
+    return socket_path + ".trailer"
+
+
+def write_trailer(socket_path: str) -> None:
+    """Atomically persist this process's telemetry next to its socket."""
+    trailer = {
+        "pid": os.getpid(),
+        "seq": TIMELINE.seq(),
+        "mono_us": int(time.perf_counter() * 1e6),
+        "registry": REGISTRY.snapshot().to_wire(),
+        "events": TIMELINE.events(),
+    }
+    tmp = trailer_path(socket_path) + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(trailer, f)
+    os.replace(tmp, trailer_path(socket_path))
+
+
+def read_trailer(socket_path: str) -> dict | None:
+    try:
+        with open(trailer_path(socket_path), encoding="utf-8") as f:
+            trailer = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return trailer if isinstance(trailer, dict) else None
 
 
 # -- model spec: how fitted models travel to replica processes ---------------
@@ -292,6 +347,21 @@ class ReplicaProcess:
         self.compiles: int | None = None
         self.cache_hits: int | None = None
         self.cache_misses: int | None = None
+        # monotonic-clock handshake: the replica stamps its perf_counter
+        # reading on the READY line; paired with the router's reading at
+        # receipt it yields the per-replica clock offset the fleet trace
+        # merge corrects with (0 on Linux, where perf_counter is the
+        # system-wide CLOCK_MONOTONIC — but the correction is what makes
+        # merged timelines portable)
+        self.ready_mono_us: int | None = None
+        self.ready_local_us: int | None = None
+
+    @property
+    def clock_offset_us(self) -> int:
+        """Router-clock minus replica-clock at the READY handshake."""
+        if self.ready_mono_us is None or self.ready_local_us is None:
+            return 0
+        return self.ready_local_us - self.ready_mono_us
 
     @property
     def dead(self) -> bool:
@@ -308,6 +378,10 @@ class ReplicaProcess:
             if not line:
                 return False  # died before READY
             if line.strip().startswith(_READY_SENTINEL):
+                self.ready_local_us = int(time.perf_counter() * 1e6)
+                parts = line.split()
+                if len(parts) >= 3 and parts[2].isdigit():
+                    self.ready_mono_us = int(parts[2])
                 self._ready = True
                 return True
         return False
@@ -374,7 +448,14 @@ def _replica_main(argv: list[str]) -> int:
         registry.register(name, model, bucket_list=bucket_list)
     batcher = MicroBatcher(registry).start()
     listener = ServeUDSListener(args.socket, batcher).start()
-    print(f"{_READY_SENTINEL} {args.socket}", flush=True)
+    print(
+        f"{_READY_SENTINEL} {args.socket} {int(time.perf_counter() * 1e6)}",
+        flush=True,
+    )
+    # first trailer flush right after READY: a replica killed between
+    # READY and its first request still leaves its telemetry fragment
+    # behind for the router to merge
+    write_trailer(args.socket)
     try:
         sys.stdin.read()  # blocks until the parent closes our stdin
     except KeyboardInterrupt:
@@ -382,6 +463,13 @@ def _replica_main(argv: list[str]) -> int:
     finally:
         listener.stop()
         batcher.stop()
+        # final trailer flush on supervised teardown: the registry and
+        # flight-recorder state the fleet aggregation folds in after this
+        # process is gone
+        try:
+            write_trailer(args.socket)
+        except OSError:
+            pass
         # shutdown report: this replica's compile traffic. A respawn
         # warmed from the shared AOT cache reports cache_misses == 0 —
         # every registration-time compile was a disk load, not fresh XLA
@@ -429,9 +517,17 @@ class _RouterHandler(socketserver.StreamRequestHandler):
             n -= len(chunk)
         return b"".join(chunks)
 
-    def _read_request(self) -> tuple[str, int, bytes] | None:
-        """Read one client frame; returns (model, rows, raw_frame) or None
-        on clean EOF. The frame is parsed only far enough to route."""
+    def _read_request(self):
+        """Read one client frame; returns ``(model, rows, raw_frame, ctx,
+        parent)`` or None on clean EOF. The frame is parsed only far
+        enough to route — and to thread the trace context through: a
+        propagated context is adopted (the relay span re-parents it), an
+        absent one is minted here (the router is the fleet's admission
+        point), and the forwarded frame carries the relay span's identity
+        so the replica's request span parents to it. On the fast lane the
+        injection is fixed-offset byte surgery (zero JSON); on the JSON
+        wire the header — already decoded for routing — is re-encoded
+        through the counted codec."""
         head = self.rfile.read(4)
         if not head:
             return None
@@ -445,9 +541,17 @@ class _RouterHandler(socketserver.StreamRequestHandler):
             name_len, rows, cols = fastlane.peek_request(struct_raw)
             name = self._read_exact(self.rfile, name_len)
             payload = self._read_exact(self.rfile, rows * cols * 4)
+            parent = fastlane.peek_trace(struct_raw)
+            ctx = (
+                parent.child() if parent is not None
+                else tracectx.mint(origin="router")
+            )
+            if ctx is not None:
+                struct_raw = fastlane.rewrite_trace(struct_raw, ctx)
             return (
                 name.decode("utf-8"), rows,
                 b"".join((head, struct_raw, name, payload)),
+                ctx, parent,
             )
         header_raw = self._read_exact(self.rfile, int.from_bytes(head, "big"))
         header = fastlane.json_loads(header_raw)
@@ -460,7 +564,16 @@ class _RouterHandler(socketserver.StreamRequestHandler):
         else:
             payload = b""
             rows = len(header.get("instances") or [None])
-        return model, rows, head + header_raw + payload
+        parent = tracectx.from_header(str(header.get("trace", "")))
+        ctx = (
+            parent.child() if parent is not None
+            else tracectx.mint(origin="router")
+        )
+        if ctx is not None:
+            header["trace"] = ctx.to_header()
+            header_raw = fastlane.json_dumps(header).encode()
+            head = len(header_raw).to_bytes(4, "big")
+        return model, rows, head + header_raw + payload, ctx, parent
 
     def _relay_response(self, rfile) -> bytes:
         """Read one complete replica response frame, verbatim."""
@@ -520,14 +633,23 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                 req = self._read_request()
                 if req is None:
                     return
-                model, rows, frame = req
+                model, rows, frame, ctx, parent = req
                 try:
                     bucket = buckets.serve_bucket(max(1, rows))
                 except ValueError:
                     bucket = buckets.max_batch_rows()
+                t0 = time.perf_counter()
                 response = fleet.route(
-                    model, bucket, frame, self._forward
+                    model, bucket, frame, self._forward, trace=ctx
                 )
+                if ctx is not None:
+                    # the relay span: fleet admission (root when minted
+                    # here) covering route + forward + response relay
+                    TIMELINE.record_span(
+                        "serve.relay", t0, time.perf_counter(),
+                        model=model,
+                        **tracectx.span_labels(ctx, parent=parent),
+                    )
                 self.wfile.write(response)
                 self.wfile.flush()
         except (EOFError, BrokenPipeError, ConnectionResetError):
@@ -585,6 +707,16 @@ class ServeFleet:
         self._served: dict[int, int] = {i: 0 for i in range(replicas)}
         self._router: socketserver.ThreadingUnixStreamServer | None = None
         self._router_thread: threading.Thread | None = None
+        # fleet observability plane: dead replicas' final registries and
+        # flight-recorder fragments (harvested from telemetry trailers,
+        # once per (slot, pid) incarnation) so the merged /metrics sum and
+        # the stitched trace stream stay right through restarts
+        self._agg_lock = threading.Lock()
+        self._final_registry = MetricsRegistry()
+        self._final_events: list[dict] = []
+        self._harvested: set[tuple[int, int]] = set()
+        self._clock_offsets: dict[int, int] = {}
+        self._exporter: FleetExporter | None = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -615,6 +747,8 @@ class ServeFleet:
                     + self._replica_stderr(worker)
                 )
             self._supervisor.report_success(slot)
+            with self._agg_lock:
+                self._clock_offsets[slot] = worker.clock_offset_us
         if os.path.exists(self.router_path):
             os.unlink(self.router_path)
         self._router = socketserver.ThreadingUnixStreamServer(
@@ -643,6 +777,9 @@ class ServeFleet:
         return ("\n--- replica stderr ---\n" + tail[-2000:]) if tail else ""
 
     def stop(self, timeout: float = 10.0) -> None:
+        if self._exporter is not None:
+            self._exporter.stop(timeout)
+            self._exporter = None
         if self._router is not None:
             self._router.shutdown()
             self._router.server_close()
@@ -655,6 +792,11 @@ class ServeFleet:
         except OSError:
             pass
         self._supervisor.close()
+        # every replica just flushed its teardown trailer; fold the final
+        # fragments in so post-stop reads (bench, reports) see the fleet's
+        # complete telemetry
+        for slot in range(self.replicas):
+            self._harvest_trailer(slot)
         REGISTRY.gauge_set("serve.fleet_replicas", 0)
 
     # -- routing ------------------------------------------------------------
@@ -676,7 +818,9 @@ class ServeFleet:
         w = lease.worker
         return w is not None and not w.dead
 
-    def route(self, model: str, bucket: int, frame: bytes, forward) -> bytes:
+    def route(
+        self, model: str, bucket: int, frame: bytes, forward, trace=None
+    ) -> bytes:
         """Pick a replica for (model, bucket) and forward the frame.
 
         The home replica (first in the ring's preference order) gets the
@@ -719,6 +863,17 @@ class ServeFleet:
                 worker = self._supervisor._slots[slot].worker
                 if worker is not None and worker.dead:
                     self._supervisor.report_crash(slot, e)
+                    # the dead replica's READY-time trailer is all that is
+                    # left of its telemetry — fold it in now
+                    self._harvest_trailer(slot)
+                if trace is not None:
+                    # the silent retry leaves a visible mark on the trace:
+                    # an instant carrying the relay span's identity, so
+                    # the stitched tree shows which hop re-routed
+                    TIMELINE.record_instant(
+                        "retry", slot=str(slot), model=model,
+                        **tracectx.span_labels(trace),
+                    )
                 continue
             finally:
                 with self._state_cond:
@@ -774,10 +929,15 @@ class ServeFleet:
         worker = lease.worker
         if worker is not None:
             worker.close()
+            # the outgoing incarnation's graceful-teardown trailer is now
+            # final — fold its registry + events into the fleet plane
+            self._harvest_trailer(slot)
         replacement = self._supervisor.checkout(slot)
         ok = replacement is not None and replacement.wait_ready(timeout)
         if ok:
             self._supervisor.report_success(slot)
+            with self._agg_lock:
+                self._clock_offsets[slot] = replacement.clock_offset_us
             REGISTRY.counter_inc("serve.replica_restarts", slot=str(slot))
         else:
             self._supervisor.report_crash(
@@ -816,6 +976,8 @@ class ServeFleet:
             served = dict(self._served)
             in_flight = dict(self._in_flight)
             draining = sorted(self._draining)
+        with self._agg_lock:
+            offsets = dict(self._clock_offsets)
         return {
             "replicas": self.replicas,
             "live_replicas": self.live_replicas(),
@@ -823,9 +985,265 @@ class ServeFleet:
             "served_per_replica": {str(k): v for k, v in served.items()},
             "in_flight": {str(k): v for k, v in in_flight.items()},
             "draining": draining,
+            "clock_offsets_us": {str(k): v for k, v in offsets.items()},
             "placement": self.placement,
             "supervisor": self._supervisor.summary(),
         }
+
+    # -- fleet observability plane -------------------------------------------
+
+    def _harvest_trailer(self, slot: int) -> None:
+        """Fold a dead/stopped replica incarnation's telemetry trailer into
+        the fleet aggregation state — once per (slot, pid), so the READY
+        trailer of a crashed incarnation and the teardown trailer of a
+        graceful one are never double-counted."""
+        trailer = read_trailer(self.replica_socket(slot))
+        if not trailer:
+            return
+        pid = int(trailer.get("pid") or 0)
+        with self._agg_lock:
+            if (slot, pid) in self._harvested:
+                return
+            self._harvested.add((slot, pid))
+            self._final_registry.merge_wire(
+                trailer.get("registry") or {}, replica=str(slot)
+            )
+            for e in trailer.get("events") or []:
+                if isinstance(e, dict):
+                    self._final_events.append(
+                        dict(
+                            e,
+                            args=dict(
+                                e.get("args") or {}, replica=str(slot)
+                            ),
+                        )
+                    )
+
+    def scrape_stats(
+        self, slot: int, since_seq: int = 0, timeout: float = 5.0
+    ) -> dict | None:
+        """Pull one live replica's registry + flight-recorder tail over the
+        STATS frame on its serve socket; None when the replica is not
+        scrapable. Plain stdlib json — the scrape surface stays off the
+        counted serve.json_codec series on both sides."""
+        if not self._available(slot):
+            return None
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            s.settimeout(timeout)
+            s.connect(self.replica_socket(slot))
+            raw = json.dumps(
+                {"kind": "stats", "since_seq": since_seq}
+            ).encode()
+            s.sendall(len(raw).to_bytes(4, "big") + raw)
+            rfile = s.makefile("rb")
+            head = rfile.read(4)
+            if len(head) < 4:
+                return None
+            body = b""
+            n = int.from_bytes(head, "big")
+            while len(body) < n:
+                chunk = rfile.read(n - len(body))
+                if not chunk:
+                    return None
+                body += chunk
+            stats = json.loads(body)
+            return stats if isinstance(stats, dict) else None
+        except (OSError, ValueError):
+            return None
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def fleet_events(self) -> list[dict]:
+        """The merged fleet-wide flight-recorder stream: the router
+        process's own events (relay spans, retry instants), every live
+        replica's scraped tail, and the harvested fragments of dead
+        incarnations — deduplicated by (pid, seq) so a span seen both over
+        a scrape and in a later trailer lands exactly once. Replica events
+        are stamped ``replica=<slot>`` in args."""
+        seen: set[tuple] = set()
+        out: list[dict] = []
+
+        def add(events: list, replica: str = "") -> None:
+            for e in events:
+                if not isinstance(e, dict):
+                    continue
+                k = (e.get("pid"), e.get("seq"))
+                if k in seen:
+                    continue
+                seen.add(k)
+                if replica:
+                    e = dict(
+                        e, args=dict(e.get("args") or {}, replica=replica)
+                    )
+                out.append(e)
+
+        add(TIMELINE.events())
+        for slot in range(self.replicas):
+            stats = self.scrape_stats(slot)
+            if stats:
+                add(stats.get("events") or [], replica=str(slot))
+        with self._agg_lock:
+            final = list(self._final_events)
+        add(final)
+        return out
+
+    def fleet_registry(self, include_router: bool = True) -> MetricsRegistry:
+        """One merged registry for the whole fleet: live replicas scraped
+        over STATS (``replica=<slot>``), dead incarnations' final trailers,
+        and (by default) the router process's own registry
+        (``replica=router``). Summing any family across the replica label
+        reproduces the per-replica registries exactly — the contract the
+        fleet /metrics test pins."""
+        merged = MetricsRegistry()
+        for slot in range(self.replicas):
+            stats = self.scrape_stats(slot)
+            if stats:
+                merged.merge_wire(
+                    stats.get("registry") or {}, replica=str(slot)
+                )
+        with self._agg_lock:
+            merged.merge_wire(self._final_registry.snapshot().to_wire())
+        if include_router:
+            merged.merge_wire(
+                REGISTRY.snapshot().to_wire(), replica="router"
+            )
+        return merged
+
+    def healthz(self) -> dict:
+        """Worst-of rollup across fleet components: any dead replica (or a
+        closed router) makes the fleet ``down``, any draining replica
+        ``degraded``, otherwise ``ok``."""
+        components: dict[str, str] = {}
+        with self._state_lock:
+            draining = set(self._draining)
+        for slot in range(self.replicas):
+            w = self._supervisor._slots[slot].worker
+            if w is None or w.dead:
+                components[f"replica-{slot}"] = "down"
+            elif slot in draining:
+                components[f"replica-{slot}"] = "draining"
+            else:
+                components[f"replica-{slot}"] = "ok"
+        components["router"] = "ok" if self._router is not None else "down"
+        if any(s == "down" for s in components.values()):
+            status = "down"
+        elif any(s == "draining" for s in components.values()):
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "components": components,
+            "live_replicas": self.live_replicas(),
+            "replicas": self.replicas,
+        }
+
+    def trace_coverage(self) -> dict:
+        """Stitching coverage over the merged fleet event stream — the
+        ≥99%-complete / zero-orphan number bench gates on."""
+        return tracectx.coverage(self.fleet_events())
+
+    def start_exporter(self, port: int = 0) -> "FleetExporter":
+        """Start (or return) the fleet-wide scrape surface."""
+        if self._exporter is None:
+            self._exporter = FleetExporter(self, port).start()
+        return self._exporter
+
+
+# -- fleet exporter ----------------------------------------------------------
+
+
+class _FleetExporterHandler(http.server.BaseHTTPRequestHandler):
+    """The unified observability plane over one port: merged fleet-wide
+    Prometheus metrics, a worst-of health rollup, and stitched
+    cross-process trace trees."""
+
+    server_version = "tpu-ml-fleet-exporter/1.0"
+
+    def log_message(self, format, *args):  # noqa: A002 - http.server naming
+        logger.debug("fleet exporter: " + format, *args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, payload: dict) -> None:
+        self._send(
+            code, json.dumps(payload).encode() + b"\n", "application/json"
+        )
+
+    def do_GET(self):  # noqa: N802 - http.server naming contract
+        fleet: ServeFleet = self.server.fleet
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            self._send(
+                200,
+                fleet.fleet_registry().to_prometheus().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            return
+        if path == "/healthz":
+            health = fleet.healthz()
+            self._json(503 if health["status"] == "down" else 200, health)
+            return
+        if path == "/traces":
+            self._json(200, fleet.trace_coverage())
+            return
+        if path.startswith("/traces/"):
+            tid = path[len("/traces/"):]
+            tree = tracectx.stitch(fleet.fleet_events(), tid)
+            if tree is None:
+                self._json(404, {"error": f"unknown trace {tid!r}"})
+            else:
+                self._json(200, tree)
+            return
+        self._json(404, {"error": f"no such endpoint: {path}"})
+
+
+class FleetExporter:
+    """HTTP scrape surface for a running fleet: ``/metrics`` (merged,
+    replica-labeled), ``/healthz`` (worst-of rollup), ``/traces``
+    (stitching coverage) and ``/traces/<id>`` (one stitched tree)."""
+
+    def __init__(self, fleet: ServeFleet, port: int = 0):
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), _FleetExporterHandler
+        )
+        self._httpd.daemon_threads = True
+        self._httpd.fleet = fleet
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def url(self, path: str = "/") -> str:
+        return f"http://127.0.0.1:{self.port}{path}"
+
+    def start(self) -> "FleetExporter":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.05},
+                name="tpu-ml-fleet-exporter",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
 
 
 if __name__ == "__main__":

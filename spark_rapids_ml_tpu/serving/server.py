@@ -78,8 +78,9 @@ from spark_rapids_ml_tpu.serving.registry import (
     ModelRegistry,
     get_registry,
 )
-from spark_rapids_ml_tpu.telemetry import httpd
+from spark_rapids_ml_tpu.telemetry import httpd, tracectx
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
 from spark_rapids_ml_tpu.utils import knobs
 
 logger = logging.getLogger("spark_rapids_ml_tpu.serving")
@@ -208,6 +209,15 @@ class ServeHandler(httpd._Handler):
         batcher ride, same telemetry; only the response shape differs (a
         query answer unpacks into ids + distances)."""
         t0 = time.perf_counter()
+        # trace admission: adopt a propagated X-TPU-ML-Trace context (the
+        # fleet router's relay span is then this span's parent) or mint a
+        # fresh sampled one — an unsampled request records no spans
+        parent = tracectx.from_header(
+            self.headers.get(tracectx.TRACE_HEADER, "")
+        )
+        ctx = parent.child() if parent is not None else tracectx.mint(
+            origin="http"
+        )
         try:
             if kind == "query":
                 entry = self._registry.get(name)
@@ -217,12 +227,18 @@ class ServeHandler(httpd._Handler):
                         "ann index"
                     )
             instances, wire = self._read_payload(name)
-            future = self._batcher.submit(name, instances)
+            future = self._batcher.submit(name, instances, trace=ctx)
             out = future.result(timeout=30.0)
         except Exception as e:  # noqa: BLE001 - predict must answer, not die
             code = status_for_error(e)
             if code == 500:
                 logger.exception("%s failed for model %s", kind, name)
+            if ctx is not None:
+                TIMELINE.record_span(
+                    "serve.request", t0, time.perf_counter(),
+                    model=name, transport="http", code=str(code),
+                    **tracectx.span_labels(ctx, parent=parent),
+                )
             self._serve_error(name, code, f"{type(e).__name__}: {e}"
                               if code == 500 else str(e))
             return
@@ -232,8 +248,16 @@ class ServeHandler(httpd._Handler):
         REGISTRY.counter_inc("serve.requests", model=name, code=200)
         REGISTRY.counter_inc("serve.transport", transport="http", wire=wire)
         REGISTRY.histogram_record(
-            "serve.latency", latency, model=name, transport="http", wire=wire
+            "serve.latency", latency,
+            exemplar=ctx.trace_hex if ctx is not None else "",
+            model=name, transport="http", wire=wire,
         )
+        if ctx is not None:
+            TIMELINE.record_span(
+                "serve.request", t0, time.perf_counter(),
+                model=name, transport="http", wire=wire,
+                **tracectx.span_labels(ctx, parent=parent),
+            )
         if kind == "query":
             REGISTRY.counter_inc(
                 "ann.queries", int(np.shape(out)[0]), index=name
@@ -375,10 +399,15 @@ def _fastlane_handle(rfile, wfile, batcher: MicroBatcher) -> bool:
     No dict is materialized and the counted JSON codec never runs — the
     per-transport parity test holds this path to a zero
     ``serve.json_codec`` delta."""
-    model, mat, is_query = fastlane.read_request(
+    model, mat, is_query, parent = fastlane.read_request(
         lambda n: _read_exact(rfile, n)
     )
     t0 = time.perf_counter()
+    # trace admission stays binary: the propagated context arrived as three
+    # fixed struct fields; minting books one counter, never a JSON touch
+    ctx = parent.child() if parent is not None else tracectx.mint(
+        origin="fastlane"
+    )
     try:
         if is_query:
             entry = batcher.registry.get(model)
@@ -387,13 +416,19 @@ def _fastlane_handle(rfile, wfile, batcher: MicroBatcher) -> bool:
                     f"{model!r} is a {entry.family} servable, not an ann "
                     "index"
                 )
-        out = batcher.submit(model, mat).result(timeout=30.0)
+        out = batcher.submit(model, mat, trace=ctx).result(timeout=30.0)
     except Exception as e:  # noqa: BLE001 - answer the frame, keep the conn
         code = status_for_error(e)
         if code == 500:
             logger.exception("fastlane predict failed for model %s", model)
         REGISTRY.counter_inc("serve.errors", model=model, code=code)
         REGISTRY.counter_inc("serve.requests", model=model, code=code)
+        if ctx is not None:
+            TIMELINE.record_span(
+                "serve.request", t0, time.perf_counter(),
+                model=model, transport="uds", wire="fast", code=str(code),
+                **tracectx.span_labels(ctx, parent=parent),
+            )
         wfile.write(fastlane.pack_error_response(code, str(e)))
         wfile.flush()
         return True
@@ -401,8 +436,16 @@ def _fastlane_handle(rfile, wfile, batcher: MicroBatcher) -> bool:
     REGISTRY.counter_inc("serve.requests", model=model, code=200)
     REGISTRY.counter_inc("serve.transport", transport="uds", wire="fast")
     REGISTRY.histogram_record(
-        "serve.latency", latency, model=model, transport="uds", wire="fast"
+        "serve.latency", latency,
+        exemplar=ctx.trace_hex if ctx is not None else "",
+        model=model, transport="uds", wire="fast",
     )
+    if ctx is not None:
+        TIMELINE.record_span(
+            "serve.request", t0, time.perf_counter(),
+            model=model, transport="uds", wire="fast",
+            **tracectx.span_labels(ctx, parent=parent),
+        )
     if is_query:
         REGISTRY.counter_inc("ann.queries", int(np.shape(out)[0]), index=model)
     with pooled_binary_response(model, out) as (view, shape):
@@ -435,6 +478,28 @@ def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
     wire = str(header.get("wire", "json"))
     accept = str(header.get("accept", wire))
     kind = str(header.get("kind", "predict"))
+    if kind == "stats":
+        # observability scrape on the serve socket: the fleet router's
+        # exporter pulls each replica's registry + flight-recorder tail
+        # over this frame. Plain stdlib json on purpose — scrape-surface
+        # traffic stays off the counted serve.json_codec series.
+        resp = {
+            "ok": True,
+            "kind": "stats",
+            "registry": REGISTRY.snapshot().to_wire(),
+            "events": TIMELINE.events(int(header.get("since_seq", 0) or 0)),
+            "seq": TIMELINE.seq(),
+            "mono_us": int(time.perf_counter() * 1e6),
+            "pid": os.getpid(),
+        }
+        raw = json.dumps(resp).encode()
+        wfile.write(len(raw).to_bytes(4, "big") + raw)
+        wfile.flush()
+        return True
+    parent = tracectx.from_header(str(header.get("trace", "")))
+    ctx = parent.child() if parent is not None else tracectx.mint(
+        origin="uds"
+    )
     t0 = time.perf_counter()
     try:
         if kind == "query":
@@ -459,13 +524,19 @@ def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
                     'missing "instances" in request header (accepted '
                     f"dtypes: {', '.join(ACCEPTED_DTYPES)})"
                 )
-        out = batcher.submit(model, instances).result(timeout=30.0)
+        out = batcher.submit(model, instances, trace=ctx).result(timeout=30.0)
     except Exception as e:  # noqa: BLE001 - answer the frame, keep the conn
         code = status_for_error(e)
         if code == 500:
             logger.exception("uds predict failed for model %s", model)
         REGISTRY.counter_inc("serve.errors", model=model, code=code)
         REGISTRY.counter_inc("serve.requests", model=model, code=code)
+        if ctx is not None:
+            TIMELINE.record_span(
+                "serve.request", t0, time.perf_counter(),
+                model=model, transport="uds", wire=wire, code=str(code),
+                **tracectx.span_labels(ctx, parent=parent),
+            )
         _uds_send(
             wfile,
             {"ok": False, "code": code, "model": model, "error": str(e)},
@@ -475,8 +546,16 @@ def _uds_handle_one(rfile, wfile, batcher: MicroBatcher) -> bool:
     REGISTRY.counter_inc("serve.requests", model=model, code=200)
     REGISTRY.counter_inc("serve.transport", transport="uds", wire=wire)
     REGISTRY.histogram_record(
-        "serve.latency", latency, model=model, transport="uds", wire=wire
+        "serve.latency", latency,
+        exemplar=ctx.trace_hex if ctx is not None else "",
+        model=model, transport="uds", wire=wire,
     )
+    if ctx is not None:
+        TIMELINE.record_span(
+            "serve.request", t0, time.perf_counter(),
+            model=model, transport="uds", wire=wire,
+            **tracectx.span_labels(ctx, parent=parent),
+        )
     base = {
         "ok": True,
         "code": 200,
@@ -696,6 +775,14 @@ def serve_summary(snap) -> dict:
         "json_codec": {
             "encode": snap.counter("serve.json_codec", op="encode"),
             "decode": snap.counter("serve.json_codec", op="decode"),
+        },
+        # tail attribution: trace mint counts + the trace_ids of the
+        # slowest requests per histogram — what tools/tail_report.py joins
+        # against the stitched span trees
+        "trace": {
+            "minted": snap.counter("serve.traces"),
+            "latency_exemplars": snap.exemplars_for("serve.latency"),
+            "queue_exemplars": snap.exemplars_for("serve.queue_delay_us"),
         },
         "response_pool": fastlane.RESPONSE_POOL.stats(),
         "fleet": {

@@ -17,6 +17,10 @@ Endpoints:
 - ``/slo``      — the last SLO evaluation (objectives, rolling windows,
   breach totals) as JSON.
 - ``/report``   — the most recent Fit/Transform report dicts as JSON.
+- ``/traces``   — trace-stitching coverage over this process's flight
+  recorder; ``/traces/<id>`` returns one stitched span tree
+  (:func:`telemetry.tracectx.stitch`). Single-process view — the fleet
+  router's ``FleetExporter`` serves the cross-process merge.
 
 ``ensure_started()`` is the fit-path hook (called from ``begin_fit``):
 with ``TPU_ML_HTTP_PORT`` set, the first ``fit()`` of the process brings
@@ -69,6 +73,21 @@ class _Handler(BaseHTTPRequestHandler):
                 from spark_rapids_ml_tpu.telemetry import report as report_mod
 
                 self._json(200, {"reports": report_mod.recent_reports()})
+            elif path == "/traces":
+                from spark_rapids_ml_tpu.telemetry import tracectx
+                from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
+
+                self._json(200, tracectx.coverage(TIMELINE.events()))
+            elif path.startswith("/traces/"):
+                from spark_rapids_ml_tpu.telemetry import tracectx
+                from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
+
+                tid = path[len("/traces/"):]
+                tree = tracectx.stitch(TIMELINE.events(), tid)
+                if tree is None:
+                    self._json(404, {"error": f"unknown trace {tid!r}"})
+                else:
+                    self._json(200, tree)
             else:
                 self._json(404, {"error": f"no such endpoint: {path}"})
         except Exception as e:  # pragma: no cover - handler must not die

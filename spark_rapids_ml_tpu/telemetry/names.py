@@ -108,6 +108,8 @@ METRICS: frozenset[str] = frozenset({
     "serve.route_misses",
     "serve.drain_events",
     "serve.replica_restarts",
+    # distributed tracing (telemetry.tracectx): traces minted at admission
+    "serve.traces",
     # closed-loop model refresh / atomic hot-swap (refresh + serving.registry)
     "serve.swaps",
     "serve.swap_refused",
@@ -159,9 +161,63 @@ METRIC_PREFIXES: tuple[str, ...] = (
     "transform.partition_seconds_",
 )
 
+# -- metric family kinds ----------------------------------------------------
+# Families not listed below are counters. tools/metrics_dump.py routes each
+# family through its natural kind when re-aggregating, and the names-family
+# meta-check (tests/test_timeline.py) asserts every family's Prometheus
+# TYPE matches the kind declared here — adding a histogram or gauge family
+# to METRICS without declaring it fails CI before it silently renders as a
+# counter on a dashboard.
+
+HISTOGRAMS: frozenset[str] = frozenset({
+    "span.seconds",
+    "compile.seconds",
+    "compile.trace_seconds",
+    "compile.lower_seconds",
+    "compile.other_seconds",
+    "health.probe_seconds",
+    "ingest.chunk_rows",
+    "stream.overlap_fraction",
+    "transform.partition_seconds",
+    "costmodel.roofline_utilization",
+    "fit.wall_seconds",
+    "transform.wall_seconds",
+    "serve.latency",
+    "serve.queue_delay_seconds",
+    "serve.queue_delay_us",
+    "serve.window_effective_seconds",
+    "serve.batch_rows",
+    "serve.swap_blackout_seconds",
+})
+
+GAUGES: frozenset[str] = frozenset({
+    "stream.active",
+    "stream.last_beat",
+    "worker.last_trailer",
+    "health.state",
+    "slo.value",
+    "slo.target",
+    "slo.rolling",
+    "worker.slots",
+    "worker.quarantined",
+    "serve.models",
+    "serve.model_version",
+    "serve.hbm_bytes",
+    "serve.fleet_replicas",
+    "refresh.lag_seconds",
+})
+
 # -- span phases (trace_range names -> span.seconds{phase=...}) ------------
 
 SPAN_PHASES: frozenset[str] = frozenset({
+    # distributed request tracing (telemetry.tracectx + serving plane)
+    "serve.request",
+    "serve.queue",
+    "serve.dispatch",
+    "serve.relay",
+    "refresh.fold",
+    "refresh.swap",
+    "refresh.probation",
     # streamed-fit / dispatch machinery
     "fold.dispatch",
     "fold.wait",

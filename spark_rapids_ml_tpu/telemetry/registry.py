@@ -30,7 +30,22 @@ Design constraints that shaped it:
 from __future__ import annotations
 
 import math
+import os
 import threading
+
+from spark_rapids_ml_tpu.utils import knobs
+
+
+def _exemplar_budget() -> int:
+    """Slowest-sample exemplars retained per histogram series
+    (``TPU_ML_TRACE_EXEMPLARS``); consulted only on records that carry an
+    exemplar, so untraced hot paths never read the environment."""
+    raw = os.environ.get(knobs.TRACE_EXEMPLARS.name, "")
+    try:
+        budget = int(raw) if raw else int(knobs.TRACE_EXEMPLARS.default)
+    except ValueError:
+        budget = int(knobs.TRACE_EXEMPLARS.default)
+    return max(budget, 0)
 
 # Bucket boundaries at GROWTH**i: 4 buckets per power of two keeps the
 # worst-case quantile error under ~9.5% (half a bucket in log space) while
@@ -196,6 +211,9 @@ class MetricsRegistry:
         self._counters: dict[tuple, float] = {}
         self._gauges: dict[tuple, float] = {}
         self._hists: dict[tuple, Histogram] = {}
+        # per-series slowest-sample exemplars: key -> [(value, trace_id)]
+        # descending by value, capped at TPU_ML_TRACE_EXEMPLARS
+        self._exemplars: dict[tuple, list] = {}
 
     # -- mutation -----------------------------------------------------------
 
@@ -208,13 +226,31 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[_key(name, labels)] = value
 
-    def histogram_record(self, name: str, value: float, **labels) -> None:
+    def histogram_record(
+        self, name: str, value: float, exemplar: str = "", **labels
+    ) -> None:
         k = _key(name, labels)
         with self._lock:
             h = self._hists.get(k)
             if h is None:
                 h = self._hists[k] = Histogram()
             h.record(value)
+            if exemplar:
+                self._exemplar_add(k, float(value), exemplar)
+
+    def _exemplar_add(self, k: tuple, value: float, exemplar: str) -> None:
+        """Keep the top-K slowest (value, trace_id) pairs per series —
+        how a p99 bucket stays attributable to actual traces. Caller
+        holds the lock."""
+        budget = _exemplar_budget()
+        if budget <= 0:
+            return
+        ex = self._exemplars.setdefault(k, [])
+        if len(ex) >= budget and value <= ex[-1][0]:
+            return
+        ex.append((value, exemplar))
+        ex.sort(key=lambda pair: -pair[0])
+        del ex[budget:]
 
     def merge_wire(self, wire: dict, **extra_labels) -> None:
         """Fold a :meth:`RegistrySnapshot.to_wire` payload — typically a
@@ -234,6 +270,10 @@ class MetricsRegistry:
                 if h is None:
                     h = self._hists[k] = Histogram()
                 h.merge_wire(hwire)
+            for name, labels, pairs in wire.get("exemplars", ()):
+                k = _key(name, {**labels, **extra})
+                for value, trace_id in pairs:
+                    self._exemplar_add(k, float(value), str(trace_id))
 
     def to_prometheus(self) -> str:
         """Current state in the Prometheus text exposition format."""
@@ -244,6 +284,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._exemplars.clear()
 
     # -- read ---------------------------------------------------------------
 
@@ -253,6 +294,9 @@ class MetricsRegistry:
                 counters=dict(self._counters),
                 gauges=dict(self._gauges),
                 hists={k: h.copy() for k, h in self._hists.items()},
+                exemplars={
+                    k: list(v) for k, v in self._exemplars.items()
+                },
             )
 
     def span_totals(self) -> dict[str, dict[str, float]]:
@@ -274,10 +318,11 @@ class MetricsRegistry:
 class RegistrySnapshot:
     """Immutable-ish copy of registry state; supports delta and JSON dump."""
 
-    def __init__(self, counters, gauges, hists):
+    def __init__(self, counters, gauges, hists, exemplars=None):
         self.counters = counters
         self.gauges = gauges
         self.hists = hists
+        self.exemplars = exemplars or {}
 
     def delta(self, prev: "RegistrySnapshot | None") -> "RegistrySnapshot":
         if prev is None:
@@ -292,7 +337,13 @@ class RegistrySnapshot:
             d = h.delta(prev.hists.get(k))
             if d.count:
                 hists[k] = d
-        return RegistrySnapshot(counters=counters, gauges=dict(self.gauges), hists=hists)
+        # exemplars are a top-K sample, not cumulative — the window keeps
+        # the current extremes for every series live in the window
+        exemplars = {k: v for k, v in self.exemplars.items() if k in hists}
+        return RegistrySnapshot(
+            counters=counters, gauges=dict(self.gauges), hists=hists,
+            exemplars=exemplars,
+        )
 
     def counter(self, name: str, **labels) -> float:
         """Sum of a counter across label sets; with labels given, the exact
@@ -300,6 +351,20 @@ class RegistrySnapshot:
         if labels:
             return self.counters.get(_key(name, labels), 0)
         return sum(v for (n, _), v in self.counters.items() if n == name)
+
+    def exemplars_for(self, name: str, **labels) -> list:
+        """Merged slowest-sample exemplars for ``name`` across matching
+        label sets: ``[(value, trace_id), ...]`` descending by value."""
+        want = tuple(sorted((k, v) for k, v in labels.items() if v))
+        merged: list = []
+        for (n, lbl), pairs in self.exemplars.items():
+            if n != name:
+                continue
+            if want and not set(want).issubset(set(lbl)):
+                continue
+            merged.extend(pairs)
+        merged.sort(key=lambda pair: -pair[0])
+        return merged
 
     def hist(self, name: str, **labels) -> Histogram:
         """Merged histogram for ``name`` across matching label sets."""
@@ -356,6 +421,10 @@ class RegistrySnapshot:
             "hists": [
                 [name, dict(labels), h.to_wire()]
                 for (name, labels), h in sorted(self.hists.items())
+            ],
+            "exemplars": [
+                [name, dict(labels), [[v, t] for v, t in pairs]]
+                for (name, labels), pairs in sorted(self.exemplars.items())
             ],
         }
 

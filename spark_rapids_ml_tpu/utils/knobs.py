@@ -234,6 +234,15 @@ _DECLARATIONS = (
          "rolling drain bound: max seconds the fleet router waits for a "
          "draining replica's in-flight requests to reach zero before the "
          "replica is restarted anyway", "serving.fleet"),
+    # -- distributed tracing (telemetry.tracectx) ---------------------------
+    Knob("TPU_ML_TRACE_SAMPLE", "float", "1.0",
+         "fraction of admitted serve requests that mint a trace context "
+         "(carried over HTTP/UDS/fastlane and stitched fleet-wide; 0 "
+         "disables request tracing)", "telemetry.tracectx"),
+    Knob("TPU_ML_TRACE_EXEMPLARS", "int", "4",
+         "slowest-request exemplars (value + trace_id) retained per "
+         "latency-histogram series and surfaced in serving evidence "
+         "(0 disables exemplar capture)", "telemetry.tracectx"),
     # -- closed-loop model refresh (spark_rapids_ml_tpu.refresh) ------------
     Knob("TPU_ML_REFRESH_INTERVAL_S", "float", "30",
          "seconds between refresh-daemon cycles (fold pending deltas, "
@@ -378,6 +387,8 @@ SERVE_HEDGE_FLOOR_US = KNOBS["TPU_ML_SERVE_HEDGE_FLOOR_US"]
 SERVE_FLEET_REPLICAS = KNOBS["TPU_ML_SERVE_FLEET_REPLICAS"]
 SERVE_FLEET_SOCKET_DIR = KNOBS["TPU_ML_SERVE_FLEET_SOCKET_DIR"]
 SERVE_DRAIN_TIMEOUT_S = KNOBS["TPU_ML_SERVE_DRAIN_TIMEOUT_S"]
+TRACE_SAMPLE = KNOBS["TPU_ML_TRACE_SAMPLE"]
+TRACE_EXEMPLARS = KNOBS["TPU_ML_TRACE_EXEMPLARS"]
 REFRESH_INTERVAL_S = KNOBS["TPU_ML_REFRESH_INTERVAL_S"]
 REFRESH_MIN_ROWS = KNOBS["TPU_ML_REFRESH_MIN_ROWS"]
 REFRESH_CHECKPOINT_DIR = KNOBS["TPU_ML_REFRESH_CHECKPOINT_DIR"]

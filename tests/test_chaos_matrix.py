@@ -990,3 +990,199 @@ class TestFleetSwapChaos:
             assert d.counter("serve.drain_events") >= 3
         finally:
             fleet.stop()
+
+
+class TestFleetTraceChaos:
+    """Trace stitching under fleet chaos: a replica SIGKILLed with
+    requests in flight yields exactly one complete trace per retried
+    request — carrying the router's silent-retry marker — and a rolling
+    restart mid-window loses no spans: the merged fleet stream stitches
+    with zero orphans."""
+
+    @staticmethod
+    def _read_exact(rf, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            chunk = rf.read(n)
+            assert chunk, "peer closed mid-frame"
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _fast_call(self, sock, rf, model, x32):
+        from spark_rapids_ml_tpu.serving import fastlane
+
+        sock.sendall(fastlane.pack_request(model, x32))
+        return fastlane.read_response(lambda n: self._read_exact(rf, n))
+
+    def _spawn_fleet(self, tmp_path, sample: str):
+        from spark_rapids_ml_tpu.serving import fleet as fleet_mod
+
+        rng = np.random.default_rng(43)
+        xf = rng.normal(size=(96, 6))
+        lin = LinearRegression().fit((xf, xf @ np.arange(1.0, 7.0)))
+        fleet = fleet_mod.ServeFleet(
+            {"lin": lin},
+            replicas=2,
+            socket_dir=str(tmp_path / "sock"),
+            bucket_list=(8,),
+            extra_env={
+                "TPU_ML_SERVE_COMPILE_CACHE_DIR": str(tmp_path / "cache"),
+                "TPU_ML_TRACE_SAMPLE": sample,
+            },
+        ).start()
+        x32 = np.ascontiguousarray(xf[:4], dtype="<f4")
+        return fleet, x32
+
+    def _hammer(self, fleet, x32, stop, failures, done):
+        import socket
+
+        try:
+            with socket.socket(socket.AF_UNIX) as s:
+                s.connect(fleet.router_path)
+                rf = s.makefile("rb")
+                while not stop.is_set():
+                    self._fast_call(s, rf, "lin", x32)
+                    done[0] += 1
+        except Exception as e:  # noqa: BLE001 — collected + asserted
+            failures.append(e)
+
+    def test_replica_kill_mid_request_one_complete_trace_with_retry(
+        self, tmp_path, monkeypatch
+    ):
+        import threading
+        import time
+
+        from spark_rapids_ml_tpu.serving import fleet as fleet_mod
+        from spark_rapids_ml_tpu.telemetry import tracectx
+
+        monkeypatch.setenv("TPU_ML_TRACE_SAMPLE", "1.0")
+        fleet, x32 = self._spawn_fleet(tmp_path, "1.0")
+        stop = threading.Event()
+        failures: list[Exception] = []
+        done = [0]
+        threads = [
+            threading.Thread(
+                target=self._hammer, args=(fleet, x32, stop, failures, done)
+            )
+            for _ in range(3)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            # let traffic flow, then SIGKILL the home replica — the
+            # hammer keeps requests in flight, so the kill lands
+            # mid-request and the router's silent retry must re-route
+            deadline = time.monotonic() + 10
+            while done[0] < 20 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            home = fleet.ring.preference(
+                fleet_mod.HashRing.key("lin", 8)
+            )[0]
+            fleet._supervisor._slots[home].worker.proc.kill()
+            want = done[0] + 50
+            deadline = time.monotonic() + 10
+            while done[0] < want and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        try:
+            assert not failures, (
+                f"clients saw failures across the kill: {failures[:3]}"
+            )
+            events = fleet.fleet_events()
+            retries = [
+                e for e in events
+                if e.get("name") == "retry"
+                and (e.get("args") or {}).get("trace_id")
+            ]
+            assert retries, (
+                "the kill never exercised the router's silent retry"
+            )
+            traces = tracectx.stitch_all(events)
+            for inst in retries:
+                tid = inst["args"]["trace_id"]
+                t = traces.get(tid)
+                assert t is not None and t["complete"], (
+                    f"retried trace {tid} did not stitch complete"
+                )
+                relays = [
+                    s for s in t["spans"]
+                    if s.get("name") == "serve.relay"
+                ]
+                reqs = [
+                    s for s in t["spans"]
+                    if s.get("name") == "serve.request"
+                ]
+                # exactly one client-visible relay — the retry re-routed
+                # inside it, it did not fork a second trace
+                assert len(relays) == 1
+                assert reqs, (
+                    "retried trace has no replica-side request span"
+                )
+                assert any(
+                    i.get("name") == "retry" for i in t["instants"]
+                )
+            # the un-respawned victim leaves the fleet rollup down
+            assert fleet.healthz()["status"] == "down"
+        finally:
+            fleet.stop()
+
+    def test_rolling_restart_mid_window_stitches_zero_orphans(
+        self, tmp_path, monkeypatch
+    ):
+        import threading
+
+        from spark_rapids_ml_tpu.telemetry import tracectx
+        from spark_rapids_ml_tpu.telemetry.timeline import TIMELINE
+
+        # sample down so a multi-thousand-request window cannot evict a
+        # trace's parent spans from the bounded flight-recorder rings —
+        # the same discipline the bench fleet stage uses
+        monkeypatch.setenv("TPU_ML_TRACE_SAMPLE", "0.02")
+        fleet, x32 = self._spawn_fleet(tmp_path, "0.02")
+        seq0 = TIMELINE.seq()
+        stop = threading.Event()
+        failures: list[Exception] = []
+        done = [0]
+        threads = [
+            threading.Thread(
+                target=self._hammer, args=(fleet, x32, stop, failures, done)
+            )
+            for _ in range(3)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            try:
+                for slot in (0, 1):
+                    assert fleet.restart_replica(slot), (
+                        f"replica {slot} respawn never became READY"
+                    )
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=30)
+            assert not failures, (
+                f"requests failed during rolling restart: {failures[:3]}"
+            )
+            assert done[0] > 0
+            # scope the router's bounded ring to this window; replica
+            # processes (and their harvested trailers) are all fresh
+            pid_self = os.getpid()
+            events = [
+                e for e in fleet.fleet_events()
+                if e.get("pid") != pid_self or e.get("seq", 0) > seq0
+            ]
+            cov = tracectx.coverage(events)
+            assert cov["traces"] > 0, "no sampled traces in the window"
+            assert cov["orphan_spans"] == 0, (
+                f"rolling restart orphaned spans: {cov}"
+            )
+            assert cov["coverage"] >= 0.99, (
+                f"stitching coverage regressed across the restart: {cov}"
+            )
+        finally:
+            fleet.stop()

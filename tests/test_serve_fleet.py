@@ -12,6 +12,12 @@ live load completes with ZERO failed requests while the respawned replica
 re-AOTs entirely from the shared persistent compile cache
 (``cache_misses == 0`` in its shutdown report).
 
+The observability-plane tests pin the fleet aggregation contracts: the
+STATS scrape frame, the merged registry whose replica-label partition
+reproduces each replica's registry exactly, the exporter endpoints
+(``/metrics``, ``/healthz``, ``/traces``, ``/traces/<id>``), and the
+telemetry-trailer flush on supervised teardown.
+
 Replica processes inherit ``JAX_PLATFORMS=cpu`` from the session env; the
 fleet tests keep the bucket list minimal (one rung) so each replica's AOT
 warmup is two executables, not the full ladder.
@@ -23,6 +29,8 @@ import json
 import os
 import socket
 import threading
+import urllib.error
+import urllib.request
 
 import numpy as np
 import pytest
@@ -30,6 +38,7 @@ import pytest
 from spark_rapids_ml_tpu.serving import fastlane
 from spark_rapids_ml_tpu.serving import fleet as fleet_mod
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.registry import MetricsRegistry
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -170,6 +179,191 @@ class TestHashRing:
                 # consistent-hash property that keeps replica caches warm
                 # across fleet resizes
                 assert reduced.preference(k)[0] == homes_full[k]
+
+
+# -- fleet observability plane -----------------------------------------------
+
+
+class TestFleetObservability:
+    """The unified observability plane over a live fleet: per-replica
+    STATS scrapes, the merged fleet registry whose replica-label
+    partition reproduces each replica's registry exactly, the exporter's
+    ``/metrics`` / ``/healthz`` / ``/traces`` endpoints, and the trailer
+    flush that keeps a restarted incarnation's telemetry in the fleet
+    totals."""
+
+    @staticmethod
+    def _drive(fleet, x, n_fast: int = 4, n_json: int = 2) -> None:
+        x32 = np.ascontiguousarray(x[:4], dtype="<f4")
+        with socket.socket(socket.AF_UNIX) as s:
+            s.connect(fleet.router_path)
+            rf = s.makefile("rb")
+            for _ in range(n_fast):
+                _fast_call(s, rf, "pca", x32)
+            for _ in range(n_json):
+                assert _json_call(s, rf, "lin", x32)["ok"]
+
+    @staticmethod
+    def _scrape_snapshot(fleet, slot: int):
+        st = fleet.scrape_stats(slot)
+        assert st is not None, f"replica {slot} not scrapable"
+        reg = MetricsRegistry()
+        reg.merge_wire(st["registry"])
+        return st, reg.snapshot()
+
+    @staticmethod
+    def _series_by_replica(snap, name: str) -> dict:
+        out: dict = {}
+        for (n, labels), v in snap.counters.items():
+            if n == name:
+                rep = dict(labels).get("replica", "")
+                out[rep] = out.get(rep, 0) + v
+        return out
+
+    def test_stats_frame_scrapes_registry_and_events(self, live_fleet):
+        x, fleet = live_fleet
+        self._drive(fleet, x)
+        total = 0.0
+        for slot in (0, 1):
+            st, snap = self._scrape_snapshot(fleet, slot)
+            assert st["ok"] and st["kind"] == "stats"
+            assert st["pid"] > 0 and st["seq"] >= 0 and st["mono_us"] > 0
+            assert isinstance(st["events"], list)
+            total += snap.counter("serve.requests")
+        # between them the two replica registries cover the traffic
+        assert total >= 6
+        offsets = fleet.stats()["clock_offsets_us"]
+        assert sorted(offsets) == ["0", "1"]
+        assert all(isinstance(v, int) for v in offsets.values())
+
+    def test_fleet_metrics_are_the_sum_of_replica_registries(
+        self, live_fleet
+    ):
+        """The ``/metrics`` contract: the merged fleet registry's total
+        for any serve family equals the sum of the per-replica registries
+        (live scrapes plus harvested final fragments), and the replica
+        label partitions the merged registry back into exactly those
+        per-replica values."""
+        x, fleet = live_fleet
+        self._drive(fleet, x)
+        per_slot = {
+            str(slot): self._scrape_snapshot(fleet, slot)[1]
+            for slot in (0, 1)
+        }
+        harvested = fleet._final_registry.snapshot()
+        merged = fleet.fleet_registry(include_router=False).snapshot()
+        for name in ("serve.requests", "serve.rows", "serve.batches"):
+            assert merged.counter(name) == pytest.approx(
+                sum(s.counter(name) for s in per_slot.values())
+                + harvested.counter(name)
+            ), f"fleet total for {name} is not the sum of its replicas"
+        merged_by_rep = self._series_by_replica(merged, "serve.requests")
+        harv_by_rep = self._series_by_replica(harvested, "serve.requests")
+        for slot, snap in per_slot.items():
+            assert merged_by_rep.get(slot, 0) == pytest.approx(
+                snap.counter("serve.requests") + harv_by_rep.get(slot, 0)
+            )
+        # the router's own registry joins under replica="router"
+        full = fleet.fleet_registry().snapshot()
+        hits = self._series_by_replica(full, "serve.route_hits")
+        assert hits.get("router", 0) > 0
+
+    def test_exporter_unified_observability_plane(self, live_fleet):
+        x, fleet = live_fleet
+        self._drive(fleet, x, n_fast=3, n_json=1)
+        ex = fleet.start_exporter()
+        assert fleet.start_exporter() is ex  # idempotent
+        body = urllib.request.urlopen(
+            ex.url("/metrics"), timeout=10
+        ).read().decode()
+        assert "# TYPE tpu_ml_serve_requests counter" in body
+        assert 'replica="0"' in body and 'replica="1"' in body
+        assert 'replica="router"' in body
+        health = json.loads(
+            urllib.request.urlopen(ex.url("/healthz"), timeout=10).read()
+        )
+        assert health["status"] == "ok"
+        assert health["components"]["router"] == "ok"
+        assert health["components"]["replica-0"] == "ok"
+        cov = json.loads(
+            urllib.request.urlopen(ex.url("/traces"), timeout=10).read()
+        )
+        assert cov["traces"] >= 1 and "coverage" in cov
+        # one stitched cross-process tree: the last relayed request
+        relays = [
+            e for e in fleet.fleet_events()
+            if e.get("name") == "serve.relay"
+        ]
+        assert relays, "router recorded no relay spans"
+        tid = (relays[-1].get("args") or {}).get("trace_id")
+        assert tid
+        tree = json.loads(
+            urllib.request.urlopen(
+                ex.url(f"/traces/{tid}"), timeout=10
+            ).read()
+        )
+        assert tree["trace_id"] == tid and tree["complete"]
+        assert len(tree["roots"]) == 1
+        root = tree["roots"][0]
+        assert root["name"] == "serve.relay"
+        child_names = {c["name"] for c in root["children"]}
+        assert "serve.request" in child_names
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                ex.url("/traces/ffffffffffffffff"), timeout=10
+            )
+        assert err.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(ex.url("/nope"), timeout=10)
+        assert err.value.code == 404
+        # worst-of rollup: one draining replica degrades the fleet
+        assert fleet.drain(1)
+        try:
+            health = json.loads(
+                urllib.request.urlopen(
+                    ex.url("/healthz"), timeout=10
+                ).read()
+            )
+            assert health["status"] == "degraded"
+            assert health["components"]["replica-1"] == "draining"
+        finally:
+            fleet.undrain(1)
+
+    def test_supervised_teardown_flushes_the_telemetry_trailer(
+        self, live_fleet
+    ):
+        """A restarted replica's final registry + flight-recorder
+        fragment must land in the fleet plane: the incarnation's
+        telemetry survives the process."""
+        x, fleet = live_fleet
+        self._drive(fleet, x)
+        # pick the slot that served the most requests this incarnation
+        victim, served = 0, -1.0
+        for slot in (0, 1):
+            n = self._scrape_snapshot(fleet, slot)[1].counter(
+                "serve.requests"
+            )
+            if n > served:
+                victim, served = slot, n
+        assert served > 0
+        old_pid = fleet._supervisor._slots[victim].worker.proc.pid
+        before = fleet._final_registry.snapshot().counter("serve.requests")
+        assert fleet.restart_replica(victim), "respawn never became READY"
+        assert (victim, old_pid) in fleet._harvested
+        harvested = fleet._final_registry.snapshot()
+        assert harvested.counter("serve.requests") - before >= served
+        # the dead incarnation's events ride the merged stream,
+        # replica-stamped for the fleet trace merge
+        ev = [
+            e for e in fleet.fleet_events() if e.get("pid") == old_pid
+        ]
+        assert ev and all(
+            (e.get("args") or {}).get("replica") == str(victim)
+            for e in ev
+        )
+        # and the merged fleet registry still covers it
+        merged = fleet.fleet_registry(include_router=False).snapshot()
+        assert merged.counter("serve.requests") >= served
 
 
 # -- fleet end-to-end --------------------------------------------------------

@@ -36,6 +36,7 @@ from spark_rapids_ml_tpu.serving import hbm as hbm_mod
 from spark_rapids_ml_tpu.serving import registry as registry_mod
 from spark_rapids_ml_tpu.serving import server as server_mod
 from spark_rapids_ml_tpu.serving.batcher import MicroBatcher
+from spark_rapids_ml_tpu.telemetry import tracectx
 from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -1222,8 +1223,9 @@ class TestFastlaneProtocol:
             pos[0] += n
             return out
 
-        model, mat, is_query = fastlane.read_request(read_exact)
+        model, mat, is_query, trace = fastlane.read_request(read_exact)
         assert model == "m" and not is_query
+        assert trace is None  # all-zero trace tail = untraced request
         assert np.array_equal(mat, x) and mat.dtype == np.dtype("<f4")
 
     def test_peek_matches_read(self):
@@ -1231,6 +1233,49 @@ class TestFastlaneProtocol:
         frame = fastlane.pack_request("abc", x)
         struct_raw = frame[4:4 + fastlane.request_struct_size()]
         assert fastlane.peek_request(struct_raw) == (3, 8, 5)
+
+    def test_trace_context_rides_the_struct(self):
+        """v2 wire: a packed trace context round-trips through the binary
+        request struct — no JSON anywhere on the path."""
+        x = np.zeros((2, 3), dtype="<f4")
+        ctx = tracectx.TraceContext(
+            trace_id=0x1122334455667788, span_id=0x9ABCDEF0,
+            origin_us=123456789,
+        )
+        frame = fastlane.pack_request("m", x, trace=ctx)
+        buf, pos = memoryview(frame[4:]), [0]
+
+        def read_exact(n):
+            out = buf[pos[0]:pos[0] + n]
+            pos[0] += n
+            return out
+
+        model, _mat, _q, got = fastlane.read_request(read_exact)
+        assert model == "m" and got == ctx
+
+    def test_peek_and_rewrite_trace_are_byte_surgery(self):
+        """The router's relay path peeks the inbound context and rewrites
+        its own child span id into the forwarded struct without touching
+        name or payload bytes."""
+        x = np.zeros((8, 5), dtype="<f4")
+        parent = tracectx.TraceContext(
+            trace_id=0xDEAD, span_id=0xBEEF, origin_us=42,
+        )
+        frame = fastlane.pack_request("abc", x, trace=parent)
+        struct_raw = bytes(frame[4:4 + fastlane.request_struct_size()])
+        assert fastlane.peek_trace(struct_raw) == parent
+        # rows/cols/name_len untouched by the trace tail
+        assert fastlane.peek_request(struct_raw) == (3, 8, 5)
+        child = parent.child()
+        rewritten = fastlane.rewrite_trace(struct_raw, child)
+        assert len(rewritten) == len(struct_raw)
+        assert fastlane.peek_trace(rewritten) == child
+        assert fastlane.peek_request(rewritten) == (3, 8, 5)
+        # untraced peek: all-zero tail reads back as None
+        bare = bytes(fastlane.pack_request("abc", x)[
+            4:4 + fastlane.request_struct_size()
+        ])
+        assert fastlane.peek_trace(bare) is None
 
     def test_error_frame_raises_with_status(self):
         frame = fastlane.pack_error_response(404, "model 'x' not found")

@@ -509,16 +509,93 @@ class TestPrometheusExposition:
                 c if c.isalnum() or c == "_" else "_" for c in name
             )
 
-        missing = [
-            n for n in sorted(names.METRICS)
-            if prom_name(n) + "{" not in out and prom_name(n) + " " not in out
-        ]
+        missing, wrong_kind = [], []
+        for n in sorted(names.METRICS):
+            pn = prom_name(n)
+            if n in names.HISTOGRAMS:
+                kind, probe = "histogram", pn + "_count"
+            elif n in names.GAUGES:
+                kind, probe = "gauge", pn
+            else:
+                kind, probe = "counter", pn
+            if probe + "{" not in out and probe + " " not in out:
+                missing.append(n)
+            # the declared kind must be the rendered TYPE: a histogram
+            # family silently rendering as a counter would rate() into
+            # garbage on a dashboard without any test noticing
+            elif f"# TYPE {pn} {kind}" not in out:
+                wrong_kind.append(f"{n} (want {kind})")
         assert not missing, f"families dropped by metrics_dump: {missing}"
+        assert not wrong_kind, (
+            f"families rendered under the wrong TYPE: {wrong_kind} — "
+            "declare the kind in telemetry.names HISTOGRAMS/GAUGES"
+        )
         # the dedicated autotune decision family carries its labels
         assert (
             'tpu_ml_autotune_decisions{estimator="Meta",'
             'kernel="stream.fold_step",source="cache"} 1' in out
         )
+
+    def test_metrics_dump_renders_perf_ledger_serving(self, tmp_path, capsys):
+        """A perf_ledger record's serving/refresh/fleet evidence renders
+        the serve.*/refresh.* families — queue_delay_us as a histogram,
+        transports labeled, swap/fold counters, version gauges."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("metrics_dump", MD_CLI)
+        md = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(md)
+
+        rec = {
+            "type": "perf_ledger",
+            "serving": {
+                "requests": 52, "errors": 1, "rows": 400, "batches": 9,
+                "hedges": 2, "shed": 0,
+                "transport_mix": {"http/json": 20, "uds/fast": 32},
+                "bucket_hits": {"8": 40, "16": 12},
+                "json_codec": {"encode": 3, "decode": 3},
+                "trace": {"minted": 52, "latency_exemplars": []},
+                "latency": {"count": 52, "sum": 1.0, "p50": 0.01,
+                            "p99": 0.08},
+                "queue_delay_us": {"count": 52, "sum": 900.0, "p50": 10.0,
+                                   "p99": 120.0},
+                "hbm_bytes": 1024,
+            },
+            "refresh": {
+                "refresh": {
+                    "swaps": 1, "swap_refused": 0, "rollbacks": 0,
+                    "folds": 2, "rows": 8192, "finalizes": 1,
+                    "checkpoints": 2, "resumes": 0,
+                    "swap_blackout": {"count": 1, "sum": 0.002,
+                                      "p50": 0.002, "p99": 0.002},
+                    "lag_seconds": 0.5,
+                    "versions": {"bench_refresh": 2},
+                },
+            },
+            "fleet": {
+                "replicas": 2,
+                "routing": {"hits": 90, "misses": 4},
+                "rolling_restart": {"drain_events": 1,
+                                    "replica_restarts": 1},
+            },
+        }
+        path = tmp_path / "ledger.jsonl"
+        path.write_text(json.dumps(rec) + "\n")
+        assert md.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "# TYPE tpu_ml_serve_queue_delay_us histogram" in out
+        assert "tpu_ml_serve_queue_delay_us_count 2" in out
+        assert "tpu_ml_serve_requests 52" in out
+        assert 'tpu_ml_serve_transport{transport="uds",wire="fast"} 32' in out
+        assert "tpu_ml_serve_traces 52" in out
+        assert "# TYPE tpu_ml_serve_latency histogram" in out
+        assert "tpu_ml_serve_swaps 1" in out
+        assert "tpu_ml_refresh_folds 2" in out
+        assert "# TYPE tpu_ml_refresh_lag_seconds gauge" in out
+        assert 'tpu_ml_serve_model_version{model="bench_refresh"} 2' in out
+        assert "# TYPE tpu_ml_serve_fleet_replicas gauge" in out
+        assert "tpu_ml_serve_route_hits 90" in out
+        assert "tpu_ml_serve_drain_events 1" in out
 
 
 class TestTraceTimelineCli:

@@ -15,8 +15,17 @@ collector or a pushgateway::
     python tools/metrics_dump.py telemetry.jsonl \\
         > /var/lib/node_exporter/textfile/tpu_ml.prom
 
-Counter keys are parsed back from their rendered ``name{k=v,...}`` form;
-the report's dedicated fields re-emit as counters (``rows_ingested``,
+Counter keys are parsed back from their rendered ``name{k=v,...}`` form
+and re-emitted through their *declared kind*: every family listed in
+``telemetry.names.HISTOGRAMS`` records a histogram sample, every family
+in ``names.GAUGES`` sets a gauge, everything else increments a counter —
+so ``serve.queue_delay_us`` renders with ``# TYPE ... histogram``, not as
+a counter that a dashboard would rate(). The names-family meta-check in
+tests/test_timeline.py asserts the TYPE line matches the declared kind
+for every family, so a new family added to names.py without a kind
+declaration (or a dump renderer) fails CI.
+
+The report's dedicated fields re-emit as counters (``rows_ingested``,
 ``h2d_bytes``, ``collective.count``, the full ``compile.*`` family from
 ``telemetry.compilemon`` — count / cache hits+misses / cache time saved —
 and the cost model's ``costmodel.flops`` / ``costmodel.bytes``; the
@@ -25,8 +34,15 @@ kernel and source) and
 per-record scalars (``fit.wall_seconds``, ``transform.wall_seconds``,
 ``compile.seconds`` / ``trace_seconds`` / ``lower_seconds``) as
 one-sample-per-record histograms, all labeled by estimator/transformer.
-Importing the registry does not pull in jax, so this runs on
-telemetry-collection hosts without it.
+
+``perf_ledger`` records (bench's JSONL) render too: their serving /
+refresh / fleet evidence blobs re-emit the ``serve.*`` and ``refresh.*``
+families — request/error/transport counters, the latency and
+µs-queue-delay digests as representative histogram samples (p50/p99 per
+window, the transform-latency idiom), swap/rollback/fold counters and
+the version/replica gauges — so a scrape of the ledger shows the serving
+plane, not just fits. Importing the registry does not pull in jax, so
+this runs on telemetry-collection hosts without it.
 """
 
 from __future__ import annotations
@@ -57,6 +73,23 @@ def parse_rendered_key(key: str) -> tuple[str, dict[str, str]]:
     return name, labels
 
 
+def _record_by_kind(reg, name: str, value: float, **labels) -> None:
+    """Route one sample through the family's declared kind
+    (``telemetry.names`` HISTOGRAMS / GAUGES; counters otherwise), so the
+    re-aggregated registry renders the same Prometheus TYPE as the live
+    one."""
+    from spark_rapids_ml_tpu.telemetry import names
+
+    if name in names.HISTOGRAMS or name.startswith(
+        "transform.partition_seconds_"
+    ):
+        reg.histogram_record(name, value, **labels)
+    elif name in names.GAUGES:
+        reg.gauge_set(name, value, **labels)
+    else:
+        reg.counter_inc(name, value, **labels)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Dump telemetry JSONL as Prometheus exposition text"
@@ -74,14 +107,16 @@ def main(argv=None) -> int:
     try:
         records = [
             r for r in read_jsonl(args.path)
-            if r.get("type") in ("fit_report", "transform_report")
+            if r.get("type")
+            in ("fit_report", "transform_report", "perf_ledger")
         ]
     except OSError as e:
         print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
         return 1
     if not records:
         print(
-            f"no fit_report/transform_report records in {args.path}",
+            f"no fit_report/transform_report/perf_ledger records in "
+            f"{args.path}",
             file=sys.stderr,
         )
         return 1
@@ -93,10 +128,13 @@ def main(argv=None) -> int:
         if rec.get("type") == "transform_report":
             _aggregate_transform(reg, rec)
             continue
+        if rec.get("type") == "perf_ledger":
+            _aggregate_serving(reg, rec)
+            continue
         est = rec.get("estimator", "")
         for key, v in (rec.get("counters") or {}).items():
             name, labels = parse_rendered_key(key)
-            reg.counter_inc(name, v, **labels)
+            _record_by_kind(reg, name, v, **labels)
         for name, v in (
             ("rows_ingested", rec.get("rows_ingested", 0)),
             ("bytes_ingested", rec.get("bytes_ingested", 0)),
@@ -168,12 +206,123 @@ def _aggregate_cost_model(reg, rec: dict, **labels) -> None:
         reg.histogram_record("costmodel.roofline_utilization", util, **labels)
 
 
+def _aggregate_serving(reg, rec: dict) -> None:
+    """Fold one perf_ledger record's serving/refresh/fleet evidence into
+    the registry: the ``serve.*`` / ``refresh.*`` families a scrape of the
+    bench ledger should show. Histogram digests re-emit as representative
+    samples (p50/p99 of the measured window — the transform-latency
+    idiom), counters and gauges verbatim."""
+
+    def digest(name: str, d: dict | None, **labels) -> None:
+        for q in ("p50", "p99"):
+            if d and d.get("count") and d.get(q) is not None:
+                reg.histogram_record(name, d[q], **labels)
+
+    serving = rec.get("serving")
+    if isinstance(serving, dict):
+        for name, key in (
+            ("serve.requests", "requests"),
+            ("serve.errors", "errors"),
+            ("serve.rows", "rows"),
+            ("serve.batches", "batches"),
+            ("serve.aot_compiles", "aot_compiles"),
+            ("serve.cold_compiles", "cold_compiles"),
+            ("serve.joined_in_flight", "joined_in_flight"),
+            ("serve.shed", "shed"),
+            ("serve.page_in", "page_in"),
+            ("serve.page_out", "page_out"),
+            ("serve.hedges", "hedges"),
+        ):
+            if serving.get(key):
+                reg.counter_inc(name, serving[key])
+        if serving.get("hbm_bytes"):
+            reg.gauge_set("serve.hbm_bytes", serving["hbm_bytes"])
+        for lane, count in (serving.get("transport_mix") or {}).items():
+            transport, _, wire = str(lane).partition("/")
+            reg.counter_inc(
+                "serve.transport", count, transport=transport, wire=wire
+            )
+        for bucket, hits in (serving.get("bucket_hits") or {}).items():
+            reg.counter_inc("serve.bucket_hits", hits, bucket=str(bucket))
+        for op in ("encode", "decode"):
+            if (serving.get("json_codec") or {}).get(op):
+                reg.counter_inc(
+                    "serve.json_codec", serving["json_codec"][op], op=op
+                )
+        if (serving.get("trace") or {}).get("minted"):
+            reg.counter_inc("serve.traces", serving["trace"]["minted"])
+        digest("serve.latency", serving.get("latency"))
+        digest("serve.queue_delay_seconds", serving.get("queue_delay"))
+        digest("serve.queue_delay_us", serving.get("queue_delay_us"))
+        digest(
+            "serve.window_effective_seconds",
+            serving.get("window_effective"),
+        )
+        digest("serve.batch_rows", serving.get("batch_rows"))
+
+    # the serving blob's nested refresh view and the dedicated refresh
+    # evidence share a schema; render whichever the record carries
+    refresh = rec.get("refresh")
+    refresh_view = (
+        (refresh.get("refresh") if isinstance(refresh, dict) else None)
+        or (serving.get("refresh") if isinstance(serving, dict) else None)
+    )
+    if isinstance(refresh_view, dict):
+        for name, key in (
+            ("serve.swaps", "swaps"),
+            ("serve.swap_refused", "swap_refused"),
+            ("serve.rollback", "rollbacks"),
+            ("refresh.folds", "folds"),
+            ("refresh.rows", "rows"),
+            ("refresh.finalizes", "finalizes"),
+            ("refresh.checkpoints", "checkpoints"),
+            ("refresh.resumes", "resumes"),
+        ):
+            if refresh_view.get(key):
+                reg.counter_inc(name, refresh_view[key])
+        digest(
+            "serve.swap_blackout_seconds", refresh_view.get("swap_blackout")
+        )
+        if refresh_view.get("lag_seconds"):
+            reg.gauge_set("refresh.lag_seconds", refresh_view["lag_seconds"])
+        for model, version in (refresh_view.get("versions") or {}).items():
+            reg.gauge_set("serve.model_version", version, model=str(model))
+
+    fleet = rec.get("fleet")
+    fleet_view = (
+        fleet
+        if isinstance(fleet, dict)
+        else (serving.get("fleet") if isinstance(serving, dict) else None)
+    )
+    if isinstance(fleet_view, dict):
+        if fleet_view.get("replicas"):
+            reg.gauge_set("serve.fleet_replicas", fleet_view["replicas"])
+        # two shapes: the serving blob's flat fleet sub-dict vs the bench
+        # fleet evidence (routing + rolling_restart sub-dicts)
+        routing = fleet_view.get("routing") or {}
+        restart = fleet_view.get("rolling_restart") or {}
+        for name, value in (
+            ("serve.route_hits",
+             routing.get("hits", fleet_view.get("route_hits"))),
+            ("serve.route_misses",
+             routing.get("misses", fleet_view.get("route_misses"))),
+            ("serve.drain_events",
+             restart.get("drain_events", fleet_view.get("drain_events"))),
+            ("serve.replica_restarts",
+             restart.get(
+                 "replica_restarts", fleet_view.get("replica_restarts")
+             )),
+        ):
+            if value:
+                reg.counter_inc(name, value)
+
+
 def _aggregate_transform(reg, rec: dict) -> None:
     """Fold one transform_report into the registry (transformer-labeled)."""
     tr = rec.get("transformer", "")
     for key, v in (rec.get("counters") or {}).items():
         name, labels = parse_rendered_key(key)
-        reg.counter_inc(name, v, **labels)
+        _record_by_kind(reg, name, v, **labels)
     for name, v in (
         ("transform.rows", rec.get("rows", 0)),
         ("transform.bytes", rec.get("bytes", 0)),

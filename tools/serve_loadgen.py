@@ -39,9 +39,12 @@ import time
 # parity test there) — mirroring keeps this tool importable and its
 # request loop free of repo imports that would book telemetry
 _MAGIC = struct.pack(">I", 0xF5A57A4E)
-_REQ_STRUCT = struct.Struct(">BBHII")
+# v2 request struct ends with the trace tail (trace_id u64, span_id u32,
+# origin_us u64); the loadgen sends it zeroed — untraced — and lets the
+# router/server mint sampled contexts at admission
+_REQ_STRUCT = struct.Struct(">BBHIIQIQ")
 _RESP_STRUCT = struct.Struct(">BBHIII")
-_FASTLANE_VERSION = 1
+_FASTLANE_VERSION = 2
 _FLAG_ERROR = 0x01
 
 
@@ -57,7 +60,7 @@ def pack_fast_request(model: str, rows: int, cols: int, payload: bytes) -> bytes
     name = model.encode("utf-8")
     return b"".join((
         _MAGIC,
-        _REQ_STRUCT.pack(_FASTLANE_VERSION, 0, len(name), rows, cols),
+        _REQ_STRUCT.pack(_FASTLANE_VERSION, 0, len(name), rows, cols, 0, 0, 0),
         name,
         payload,
     ))

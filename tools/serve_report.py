@@ -73,6 +73,19 @@ key holds the blob ``bench.py --smoke`` embeds
   - ``refresh-failed-requests`` / ``refresh-post-swap-compiles`` — the
     refresh bench stage saw a client-visible failure or a backend
     compile after the publish; both are hard swap-contract violations.
+  - ``orphan-spans`` — the record's ``trace_coverage`` blob (bench's
+    stitched-trace audit over the measured window, serving or fleet
+    stage) reports orphan spans or <99% of sampled requests stitching
+    into a complete trace. An orphan span names a parent that no merged
+    event stream contains: a replica fragment was never harvested (lost
+    trailer), a hop dropped the trace context on a wire, or the
+    flight-recorder ring evicted a parent mid-window (lower
+    ``TPU_ML_TRACE_SAMPLE`` or raise ``TPU_ML_TIMELINE_EVENTS``).
+
+The record's tracing evidence also renders: traces minted in the
+window, stitching coverage, and the slowest latency exemplars (trace
+ids — pull any of them up with ``/traces/<id>`` or decompose the tail
+with ``tools/tail_report.py``).
 
 Exit status: 0 normally; with ``--strict``, 2 when any anomaly fired OR
 any record had to be skipped (CI gate). Stdlib-only — renders on hosts
@@ -184,6 +197,32 @@ def check_anomalies(summary: dict, wrapper: dict) -> list[str]:
             f"{win_hist['count']:g} dispatch(es) — the device-time "
             "feedback never shrank the window (or every dispatch outran "
             "the ceiling)"
+        )
+    out.extend(
+        check_trace_anomalies(summary.get("trace_coverage"), "serving")
+    )
+    return out
+
+
+def check_trace_anomalies(cov: dict | None, where: str) -> list[str]:
+    """One ``trace_coverage`` blob (telemetry.tracectx.coverage) against
+    the stitching contract: zero orphan spans, >=99% of sampled requests
+    forming one complete trace."""
+    if not isinstance(cov, dict) or not cov.get("traces"):
+        return []
+    out: list[str] = []
+    orphans = cov.get("orphan_spans", 0) or 0
+    coverage = cov.get("coverage", 1.0)
+    if orphans or coverage < 0.99:
+        out.append(
+            f"orphan-spans: {where} window stitched "
+            f"{cov.get('complete', 0):g}/{cov['traces']:g} trace(s) "
+            f"complete ({coverage:.1%}) with {orphans:g} orphan span(s) — "
+            "a span names a parent no merged stream contains: a replica "
+            "fragment was never harvested, a hop dropped the trace "
+            "context, or the flight-recorder ring evicted a parent "
+            "mid-window (lower TPU_ML_TRACE_SAMPLE or raise "
+            "TPU_ML_TIMELINE_EVENTS)"
         )
     return out
 
@@ -461,11 +500,43 @@ def render_record(rec: dict, out=sys.stdout) -> list[str] | None:
     )
     print(comp_line, file=out)
 
+    trace = summary.get("trace") or {}
+    cov = summary.get("trace_coverage") or {}
+    if trace.get("minted") or cov.get("traces"):
+        line = f"tracing: {trace.get('minted', 0):g} trace(s) minted"
+        if cov.get("traces"):
+            line += (
+                f", {cov.get('complete', 0):g}/{cov['traces']:g} stitched "
+                f"complete ({cov.get('coverage', 1.0):.1%}), "
+                f"{cov.get('orphan_spans', 0):g} orphan span(s)"
+            )
+        print(line, file=out)
+        exemplars = trace.get("latency_exemplars") or []
+        if exemplars:
+            print(
+                "  slowest exemplars: " + ", ".join(
+                    f"{tid} ({_fmt_s(v)})" for v, tid in exemplars[:4]
+                ),
+                file=out,
+            )
+
     anomalies = check_anomalies(summary, rec)
     anomalies.extend(check_refresh_anomalies(refresh))
     stage = rec.get("refresh")
     if isinstance(stage, dict) and "swap_blackout_ms" in stage:
         anomalies.extend(_render_refresh_stage(stage, out))
+    fleet_stage = rec.get("fleet")
+    if isinstance(fleet_stage, dict):
+        fleet_cov = fleet_stage.get("trace_coverage") or {}
+        if fleet_cov.get("traces"):
+            print(
+                f"fleet tracing: {fleet_cov.get('complete', 0):g}/"
+                f"{fleet_cov['traces']:g} cross-process trace(s) stitched "
+                f"complete ({fleet_cov.get('coverage', 1.0):.1%}), "
+                f"{fleet_cov.get('orphan_spans', 0):g} orphan span(s)",
+                file=out,
+            )
+        anomalies.extend(check_trace_anomalies(fleet_cov, "fleet"))
     for a in anomalies:
         print(f"  !! {a}", file=out)
     if not anomalies:

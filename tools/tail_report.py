@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""Tail attribution: decompose serve p99 into per-segment budgets from
+stitched traces and flag the dominant segment.
+
+Usage::
+
+    python -m tools.tail_report fleet_events.json
+    python -m tools.tail_report router.jsonl /tmp/r0.sock.trailer \\
+        /tmp/r1.sock.trailer --percentile 99 --top 5
+    python -m tools.tail_report events.json --ledger perf.jsonl --json
+
+Input is any mix of event streams: a fleet event dump (JSON object with
+an ``events`` list, e.g. ``ServeFleet.fleet_events()`` written to a
+file), replica telemetry trailers (``<socket>.trailer``), Chrome trace
+JSON or the ``TPU_ML_TIMELINE_PATH`` timeline JSONL. Streams are merged
+and stitched with :func:`telemetry.tracectx.stitch_all`; every complete
+trace that carries a ``serve.request`` span is decomposed into:
+
+``queue``
+    the micro-batcher admission wait (``serve.queue`` span),
+``route``/``relay``
+    router-side time (``serve.relay`` span minus the replica's
+    ``serve.request`` span): ring walk, trace injection, the UDS hop to
+    the replica and any silent crash retries. The current
+    instrumentation cannot split the routing decision from the relay
+    wire, so ``route`` reads 0 and both ride the ``relay`` row;
+    single-process traces have neither,
+``device``
+    the coalesced device dispatch the request rode (the
+    ``serve.dispatch`` span link-joined to this trace; hedge losers are
+    excluded — the loser is off the critical path),
+``response``
+    the residual inside the serving process: decode, finalize, framing
+    the reply (``serve.request`` minus queue minus device).
+
+The report prints the fleet percentile, the mean per-segment budget over
+the tail (every trace at or above the percentile), the dominant segment,
+and the top-N slowest stitched traces. ``--ledger`` cross-references the
+latest perf-ledger record's serving/fleet evidence: trace ids that ride
+the ledger's latency exemplars are marked ``*`` in the top table, so the
+slow requests the registry sampled can be pulled up by id
+(``/traces/<id>``). ``--json`` emits the same payload for machines.
+
+Exit status: 0 normally, 1 when no stitched ``serve.request`` trace is
+found (nothing to attribute).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# the stitching primitives live in the package, which must be importable
+# from the repo root — `python tools/tail_report.py` does not put it on
+# sys.path
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from spark_rapids_ml_tpu.telemetry import tracectx  # noqa: E402
+
+SEGMENTS = ("queue", "route", "relay", "device", "response")
+
+
+def load_events(path: str) -> list[dict]:
+    """Merged event list from one file: fleet event dump / trailer
+    (``{"events": [...]}``), Chrome trace JSON, or timeline JSONL."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        obj = json.loads(text)
+        if isinstance(obj, dict) and "traceEvents" in obj:
+            return [
+                e for e in obj["traceEvents"]
+                if isinstance(e, dict) and e.get("ph") != "M"
+            ]
+        if isinstance(obj, dict) and isinstance(obj.get("events"), list):
+            return [e for e in obj["events"] if isinstance(e, dict)]
+        return []
+    events: list[dict] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("type") == "timeline":
+            events.extend(
+                e for e in rec.get("events", []) if isinstance(e, dict)
+            )
+    return events
+
+
+def _span(trace: dict, name: str) -> dict | None:
+    """The longest span named ``name`` in a stitched trace (a retried
+    request can legitimately carry two; the longest is the critical
+    path)."""
+    best = None
+    for s in trace["spans"]:
+        if s.get("name") == name and (
+            best is None or s.get("dur", 0) > best.get("dur", 0)
+        ):
+            best = s
+    return best
+
+
+def decompose(trace: dict) -> dict | None:
+    """One stitched trace → per-segment budget dict (µs), or None when it
+    carries no ``serve.request`` span (refresh chains etc.)."""
+    request = _span(trace, "serve.request")
+    if request is None:
+        return None
+    relay = _span(trace, "serve.relay")
+    queue = _span(trace, "serve.queue")
+    # the winning dispatch joined by span link; hedge losers excluded
+    device_us = 0
+    for link in trace["links"]:
+        e = link["event"]
+        if e.get("name") != "serve.dispatch":
+            continue
+        if (e.get("args") or {}).get("hedge_lost"):
+            continue
+        device_us = max(device_us, e.get("dur", 0))
+    req_us = request.get("dur", 0)
+    queue_us = min(queue.get("dur", 0) if queue else 0, req_us)
+    device_us = min(device_us, max(req_us - queue_us, 0))
+    total_us = relay.get("dur", 0) if relay else req_us
+    segments = {
+        "queue": queue_us,
+        "route": 0,
+        "relay": max(total_us - req_us, 0) if relay else 0,
+        "device": device_us,
+        "response": max(req_us - queue_us - device_us, 0),
+    }
+    args = request.get("args") or {}
+    return {
+        "trace_id": trace["trace_id"],
+        "total_us": total_us,
+        "segments": segments,
+        "model": args.get("model", ""),
+        "transport": args.get("transport", ""),
+        "wire": args.get("wire", ""),
+        "retries": sum(
+            1 for i in trace["instants"] if i.get("name") == "retry"
+        ),
+        "fleet": relay is not None,
+    }
+
+
+def ledger_exemplars(path: str) -> set[str]:
+    """Trace ids riding the latest perf-ledger record's serving/fleet
+    latency exemplars (the registry's slowest-sample blobs)."""
+    ids: set[str] = set()
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError:
+        return ids
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        found = False
+        for key in ("serving", "fleet", "refresh"):
+            blob = rec.get(key)
+            trace = blob.get("trace") if isinstance(blob, dict) else None
+            if not isinstance(trace, dict):
+                continue
+            for ex_key in ("latency_exemplars", "queue_exemplars"):
+                for pair in trace.get(ex_key, ()):
+                    if isinstance(pair, (list, tuple)) and len(pair) == 2:
+                        ids.add(str(pair[1]))
+                        found = True
+        if found:
+            return ids
+    return ids
+
+
+def build_report(
+    events: list[dict], *, percentile: float = 99.0, top: int = 5,
+    model: str = "",
+) -> dict:
+    """The tail-attribution payload over a merged event stream."""
+    traces = tracectx.stitch_all(events)
+    rows = []
+    for t in traces.values():
+        if not t["complete"]:
+            continue
+        row = decompose(t)
+        if row is None:
+            continue
+        if model and row["model"] != model:
+            continue
+        rows.append(row)
+    rows.sort(key=lambda r: -r["total_us"])
+    cov = tracectx.coverage(events)
+    if not rows:
+        return {
+            "percentile": percentile, "requests": 0, "coverage": cov,
+            "tail": [], "segments_us": {}, "dominant_segment": None,
+            "top": [],
+        }
+    totals = sorted(r["total_us"] for r in rows)
+    idx = min(len(totals) - 1, int(percentile / 100.0 * len(totals)))
+    cut_us = totals[idx]
+    tail = [r for r in rows if r["total_us"] >= cut_us]
+    budget = {
+        seg: sum(r["segments"][seg] for r in tail) / len(tail)
+        for seg in SEGMENTS
+    }
+    tail_total = sum(budget.values()) or 1.0
+    dominant = max(budget, key=lambda seg: budget[seg])
+    return {
+        "percentile": percentile,
+        "requests": len(rows),
+        "coverage": cov,
+        f"p{percentile:g}_us": cut_us,
+        "p50_us": totals[min(len(totals) - 1, len(totals) // 2)],
+        "tail_requests": len(tail),
+        "segments_us": {k: round(v, 1) for k, v in budget.items()},
+        "segments_share": {
+            k: round(v / tail_total, 4) for k, v in budget.items()
+        },
+        "dominant_segment": dominant,
+        "retried_requests": sum(1 for r in rows if r["retries"]),
+        "top": rows[:top],
+    }
+
+
+def _fmt_us(v: float) -> str:
+    return f"{v / 1e3:.3f}ms" if v >= 1e3 else f"{v:.0f}us"
+
+
+def print_report(rep: dict, exemplar_ids: set[str], out=sys.stdout) -> None:
+    cov = rep["coverage"]
+    print(
+        f"stitched {rep['requests']} request trace(s) "
+        f"({cov['complete']}/{cov['traces']} complete, "
+        f"{cov['orphan_spans']} orphan spans)",
+        file=out,
+    )
+    if not rep["requests"]:
+        print("nothing to attribute: no complete serve.request traces",
+              file=out)
+        return
+    pkey = f"p{rep['percentile']:g}_us"
+    print(
+        f"p50 {_fmt_us(rep['p50_us'])}   "
+        f"p{rep['percentile']:g} {_fmt_us(rep[pkey])}   "
+        f"tail = {rep['tail_requests']} request(s) at/above the cut",
+        file=out,
+    )
+    if rep["retried_requests"]:
+        print(f"{rep['retried_requests']} request(s) survived a replica "
+              "crash retry", file=out)
+    print(f"\np{rep['percentile']:g} budget by segment (tail mean):",
+          file=out)
+    for seg in SEGMENTS:
+        us = rep["segments_us"][seg]
+        share = rep["segments_share"][seg]
+        flag = "  << dominant" if seg == rep["dominant_segment"] else ""
+        print(f"  {seg:<9} {_fmt_us(us):>10}  {share:>6.1%}{flag}",
+              file=out)
+    print(f"\ndominant segment: {rep['dominant_segment']}", file=out)
+    print("\nslowest stitched traces (* = rides a ledger latency exemplar):",
+          file=out)
+    for r in rep["top"]:
+        star = "*" if r["trace_id"] in exemplar_ids else " "
+        where = "fleet" if r["fleet"] else (r["transport"] or "local")
+        segs = " ".join(
+            f"{seg}={_fmt_us(r['segments'][seg])}"
+            for seg in SEGMENTS
+            if r["segments"][seg]
+        )
+        retry = f" retries={r['retries']}" if r["retries"] else ""
+        print(
+            f" {star} {r['trace_id']} {_fmt_us(r['total_us']):>10} "
+            f"{r['model']:<14} {where:<7} {segs}{retry}",
+            file=out,
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Decompose serve tail latency from stitched traces"
+    )
+    ap.add_argument(
+        "paths", nargs="+", metavar="PATH",
+        help="event streams: fleet event dump JSON, replica .trailer, "
+             "Chrome trace JSON or timeline JSONL (merged)",
+    )
+    ap.add_argument(
+        "--percentile", type=float, default=99.0,
+        help="tail percentile to attribute (default 99)",
+    )
+    ap.add_argument(
+        "--top", type=int, default=5,
+        help="slowest traces to list (default 5)",
+    )
+    ap.add_argument(
+        "--model", default="", help="only attribute this model's requests"
+    )
+    ap.add_argument(
+        "--ledger", default="",
+        help="perf ledger JSONL: mark traces riding its latency exemplars",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit the payload as JSON"
+    )
+    args = ap.parse_args(argv)
+
+    events: list[dict] = []
+    for path in args.paths:
+        try:
+            events.extend(load_events(path))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            return 1
+
+    rep = build_report(
+        events, percentile=args.percentile, top=args.top, model=args.model
+    )
+    exemplar_ids = ledger_exemplars(args.ledger) if args.ledger else set()
+    if args.json:
+        rep["ledger_exemplars"] = sorted(exemplar_ids)
+        print(json.dumps(rep, indent=2))
+    else:
+        print_report(rep, exemplar_ids)
+    return 0 if rep["requests"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,12 +7,30 @@ Usage::
     python tools/trace_timeline.py timeline.jsonl --out trace.json
     python tools/trace_timeline.py timeline.jsonl --last 1 --strict \\
         --gap-threshold 0.5
+    python tools/trace_timeline.py router.jsonl /tmp/r0.sock.trailer \\
+        /tmp/r1.sock.trailer --offsets fleet_stats.json --out fleet.json
 
 Input is either the JSONL file written by ``TPU_ML_TIMELINE_PATH``
 (``timeline`` records, one per outermost fit or transform — see
 ``telemetry/export.py``) or an already-exported Chrome trace JSON object.
 Transform timelines carry a ``transform_id`` instead of (or alongside) a
 ``fit_id``; both show in the record header and both have a filter flag.
+
+**Fleet merge.** More than one path merges per-process fragments into
+one fleet trace: each extra path may be another timeline JSONL, a
+replica telemetry trailer (the ``<socket>.trailer`` JSON the fleet
+supervisor flushes at READY and on teardown) or a fleet event dump
+(any JSON object with an ``events`` list). ``--offsets`` supplies the
+monotonic-clock correction from the READY handshake — either the fleet
+router's ``stats()`` JSON (its ``clock_offsets_us`` is keyed by replica
+slot and matched against each event's ``replica`` label) or a flat
+``{basename-or-pid: offset_us}`` mapping; offsets are *added* to event
+timestamps (offset = router clock minus replica clock), so all
+processes land on the router's clock. On a single host
+CLOCK_MONOTONIC is already system-wide and offsets are ~handshake
+latency; cross-host fragments need them. When the package is
+importable the merged stream also gets a trace-stitching coverage
+line (complete traces / orphan spans).
 
 The default output is a per-fit summary: event counts, per-track (one
 track = one ``(pid, partition)``) span busy time and the largest idle gap
@@ -36,6 +54,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -48,19 +67,34 @@ def _fmt_s(v: float) -> str:
 
 
 def load_records(path: str) -> list[dict]:
-    """Timeline records from JSONL (``type == "timeline"``) or a raw Chrome
-    trace object (wrapped as one synthetic record). Corrupt JSONL lines are
-    skipped with a note — a torn line from a crashed process must not hide
-    the rest of the file."""
+    """Timeline records from JSONL (``type == "timeline"``), a raw Chrome
+    trace object, a replica telemetry trailer (``{"pid", "events", ...}``)
+    or a fleet event dump — single JSON objects are wrapped as one
+    synthetic record. Corrupt JSONL lines are skipped with a note — a torn
+    line from a crashed process must not hide the rest of the file."""
+    import os
+
     with open(path, encoding="utf-8") as f:
         text = f.read()
+    source = os.path.basename(path)
     stripped = text.lstrip()
-    if stripped.startswith("{") and '"traceEvents"' in stripped[:200]:
-        trace = json.loads(text)
-        events = [
-            e for e in trace.get("traceEvents", []) if e.get("ph") != "M"
-        ]
-        return [{"type": "timeline", "fit_id": "", "events": events}]
+    if stripped.startswith("{"):
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            obj = None
+        if isinstance(obj, dict) and "traceEvents" in obj:
+            events = [
+                e for e in obj.get("traceEvents", []) if e.get("ph") != "M"
+            ]
+            return [{"type": "timeline", "fit_id": "", "events": events,
+                     "source": source}]
+        if isinstance(obj, dict) and isinstance(obj.get("events"), list):
+            # a replica trailer or fleet event dump: one flat event list,
+            # possibly with the writer's pid alongside
+            return [{"type": "timeline", "fit_id": "",
+                     "events": obj["events"], "pid": obj.get("pid"),
+                     "source": source}]
     records = []
     for line in text.splitlines():
         line = line.strip()
@@ -72,8 +106,57 @@ def load_records(path: str) -> list[dict]:
             print("# skipping corrupt line", file=sys.stderr)
             continue
         if rec.get("type") == "timeline":
+            rec.setdefault("source", source)
             records.append(rec)
     return records
+
+
+def load_offsets(spec: str) -> dict:
+    """Clock-offset spec: a JSON file path or inline JSON. Accepts either
+    the fleet router's ``stats()`` dump (``clock_offsets_us`` keyed by
+    replica slot, applied per event via its ``replica`` label) or a flat
+    ``{basename-or-pid: offset_us}`` mapping applied per input file."""
+    if not spec:
+        return {}
+    text = spec
+    if not spec.lstrip().startswith("{"):
+        with open(spec, encoding="utf-8") as f:
+            text = f.read()
+    obj = json.loads(text)
+    if not isinstance(obj, dict):
+        raise ValueError("offsets must be a JSON object")
+    if isinstance(obj.get("clock_offsets_us"), dict):
+        return {"clock_offsets_us": {
+            str(k): int(v) for k, v in obj["clock_offsets_us"].items()
+        }}
+    return {str(k): int(v) for k, v in obj.items()}
+
+
+def apply_offsets(records: list[dict], offsets: dict) -> int:
+    """Shift event timestamps onto the router's clock; returns how many
+    events moved. Slot-keyed offsets (``clock_offsets_us``) match each
+    event's ``replica`` arg; flat offsets match a record's source
+    basename or writer pid."""
+    by_replica = offsets.get("clock_offsets_us")
+    shifted = 0
+    for rec in records:
+        rec_off = 0
+        if by_replica is None:
+            for key in (rec.get("source"), str(rec.get("pid"))):
+                if key is not None and key in offsets:
+                    rec_off = offsets[key]
+                    break
+        for e in rec.get("events", []):
+            if not isinstance(e, dict) or "ts" not in e:
+                continue
+            off = rec_off
+            if by_replica is not None:
+                replica = (e.get("args") or {}).get("replica")
+                off = by_replica.get(str(replica), 0) if replica is not None else 0
+            if off:
+                e["ts"] = e["ts"] + off
+                shifted += 1
+    return shifted
 
 
 def chrome_trace(events: list[dict]) -> dict:
@@ -213,12 +296,21 @@ def main(argv=None) -> int:
         description="Summarize/export flight-recorder timeline JSONL"
     )
     ap.add_argument(
-        "path", help="timeline JSONL (TPU_ML_TIMELINE_PATH) or Chrome trace JSON"
+        "paths", nargs="+", metavar="PATH",
+        help="timeline JSONL (TPU_ML_TIMELINE_PATH), Chrome trace JSON, "
+             "replica .trailer JSON or fleet event dump; several paths "
+             "merge into one fleet trace",
     )
     ap.add_argument(
         "--out", metavar="TRACE_JSON", default="",
         help="write the selected records merged as Chrome trace JSON "
              "(load in Perfetto / chrome://tracing)",
+    )
+    ap.add_argument(
+        "--offsets", metavar="JSON", default="",
+        help="per-replica clock offsets (us) from the READY handshake: a "
+             "fleet stats() JSON (clock_offsets_us) or a flat "
+             "{basename-or-pid: offset_us} mapping, as a file or inline",
     )
     ap.add_argument(
         "--last", type=int, default=0, metavar="N",
@@ -242,11 +334,22 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
-    try:
-        records = load_records(args.path)
-    except (OSError, json.JSONDecodeError) as e:
-        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
-        return 1
+    records = []
+    for path in args.paths:
+        try:
+            records.extend(load_records(path))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            return 1
+    if args.offsets:
+        try:
+            offsets = load_offsets(args.offsets)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: bad --offsets: {e}", file=sys.stderr)
+            return 1
+        shifted = apply_offsets(records, offsets)
+        if shifted:
+            print(f"clock-corrected {shifted} events onto the router clock")
     if args.fit:
         records = [r for r in records if r.get("fit_id") == args.fit]
     if args.transform:
@@ -256,19 +359,41 @@ def main(argv=None) -> int:
     if args.last > 0:
         records = records[-args.last:]
     if not records:
-        print(f"no timeline records in {args.path}", file=sys.stderr)
+        print(f"no timeline records in {', '.join(args.paths)}", file=sys.stderr)
         return 1
 
-    print(f"{len(records)} timeline record(s) from {args.path}")
+    print(f"{len(records)} timeline record(s) from {', '.join(args.paths)}")
     any_exceeded = False
     for rec in records:
         if summarize_record(rec, args.gap_threshold):
             any_exceeded = True
 
+    merged: list[dict] = []
+    for rec in records:
+        merged.extend(e for e in rec.get("events", []) if isinstance(e, dict))
+
+    if len(args.paths) > 1 or args.out:
+        # fleet view: trace-stitching coverage over the merged stream —
+        # best-effort, the tool stays usable without the package installed
+        try:
+            sys.path.insert(
+                0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            )
+            from spark_rapids_ml_tpu.telemetry import tracectx
+
+            cov = tracectx.coverage(merged)
+            if cov["traces"]:
+                print(
+                    f"\ntrace stitching: {cov['complete']}/{cov['traces']} "
+                    f"complete ({cov['coverage']:.2%}), "
+                    f"{cov['orphan_spans']} orphan spans, "
+                    f"{cov['multi_root']} multi-root"
+                )
+        except ImportError:
+            pass
+
     if args.out:
-        merged: list[dict] = []
-        for rec in records:
-            merged.extend(e for e in rec.get("events", []) if isinstance(e, dict))
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(chrome_trace(merged), f)
         print(f"\nwrote Chrome trace: {args.out} ({len(merged)} events)")
